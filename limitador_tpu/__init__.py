@@ -1,0 +1,55 @@
+"""limitador_tpu — a TPU-native rate-limiting framework.
+
+A brand-new implementation of the capabilities of Kuadrant/limitador
+(reference at /root/reference), restructured TPU-first: the hot
+check-and-update path micro-batches requests, hashes counter keys into a
+dense device-resident slot table, and decides admission in one fused
+JAX/XLA kernel (expiry + within-batch exact serial admission + scatter-add),
+sharded across chips with psum for cross-shard reads.
+
+Public surface mirrors the reference crate:
+
+    from limitador_tpu import RateLimiter, Limit, Context
+    limiter = RateLimiter()
+    limiter.add_limit(Limit("ns", max_value=10, seconds=60))
+    result = limiter.check_rate_limited_and_update("ns", Context({}), 1)
+"""
+
+from .core.cel import (
+    Context,
+    EvaluationError,
+    Expression,
+    ParseError,
+    Predicate,
+)
+from .core.counter import Counter
+from .core.limit import Limit, Namespace
+from .core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
+from .storage.base import (
+    AsyncCounterStorage,
+    Authorization,
+    CounterStorage,
+    StorageError,
+)
+from .storage.in_memory import InMemoryStorage
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Context",
+    "Counter",
+    "CheckResult",
+    "Expression",
+    "EvaluationError",
+    "Limit",
+    "Namespace",
+    "ParseError",
+    "Predicate",
+    "RateLimiter",
+    "AsyncRateLimiter",
+    "Authorization",
+    "CounterStorage",
+    "AsyncCounterStorage",
+    "InMemoryStorage",
+    "StorageError",
+]

@@ -1,0 +1,16 @@
+from .cel import Context, Expression, Predicate
+from .counter import Counter
+from .limit import Limit, Namespace
+from .limiter import AsyncRateLimiter, CheckResult, RateLimiter
+
+__all__ = [
+    "Context",
+    "Expression",
+    "Predicate",
+    "Counter",
+    "Limit",
+    "Namespace",
+    "AsyncRateLimiter",
+    "CheckResult",
+    "RateLimiter",
+]

@@ -1,0 +1,1129 @@
+"""CEL (Common Expression Language) subset engine.
+
+The reference binds the Rust ``cel`` crate for limit conditions and variables
+(/root/reference/limitador/src/limit/cel.rs). No CEL library ships in this
+environment, so this is a from-scratch implementation of the CEL subset that
+limitador's semantics require:
+
+- ``Predicate`` — boolean condition over a request ``Context``; returns False
+  (never errors) when a referenced root variable is absent or a map key is
+  missing (cel.rs:321-339), errors on non-bool results.
+- ``Expression`` — value expression whose result is stringified for counter
+  qualification; ``eval`` returns ``None`` on missing map keys (cel.rs:176-192);
+  ``eval_map`` extracts a string->string map for metric labels (cel.rs:194-209).
+- ``Context`` — named bindings, the Envoy ``descriptors`` list-of-maps binding
+  (cel.rs:99-110), and the per-limit ``limit.name``/``limit.id`` inner scope
+  (cel.rs:112-140).
+
+Besides interpretation, expressions expose a structural AST (``Expr``) so the
+TPU limit compiler (limitador_tpu/tpu/compiler.py) can translate the common
+predicate shapes (``descriptors[0].key == 'value'`` etc.) into vectorized
+masks over interned token ids; anything it cannot vectorize falls back to this
+interpreter on the host.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+__all__ = [
+    "CelError",
+    "ParseError",
+    "EvaluationError",
+    "NoSuchKey",
+    "UndeclaredReference",
+    "Context",
+    "Expression",
+    "Predicate",
+    "parse",
+]
+
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class CelError(Exception):
+    """Base class for CEL errors."""
+
+
+class ParseError(CelError):
+    def __init__(self, source: str, message: str):
+        super().__init__(f"couldn't parse {source!r}: {message}")
+        self.source = source
+        self.message = message
+
+
+class EvaluationError(CelError):
+    """Runtime evaluation failure (type errors, bad arguments, ...)."""
+
+
+class NoSuchKey(EvaluationError):
+    """A map was indexed with a key it does not contain."""
+
+    def __init__(self, key: Any):
+        super().__init__(f"no such key: {key!r}")
+        self.key = key
+
+
+class UndeclaredReference(EvaluationError):
+    """An identifier did not resolve to any binding in the context."""
+
+    def __init__(self, name: str):
+        super().__init__(f"undeclared reference to {name!r}")
+        self.name = name
+
+
+# ---------------------------------------------------------------------------
+# Values
+#
+# CEL values map onto Python values:
+#   int/uint -> int (uint tracked by the Uint wrapper only transiently)
+#   double   -> float
+#   string   -> str
+#   bool     -> bool
+#   bytes    -> bytes
+#   null     -> None
+#   list     -> list
+#   map      -> dict
+#   timestamp-> datetime.datetime (aware)
+#   duration -> datetime.timedelta
+# ---------------------------------------------------------------------------
+
+
+_RFC3339 = re.compile(
+    r"^(\d{4})-(\d{2})-(\d{2})[Tt](\d{2}):(\d{2}):(\d{2})(\.\d+)?"
+    r"([Zz]|[+-]\d{2}:\d{2})$"
+)
+
+
+def _parse_timestamp(s: str) -> _dt.datetime:
+    m = _RFC3339.match(s)
+    if not m:
+        raise EvaluationError(f"invalid timestamp: {s!r}")
+    year, month, day, hh, mm, ss = (int(m.group(i)) for i in range(1, 7))
+    frac = m.group(7)
+    micros = int(round(float(frac) * 1_000_000)) if frac else 0
+    tzs = m.group(8)
+    if tzs in ("Z", "z"):
+        tz = _dt.timezone.utc
+    else:
+        sign = 1 if tzs[0] == "+" else -1
+        tz = _dt.timezone(
+            sign * _dt.timedelta(hours=int(tzs[1:3]), minutes=int(tzs[4:6]))
+        )
+    return _dt.datetime(year, month, day, hh, mm, ss, micros, tzinfo=tz)
+
+
+_DURATION_RE = re.compile(r"([+-]?\d+(?:\.\d+)?)(h|m|s|ms|us|ns)")
+
+
+def _parse_duration(s: str) -> _dt.timedelta:
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise EvaluationError(f"invalid duration: {s!r}")
+        pos = m.end()
+        qty = float(m.group(1))
+        unit = m.group(2)
+        total += qty * {
+            "h": 3600.0,
+            "m": 60.0,
+            "s": 1.0,
+            "ms": 1e-3,
+            "us": 1e-6,
+            "ns": 1e-9,
+        }[unit]
+    if pos != len(s) or pos == 0:
+        raise EvaluationError(f"invalid duration: {s!r}")
+    return _dt.timedelta(seconds=total)
+
+
+def format_value(value: Any) -> str:
+    """Stringify a CEL value the way the reference does (cel.rs:176-192)."""
+    if value is None:
+        return "null"
+    if value is True:
+        return "true"
+    if value is False:
+        return "false"
+    if isinstance(value, int):
+        return str(value)
+    if isinstance(value, float):
+        if value != value:  # NaN
+            return "NaN"
+        if value == float("inf"):
+            return "inf"
+        if value == float("-inf"):
+            return "-inf"
+        s = repr(value)
+        if s.endswith(".0"):
+            s = s[:-2]
+        return s
+    if isinstance(value, str):
+        return value
+    raise EvaluationError(f"unexpected value of type {_type_name(value)}: {value!r}")
+
+
+def _type_name(v: Any) -> str:
+    if v is None:
+        return "null"
+    if isinstance(v, bool):
+        return "bool"
+    if isinstance(v, int):
+        return "int"
+    if isinstance(v, float):
+        return "double"
+    if isinstance(v, str):
+        return "string"
+    if isinstance(v, bytes):
+        return "bytes"
+    if isinstance(v, list):
+        return "list"
+    if isinstance(v, dict):
+        return "map"
+    if isinstance(v, _dt.datetime):
+        return "timestamp"
+    if isinstance(v, _dt.timedelta):
+        return "duration"
+    return type(v).__name__
+
+
+# ---------------------------------------------------------------------------
+# AST
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Expr:
+    """Base AST node."""
+
+
+@dataclass(frozen=True)
+class Literal(Expr):
+    value: Any
+
+
+@dataclass(frozen=True)
+class Ident(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class Select(Expr):
+    operand: Expr
+    field: str
+
+
+@dataclass(frozen=True)
+class Index(Expr):
+    operand: Expr
+    index: Expr
+
+
+@dataclass(frozen=True)
+class Call(Expr):
+    target: Optional[Expr]  # method receiver, None for global functions
+    function: str
+    args: Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class Unary(Expr):
+    op: str  # '!' or '-'
+    operand: Expr
+
+
+@dataclass(frozen=True)
+class Binary(Expr):
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class Ternary(Expr):
+    cond: Expr
+    then: Expr
+    otherwise: Expr
+
+
+@dataclass(frozen=True)
+class ListExpr(Expr):
+    items: Tuple[Expr, ...]
+
+
+@dataclass(frozen=True)
+class MapExpr(Expr):
+    entries: Tuple[Tuple[Expr, Expr], ...]
+
+
+# ---------------------------------------------------------------------------
+# Tokenizer
+# ---------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>//[^\n]*)
+  | (?P<float>\d+\.\d+(?:[eE][+-]?\d+)?|\d+[eE][+-]?\d+|\.\d+(?:[eE][+-]?\d+)?)
+  | (?P<uint>(?:0x[0-9a-fA-F]+|\d+)[uU])
+  | (?P<int>0x[0-9a-fA-F]+|\d+)
+  | (?P<string>
+        [rR]?"(?:\\.|[^"\\])*"
+      | [rR]?'(?:\\.|[^'\\])*'
+    )
+  | (?P<bytes>[bB][rR]?"(?:\\.|[^"\\])*"|[bB][rR]?'(?:\\.|[^'\\])*')
+  | (?P<ident>[_a-zA-Z][_a-zA-Z0-9]*)
+  | (?P<op>\|\||&&|==|!=|<=|>=|[-+*/%!<>?:.,()\[\]{}])
+    """,
+    re.VERBOSE,
+)
+
+_ESCAPES = {
+    "n": "\n",
+    "t": "\t",
+    "r": "\r",
+    '"': '"',
+    "'": "'",
+    "\\": "\\",
+    "a": "\a",
+    "b": "\b",
+    "f": "\f",
+    "v": "\v",
+    "0": "\0",
+    "`": "`",
+    "?": "?",
+}
+
+
+@dataclass
+class _Token:
+    kind: str
+    value: Any
+    pos: int
+
+
+def _unescape(body: str, source: str) -> str:
+    out: List[str] = []
+    i = 0
+    while i < len(body):
+        c = body[i]
+        if c != "\\":
+            out.append(c)
+            i += 1
+            continue
+        i += 1
+        if i >= len(body):
+            raise ParseError(source, "dangling escape")
+        e = body[i]
+        if e in _ESCAPES:
+            out.append(_ESCAPES[e])
+            i += 1
+        elif e in ("x", "u", "U"):
+            width = {"x": 2, "u": 4, "U": 8}[e]
+            digits = body[i + 1 : i + 1 + width]
+            if len(digits) != width:
+                raise ParseError(source, f"truncated \\{e} escape")
+            try:
+                out.append(chr(int(digits, 16)))
+            except ValueError:
+                raise ParseError(source, f"invalid \\{e} escape {digits!r}") from None
+            i += 1 + width
+        else:
+            raise ParseError(source, f"unknown escape \\{e}")
+    return "".join(out)
+
+
+def _tokenize(source: str) -> List[_Token]:
+    tokens: List[_Token] = []
+    pos = 0
+    while pos < len(source):
+        m = _TOKEN_RE.match(source, pos)
+        if not m:
+            raise ParseError(source, f"unexpected character {source[pos]!r} at {pos}")
+        kind = m.lastgroup or ""
+        text = m.group()
+        if kind not in ("ws", "comment"):
+            if kind == "float":
+                tokens.append(_Token("num", float(text), pos))
+            elif kind == "uint":
+                tokens.append(_Token("num", int(text[:-1], 0), pos))
+            elif kind == "int":
+                tokens.append(_Token("num", int(text, 0), pos))
+            elif kind == "string":
+                raw = text[0] in "rR"
+                body = text[2:-1] if raw else text[1:-1]
+                tokens.append(
+                    _Token("str", body if raw else _unescape(body, source), pos)
+                )
+            elif kind == "bytes":
+                t = text[1:]
+                raw = t[0] in "rR"
+                body = t[2:-1] if raw else t[1:-1]
+                s = body if raw else _unescape(body, source)
+                tokens.append(_Token("bytes", s.encode("latin-1"), pos))
+            elif kind == "ident":
+                tokens.append(_Token("ident", text, pos))
+            else:
+                tokens.append(_Token("op", text, pos))
+        pos = m.end()
+    tokens.append(_Token("eof", None, pos))
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# Parser (recursive descent, CEL precedence)
+# ---------------------------------------------------------------------------
+
+
+class _Parser:
+    def __init__(self, source: str):
+        self.source = source
+        self.tokens = _tokenize(source)
+        self.i = 0
+
+    @property
+    def tok(self) -> _Token:
+        return self.tokens[self.i]
+
+    def _advance(self) -> _Token:
+        t = self.tok
+        self.i += 1
+        return t
+
+    def _expect_op(self, op: str) -> None:
+        t = self.tok
+        if t.kind != "op" or t.value != op:
+            raise ParseError(self.source, f"expected {op!r}, found {t.value!r}")
+        self.i += 1
+
+    def _match_op(self, *ops: str) -> Optional[str]:
+        t = self.tok
+        if t.kind == "op" and t.value in ops:
+            self.i += 1
+            return t.value
+        return None
+
+    def parse(self) -> Expr:
+        e = self.expr()
+        if self.tok.kind != "eof":
+            raise ParseError(self.source, f"trailing input at {self.tok.pos}")
+        return e
+
+    def expr(self) -> Expr:
+        cond = self.or_expr()
+        if self._match_op("?"):
+            then = self.or_expr()
+            self._expect_op(":")
+            otherwise = self.expr()
+            return Ternary(cond, then, otherwise)
+        return cond
+
+    def or_expr(self) -> Expr:
+        left = self.and_expr()
+        while self._match_op("||"):
+            left = Binary("||", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> Expr:
+        left = self.rel_expr()
+        while self._match_op("&&"):
+            left = Binary("&&", left, self.rel_expr())
+        return left
+
+    def rel_expr(self) -> Expr:
+        left = self.add_expr()
+        while True:
+            op = self._match_op("==", "!=", "<", "<=", ">", ">=")
+            if op is None:
+                if self.tok.kind == "ident" and self.tok.value == "in":
+                    self.i += 1
+                    op = "in"
+                else:
+                    return left
+            left = Binary(op, left, self.add_expr())
+
+    def add_expr(self) -> Expr:
+        left = self.mul_expr()
+        while True:
+            op = self._match_op("+", "-")
+            if op is None:
+                return left
+            left = Binary(op, left, self.mul_expr())
+
+    def mul_expr(self) -> Expr:
+        left = self.unary_expr()
+        while True:
+            op = self._match_op("*", "/", "%")
+            if op is None:
+                return left
+            left = Binary(op, left, self.unary_expr())
+
+    def unary_expr(self) -> Expr:
+        if self._match_op("!"):
+            return Unary("!", self.unary_expr())
+        if self._match_op("-"):
+            return Unary("-", self.unary_expr())
+        return self.member_expr()
+
+    def member_expr(self) -> Expr:
+        e = self.primary()
+        while True:
+            if self._match_op("."):
+                t = self._advance()
+                if t.kind != "ident":
+                    raise ParseError(self.source, f"expected field name, got {t.value!r}")
+                if self._match_op("("):
+                    args = self._call_args()
+                    e = Call(e, t.value, tuple(args))
+                else:
+                    e = Select(e, t.value)
+            elif self._match_op("["):
+                idx = self.expr()
+                self._expect_op("]")
+                e = Index(e, idx)
+            else:
+                return e
+
+    def _call_args(self) -> List[Expr]:
+        args: List[Expr] = []
+        if self._match_op(")"):
+            return args
+        while True:
+            args.append(self.expr())
+            if self._match_op(")"):
+                return args
+            self._expect_op(",")
+
+    def primary(self) -> Expr:
+        t = self.tok
+        if t.kind == "num":
+            self.i += 1
+            return Literal(t.value)
+        if t.kind == "str":
+            self.i += 1
+            return Literal(t.value)
+        if t.kind == "bytes":
+            self.i += 1
+            return Literal(t.value)
+        if t.kind == "ident":
+            self.i += 1
+            name = t.value
+            if name == "true":
+                return Literal(True)
+            if name == "false":
+                return Literal(False)
+            if name == "null":
+                return Literal(None)
+            if self._match_op("("):
+                args = self._call_args()
+                return Call(None, name, tuple(args))
+            return Ident(name)
+        if self._match_op("("):
+            e = self.expr()
+            self._expect_op(")")
+            return e
+        if self._match_op("["):
+            items: List[Expr] = []
+            if not self._match_op("]"):
+                while True:
+                    items.append(self.expr())
+                    if self._match_op("]"):
+                        break
+                    self._expect_op(",")
+            return ListExpr(tuple(items))
+        if self._match_op("{"):
+            entries: List[Tuple[Expr, Expr]] = []
+            if not self._match_op("}"):
+                while True:
+                    k = self.expr()
+                    self._expect_op(":")
+                    v = self.expr()
+                    entries.append((k, v))
+                    if self._match_op("}"):
+                        break
+                    self._expect_op(",")
+            return MapExpr(tuple(entries))
+        raise ParseError(self.source, f"unexpected token {t.value!r} at {t.pos}")
+
+
+def parse(source: str) -> Expr:
+    return _Parser(source).parse()
+
+
+def references(node: Expr) -> set:
+    """Root identifiers referenced by an expression (cel crate references())."""
+    out: set = set()
+
+    def walk(e: Expr) -> None:
+        if isinstance(e, Ident):
+            out.add(e.name)
+        elif isinstance(e, Select):
+            walk(e.operand)
+        elif isinstance(e, Index):
+            walk(e.operand)
+            walk(e.index)
+        elif isinstance(e, Call):
+            if e.target is not None:
+                walk(e.target)
+            for a in e.args:
+                walk(a)
+        elif isinstance(e, Unary):
+            walk(e.operand)
+        elif isinstance(e, Binary):
+            walk(e.left)
+            walk(e.right)
+        elif isinstance(e, Ternary):
+            walk(e.cond)
+            walk(e.then)
+            walk(e.otherwise)
+        elif isinstance(e, ListExpr):
+            for it in e.items:
+                walk(it)
+        elif isinstance(e, MapExpr):
+            for k, v in e.entries:
+                walk(k)
+                walk(v)
+
+    walk(node)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Evaluator
+# ---------------------------------------------------------------------------
+
+
+def _is_num(v: Any) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _eq(a: Any, b: Any) -> bool:
+    if _is_num(a) and _is_num(b):
+        return a == b
+    if type(a) is bool or type(b) is bool:
+        return a is b if isinstance(a, bool) and isinstance(b, bool) else False
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, str) and isinstance(b, str):
+        return a == b
+    if isinstance(a, bytes) and isinstance(b, bytes):
+        return a == b
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(_eq(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict) and isinstance(b, dict):
+        if len(a) != len(b):
+            return False
+        for k, v in a.items():
+            if k not in b or not _eq(v, b[k]):
+                return False
+        return True
+    if isinstance(a, (_dt.datetime, _dt.timedelta)) and type(a) is type(b):
+        return a == b
+    return False
+
+
+def _cmp(op: str, a: Any, b: Any) -> bool:
+    ok = (
+        (_is_num(a) and _is_num(b))
+        or (isinstance(a, str) and isinstance(b, str))
+        or (isinstance(a, bytes) and isinstance(b, bytes))
+        or (isinstance(a, _dt.datetime) and isinstance(b, _dt.datetime))
+        or (isinstance(a, _dt.timedelta) and isinstance(b, _dt.timedelta))
+        or (isinstance(a, bool) and isinstance(b, bool))
+    )
+    if not ok:
+        raise EvaluationError(
+            f"cannot compare {_type_name(a)} with {_type_name(b)}"
+        )
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
+
+
+class _Evaluator:
+    def __init__(self, ctx: "Context"):
+        self.ctx = ctx
+
+    def eval(self, e: Expr) -> Any:
+        method = getattr(self, "_eval_" + type(e).__name__)
+        return method(e)
+
+    def _eval_Literal(self, e: Literal) -> Any:
+        return e.value
+
+    def _eval_Ident(self, e: Ident) -> Any:
+        return self.ctx._lookup(e.name)
+
+    def _eval_Select(self, e: Select) -> Any:
+        operand = self.eval(e.operand)
+        if isinstance(operand, dict):
+            if e.field in operand:
+                return operand[e.field]
+            raise NoSuchKey(e.field)
+        raise EvaluationError(
+            f"cannot access field {e.field!r} on {_type_name(operand)}"
+        )
+
+    def _eval_Index(self, e: Index) -> Any:
+        operand = self.eval(e.operand)
+        idx = self.eval(e.index)
+        if isinstance(operand, list):
+            if not isinstance(idx, int) or isinstance(idx, bool):
+                raise EvaluationError(f"list index must be int, got {_type_name(idx)}")
+            if 0 <= idx < len(operand):
+                return operand[idx]
+            raise EvaluationError(f"list index out of range: {idx}")
+        if isinstance(operand, dict):
+            if idx in operand:
+                return operand[idx]
+            raise NoSuchKey(idx)
+        raise EvaluationError(f"cannot index {_type_name(operand)}")
+
+    def _eval_Unary(self, e: Unary) -> Any:
+        v = self.eval(e.operand)
+        if e.op == "!":
+            if isinstance(v, bool):
+                return not v
+            raise EvaluationError(f"cannot negate {_type_name(v)}")
+        # '-'
+        if _is_num(v):
+            return -v
+        raise EvaluationError(f"cannot apply unary '-' to {_type_name(v)}")
+
+    def _eval_Binary(self, e: Binary) -> Any:
+        op = e.op
+        if op == "||":
+            left = self.eval(e.left)
+            if left is True:
+                return True
+            right = self.eval(e.right)
+            if not isinstance(left, bool) or not isinstance(right, bool):
+                raise EvaluationError("'||' requires bool operands")
+            return left or right
+        if op == "&&":
+            left = self.eval(e.left)
+            if left is False:
+                return False
+            right = self.eval(e.right)
+            if not isinstance(left, bool) or not isinstance(right, bool):
+                raise EvaluationError("'&&' requires bool operands")
+            return left and right
+
+        a = self.eval(e.left)
+        b = self.eval(e.right)
+        if op == "==":
+            return _eq(a, b)
+        if op == "!=":
+            return not _eq(a, b)
+        if op in ("<", "<=", ">", ">="):
+            return _cmp(op, a, b)
+        if op == "in":
+            if isinstance(b, list):
+                return any(_eq(a, x) for x in b)
+            if isinstance(b, dict):
+                return a in b
+            if isinstance(b, str) and isinstance(a, str):
+                return a in b
+            raise EvaluationError(f"cannot test membership in {_type_name(b)}")
+        if op == "+":
+            if isinstance(a, str) and isinstance(b, str):
+                return a + b
+            if isinstance(a, bytes) and isinstance(b, bytes):
+                return a + b
+            if isinstance(a, list) and isinstance(b, list):
+                return a + b
+            if _is_num(a) and _is_num(b):
+                return a + b
+            if isinstance(a, _dt.datetime) and isinstance(b, _dt.timedelta):
+                return a + b
+            if isinstance(a, _dt.timedelta) and isinstance(b, _dt.datetime):
+                return b + a
+            if isinstance(a, _dt.timedelta) and isinstance(b, _dt.timedelta):
+                return a + b
+            raise EvaluationError(
+                f"cannot add {_type_name(a)} and {_type_name(b)}"
+            )
+        if op == "-":
+            if _is_num(a) and _is_num(b):
+                return a - b
+            if isinstance(a, _dt.datetime) and isinstance(b, _dt.timedelta):
+                return a - b
+            if isinstance(a, _dt.datetime) and isinstance(b, _dt.datetime):
+                return a - b
+            if isinstance(a, _dt.timedelta) and isinstance(b, _dt.timedelta):
+                return a - b
+            raise EvaluationError(
+                f"cannot subtract {_type_name(b)} from {_type_name(a)}"
+            )
+        if op == "*":
+            if _is_num(a) and _is_num(b):
+                return a * b
+            raise EvaluationError(
+                f"cannot multiply {_type_name(a)} and {_type_name(b)}"
+            )
+        if op == "/":
+            if _is_num(a) and _is_num(b):
+                if b == 0:
+                    raise EvaluationError("division by zero")
+                if isinstance(a, int) and isinstance(b, int):
+                    q = abs(a) // abs(b)  # CEL int division truncates toward zero
+                    return q if (a >= 0) == (b >= 0) else -q
+                return a / b
+            raise EvaluationError(
+                f"cannot divide {_type_name(a)} by {_type_name(b)}"
+            )
+        if op == "%":
+            if isinstance(a, int) and isinstance(b, int) and not isinstance(a, bool):
+                if b == 0:
+                    raise EvaluationError("modulo by zero")
+                r = abs(a) % abs(b)  # truncated toward zero, sign of dividend
+                return r if a >= 0 else -r
+            raise EvaluationError(
+                f"cannot apply '%' to {_type_name(a)} and {_type_name(b)}"
+            )
+        raise EvaluationError(f"unknown operator {op!r}")
+
+    def _eval_Ternary(self, e: Ternary) -> Any:
+        cond = self.eval(e.cond)
+        if not isinstance(cond, bool):
+            raise EvaluationError("ternary condition must be bool")
+        return self.eval(e.then) if cond else self.eval(e.otherwise)
+
+    def _eval_ListExpr(self, e: ListExpr) -> Any:
+        return [self.eval(x) for x in e.items]
+
+    def _eval_MapExpr(self, e: MapExpr) -> Any:
+        out: Dict[Any, Any] = {}
+        for k, v in e.entries:
+            out[self.eval(k)] = self.eval(v)
+        return out
+
+    # -- functions ---------------------------------------------------------
+
+    def _eval_Call(self, e: Call) -> Any:
+        if e.target is None:
+            return self._call_global(e.function, [self.eval(a) for a in e.args])
+        recv = self.eval(e.target)
+        return self._call_method(recv, e.function, [self.eval(a) for a in e.args])
+
+    def _call_global(self, fn: str, args: List[Any]) -> Any:
+        if fn == "size":
+            (v,) = args
+            if isinstance(v, (str, bytes, list, dict)):
+                return len(v)
+            raise EvaluationError(f"size() not supported for {_type_name(v)}")
+        if fn == "string":
+            (v,) = args
+            return format_value(v)
+        if fn == "int":
+            (v,) = args
+            if isinstance(v, bool):
+                raise EvaluationError("int() of bool")
+            if isinstance(v, (int, float)):
+                return int(v)
+            if isinstance(v, str):
+                try:
+                    return int(v, 10)
+                except ValueError as err:
+                    raise EvaluationError(str(err)) from None
+            if isinstance(v, _dt.datetime):
+                return int(v.timestamp())
+            raise EvaluationError(f"int() not supported for {_type_name(v)}")
+        if fn == "uint":
+            v = self._call_global("int", args)
+            if v < 0:
+                raise EvaluationError("uint() of negative value")
+            return v
+        if fn == "double":
+            (v,) = args
+            if isinstance(v, bool):
+                raise EvaluationError("double() of bool")
+            if isinstance(v, (int, float)):
+                return float(v)
+            if isinstance(v, str):
+                try:
+                    return float(v)
+                except ValueError as err:
+                    raise EvaluationError(str(err)) from None
+            raise EvaluationError(f"double() not supported for {_type_name(v)}")
+        if fn == "bytes":
+            (v,) = args
+            if isinstance(v, str):
+                return v.encode("utf-8")
+            if isinstance(v, bytes):
+                return v
+            raise EvaluationError(f"bytes() not supported for {_type_name(v)}")
+        if fn == "timestamp":
+            (v,) = args
+            if isinstance(v, str):
+                return _parse_timestamp(v)
+            if isinstance(v, _dt.datetime):
+                return v
+            raise EvaluationError(f"timestamp() not supported for {_type_name(v)}")
+        if fn == "duration":
+            (v,) = args
+            if isinstance(v, str):
+                return _parse_duration(v)
+            if isinstance(v, _dt.timedelta):
+                return v
+            raise EvaluationError(f"duration() not supported for {_type_name(v)}")
+        if fn == "matches":
+            s, pattern = args
+            return self._call_method(s, "matches", [pattern])
+        if fn == "has":
+            raise EvaluationError("has() must be applied to a field selection")
+        raise EvaluationError(f"unknown function {fn!r}")
+
+    def _call_method(self, recv: Any, fn: str, args: List[Any]) -> Any:
+        if fn in ("startsWith", "endsWith", "contains", "matches"):
+            if not isinstance(recv, str) or len(args) != 1 or not isinstance(args[0], str):
+                raise EvaluationError(f"{fn}() requires string receiver and argument")
+            if fn == "startsWith":
+                return recv.startswith(args[0])
+            if fn == "endsWith":
+                return recv.endswith(args[0])
+            if fn == "contains":
+                return args[0] in recv
+            try:
+                return re.search(args[0], recv) is not None
+            except re.error as err:
+                raise EvaluationError(f"invalid regex: {err}") from None
+        if fn == "size" and not args:
+            return self._call_global("size", [recv])
+        if fn in ("lowerAscii", "upperAscii"):
+            if not isinstance(recv, str):
+                raise EvaluationError(f"{fn}() requires string receiver")
+            return recv.lower() if fn == "lowerAscii" else recv.upper()
+        if isinstance(recv, _dt.datetime):
+            return self._timestamp_method(recv, fn, args)
+        if isinstance(recv, _dt.timedelta):
+            return self._duration_method(recv, fn, args)
+        raise EvaluationError(f"unknown method {fn!r} on {_type_name(recv)}")
+
+    @staticmethod
+    def _tz(recv: _dt.datetime, args: List[Any]) -> _dt.datetime:
+        if not args:
+            return recv.astimezone(_dt.timezone.utc)
+        spec = args[0]
+        if not isinstance(spec, str):
+            raise EvaluationError("timezone must be a string")
+        m = re.match(r"^([+-])(\d{2}):(\d{2})$", spec)
+        if m:
+            sign = 1 if m.group(1) == "+" else -1
+            tz = _dt.timezone(
+                sign * _dt.timedelta(hours=int(m.group(2)), minutes=int(m.group(3)))
+            )
+            return recv.astimezone(tz)
+        if spec in ("UTC", "Z"):
+            return recv.astimezone(_dt.timezone.utc)
+        raise EvaluationError(f"unsupported timezone {spec!r}")
+
+    def _timestamp_method(self, recv: _dt.datetime, fn: str, args: List[Any]) -> Any:
+        t = self._tz(recv, args)
+        if fn == "getHours":
+            return t.hour
+        if fn == "getMinutes":
+            return t.minute
+        if fn == "getSeconds":
+            return t.second
+        if fn == "getMilliseconds":
+            return t.microsecond // 1000
+        if fn == "getFullYear":
+            return t.year
+        if fn == "getMonth":  # 0-based per CEL spec
+            return t.month - 1
+        if fn == "getDate":  # 1-based day of month
+            return t.day
+        if fn == "getDayOfMonth":  # 0-based per CEL spec
+            return t.day - 1
+        if fn == "getDayOfWeek":  # 0 = Sunday per CEL spec
+            return (t.weekday() + 1) % 7
+        if fn == "getDayOfYear":  # 0-based
+            return t.timetuple().tm_yday - 1
+        raise EvaluationError(f"unknown timestamp method {fn!r}")
+
+    @staticmethod
+    def _duration_method(recv: _dt.timedelta, fn: str, args: List[Any]) -> Any:
+        total = recv.total_seconds()
+        if fn == "getHours":
+            return int(total // 3600)
+        if fn == "getMinutes":
+            return int(total // 60)
+        if fn == "getSeconds":
+            return int(total)
+        if fn == "getMilliseconds":
+            return int(total * 1000)
+        raise EvaluationError(f"unknown duration method {fn!r}")
+
+
+# ---------------------------------------------------------------------------
+# Public surface mirroring the reference binding
+# ---------------------------------------------------------------------------
+
+
+class Context:
+    """Evaluation context: named bindings + the set of declared root variables.
+
+    Mirrors cel.rs:76-145. ``variables`` is the set used by ``Predicate.test``'s
+    missing-variable short-circuit and by ``Limit.applies``'s
+    ``has_variables`` check; the ``limit`` binding added by ``for_limit`` is
+    deliberately NOT part of it (cel.rs:112-140).
+    """
+
+    __slots__ = ("variables", "_bindings")
+
+    def __init__(
+        self,
+        values: Optional[Dict[str, str]] = None,
+        root: str = "",
+    ):
+        self.variables: set = set()
+        self._bindings: Dict[str, Any] = {}
+        if root == "":
+            for k, v in (values or {}).items():
+                self._bindings[k] = v
+                self.variables.add(k)
+        else:
+            self._bindings[root] = dict(values or {})
+
+    @classmethod
+    def from_values(cls, values: Dict[str, str]) -> "Context":
+        return cls(values)
+
+    def list_binding(self, name: str, value: Sequence[Dict[str, str]]) -> None:
+        """Bind a list of string maps (Envoy descriptors), cel.rs:99-110."""
+        self.variables.add(name)
+        self._bindings[name] = [dict(m) for m in value]
+
+    def for_limit(self, limit: Any) -> "Context":
+        inner = Context()
+        inner.variables = set(self.variables)
+        inner._bindings = dict(self._bindings)
+        inner._bindings["limit"] = {
+            "name": limit.name,
+            "id": limit.id,
+        }
+        return inner
+
+    def has_variables(self, names: Sequence[str]) -> bool:
+        return all(n in self.variables for n in names)
+
+    def _lookup(self, name: str) -> Any:
+        if name in self._bindings:
+            return self._bindings[name]
+        raise UndeclaredReference(name)
+
+    def __repr__(self) -> str:
+        return f"Context({self._bindings!r})"
+
+
+class Expression:
+    """A parsed CEL value expression (cel.rs:161-227)."""
+
+    __slots__ = ("source", "ast", "_refs")
+
+    def __init__(self, source: str):
+        source = str(source)
+        self.source = source
+        self.ast = parse(source)
+        self._refs = frozenset(references(self.ast))
+
+    @classmethod
+    def parse(cls, source: str) -> "Expression":
+        return cls(source)
+
+    def eval(self, ctx: Context) -> Optional[str]:
+        """Evaluate and stringify; None when a map key is missing."""
+        try:
+            value = _Evaluator(ctx).eval(self.ast)
+        except NoSuchKey:
+            return None
+        return format_value(value)
+
+    def eval_map(self, ctx: Context) -> Dict[str, str]:
+        value = _Evaluator(ctx).eval(self.ast)
+        if isinstance(value, dict):
+            return {
+                k: v
+                for k, v in value.items()
+                if isinstance(k, str) and isinstance(v, str)
+            }
+        return {}
+
+    def resolve(self, ctx: Context) -> Any:
+        return _Evaluator(ctx).eval(self.ast)
+
+    def variables(self) -> List[str]:
+        return sorted(self._refs)
+
+    # Value-semantics keyed on source text, like the reference (cel.rs:273-297)
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Expression) and self.source == other.source
+
+    def __lt__(self, other: "Expression") -> bool:
+        return self.source < other.source
+
+    def __hash__(self) -> int:
+        return hash(self.source)
+
+    def __repr__(self) -> str:
+        return f"Expression({self.source!r})"
+
+
+class Predicate:
+    """A parsed CEL boolean condition (cel.rs:301-340)."""
+
+    __slots__ = ("expression", "_vars")
+
+    def __init__(self, source: str):
+        self.expression = Expression(source)
+        self._vars = self.expression._refs
+
+    @classmethod
+    def parse(cls, source: str) -> "Predicate":
+        return cls(source)
+
+    @property
+    def source(self) -> str:
+        return self.expression.source
+
+    def variables(self) -> List[str]:
+        return sorted(self._vars)
+
+    def test(self, ctx: Context) -> bool:
+        # Missing root variable (other than the injected `limit` scope) -> False
+        for v in self._vars:
+            if v != "limit" and v not in ctx.variables:
+                return False
+        try:
+            value = _Evaluator(ctx).eval(self.expression.ast)
+        except NoSuchKey:
+            return False
+        if isinstance(value, bool):
+            return value
+        raise EvaluationError(
+            f"unexpected value of type {_type_name(value)}: {value!r}"
+        )
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Predicate) and self.source == other.source
+
+    def __lt__(self, other: "Predicate") -> bool:
+        return self.source < other.source
+
+    def __hash__(self) -> int:
+        return hash(self.source)
+
+    def __repr__(self) -> str:
+        return f"Predicate({self.source!r})"

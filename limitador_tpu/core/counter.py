@@ -1,0 +1,106 @@
+"""Counter model.
+
+Mirrors /root/reference/limitador/src/counter.rs: a counter is a limit plus
+the resolved variable values that qualify it, with transient ``remaining`` /
+``expires_in`` observability fields excluded from identity
+(counter.rs:123-138).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+from .cel import Context
+from .limit import Limit, Namespace
+
+__all__ = ["Counter"]
+
+
+class Counter:
+    __slots__ = ("limit", "set_variables", "remaining", "expires_in")
+
+    def __init__(self, limit: Limit, set_variables: Dict[str, str]):
+        self.limit = limit
+        # BTreeMap semantics: store sorted by key.
+        self.set_variables: Dict[str, str] = dict(sorted(set_variables.items()))
+        self.remaining: Optional[int] = None
+        self.expires_in: Optional[float] = None  # seconds
+
+    @classmethod
+    def new(cls, limit: Limit, ctx: Context) -> Optional["Counter"]:
+        """Build from a context; None when a variable is unresolvable
+        (counter.rs:20-32)."""
+        variables = limit.resolve_variables(ctx)
+        if variables is None:
+            return None
+        return cls(limit, variables)
+
+    @classmethod
+    def resolved_vars(cls, limit: Limit, set_variables: Dict[str, str]) -> "Counter":
+        """Build from already-resolved variables, dropping ones the limit does
+        not declare (counter.rs:34-48)."""
+        vars_kept = {
+            k: v for k, v in set_variables.items() if limit.has_variable(k)
+        }
+        return cls(limit, vars_kept)
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def max_value(self) -> int:
+        return self.limit.max_value
+
+    @property
+    def namespace(self) -> Namespace:
+        return self.limit.namespace
+
+    @property
+    def id(self) -> Optional[str]:
+        return self.limit.id
+
+    @property
+    def window_seconds(self) -> int:
+        return self.limit.seconds
+
+    def is_qualified(self) -> bool:
+        return bool(self.set_variables)
+
+    def key(self) -> "Counter":
+        """Identity-only copy (no transient fields), counter.rs:51-58."""
+        return Counter(self.limit, self.set_variables)
+
+    def update_to_limit(self, limit: Limit) -> bool:
+        if limit == self.limit:
+            self.limit = limit
+            return True
+        return False
+
+    # -- identity (limit + set_variables only) -----------------------------
+
+    def _key(self) -> Tuple:
+        return (self.limit._key(), tuple(self.set_variables.items()))
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Counter) and self._key() == other._key()
+
+    def __hash__(self) -> int:
+        return hash(self._key())
+
+    def __repr__(self) -> str:
+        return (
+            f"Counter(limit={self.limit!r}, set_variables={self.set_variables!r}, "
+            f"remaining={self.remaining}, expires_in={self.expires_in})"
+        )
+
+    # -- DTO ---------------------------------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "limit": self.limit.to_dict(),
+            "set_variables": dict(self.set_variables),
+        }
+        if self.remaining is not None:
+            d["remaining"] = self.remaining
+        if self.expires_in is not None:
+            d["expires_in_seconds"] = self.expires_in
+        return d

@@ -1,0 +1,175 @@
+"""Limit model.
+
+Mirrors the reference's ``Limit``/``Namespace`` semantics
+(/root/reference/limitador/src/limit.rs):
+
+- identity (eq/hash/ordering) covers namespace, seconds, conditions and
+  variables but EXCLUDES id, name and max_value (limit.rs:177-214) — two
+  limits that differ only in max share the same counters;
+- ``applies(ctx)`` is true when every condition predicate tests true under
+  the per-limit scope AND every variable's root references are bound
+  (limit.rs:157-174);
+- ``resolve_variables(ctx)`` evaluates each variable expression, returning
+  None if any is unresolvable (missing map key) (limit.rs:133-148).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple, Union
+
+from .cel import Context, EvaluationError, Expression, Predicate
+
+__all__ = ["Namespace", "Limit"]
+
+
+class Namespace(str):
+    """A limit namespace; a plain string with nominal typing (limit.rs:12-31)."""
+
+    __slots__ = ()
+
+    @classmethod
+    def of(cls, value: Union[str, "Namespace"]) -> "Namespace":
+        return value if isinstance(value, Namespace) else cls(value)
+
+    def __repr__(self) -> str:
+        return f"Namespace({str.__repr__(self)})"
+
+
+def _as_predicate(p: Union[str, Predicate]) -> Predicate:
+    return p if isinstance(p, Predicate) else Predicate.parse(p)
+
+
+def _as_expression(e: Union[str, Expression]) -> Expression:
+    return e if isinstance(e, Expression) else Expression.parse(e)
+
+
+class Limit:
+    __slots__ = ("id", "namespace", "max_value", "seconds", "name",
+                 "conditions", "variables", "_identity", "_hash")
+
+    def __init__(
+        self,
+        namespace: Union[str, Namespace],
+        max_value: int,
+        seconds: int,
+        conditions: Iterable[Union[str, Predicate]] = (),
+        variables: Iterable[Union[str, Expression]] = (),
+        name: Optional[str] = None,
+        id: Optional[str] = None,
+    ):
+        self.id = id
+        self.namespace = Namespace.of(namespace)
+        self.max_value = int(max_value)
+        self.seconds = int(seconds)
+        self.name = name
+        # BTreeSet semantics: sorted, deduplicated, ordered by source text.
+        self.conditions: Tuple[Predicate, ...] = tuple(
+            sorted(set(_as_predicate(c) for c in conditions), key=lambda p: p.source)
+        )
+        self.variables: Tuple[Expression, ...] = tuple(
+            sorted(set(_as_expression(v) for v in variables), key=lambda e: e.source)
+        )
+        # Identity is immutable after construction; cache the tuple + hash —
+        # limits key hot-path dict lookups on every request.
+        self._identity = (
+            str(self.namespace),
+            self.seconds,
+            tuple(c.source for c in self.conditions),
+            tuple(v.source for v in self.variables),
+        )
+        self._hash = hash(self._identity)
+
+    @classmethod
+    def with_id(
+        cls,
+        id: str,
+        namespace: Union[str, Namespace],
+        max_value: int,
+        seconds: int,
+        conditions: Iterable[Union[str, Predicate]] = (),
+        variables: Iterable[Union[str, Expression]] = (),
+    ) -> "Limit":
+        return cls(namespace, max_value, seconds, conditions, variables, id=id)
+
+    # -- accessors mirroring the reference ---------------------------------
+
+    def condition_sources(self) -> Set[str]:
+        return {c.source for c in self.conditions}
+
+    def variable_sources(self) -> Set[str]:
+        return {v.source for v in self.variables}
+
+    @property
+    def window_seconds(self) -> int:
+        return self.seconds
+
+    def has_variable(self, var: str) -> bool:
+        return any(var in v._refs for v in self.variables)
+
+    # -- evaluation --------------------------------------------------------
+
+    def applies(self, ctx: Context) -> bool:
+        scoped = ctx.for_limit(self)
+        if not all(p.test(scoped) for p in self.conditions):
+            return False
+        return all(ctx.has_variables(v.variables()) for v in self.variables)
+
+    def resolve_variables(self, ctx: Context) -> Optional[Dict[str, str]]:
+        """Map variable source -> stringified value; None if any unresolvable."""
+        out: Dict[str, str] = {}
+        for variable in self.variables:
+            value = variable.eval(ctx)
+            if value is None:
+                return None
+            out[variable.source] = value
+        return out
+
+    # -- identity (excludes id/name/max_value: limit.rs:177-214) -----------
+
+    def _key(self) -> Tuple:
+        return self._identity
+
+    def __eq__(self, other: Any) -> bool:
+        return isinstance(other, Limit) and self._identity == other._identity
+
+    def __hash__(self) -> int:
+        return self._hash
+
+    def __lt__(self, other: "Limit") -> bool:
+        return self._key() < other._key()
+
+    def __repr__(self) -> str:
+        return (
+            f"Limit(namespace={str(self.namespace)!r}, max_value={self.max_value}, "
+            f"seconds={self.seconds}, conditions={[c.source for c in self.conditions]}, "
+            f"variables={[v.source for v in self.variables]}, name={self.name!r}, "
+            f"id={self.id!r})"
+        )
+
+    # -- (de)serialization (YAML limits file / HTTP DTO schema) ------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "namespace": str(self.namespace),
+            "max_value": self.max_value,
+            "seconds": self.seconds,
+            "conditions": sorted(c.source for c in self.conditions),
+            "variables": sorted(v.source for v in self.variables),
+        }
+        if self.name is not None:
+            d["name"] = self.name
+        if self.id is not None:
+            d["id"] = self.id
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Limit":
+        return cls(
+            namespace=d["namespace"],
+            max_value=int(d.get("max_value", 0)),
+            seconds=int(d["seconds"]),
+            conditions=d.get("conditions") or (),
+            variables=d.get("variables") or (),
+            name=d.get("name"),
+            id=d.get("id"),
+        )

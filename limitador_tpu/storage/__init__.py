@@ -1,0 +1,21 @@
+from .base import (
+    AsyncCounterStorage,
+    AsyncStorage,
+    Authorization,
+    CounterStorage,
+    Storage,
+    StorageError,
+)
+from .expiring_value import ExpiringValue
+from .in_memory import InMemoryStorage
+
+__all__ = [
+    "AsyncCounterStorage",
+    "AsyncStorage",
+    "Authorization",
+    "CounterStorage",
+    "Storage",
+    "StorageError",
+    "ExpiringValue",
+    "InMemoryStorage",
+]

@@ -1,0 +1,293 @@
+"""Storage abstraction.
+
+Mirrors /root/reference/limitador/src/storage/mod.rs:
+
+- ``CounterStorage`` / ``AsyncCounterStorage`` — the backend extension point
+  (storage/mod.rs:279-310). The TPU backend, the exact in-memory oracle, the
+  disk backend and the distributed CRDT backend all plug in here.
+- ``Storage`` / ``AsyncStorage`` — facade owning the limits registry
+  (namespace -> set of limits), separate from counters
+  (storage/mod.rs:31-39).
+- ``Authorization`` — Ok or Limited(first limit name) (storage/mod.rs:26-29).
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import ClassVar, Dict, Iterable, List, Optional, Set
+
+from ..core.counter import Counter
+from ..core.limit import Limit, Namespace
+
+__all__ = [
+    "Authorization",
+    "StorageError",
+    "CounterStorage",
+    "AsyncCounterStorage",
+    "Storage",
+    "AsyncStorage",
+]
+
+
+@dataclass
+class Authorization:
+    """Ok, or Limited carrying the first over-limit counter's limit name."""
+
+    limited: bool
+    limit_name: Optional[str] = None
+
+    OK: ClassVar["Authorization"]
+
+    @classmethod
+    def limited_by(cls, name: Optional[str]) -> "Authorization":
+        return cls(True, name)
+
+
+Authorization.OK = Authorization(False, None)
+
+
+class StorageError(Exception):
+    """Counter-storage failure; ``transient`` mirrors StorageErr::transient
+    (storage/mod.rs:312-317) and drives the partitioned/fail-open behavior."""
+
+    def __init__(self, msg: str, transient: bool = False):
+        super().__init__(msg)
+        self.transient = transient
+
+
+class CounterStorage(ABC):
+    """Synchronous counter backend (storage/mod.rs:279-293)."""
+
+    @abstractmethod
+    def is_within_limits(self, counter: Counter, delta: int) -> bool: ...
+
+    @abstractmethod
+    def add_counter(self, limit: Limit) -> None: ...
+
+    @abstractmethod
+    def update_counter(self, counter: Counter, delta: int) -> None: ...
+
+    @abstractmethod
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        """Check every counter, and only if all admit, apply delta to all.
+
+        When ``load_counters`` is true, each counter's ``remaining`` and
+        ``expires_in`` are populated (even on the limited path).
+        """
+
+    @abstractmethod
+    def get_counters(self, limits: Set[Limit]) -> Set[Counter]: ...
+
+    @abstractmethod
+    def delete_counters(self, limits: Set[Limit]) -> None: ...
+
+    @abstractmethod
+    def clear(self) -> None: ...
+
+    def close(self) -> None:  # optional backend teardown
+        pass
+
+
+class AsyncCounterStorage(ABC):
+    """Asynchronous counter backend (storage/mod.rs:295-310)."""
+
+    @abstractmethod
+    async def is_within_limits(self, counter: Counter, delta: int) -> bool: ...
+
+    @abstractmethod
+    async def add_counter(self, limit: Limit) -> None: ...
+
+    @abstractmethod
+    async def update_counter(self, counter: Counter, delta: int) -> None: ...
+
+    @abstractmethod
+    async def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization: ...
+
+    @abstractmethod
+    async def get_counters(self, limits: Set[Limit]) -> Set[Counter]: ...
+
+    @abstractmethod
+    async def delete_counters(self, limits: Set[Limit]) -> None: ...
+
+    @abstractmethod
+    async def clear(self) -> None: ...
+
+    async def close(self) -> None:
+        pass
+
+
+class _LimitsRegistry:
+    """namespace -> set-of-limits registry shared by both facades.
+
+    Set semantics follow Rust HashSet over Limit identity (which excludes
+    id/name/max_value): inserting an equal limit keeps the existing one.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._limits: Dict[Namespace, Dict[Limit, Limit]] = {}
+
+    def namespaces(self) -> Set[Namespace]:
+        with self._lock:
+            return set(self._limits.keys())
+
+    def add(self, limit: Limit) -> bool:
+        ns = limit.namespace
+        with self._lock:
+            per_ns = self._limits.setdefault(ns, {})
+            if limit in per_ns:
+                return False
+            per_ns[limit] = limit
+            return True
+
+    def update(self, update: Limit) -> bool:
+        """Replace stored limit when max_value or name changed
+        (storage/mod.rs:67-83)."""
+        with self._lock:
+            per_ns = self._limits.get(update.namespace)
+            if per_ns is None:
+                return False
+            existing = per_ns.get(update)
+            if existing is None:
+                return False
+            if existing.max_value != update.max_value or existing.name != update.name:
+                del per_ns[existing]
+                per_ns[update] = update
+                return True
+            return False
+
+    def get(self, namespace: Namespace) -> Set[Limit]:
+        with self._lock:
+            per_ns = self._limits.get(Namespace.of(namespace))
+            return set(per_ns.values()) if per_ns else set()
+
+    def find(self, limit: Limit) -> Optional[Limit]:
+        with self._lock:
+            per_ns = self._limits.get(limit.namespace)
+            return per_ns.get(limit) if per_ns else None
+
+    def remove(self, limit: Limit) -> None:
+        with self._lock:
+            per_ns = self._limits.get(limit.namespace)
+            if per_ns is not None:
+                per_ns.pop(limit, None)
+                if not per_ns:
+                    del self._limits[limit.namespace]
+
+    def remove_namespace(self, namespace: Namespace) -> Set[Limit]:
+        with self._lock:
+            per_ns = self._limits.pop(Namespace.of(namespace), None)
+            return set(per_ns.values()) if per_ns else set()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._limits.clear()
+
+
+class Storage:
+    """Sync facade: limits registry + counter backend (storage/mod.rs:41-154)."""
+
+    def __init__(self, counters: CounterStorage):
+        self._registry = _LimitsRegistry()
+        self.counters = counters
+
+    def get_namespaces(self) -> Set[Namespace]:
+        return self._registry.namespaces()
+
+    def add_limit(self, limit: Limit) -> bool:
+        self.counters.add_counter(limit)
+        return self._registry.add(limit)
+
+    def update_limit(self, update: Limit) -> bool:
+        return self._registry.update(update)
+
+    def get_limits(self, namespace: Namespace) -> Set[Limit]:
+        return self._registry.get(namespace)
+
+    def delete_limit(self, limit: Limit) -> None:
+        stored = self._registry.find(limit) or limit
+        self.counters.delete_counters({stored})
+        self._registry.remove(limit)
+
+    def delete_limits(self, namespace: Namespace) -> None:
+        removed = self._registry.remove_namespace(namespace)
+        if removed:
+            self.counters.delete_counters(removed)
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        return self.counters.is_within_limits(counter, delta)
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        self.counters.update_counter(counter, delta)
+
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        return self.counters.check_and_update(counters, delta, load_counters)
+
+    def get_counters(self, namespace: Namespace) -> Set[Counter]:
+        limits = self._registry.get(namespace)
+        if not limits:
+            return set()
+        return self.counters.get_counters(limits)
+
+    def clear(self) -> None:
+        self._registry.clear()
+        self.counters.clear()
+
+
+class AsyncStorage:
+    """Async facade over an AsyncCounterStorage (storage/mod.rs:156-277)."""
+
+    def __init__(self, counters: AsyncCounterStorage):
+        self._registry = _LimitsRegistry()
+        self.counters = counters
+
+    def get_namespaces(self) -> Set[Namespace]:
+        return self._registry.namespaces()
+
+    def add_limit(self, limit: Limit) -> bool:
+        return self._registry.add(limit)
+
+    def update_limit(self, update: Limit) -> bool:
+        return self._registry.update(update)
+
+    def get_limits(self, namespace: Namespace) -> Set[Limit]:
+        return self._registry.get(namespace)
+
+    async def delete_limit(self, limit: Limit) -> None:
+        stored = self._registry.find(limit) or limit
+        await self.counters.delete_counters({stored})
+        self._registry.remove(limit)
+
+    async def delete_limits(self, namespace: Namespace) -> None:
+        removed = self._registry.remove_namespace(namespace)
+        if removed:
+            await self.counters.delete_counters(removed)
+
+    async def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        return await self.counters.is_within_limits(counter, delta)
+
+    async def update_counter(self, counter: Counter, delta: int) -> None:
+        await self.counters.update_counter(counter, delta)
+
+    async def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        return await self.counters.check_and_update(counters, delta, load_counters)
+
+    async def get_counters(self, namespace: Namespace) -> Set[Counter]:
+        limits = self._registry.get(namespace)
+        if not limits:
+            return set()
+        return await self.counters.get_counters(limits)
+
+    async def clear(self) -> None:
+        self._registry.clear()
+        await self.counters.clear()

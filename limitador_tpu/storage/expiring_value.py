@@ -1,0 +1,69 @@
+"""Fixed-window counter cell.
+
+Mirrors /root/reference/limitador/src/storage/atomic_expiring_value.rs: a
+(value, expiry) pair where reads see 0 once the window has expired and an
+update in an expired window resets value=delta, expiry=now+window
+(atomic_expiring_value.rs:36-47,87-99). The reference uses lock-free atomics;
+here callers serialize access (storage-level lock / single batcher thread),
+and the device-side equivalent is the vectorized
+``where(now >= expiry, delta, value + delta)`` in the TPU kernel.
+
+Time is float seconds since the epoch throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+__all__ = ["ExpiringValue"]
+
+
+class ExpiringValue:
+    __slots__ = ("value_raw", "expiry")
+
+    def __init__(self, value: int = 0, expiry: float = 0.0):
+        self.value_raw = int(value)
+        self.expiry = float(expiry)
+
+    def value_at(self, now: float) -> int:
+        return 0 if now >= self.expiry else self.value_raw
+
+    def ttl(self, now: float) -> float:
+        return max(self.expiry - now, 0.0)
+
+    def update(self, delta: int, window_seconds: float, now: float) -> int:
+        """Add delta within the window, or reset the window. Returns the new
+        value (atomic_expiring_value.rs:36-42)."""
+        if now >= self.expiry:
+            self.value_raw = delta
+            self.expiry = now + window_seconds
+        else:
+            self.value_raw += delta
+        return self.value_raw
+
+    def set(self, value: int, window_seconds: float, now: float) -> None:
+        self.value_raw = int(value)
+        self.expiry = now + window_seconds
+
+    def merge_at(self, other: "ExpiringValue", now: float) -> None:
+        """CRDT-ish merge: sum live values, keep the earliest future expiry
+        (atomic_expiring_value.rs:113-130)."""
+        mine = self.value_at(now)
+        theirs = other.value_at(now)
+        if theirs > 0:
+            if mine == 0:
+                self.expiry = other.expiry
+            else:
+                self.expiry = min(
+                    e for e in (self.expiry, other.expiry) if e > now
+                )
+        self.value_raw = mine + theirs
+
+    def is_expired(self, now: float) -> bool:
+        return now >= self.expiry
+
+    def copy(self) -> "ExpiringValue":
+        return ExpiringValue(self.value_raw, self.expiry)
+
+    def __repr__(self) -> str:
+        return f"ExpiringValue(value={self.value_raw}, expiry={self.expiry})"

@@ -1,0 +1,94 @@
+"""Backend matrix for the behavioral test suite.
+
+The reference stamps every behavioral test out for each storage backend via
+``test_with_all_storage_impls!`` (integration_tests.rs:3-74). Here the same
+tests run parametrized over the factories below; backends register as they
+come online. ``TestsLimiter`` unifies sync and async limiters behind a sync
+API (tests/helpers/tests_limiter.rs equivalent).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Dict, List
+
+from limitador_tpu import AsyncRateLimiter, RateLimiter
+
+
+class TestsLimiter:
+    """Sync adapter over RateLimiter or AsyncRateLimiter."""
+
+    def __init__(self, inner, cleanup: Callable = None):
+        self.inner = inner
+        self._cleanup = cleanup
+        self.is_async = isinstance(inner, AsyncRateLimiter)
+        self._loop = asyncio.new_event_loop() if self.is_async else None
+
+    def _run(self, value):
+        if asyncio.iscoroutine(value):
+            return self._loop.run_until_complete(value)
+        return value
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if callable(attr):
+            def call(*args, **kwargs):
+                return self._run(attr(*args, **kwargs))
+            return call
+        return attr
+
+    def cleanup(self):
+        if self._cleanup:
+            self._cleanup()
+        if self._loop is not None:
+            self._loop.close()
+
+
+def _memory() -> TestsLimiter:
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    return TestsLimiter(RateLimiter(InMemoryStorage(10_000)))
+
+
+def _tpu() -> TestsLimiter:
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    storage = TpuStorage(capacity=4096)
+    return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
+
+
+def _disk(tmp_path_factory=None) -> TestsLimiter:
+    import tempfile
+
+    from limitador_tpu.storage.disk import DiskStorage
+
+    tmpdir = tempfile.mkdtemp(prefix="limitador-disk-")
+    storage = DiskStorage(f"{tmpdir}/counters.db")
+    return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
+
+
+def _distributed() -> TestsLimiter:
+    from limitador_tpu.storage.distributed import CrInMemoryStorage
+
+    storage = CrInMemoryStorage.standalone("test_node")
+    return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
+
+
+FACTORIES: Dict[str, Callable[[], TestsLimiter]] = {
+    "memory": _memory,
+    "tpu": _tpu,
+    "disk": _disk,
+    "distributed": _distributed,
+}
+
+
+def available_backends() -> List[str]:
+    out = []
+    for name, factory in FACTORIES.items():
+        try:
+            limiter = factory()
+            limiter.cleanup()
+            out.append(name)
+        except ImportError:
+            continue
+    return out

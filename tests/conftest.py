@@ -1,0 +1,37 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that the TPU backend and the
+multi-chip sharding paths are exercised without TPU hardware (the driver
+benches on the real chip separately). Must run before jax is imported.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def fake_clock():
+    """Controllable clock so window-expiry tests don't sleep."""
+
+    class _Clock:
+        def __init__(self):
+            self.now = 1_700_000_000.0
+
+        def __call__(self):
+            return self.now
+
+        def advance(self, seconds):
+            self.now += seconds
+
+    return _Clock()

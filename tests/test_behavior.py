@@ -1,0 +1,365 @@
+"""Behavioral test matrix.
+
+Direct port of the reference's backend-parametrized integration tests
+(/root/reference/limitador/tests/integration_tests.rs:176-210, bodies
+:217-1283). Every storage backend must pass every test — this is the parity
+contract the TPU backend is held to.
+"""
+
+import time
+
+import pytest
+
+from limitador_tpu import Context, Limit
+
+from .backends import FACTORIES, available_backends
+
+BACKENDS = available_backends()
+
+
+@pytest.fixture(params=BACKENDS)
+def limiter(request):
+    lim = FACTORIES[request.param]()
+    yield lim
+    lim.cleanup()
+
+
+def ctx_of(values):
+    return Context(values)
+
+
+GET_COND = "req_method == 'GET'"
+POST_COND = "req_method == 'POST'"
+
+
+def test_get_namespaces(limiter):
+    limiter.add_limit(Limit("first_namespace", 10, 60, [GET_COND], ["app_id"]))
+    limiter.add_limit(Limit("second_namespace", 20, 60, [GET_COND], ["app_id"]))
+    namespaces = limiter.get_namespaces()
+    assert "first_namespace" in namespaces
+    assert "second_namespace" in namespaces
+
+
+def test_get_namespaces_returns_empty_when_there_arent_any(limiter):
+    assert limiter.get_namespaces() == set()
+
+
+def test_get_namespaces_doesnt_return_the_ones_that_no_longer_have_limits(limiter):
+    lim1 = Limit("first_namespace", 10, 60, [GET_COND], ["app_id"])
+    lim2 = Limit("second_namespace", 20, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(lim1)
+    limiter.add_limit(lim2)
+    limiter.delete_limit(lim2)
+    namespaces = limiter.get_namespaces()
+    assert "first_namespace" in namespaces
+    assert "second_namespace" not in namespaces
+
+
+def test_add_a_limit(limiter):
+    limit = Limit("test_namespace", 10, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit)
+    assert limiter.get_limits("test_namespace") == {limit}
+
+
+def test_add_limit_without_vars(limiter):
+    limit = Limit("test_namespace", 10, 60, [GET_COND], [])
+    limiter.add_limit(limit)
+    assert limiter.get_limits("test_namespace") == {limit}
+
+
+def test_add_several_limits_in_the_same_namespace(limiter):
+    ns = "test_namespace"
+    limit_1 = Limit(ns, 10, 60, [POST_COND], ["app_id"])
+    limit_2 = Limit(ns, 5, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit_1)
+    limiter.add_limit(limit_2)
+    assert limiter.get_limits(ns) == {limit_1, limit_2}
+
+
+def test_delete_limit(limiter):
+    limit = Limit("test_namespace", 10, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit)
+    limiter.delete_limit(limit)
+    assert limiter.get_limits("test_namespace") == set()
+
+
+def test_delete_limit_also_deletes_associated_counters(limiter):
+    ns = "test_namespace"
+    limit = Limit(ns, 10, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit)
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "1"}), 1)
+    limiter.delete_limit(limit)
+    assert limiter.get_counters(ns) == set()
+
+
+def test_get_limits_returns_empty_if_no_limits_in_namespace(limiter):
+    assert limiter.get_limits("test_namespace") == set()
+
+
+def test_delete_limits_of_a_namespace(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 10, 60, [POST_COND], ["app_id"]))
+    limiter.add_limit(Limit(ns, 5, 60, [GET_COND], ["app_id"]))
+    limiter.delete_limits(ns)
+    assert limiter.get_limits(ns) == set()
+
+
+def test_delete_limits_does_not_delete_limits_from_other_namespaces(limiter):
+    limiter.add_limit(Limit("test_namespace_1", 10, 60, ["x == '10'"], ["z"]))
+    limiter.add_limit(Limit("test_namespace_2", 5, 60, ["x == '10'"], ["z"]))
+    limiter.delete_limits("test_namespace_1")
+    assert limiter.get_limits("test_namespace_1") == set()
+    assert len(limiter.get_limits("test_namespace_2")) == 1
+
+
+def test_delete_limits_of_a_namespace_also_deletes_counters(limiter):
+    ns = "test_namespace"
+    limit = Limit(ns, 5, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit)
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "1"}), 1)
+    limiter.delete_limits(ns)
+    assert limiter.get_counters(ns) == set()
+
+
+def test_delete_limits_of_an_empty_namespace_does_nothing(limiter):
+    limiter.delete_limits("test_namespace")
+
+
+def test_rate_limited(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    for i in range(max_hits):
+        assert not limiter.is_rate_limited(ns, ctx, 1).limited, f"limited after {i}"
+        limiter.update_counters(ns, ctx, 1)
+    assert limiter.is_rate_limited(ns, ctx, 1).limited
+
+
+def test_rate_limited_id_counter(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limit = Limit.with_id(
+        "test-rate_limited_id_counter", ns, max_hits, 60, [GET_COND], ["app_id"]
+    )
+    limiter.add_limit(limit)
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    for i in range(max_hits):
+        assert not limiter.is_rate_limited(ns, ctx, 1).limited, f"limited after {i}"
+        limiter.update_counters(ns, ctx, 1)
+    assert limiter.is_rate_limited(ns, ctx, 1).limited
+
+
+def test_multiple_limits_rate_limited(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    limiter.add_limit(Limit(ns, max_hits + 1, 60, [POST_COND], ["app_id"]))
+    get_ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    post_ctx = ctx_of({"req_method": "POST", "app_id": "test_app_id"})
+
+    for i in range(max_hits):
+        assert not limiter.is_rate_limited(ns, get_ctx, 1).limited
+        assert not limiter.is_rate_limited(ns, post_ctx, 1).limited
+        limiter.check_rate_limited_and_update(ns, get_ctx, 1, False)
+        limiter.check_rate_limited_and_update(ns, post_ctx, 1, False)
+
+    time.sleep(0.04)  # let write-behind backends flush
+    assert limiter.is_rate_limited(ns, get_ctx, 1).limited
+    assert not limiter.is_rate_limited(ns, post_ctx, 1).limited
+
+
+def test_rate_limited_with_delta_higher_than_one(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 10, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    for _ in range(2):
+        assert not limiter.is_rate_limited(ns, ctx, 5).limited
+        limiter.update_counters(ns, ctx, 5)
+    assert limiter.is_rate_limited(ns, ctx, 1).limited
+
+
+def test_rate_limited_with_delta_higher_than_max(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 10, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    assert limiter.is_rate_limited(ns, ctx, 11).limited
+
+
+def test_takes_into_account_only_vars_of_the_limits(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    base = {"req_method": "GET", "app_id": "test_app_id"}
+    for i in range(max_hits):
+        values = dict(base)
+        values["does_not_apply"] = str(i)
+        ctx = ctx_of(values)
+        assert not limiter.is_rate_limited(ns, ctx, 1).limited, f"limited after {i}"
+        limiter.update_counters(ns, ctx, 1)
+    assert limiter.is_rate_limited(ns, ctx_of(base), 1).limited
+
+
+def test_is_rate_limited_returns_false_when_no_limits_in_namespace(limiter):
+    ctx = ctx_of({"req_method": "GET"})
+    assert not limiter.is_rate_limited("test_namespace", ctx, 1).limited
+
+
+def test_is_rate_limited_returns_false_when_no_matching_limits(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 0, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "POST", "app_id": "test_app_id"})
+    assert not limiter.is_rate_limited(ns, ctx, 1).limited
+
+
+def test_is_rate_limited_applies_limit_if_its_unconditional(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 0, 60, [], ["app_id"]))
+    ctx = ctx_of({"app_id": "test_app_id"})
+    assert limiter.is_rate_limited(ns, ctx, 1).limited
+
+
+def test_check_rate_limited_and_update(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+    for _ in range(max_hits):
+        assert not limiter.check_rate_limited_and_update(ns, ctx, 1, False).limited
+    assert limiter.check_rate_limited_and_update(ns, ctx, 1, False).limited
+
+
+def test_check_rate_limited_and_update_load_counters(limiter):
+    ns = "test_namespace"
+    max_hits = 3
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "GET", "app_id": "test_app_id"})
+
+    for hit in range(max_hits):
+        result = limiter.check_rate_limited_and_update(ns, ctx, 1, True)
+        assert not result.limited
+        assert len(result.counters) == 1
+        for counter in result.counters:
+            if counter.expires_in is not None:
+                assert counter.expires_in <= 60
+            assert counter.remaining == 3 - (hit + 1)
+
+    result = limiter.check_rate_limited_and_update(ns, ctx, 1, True)
+    assert result.limited
+    assert len(result.counters) == 1
+    for counter in result.counters:
+        if counter.expires_in is not None:
+            assert counter.expires_in <= 60
+        assert counter.remaining == 0
+
+
+def test_check_rate_limited_and_update_returns_false_if_no_limits_apply(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 10, 60, [GET_COND], ["app_id"]))
+    ctx = ctx_of({"req_method": "POST", "app_id": "test_app_id"})
+    assert not limiter.check_rate_limited_and_update(ns, ctx, 1, False).limited
+
+
+def test_check_rate_limited_and_update_applies_limit_if_its_unconditional(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 0, 60, [], ["app_id"]))
+    ctx = ctx_of({"app_id": "test_app_id"})
+    assert limiter.check_rate_limited_and_update(ns, ctx, 1, False).limited
+
+
+def test_get_counters(limiter):
+    ns = "test_namespace"
+    max_hits = 10
+    limiter.add_limit(Limit(ns, max_hits, 60, [GET_COND], ["app_id"]))
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "1"}), 1)
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "2"}), 5)
+
+    assert len(limiter.get_limits(ns)) == 1
+    counters = limiter.get_counters(ns)
+    assert len(counters) == 2
+    for counter in counters:
+        app_id = counter.set_variables["app_id"]
+        if app_id == "1":
+            assert counter.remaining == max_hits - 1
+        elif app_id == "2":
+            assert counter.remaining == max_hits - 5
+        else:
+            pytest.fail("Unexpected app ID")
+
+
+def test_get_counters_returns_empty_when_no_limits_in_namespace(limiter):
+    assert limiter.get_counters("test_namespace") == set()
+
+
+def test_get_counters_returns_empty_when_no_counters_in_namespace(limiter):
+    limiter.add_limit(Limit("test_namespace", 10, 60, [GET_COND], ["app_id"]))
+    assert limiter.get_counters("test_namespace") == set()
+
+
+def test_get_counters_does_not_return_expired_ones(limiter):
+    ns = "test_namespace"
+    limiter.add_limit(Limit(ns, 10, 1, [GET_COND], ["app_id"]))
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "1"}), 1)
+    time.sleep(1.1)
+    assert len(limiter.get_counters(ns)) == 0
+
+
+def test_configure_with_creates_the_given_limits(limiter):
+    first = Limit("first_namespace", 10, 60, [GET_COND], ["app_id"])
+    second = Limit("second_namespace", 20, 60, [GET_COND], ["app_id"])
+    limiter.configure_with([first, second])
+    assert first in limiter.get_limits("first_namespace")
+    assert second in limiter.get_limits("second_namespace")
+
+
+def test_configure_with_keeps_the_given_limits_and_counters_if_they_exist(limiter):
+    ns = "test_namespace"
+    max_value = 10
+    limit = Limit(ns, max_value, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(limit)
+    limiter.update_counters(ns, ctx_of({"req_method": "GET", "app_id": "1"}), 1)
+    limiter.configure_with([limit])
+    assert limit in limiter.get_limits(ns)
+    counters = list(limiter.get_counters(ns))
+    assert len(counters) == 1
+    assert counters[0].remaining == max_value - 1
+
+
+def test_configure_with_deletes_all_except_the_limits_given(limiter):
+    ns = "test_namespace"
+    keep = Limit(ns, 10, 1, [GET_COND], ["app_id"])
+    delete = Limit(ns, 20, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(keep)
+    limiter.add_limit(delete)
+    limiter.configure_with([keep])
+    limits = limiter.get_limits(ns)
+    assert keep in limits
+    assert delete not in limits
+
+
+def test_configure_with_updates_the_limits(limiter):
+    ns = "test_namespace"
+    orig = Limit(ns, 10, 60, [GET_COND], ["app_id"])
+    update = Limit(ns, 20, 60, [GET_COND], ["app_id"])
+    limiter.add_limit(orig)
+    limiter.configure_with([update])
+    limits = limiter.get_limits(ns)
+    assert len(limits) == 1
+    assert next(iter(limits)).max_value == 20
+
+
+def test_add_limit_only_adds_if_not_present(limiter):
+    ns = "test_namespace"
+    limit_1 = Limit(ns, 10, 60, [GET_COND], ["app_id"])
+    limit_2 = Limit(ns, 20, 60, [GET_COND], ["app_id"])
+    limit_3 = Limit(ns, 20, 60, [GET_COND], ["app_id"], name="Name is irrelevant too")
+
+    assert limiter.add_limit(limit_1) is True
+    assert limiter.add_limit(limit_2) is False
+    assert limiter.add_limit(limit_3) is False
+
+    limits = limiter.get_limits(ns)
+    assert len(limits) == 1
+    known = next(iter(limits))
+    assert known.max_value == 10
+    assert known.name is None

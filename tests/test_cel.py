@@ -1,0 +1,168 @@
+"""CEL engine unit tests.
+
+Semantics mirrored from /root/reference/limitador/src/limit/cel.rs tests and
+the behaviors limitador depends on: missing-variable => predicate False,
+missing map key => predicate False / expression None, non-bool predicate
+result => error, descriptor list bindings, the per-limit `limit` scope.
+"""
+
+import pytest
+
+from limitador_tpu.core.cel import (
+    Context,
+    EvaluationError,
+    Expression,
+    ParseError,
+    Predicate,
+)
+from limitador_tpu.core.limit import Limit
+
+
+def ctx_of(values):
+    return Context(values)
+
+
+class TestPredicate:
+    def test_basic_equality(self):
+        p = Predicate.parse("req_method == 'GET'")
+        assert p.test(ctx_of({"req_method": "GET"})) is True
+        assert p.test(ctx_of({"req_method": "POST"})) is False
+
+    def test_missing_variable_is_false(self):
+        p = Predicate.parse("req_method == 'GET'")
+        assert p.test(ctx_of({})) is False
+
+    def test_missing_map_key_is_false(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}])
+        p = Predicate.parse("descriptors[0]['b'] == '1'")
+        assert p.test(ctx) is False
+
+    def test_descriptor_binding(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"req.method": "GET", "host": "h"}])
+        assert Predicate.parse("descriptors[0]['req.method'] == 'GET'").test(ctx)
+        assert Predicate.parse("descriptors[0].host == 'h'").test(ctx)
+
+    def test_non_bool_result_errors(self):
+        p = Predicate.parse("x")
+        with pytest.raises(EvaluationError):
+            p.test(ctx_of({"x": "foo"}))
+
+    def test_numeric_comparison_on_strings_vs_ints(self):
+        p = Predicate.parse("int(x) > 3")
+        assert p.test(ctx_of({"x": "5"}))
+        assert not p.test(ctx_of({"x": "2"}))
+
+    def test_logical_operators(self):
+        ctx = ctx_of({"a": "1", "b": "2"})
+        assert Predicate.parse("a == '1' && b == '2'").test(ctx)
+        assert Predicate.parse("a == 'x' || b == '2'").test(ctx)
+        assert not Predicate.parse("a == 'x' && b == '2'").test(ctx)
+        assert Predicate.parse("!(a == 'x')").test(ctx)
+
+    def test_short_circuit_or_with_missing_key_still_false_path(self):
+        # Reference semantics: the whole predicate returns false on NoSuchKey.
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}])
+        p = Predicate.parse("descriptors[0].missing == '1' || descriptors[0].a == '1'")
+        # Left side raises NoSuchKey before reaching ||; predicate is False.
+        assert p.test(ctx) is False
+
+    def test_string_methods(self):
+        ctx = ctx_of({"path": "/api/v1/users"})
+        assert Predicate.parse("path.startsWith('/api')").test(ctx)
+        assert Predicate.parse("path.endsWith('users')").test(ctx)
+        assert Predicate.parse("path.contains('v1')").test(ctx)
+        assert Predicate.parse("path.matches('^/api/v[0-9]+/')").test(ctx)
+
+    def test_in_operator(self):
+        ctx = ctx_of({"method": "GET"})
+        assert Predicate.parse("method in ['GET', 'HEAD']").test(ctx)
+        assert not Predicate.parse("method in ['POST']").test(ctx)
+
+    def test_limit_scope(self):
+        limit = Limit("ns", 10, 60, name="mylimit", id="myid")
+        p = Predicate.parse("limit.name == 'mylimit'")
+        ctx = ctx_of({}).for_limit(limit)
+        assert p.test(ctx)
+        p2 = Predicate.parse("limit.id == 'myid'")
+        assert p2.test(ctx)
+
+    def test_limit_scope_null_name(self):
+        limit = Limit("ns", 10, 60)
+        ctx = ctx_of({}).for_limit(limit)
+        assert Predicate.parse("limit.name == null").test(ctx)
+
+    def test_parse_error(self):
+        with pytest.raises(ParseError):
+            Predicate.parse("a ==")
+        with pytest.raises(ParseError):
+            Predicate.parse("((a)")
+
+    def test_ternary(self):
+        ctx = ctx_of({"x": "a"})
+        assert Predicate.parse("x == 'a' ? true : false").test(ctx)
+
+    def test_variables_listing(self):
+        p = Predicate.parse("a == '1' && b.c == '2'")
+        assert set(p.variables()) == {"a", "b"}
+
+
+class TestExpression:
+    def test_plain_variable(self):
+        e = Expression.parse("app_id")
+        assert e.eval(ctx_of({"app_id": "foo"})) == "foo"
+
+    def test_missing_key_returns_none(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}])
+        assert Expression.parse("descriptors[0].missing").eval(ctx) is None
+
+    def test_stringification(self):
+        ctx = ctx_of({})
+        assert Expression.parse("3").eval(ctx) == "3"
+        assert Expression.parse("3.5").eval(ctx) == "3.5"
+        assert Expression.parse("3.0").eval(ctx) == "3"
+        assert Expression.parse("true").eval(ctx) == "true"
+        assert Expression.parse("null").eval(ctx) == "null"
+        assert Expression.parse("'s'").eval(ctx) == "s"
+
+    def test_timestamp_gethours(self):
+        # Mirrors counter.rs:146-163
+        e = Expression.parse("timestamp(ts).getHours()")
+        ctx = ctx_of({"ts": "2019-10-12T13:20:50.52Z"})
+        assert e.eval(ctx) == "13"
+
+    def test_string_concat(self):
+        e = Expression.parse("a + '-' + b")
+        assert e.eval(ctx_of({"a": "x", "b": "y"})) == "x-y"
+
+    def test_arithmetic(self):
+        ctx = ctx_of({})
+        assert Expression.parse("7 / 2").eval(ctx) == "3"
+        assert Expression.parse("-7 / 2").eval(ctx) == "-3"
+        assert Expression.parse("7 % 2").eval(ctx) == "1"
+        assert Expression.parse("-7 % 2").eval(ctx) == "-1"
+        assert Expression.parse("2 * 3 + 1").eval(ctx) == "7"
+
+    def test_eval_map(self):
+        e = Expression.parse("{'a': x, 'b': 'static'}")
+        assert e.eval_map(ctx_of({"x": "1"})) == {"a": "1", "b": "static"}
+
+    def test_eval_map_non_map_returns_empty(self):
+        assert Expression.parse("'notamap'").eval_map(ctx_of({})) == {}
+
+    def test_list_and_map_results_error(self):
+        with pytest.raises(EvaluationError):
+            Expression.parse("[1,2]").eval(ctx_of({}))
+
+    def test_size(self):
+        assert Expression.parse("size('abc')").eval(ctx_of({})) == "3"
+        assert Expression.parse("'abc'.size()").eval(ctx_of({})) == "3"
+
+    def test_ordering_by_source(self):
+        a, b = Expression.parse("a"), Expression.parse("b")
+        assert a < b
+        assert a == Expression.parse("a")
+        assert hash(a) == hash(Expression.parse("a"))
