@@ -1,0 +1,3 @@
+from . import kernel
+
+__all__ = ["kernel"]

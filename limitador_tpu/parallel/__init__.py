@@ -1,0 +1,15 @@
+from .mesh import (
+    ShardedBatchResult,
+    ShardedCounterState,
+    make_mesh,
+    make_sharded_table,
+    sharded_check_and_update,
+)
+
+__all__ = [
+    "ShardedBatchResult",
+    "ShardedCounterState",
+    "make_mesh",
+    "make_sharded_table",
+    "sharded_check_and_update",
+]

@@ -1,0 +1,159 @@
+"""Multi-chip sharded counter table.
+
+TPU-native analogue of the reference's counter-distribution topologies
+(SURVEY.md §2.3, /root/reference/doc/topologies.md):
+
+- **Owner-sharded keys (exact)**: the counter table is sharded by slot over
+  the mesh ("shard" axis); the host routes each hit to its owner device
+  (the ICI equivalent of Redis-cluster hash-tag sharding, keys.rs:1-13).
+  Requests may span devices: admission is all-or-nothing per request, so
+  each fixpoint sweep combines per-device hit verdicts with a cross-device
+  ``pmin`` over the replicated request vector. Exactness is preserved —
+  the fixpoint argument of ops/kernel.py is unchanged, the AND just rides
+  ICI.
+- **Replicated global counters (psum)**: counters of "global limit"
+  namespaces hold a per-device partial count; their effective value is
+  ``psum`` of partials (the CRDT read-as-sum of
+  distributed/cr_counter_value.rs:38-46 mapped onto ICI collectives).
+  Admission uses the psum'd base plus the device-local prefix, so
+  over-admission is bounded by one batch per remote device — the same
+  bounded-inaccuracy contract the reference documents for its distributed
+  and cached-Redis modes (redis_cached.rs:25-41).
+
+Layout: values/expiry are [n_shards, local_capacity+1] with
+PartitionSpec("shard", None); hit arrays are [n_shards, H_local] sharded the
+same way; request vectors are replicated.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.kernel import check_and_update_core
+
+__all__ = [
+    "ShardedCounterState",
+    "ShardedBatchResult",
+    "make_sharded_table",
+    "make_mesh",
+    "sharded_check_and_update",
+]
+
+_NEVER = jnp.iinfo(jnp.int32).max
+
+
+class ShardedCounterState(NamedTuple):
+    values: jax.Array     # int32[n_shards, L+1] sharded over "shard"
+    expiry_ms: jax.Array  # int32[n_shards, L+1] sharded over "shard"
+
+
+class ShardedBatchResult(NamedTuple):
+    admitted: jax.Array   # bool[R] replicated
+    hit_ok: jax.Array     # bool[n_shards, H_local]
+    remaining: jax.Array  # int32[n_shards, H_local]
+    ttl_ms: jax.Array     # int32[n_shards, H_local]
+
+
+def make_mesh(devices=None, axis: str = "shard") -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    return Mesh(devices, (axis,))
+
+
+def make_sharded_table(
+    mesh: Mesh, local_capacity: int, axis: str = "shard"
+) -> ShardedCounterState:
+    n = mesh.shape[axis]
+    sharding = NamedSharding(mesh, P(axis, None))
+    make = lambda: jax.device_put(
+        jnp.zeros((n, local_capacity + 1), jnp.int32), sharding
+    )
+    return ShardedCounterState(values=make(), expiry_ms=make())
+
+
+def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
+                fresh, is_global, now_ms, num_req, axis, global_region):
+    """Per-device admission over the local shard; runs inside shard_map.
+
+    Delegates to ops/kernel.py's shared ``check_and_update_core`` with two
+    cross-device hooks:
+
+    - ``vote_combine``: requests may span devices; admission is all-or-
+      nothing, so per-device verdicts AND across the mesh via ``pmin``
+      (devices without hits for a request vote True).
+    - ``base_hook``: global counters occupy the same slot (< global_region)
+      on every shard, each holding a per-device partial; the effective base
+      is the psum of live partials over that compact region (the CRDT
+      read-as-sum riding ICI). In-batch remote contributions are not
+      visible until the next batch — bounded over-admission, as in the
+      reference's distributed mode.
+    """
+    live_partial = jnp.where(now_ms < expiry[:global_region],
+                             values[:global_region], 0)
+    global_vals = lax.psum(live_partial, axis)
+    s_glob = is_global[jnp.argsort(slots, stable=True)]
+
+    def base_hook(v_local, s_slot):
+        safe_idx = jnp.minimum(s_slot, global_region - 1)
+        return jnp.where(s_glob, global_vals[safe_idx], v_local)
+
+    def vote_combine(local_vote):
+        return lax.pmin(local_vote.astype(jnp.int32), axis).astype(bool)
+
+    return check_and_update_core(
+        values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
+        now_ms, num_req, vote_combine=vote_combine, base_hook=base_hook,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "global_region"),
+    donate_argnums=(1,),
+)
+def sharded_check_and_update(
+    mesh: Mesh,
+    state: ShardedCounterState,
+    slots: jax.Array,       # int32[n, H_local] owner-local slot per hit
+    deltas: jax.Array,      # int32[n, H_local]
+    maxes: jax.Array,       # int32[n, H_local]
+    windows_ms: jax.Array,  # int32[n, H_local]
+    req_ids: jax.Array,     # int32[n, H_local] global request ids
+    fresh: jax.Array,       # bool[n, H_local]
+    is_global: jax.Array,   # bool[n, H_local] psum-replicated counter hits
+    now_ms: jax.Array,      # int32 scalar
+    axis: str = "shard",
+    global_region: int = 1024,
+) -> Tuple[ShardedCounterState, ShardedBatchResult]:
+    """One fused multi-chip check-and-update step over the sharded table."""
+    num_req = slots.shape[0] * slots.shape[1]
+
+    def fn(values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
+           is_global):
+        (nv, ne, admitted, ok, remaining, ttl) = _local_step(
+            values[0], expiry[0], slots[0], deltas[0], maxes[0], windows[0],
+            req_ids[0], fresh[0], is_global[0], now_ms, num_req, axis,
+            global_region,
+        )
+        return (
+            nv[None], ne[None], admitted, ok[None], remaining[None], ttl[None]
+        )
+
+    spec = P(axis, None)
+    rep = P()
+    nv, ne, admitted, ok, remaining, ttl = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec,) * 9,
+        out_specs=(spec, spec, rep, spec, spec, spec),
+        check_vma=False,
+    )(state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
+      req_ids, fresh, is_global)
+    return (
+        ShardedCounterState(nv, ne),
+        ShardedBatchResult(admitted, ok, remaining, ttl),
+    )

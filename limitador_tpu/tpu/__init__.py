@@ -1,0 +1,4 @@
+from .batcher import AsyncTpuStorage, MicroBatcher
+from .storage import TpuStorage
+
+__all__ = ["TpuStorage", "AsyncTpuStorage", "MicroBatcher"]

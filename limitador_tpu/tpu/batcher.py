@@ -1,0 +1,142 @@
+"""Async micro-batcher: many concurrent checks -> one fused kernel launch.
+
+The serving plane (gRPC/HTTP handlers) awaits ``AsyncTpuStorage`` methods;
+concurrent ``check_and_update`` calls are coalesced into a single device
+batch. This is where p99 <= 2ms is won or lost (SURVEY.md §7.4): the batcher
+flushes on (a) batch full, (b) the oldest request exceeding ``max_delay``,
+mirroring the size|interval|priority triple of the reference's write-behind
+Batcher (/root/reference/limitador/src/storage/redis/counters_cache.rs:183-238)
+— except here the flush IS the decision, not an async reconciliation, so
+admission stays exact.
+
+Within a batch, requests keep their enqueue order and the kernel decides
+admission exactly as if they were processed serially; all hit-building and
+result-decoding semantics live in ``TpuStorage.check_many`` — the batcher
+only owns the coalescing.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import List, Optional
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+from ..storage.base import AsyncCounterStorage, Authorization
+from .storage import TpuStorage, _Request
+
+__all__ = ["MicroBatcher", "AsyncTpuStorage"]
+
+
+class MicroBatcher:
+    def __init__(
+        self,
+        storage: TpuStorage,
+        max_batch_hits: int = 8192,
+        max_delay: float = 0.0005,
+    ):
+        self.storage = storage
+        self.max_batch_hits = max_batch_hits
+        self.max_delay = max_delay
+        self._pending: List[tuple] = []  # (_Request, Future)
+        self._pending_hits = 0
+        self._wakeup: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._wakeup = asyncio.Event()
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def submit(
+        self, counters: List[Counter], delta: int, load: bool
+    ) -> Authorization:
+        """Enqueue one request; resolves when its batch has been decided."""
+        self._ensure_started()
+        future = asyncio.get_running_loop().create_future()
+        request = _Request(counters, delta, load)
+        self._pending.append((request, future))
+        self._pending_hits += len(request.ordered)
+        self._wakeup.set()
+        return await future
+
+    async def _run(self) -> None:
+        while not self._closed:
+            while not self._pending:
+                self._wakeup.clear()
+                if self._closed:
+                    return
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    if self._closed:
+                        return
+            if self._pending_hits < self.max_batch_hits:
+                # Linger briefly to let concurrent requests coalesce.
+                await asyncio.sleep(self.max_delay)
+            batch = self._pending
+            self._pending = []
+            self._pending_hits = 0
+            try:
+                auths = self.storage.check_many([r for r, _f in batch])
+                for (_r, future), auth in zip(batch, auths):
+                    if not future.done():
+                        future.set_result(auth)
+            except Exception as exc:  # propagate to every waiter
+                for _r, future in batch:
+                    if not future.done():
+                        future.set_exception(exc)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._wakeup is not None:
+            self._wakeup.set()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+
+
+class AsyncTpuStorage(AsyncCounterStorage):
+    """AsyncCounterStorage over TpuStorage + MicroBatcher: the hot
+    check_and_update path batches; admin operations delegate inline."""
+
+    def __init__(
+        self,
+        storage: Optional[TpuStorage] = None,
+        max_batch_hits: int = 8192,
+        max_delay: float = 0.0005,
+        **kwargs,
+    ):
+        self.inner = storage or TpuStorage(**kwargs)
+        self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
+
+    async def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        if not counters:
+            return Authorization.OK
+        return await self.batcher.submit(counters, delta, load_counters)
+
+    async def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        return self.inner.is_within_limits(counter, delta)
+
+    async def add_counter(self, limit: Limit) -> None:
+        self.inner.add_counter(limit)
+
+    async def update_counter(self, counter: Counter, delta: int) -> None:
+        self.inner.update_counter(counter, delta)
+
+    async def get_counters(self, limits) -> set:
+        return self.inner.get_counters(limits)
+
+    async def delete_counters(self, limits) -> None:
+        self.inner.delete_counters(limits)
+
+    async def clear(self) -> None:
+        self.inner.clear()
+
+    async def close(self) -> None:
+        await self.batcher.close()

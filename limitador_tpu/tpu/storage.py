@@ -1,0 +1,330 @@
+"""TpuStorage — the device-resident counter backend.
+
+Implements the ``CounterStorage`` protocol (storage/base.py, mirroring
+/root/reference/limitador/src/storage/mod.rs:279-293) over the fused kernel
+in limitador_tpu/ops/kernel.py. Equivalent of the reference's
+``InMemoryStorage`` in exactness (never over-admits; check-all-then-
+update-all) with counters living in device HBM instead of host maps:
+
+- The host owns the key space: counter identity -> slot index, mirroring the
+  reference's split between the unbounded simple-limits map
+  (in_memory.rs:14) and the LRU-capped qualified-counter cache
+  (in_memory.rs:15-16, 204-212). Qualified slots are evicted LRU (as moka's
+  cap does); simple-limit slots are pinned.
+- The device owns the values: a dense int32 (value, expiry_ms) table; every
+  check/update is a fused gather -> admit -> scatter kernel call.
+- ``check_many`` is the single implementation of hit-array construction,
+  reference processing order, first-limited naming and the non-load
+  early-return slot-release semantics; the per-call ``check_and_update``
+  and the async MicroBatcher (tpu/batcher.py) both go through it.
+
+Documented representation limits (see ops/kernel.py): max_value clamps to
+2**30, deltas to 2**30-1, windows to WINDOW_MS_CAP (~12.4 days). The epoch
+auto-rebases on long uptimes so expiries never overflow int32.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+from ..storage.base import Authorization, CounterStorage, StorageError
+from ..ops import kernel as K
+
+__all__ = ["TpuStorage"]
+
+_INT32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    """Next power of two >= n (static kernel shapes, few XLA programs)."""
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _clamp_window_ms(seconds: int) -> int:
+    return min(seconds * 1000, K.WINDOW_MS_CAP)
+
+
+class _SlotTable:
+    """Host-side key space: counter identity -> device slot."""
+
+    def __init__(self, capacity: int):
+        self.capacity = capacity
+        self.free: List[int] = list(range(capacity - 1, -1, -1))
+        # pinned (simple-limit) slots: key -> slot
+        self.simple: Dict[tuple, int] = {}
+        # LRU for qualified counters: key -> slot (front = oldest)
+        self.qualified: "OrderedDict[tuple, int]" = OrderedDict()
+        # slot -> (key, Counter identity object) for introspection
+        self.info: Dict[int, Tuple[tuple, Counter]] = {}
+
+    def lookup(self, key: tuple, qualified: bool) -> Optional[int]:
+        if qualified:
+            slot = self.qualified.get(key)
+            if slot is not None:
+                self.qualified.move_to_end(key)
+            return slot
+        return self.simple.get(key)
+
+    def release(self, slot: int, key: tuple, qualified: bool) -> None:
+        self.info.pop(slot, None)
+        if qualified:
+            self.qualified.pop(key, None)
+        else:
+            self.simple.pop(key, None)
+        self.free.append(slot)
+
+
+class _Request:
+    """One logical check inside a ``check_many`` batch."""
+
+    __slots__ = ("ordered", "delta", "load")
+
+    def __init__(self, counters: Sequence[Counter], delta: int, load: bool):
+        # Reference processing order: simple counters then qualified
+        # (in_memory.rs:104-139) — drives first_limited naming.
+        self.ordered = [c for c in counters if not c.is_qualified()] + [
+            c for c in counters if c.is_qualified()
+        ]
+        self.delta = delta
+        self.load = load
+
+
+class TpuStorage(CounterStorage):
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        cache_size: Optional[int] = None,
+        clock=time.time,
+    ):
+        """``capacity`` sizes the device table (8 bytes/counter of HBM);
+        ``cache_size`` caps qualified counters (default: capacity)."""
+        self._lock = threading.RLock()
+        self._clock = clock
+        self._capacity = int(capacity)
+        self._cache_size = int(cache_size) if cache_size else self._capacity
+        self._table = _SlotTable(self._capacity)
+        self._state = K.make_table(self._capacity)
+        self._epoch = clock()  # device time 0 in host seconds
+        self._scratch = self._capacity  # padding slot
+
+    # -- time --------------------------------------------------------------
+
+    def _now_ms(self) -> int:
+        now = int((self._clock() - self._epoch) * 1000)
+        if now > (1 << 30):
+            # Rebase before now_ms + WINDOW_MS_CAP could overflow int32.
+            shift = now - 1000
+            self._state = K.rebase_epoch(self._state, np.int32(shift))
+            self._epoch += shift / 1000.0
+            now -= shift
+        return now
+
+    # -- slot management ---------------------------------------------------
+
+    @staticmethod
+    def _key_of(counter: Counter) -> tuple:
+        return (counter.limit._identity, tuple(counter.set_variables.items()))
+
+    def _evict_one(self) -> None:
+        """Free the least-recently-used qualified slot (the moka cap
+        analogue, in_memory.rs:204-212). Pure host bookkeeping: the recycled
+        slot's stale device cell is overridden by the kernel's ``fresh``
+        flag on next allocation — no device read or write here."""
+        if not self._table.qualified:
+            raise StorageError("TPU counter table full (no evictable slots)")
+        key, slot = next(iter(self._table.qualified.items()))
+        self._table.release(slot, key, qualified=True)
+
+    def _slot_for(self, counter: Counter, create: bool) -> Tuple[Optional[int], bool]:
+        """Return (slot, fresh). fresh=True when allocated/recycled now."""
+        qualified = counter.is_qualified()
+        key = self._key_of(counter)
+        slot = self._table.lookup(key, qualified)
+        if slot is not None:
+            return slot, False
+        if not create:
+            return None, False
+        if qualified:
+            while len(self._table.qualified) >= self._cache_size:
+                self._evict_one()
+        if not self._table.free:
+            self._evict_one()
+        slot = self._table.free.pop()
+        if qualified:
+            self._table.qualified[key] = slot
+        else:
+            self._table.simple[key] = slot
+        self._table.info[slot] = (key, counter.key())
+        return slot, True
+
+    # -- the shared batched check path -------------------------------------
+
+    def check_many(self, requests: List[_Request]) -> List[Authorization]:
+        """Run a batch of check-all-then-update-all requests in one kernel
+        launch, in list order (== serial order for exactness). Applies
+        load_counters side effects and the reference's non-load
+        early-return semantics (a limited non-load request does not create
+        qualified counters past its first limited hit, in_memory.rs:110-133
+        — only safe to undo when no other request in the batch shares the
+        freshly-allocated slot)."""
+        nhits = sum(len(r.ordered) for r in requests)
+        H = _bucket(max(nhits, 1))
+        slots = np.full(H, self._scratch, np.int32)
+        deltas = np.zeros(H, np.int32)
+        maxes = np.full(H, _INT32_MAX, np.int32)
+        windows = np.zeros(H, np.int32)
+        req = np.full(H, H - 1, np.int32)
+        fresh = np.zeros(H, bool)
+
+        with self._lock:
+            now_ms = self._now_ms()
+            fresh_hits_by_req: List[List[Tuple[int, Counter, int]]] = []
+            slot_use_count: Dict[int, int] = {}
+            i = 0
+            for r, request in enumerate(requests):
+                fresh_hits: List[Tuple[int, Counter, int]] = []
+                delta = min(int(request.delta), K.MAX_DELTA_CAP)
+                for j, c in enumerate(request.ordered):
+                    slot, is_fresh = self._slot_for(c, create=True)
+                    slots[i] = slot
+                    deltas[i] = delta
+                    maxes[i] = min(c.max_value, K.MAX_VALUE_CAP)
+                    windows[i] = _clamp_window_ms(c.window_seconds)
+                    req[i] = r
+                    fresh[i] = is_fresh
+                    slot_use_count[slot] = slot_use_count.get(slot, 0) + 1
+                    if is_fresh:
+                        fresh_hits.append((j, c, slot))
+                    i += 1
+                fresh_hits_by_req.append(fresh_hits)
+
+            self._state, result = K.check_and_update_batch(
+                self._state, slots, deltas, maxes, windows, req, fresh,
+                np.int32(now_ms),
+            )
+            hit_ok = np.asarray(result.hit_ok)
+            remaining = np.asarray(result.remaining)
+            ttl_ms = np.asarray(result.ttl_ms)
+
+            auths: List[Authorization] = []
+            base = 0
+            for r, request in enumerate(requests):
+                n = len(request.ordered)
+                oks = hit_ok[base : base + n]
+                all_ok = bool(np.all(oks))
+                if request.load:
+                    for j, c in enumerate(request.ordered):
+                        c.remaining = int(remaining[base + j])
+                        c.expires_in = float(ttl_ms[base + j]) / 1000.0
+                if all_ok:
+                    auths.append(Authorization.OK)
+                else:
+                    first = int(np.argmin(oks))
+                    auths.append(
+                        Authorization.limited_by(
+                            request.ordered[first].limit.name
+                        )
+                    )
+                    if not request.load:
+                        for j, c, slot in fresh_hits_by_req[r]:
+                            if j > first and slot_use_count.get(slot) == 1:
+                                self._table.release(
+                                    slot, self._key_of(c), c.is_qualified()
+                                )
+                base += n
+        return auths
+
+    # -- CounterStorage ----------------------------------------------------
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        with self._lock:
+            now_ms = self._now_ms()
+            slot, _ = self._slot_for(counter, create=False)
+            if slot is None:
+                value = 0
+            else:
+                v, _ttl = K.read_slots(
+                    self._state, np.asarray([slot], np.int32), np.int32(now_ms)
+                )
+                value = int(v[0])
+        return value + delta <= counter.max_value
+
+    def add_counter(self, limit: Limit) -> None:
+        if not limit.variables:
+            with self._lock:
+                self._slot_for(Counter(limit, {}), create=True)
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        with self._lock:
+            now_ms = self._now_ms()
+            slot, is_fresh = self._slot_for(counter, create=True)
+            H = _bucket(1)
+            slots = np.full(H, self._scratch, np.int32)
+            deltas = np.zeros(H, np.int32)
+            windows = np.zeros(H, np.int32)
+            fresh = np.zeros(H, bool)
+            slots[0] = slot
+            deltas[0] = min(int(delta), K.MAX_DELTA_CAP)
+            windows[0] = _clamp_window_ms(counter.window_seconds)
+            fresh[0] = is_fresh
+            self._state = K.update_batch(
+                self._state, slots, deltas, windows, fresh, np.int32(now_ms)
+            )
+
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        if not counters:
+            return Authorization.OK
+        return self.check_many([_Request(counters, delta, load_counters)])[0]
+
+    def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
+        out: Set[Counter] = set()
+        with self._lock:
+            now_ms = self._now_ms()
+            values = np.asarray(self._state.values)
+            expiry = np.asarray(self._state.expiry_ms)
+            namespaces = {limit.namespace for limit in limits}
+            for slot, (_key, counter) in self._table.info.items():
+                if (
+                    counter.limit in limits
+                    or counter.namespace in namespaces
+                ):
+                    ttl = int(expiry[slot]) - now_ms
+                    if ttl <= 0:
+                        continue
+                    c = counter.key()
+                    c.remaining = c.max_value - int(values[slot])
+                    c.expires_in = ttl / 1000.0
+                    out.add(c)
+        return out
+
+    def delete_counters(self, limits: Set[Limit]) -> None:
+        with self._lock:
+            doomed: List[int] = []
+            for slot, (key, counter) in list(self._table.info.items()):
+                if counter.limit in limits:
+                    doomed.append(slot)
+                    self._table.release(slot, key, counter.is_qualified())
+            if doomed:
+                self._state = K.clear_slots(
+                    self._state, np.asarray(doomed, np.int32)
+                )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._table = _SlotTable(self._capacity)
+            self._state = K.make_table(self._capacity)
+
+    def close(self) -> None:
+        pass

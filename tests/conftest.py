@@ -8,7 +8,6 @@ benches on the real chip separately). Must run before jax is imported.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -16,6 +15,13 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The axon site hook forces jax_platforms=axon,cpu regardless of the
+# JAX_PLATFORMS env var; the config update below wins. Tests always run on
+# the 8-device virtual CPU mesh (the driver benches on the real chip).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

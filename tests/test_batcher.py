@@ -1,0 +1,126 @@
+"""Micro-batcher tests: batching must be semantically invisible and exact."""
+
+import asyncio
+
+import pytest
+
+from limitador_tpu import AsyncRateLimiter, Context, Limit
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_concurrent_checks_admit_exactly_max():
+    async def main():
+        storage = AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.002)
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 100, 60, [], ["u"]))
+
+        async def one(i):
+            ctx = Context({"u": "shared"})
+            r = await limiter.check_rate_limited_and_update("ns", ctx, 1)
+            return not r.limited
+
+        results = await asyncio.gather(*[one(i) for i in range(300)])
+        await storage.close()
+        return sum(results)
+
+    assert run(main()) == 100
+
+
+def test_batched_load_counters_and_names():
+    async def main():
+        storage = AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.002)
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 2, 60, [], ["u"], name="per-user"))
+
+        outs = []
+        for _ in range(3):
+            r = await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": "x"}), 1, load_counters=True
+            )
+            outs.append((r.limited, r.limit_name,
+                         [c.remaining for c in r.counters]))
+        await storage.close()
+        return outs
+
+    outs = run(main())
+    assert outs[0] == (False, None, [1])
+    assert outs[1] == (False, None, [0])
+    assert outs[2] == (True, "per-user", [0])
+
+
+def test_multi_user_batch_isolation():
+    async def main():
+        storage = AsyncTpuStorage(TpuStorage(capacity=1 << 12), max_delay=0.002)
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 5, 60, [], ["u"]))
+
+        async def hammer(user, n):
+            admitted = 0
+            for _ in range(n):
+                r = await limiter.check_rate_limited_and_update(
+                    "ns", Context({"u": user}), 1
+                )
+                admitted += 0 if r.limited else 1
+            return admitted
+
+        got = await asyncio.gather(*[hammer(f"u{i}", 8) for i in range(10)])
+        await storage.close()
+        return got
+
+    assert run(main()) == [5] * 10
+
+
+def test_qualified_counters_evict_gracefully_at_capacity():
+    async def main():
+        storage = AsyncTpuStorage(TpuStorage(capacity=8), max_delay=0.001)
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 5, 60, [], ["u"]))
+        for i in range(20):
+            r = await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": str(i)}), 1
+            )
+            assert not r.limited
+        await storage.close()
+
+    run(main())
+
+
+def test_batcher_exception_propagates():
+    """A table whose slots are all pinned by simple limits cannot host a
+    qualified counter: the StorageError raised during the flush must reach
+    every awaiting future."""
+    from limitador_tpu.storage.base import StorageError
+
+    async def main():
+        inner = TpuStorage(capacity=2)
+        storage = AsyncTpuStorage(inner, max_delay=0.001)
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("a", 5, 60))
+        limiter.add_limit(Limit("b", 5, 60))
+        inner.add_counter(Limit("a", 5, 60))
+        inner.add_counter(Limit("b", 5, 60))
+        limiter.add_limit(Limit("q", 5, 60, [], ["u"]))
+
+        async def one(i):
+            try:
+                await limiter.check_rate_limited_and_update(
+                    "q", Context({"u": str(i)}), 1
+                )
+                return None
+            except StorageError as exc:
+                return exc
+
+        results = await asyncio.gather(*[one(i) for i in range(3)])
+        await storage.close()
+        return results
+
+    results = run(main())
+    assert all(isinstance(r, Exception) for r in results)
