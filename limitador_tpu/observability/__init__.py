@@ -1,0 +1,3 @@
+from .metrics import PrometheusMetrics
+
+__all__ = ["PrometheusMetrics"]

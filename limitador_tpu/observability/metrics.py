@@ -1,0 +1,99 @@
+"""Prometheus metrics.
+
+Mirrors /root/reference/limitador-server/src/prometheus_metrics.rs: counters
+``authorized_calls`` / ``authorized_hits`` / ``limited_calls`` labeled by
+``limitador_namespace`` (plus ``limitador_limit_name`` when enabled),
+gauges ``limitador_up`` / ``datastore_partitioned``, histogram
+``datastore_latency`` (seconds) around device/storage calls.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Iterable, Optional
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+__all__ = ["PrometheusMetrics"]
+
+NAMESPACE_LABEL = "limitador_namespace"
+LIMIT_NAME_LABEL = "limitador_limit_name"
+
+
+class PrometheusMetrics:
+    def __init__(
+        self,
+        use_limit_name_label: bool = False,
+        registry: Optional[CollectorRegistry] = None,
+    ):
+        self.registry = registry or CollectorRegistry()
+        self.use_limit_name_label = use_limit_name_label
+        labels = [NAMESPACE_LABEL]
+        limited_labels = (
+            [NAMESPACE_LABEL, LIMIT_NAME_LABEL]
+            if use_limit_name_label
+            else [NAMESPACE_LABEL]
+        )
+        self.authorized_calls = Counter(
+            "authorized_calls", "Authorized calls", labels,
+            registry=self.registry,
+        )
+        self.authorized_hits = Counter(
+            "authorized_hits", "Authorized hits", labels,
+            registry=self.registry,
+        )
+        self.limited_calls = Counter(
+            "limited_calls", "Limited calls", limited_labels,
+            registry=self.registry,
+        )
+        self.limitador_up = Gauge(
+            "limitador_up", "Limitador is running", registry=self.registry
+        )
+        self.limitador_up.set(1)
+        self.datastore_partitioned = Gauge(
+            "datastore_partitioned",
+            "Limitador is partitioned from backing datastore",
+            registry=self.registry,
+        )
+        self.datastore_partitioned.set(0)
+        self.datastore_latency = Histogram(
+            "datastore_latency",
+            "Latency to the underlying counter datastore",
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+
+    def incr_authorized_calls(self, namespace: str) -> None:
+        self.authorized_calls.labels(namespace).inc()
+
+    def incr_authorized_hits(self, namespace: str, hits: int) -> None:
+        self.authorized_hits.labels(namespace).inc(hits)
+
+    def incr_limited_calls(
+        self, namespace: str, limit_name: Optional[str] = None
+    ) -> None:
+        if self.use_limit_name_label:
+            self.limited_calls.labels(namespace, limit_name or "").inc()
+        else:
+            self.limited_calls.labels(namespace).inc()
+
+    @contextmanager
+    def time_datastore(self):
+        start = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.datastore_latency.observe(time.perf_counter() - start)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,12 @@
+from .http_api import make_http_app, run_http_server
+from .limits_file import LimitsFileWatcher, load_limits_file
+from .rls import RlsService, serve_rls
+
+__all__ = [
+    "make_http_app",
+    "run_http_server",
+    "LimitsFileWatcher",
+    "load_limits_file",
+    "RlsService",
+    "serve_rls",
+]

@@ -1,0 +1,241 @@
+"""limitador-tpu server binary.
+
+CLI/env layering mirrors /root/reference/limitador-server/src/main.rs
+(clap subcommands per storage, main.rs:483-730) and config.rs's env
+registry; env vars keep the reference's names (LIMITS_FILE,
+ENVOY_RLS_HOST/PORT, HTTP_API_HOST/PORT, RATE_LIMIT_HEADERS,
+LIMIT_NAME_IN_PROMETHEUS_LABELS). CLI wins over env, env over defaults
+(doc/server/configuration.md:46).
+
+    python -m limitador_tpu.server LIMITS_FILE [storage] [options]
+
+Storages: tpu (default — device-resident counters), memory, disk,
+distributed. ``--validate`` parses the limits file and exits.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import sys
+
+from ..core.limiter import AsyncRateLimiter, RateLimiter
+from ..observability.metrics import PrometheusMetrics
+from .http_api import run_http_server
+from .limits_file import LimitsFileError, LimitsFileWatcher, load_limits_file
+from .rls import (
+    RATE_LIMIT_HEADERS_DRAFT03,
+    RATE_LIMIT_HEADERS_NONE,
+    serve_rls,
+)
+
+__all__ = ["main", "build_parser"]
+
+
+def _env(name, default=None):
+    return os.environ.get(name, default)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="limitador-tpu-server",
+        description="TPU-native rate limiter (Envoy RLS v3 + HTTP API)",
+    )
+    p.add_argument(
+        "limits_file",
+        nargs="?",
+        default=_env("LIMITS_FILE"),
+        help="YAML limits file (env: LIMITS_FILE)",
+    )
+    p.add_argument(
+        "storage",
+        nargs="?",
+        default=_env("STORAGE", "tpu"),
+        choices=["tpu", "memory", "disk", "distributed"],
+        help="counter storage backend (default: tpu)",
+    )
+    p.add_argument("--rls-host", default=_env("ENVOY_RLS_HOST", "0.0.0.0"))
+    p.add_argument(
+        "--rls-port", type=int, default=int(_env("ENVOY_RLS_PORT", "8081"))
+    )
+    p.add_argument("--http-host", default=_env("HTTP_API_HOST", "0.0.0.0"))
+    p.add_argument(
+        "--http-port", type=int, default=int(_env("HTTP_API_PORT", "8080"))
+    )
+    p.add_argument(
+        "--limit-name-in-labels",
+        action="store_true",
+        default=_env("LIMIT_NAME_IN_PROMETHEUS_LABELS") == "1",
+        help="add limit names to prometheus labels",
+    )
+    p.add_argument(
+        "--rate-limit-headers",
+        choices=[RATE_LIMIT_HEADERS_NONE, RATE_LIMIT_HEADERS_DRAFT03],
+        default=_env("RATE_LIMIT_HEADERS", RATE_LIMIT_HEADERS_NONE),
+    )
+    p.add_argument(
+        "--validate",
+        action="store_true",
+        help="validate the limits file and exit",
+    )
+    # storage tuning
+    p.add_argument(
+        "--cache-size", type=int, default=None,
+        help="qualified-counter cache cap (memory/tpu)",
+    )
+    p.add_argument(
+        "--tpu-capacity", type=int,
+        default=int(_env("TPU_TABLE_CAPACITY", str(1 << 20))),
+        help="device counter-table capacity (tpu)",
+    )
+    p.add_argument(
+        "--batch-delay-us", type=int,
+        default=int(_env("TPU_BATCH_DELAY_US", "500")),
+        help="micro-batcher linger in microseconds (tpu)",
+    )
+    p.add_argument("--disk-path", default=_env("DISK_PATH"))
+    p.add_argument(
+        "--peer", action="append", default=None,
+        help="distributed: peer address (repeatable)",
+    )
+    p.add_argument("--node-id", default=_env("NODE_ID"))
+    p.add_argument(
+        "--listen-address", default=_env("LISTEN_ADDRESS"),
+        help="distributed: replication listen address",
+    )
+    return p
+
+
+def build_limiter(args):
+    """Limiter::new equivalent (main.rs:93-185): pick + build the backend."""
+    if args.storage == "memory":
+        from ..storage.in_memory import DEFAULT_CACHE_SIZE, InMemoryStorage
+
+        return RateLimiter(
+            InMemoryStorage(args.cache_size or DEFAULT_CACHE_SIZE)
+        )
+    if args.storage == "tpu":
+        from ..tpu.batcher import AsyncTpuStorage
+        from ..tpu.storage import TpuStorage
+
+        storage = TpuStorage(
+            capacity=args.tpu_capacity, cache_size=args.cache_size
+        )
+        return AsyncRateLimiter(
+            AsyncTpuStorage(storage, max_delay=args.batch_delay_us / 1e6)
+        )
+    if args.storage == "disk":
+        try:
+            from ..storage.disk import DiskStorage
+        except ImportError as exc:
+            raise SystemExit(f"storage 'disk' unavailable: {exc}") from None
+
+        path = args.disk_path or "limitador_counters.db"
+        return RateLimiter(DiskStorage(path))
+    if args.storage == "distributed":
+        try:
+            from ..storage.distributed import CrInMemoryStorage
+        except ImportError as exc:
+            raise SystemExit(
+                f"storage 'distributed' unavailable: {exc}"
+            ) from None
+
+        return RateLimiter(
+            CrInMemoryStorage(
+                node_id=args.node_id or "node",
+                listen_address=args.listen_address or "0.0.0.0:5001",
+                peers=args.peer or [],
+            )
+        )
+    raise SystemExit(f"unknown storage {args.storage!r}")
+
+
+async def _amain(args) -> int:
+    limiter = build_limiter(args)
+    metrics = PrometheusMetrics(use_limit_name_label=args.limit_name_in_labels)
+    status = {"limits_file_version": 0, "limits_file_errors": 0}
+
+    async def apply_limits(limits):
+        if isinstance(limiter, AsyncRateLimiter):
+            await limiter.configure_with(limits)
+        else:
+            limiter.configure_with(limits)
+
+    watcher = None
+    if args.limits_file:
+        loop = asyncio.get_running_loop()
+
+        def on_change(limits):
+            status["limits_file_version"] += 1
+            asyncio.run_coroutine_threadsafe(apply_limits(limits), loop)
+
+        def on_error(exc):
+            status["limits_file_errors"] += 1
+            print(f"limits file reload failed: {exc}", file=sys.stderr)
+
+        # Construct the watcher (capturing its baseline stamp) BEFORE the
+        # initial load, so a file replaced between load and watch (e.g. a
+        # ConfigMap symlink flip during startup) still triggers a reload.
+        watcher = LimitsFileWatcher(args.limits_file, on_change, on_error)
+        limits = load_limits_file(args.limits_file)
+        await apply_limits(limits)
+        status["limits_file_version"] = 1
+        watcher.start()
+
+    rls_server = await serve_rls(
+        limiter,
+        f"{args.rls_host}:{args.rls_port}",
+        metrics,
+        args.rate_limit_headers,
+    )
+    http_runner = await run_http_server(
+        limiter, args.http_host, args.http_port, metrics, status
+    )
+    print(
+        f"limitador-tpu: RLS gRPC on {args.rls_host}:{args.rls_port}, "
+        f"HTTP on {args.http_host}:{args.http_port}, "
+        f"storage={args.storage}",
+        file=sys.stderr,
+    )
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:
+            pass
+    await stop.wait()
+
+    if watcher:
+        watcher.stop()
+    await rls_server.stop(grace=1.0)
+    await http_runner.cleanup()
+    if isinstance(limiter, AsyncRateLimiter):
+        await limiter.storage.counters.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.validate:
+        if not args.limits_file:
+            print("--validate requires a limits file", file=sys.stderr)
+            return 2
+        try:
+            limits = load_limits_file(args.limits_file)
+        except LimitsFileError as exc:
+            print(f"INVALID: {exc}", file=sys.stderr)
+            return 1
+        print(f"OK: {len(limits)} limits")
+        return 0
+    try:
+        return asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

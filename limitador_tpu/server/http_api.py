@@ -1,0 +1,194 @@
+"""HTTP admin/check API.
+
+Mirrors /root/reference/limitador-server/src/http_api/server.rs over aiohttp:
+
+    GET  /status            liveness + limits-config version/error counters
+    GET  /metrics           Prometheus text exposition
+    GET  /limits/{ns}       limits of a namespace (DTO: request_types.rs:19-27)
+    GET  /counters/{ns}     live counters with remaining/expires_in_seconds
+    POST /check             200/429, read-only (server.rs:127-157)
+    POST /report            200, update-only (server.rs:159-183)
+    POST /check_and_report  200/429 + optional draft-03 headers
+                            (server.rs:185-260)
+
+POST bodies are CheckAndReportInfo: {"namespace", "values": {str: str},
+"delta", "response_headers": optional "DRAFT_VERSION_03"}
+(request_types.rs:10-16).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from aiohttp import web
+
+from ..core.cel import Context
+from ..core.limit import Limit
+from ..observability.metrics import PrometheusMetrics
+from ..storage.base import StorageError
+from .rls import RATE_LIMIT_HEADERS_DRAFT03
+
+__all__ = ["make_http_app", "run_http_server"]
+
+
+def _limit_dto(limit: Limit) -> dict:
+    return {
+        "id": limit.id,
+        "namespace": str(limit.namespace),
+        "max_value": limit.max_value,
+        "seconds": limit.seconds,
+        "name": limit.name,
+        "conditions": sorted(c.source for c in limit.conditions),
+        "variables": sorted(v.source for v in limit.variables),
+    }
+
+
+def _counter_dto(counter) -> dict:
+    return {
+        "limit": _limit_dto(counter.limit),
+        "set_variables": dict(counter.set_variables),
+        "remaining": counter.remaining,
+        "expires_in_seconds": (
+            int(counter.expires_in) if counter.expires_in is not None else None
+        ),
+    }
+
+
+class _Api:
+    def __init__(self, limiter, metrics: Optional[PrometheusMetrics], status):
+        self.limiter = limiter
+        self.metrics = metrics
+        self.status = status or {}
+
+    async def _call(self, value):
+        if asyncio.iscoroutine(value):
+            return await value
+        return value
+
+    # -- handlers ----------------------------------------------------------
+
+    async def get_status(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", **self.status})
+
+    async def get_metrics(self, request: web.Request) -> web.Response:
+        body = self.metrics.render() if self.metrics else b""
+        return web.Response(body=body, content_type="text/plain")
+
+    async def get_limits(self, request: web.Request) -> web.Response:
+        ns = request.match_info["namespace"]
+        limits = self.limiter.get_limits(ns)
+        return web.json_response([_limit_dto(l) for l in sorted(limits)])
+
+    async def get_counters(self, request: web.Request) -> web.Response:
+        ns = request.match_info["namespace"]
+        try:
+            counters = await self._call(self.limiter.get_counters(ns))
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        dtos = sorted(
+            (_counter_dto(c) for c in counters),
+            key=lambda d: json.dumps(d, sort_keys=True),
+        )
+        return web.json_response(dtos)
+
+    @staticmethod
+    def _parse_info(data) -> tuple:
+        namespace = data["namespace"]
+        values = data.get("values") or {}
+        delta = int(data.get("delta", 1))
+        if delta < 0:
+            # The reference's DTO declares delta: u64 (request_types.rs:14);
+            # a negative delta would decrement counters and defeat limits.
+            raise ValueError("delta must be >= 0")
+        response_headers = data.get("response_headers")
+        ctx = Context()
+        ctx.list_binding("descriptors", [dict(values)])
+        return namespace, ctx, delta, response_headers
+
+    async def post_check(self, request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+            namespace, ctx, delta, _ = self._parse_info(data)
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response({"error": f"bad request: {exc}"}, status=400)
+        try:
+            result = await self._call(
+                self.limiter.is_rate_limited(namespace, ctx, delta)
+            )
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        if result.limited:
+            return web.Response(status=429)
+        return web.Response(status=200)
+
+    async def post_report(self, request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+            namespace, ctx, delta, _ = self._parse_info(data)
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response({"error": f"bad request: {exc}"}, status=400)
+        try:
+            await self._call(self.limiter.update_counters(namespace, ctx, delta))
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.Response(status=200)
+
+    async def post_check_and_report(self, request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+            namespace, ctx, delta, response_headers = self._parse_info(data)
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response({"error": f"bad request: {exc}"}, status=400)
+        want_headers = response_headers == RATE_LIMIT_HEADERS_DRAFT03
+        try:
+            result = await self._call(
+                self.limiter.check_rate_limited_and_update(
+                    namespace, ctx, delta, want_headers
+                )
+            )
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        headers = result.response_header() if want_headers else {}
+        if result.limited:
+            if self.metrics:
+                self.metrics.incr_limited_calls(namespace, result.limit_name)
+            return web.Response(status=429, headers=headers)
+        if self.metrics:
+            self.metrics.incr_authorized_calls(namespace)
+            self.metrics.incr_authorized_hits(namespace, delta)
+        return web.Response(status=200, headers=headers)
+
+
+def make_http_app(
+    limiter,
+    metrics: Optional[PrometheusMetrics] = None,
+    status: Optional[dict] = None,
+) -> web.Application:
+    api = _Api(limiter, metrics, status)
+    app = web.Application()
+    app.router.add_get("/status", api.get_status)
+    app.router.add_get("/metrics", api.get_metrics)
+    app.router.add_get("/limits/{namespace}", api.get_limits)
+    app.router.add_get("/counters/{namespace}", api.get_counters)
+    app.router.add_post("/check", api.post_check)
+    app.router.add_post("/report", api.post_report)
+    app.router.add_post("/check_and_report", api.post_check_and_report)
+    return app
+
+
+async def run_http_server(
+    limiter,
+    host: str = "0.0.0.0",
+    port: int = 8080,
+    metrics: Optional[PrometheusMetrics] = None,
+    status: Optional[dict] = None,
+) -> web.AppRunner:
+    """Start the HTTP server (returns the runner; caller owns shutdown)."""
+    app = make_http_app(limiter, metrics, status)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    return runner

@@ -1,0 +1,113 @@
+"""Limits YAML file: load, validate, hot reload.
+
+Mirrors the reference's limits-file handling
+(/root/reference/limitador-server/src/main.rs:187-246,302-407): the YAML is
+a list of limit objects (doc/server/configuration.md:58-105); changes are
+re-applied declaratively via ``configure_with`` (counters of surviving
+limits are preserved); the watcher tracks the canonical path so kubernetes
+ConfigMap symlink flips are caught. The reference uses inotify; here a
+polling thread watches (mtime, resolved path) — dependency-free and
+equally correct for the ConfigMap case.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, List, Optional
+
+import yaml
+
+from ..core.cel import ParseError
+from ..core.limit import Limit
+
+__all__ = ["load_limits_file", "LimitsFileWatcher"]
+
+
+class LimitsFileError(Exception):
+    pass
+
+
+def load_limits_file(path: str) -> List[Limit]:
+    """Parse + validate the limits YAML; raises LimitsFileError."""
+    try:
+        with open(path) as f:
+            data = yaml.safe_load(f)
+    except OSError as exc:
+        raise LimitsFileError(f"cannot read limits file {path}: {exc}") from None
+    except yaml.YAMLError as exc:
+        raise LimitsFileError(f"invalid YAML in {path}: {exc}") from None
+    if data is None:
+        return []
+    if not isinstance(data, list):
+        raise LimitsFileError(f"limits file {path} must contain a list")
+    limits = []
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict):
+            raise LimitsFileError(f"limits file {path}: entry {i} not a map")
+        try:
+            limits.append(Limit.from_dict(entry))
+        except (KeyError, TypeError, ValueError, ParseError) as exc:
+            raise LimitsFileError(
+                f"limits file {path}: entry {i} invalid: {exc}"
+            ) from None
+    return limits
+
+
+class LimitsFileWatcher:
+    """Polls (resolved path, mtime) and fires ``on_change(limits)`` — or
+    ``on_error(exc)`` — when the file content version changes."""
+
+    def __init__(
+        self,
+        path: str,
+        on_change: Callable[[List[Limit]], None],
+        on_error: Optional[Callable[[Exception], None]] = None,
+        poll_interval: float = 1.0,
+    ):
+        self.path = path
+        self.on_change = on_change
+        self.on_error = on_error
+        self.poll_interval = poll_interval
+        self._stamp = self._current_stamp()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.version = 1
+        self.errors = 0
+
+    def _current_stamp(self):
+        try:
+            real = os.path.realpath(self.path)
+            return (real, os.stat(real).st_mtime_ns)
+        except OSError:
+            return (None, None)
+
+    def _tick(self) -> None:
+        stamp = self._current_stamp()
+        if stamp == self._stamp:
+            return
+        self._stamp = stamp
+        try:
+            limits = load_limits_file(self.path)
+        except LimitsFileError as exc:
+            self.errors += 1
+            if self.on_error:
+                self.on_error(exc)
+            return
+        self.version += 1
+        self.on_change(limits)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval):
+            self._tick()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="limits-file-watcher", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)

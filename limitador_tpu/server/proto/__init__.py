@@ -1,0 +1,18 @@
+"""Generated protobuf bindings (wire-compatible Envoy RLS v3 + Kuadrant v1).
+
+protoc emits absolute imports rooted at the proto path, so this package dir
+joins sys.path before the generated modules load.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(os.path.abspath(__file__))
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
+from envoy.config.core.v3 import base_pb2  # noqa: E402
+from envoy.extensions.common.ratelimit.v3 import ratelimit_pb2  # noqa: E402
+
+__all__ = ["rls_pb2", "base_pb2", "ratelimit_pb2"]

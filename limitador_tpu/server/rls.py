@@ -1,0 +1,229 @@
+"""Envoy RLS v3 + Kuadrant RLS v1 gRPC services.
+
+Mirrors /root/reference/limitador-server/src/envoy_rls/server.rs and
+kuadrant_service.rs over grpc.aio with generic method handlers (the
+environment ships protoc without the gRPC python plugin; the service
+surface is just method paths + message (de)serializers).
+
+Behavioral parity points:
+- empty domain -> overall_code UNKNOWN (server.rs:106-116);
+- descriptors bind as the ``descriptors`` list-of-maps CEL variable
+  (server.rs:121-139);
+- hits_addend 0 -> 1 (the proto default is 0 but the spec default is 1,
+  server.rs:129-135);
+- storage errors -> gRPC UNAVAILABLE so Envoy's failure_mode_deny policy
+  decides fail-open/closed (server.rs:160-172);
+- optional draft-03 ratelimit response headers (server.rs:179-207);
+- Kuadrant split: CheckRateLimit is read-only, Report only updates
+  (kuadrant_service.rs:27-184).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import grpc
+
+from ..core.cel import Context
+from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
+from ..observability.metrics import PrometheusMetrics
+from ..storage.base import StorageError
+from .proto import rls_pb2
+
+__all__ = [
+    "RATE_LIMIT_HEADERS_NONE",
+    "RATE_LIMIT_HEADERS_DRAFT03",
+    "make_rls_handlers",
+    "serve_rls",
+]
+
+RATE_LIMIT_HEADERS_NONE = "NONE"
+RATE_LIMIT_HEADERS_DRAFT03 = "DRAFT_VERSION_03"
+
+_ENVOY_SERVICE = "envoy.service.ratelimit.v3.RateLimitService"
+_KUADRANT_SERVICE = "kuadrant.service.ratelimit.v1.RateLimitService"
+
+
+def _context_from_request(req) -> Context:
+    values = []
+    for descriptor in req.descriptors:
+        values.append({e.key: e.value for e in descriptor.entries})
+    ctx = Context()
+    ctx.list_binding("descriptors", values)
+    return ctx
+
+
+def _hits_addend(req) -> int:
+    return req.hits_addend if req.hits_addend != 0 else 1
+
+
+def _response(code, result: Optional[CheckResult], with_headers: bool):
+    resp = rls_pb2.RateLimitResponse(overall_code=code)
+    if with_headers and result is not None:
+        for key, value in result.response_header().items():
+            resp.response_headers_to_add.add(key=key, value=value)
+    return resp
+
+
+class RlsService:
+    """Shared implementation behind both gRPC services."""
+
+    def __init__(
+        self,
+        limiter,
+        metrics: Optional[PrometheusMetrics] = None,
+        rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
+    ):
+        self.limiter = limiter
+        self.metrics = metrics
+        self.rate_limit_headers = rate_limit_headers
+        self._is_async = isinstance(limiter, AsyncRateLimiter)
+
+    async def _check_and_update(self, namespace, ctx, delta, load):
+        if self._is_async:
+            return await self.limiter.check_rate_limited_and_update(
+                namespace, ctx, delta, load
+            )
+        return self.limiter.check_rate_limited_and_update(
+            namespace, ctx, delta, load
+        )
+
+    async def _is_rate_limited(self, namespace, ctx, delta):
+        if self._is_async:
+            return await self.limiter.is_rate_limited(namespace, ctx, delta)
+        return self.limiter.is_rate_limited(namespace, ctx, delta)
+
+    async def _update_counters(self, namespace, ctx, delta):
+        if self._is_async:
+            await self.limiter.update_counters(namespace, ctx, delta)
+        else:
+            self.limiter.update_counters(namespace, ctx, delta)
+
+    # -- Envoy ShouldRateLimit (THE hot path) -----------------------------
+
+    async def should_rate_limit(self, request, context):
+        namespace = request.domain
+        if not namespace:
+            return rls_pb2.RateLimitResponse(
+                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
+            )
+        ctx = _context_from_request(request)
+        hits_addend = _hits_addend(request)
+        with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
+        try:
+            result = await self._check_and_update(
+                namespace, ctx, hits_addend, with_headers
+            )
+        except StorageError as exc:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
+            )
+        if result.limited:
+            if self.metrics:
+                self.metrics.incr_limited_calls(namespace, result.limit_name)
+            code = rls_pb2.RateLimitResponse.OVER_LIMIT
+        else:
+            if self.metrics:
+                self.metrics.incr_authorized_calls(namespace)
+                self.metrics.incr_authorized_hits(namespace, hits_addend)
+            code = rls_pb2.RateLimitResponse.OK
+        return _response(code, result, with_headers)
+
+    # -- Kuadrant check/report split --------------------------------------
+
+    async def check_rate_limit(self, request, context):
+        namespace = request.domain
+        if not namespace:
+            return rls_pb2.RateLimitResponse(
+                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
+            )
+        ctx = _context_from_request(request)
+        try:
+            # The reference checks with delta 1 regardless of hits_addend
+            # (kuadrant_service.rs check path); the addend applies on Report.
+            result = await self._is_rate_limited(namespace, ctx, 1)
+        except StorageError as exc:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
+            )
+        if result.limited:
+            if self.metrics:
+                self.metrics.incr_limited_calls(namespace, result.limit_name)
+            code = rls_pb2.RateLimitResponse.OVER_LIMIT
+        else:
+            if self.metrics:
+                self.metrics.incr_authorized_calls(namespace)
+            code = rls_pb2.RateLimitResponse.OK
+        with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
+        return _response(code, result, with_headers)
+
+    async def report(self, request, context):
+        namespace = request.domain
+        if not namespace:
+            return rls_pb2.RateLimitResponse(
+                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
+            )
+        ctx = _context_from_request(request)
+        hits_addend = _hits_addend(request)
+        try:
+            await self._update_counters(namespace, ctx, hits_addend)
+        except StorageError as exc:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
+            )
+        if self.metrics:
+            # Report counts hits only (kuadrant_service.rs report path);
+            # authorized_calls is counted by CheckRateLimit.
+            self.metrics.incr_authorized_hits(namespace, hits_addend)
+        return rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        )
+
+
+def make_rls_handlers(service: RlsService):
+    """Generic handlers for both services (no grpc plugin codegen needed)."""
+    req_des = rls_pb2.RateLimitRequest.FromString
+    resp_ser = lambda m: m.SerializeToString()
+
+    envoy = grpc.method_handlers_generic_handler(
+        _ENVOY_SERVICE,
+        {
+            "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                service.should_rate_limit,
+                request_deserializer=req_des,
+                response_serializer=resp_ser,
+            )
+        },
+    )
+    kuadrant = grpc.method_handlers_generic_handler(
+        _KUADRANT_SERVICE,
+        {
+            "CheckRateLimit": grpc.unary_unary_rpc_method_handler(
+                service.check_rate_limit,
+                request_deserializer=req_des,
+                response_serializer=resp_ser,
+            ),
+            "Report": grpc.unary_unary_rpc_method_handler(
+                service.report,
+                request_deserializer=req_des,
+                response_serializer=resp_ser,
+            ),
+        },
+    )
+    return [envoy, kuadrant]
+
+
+async def serve_rls(
+    limiter,
+    address: str = "0.0.0.0:8081",
+    metrics: Optional[PrometheusMetrics] = None,
+    rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
+) -> grpc.aio.Server:
+    """Start the gRPC server (returns it started; caller owns shutdown)."""
+    server = grpc.aio.server()
+    service = RlsService(limiter, metrics, rate_limit_headers)
+    for handler in make_rls_handlers(service):
+        server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(address)
+    await server.start()
+    return server
