@@ -1,0 +1,207 @@
+"""Leaderless replicated counter storage (CRDT + gossip).
+
+Mirrors /root/reference/limitador/src/storage/distributed/mod.rs: counters
+are per-actor CRDTs merged by max (cr_counter_value.py); every local
+increment publishes the counter's full snapshot to the replication Broker
+(distributed/mod.rs:286-292); incoming CounterUpdates merge into local
+state (:233-247); a newly connected peer receives a full re-sync
+(:294-332). Reads never block on the network — bounded over-admission
+between gossip rounds is the documented contract of this topology
+(doc/topologies.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from ...core.counter import Counter
+from ...core.limit import Limit
+from ..base import Authorization, CounterStorage
+from ..keys import key_for_counter, partial_counter_from_key
+from .cr_counter_value import CrCounterValue
+
+__all__ = ["CrInMemoryStorage", "CrCounterValue"]
+
+
+class _Entry:
+    __slots__ = ("key", "value")
+
+    def __init__(self, key: bytes, value: CrCounterValue):
+        self.key = key
+        self.value = value
+
+
+class CrInMemoryStorage(CounterStorage):
+    def __init__(
+        self,
+        node_id: str,
+        listen_address: Optional[str] = None,
+        peers: Optional[List[str]] = None,
+        clock=time.time,
+    ):
+        self._lock = threading.RLock()
+        self._clock = clock
+        self.node_id = node_id
+        self._counters: Dict[bytes, _Entry] = {}
+        self.broker = None
+        if listen_address is not None:
+            from .broker import Broker
+
+            self.broker = Broker(
+                peer_id=node_id,
+                listen_address=listen_address,
+                peer_urls=peers or [],
+                on_update=self._on_remote_update,
+                snapshot_provider=self._snapshot,
+            )
+            self.broker.start()
+
+    @classmethod
+    def standalone(cls, node_id: str) -> "CrInMemoryStorage":
+        """Single-node instance (no replication) — same CRDT semantics."""
+        return cls(node_id)
+
+    # -- replication plumbing ------------------------------------------------
+
+    def _snapshot(self):
+        """Full counter set for re-syncing a newly connected peer."""
+        with self._lock:
+            out = []
+            now = self._clock()
+            for entry in self._counters.values():
+                if entry.value.expired_at(now):
+                    continue
+                values, expiry = entry.value.snapshot()
+                out.append((entry.key, values, int(expiry * 1000)))
+            return out
+
+    def _on_remote_update(
+        self, key: bytes, values: Dict[str, int], expires_at_ms: int
+    ) -> None:
+        now = self._clock()
+        expiry = expires_at_ms / 1000.0
+        with self._lock:
+            entry = self._counters.get(key)
+            if entry is None:
+                value = CrCounterValue(self.node_id, 0.0, now)  # expired shell
+                entry = _Entry(key, value)
+                self._counters[key] = entry
+            entry.value.merge_at(values, expiry, now)
+
+    def _publish(self, entry: _Entry) -> None:
+        if self.broker is not None:
+            values, expiry = entry.value.snapshot()
+            self.broker.publish(entry.key, values, int(expiry * 1000))
+
+    # -- internals -------------------------------------------------------------
+
+    def _entry_for(self, counter: Counter, now: float) -> _Entry:
+        key = key_for_counter(counter)
+        entry = self._counters.get(key)
+        if entry is None:
+            entry = _Entry(
+                key, CrCounterValue(self.node_id, counter.window_seconds, now)
+            )
+            self._counters[key] = entry
+        return entry
+
+    # -- CounterStorage ----------------------------------------------------------
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        now = self._clock()
+        with self._lock:
+            entry = self._counters.get(key_for_counter(counter))
+            value = entry.value.read_at(now) if entry else 0
+        return value + delta <= counter.max_value
+
+    def add_counter(self, limit: Limit) -> None:
+        pass  # entries are created on first touch
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        now = self._clock()
+        with self._lock:
+            entry = self._entry_for(counter, now)
+            entry.value.inc_at(delta, counter.window_seconds, now)
+            self._publish(entry)
+
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        now = self._clock()
+        with self._lock:
+            first_limited: Optional[Authorization] = None
+            to_update: List[tuple] = []
+            for counter in counters:
+                entry = self._entry_for(counter, now)
+                value = entry.value.read_at(now)
+                if load_counters:
+                    remaining = counter.max_value - (value + delta)
+                    counter.remaining = max(remaining, 0)
+                    counter.expires_in = (
+                        entry.value.ttl(now)
+                        if not entry.value.expired_at(now)
+                        else counter.window_seconds
+                    )
+                    if first_limited is None and remaining < 0:
+                        first_limited = Authorization.limited_by(
+                            counter.limit.name
+                        )
+                if value + delta > counter.max_value:
+                    if not load_counters:
+                        return Authorization.limited_by(counter.limit.name)
+                to_update.append((entry, counter))
+            if first_limited is not None:
+                return first_limited
+            for entry, counter in to_update:
+                entry.value.inc_at(delta, counter.window_seconds, now)
+                self._publish(entry)
+            return Authorization.OK
+
+    @staticmethod
+    def _decode(key: bytes, limits: Set[Limit]) -> Optional[Counter]:
+        """Counter from key, or None for foreign/undecodable keys (a peer
+        running a different key codec must not break the admin API)."""
+        try:
+            return partial_counter_from_key(key, limits)
+        except Exception:
+            return None
+
+    def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
+        now = self._clock()
+        out: Set[Counter] = set()
+        # Values are read under the lock: the broker thread's merge_at
+        # mutates the same per-actor dicts.
+        with self._lock:
+            live = [
+                (entry.key, entry.value.read_at(now), entry.value.ttl(now))
+                for entry in self._counters.values()
+                if not entry.value.expired_at(now)
+            ]
+        for key, value, ttl in live:
+            counter = self._decode(key, limits)
+            if counter is None:
+                continue
+            counter.remaining = counter.max_value - value
+            counter.expires_in = ttl
+            out.add(counter)
+        return out
+
+    def delete_counters(self, limits: Set[Limit]) -> None:
+        with self._lock:
+            doomed = [
+                key
+                for key in self._counters
+                if self._decode(key, limits) is not None
+            ]
+            for key in doomed:
+                del self._counters[key]
+
+    def clear(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+    def close(self) -> None:
+        if self.broker is not None:
+            self.broker.stop()

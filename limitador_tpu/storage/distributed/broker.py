@@ -1,0 +1,348 @@
+"""Replication broker: full-mesh gRPC gossip of CRDT counter updates.
+
+The distributed communication backend, mirroring
+/root/reference/limitador/src/storage/distributed/grpc/mod.rs over grpc.aio:
+
+- bidirectional ``Replication.Stream(stream Packet)`` sessions (same wire
+  messages / field numbers as the reference's proto; note counter KEYS are
+  this implementation's msgpack codec — mixing with Rust-limitador peers
+  (postcard keys) parses but does not merge counters, so clusters must be
+  homogeneous);
+- handshake: both sides send Hello, answer with Pong carrying wall-clock
+  ms; the receiver derives per-peer clock skew used to map remote expiry
+  timestamps into the local clock (grpc/mod.rs:33-77, 625-746);
+- duplicate-session tiebreak by peer-id ordering (grpc/mod.rs:678-709);
+- membership gossip: MembershipUpdate advertises known peers; unknown
+  peers are dialed, forming the full mesh (grpc/mod.rs:230-260);
+- re-sync on connect: the full counter set streams to a newly connected
+  peer, ending with ReSyncEnd (grpc/mod.rs:110-148);
+- per-session send loop coalesces multiple updates to the same key —
+  backpressure by coalescing, never by blocking the hot path
+  (grpc/mod.rs:155-192);
+- auto-reconnect every second (grpc/mod.rs:521-529).
+
+The broker owns a daemon thread running its own asyncio loop; the sync
+storage publishes via ``publish()`` (thread-safe) and receives merges on
+the broker thread through ``on_update`` (the storage lock serializes).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+import grpc
+
+from ...server import proto as _proto  # ensures generated modules importable
+from limitador.service.distributed.v1 import distributed_pb2 as pb
+
+__all__ = ["Broker"]
+
+log = logging.getLogger("limitador_tpu.distributed")
+
+_SERVICE = "limitador.service.distributed.v1.Replication"
+_METHOD = f"/{_SERVICE}/Stream"
+_RECONNECT_SECONDS = 1.0
+
+OnUpdate = Callable[[bytes, Dict[str, int], int], None]
+SnapshotProvider = Callable[[], Iterable[Tuple[bytes, Dict[str, int], int]]]
+
+
+def _now_ms() -> int:
+    return int(time.time() * 1000)
+
+
+class _Session:
+    """One live replication session with a peer (either direction)."""
+
+    def __init__(self, peer_id: str, initiated: bool):
+        self.peer_id = peer_id
+        self.initiated = initiated
+        self.clock_skew_ms = 0
+        self.latency_ms = 0
+        self._pending: Dict[bytes, Tuple[Dict[str, int], int]] = {}
+        self._wakeup = asyncio.Event()
+        self.closed = asyncio.Event()
+
+    def enqueue(self, key: bytes, values: Dict[str, int], expires_at: int) -> None:
+        # Coalesce by key: only the latest snapshot per counter is sent.
+        self._pending[key] = (values, expires_at)
+        self._wakeup.set()
+
+    async def drain(self) -> List[pb.Packet]:
+        await self._wakeup.wait()
+        self._wakeup.clear()
+        pending, self._pending = self._pending, {}
+        return [
+            pb.Packet(
+                counter_update=pb.CounterUpdate(
+                    key=key, values=values, expires_at=expires_at
+                )
+            )
+            for key, (values, expires_at) in pending.items()
+        ]
+
+
+class Broker:
+    def __init__(
+        self,
+        peer_id: str,
+        listen_address: str,
+        peer_urls: Iterable[str],
+        on_update: OnUpdate,
+        snapshot_provider: SnapshotProvider,
+    ):
+        self.peer_id = peer_id
+        self.listen_address = listen_address
+        self.peer_urls: List[str] = list(peer_urls)
+        self.on_update = on_update
+        self.snapshot_provider = snapshot_provider
+        self.sessions: Dict[str, _Session] = {}
+        self.known_peers: Dict[str, List[str]] = {}  # peer_id -> urls
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._thread: Optional[threading.Thread] = None
+        self._server: Optional[grpc.aio.Server] = None
+        self._dialers: Dict[str, asyncio.Task] = {}
+        self._stopping = threading.Event()
+        self._started = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._thread_main, name=f"broker-{self.peer_id}", daemon=True
+        )
+        self._thread.start()
+        self._started.wait(timeout=10)
+
+    def _thread_main(self) -> None:
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_until_complete(self._amain())
+
+    async def _amain(self) -> None:
+        self._server = grpc.aio.server()
+        handler = grpc.method_handlers_generic_handler(
+            _SERVICE,
+            {
+                "Stream": grpc.stream_stream_rpc_method_handler(
+                    self._serve_stream,
+                    request_deserializer=pb.Packet.FromString,
+                    response_serializer=lambda m: m.SerializeToString(),
+                )
+            },
+        )
+        self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_insecure_port(self.listen_address)
+        await self._server.start()
+        for url in self.peer_urls:
+            self._spawn_dialer(url)
+        self._started.set()
+        while not self._stopping.is_set():
+            await asyncio.sleep(0.1)
+        for d in self._dialers.values():
+            d.cancel()
+        await asyncio.gather(*self._dialers.values(), return_exceptions=True)
+        await self._server.stop(grace=0.2)
+
+    def _spawn_dialer(self, url: str) -> None:
+        """One tracked dial loop per url (gossip-learned ones included, so
+        shutdown cancels them and a peer's multiple urls don't race)."""
+        if url not in self._dialers and url != self.listen_address:
+            self._dialers[url] = asyncio.ensure_future(self._dial_loop(url))
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    # -- publishing (called from the storage thread) --------------------------
+
+    def publish(self, key: bytes, values: Dict[str, int], expires_at: int) -> None:
+        if self._loop is None:
+            return
+        def _enqueue():
+            for session in list(self.sessions.values()):
+                session.enqueue(key, values, expires_at)
+        try:
+            self._loop.call_soon_threadsafe(_enqueue)
+        except RuntimeError:
+            pass  # loop shut down
+
+    # -- session protocol ------------------------------------------------------
+
+    def _membership_packet(self) -> pb.Packet:
+        peers = [
+            pb.Peer(peer_id=pid, urls=urls, latency=0)
+            for pid, urls in self.known_peers.items()
+        ]
+        return pb.Packet(membership_update=pb.MembershipUpdate(peers=peers))
+
+    def _register(self, session: _Session) -> bool:
+        """Duplicate-session tiebreak (grpc/mod.rs:678-709): when two
+        sessions to the same peer race, keep the one initiated by the
+        lexicographically smaller peer id."""
+        existing = self.sessions.get(session.peer_id)
+        if existing is not None and not existing.closed.is_set():
+            keep_initiated_by_us = self.peer_id < session.peer_id
+            if session.initiated != keep_initiated_by_us:
+                return False
+            existing.closed.set()
+        self.sessions[session.peer_id] = session
+        return True
+
+    async def _run_session(self, session: _Session, send, recv) -> None:
+        """Symmetric post-Hello protocol: pong, membership, re-sync, updates."""
+        await send(pb.Packet(pong=pb.Pong(current_time=_now_ms())))
+        await send(self._membership_packet())
+        for key, values, expires_at in self.snapshot_provider():
+            await send(
+                pb.Packet(
+                    counter_update=pb.CounterUpdate(
+                        key=key, values=values, expires_at=expires_at
+                    )
+                )
+            )
+        await send(pb.Packet(re_sync_end=pb.Empty()))
+
+        async def sender():
+            while not session.closed.is_set():
+                for packet in await session.drain():
+                    await send(packet)
+
+        send_task = asyncio.ensure_future(sender())
+        try:
+            while True:
+                packet = await recv()
+                if packet is None:
+                    break
+                kind = packet.WhichOneof("message")
+                if kind == "counter_update":
+                    cu = packet.counter_update
+                    # Map the remote expiry into the local clock.
+                    expires_at = cu.expires_at - session.clock_skew_ms
+                    self.on_update(cu.key, dict(cu.values), expires_at)
+                elif kind == "ping":
+                    await send(pb.Packet(pong=pb.Pong(current_time=_now_ms())))
+                elif kind == "pong":
+                    session.clock_skew_ms = packet.pong.current_time - _now_ms()
+                elif kind == "membership_update":
+                    for peer in packet.membership_update.peers:
+                        if (
+                            peer.peer_id != self.peer_id
+                            and peer.peer_id not in self.known_peers
+                        ):
+                            self.known_peers[peer.peer_id] = list(peer.urls)
+                            for url in peer.urls:
+                                self._spawn_dialer(url)
+                # re_sync_end / hello: nothing to do post-handshake
+        finally:
+            session.closed.set()
+            send_task.cancel()
+            if self.sessions.get(session.peer_id) is session:
+                del self.sessions[session.peer_id]
+
+    # -- server side -----------------------------------------------------------
+
+    async def _serve_stream(self, request_iterator, context):
+        out: asyncio.Queue = asyncio.Queue()
+
+        async def send(packet):
+            await out.put(packet)
+
+        it = request_iterator.__aiter__()
+
+        async def recv():
+            try:
+                return await it.__anext__()
+            except StopAsyncIteration:
+                return None
+
+        async def protocol():
+            hello_pkt = await recv()
+            if hello_pkt is None or hello_pkt.WhichOneof("message") != "hello":
+                await out.put(None)
+                return
+            peer_id = hello_pkt.hello.sender_peer_id
+            self.known_peers.setdefault(
+                peer_id, list(hello_pkt.hello.sender_urls)
+            )
+            session = _Session(peer_id, initiated=False)
+            if not self._register(session):
+                await out.put(None)
+                return
+            await send(pb.Packet(hello=pb.Hello(sender_peer_id=self.peer_id)))
+            try:
+                await self._run_session(session, send, recv)
+            finally:
+                await out.put(None)
+
+        task = asyncio.ensure_future(protocol())
+        try:
+            while True:
+                packet = await out.get()
+                if packet is None:
+                    break
+                yield packet
+        finally:
+            task.cancel()
+
+    # -- client side -------------------------------------------------------------
+
+    async def _dial_loop(self, url: str) -> None:
+        while not self._stopping.is_set():
+            try:
+                await self._dial_once(url)
+            except (grpc.RpcError, grpc.aio.AioRpcError, OSError) as exc:
+                log.debug("dial %s failed: %s", url, exc)
+            except asyncio.CancelledError:
+                return
+            await asyncio.sleep(_RECONNECT_SECONDS)
+
+    async def _dial_once(self, url: str) -> None:
+        async with grpc.aio.insecure_channel(url) as channel:
+            stream = channel.stream_stream(
+                _METHOD,
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=pb.Packet.FromString,
+            )
+            call = stream()
+            await call.write(
+                pb.Packet(
+                    hello=pb.Hello(
+                        sender_peer_id=self.peer_id,
+                        sender_urls=[self.listen_address],
+                        receiver_url=url,
+                    )
+                )
+            )
+            hello_pkt = await call.read()
+            if (
+                hello_pkt is grpc.aio.EOF
+                or hello_pkt.WhichOneof("message") != "hello"
+            ):
+                return
+            peer_id = hello_pkt.hello.sender_peer_id
+            if peer_id == self.peer_id:
+                return  # configured to dial ourselves
+            session = _Session(peer_id, initiated=True)
+            if not self._register(session):
+                # A healthy session to this peer already exists (tiebreak
+                # kept it); park until it drops instead of redialing every
+                # second (reference grpc/mod.rs:506-517).
+                existing = self.sessions.get(peer_id)
+                if existing is not None:
+                    await existing.closed.wait()
+                return
+
+            async def send(packet):
+                await call.write(packet)
+
+            async def recv():
+                packet = await call.read()
+                return None if packet is grpc.aio.EOF else packet
+
+            await self._run_session(session, send, recv)

@@ -1,0 +1,95 @@
+"""Per-actor CRDT counter (G-counter with window expiry).
+
+Mirrors /root/reference/limitador/src/storage/distributed/cr_counter_value.rs:
+each replica ("actor") owns its count; the value reads as the sum of all
+live per-actor counts (read-as-sum, :38-46); merging takes the per-actor
+max (:77-113) so replays are idempotent and concurrent merges commute; an
+expired window resets everything.
+
+Python port notes: callers serialize access (the storage lock), so plain
+ints replace the atomics; time is float seconds since epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+__all__ = ["CrCounterValue"]
+
+
+class CrCounterValue:
+    __slots__ = ("ourselves", "own", "others", "expiry")
+
+    def __init__(self, actor: str, window_seconds: float, now: float):
+        self.ourselves = actor
+        self.own = 0
+        self.others: Dict[str, int] = {}
+        self.expiry = now + window_seconds
+
+    def expired_at(self, now: float) -> bool:
+        return now >= self.expiry
+
+    def read_at(self, now: float) -> int:
+        if self.expired_at(now):
+            return 0
+        return self.own + sum(self.others.values())
+
+    def ttl(self, now: float) -> float:
+        return max(self.expiry - now, 0.0)
+
+    def inc_at(self, increment: int, window_seconds: float, now: float) -> None:
+        if self.expired_at(now):
+            self.own = increment
+            self.others.clear()
+            self.expiry = now + window_seconds
+        else:
+            self.own += increment
+
+    def inc_actor_at(
+        self, actor: str, increment: int, window_seconds: float, now: float
+    ) -> None:
+        if actor == self.ourselves:
+            self.inc_at(increment, window_seconds, now)
+        elif self.expired_at(now):
+            self.own = 0
+            self.others = {actor: increment}
+            self.expiry = now + window_seconds
+        else:
+            self.others[actor] = self.others.get(actor, 0) + increment
+
+    def merge_at(
+        self, values: Dict[str, int], expiry: float, now: float
+    ) -> None:
+        """Merge a remote snapshot: per-actor max, earliest future expiry;
+        an expired local window adopts the remote one wholesale
+        (cr_counter_value.rs:84-113)."""
+        if expiry <= now:
+            return
+        if self.expired_at(now):
+            self.own = 0
+            self.others.clear()
+            self.expiry = expiry
+        else:
+            self.expiry = min(
+                e for e in (self.expiry, expiry) if e > now
+            )
+        for actor, other_value in values.items():
+            if actor == self.ourselves:
+                if other_value > self.own:
+                    self.own = other_value
+            else:
+                local = self.others.get(actor, 0)
+                if other_value > local:
+                    self.others[actor] = other_value
+
+    def snapshot(self) -> Tuple[Dict[str, int], float]:
+        """All per-actor values (incl. our own) + expiry, for replication."""
+        values = dict(self.others)
+        values[self.ourselves] = self.own
+        return values, self.expiry
+
+    def __repr__(self) -> str:
+        return (
+            f"CrCounterValue(actor={self.ourselves!r}, own={self.own}, "
+            f"others={self.others!r}, expiry={self.expiry})"
+        )

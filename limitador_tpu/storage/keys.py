@@ -1,0 +1,106 @@
+"""Counter key codecs.
+
+Mirrors /root/reference/limitador/src/storage/keys.rs:
+
+- Text encoding ``namespace:{ns},counter:<json>`` with the ``{ns}``
+  hash-tag so a Redis-cluster-style sharder routes a namespace's counters
+  together (keys.rs:1-40); ``prefix_for_namespace`` gives the scan prefix.
+- Binary versioned codec (keys.rs:188-298): version byte 2 encodes
+  (limit id, set_variables) for limits with an id — compact; version 1
+  encodes the full limit identity (namespace, seconds, conditions,
+  variables) plus set_variables. The reference serializes with postcard;
+  here msgpack plays that role (same version-prefix scheme, symmetric
+  decode back to a partial counter).
+
+``partial_counter_from_key`` reconstructs enough of a Counter to re-attach
+it to a live Limit via ``Counter.update_to_limit`` (keys.rs:79-106).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, Optional, Tuple
+
+import msgpack
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+
+__all__ = [
+    "key_for_counter_text",
+    "prefix_for_namespace",
+    "key_for_counter",
+    "partial_counter_from_key",
+]
+
+
+# -- text codec (keys.rs:20-63) ---------------------------------------------
+
+
+def key_for_counter_text(counter: Counter) -> str:
+    counter_json = json.dumps(
+        {
+            "namespace": str(counter.namespace),
+            "seconds": counter.window_seconds,
+            "conditions": sorted(c.source for c in counter.limit.conditions),
+            "variables": sorted(v.source for v in counter.limit.variables),
+            "vars": dict(counter.set_variables),
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+    return f"namespace:{{{counter.namespace}}},counter:{counter_json}"
+
+
+def prefix_for_namespace(namespace: str) -> str:
+    return f"namespace:{{{namespace}}},"
+
+
+# -- binary codec (keys.rs:188-298) -----------------------------------------
+
+
+def key_for_counter(counter: Counter) -> bytes:
+    """Version-prefixed binary key; v2 (id + vars) when the limit has an
+    id, else v1 (full limit identity + vars)."""
+    if counter.limit.id is not None:
+        payload = [
+            counter.limit.id,
+            sorted(counter.set_variables.items()),
+        ]
+        return b"\x02" + msgpack.packb(payload, use_bin_type=True)
+    payload = [
+        str(counter.namespace),
+        counter.window_seconds,
+        sorted(c.source for c in counter.limit.conditions),
+        sorted(v.source for v in counter.limit.variables),
+        sorted(counter.set_variables.items()),
+    ]
+    return b"\x01" + msgpack.packb(payload, use_bin_type=True)
+
+
+def partial_counter_from_key(
+    key: bytes, limits: Iterable[Limit]
+) -> Optional[Counter]:
+    """Decode a binary key and re-attach it to the matching limit from
+    ``limits``; None if no limit matches (the limit was deleted)."""
+    version, body = key[0], key[1:]
+    if version == 2:
+        limit_id, vars_list = msgpack.unpackb(body, raw=False)
+        for limit in limits:
+            if limit.id == limit_id:
+                return Counter(limit, dict(vars_list))
+        return None
+    if version == 1:
+        namespace, seconds, conditions, variables, vars_list = msgpack.unpackb(
+            body, raw=False
+        )
+        for limit in limits:
+            if (
+                str(limit.namespace) == namespace
+                and limit.seconds == seconds
+                and sorted(c.source for c in limit.conditions) == conditions
+                and sorted(v.source for v in limit.variables) == variables
+            ):
+                return Counter(limit, dict(vars_list))
+        return None
+    raise ValueError(f"unknown counter key version {version}")

@@ -1,0 +1,152 @@
+"""Distributed CRDT + gossip replication tests.
+
+CRDT laws from cr_counter_value.rs tests (commutativity, per-actor max,
+expiry); multi-node convergence from integration_tests.rs
+distributed_rate_limited (2 real nodes on loopback, alternate hits,
+eventually limited on both).
+"""
+
+import socket
+import time
+
+import pytest
+
+from limitador_tpu import Context, Limit, RateLimiter
+from limitador_tpu.storage.distributed import CrCounterValue, CrInMemoryStorage
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class TestCrCounterValue:
+    def test_read_as_sum(self):
+        v = CrCounterValue("a", 60, now=100.0)
+        v.inc_at(3, 60, 100.0)
+        v.inc_actor_at("b", 4, 60, 101.0)
+        assert v.read_at(102.0) == 7
+
+    def test_merge_is_per_actor_max(self):
+        v = CrCounterValue("a", 60, now=100.0)
+        v.inc_at(3, 60, 100.0)
+        # Remote snapshot claims a=2 (stale, ours is 3) and b=5.
+        v.merge_at({"a": 2, "b": 5}, expiry=160.0, now=101.0)
+        assert v.read_at(101.0) == 8  # max(3,2) + 5
+
+    def test_merge_commutes(self):
+        def build(merges):
+            v = CrCounterValue("me", 60, now=100.0)
+            for values, expiry in merges:
+                v.merge_at(values, expiry, 100.0)
+            return v.read_at(100.0), v.expiry
+
+        m1 = ({"a": 3}, 150.0)
+        m2 = ({"a": 1, "b": 2}, 140.0)
+        assert build([m1, m2]) == build([m2, m1])
+
+    def test_merge_idempotent(self):
+        v = CrCounterValue("me", 60, now=100.0)
+        for _ in range(3):
+            v.merge_at({"a": 5}, 150.0, 100.0)
+        assert v.read_at(100.0) == 5
+
+    def test_expiry_resets(self):
+        v = CrCounterValue("a", 10, now=100.0)
+        v.inc_at(3, 10, 100.0)
+        v.inc_actor_at("b", 4, 10, 100.0)
+        assert v.read_at(111.0) == 0
+        v.inc_at(1, 10, 111.0)
+        assert v.read_at(111.0) == 1  # old actors dropped
+
+    def test_expired_remote_merge_ignored(self):
+        v = CrCounterValue("a", 60, now=100.0)
+        v.inc_at(1, 60, 100.0)
+        v.merge_at({"b": 99}, expiry=90.0, now=100.0)  # already expired
+        assert v.read_at(100.0) == 1
+
+
+class TestSingleNode:
+    def test_standalone_behaves_like_memory(self):
+        storage = CrInMemoryStorage.standalone("n1")
+        limiter = RateLimiter(storage)
+        limiter.add_limit(Limit("ns", 3, 60, [], ["u"]))
+        ctx = Context({"u": "a"})
+        for _ in range(3):
+            assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+        assert limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+
+
+class TestReplication:
+    def make_cluster(self, n=2):
+        ports = [free_port() for _ in range(n)]
+        urls = [f"127.0.0.1:{p}" for p in ports]
+        nodes = []
+        for i in range(n):
+            peers = [u for j, u in enumerate(urls) if j != i]
+            nodes.append(
+                CrInMemoryStorage(
+                    f"node{i}", listen_address=urls[i], peers=peers
+                )
+            )
+        return nodes
+
+    def eventually(self, cond, timeout=10.0, tick=0.1):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if cond():
+                return True
+            time.sleep(tick)
+        return False
+
+    def test_distributed_rate_limited(self):
+        nodes = self.make_cluster(2)
+        try:
+            limit = Limit("ns", 3, 60, ["m == 'GET'"], ["u"])
+            limiters = [RateLimiter(s) for s in nodes]
+            for lim in limiters:
+                lim.add_limit(limit)
+            ctx = Context({"m": "GET", "u": "app"})
+            for i in range(3):
+                lim = limiters[i % 2]
+                assert not lim.is_rate_limited("ns", ctx, 1).limited, f"hit {i}"
+                lim.update_counters("ns", ctx, 1)
+            # Convergence: both nodes eventually see the global count.
+            assert self.eventually(
+                lambda: limiters[0].is_rate_limited("ns", ctx, 1).limited
+            ), "node0 never converged"
+            assert self.eventually(
+                lambda: limiters[1].is_rate_limited("ns", ctx, 1).limited
+            ), "node1 never converged"
+        finally:
+            for s in nodes:
+                s.close()
+
+    def test_resync_on_late_join(self):
+        """A node joining after traffic receives the full counter set."""
+        port0, port1 = free_port(), free_port()
+        n0 = CrInMemoryStorage("node0", f"127.0.0.1:{port0}", [])
+        try:
+            limit = Limit("ns", 10, 60, [], ["u"])
+            lim0 = RateLimiter(n0)
+            lim0.add_limit(limit)
+            lim0.update_counters("ns", Context({"u": "x"}), 7)
+
+            n1 = CrInMemoryStorage(
+                "node1", f"127.0.0.1:{port1}", [f"127.0.0.1:{port0}"]
+            )
+            try:
+                lim1 = RateLimiter(n1)
+                lim1.add_limit(limit)
+                assert self.eventually(
+                    lambda: any(
+                        c.remaining == 3 for c in lim1.get_counters("ns")
+                    )
+                ), "late joiner never re-synced"
+            finally:
+                n1.close()
+        finally:
+            n0.close()
