@@ -1,15 +1,27 @@
 """Benchmark: ShouldRateLimit decisions/sec on the device counter table.
 
-Reproduces BASELINE.md config 4 — 1M hot keys, Zipf-0.99, 32k-request
-micro-batches, per-key fixed-window limits — against the north-star target
-of 10M decisions/sec (BASELINE.json). Prints ONE JSON line:
+Default run reproduces BASELINE.md config 4 — 1M hot keys, Zipf-0.99,
+32k-request micro-batches, per-key fixed-window limits — against the
+north-star target of 10M decisions/sec (BASELINE.json) and prints ONE JSON
+line:
 
     {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
 vs_baseline is value / 10M (the target the driver tracks). Human-readable
 details (latency percentiles, config) go to stderr.
+
+The other BASELINE configs run with --config:
+    --config memory     in-memory oracle, 1k keys (CPU baseline, config 1)
+    --config pipeline   full compiled pipeline: descriptor replay, 100k
+                        keys, 1 limit/namespace (config 2)
+    --config tenants    10k namespaces x 100 keys, mixed windows (config 3)
+    --config device     1M keys Zipf-0.99, 32k micro-batches (config 4,
+                        the default headline)
+    --config sharded    keys sharded over all devices, psum global region
+                        (config 5; multi-chip on a virtual mesh off-TPU)
 """
 
+import argparse
 import json
 import sys
 import time
@@ -25,13 +37,189 @@ def zipf_keys(n_keys: int, n_samples: int, s: float, rng) -> np.ndarray:
     return np.searchsorted(cdf, u).astype(np.int32)
 
 
+def emit(metric: str, value: float, unit: str, baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 1),
+                "unit": unit,
+                "vs_baseline": round(value / baseline, 4),
+            }
+        )
+    )
+
+
+def bench_memory():
+    """Config 1: single-namespace fixed-window, 1k keys, in-memory oracle."""
+    from limitador_tpu import Context, Limit, RateLimiter
+
+    limiter = RateLimiter()
+    limiter.add_limit(Limit("ns", 10**9, 60, [], ["u"]))
+    users = [str(i) for i in range(1000)]
+    ctxs = [Context({"u": u}) for u in users]
+    n = 50_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        limiter.check_rate_limited_and_update("ns", ctxs[i % 1000], 1)
+    dt = time.perf_counter() - t0
+    print(f"memory oracle: {n/dt/1e3:.1f}k decisions/s", file=sys.stderr)
+    emit("inmemory_decisions_per_sec", n / dt, "decisions/s", 1e7)
+
+
+def bench_pipeline():
+    """Config 2: full compiled pipeline — descriptor replay, 100k keys."""
+    import asyncio
+
+    from limitador_tpu import Limit
+    from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+    from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+    async def run():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(
+                TpuStorage(capacity=1 << 17),
+                max_delay=0.002,
+                max_batch_hits=16384,
+            )
+        )
+        limiter.max_batch = 16384
+        limiter.add_limit(
+            Limit("api", 10**6, 60,
+                  ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
+        )
+        rng = np.random.default_rng(0)
+        users = [str(int(x)) for x in rng.integers(0, 100_000, 200_000)]
+        # warmup (compiles the kernel buckets)
+        await asyncio.gather(*[
+            limiter.check_rate_limited_and_update(
+                "api", {"m": "GET", "u": users[i]}, 1)
+            for i in range(4096)
+        ])
+        n = 100_000
+        t0 = time.perf_counter()
+        for ofs in range(0, n, 8192):
+            await asyncio.gather(*[
+                limiter.check_rate_limited_and_update(
+                    "api", {"m": "GET", "u": users[ofs + i]}, 1)
+                for i in range(8192)
+            ])
+        dt = time.perf_counter() - t0
+        await limiter.storage.counters.close()
+        return n / dt
+
+    rate = asyncio.new_event_loop().run_until_complete(run())
+    print(f"compiled pipeline: {rate/1e3:.1f}k decisions/s "
+          "(python host path end-to-end)", file=sys.stderr)
+    emit("pipeline_decisions_per_sec", rate, "decisions/s", 1e7)
+
+
+def bench_tenants(device_step):
+    """Config 3: 10k namespaces x 100 keys, mixed windows, on device."""
+    rng = np.random.default_rng(7)
+    n_keys = 10_000 * 100
+    batch = 1 << 15
+    n_batches = 32
+    keys = rng.integers(0, n_keys, (n_batches, batch)).astype(np.int32)
+    windows = (
+        rng.choice([1_000, 60_000, 3_600_000], batch).astype(np.int32)
+    )
+    rate = device_step(n_keys, keys, windows=windows)
+    print(f"multi-tenant device: {rate/1e6:.2f}M decisions/s", file=sys.stderr)
+    emit("tenants_decisions_per_sec", rate, "decisions/s", 1e7)
+
+
+def bench_sharded():
+    """Config 5: keys sharded across all local devices with a psum global
+    region (virtual mesh off-TPU; on a real pod this rides ICI)."""
+    import jax
+
+    from limitador_tpu.parallel import (
+        make_mesh, make_sharded_table, sharded_check_and_update,
+    )
+
+    n = len(jax.devices())
+    mesh = make_mesh()
+    local_cap = 1 << 17
+    state = make_sharded_table(mesh, local_cap)
+    rng = np.random.default_rng(3)
+    H = 1 << 12
+    batches = 16
+    slots = rng.integers(1024, local_cap, (batches, n, H)).astype(np.int32)
+    deltas = np.ones((n, H), np.int32)
+    maxes = np.full((n, H), 1000, np.int32)
+    windows = np.full((n, H), 60_000, np.int32)
+    req = np.arange(n * H, dtype=np.int32).reshape(n, H)
+    fresh = np.zeros((n, H), bool)
+    is_global = np.zeros((n, H), bool)
+    is_global[:, 0] = True
+    slots_g = slots.copy()
+    slots_g[:, :, 0] = 7
+    state, res = sharded_check_and_update(
+        mesh, state, slots_g[0], deltas, maxes, windows, req, fresh,
+        is_global, np.int32(500),
+    )
+    jax.block_until_ready(res.admitted)
+    t0 = time.perf_counter()
+    for i in range(batches):
+        state, res = sharded_check_and_update(
+            mesh, state, slots_g[i], deltas, maxes, windows, req, fresh,
+            is_global, np.int32(1000 + i),
+        )
+    jax.block_until_ready(res.admitted)
+    dt = time.perf_counter() - t0
+    rate = batches * n * H / dt
+    print(
+        f"sharded over {n} devices: {rate/1e6:.2f}M decisions/s",
+        file=sys.stderr,
+    )
+    emit("sharded_decisions_per_sec", rate, "decisions/s", 1e7)
+
+
 def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--config",
+        default="device",
+        choices=["device", "memory", "pipeline", "tenants", "sharded"],
+    )
+    args = parser.parse_args()
+
+    if args.config == "memory":
+        return bench_memory()
+    if args.config == "pipeline":
+        return bench_pipeline()
+    if args.config == "sharded":
+        return bench_sharded()
+
     import jax
 
     from limitador_tpu.ops.kernel import (
         check_and_update_batch,
         make_table,
     )
+
+    if args.config == "tenants":
+        def device_step(n_keys, keys_batches, windows):
+            state = make_table(n_keys)
+            batch = keys_batches.shape[1]
+            deltas = np.ones(batch, np.int32)
+            maxes = np.full(batch, 1000, np.int32)
+            req_ids = np.arange(batch, dtype=np.int32)
+            fresh = np.zeros(batch, bool)
+            state, result = check_and_update_batch(
+                state, keys_batches[0], deltas, maxes, windows, req_ids,
+                fresh, np.int32(500))
+            jax.block_until_ready(result.admitted)
+            t0 = time.perf_counter()
+            for i, keys in enumerate(keys_batches):
+                state, result = check_and_update_batch(
+                    state, keys, deltas, maxes, windows, req_ids, fresh,
+                    np.int32(1000 + i))
+            jax.block_until_ready(result.admitted)
+            return keys_batches.shape[0] * batch / (time.perf_counter() - t0)
+
+        return bench_tenants(device_step)
 
     n_keys = 1 << 20          # 1M distinct counters
     batch = 1 << 15           # 32768 requests per micro-batch
@@ -97,15 +285,11 @@ def main():
         file=sys.stderr,
     )
 
-    print(
-        json.dumps(
-            {
-                "metric": "should_rate_limit_decisions_per_sec",
-                "value": round(decisions_per_sec, 1),
-                "unit": "decisions/s",
-                "vs_baseline": round(decisions_per_sec / 1e7, 4),
-            }
-        )
+    emit(
+        "should_rate_limit_decisions_per_sec",
+        decisions_per_sec,
+        "decisions/s",
+        1e7,
     )
 
 

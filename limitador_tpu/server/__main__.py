@@ -95,6 +95,13 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(_env("TPU_BATCH_DELAY_US", "500")),
         help="micro-batcher linger in microseconds (tpu)",
     )
+    p.add_argument(
+        "--pipeline",
+        choices=["standard", "compiled"],
+        default=_env("TPU_PIPELINE", "standard"),
+        help="tpu request path: per-request CEL (standard) or batch-"
+        "compiled vectorized masks (compiled)",
+    )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
         "--peer", action="append", default=None,
@@ -123,9 +130,14 @@ def build_limiter(args):
         storage = TpuStorage(
             capacity=args.tpu_capacity, cache_size=args.cache_size
         )
-        return AsyncRateLimiter(
-            AsyncTpuStorage(storage, max_delay=args.batch_delay_us / 1e6)
+        async_storage = AsyncTpuStorage(
+            storage, max_delay=args.batch_delay_us / 1e6
         )
+        if args.pipeline == "compiled":
+            from ..tpu.pipeline import CompiledTpuLimiter
+
+            return CompiledTpuLimiter(async_storage)
+        return AsyncRateLimiter(async_storage)
     if args.storage == "disk":
         try:
             from ..storage.disk import DiskStorage

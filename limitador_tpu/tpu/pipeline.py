@@ -1,0 +1,231 @@
+"""Compiled TPU pipeline: descriptor batches -> masks -> slots -> kernel.
+
+The fully TPU-native request path (SURVEY.md §7.3): instead of interpreting
+CEL per request before storage (lib.rs:507-522), raw requests
+(namespace, descriptor map, delta) queue into the micro-batcher; at flush
+the whole batch evaluates through the vectorized limit compiler
+(tpu/compiler.py) — one columnar pass per namespace — and the resulting
+counters go through the same exact device kernel as the per-request path.
+
+``CompiledTpuLimiter`` is a drop-in ``AsyncRateLimiter``: same public API,
+same semantics (the compiler is equivalence-tested against the CEL
+interpreter), same storage. Namespace compilers rebuild lazily whenever
+that namespace's limits change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple, Union
+
+from ..core.cel import Context
+from ..core.counter import Counter
+from ..core.limiter import AsyncRateLimiter, CheckResult
+from ..core.limit import Limit, Namespace
+from ..storage.base import Authorization
+from .batcher import AsyncTpuStorage
+from .compiler import NamespaceCompiler
+
+__all__ = ["CompiledTpuLimiter"]
+
+
+class _RawPending:
+    __slots__ = ("namespace", "values", "delta", "load", "future")
+
+    def __init__(self, namespace, values, delta, load, future):
+        self.namespace = namespace
+        self.values = values
+        self.delta = delta
+        self.load = load
+        self.future = future
+
+
+def _values_of(
+    ctx_or_values: Union[Context, Dict[str, str]]
+) -> Optional[Dict[str, str]]:
+    """Descriptor map when the context has exactly the single-descriptor
+    shape the compiler handles; None routes the request to the exact
+    per-request path (multi-descriptor requests, root-bound library
+    contexts, ...)."""
+    if isinstance(ctx_or_values, dict):
+        return ctx_or_values
+    bindings = ctx_or_values._bindings
+    descriptors = bindings.get("descriptors")
+    if (
+        descriptors is not None
+        and len(descriptors) == 1
+        and len(bindings) == 1
+    ):
+        return descriptors[0]
+    return None
+
+
+class CompiledTpuLimiter(AsyncRateLimiter):
+    """AsyncRateLimiter whose hot path batch-compiles limit evaluation.
+
+    Restriction (checked at evaluation): compiled evaluation binds the
+    request's descriptor map as ``descriptors[0]`` — the same shape the
+    RLS/HTTP serving plane uses. Exotic contexts still work through the
+    inherited per-request path.
+    """
+
+    def __init__(self, storage: Optional[AsyncTpuStorage] = None, **kwargs):
+        super().__init__(storage or AsyncTpuStorage(**kwargs))
+        self._tpu: AsyncTpuStorage = self.storage.counters
+        self._compilers: Dict[Namespace, NamespaceCompiler] = {}
+        self._rev: Dict[Namespace, List[str]] = {}
+        self._pending: List[_RawPending] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        self.max_delay = self._tpu.batcher.max_delay
+        self.max_batch = 4096
+
+    # -- compiler cache invalidation ----------------------------------------
+
+    def _invalidate(self, namespace: Namespace) -> None:
+        self._compilers.pop(namespace, None)
+
+    def add_limit(self, limit: Limit) -> bool:
+        self._invalidate(limit.namespace)
+        return super().add_limit(limit)
+
+    def update_limit(self, limit: Limit) -> bool:
+        self._invalidate(limit.namespace)
+        return super().update_limit(limit)
+
+    async def delete_limit(self, limit: Limit) -> None:
+        self._invalidate(limit.namespace)
+        await super().delete_limit(limit)
+
+    async def delete_limits(self, namespace) -> None:
+        self._invalidate(Namespace.of(namespace))
+        await super().delete_limits(namespace)
+
+    async def configure_with(self, limits) -> None:
+        self._compilers.clear()
+        await super().configure_with(limits)
+
+    def _compiler_for(self, namespace: Namespace) -> NamespaceCompiler:
+        compiler = self._compilers.get(namespace)
+        if compiler is None:
+            compiler = NamespaceCompiler(self.get_limits(namespace))
+            self._compilers[namespace] = compiler
+        return compiler
+
+    # -- the batched hot path -------------------------------------------------
+
+    async def check_rate_limited_and_update(
+        self,
+        namespace,
+        ctx: Union[Context, Dict[str, str]],
+        delta: int,
+        load_counters: bool = False,
+    ) -> CheckResult:
+        namespace = Namespace.of(namespace)
+        values = _values_of(ctx)
+        if values is None:
+            # Context shape the compiler doesn't cover: exact inherited path.
+            return await super().check_rate_limited_and_update(
+                namespace, ctx, delta, load_counters
+            )
+        future = asyncio.get_running_loop().create_future()
+        self._pending.append(
+            _RawPending(namespace, values, delta, load_counters, future)
+        )
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon()
+            )
+        if len(self._pending) >= self.max_batch:
+            await self._flush()
+        return await future
+
+    async def _flush_soon(self) -> None:
+        await asyncio.sleep(self.max_delay)
+        await self._flush()
+        # Requests that arrived while the flush was busy on the device must
+        # not wait for the NEXT submission to schedule a timer — re-arm
+        # unconditionally (this coroutine IS the current _flush_task, so a
+        # done() check here would always see itself as running).
+        if self._pending:
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon()
+            )
+
+    async def _flush(self) -> None:
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        try:
+            requests = self._evaluate_batch(batch)
+        except Exception as exc:
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+
+        await self._decide(requests)
+
+    def _evaluate_batch(
+        self, batch: List[_RawPending]
+    ) -> List[Tuple[_RawPending, List[Counter]]]:
+        # Group by namespace; one columnar evaluation each.
+        by_ns: Dict[Namespace, List[int]] = {}
+        for i, p in enumerate(batch):
+            by_ns.setdefault(p.namespace, []).append(i)
+
+        requests: List[Tuple[_RawPending, List[Counter]]] = []
+        src_cache: Dict[Limit, List[str]] = {}
+        for namespace, idxs in by_ns.items():
+            compiler = self._compiler_for(namespace)
+            evaluated = compiler.evaluate([batch[i].values for i in idxs])
+            strings = compiler.interner.strings
+            for i, hits in zip(idxs, evaluated):
+                counters = []
+                for limit, tokens in hits:
+                    var_sources = src_cache.get(limit)
+                    if var_sources is None:
+                        # limit.variables is already source-sorted
+                        var_sources = [v.source for v in limit.variables]
+                        src_cache[limit] = var_sources
+                    set_vars = {
+                        src: strings[tok]
+                        for src, tok in zip(var_sources, tokens)
+                    }
+                    counters.append(Counter(limit, set_vars))
+                requests.append((batch[i], counters))
+        return requests
+
+    async def _decide(
+        self, requests: List[Tuple[_RawPending, List[Counter]]]
+    ) -> None:
+        # The whole evaluated batch is already in hand: go straight to the
+        # storage's batched kernel path (no second trip through the
+        # micro-batcher). The blocking device call runs in a worker thread
+        # so concurrent submissions keep accumulating for the next flush.
+        from .storage import _Request
+
+        live: List[Tuple[_RawPending, List[Counter]]] = []
+        for p, counters in requests:
+            if not counters:
+                if not p.future.done():
+                    p.future.set_result(CheckResult(False, [], None))
+            else:
+                live.append((p, counters))
+        if not live:
+            return
+        reqs = [_Request(c, p.delta, p.load) for p, c in live]
+        loop = asyncio.get_running_loop()
+        try:
+            auths = await loop.run_in_executor(
+                None, self._tpu.inner.check_many, reqs
+            )
+        except Exception as exc:
+            for p, _c in live:
+                if not p.future.done():
+                    p.future.set_exception(exc)
+            return
+        for (p, counters), auth in zip(live, auths):
+            loaded = counters if p.load else []
+            result = CheckResult(auth.limited, loaded, auth.limit_name)
+            if not p.future.done():
+                p.future.set_result(result)

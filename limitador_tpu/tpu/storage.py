@@ -177,44 +177,57 @@ class TpuStorage(CounterStorage):
         qualified counters past its first limited hit, in_memory.rs:110-133
         — only safe to undo when no other request in the batch shares the
         freshly-allocated slot)."""
+        import jax
+
         nhits = sum(len(r.ordered) for r in requests)
         H = _bucket(max(nhits, 1))
-        slots = np.full(H, self._scratch, np.int32)
-        deltas = np.zeros(H, np.int32)
-        maxes = np.full(H, _INT32_MAX, np.int32)
-        windows = np.zeros(H, np.int32)
-        req = np.full(H, H - 1, np.int32)
-        fresh = np.zeros(H, bool)
+        # Build as Python lists (then one vectorized pad+convert): per-element
+        # numpy scalar stores dominate the host loop otherwise.
+        slots_l: List[int] = []
+        deltas_l: List[int] = []
+        maxes_l: List[int] = []
+        windows_l: List[int] = []
+        req_l: List[int] = []
+        fresh_l: List[bool] = []
 
         with self._lock:
             now_ms = self._now_ms()
             fresh_hits_by_req: List[List[Tuple[int, Counter, int]]] = []
             slot_use_count: Dict[int, int] = {}
-            i = 0
+            slot_for = self._slot_for
             for r, request in enumerate(requests):
                 fresh_hits: List[Tuple[int, Counter, int]] = []
                 delta = min(int(request.delta), K.MAX_DELTA_CAP)
                 for j, c in enumerate(request.ordered):
-                    slot, is_fresh = self._slot_for(c, create=True)
-                    slots[i] = slot
-                    deltas[i] = delta
-                    maxes[i] = min(c.max_value, K.MAX_VALUE_CAP)
-                    windows[i] = _clamp_window_ms(c.window_seconds)
-                    req[i] = r
-                    fresh[i] = is_fresh
+                    slot, is_fresh = slot_for(c, create=True)
+                    slots_l.append(slot)
+                    deltas_l.append(delta)
+                    maxes_l.append(min(c.max_value, K.MAX_VALUE_CAP))
+                    windows_l.append(_clamp_window_ms(c.window_seconds))
+                    req_l.append(r)
+                    fresh_l.append(is_fresh)
                     slot_use_count[slot] = slot_use_count.get(slot, 0) + 1
                     if is_fresh:
                         fresh_hits.append((j, c, slot))
-                    i += 1
                 fresh_hits_by_req.append(fresh_hits)
+
+            pad = H - nhits
+            slots = np.asarray(
+                slots_l + [self._scratch] * pad, np.int32)
+            deltas = np.asarray(deltas_l + [0] * pad, np.int32)
+            maxes = np.asarray(maxes_l + [int(_INT32_MAX)] * pad, np.int32)
+            windows = np.asarray(windows_l + [0] * pad, np.int32)
+            req = np.asarray(req_l + [H - 1] * pad, np.int32)
+            fresh = np.asarray(fresh_l + [False] * pad, bool)
 
             self._state, result = K.check_and_update_batch(
                 self._state, slots, deltas, maxes, windows, req, fresh,
                 np.int32(now_ms),
             )
-            hit_ok = np.asarray(result.hit_ok)
-            remaining = np.asarray(result.remaining)
-            ttl_ms = np.asarray(result.ttl_ms)
+            # One transfer for all three outputs (matters over remote links).
+            hit_ok, remaining, ttl_ms = jax.device_get(
+                (result.hit_ok, result.remaining, result.ttl_ms)
+            )
 
             auths: List[Authorization] = []
             base = 0

@@ -1,0 +1,146 @@
+"""Vectorized limit compiler tests: equivalence with the CEL interpreter.
+
+The compiler must never change semantics — only speed. Every compiled form
+is checked against `Limit.applies` + `resolve_variables` over randomized
+batches; unsupported forms must be classified as fallback (and still
+produce identical results through the interpreter path).
+"""
+
+import random
+
+from limitador_tpu import Context, Limit
+from limitador_tpu.tpu.compiler import NamespaceCompiler
+
+
+def interpreter_counters(limits, values):
+    ctx = Context()
+    ctx.list_binding("descriptors", [values])
+    out = []
+    for limit in sorted(limits):
+        if limit.applies(ctx):
+            resolved = limit.resolve_variables(ctx)
+            if resolved is not None:
+                out.append((limit, tuple(v for _k, v in sorted(resolved.items()))))
+    return out
+
+
+def assert_equivalent(limits, batch):
+    compiler = NamespaceCompiler(limits)
+    got = compiler.evaluate(batch)
+    # Map token ids back to strings for comparison.
+    rev = {v: k for k, v in compiler.interner._ids.items()}
+    for r, values in enumerate(batch):
+        want = interpreter_counters(limits, values)
+        got_r = [
+            (limit, tuple(rev[t] for t in tokens)) for limit, tokens in got[r]
+        ]
+        assert sorted(got_r, key=lambda x: x[0]._identity) == sorted(
+            want, key=lambda x: x[0]._identity
+        ), f"request {r}: {values}"
+
+
+D = "descriptors[0]"
+
+
+class TestCompiledForms:
+    def test_equality_and_variables(self):
+        limits = [
+            Limit("ns", 5, 60, [f"{D}.method == 'GET'"], [f"{D}.user"]),
+            Limit("ns", 9, 30, [f"{D}['method'] != 'GET'"], []),
+        ]
+        batch = [
+            {"method": "GET", "user": "a"},
+            {"method": "POST", "user": "b"},
+            {"user": "c"},               # method missing: both conds False
+            {"method": "GET"},           # var missing: no counter
+            {},
+        ]
+        compiler = NamespaceCompiler(limits)
+        assert compiler.stats() == {"limits": 2, "vectorized": 2, "fallback": 0}
+        assert_equivalent(limits, batch)
+
+    def test_membership_and_logic(self):
+        limits = [
+            Limit("ns", 5, 60, [f"{D}.m in ['GET', 'HEAD']"], []),
+            Limit("ns", 5, 120, [f"{D}.m == 'GET' && {D}.env == 'prod'"], []),
+            Limit("ns", 5, 180, [f"{D}.m == 'PUT' || {D}.env == 'dev'"], []),
+            Limit("ns", 5, 240, [f"!({D}.m == 'GET')"], []),
+        ]
+        batch = [
+            {"m": "GET", "env": "prod"},
+            {"m": "HEAD", "env": "dev"},
+            {"m": "PUT"},
+            {"env": "dev"},
+            {"m": "DELETE", "env": "staging"},
+            {},
+        ]
+        compiler = NamespaceCompiler(limits)
+        assert compiler.stats()["vectorized"] == 4
+        assert_equivalent(limits, batch)
+
+    def test_not_with_missing_key_is_false(self):
+        # CEL: NoSuchKey -> whole predicate False, so !(k == 'v') with k
+        # absent must be False, not True.
+        limits = [Limit("ns", 5, 60, [f"!({D}.k == 'v')"], [])]
+        assert_equivalent(limits, [{"k": "v"}, {"k": "x"}, {}])
+
+    def test_unseen_value_at_eval_time(self):
+        limits = [Limit("ns", 5, 60, [f"{D}.k == 'rare'"], [])]
+        # 'zzz' was never interned at compile time; must simply not match.
+        assert_equivalent(limits, [{"k": "zzz"}, {"k": "rare"}])
+
+
+class TestFallbackForms:
+    def test_regex_falls_back_but_stays_exact(self):
+        limits = [
+            Limit("ns", 5, 60, [f"{D}.path.matches('^/api/')"], [f"{D}.user"]),
+            Limit("ns", 7, 60, [f"{D}.m == 'GET'"], []),  # this one vectorizes
+        ]
+        compiler = NamespaceCompiler(limits)
+        assert compiler.stats()["fallback"] == 1
+        assert compiler.stats()["vectorized"] == 1
+        batch = [
+            {"path": "/api/x", "user": "a", "m": "GET"},
+            {"path": "/web", "user": "b"},
+            {"m": "GET"},
+        ]
+        assert_equivalent(limits, batch)
+
+    def test_unconditional_limit_vectorizes(self):
+        limits = [Limit("ns", 5, 60, [], [f"{D}.user"])]
+        compiler = NamespaceCompiler(limits)
+        assert compiler.stats()["vectorized"] == 1
+        assert_equivalent(limits, [{"user": "a"}, {}])
+
+
+class TestRandomized:
+    def test_fuzz_equivalence(self):
+        rng = random.Random(7)
+        keys = ["m", "env", "user", "tier"]
+        vals = ["a", "b", "c", "GET", "POST", "prod"]
+        conds = [
+            f"{D}.m == 'GET'",
+            f"{D}.env != 'prod'",
+            f"{D}.tier in ['a', 'b']",
+            f"{D}.m == 'POST' && {D}.env == 'prod'",
+            f"!({D}.tier == 'c')",
+            f"{D}.m == 'GET' || {D}.tier == 'b'",
+        ]
+        limits = [
+            Limit(
+                "ns", rng.randint(1, 9), rng.choice([30, 60, 90, 61, 62, 63]),
+                rng.sample(conds, rng.randint(0, 2)),
+                [f"{D}.user"] if rng.random() < 0.5 else [],
+            )
+            for _ in range(8)
+        ]
+        # dedupe by identity (set semantics of the registry)
+        limits = list({l: l for l in limits}.values())
+        batch = [
+            {
+                k: rng.choice(vals)
+                for k in rng.sample(keys, rng.randint(0, len(keys)))
+            }
+            for _ in range(200)
+        ]
+        assert_equivalent(limits, batch)

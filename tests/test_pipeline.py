@@ -1,0 +1,178 @@
+"""Compiled-pipeline limiter: equivalence with the standard path."""
+
+import asyncio
+
+import pytest
+
+from limitador_tpu import Context, Limit
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+D = "descriptors[0]"
+
+
+def test_compiled_pipeline_end_to_end():
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        limiter.add_limit(
+            Limit("api", 3, 60, [f"{D}.m == 'GET'"], [f"{D}.u"], name="q")
+        )
+        outs = []
+        for i in range(4):
+            r = await limiter.check_rate_limited_and_update(
+                "api", {"m": "GET", "u": "alice"}, 1, load_counters=True
+            )
+            outs.append((r.limited, r.limit_name,
+                         [c.remaining for c in r.counters]))
+        # non-matching requests untouched
+        r2 = await limiter.check_rate_limited_and_update(
+            "api", {"m": "POST", "u": "alice"}, 1
+        )
+        # headers still work through CheckResult
+        r3 = await limiter.check_rate_limited_and_update(
+            "api", {"m": "GET", "u": "bob"}, 1, load_counters=True
+        )
+        headers = r3.response_header()
+        await limiter.storage.counters.close()
+        return outs, r2.limited, headers
+
+    outs, post_limited, headers = run(main())
+    assert outs[0] == (False, None, [2])
+    assert outs[1] == (False, None, [1])
+    assert outs[2] == (False, None, [0])
+    assert outs[3] == (True, "q", [0])
+    assert post_limited is False
+    assert headers["X-RateLimit-Remaining"] == "2"
+
+
+def test_compiled_pipeline_concurrent_exactness():
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.002)
+        )
+        limiter.add_limit(Limit("api", 50, 60, [], [f"{D}.u"]))
+
+        async def one(i):
+            r = await limiter.check_rate_limited_and_update(
+                "api", {"u": "shared"}, 1
+            )
+            return not r.limited
+
+        results = await asyncio.gather(*[one(i) for i in range(120)])
+        await limiter.storage.counters.close()
+        return sum(results)
+
+    assert run(main()) == 50
+
+
+def test_compiler_cache_invalidation_on_reconfigure():
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        limiter.add_limit(Limit("api", 1, 60, [], [f"{D}.u"]))
+        r1 = await limiter.check_rate_limited_and_update("api", {"u": "x"}, 1)
+        r2 = await limiter.check_rate_limited_and_update("api", {"u": "x"}, 1)
+        # raise the limit live; compiled plan must rebuild
+        await limiter.configure_with([Limit("api", 100, 60, [], [f"{D}.u"])])
+        r3 = await limiter.check_rate_limited_and_update("api", {"u": "x"}, 1)
+        await limiter.storage.counters.close()
+        return r1.limited, r2.limited, r3.limited
+
+    assert run(main()) == (False, True, False)
+
+
+def test_fallback_limits_still_work_through_pipeline():
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        limiter.add_limit(
+            Limit("api", 2, 60, [f"{D}.path.matches('^/v1/')"], [f"{D}.u"])
+        )
+        a = await limiter.check_rate_limited_and_update(
+            "api", {"path": "/v1/x", "u": "a"}, 1
+        )
+        b = await limiter.check_rate_limited_and_update(
+            "api", {"path": "/web", "u": "a"}, 1
+        )
+        c = await limiter.check_rate_limited_and_update(
+            "api", {"path": "/v1/y", "u": "a"}, 2
+        )
+        await limiter.storage.counters.close()
+        return a.limited, b.limited, c.limited
+
+    assert run(main()) == (False, False, True)
+
+
+def test_sporadic_request_during_inflight_flush_is_not_lost():
+    """Regression: a request enqueued while a flush awaits the device must
+    be flushed by a re-armed timer, not wait for the next submission."""
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        limiter.add_limit(Limit("api", 10, 60, [], [f"{D}.u"]))
+        r1 = limiter.check_rate_limited_and_update("api", {"u": "a"}, 1)
+        t1 = asyncio.ensure_future(r1)
+        await asyncio.sleep(0.0015)  # first flush likely in-flight
+        r2 = await asyncio.wait_for(
+            limiter.check_rate_limited_and_update("api", {"u": "b"}, 1),
+            timeout=10,
+        )
+        out1 = await asyncio.wait_for(t1, timeout=10)
+        await limiter.storage.counters.close()
+        return out1.limited, r2.limited
+
+    assert run(main()) == (False, False)
+
+
+def test_multi_descriptor_context_routes_to_exact_path():
+    """Contexts beyond the single-descriptor shape use the inherited
+    per-request path (no silent fail-open)."""
+    from limitador_tpu import Context
+
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        limiter.add_limit(
+            Limit("api", 1, 60, ["descriptors[1].k == 'v'"], [])
+        )
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}, {"k": "v"}])
+        r1 = await limiter.check_rate_limited_and_update("api", ctx, 1)
+        r2 = await limiter.check_rate_limited_and_update("api", ctx, 1)
+        await limiter.storage.counters.close()
+        return r1.limited, r2.limited
+
+    assert run(main()) == (False, True)
+
+
+def test_interner_reset_keeps_semantics():
+    from limitador_tpu.tpu.compiler import NamespaceCompiler
+
+    limits = [Limit("ns", 5, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])]
+    compiler = NamespaceCompiler(limits)
+    compiler.MAX_INTERNED = 4  # force resets between batches
+    for round_i in range(3):
+        batch = [
+            {"m": "GET", "u": f"user-{round_i}-{j}"} for j in range(10)
+        ]
+        out = compiler.evaluate(batch)
+        strings = compiler.interner.strings
+        for j, hits in enumerate(out):
+            assert len(hits) == 1
+            _limit, tokens = hits[0]
+            assert strings[tokens[0]] == f"user-{round_i}-{j}"
