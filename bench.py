@@ -114,6 +114,66 @@ def bench_pipeline():
     emit("pipeline_decisions_per_sec", rate, "decisions/s", 1e7)
 
 
+def bench_native():
+    """Native columnar serving path: raw RLS blobs -> C++ parse ->
+    compiled masks -> native slot map -> device kernel -> response blobs.
+    The full end-to-end host+device path, no Python per-request objects."""
+    import asyncio
+
+    from limitador_tpu import Limit, native
+    from limitador_tpu.server.proto import rls_pb2
+    from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+    from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+    if not native.available():
+        print("native unavailable:", native.build_error(), file=sys.stderr)
+        emit("native_pipeline_decisions_per_sec", 0.0, "decisions/s", 1e7)
+        return
+
+    rng = np.random.default_rng(0)
+    blobs = []
+    for i in range(1 << 15):
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d = req.descriptors.add()
+        e = d.entries.add(); e.key = "m"; e.value = "GET"
+        e = d.entries.add(); e.key = "u"
+        e.value = f"user-{int(rng.integers(0, 100_000))}"
+        blobs.append(req.SerializeToString())
+
+    async def run():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 17), max_delay=0.001)
+        )
+        limiter.add_limit(
+            Limit("api", 10**6, 60,
+                  ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
+        )
+        pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001)
+        # warm (compiles kernel buckets, allocates slots)
+        await asyncio.gather(*[pipeline.submit(b) for b in blobs[:4096]])
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(4):
+            for ofs in range(0, len(blobs), 8192):
+                await asyncio.gather(
+                    *[pipeline.submit(b) for b in blobs[ofs:ofs + 8192]]
+                )
+                n += 8192
+        dt = time.perf_counter() - t0
+        await pipeline.close()
+        await limiter.storage.counters.close()
+        return n / dt
+
+    rate = asyncio.new_event_loop().run_until_complete(run())
+    print(
+        f"native pipeline: {rate/1e3:.1f}k decisions/s end-to-end "
+        "(raw blobs -> response blobs)",
+        file=sys.stderr,
+    )
+    emit("native_pipeline_decisions_per_sec", rate, "decisions/s", 1e7)
+
+
 def bench_tenants(device_step):
     """Config 3: 10k namespaces x 100 keys, mixed windows, on device."""
     rng = np.random.default_rng(7)
@@ -181,7 +241,8 @@ def main():
     parser.add_argument(
         "--config",
         default="device",
-        choices=["device", "memory", "pipeline", "tenants", "sharded"],
+        choices=["device", "memory", "pipeline", "native", "tenants",
+                 "sharded"],
     )
     args = parser.parse_args()
 
@@ -189,6 +250,8 @@ def main():
         return bench_memory()
     if args.config == "pipeline":
         return bench_pipeline()
+    if args.config == "native":
+        return bench_native()
     if args.config == "sharded":
         return bench_sharded()
 

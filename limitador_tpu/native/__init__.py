@@ -1,0 +1,250 @@
+"""ctypes binding for the native host path (native/hostpath.cc).
+
+Builds the shared library with g++ on first use (cached in native/build/);
+``available()`` gates every consumer — all native users keep an exact
+pure-Python fallback, so a missing toolchain only costs speed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["available", "HostPath"]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_ROOT, "native", "hostpath.cc")
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libhostpath.so")
+
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        proc = subprocess.run(
+            cmd, capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return f"g++ invocation failed: {exc}"
+    if proc.returncode != 0:
+        return f"g++ failed: {proc.stderr[-2000:]}"
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            _build_error = _build()
+            if _build_error is not None:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as exc:
+            _build_error = str(exc)
+            return None
+        lib.hp_new.restype = ctypes.c_void_p
+        lib.hp_free.argtypes = [ctypes.c_void_p]
+        lib.hp_track_key.restype = ctypes.c_int32
+        lib.hp_track_key.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.hp_intern.restype = ctypes.c_int32
+        lib.hp_intern.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.hp_find.restype = ctypes.c_int32
+        lib.hp_find.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32]
+        lib.hp_string.restype = ctypes.c_int32
+        lib.hp_string.argtypes = [
+            ctypes.c_void_p, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_char_p),
+        ]
+        lib.hp_interned_count.restype = ctypes.c_int64
+        lib.hp_interned_count.argtypes = [ctypes.c_void_p]
+        lib.hp_parse_batch.restype = ctypes.c_int32
+        lib.hp_parse_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            np.ctypeslib.ndpointer(np.int32), ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+            np.ctypeslib.ndpointer(np.int32),
+        ]
+        lib.hp_slots_lookup.argtypes = [
+            ctypes.c_void_p, np.ctypeslib.ndpointer(np.int32),
+            ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int64),
+        ]
+        lib.hp_slots_insert.argtypes = [
+            ctypes.c_void_p, np.ctypeslib.ndpointer(np.int32),
+            ctypes.c_int32, ctypes.c_int64,
+        ]
+        lib.hp_slots_remove.argtypes = [
+            ctypes.c_void_p, np.ctypeslib.ndpointer(np.int32), ctypes.c_int32,
+        ]
+        lib.hp_slots_count.restype = ctypes.c_int64
+        lib.hp_slots_count.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class HostPath:
+    """One native context: interner + tracked keys + slot map."""
+
+    def __init__(self, tracked_keys: Sequence[str] = ()):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native hostpath unavailable: {_build_error}")
+        self._lib = lib
+        self._ctx = ctypes.c_void_p(lib.hp_new())
+        self.tracked: List[str] = []
+        for key in tracked_keys:
+            self.track(key)
+
+    def close(self) -> None:
+        if self._ctx:
+            self._lib.hp_free(self._ctx)
+            self._ctx = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def track(self, key: str) -> int:
+        raw = key.encode()
+        idx = self._lib.hp_track_key(self._ctx, raw, len(raw))
+        self.tracked.append(key)
+        return idx
+
+    def intern(self, s: str) -> int:
+        raw = s.encode()
+        return self._lib.hp_intern(self._ctx, raw, len(raw))
+
+    def find(self, s: str) -> int:
+        raw = s.encode()
+        return self._lib.hp_find(self._ctx, raw, len(raw))
+
+    def string(self, token: int) -> str:
+        out = ctypes.c_char_p()
+        n = self._lib.hp_string(self._ctx, token, ctypes.byref(out))
+        if n < 0:
+            raise KeyError(token)
+        return ctypes.string_at(out, n).decode()
+
+    def interned_count(self) -> int:
+        return self._lib.hp_interned_count(self._ctx)
+
+    def parse_batch(
+        self, blobs: Sequence[bytes]
+    ) -> Tuple[np.ndarray, np.ndarray, Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+        """Parse serialized RateLimitRequest blobs into columns.
+
+        Returns (domain_tokens, hits, columns{key->tokens}, ndesc_entries,
+        extra_descriptors); -1 marks absent/failed."""
+        n = len(blobs)
+        sizes = np.asarray([len(b) for b in blobs], np.int32)
+        buf = b"".join(blobs)
+        domains = np.empty(n, np.int32)
+        hits = np.empty(n, np.int32)
+        cols = np.empty((max(len(self.tracked), 1), n), np.int32)
+        ndesc = np.empty(n, np.int32)
+        extra = np.empty(n, np.int32)
+        self._lib.hp_parse_batch(
+            self._ctx, buf, sizes, n, domains, hits, cols, ndesc, extra
+        )
+        columns = {
+            key: cols[i] for i, key in enumerate(self.tracked)
+        }
+        return domains, hits, columns, ndesc, extra
+
+    def as_interner(self) -> "NativeInterner":
+        return NativeInterner(self)
+
+    # -- slot map -----------------------------------------------------------
+
+    def slots_lookup(self, keys: np.ndarray) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, np.int32)
+        n, k = keys.shape
+        out = np.empty(n, np.int64)
+        self._lib.hp_slots_lookup(self._ctx, keys, n, k, out)
+        return out
+
+    def slots_insert(self, key: np.ndarray, slot: int) -> None:
+        key = np.ascontiguousarray(key, np.int32)
+        self._lib.hp_slots_insert(self._ctx, key, key.shape[0], slot)
+
+    def slots_remove(self, key: np.ndarray) -> None:
+        key = np.ascontiguousarray(key, np.int32)
+        self._lib.hp_slots_remove(self._ctx, key, key.shape[0])
+
+    def slots_count(self) -> int:
+        return self._lib.hp_slots_count(self._ctx)
+
+
+class _IdsView:
+    """dict-like `.get` over the native interner (compiled-constant lookup
+    interface the mask programs use)."""
+
+    __slots__ = ("hp",)
+
+    def __init__(self, hp: HostPath):
+        self.hp = hp
+
+    def get(self, s: str, default: int = -2) -> int:
+        out = self.hp.find(s)
+        return out if out != -2 else default
+
+
+class _StringsView:
+    __slots__ = ("hp",)
+
+    def __init__(self, hp: HostPath):
+        self.hp = hp
+
+    def __getitem__(self, token: int) -> str:
+        return self.hp.string(token)
+
+
+class NativeInterner:
+    """Drop-in for compiler.Interner backed by the C++ table, so compiled
+    constants and natively-parsed columns share one id space."""
+
+    __slots__ = ("hp", "_ids", "strings")
+
+    def __init__(self, hp: HostPath):
+        self.hp = hp
+        self._ids = _IdsView(hp)
+        self.strings = _StringsView(hp)
+
+    def intern(self, s: str) -> int:
+        return self.hp.intern(s)
+
+    def __len__(self) -> int:
+        return self.hp.interned_count()

@@ -97,10 +97,12 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--pipeline",
-        choices=["standard", "compiled"],
+        choices=["standard", "compiled", "native"],
         default=_env("TPU_PIPELINE", "standard"),
-        help="tpu request path: per-request CEL (standard) or batch-"
-        "compiled vectorized masks (compiled)",
+        help="tpu request path: per-request CEL (standard), batch-compiled "
+        "vectorized masks (compiled), or the C++ columnar host path for "
+        "ShouldRateLimit (native; falls back to compiled when the native "
+        "library is unavailable)",
     )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
@@ -133,7 +135,7 @@ def build_limiter(args):
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6
         )
-        if args.pipeline == "compiled":
+        if args.pipeline in ("compiled", "native"):
             from ..tpu.pipeline import CompiledTpuLimiter
 
             return CompiledTpuLimiter(async_storage)
@@ -168,12 +170,15 @@ async def _amain(args) -> int:
     limiter = build_limiter(args)
     metrics = PrometheusMetrics(use_limit_name_label=args.limit_name_in_labels)
     status = {"limits_file_version": 0, "limits_file_errors": 0}
+    pipelines_to_invalidate = []
 
     async def apply_limits(limits):
         if isinstance(limiter, AsyncRateLimiter):
             await limiter.configure_with(limits)
         else:
             limiter.configure_with(limits)
+        for pipeline in pipelines_to_invalidate:
+            pipeline.invalidate()
 
     watcher = None
     if args.limits_file:
@@ -196,11 +201,30 @@ async def _amain(args) -> int:
         status["limits_file_version"] = 1
         watcher.start()
 
+    native_pipeline = None
+    if args.storage == "tpu" and args.pipeline == "native":
+        from .. import native as native_mod
+
+        if native_mod.available():
+            from ..tpu.native_pipeline import NativeRlsPipeline
+
+            native_pipeline = NativeRlsPipeline(
+                limiter, metrics, max_delay=args.batch_delay_us / 1e6
+            )
+            pipelines_to_invalidate.append(native_pipeline)
+        else:
+            print(
+                f"native hostpath unavailable "
+                f"({native_mod.build_error()}); using compiled pipeline",
+                file=sys.stderr,
+            )
+
     rls_server = await serve_rls(
         limiter,
         f"{args.rls_host}:{args.rls_port}",
         metrics,
         args.rate_limit_headers,
+        native_pipeline=native_pipeline,
     )
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status

@@ -213,17 +213,50 @@ def make_rls_handlers(service: RlsService):
     return [envoy, kuadrant]
 
 
+def make_native_should_rate_limit_handler(native_pipeline):
+    """ShouldRateLimit over RAW request bytes: identity (de)serializers keep
+    Python protobuf off the hot path entirely — the native pipeline parses
+    the wire bytes in C++ and answers with prebuilt response blobs."""
+
+    async def handler(blob: bytes, context) -> bytes:
+        try:
+            return await native_pipeline.submit(blob)
+        except StorageError as exc:
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
+            )
+
+    return grpc.method_handlers_generic_handler(
+        _ENVOY_SERVICE,
+        {
+            "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                handler,
+                request_deserializer=None,   # raw bytes in
+                response_serializer=None,    # raw bytes out
+            )
+        },
+    )
+
+
 async def serve_rls(
     limiter,
     address: str = "0.0.0.0:8081",
     metrics: Optional[PrometheusMetrics] = None,
     rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
+    native_pipeline=None,
 ) -> grpc.aio.Server:
-    """Start the gRPC server (returns it started; caller owns shutdown)."""
+    """Start the gRPC server (returns it started; caller owns shutdown).
+
+    With ``native_pipeline`` set (and headers off), ShouldRateLimit runs the
+    native columnar path; the Kuadrant service keeps the standard handlers.
+    """
     server = grpc.aio.server()
     service = RlsService(limiter, metrics, rate_limit_headers)
-    for handler in make_rls_handlers(service):
-        server.add_generic_rpc_handlers((handler,))
+    envoy_handler, kuadrant_handler = make_rls_handlers(service)
+    if native_pipeline is not None and rate_limit_headers == RATE_LIMIT_HEADERS_NONE:
+        envoy_handler = make_native_should_rate_limit_handler(native_pipeline)
+    server.add_generic_rpc_handlers((envoy_handler,))
+    server.add_generic_rpc_handlers((kuadrant_handler,))
     server.add_insecure_port(address)
     await server.start()
     return server

@@ -233,9 +233,15 @@ class NamespaceCompiler:
     #: would otherwise grow the table without bound over a server's life.
     MAX_INTERNED = 1 << 20
 
-    def __init__(self, limits: Sequence[Limit]):
-        self.interner = Interner()
-        self.limits = [CompiledLimit(l, i) for i, l in enumerate(sorted(limits))]
+    def __init__(self, limits: Sequence[Limit], interner=None):
+        # Pluggable interner: the native host path shares its C++ interner
+        # so compiled constants and parsed columns agree on token ids.
+        self.interner = interner if interner is not None else Interner()
+        # Unqualified limits first (then by identity): the storage processes
+        # simple counters before qualified ones (in_memory.rs:104-139), and
+        # first-limited naming follows that order.
+        ordered = sorted(limits, key=lambda l: (bool(l.variables),) + l._identity)
+        self.limits = [CompiledLimit(l, i) for i, l in enumerate(ordered)]
         self.columns_needed: set = set()
         for cl in self.limits:
             if cl.vectorized:
@@ -275,6 +281,10 @@ class NamespaceCompiler:
             cols[key] = col
         return cols
 
+    @property
+    def fully_vectorized(self) -> bool:
+        return all(cl.vectorized for cl in self.limits)
+
     def _reintern_constants(self) -> None:
         self.interner = Interner()
         for cl in self.limits:
@@ -282,12 +292,31 @@ class NamespaceCompiler:
                 for p in cl.limit.conditions:
                     self._intern_constants(p.expression.ast)
 
+    def evaluate_columns(self, cols: Dict[str, np.ndarray], n: int):
+        """Lower-level columnar evaluation for pre-built columns (native
+        parse path): yields (CompiledLimit, applies_mask, var_cols) per
+        vectorized limit — no per-request Python objects."""
+        for cl in self.limits:
+            if not cl.vectorized:
+                continue
+            applies = np.ones(n, bool)
+            for m in cl.mask:
+                applies &= m.verdict(cols, self.interner, n)
+            var_cols = [cols[k] for k in cl.var_keys]
+            for vc in var_cols:
+                applies &= vc != MISSING
+            yield cl, applies, var_cols
+
     def evaluate(
         self, batch: Sequence[Dict[str, str]]
     ) -> List[List[Tuple[Limit, Tuple[int, ...]]]]:
-        if len(self.interner) > self.MAX_INTERNED:
+        if (
+            isinstance(self.interner, Interner)
+            and len(self.interner) > self.MAX_INTERNED
+        ):
             # Token ids only live within one evaluate() call (counters carry
-            # strings), so resetting between batches is safe.
+            # strings), so resetting between batches is safe. A shared
+            # (native) interner manages its own lifetime.
             self._reintern_constants()
         n = len(batch)
         out: List[List[Tuple[Limit, Tuple[int, ...]]]] = [[] for _ in range(n)]

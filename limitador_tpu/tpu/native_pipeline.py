@@ -1,0 +1,503 @@
+"""Native columnar RLS serving path.
+
+The fastest end-to-end route through the framework: the gRPC handler gives
+this pipeline RAW serialized RateLimitRequest bytes (identity deserializer
+— Python protobuf never runs on the hot path); a micro-batch of blobs then
+flows
+
+    C++ parse + intern -> token columns          (native/hostpath.cc)
+    -> compiled predicate masks (numpy)          (tpu/compiler.py)
+    -> composite-key slot lookup (C++ hash map)  (native slot map)
+    -> ONE fused device kernel                   (ops/kernel.py)
+    -> per-request OK / OVER_LIMIT blobs (prebuilt bytes)
+
+Python objects only materialize off the fast path: slot-map misses
+(allocation via the storage's key space, kept coherent with native keys so
+LRU eviction invalidates both sides), requests with multiple descriptors,
+namespaces with non-vectorizable limits, and header-loading modes — all of
+which route to the exact per-request pipeline.
+
+Semantics are the same exact check-all-then-update-all as everywhere else;
+this module only changes how fast the batch is assembled.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.counter import Counter
+from ..core.limit import Namespace
+from ..observability.metrics import PrometheusMetrics
+from ..storage.base import StorageError
+from .. import native
+from .compiler import NamespaceCompiler
+from .pipeline import CompiledTpuLimiter
+from .storage import TpuStorage
+
+__all__ = ["NativeRlsPipeline"]
+
+
+class _NsPlan:
+    """Per-namespace compiled plan bound to the native interner."""
+
+    __slots__ = ("namespace", "compiler", "limits_meta")
+
+    def __init__(self, namespace: Namespace, compiler: NamespaceCompiler, hp):
+        self.namespace = namespace
+        self.compiler = compiler
+        # per vectorized limit: (limit_token, max, window_s, name, limit).
+        # The token is interned from the limit's stable identity — compile
+        # order must NOT leak into native slot keys, or a limits reload that
+        # reorders limits would alias counters (plans rebuild, the native
+        # slot map does not).
+        self.limits_meta = [
+            (
+                hp.intern("limit\x00" + repr(cl.limit._identity)),
+                cl.limit.max_value,
+                cl.limit.window_seconds,
+                cl.limit.name,
+                cl.limit,
+            )
+            for cl in compiler.limits
+        ]
+
+
+class NativeRlsPipeline:
+    """Owns the native context and decides batches of raw RLS blobs.
+
+    ``submit(blob)`` resolves to the serialized RateLimitResponse bytes.
+    """
+
+    OK_BLOB: bytes
+    OVER_BLOB: bytes
+    UNKNOWN_BLOB: bytes
+
+    def __init__(
+        self,
+        limiter: CompiledTpuLimiter,
+        metrics: Optional[PrometheusMetrics] = None,
+        max_delay: float = 0.0005,
+        max_batch: int = 8192,
+    ):
+        if not native.available():
+            raise RuntimeError(
+                f"native hostpath unavailable: {native.build_error()}"
+            )
+        from ..server.proto import rls_pb2
+
+        self._pb = rls_pb2
+        self.OK_BLOB = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OK
+        ).SerializeToString()
+        self.OVER_BLOB = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.OVER_LIMIT
+        ).SerializeToString()
+        self.UNKNOWN_BLOB = rls_pb2.RateLimitResponse(
+            overall_code=rls_pb2.RateLimitResponse.UNKNOWN
+        ).SerializeToString()
+
+        self.limiter = limiter
+        self.storage: TpuStorage = limiter._tpu.inner
+        self.metrics = metrics
+        self.max_delay = max_delay
+        self.max_batch = max_batch
+
+        self.hp = native.HostPath()
+        self._interner = self.hp.as_interner()
+        self._tracked: Dict[str, int] = {}
+        self._plans: Dict[int, Optional[_NsPlan]] = {}  # domain token -> plan
+        self._pending: List[Tuple[bytes, asyncio.Future]] = []
+        self._flush_task: Optional[asyncio.Task] = None
+        # The C++ context is single-threaded by design; overlapping flushes
+        # (timer + max_batch trigger) serialize here.
+        self._native_lock = threading.Lock()
+        #: rebuild the native context when the interner exceeds this many
+        #: distinct strings (high-cardinality values must not grow RSS
+        #: without bound; device counters are keyed by the Python table, so
+        #: a rebuild only costs re-warming the caches).
+        self.max_interned = 4 << 20
+        # eviction coherence: python slot release -> native map removal
+        self.storage._table.on_native_release = self.hp.slots_remove
+
+    # -- plan management ----------------------------------------------------
+
+    def invalidate(self) -> None:
+        """Limits changed: drop all plans (rebuilt lazily)."""
+        self._plans.clear()
+
+    def _plan_for(self, domain_token: int) -> Optional[_NsPlan]:
+        plan = self._plans.get(domain_token, _MISSING_PLAN)
+        if plan is not _MISSING_PLAN:
+            return plan
+        namespace = Namespace.of(self.hp.string(domain_token))
+        limits = self.limiter.get_limits(namespace)
+        compiler = NamespaceCompiler(limits, interner=self._interner)
+        if not limits or not compiler.fully_vectorized:
+            # Namespace needs the exact path (or has no limits -> cheap OK,
+            # handled by an empty plan).
+            plan = _NsPlan(namespace, compiler, self.hp) if not limits else None
+        else:
+            plan = _NsPlan(namespace, compiler, self.hp)
+            for cl in compiler.limits:
+                for key in cl.var_keys:
+                    self._track(key)
+                for m in cl.mask:
+                    for key in m.keys:
+                        self._track(key)
+        self._plans[domain_token] = plan
+        return plan
+
+    def _track(self, key: str) -> None:
+        if key not in self._tracked:
+            self._tracked[key] = self.hp.track(key)
+
+    # -- submission ----------------------------------------------------------
+
+    async def submit(self, blob: bytes) -> bytes:
+        future = asyncio.get_running_loop().create_future()
+        self._pending.append((blob, future))
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon()
+            )
+        if len(self._pending) >= self.max_batch:
+            await self._flush()
+        return await future
+
+    async def _flush_soon(self) -> None:
+        await asyncio.sleep(self.max_delay)
+        await self._flush()
+        if self._pending:
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon()
+            )
+
+    async def _flush(self) -> None:
+        batch, self._pending = self._pending, []
+        if not batch:
+            return
+        try:
+            slow = await asyncio.get_running_loop().run_in_executor(
+                None, self._decide_columnar, batch
+            )
+        except Exception as exc:
+            for _blob, future in batch:
+                if not future.done():
+                    future.set_exception(exc)
+            return
+        # Requests the columnar path couldn't take: exact per-request path.
+        for blob, future in slow:
+            asyncio.ensure_future(self._decide_exact(blob, future))
+
+    # -- the columnar fast path ----------------------------------------------
+
+    def _recycle_context_if_needed(self) -> None:
+        """Interner past the cap: swap in a fresh native context. Slot-map
+        entries repopulate lazily through the Python key space."""
+        if self.hp.interned_count() <= self.max_interned:
+            return
+        old = self.hp
+        self.hp = native.HostPath()
+        self._interner = self.hp.as_interner()
+        self._tracked = {}
+        self._plans = {}
+        self.storage._table.native_keys.clear()
+        self.storage._table.on_native_release = self.hp.slots_remove
+        old.close()
+
+    def _decide_columnar(self, batch) -> List[Tuple[bytes, asyncio.Future]]:
+        with self._native_lock:
+            return self._decide_columnar_locked(batch)
+
+    def _decide_columnar_locked(self, batch):
+        self._recycle_context_if_needed()
+        blobs = [b for b, _f in batch]
+        n = len(blobs)
+        domains, hits, cols, _ndesc, extra = self.hp.parse_batch(blobs)
+
+        slow: List[Tuple[bytes, asyncio.Future]] = []
+        results: List[Optional[bytes]] = [None] * n
+
+        # Group rows by domain token.
+        by_domain: Dict[int, List[int]] = {}
+        for r in range(n):
+            if domains[r] < 0:
+                results[r] = self.UNKNOWN_BLOB
+            elif extra[r] > 0:
+                slow.append(batch[r])  # results[r] stays None (slow path)
+            else:
+                by_domain.setdefault(int(domains[r]), []).append(r)
+
+        for token, rows in by_domain.items():
+            plan = self._plan_for(token)
+            if plan is None:
+                for r in rows:
+                    slow.append(batch[r])  # results stay None (slow path)
+                continue
+            if not plan.limits_meta:
+                for r in rows:
+                    results[r] = self.OK_BLOB
+                continue
+            self._decide_namespace(
+                plan, token, rows, hits, cols, results, batch, blobs
+            )
+
+        for (blob, future), out in zip(batch, results):
+            # None marks slow-path rows (resolved later); note UNKNOWN
+            # serializes to b"" (all-default proto3), which is a valid
+            # response — only None is the sentinel.
+            if out is _STORAGE_ERROR:
+                future.get_loop().call_soon_threadsafe(
+                    _reject, future,
+                    StorageError("counter allocation failed", transient=True),
+                )
+            elif out is not None:
+                future.get_loop().call_soon_threadsafe(
+                    _resolve, future, out
+                )
+        return slow
+
+    def _decide_namespace(
+        self, plan, token, rows, hits, cols, results, batch, blobs
+    ) -> None:
+        rows_arr = np.asarray(rows, np.int32)
+        m = rows_arr.shape[0]
+        needed = set()
+        for cl in plan.compiler.limits:
+            needed.update(cl.var_keys)
+            for mask in cl.mask:
+                needed.update(mask.keys)
+        if any(k not in cols for k in needed):
+            # First batch for this namespace: its keys were tracked after
+            # the batch-wide parse. Re-parse just this group.
+            _d, h2, cols_local, _n, _e = self.hp.parse_batch(
+                [blobs[r] for r in rows]
+            )
+            group_cols = {k: cols_local[k] for k in needed}
+            deltas_req = h2
+        else:
+            group_cols = {k: cols[k][rows_arr] for k in needed}
+            deltas_req = hits[rows_arr]
+
+        hit_slots: List[np.ndarray] = []
+        hit_deltas: List[np.ndarray] = []
+        hit_maxes: List[np.ndarray] = []
+        hit_windows: List[np.ndarray] = []
+        hit_req: List[np.ndarray] = []
+        hit_fresh: List[np.ndarray] = []
+        hit_name: List[Tuple[object, np.ndarray]] = []  # (limit, local req idx)
+        failed_reqs: set = set()  # local idx whose allocation errored
+
+        # Lookup -> (alloc misses) -> kernel happens under the storage lock
+        # so a concurrent LRU eviction cannot recycle a looked-up slot
+        # between lookup and kernel (check_columnar re-enters the RLock).
+        with self.storage._lock:
+            # Phase 1: evaluate + resolve slots for EVERY limit before
+            # building hit arrays — a late allocation failure must void the
+            # failed request's deltas on earlier limits too (all-or-nothing).
+            staged = []
+            for (cl, applies, var_cols), meta in zip(
+                plan.compiler.evaluate_columns(group_cols, m),
+                plan.limits_meta,
+            ):
+                limit_token, max_value, window_s, name, limit = meta
+                idx = np.nonzero(applies)[0].astype(np.int32)
+                if idx.size == 0:
+                    continue
+                k = 2 + len(var_cols)
+                keys = np.empty((idx.size, k), np.int32)
+                keys[:, 0] = token
+                keys[:, 1] = limit_token
+                for j, vc in enumerate(var_cols):
+                    keys[:, 2 + j] = vc[idx]
+                slots = self.hp.slots_lookup(keys)
+                fresh = slots < 0
+                if fresh.any():
+                    self._allocate_missing(
+                        limit, var_cols, idx, keys, slots, fresh, failed_reqs
+                    )
+                    # failed allocations leave slot -1: point them at the
+                    # inert scratch cell with delta 0
+                    bad = slots < 0
+                    slots[bad] = self.storage._scratch
+                    fresh[bad] = False
+                staged.append((limit, idx, slots, fresh, max_value, window_s))
+
+            # Phase 2: build hit arrays with failed requests fully voided.
+            for limit, idx, slots, fresh, max_value, window_s in staged:
+                hit_slots.append(slots.astype(np.int32))
+                deltas_l = np.minimum(
+                    deltas_req[idx], (1 << 30) - 1
+                ).astype(np.int32)
+                if failed_reqs:
+                    deltas_l[np.isin(idx, list(failed_reqs))] = 0
+                hit_deltas.append(deltas_l)
+                hit_maxes.append(
+                    np.full(idx.size, min(max_value, 1 << 30), np.int32)
+                )
+                hit_windows.append(
+                    np.full(
+                        idx.size,
+                        min(window_s * 1000, 2**31 - 2**30 - 2),
+                        np.int32,
+                    )
+                )
+                hit_req.append(idx)
+                hit_fresh.append(fresh)
+                hit_name.append((limit, idx))
+
+            namespace = str(plan.namespace)
+            if not hit_slots:
+                for local, r in enumerate(rows):
+                    results[r] = self.OK_BLOB
+                if self.metrics:
+                    self.metrics.authorized_calls.labels(namespace).inc(m)
+                    self.metrics.authorized_hits.labels(namespace).inc(
+                        int(deltas_req.sum())
+                    )
+                return
+
+            slots = np.concatenate(hit_slots)
+            deltas = np.concatenate(hit_deltas)
+            maxes = np.concatenate(hit_maxes)
+            windows = np.concatenate(hit_windows)
+            req = np.concatenate(hit_req)
+            fresh = np.concatenate(hit_fresh)
+            # Kernel req ids must be dense in [0, H): requests without hits
+            # don't participate, so compress local indices.
+            order = np.argsort(req, kind="stable")
+            participating, kernel_req = np.unique(
+                req[order], return_inverse=True
+            )
+            arrays = self.storage.pad_hits(
+                (slots[order], deltas[order], maxes[order], windows[order],
+                 kernel_req.astype(np.int32), fresh[order]),
+                slots.shape[0],
+            )
+            admitted, hit_ok, _rem, _ttl = self.storage.check_columnar(*arrays)
+
+        admitted_by_local = dict(
+            zip(participating.tolist(), admitted[: participating.size])
+        )
+        n_ok = 0
+        ok_hits = 0
+        limited_rows = []
+        for local, r in enumerate(rows):
+            if local in failed_reqs:
+                results[r] = _STORAGE_ERROR
+            elif admitted_by_local.get(local, True):
+                results[r] = self.OK_BLOB
+                n_ok += 1
+                ok_hits += int(deltas_req[local])
+            else:
+                results[r] = self.OVER_BLOB
+                limited_rows.append(local)
+        if self.metrics:
+            if n_ok:
+                self.metrics.authorized_calls.labels(namespace).inc(n_ok)
+                self.metrics.authorized_hits.labels(namespace).inc(ok_hits)
+            for local in limited_rows:
+                # first failing hit in request order names the limit
+                name = None
+                pos = np.nonzero(req[order] == local)[0]
+                for p in pos:
+                    if not hit_ok[p]:
+                        # recover the limit via cumulative spans
+                        offset = 0
+                        for limit, idx in hit_name:
+                            if order[p] < offset + idx.size:
+                                name = limit.name
+                                break
+                            offset += idx.size
+                        break
+                self.metrics.incr_limited_calls(namespace, name)
+
+    def _allocate_missing(
+        self, limit, var_cols, idx, keys, slots, fresh_mask, failed_reqs
+    ) -> None:
+        """Slot-map misses: allocate through the storage's key space (so
+        LRU/eviction bookkeeping stays authoritative) and mirror into the
+        native map. A per-counter StorageError fails only its own request
+        (recorded in ``failed_reqs``), never the batch. Caller holds the
+        storage lock."""
+        var_sources = [v.source for v in limit.variables]
+        storage = self.storage
+        for pos in np.nonzero(fresh_mask)[0]:
+            set_vars = {
+                src: self.hp.string(int(var_cols[j][idx[pos]]))
+                for j, src in enumerate(var_sources)
+            }
+            counter = Counter(limit, set_vars)
+            try:
+                slot, is_fresh = storage._slot_for(counter, create=True)
+            except StorageError:
+                failed_reqs.add(int(idx[pos]))
+                continue
+            # The key may already live in the Python key space (counter
+            # created via the per-request path): then the cell is LIVE
+            # and must not be reset by the fresh flag.
+            fresh_mask[pos] = is_fresh
+            key = keys[pos].copy()
+            self.hp.slots_insert(key, slot)
+            storage._table.native_keys[slot] = key
+            slots[pos] = slot
+
+    # -- exact fallback --------------------------------------------------------
+
+    async def _decide_exact(self, blob: bytes, future: asyncio.Future) -> None:
+        from ..server.rls import _context_from_request, _hits_addend
+
+        try:
+            req = self._pb.RateLimitRequest.FromString(blob)
+            if not req.domain:
+                out = self.UNKNOWN_BLOB
+            else:
+                ctx = _context_from_request(req)
+                result = await self.limiter.check_rate_limited_and_update(
+                    req.domain, ctx, _hits_addend(req), False
+                )
+                namespace = req.domain
+                if result.limited:
+                    if self.metrics:
+                        self.metrics.incr_limited_calls(
+                            namespace, result.limit_name
+                        )
+                    out = self.OVER_BLOB
+                else:
+                    if self.metrics:
+                        self.metrics.incr_authorized_calls(namespace)
+                        self.metrics.incr_authorized_hits(
+                            namespace, _hits_addend(req)
+                        )
+                    out = self.OK_BLOB
+            if not future.done():
+                future.set_result(out)
+        except Exception as exc:
+            if not future.done():
+                future.set_exception(exc)
+
+    async def close(self) -> None:
+        if self._flush_task is not None:
+            await self._flush()
+
+
+def _resolve(future: asyncio.Future, value: bytes) -> None:
+    if not future.done():
+        future.set_result(value)
+
+
+def _reject(future: asyncio.Future, exc: Exception) -> None:
+    if not future.done():
+        future.set_exception(exc)
+
+
+class _Missing:
+    pass
+
+
+_MISSING_PLAN = _Missing()
+_STORAGE_ERROR = _Missing()

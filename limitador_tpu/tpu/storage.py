@@ -66,6 +66,9 @@ class _SlotTable:
         self.qualified: "OrderedDict[tuple, int]" = OrderedDict()
         # slot -> (key, Counter identity object) for introspection
         self.info: Dict[int, Tuple[tuple, Counter]] = {}
+        # slot -> native composite key + removal hook (native fast path)
+        self.native_keys: Dict[int, object] = {}
+        self.on_native_release = None
 
     def lookup(self, key: tuple, qualified: bool) -> Optional[int]:
         if qualified:
@@ -82,6 +85,11 @@ class _SlotTable:
         else:
             self.simple.pop(key, None)
         self.free.append(slot)
+        # Eviction coherence with the native slot map: a recycled slot must
+        # not remain reachable under its old native key.
+        native_key = self.native_keys.pop(slot, None)
+        if native_key is not None and self.on_native_release is not None:
+            self.on_native_release(native_key)
 
 
 class _Request:
@@ -300,6 +308,49 @@ class TpuStorage(CounterStorage):
         if not counters:
             return Authorization.OK
         return self.check_many([_Request(counters, delta, load_counters)])[0]
+
+    # -- columnar entry point (native serving path) ------------------------
+
+    def check_columnar(
+        self,
+        slots: np.ndarray,
+        deltas: np.ndarray,
+        maxes: np.ndarray,
+        windows_ms: np.ndarray,
+        req_ids: np.ndarray,
+        fresh: np.ndarray,
+    ):
+        """Run one kernel over pre-built, request-ordered hit arrays (no
+        per-hit Python objects). Caller pads to a bucket (use
+        ``pad_hits``); returns host arrays (admitted, hit_ok, remaining,
+        ttl_ms)."""
+        import jax
+
+        with self._lock:
+            now_ms = self._now_ms()
+            self._state, result = K.check_and_update_batch(
+                self._state, slots, deltas, maxes, windows_ms, req_ids,
+                fresh, np.int32(now_ms),
+            )
+            return jax.device_get(
+                (result.admitted, result.hit_ok, result.remaining,
+                 result.ttl_ms)
+            )
+
+    def pad_hits(self, arrays: Tuple[np.ndarray, ...], nhits: int):
+        """Pad (slots, deltas, maxes, windows, req_ids, fresh) to the next
+        bucket with inert scratch hits."""
+        H = _bucket(max(nhits, 1))
+        pad = H - nhits
+        slots, deltas, maxes, windows, req, fresh = arrays
+        return (
+            np.concatenate([slots, np.full(pad, self._scratch, np.int32)]),
+            np.concatenate([deltas, np.zeros(pad, np.int32)]),
+            np.concatenate([maxes, np.full(pad, _INT32_MAX, np.int32)]),
+            np.concatenate([windows, np.zeros(pad, np.int32)]),
+            np.concatenate([req, np.full(pad, H - 1, np.int32)]),
+            np.concatenate([fresh, np.zeros(pad, bool)]),
+        )
 
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
         out: Set[Counter] = set()

@@ -1,0 +1,146 @@
+"""Native host-path tests: interner, RLS wire parser, slot map — checked
+against the Python protobuf library and Python dict equivalents."""
+
+import numpy as np
+import pytest
+
+from limitador_tpu import native
+from limitador_tpu.server.proto import rls_pb2
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native unavailable: {native.build_error() if hasattr(native, 'build_error') else ''}"
+)
+
+
+def make_blob(domain="ns", entries=None, hits=0):
+    req = rls_pb2.RateLimitRequest(domain=domain, hits_addend=hits)
+    if entries is not None:
+        d = req.descriptors.add()
+        for k, v in entries.items():
+            e = d.entries.add()
+            e.key = k
+            e.value = v
+    return req.SerializeToString()
+
+
+class TestInterner:
+    def test_dense_ids_and_reverse(self):
+        hp = native.HostPath()
+        a = hp.intern("alpha")
+        b = hp.intern("beta")
+        assert (a, b) == (0, 1)
+        assert hp.intern("alpha") == a
+        assert hp.string(a) == "alpha"
+        assert hp.string(b) == "beta"
+        assert hp.find("alpha") == a
+        assert hp.find("nope") == -2
+        assert hp.interned_count() == 2
+
+    def test_many_strings_grow(self):
+        hp = native.HostPath()
+        ids = [hp.intern(f"s{i}") for i in range(50_000)]
+        assert ids == list(range(50_000))
+        assert hp.intern("s49999") == 49999
+        assert hp.string(12345) == "s12345"
+
+    def test_unicode_and_empty(self):
+        hp = native.HostPath()
+        u = hp.intern("héllo wörld ✓")
+        assert hp.string(u) == "héllo wörld ✓"
+        e = hp.intern("")
+        assert hp.string(e) == ""
+
+
+class TestParser:
+    def test_parse_matches_protobuf(self):
+        hp = native.HostPath(["user", "method"])
+        blobs = [
+            make_blob("api", {"user": "alice", "method": "GET"}, hits=3),
+            make_blob("other", {"user": "bob"}, hits=0),
+            make_blob("api", {"method": "POST", "extra": "x"}),
+            make_blob("", None),
+        ]
+        domains, hits, cols, ndesc, extra = hp.parse_batch(blobs)
+        assert hp.string(domains[0]) == "api"
+        assert hp.string(domains[1]) == "other"
+        assert domains[3] == -1  # empty domain
+        assert list(hits) == [3, 1, 1, 1]  # 0 -> 1 default
+        assert hp.string(cols["user"][0]) == "alice"
+        assert hp.string(cols["method"][0]) == "GET"
+        assert cols["method"][1] == -1  # absent key
+        assert hp.string(cols["method"][2]) == "POST"
+        assert list(ndesc) == [2, 1, 2, 0]
+        assert list(extra) == [0, 0, 0, 0]
+
+    def test_multi_descriptor_flagged(self):
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d1 = req.descriptors.add()
+        e = d1.entries.add(); e.key = "u"; e.value = "a"
+        d2 = req.descriptors.add()
+        e = d2.entries.add(); e.key = "u"; e.value = "b"
+        hp = native.HostPath(["u"])
+        domains, hits, cols, ndesc, extra = hp.parse_batch(
+            [req.SerializeToString()]
+        )
+        assert extra[0] == 1          # routed to exact path by caller
+        assert hp.string(cols["u"][0]) == "a"
+
+    def test_garbage_blob(self):
+        hp = native.HostPath(["u"])
+        domains, hits, cols, ndesc, extra = hp.parse_batch(
+            [b"\xff\xff\xff\x01garbage", make_blob("ok", {"u": "x"})]
+        )
+        assert domains[0] == -1
+        assert hp.string(domains[1]) == "ok"
+
+    def test_fuzz_against_protobuf(self):
+        import random
+
+        rng = random.Random(3)
+        hp = native.HostPath(["k0", "k1", "k2"])
+        blobs, want = [], []
+        for _ in range(500):
+            entries = {
+                f"k{rng.randint(0, 4)}": f"v{rng.randint(0, 30)}"
+                for _ in range(rng.randint(0, 4))
+            }
+            hits = rng.randint(0, 5)
+            blobs.append(make_blob("ns", entries, hits))
+            want.append((entries, hits))
+        domains, hits, cols, ndesc, extra = hp.parse_batch(blobs)
+        for r, (entries, h) in enumerate(want):
+            assert hits[r] == (h if h != 0 else 1)
+            for t in ("k0", "k1", "k2"):
+                tok = cols[t][r]
+                if t in entries:
+                    assert hp.string(tok) == entries[t], (r, t)
+                else:
+                    assert tok == -1
+
+
+class TestSlotMap:
+    def test_insert_lookup_remove(self):
+        hp = native.HostPath()
+        k1 = np.asarray([5, 7, 9], np.int32)
+        k2 = np.asarray([5, 7], np.int32)  # shorter key, shared prefix
+        hp.slots_insert(k1, 42)
+        hp.slots_insert(k2, 43)
+        got = hp.slots_lookup(np.stack([k1, k1]))
+        assert list(got) == [42, 42]
+        assert hp.slots_lookup(k2[None, :])[0] == 43
+        assert hp.slots_lookup(np.asarray([[1, 2, 3]], np.int32))[0] == -1
+        hp.slots_remove(k1)
+        assert hp.slots_lookup(k1[None, :])[0] == -1
+        assert hp.slots_lookup(k2[None, :])[0] == 43
+        assert hp.slots_count() == 1
+
+    def test_many_keys_with_collision_pressure(self):
+        hp = native.HostPath()
+        rng = np.random.default_rng(0)
+        keys = rng.integers(0, 1000, (20_000, 2)).astype(np.int32)
+        uniq, idx = np.unique(keys, axis=0, return_index=True)
+        for i, key in enumerate(uniq):
+            hp.slots_insert(key, 1000 + i)
+        got = hp.slots_lookup(uniq)
+        assert list(got) == [1000 + i for i in range(len(uniq))]
+        assert hp.slots_count() == len(uniq)
