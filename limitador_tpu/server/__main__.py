@@ -53,8 +53,9 @@ def build_parser() -> argparse.ArgumentParser:
         "storage",
         nargs="?",
         default=_env("STORAGE", "tpu"),
-        choices=["tpu", "memory", "disk", "distributed"],
-        help="counter storage backend (default: tpu)",
+        choices=["tpu", "memory", "disk", "distributed", "cached"],
+        help="counter storage backend (default: tpu); 'cached' is the "
+        "write-behind topology over a disk authority (--disk-path)",
     )
     p.add_argument("--rls-host", default=_env("ENVOY_RLS_HOST", "0.0.0.0"))
     p.add_argument(
@@ -106,6 +107,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
+        "--snapshot-path", default=_env("TPU_SNAPSHOT_PATH"),
+        help="tpu: periodically checkpoint the counter table here and "
+        "restore from it on startup",
+    )
+    p.add_argument(
+        "--snapshot-period", type=float,
+        default=float(_env("TPU_SNAPSHOT_PERIOD", "30")),
+        help="tpu: seconds between counter-table checkpoints",
+    )
+    p.add_argument(
         "--peer", action="append", default=None,
         help="distributed: peer address (repeatable)",
     )
@@ -129,9 +140,31 @@ def build_limiter(args):
         from ..tpu.batcher import AsyncTpuStorage
         from ..tpu.storage import TpuStorage
 
-        storage = TpuStorage(
-            capacity=args.tpu_capacity, cache_size=args.cache_size
-        )
+        storage = None
+        if args.snapshot_path and os.path.exists(args.snapshot_path):
+            try:
+                storage = TpuStorage.restore(args.snapshot_path)
+            except Exception as exc:
+                print(
+                    f"snapshot {args.snapshot_path} unreadable ({exc}); "
+                    "starting with a fresh table",
+                    file=sys.stderr,
+                )
+            else:
+                print(
+                    f"restored counter table from {args.snapshot_path}",
+                    file=sys.stderr,
+                )
+                if storage._capacity != args.tpu_capacity:
+                    print(
+                        f"warning: snapshot capacity {storage._capacity} "
+                        f"overrides --tpu-capacity {args.tpu_capacity}",
+                        file=sys.stderr,
+                    )
+        if storage is None:
+            storage = TpuStorage(
+                capacity=args.tpu_capacity, cache_size=args.cache_size
+            )
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6
         )
@@ -148,6 +181,12 @@ def build_limiter(args):
 
         path = args.disk_path or "limitador_counters.db"
         return RateLimiter(DiskStorage(path))
+    if args.storage == "cached":
+        from ..storage.cached import CachedCounterStorage
+        from ..storage.disk import DiskStorage
+
+        path = args.disk_path or "limitador_counters.db"
+        return AsyncRateLimiter(CachedCounterStorage(DiskStorage(path)))
     if args.storage == "distributed":
         try:
             from ..storage.distributed import CrInMemoryStorage
@@ -236,6 +275,37 @@ async def _amain(args) -> int:
         file=sys.stderr,
     )
 
+    snapshot_task = None
+    if args.storage == "tpu" and args.snapshot_path:
+        tpu_storage = limiter.storage.counters.inner
+
+        import threading
+
+        snapshot_mutex = threading.Lock()
+
+        def take_snapshot():
+            # Serializes periodic vs shutdown snapshots: cancelling the loop
+            # task cannot stop an executor thread mid-write, and two writers
+            # on one tmp file would publish a corrupt checkpoint.
+            with snapshot_mutex:
+                tmp = args.snapshot_path + ".tmp"
+                tpu_storage.snapshot(tmp)
+                os.replace(tmp, args.snapshot_path)
+
+        async def snapshot_loop():
+            while True:
+                await asyncio.sleep(args.snapshot_period)
+                try:
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, take_snapshot
+                    )
+                except Exception as exc:
+                    # A failed checkpoint (disk full, ...) must not end
+                    # periodic checkpointing for the process lifetime.
+                    print(f"snapshot failed: {exc}", file=sys.stderr)
+
+        snapshot_task = asyncio.get_running_loop().create_task(snapshot_loop())
+
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
     for sig in (signal.SIGINT, signal.SIGTERM):
@@ -244,6 +314,21 @@ async def _amain(args) -> int:
         except NotImplementedError:
             pass
     await stop.wait()
+
+    if snapshot_task is not None:
+        # Drain any in-flight periodic snapshot before the final one — two
+        # writers on the same tmp file would publish a corrupt checkpoint.
+        snapshot_task.cancel()
+        try:
+            await snapshot_task
+        except asyncio.CancelledError:
+            pass
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, take_snapshot
+            )
+        except Exception as exc:
+            print(f"final snapshot failed: {exc}", file=sys.stderr)
 
     if watcher:
         watcher.stop()

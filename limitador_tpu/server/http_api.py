@@ -62,7 +62,16 @@ class _Api:
         self.metrics = metrics
         self.status = status or {}
 
-    async def _call(self, value):
+    async def _call(self, thunk):
+        """Invoke (and await if needed) under a datastore-latency span; the
+        thunk defers sync-limiter work into the timed region."""
+        if self.metrics is not None:
+            with self.metrics.time_datastore():
+                value = thunk()
+                if asyncio.iscoroutine(value):
+                    return await value
+                return value
+        value = thunk()
         if asyncio.iscoroutine(value):
             return await value
         return value
@@ -84,7 +93,7 @@ class _Api:
     async def get_counters(self, request: web.Request) -> web.Response:
         ns = request.match_info["namespace"]
         try:
-            counters = await self._call(self.limiter.get_counters(ns))
+            counters = await self._call(lambda: self.limiter.get_counters(ns))
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
         dtos = sorted(
@@ -115,7 +124,7 @@ class _Api:
             return web.json_response({"error": f"bad request: {exc}"}, status=400)
         try:
             result = await self._call(
-                self.limiter.is_rate_limited(namespace, ctx, delta)
+                lambda: self.limiter.is_rate_limited(namespace, ctx, delta)
             )
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
@@ -130,7 +139,9 @@ class _Api:
         except (KeyError, ValueError, TypeError) as exc:
             return web.json_response({"error": f"bad request: {exc}"}, status=400)
         try:
-            await self._call(self.limiter.update_counters(namespace, ctx, delta))
+            await self._call(
+                lambda: self.limiter.update_counters(namespace, ctx, delta)
+            )
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
         return web.Response(status=200)
@@ -144,7 +155,7 @@ class _Api:
         want_headers = response_headers == RATE_LIMIT_HEADERS_DRAFT03
         try:
             result = await self._call(
-                self.limiter.check_rate_limited_and_update(
+                lambda: self.limiter.check_rate_limited_and_update(
                     namespace, ctx, delta, want_headers
                 )
             )

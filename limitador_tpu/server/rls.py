@@ -20,9 +20,12 @@ Behavioral parity points:
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import grpc
+
+_NULLCONTEXT = contextlib.nullcontext()
 
 from ..core.cel import Context
 from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
@@ -79,25 +82,37 @@ class RlsService:
         self.rate_limit_headers = rate_limit_headers
         self._is_async = isinstance(limiter, AsyncRateLimiter)
 
+    def _timed(self):
+        """datastore_latency span around storage calls (the MetricsLayer
+        busy-time aggregation of the reference, metrics.rs:100-211)."""
+        if self.metrics is not None:
+            return self.metrics.time_datastore()
+        return _NULLCONTEXT
+
     async def _check_and_update(self, namespace, ctx, delta, load):
-        if self._is_async:
-            return await self.limiter.check_rate_limited_and_update(
+        with self._timed():
+            if self._is_async:
+                return await self.limiter.check_rate_limited_and_update(
+                    namespace, ctx, delta, load
+                )
+            return self.limiter.check_rate_limited_and_update(
                 namespace, ctx, delta, load
             )
-        return self.limiter.check_rate_limited_and_update(
-            namespace, ctx, delta, load
-        )
 
     async def _is_rate_limited(self, namespace, ctx, delta):
-        if self._is_async:
-            return await self.limiter.is_rate_limited(namespace, ctx, delta)
-        return self.limiter.is_rate_limited(namespace, ctx, delta)
+        with self._timed():
+            if self._is_async:
+                return await self.limiter.is_rate_limited(
+                    namespace, ctx, delta
+                )
+            return self.limiter.is_rate_limited(namespace, ctx, delta)
 
     async def _update_counters(self, namespace, ctx, delta):
-        if self._is_async:
-            await self.limiter.update_counters(namespace, ctx, delta)
-        else:
-            self.limiter.update_counters(namespace, ctx, delta)
+        with self._timed():
+            if self._is_async:
+                await self.limiter.update_counters(namespace, ctx, delta)
+            else:
+                self.limiter.update_counters(namespace, ctx, delta)
 
     # -- Envoy ShouldRateLimit (THE hot path) -----------------------------
 

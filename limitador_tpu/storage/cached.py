@@ -1,0 +1,272 @@
+"""Write-behind cached counter storage.
+
+Mirrors the reference's cached-Redis topology
+(/root/reference/limitador/src/storage/redis/redis_cached.rs and
+counters_cache.rs): N replicas keep local counters and asynchronously
+reconcile with a shared authority —
+
+- reads hit the local cache; a miss is optimistically treated as a fresh
+  counter ("this is a plain lie!", redis_cached.rs:101-116) so decisions
+  never wait on the authority;
+- increments apply locally AND queue in a batcher (pending delta per
+  counter, coalesced); a background flush loop pushes batches to the
+  authority every ``flush_period`` / when ``batch_size`` accumulates
+  (counters_cache.rs:183-238);
+- the authority applies deltas and returns authoritative values, which
+  reconcile into the cache (other replicas' increments become visible:
+  apply_remote_delta, counters_cache.rs:303-331);
+- a transient authority failure flips the partitioned flag and RETURNS the
+  in-flight deltas to the cache — nothing is lost, the replica keeps
+  serving from local state (redis_cached.rs:216-230, 363-388).
+
+Accuracy contract: bounded over-admission (by flush period x replica
+count), exactly as the reference documents for this topology
+(redis_cached.rs:25-41). Any backend exposing ``apply_deltas`` can be the
+authority (in-memory, disk, TPU table — the analogue of Redis here).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+from .base import AsyncCounterStorage, Authorization, CounterStorage, StorageError
+from .expiring_value import ExpiringValue
+from .keys import key_for_counter
+
+__all__ = ["CachedCounterStorage", "DEFAULT_FLUSH_PERIOD", "DEFAULT_BATCH_SIZE"]
+
+DEFAULT_FLUSH_PERIOD = 1.0   # seconds (redis/mod.rs:10-13)
+DEFAULT_BATCH_SIZE = 100
+DEFAULT_MAX_CACHED = 10_000
+
+
+class _CachedValue:
+    """Local view of one counter: last authoritative value + local deltas
+    not yet flushed (CachedCounterValue, counters_cache.rs:71-120)."""
+
+    __slots__ = ("value", "pending", "from_authority")
+
+    def __init__(self, value: ExpiringValue, from_authority: bool):
+        self.value = value
+        self.pending = 0
+        self.from_authority = from_authority
+
+
+class CachedCounterStorage(AsyncCounterStorage):
+    def __init__(
+        self,
+        authority: CounterStorage,
+        flush_period: float = DEFAULT_FLUSH_PERIOD,
+        batch_size: int = DEFAULT_BATCH_SIZE,
+        max_cached: int = DEFAULT_MAX_CACHED,
+        clock=time.time,
+        on_partitioned: Optional[Callable[[bool], None]] = None,
+    ):
+        self.authority = authority
+        self.flush_period = flush_period
+        self.batch_size = batch_size
+        self.max_cached = max_cached
+        self._clock = clock
+        self._on_partitioned = on_partitioned
+        self.partitioned = False
+        self._cache: Dict[bytes, _CachedValue] = {}
+        self._counters: Dict[bytes, Counter] = {}  # key -> identity counter
+        self._batch: Dict[bytes, int] = {}  # pending flush deltas
+        self._wake: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    # -- flush loop --------------------------------------------------------
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._wake = asyncio.Event()
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        while not self._closed:
+            try:
+                await asyncio.wait_for(
+                    self._wake.wait(), timeout=self.flush_period
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            if self._batch:
+                await self.flush()
+
+    async def flush(self) -> None:
+        """One write-behind flush: push pending deltas, reconcile
+        authoritative values (flush_batcher_and_update_counters,
+        redis_cached.rs:344-394)."""
+        batch, self._batch = self._batch, {}
+        if not batch:
+            return
+        items = [(self._counters[key], delta) for key, delta in batch.items()]
+        loop = asyncio.get_running_loop()
+        try:
+            authoritative = await loop.run_in_executor(
+                None, self._apply_to_authority, items
+            )
+        except StorageError as exc:
+            if exc.transient:
+                # Partition: revert in-flight deltas into the cache and
+                # keep serving locally (redis_cached.rs:363-388).
+                self._set_partitioned(True)
+                now = self._clock()
+                for (counter, delta), (key, _d) in zip(items, batch.items()):
+                    entry = self._entry(counter, key, now)
+                    entry.pending += delta
+                    self._batch[key] = self._batch.get(key, 0) + delta
+                return
+            raise
+        self._set_partitioned(False)
+        now = self._clock()
+        for (counter, _delta), (key, _d), (value, ttl) in zip(
+            items, batch.items(), authoritative
+        ):
+            entry = self._cache.get(key)
+            if entry is None:
+                continue
+            # Remote replicas' increments arrive here: authoritative value
+            # + still-unflushed local pending is the new local view.
+            entry.value.set(value + entry.pending, ttl, now)
+            entry.from_authority = True
+
+    def _apply_to_authority(self, items: List[Tuple[Counter, int]]):
+        apply = getattr(self.authority, "apply_deltas", None)
+        if apply is not None:
+            return apply(items)
+        # Fallback: plain updates, reconcile with a local re-read.
+        out = []
+        for counter, delta in items:
+            self.authority.update_counter(counter, delta)
+            out.append((0, counter.window_seconds))
+        return out
+
+    def _set_partitioned(self, value: bool) -> None:
+        if value != self.partitioned:
+            self.partitioned = value
+            if self._on_partitioned:
+                self._on_partitioned(value)
+
+    # -- cache helpers ------------------------------------------------------
+
+    def _entry(self, counter: Counter, key: bytes, now: float) -> _CachedValue:
+        entry = self._cache.get(key)
+        if entry is None:
+            # Optimistic miss: assume a fresh window (the documented lie).
+            entry = _CachedValue(
+                ExpiringValue(0, now + counter.window_seconds),
+                from_authority=False,
+            )
+            self._cache[key] = entry
+            self._counters[key] = counter.key()
+            if len(self._cache) > self.max_cached:
+                evict = next(iter(self._cache))
+                if evict != key:
+                    self._cache.pop(evict, None)
+                    self._counters.pop(evict, None)
+        return entry
+
+    def _queue(self, counter: Counter, key: bytes, delta: int) -> None:
+        self._batch[key] = self._batch.get(key, 0) + delta
+        if len(self._batch) >= self.batch_size and self._wake is not None:
+            self._wake.set()
+
+    # -- AsyncCounterStorage -------------------------------------------------
+
+    async def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        now = self._clock()
+        entry = self._cache.get(key_for_counter(counter))
+        value = entry.value.value_at(now) if entry is not None else 0
+        return value + delta <= counter.max_value
+
+    async def add_counter(self, limit: Limit) -> None:
+        pass
+
+    async def update_counter(self, counter: Counter, delta: int) -> None:
+        self._ensure_started()
+        now = self._clock()
+        key = key_for_counter(counter)
+        entry = self._entry(counter, key, now)
+        entry.value.update(delta, counter.window_seconds, now)
+        self._queue(counter, key, delta)
+
+    async def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        self._ensure_started()
+        now = self._clock()
+        first_limited: Optional[Authorization] = None
+        staged: List[Tuple[Counter, bytes, _CachedValue]] = []
+        for counter in counters:
+            key = key_for_counter(counter)
+            entry = self._entry(counter, key, now)
+            value = entry.value.value_at(now)
+            if load_counters:
+                remaining = counter.max_value - (value + delta)
+                counter.remaining = max(remaining, 0)
+                counter.expires_in = entry.value.ttl(now)
+                if first_limited is None and remaining < 0:
+                    first_limited = Authorization.limited_by(counter.limit.name)
+            if value + delta > counter.max_value:
+                if not load_counters:
+                    return Authorization.limited_by(counter.limit.name)
+            staged.append((counter, key, entry))
+        if first_limited is not None:
+            return first_limited
+        for counter, key, entry in staged:
+            entry.value.update(delta, counter.window_seconds, now)
+            self._queue(counter, key, delta)
+        return Authorization.OK
+
+    async def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
+        now = self._clock()
+        out: Set[Counter] = set()
+        namespaces = {limit.namespace for limit in limits}
+        for key, counter in self._counters.items():
+            if counter.limit in limits or counter.namespace in namespaces:
+                entry = self._cache.get(key)
+                if entry is None or entry.value.is_expired(now):
+                    continue
+                c = counter.key()
+                c.remaining = c.max_value - entry.value.value_at(now)
+                c.expires_in = entry.value.ttl(now)
+                out.add(c)
+        return out
+
+    async def delete_counters(self, limits: Set[Limit]) -> None:
+        doomed = [
+            key
+            for key, counter in self._counters.items()
+            if counter.limit in limits
+        ]
+        for key in doomed:
+            self._cache.pop(key, None)
+            self._counters.pop(key, None)
+            self._batch.pop(key, None)
+        self.authority.delete_counters(limits)
+
+    async def clear(self) -> None:
+        self._cache.clear()
+        self._counters.clear()
+        self._batch.clear()
+        self.authority.clear()
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._wake is not None:
+            self._wake.set()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.authority.close
+        )

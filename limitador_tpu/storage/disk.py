@@ -203,6 +203,25 @@ class DiskStorage(CounterStorage):
             self._db.execute("DELETE FROM counters")
             self._db.commit()
 
+    def apply_deltas(self, items):
+        """Authority-side batch apply for write-behind caches (see
+        in_memory.apply_deltas)."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            try:
+                for counter, delta in items:
+                    key = key_for_counter(counter)
+                    self._merge(counter, key, delta, now)
+                    value, expiry = self._read(key, now)
+                    out.append(
+                        (value, (expiry - now) if expiry else 0.0)
+                    )
+                self._db.commit()
+            except sqlite3.Error as exc:
+                self._fail(exc)
+        return out
+
     def close(self) -> None:
         with self._lock:
             self._db.close()

@@ -169,3 +169,20 @@ class InMemoryStorage(CounterStorage):
         with self._lock:
             self._simple.clear()
             self._qualified.clear()
+
+    def apply_deltas(self, items):
+        """Authority-side batch apply for write-behind caches: apply each
+        delta, return (post-apply value, ttl seconds) — the role the
+        BATCH_UPDATE_COUNTERS Lua script plays for the reference
+        (redis/scripts.rs:28-45)."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            for counter, delta in items:
+                if counter.is_qualified():
+                    ev = self._qualified_get_or_create(counter, now)
+                else:
+                    ev = self._simple.setdefault(counter.limit, ExpiringValue())
+                value = ev.update(delta, counter.window_seconds, now)
+                out.append((value, ev.ttl(now)))
+        return out

@@ -390,5 +390,84 @@ class TpuStorage(CounterStorage):
             self._table = _SlotTable(self._capacity)
             self._state = K.make_table(self._capacity)
 
+    def apply_deltas(self, items):
+        """Authority-side batch apply for write-behind caches: one
+        update_batch + one read, vectorized (the device table playing the
+        shared-Redis role of the reference's cached topology)."""
+        n = len(items)
+        H = _bucket(max(n, 1))
+        slots = np.full(H, self._scratch, np.int32)
+        deltas = np.zeros(H, np.int32)
+        windows = np.zeros(H, np.int32)
+        fresh = np.zeros(H, bool)
+        with self._lock:
+            now_ms = self._now_ms()
+            for i, (counter, delta) in enumerate(items):
+                slot, is_fresh = self._slot_for(counter, create=True)
+                slots[i] = slot
+                deltas[i] = min(int(delta), K.MAX_DELTA_CAP)
+                windows[i] = _clamp_window_ms(counter.window_seconds)
+                fresh[i] = is_fresh
+            self._state = K.update_batch(
+                self._state, slots, deltas, windows, fresh, np.int32(now_ms)
+            )
+            values, ttls = K.read_slots(
+                self._state, slots[:n], np.int32(now_ms)
+            )
+            values = np.asarray(values)
+            ttls = np.asarray(ttls)
+        return [
+            (int(values[i]), float(ttls[i]) / 1000.0) for i in range(n)
+        ]
+
+    # -- checkpoint / resume (SURVEY.md §5) ---------------------------------
+
+    def snapshot(self, path: str) -> None:
+        """Persist the full counter state (device arrays + host key space)
+        so a restart resumes counting — the reopen semantics the reference
+        gets from RocksDB (rocksdb_storage.rs:237-287), for the device
+        table."""
+        import pickle
+
+        with self._lock:
+            values = np.asarray(self._state.values)
+            expiry = np.asarray(self._state.expiry_ms)
+            table = {
+                "capacity": self._capacity,
+                "cache_size": self._cache_size,
+                "epoch": self._epoch,
+                "free": list(self._table.free),
+                "simple": dict(self._table.simple),
+                "qualified": list(self._table.qualified.items()),
+                "info": dict(self._table.info),
+            }
+        with open(path, "wb") as f:
+            pickle.dump({"values": values, "expiry": expiry, "table": table},
+                        f)
+
+    @classmethod
+    def restore(cls, path: str, clock=time.time) -> "TpuStorage":
+        import pickle
+
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        table = data["table"]
+        self = cls(
+            capacity=table["capacity"], cache_size=table["cache_size"],
+            clock=clock,
+        )
+        # Keep the saved epoch so absolute expiries stay correct; _now_ms
+        # rebases on its own schedule afterwards.
+        self._epoch = table["epoch"]
+        self._state = K.CounterTableState(
+            values=K.jnp.asarray(data["values"]),
+            expiry_ms=K.jnp.asarray(data["expiry"]),
+        )
+        self._table.free = list(table["free"])
+        self._table.simple = dict(table["simple"])
+        self._table.qualified.update(table["qualified"])
+        self._table.info = dict(table["info"])
+        return self
+
     def close(self) -> None:
         pass

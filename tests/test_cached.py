@@ -1,0 +1,169 @@
+"""Write-behind cached storage: batching, convergence across replicas,
+partition revert — mirroring the reference's cached-Redis tests
+(redis_cached.rs:471-613)."""
+
+import asyncio
+
+import pytest
+
+from limitador_tpu import AsyncRateLimiter, Context, Limit
+from limitador_tpu.storage.base import StorageError
+from limitador_tpu.storage.cached import CachedCounterStorage
+from limitador_tpu.storage.in_memory import InMemoryStorage
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_local_decisions_and_flush_to_authority():
+    async def main():
+        authority = InMemoryStorage()
+        cached = CachedCounterStorage(authority, flush_period=0.02)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 10, 60, [], ["u"])
+        limiter.add_limit(limit)
+        for _ in range(4):
+            r = await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": "a"}), 1
+            )
+            assert not r.limited
+        await cached.flush()
+        # authority saw the coalesced batch
+        auth_counters = authority.get_counters({limit})
+        await cached.close()
+        return {c.set_variables["u"]: c.remaining for c in auth_counters}
+
+    assert run(main()) == {"a": 6}
+
+
+def test_replicas_converge_through_shared_authority():
+    """Two cached replicas over one authority: each admits locally, the
+    flush reconciliation makes the other's hits visible (the N-limitadors-
+    one-Redis deployment, doc/topologies.md)."""
+
+    async def main():
+        authority = InMemoryStorage()
+        a = CachedCounterStorage(authority, flush_period=0.01)
+        b = CachedCounterStorage(authority, flush_period=0.01)
+        la, lb = AsyncRateLimiter(a), AsyncRateLimiter(b)
+        limit = Limit("ns", 4, 60, [], ["u"])
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"u": "x"})
+        for _ in range(2):
+            assert not (await la.check_rate_limited_and_update("ns", ctx, 1)).limited
+            assert not (await lb.check_rate_limited_and_update("ns", ctx, 1)).limited
+        # both flush: the authority now holds all 4 hits
+        await a.flush()
+        await b.flush()
+        # Reconciliation rides flushes of pending counters: replica a's next
+        # hit may still be admitted from its stale local view (the
+        # documented bounded over-admission of this topology), but its
+        # flush reconciles the authoritative count and the following hit
+        # must be limited.
+        first = await la.check_rate_limited_and_update("ns", ctx, 1)
+        await a.flush()
+        second = await la.check_rate_limited_and_update("ns", ctx, 1)
+        await a.close()
+        await b.close()
+        return first.limited, second.limited
+
+    assert run(main()) == (False, True)  # over-admit once, then converge
+
+
+class FlakyAuthority(InMemoryStorage):
+    def __init__(self):
+        super().__init__()
+        self.fail = False
+        self.applied = []
+
+    def apply_deltas(self, items):
+        if self.fail:
+            raise StorageError("connection refused", transient=True)
+        self.applied.append([(c.set_variables.get("u"), d) for c, d in items])
+        return super().apply_deltas(items)
+
+
+def test_partition_revert_and_recovery():
+    async def main():
+        authority = FlakyAuthority()
+        flags = []
+        cached = CachedCounterStorage(
+            authority, flush_period=0.01, on_partitioned=flags.append
+        )
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 100, 60, [], ["u"])
+        limiter.add_limit(limit)
+
+        await limiter.check_rate_limited_and_update("ns", Context({"u": "a"}), 5)
+        authority.fail = True
+        await cached.flush()
+        assert cached.partitioned is True
+        # local serving continues, deltas preserved
+        r = await limiter.check_rate_limited_and_update(
+            "ns", Context({"u": "a"}), 1, True
+        )
+        assert not r.limited
+        assert r.counters[0].remaining == 94  # 100 - 5 - 1 locally
+
+        authority.fail = False
+        await cached.flush()
+        assert cached.partitioned is False
+        # the reverted 5 and the new 1 both reached the authority
+        auth = authority.get_counters({limit})
+        remaining = next(iter(auth)).remaining
+        await cached.close()
+        return flags, remaining
+
+    flags, remaining = run(main())
+    assert flags == [True, False]
+    assert remaining == 94
+
+
+def test_batch_coalesces_per_counter():
+    async def main():
+        authority = FlakyAuthority()
+        cached = CachedCounterStorage(authority, flush_period=10.0)
+        limiter = AsyncRateLimiter(cached)
+        limiter.add_limit(Limit("ns", 1000, 60, [], ["u"]))
+        for _ in range(5):
+            await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": "a"}), 2
+            )
+        await limiter.check_rate_limited_and_update(
+            "ns", Context({"u": "b"}), 1
+        )
+        await cached.flush()
+        await cached.close()
+        return authority.applied
+
+    applied = run(main())
+    assert len(applied) == 1
+    assert sorted(applied[0]) == [("a", 10), ("b", 1)]
+
+
+def test_tpu_authority():
+    """The device table as the shared authority (Redis role)."""
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    async def main():
+        authority = TpuStorage(capacity=256)
+        cached = CachedCounterStorage(authority, flush_period=0.01)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 5, 60, [], ["u"])
+        limiter.add_limit(limit)
+        for _ in range(3):
+            await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": "z"}), 1
+            )
+        await cached.flush()
+        auth = authority.get_counters({limit})
+        await cached.close()
+        return next(iter(auth)).remaining
+
+    assert run(main()) == 2

@@ -236,3 +236,32 @@ def test_long_window_limit_enforced_with_uptime():
     # Still enforced (window capped at ~12 days, not wrapped) much later.
     clock.advance(3600)
     assert limiter.check_rate_limited_and_update("ns", Context({}), 1).limited
+
+
+def test_snapshot_restore_roundtrip(tmp_path):
+    """Checkpoint/resume: the device table + key space survive a restart
+    with values and absolute expiries intact."""
+    clock = FakeClock()
+    storage = TpuStorage(capacity=128, clock=clock)
+    limiter = RateLimiter(storage)
+    limit = Limit("ns", 10, 60, [], ["u"])
+    limiter.add_limit(limit)
+    limiter.update_counters("ns", Context({"u": "a"}), 7)
+    clock.advance(5)
+
+    path = str(tmp_path / "table.ckpt")
+    storage.snapshot(path)
+
+    restored = TpuStorage.restore(path, clock=clock)
+    limiter2 = RateLimiter(restored)
+    limiter2.add_limit(limit)
+    counters = limiter2.get_counters("ns")
+    assert len(counters) == 1
+    c = next(iter(counters))
+    assert c.remaining == 3
+    assert abs(c.expires_in - 55) < 0.1  # absolute expiry preserved
+    # counting resumes where it left off
+    r = limiter2.check_rate_limited_and_update("ns", Context({"u": "a"}), 3)
+    assert not r.limited
+    assert limiter2.check_rate_limited_and_update(
+        "ns", Context({"u": "a"}), 1).limited
