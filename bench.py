@@ -174,6 +174,79 @@ def bench_native():
     emit("native_pipeline_decisions_per_sec", rate, "decisions/s", 1e7)
 
 
+def bench_backends():
+    """Reference criterion-scenario parity (limitador/benches/bench.rs):
+    is_rate_limited / check_rate_limited_and_update / update_counters per
+    backend. Prints a table to stderr; emits the tpu check rate."""
+    import tempfile
+
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.storage.disk import DiskStorage
+    from limitador_tpu.storage.distributed import CrInMemoryStorage
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    def backends():
+        yield "memory", InMemoryStorage()
+        yield "tpu", TpuStorage(capacity=1 << 16)
+        yield "disk", DiskStorage(
+            tempfile.mkdtemp(prefix="bench-disk-") + "/c.db"
+        )
+        yield "distributed", CrInMemoryStorage.standalone("bench")
+
+    # scenario: 10 limits/namespace x (1 condition, 1 variable)
+    limits = [
+        Limit("ns", 10**9, 60, [f"descriptors[0].m == 'm{i}'"],
+              ["descriptors[0].u"])
+        for i in range(10)
+    ]
+    ctxs = []
+    for i in range(200):
+        ctx = Context()
+        ctx.list_binding(
+            "descriptors", [{"m": f"m{i % 10}", "u": f"user{i % 50}"}]
+        )
+        ctxs.append(ctx)
+
+    print(
+        "note: per-call (unbatched) tpu ops pay one device sync each — "
+        "through the axon tunnel that sync is erratic (0.2-66ms); "
+        "production throughput comes from the batched paths (configs "
+        "device/native), not this per-call matrix",
+        file=sys.stderr,
+    )
+    tpu_rate = 0.0
+    for name, storage in backends():
+        limiter = RateLimiter(storage)
+        for l in limits:
+            limiter.add_limit(l)
+        rates = {}
+        for op, fn in (
+            ("is_rate_limited",
+             lambda c: limiter.is_rate_limited("ns", c, 1)),
+            ("check_and_update",
+             lambda c: limiter.check_rate_limited_and_update("ns", c, 1)),
+            ("update_counters",
+             lambda c: limiter.update_counters("ns", c, 1)),
+        ):
+            n = 500 if name != "tpu" else 200
+            fn(ctxs[0])  # warm
+            t0 = time.perf_counter()
+            for i in range(n):
+                fn(ctxs[i % 200])
+            rates[op] = n / (time.perf_counter() - t0)
+        print(
+            f"{name:>12}: " + "  ".join(
+                f"{op} {rate/1e3:7.1f}k/s" for op, rate in rates.items()
+            ),
+            file=sys.stderr,
+        )
+        if name == "tpu":
+            tpu_rate = rates["check_and_update"]
+        storage.close()
+    emit("backend_check_and_update_per_sec", tpu_rate, "decisions/s", 1e7)
+
+
 def bench_tenants(device_step):
     """Config 3: 10k namespaces x 100 keys, mixed windows, on device."""
     rng = np.random.default_rng(7)
@@ -242,12 +315,14 @@ def main():
         "--config",
         default="device",
         choices=["device", "memory", "pipeline", "native", "tenants",
-                 "sharded"],
+                 "sharded", "backends"],
     )
     args = parser.parse_args()
 
     if args.config == "memory":
         return bench_memory()
+    if args.config == "backends":
+        return bench_backends()
     if args.config == "pipeline":
         return bench_pipeline()
     if args.config == "native":

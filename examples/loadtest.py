@@ -1,0 +1,75 @@
+"""Simple RLS load generator (the reference ships a goose/ghz-based one in
+sandbox/; this drives ShouldRateLimit over N concurrent gRPC channels).
+
+    python examples/loadtest.py --target 127.0.0.1:8081 --domain api \
+        --rps-report-every 2 --connections 8
+"""
+
+import argparse
+import threading
+import time
+
+import grpc
+
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from limitador_tpu.server.proto import rls_pb2
+
+METHOD = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
+
+
+def worker(target, domain, stats, stop, idx):
+    channel = grpc.insecure_channel(target)
+    fn = channel.unary_unary(
+        METHOD,
+        request_serializer=lambda m: m.SerializeToString(),
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    i = 0
+    while not stop.is_set():
+        req = rls_pb2.RateLimitRequest(domain=domain)
+        d = req.descriptors.add()
+        e = d.entries.add(); e.key = "method"; e.value = "GET"
+        e = d.entries.add(); e.key = "user"; e.value = f"u{idx}-{i % 1000}"
+        try:
+            resp = fn(req, timeout=5)
+            stats[idx][resp.overall_code] = stats[idx].get(resp.overall_code, 0) + 1
+        except grpc.RpcError:
+            stats[idx]["err"] = stats[idx].get("err", 0) + 1
+        i += 1
+    channel.close()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--target", default="127.0.0.1:8081")
+    p.add_argument("--domain", default="api")
+    p.add_argument("--connections", type=int, default=8)
+    p.add_argument("--duration", type=float, default=10.0)
+    args = p.parse_args()
+
+    stop = threading.Event()
+    stats = [dict() for _ in range(args.connections)]
+    threads = [
+        threading.Thread(target=worker,
+                         args=(args.target, args.domain, stats, stop, i))
+        for i in range(args.connections)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(args.duration)
+    stop.set()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    total = sum(sum(s.values()) for s in stats)
+    ok = sum(s.get(1, 0) for s in stats)
+    over = sum(s.get(2, 0) for s in stats)
+    err = sum(s.get("err", 0) for s in stats)
+    print(f"{total/dt:.0f} req/s over {dt:.1f}s "
+          f"(OK {ok}, OVER_LIMIT {over}, errors {err})")
+
+
+if __name__ == "__main__":
+    main()

@@ -32,15 +32,36 @@ class PrometheusMetrics:
         self,
         use_limit_name_label: bool = False,
         registry: Optional[CollectorRegistry] = None,
+        metric_labels: Optional[str] = None,
     ):
+        """``metric_labels`` is a CEL map expression evaluated against each
+        request context to produce extra label values (the reference's
+        --metric-labels-default, prometheus_metrics.rs:135-167). Label
+        NAMES must be literal map keys (prometheus requires fixed names);
+        values may be any CEL expression over the request."""
         self.registry = registry or CollectorRegistry()
         self.use_limit_name_label = use_limit_name_label
-        labels = [NAMESPACE_LABEL]
+        self.labels_expr = None
+        self.custom_label_names: list = []
+        if metric_labels:
+            from ..core.cel import Expression, MapExpr, Literal
+
+            expr = Expression.parse(metric_labels)
+            if not isinstance(expr.ast, MapExpr):
+                raise ValueError("metric labels must be a CEL map literal")
+            names = []
+            for k, _v in expr.ast.entries:
+                if not (isinstance(k, Literal) and isinstance(k.value, str)):
+                    raise ValueError("metric label names must be string literals")
+                names.append(k.value)
+            self.labels_expr = expr
+            self.custom_label_names = names
+        labels = [NAMESPACE_LABEL] + self.custom_label_names
         limited_labels = (
             [NAMESPACE_LABEL, LIMIT_NAME_LABEL]
             if use_limit_name_label
             else [NAMESPACE_LABEL]
-        )
+        ) + self.custom_label_names
         self.authorized_calls = Counter(
             "authorized_calls", "Authorized calls", labels,
             registry=self.registry,
@@ -73,19 +94,37 @@ class PrometheusMetrics:
             ),
         )
 
-    def incr_authorized_calls(self, namespace: str) -> None:
-        self.authorized_calls.labels(namespace).inc()
+    def custom_labels(self, ctx) -> list:
+        """Evaluate the CEL label map against a request context; absent /
+        failing values become empty labels (never error the hot path)."""
+        if self.labels_expr is None or ctx is None:
+            return [""] * len(self.custom_label_names)
+        try:
+            values = self.labels_expr.eval_map(ctx)
+        except Exception:
+            values = {}
+        return [values.get(name, "") for name in self.custom_label_names]
 
-    def incr_authorized_hits(self, namespace: str, hits: int) -> None:
-        self.authorized_hits.labels(namespace).inc(hits)
+    def incr_authorized_calls(
+        self, namespace: str, ctx=None, n: int = 1
+    ) -> None:
+        self.authorized_calls.labels(
+            namespace, *self.custom_labels(ctx)
+        ).inc(n)
+
+    def incr_authorized_hits(self, namespace: str, hits: int, ctx=None) -> None:
+        self.authorized_hits.labels(
+            namespace, *self.custom_labels(ctx)
+        ).inc(hits)
 
     def incr_limited_calls(
-        self, namespace: str, limit_name: Optional[str] = None
+        self, namespace: str, limit_name: Optional[str] = None, ctx=None
     ) -> None:
+        extra = self.custom_labels(ctx)
         if self.use_limit_name_label:
-            self.limited_calls.labels(namespace, limit_name or "").inc()
+            self.limited_calls.labels(namespace, limit_name or "", *extra).inc()
         else:
-            self.limited_calls.labels(namespace).inc()
+            self.limited_calls.labels(namespace, *extra).inc()
 
     @contextmanager
     def time_datastore(self):

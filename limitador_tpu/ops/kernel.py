@@ -147,7 +147,10 @@ def check_and_update_core(
     H = slots.shape[0]
 
     order = jnp.argsort(slots, stable=True)      # by slot, then request order
-    inv_order = jnp.argsort(order, stable=True)  # scatter back to hit order
+    # inverse permutation via scatter (O(H), vs a second O(H log H) sort)
+    inv_order = jnp.zeros_like(order).at[order].set(
+        jnp.arange(H, dtype=order.dtype)
+    )
 
     s_slot = slots[order]
     s_delta = deltas[order]

@@ -72,6 +72,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="add limit names to prometheus labels",
     )
     p.add_argument(
+        "--metric-labels",
+        default=_env("METRIC_LABELS"),
+        help="CEL map literal evaluated per request for extra prometheus "
+        "labels, e.g. \"{'tenant': descriptors[0].tenant}\"",
+    )
+    p.add_argument(
+        "--grpc-reflection-service",
+        action="store_true",
+        help="enable gRPC server reflection (requires grpcio-reflection)",
+    )
+    p.add_argument(
         "--rate-limit-headers",
         choices=[RATE_LIMIT_HEADERS_NONE, RATE_LIMIT_HEADERS_DRAFT03],
         default=_env("RATE_LIMIT_HEADERS", RATE_LIMIT_HEADERS_NONE),
@@ -207,7 +218,22 @@ def build_limiter(args):
 
 async def _amain(args) -> int:
     limiter = build_limiter(args)
-    metrics = PrometheusMetrics(use_limit_name_label=args.limit_name_in_labels)
+    metrics = PrometheusMetrics(
+        use_limit_name_label=args.limit_name_in_labels,
+        metric_labels=args.metric_labels,
+    )
+    reflection_enabled = False
+    if args.grpc_reflection_service:
+        try:
+            import grpc_reflection  # noqa: F401
+
+            reflection_enabled = True
+        except ImportError:
+            print(
+                "grpc reflection requested but grpcio-reflection is not "
+                "installed; continuing without it",
+                file=sys.stderr,
+            )
     status = {"limits_file_version": 0, "limits_file_errors": 0}
     pipelines_to_invalidate = []
 
@@ -264,6 +290,7 @@ async def _amain(args) -> int:
         metrics,
         args.rate_limit_headers,
         native_pipeline=native_pipeline,
+        enable_reflection=reflection_enabled,
     )
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status
@@ -356,6 +383,9 @@ def main(argv=None) -> int:
         return asyncio.run(_amain(args))
     except KeyboardInterrupt:
         return 0
+    except (ValueError, LimitsFileError) as exc:
+        print(f"configuration error: {exc}", file=sys.stderr)
+        return 2
 
 
 if __name__ == "__main__":

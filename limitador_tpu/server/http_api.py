@@ -164,11 +164,13 @@ class _Api:
         headers = result.response_header() if want_headers else {}
         if result.limited:
             if self.metrics:
-                self.metrics.incr_limited_calls(namespace, result.limit_name)
+                self.metrics.incr_limited_calls(
+                    namespace, result.limit_name, ctx=ctx
+                )
             return web.Response(status=429, headers=headers)
         if self.metrics:
-            self.metrics.incr_authorized_calls(namespace)
-            self.metrics.incr_authorized_hits(namespace, delta)
+            self.metrics.incr_authorized_calls(namespace, ctx=ctx)
+            self.metrics.incr_authorized_hits(namespace, delta, ctx=ctx)
         return web.Response(status=200, headers=headers)
 
 
@@ -177,8 +179,10 @@ def make_http_app(
     metrics: Optional[PrometheusMetrics] = None,
     status: Optional[dict] = None,
 ) -> web.Application:
+    from .middleware import http_request_id_middleware
+
     api = _Api(limiter, metrics, status)
-    app = web.Application()
+    app = web.Application(middlewares=[http_request_id_middleware])
     app.router.add_get("/status", api.get_status)
     app.router.add_get("/metrics", api.get_metrics)
     app.router.add_get("/limits/{namespace}", api.get_limits)

@@ -1,0 +1,53 @@
+"""Request-id propagation.
+
+Mirrors the reference's RequestIdMiddleware (envoy_rls/server.rs:274-300,
+http_api/server.rs:297-314): every request carries an ``x-request-id`` —
+the client's if present, else a fresh uuid — echoed on HTTP responses and
+gRPC initial metadata so logs and traces correlate across hops.
+"""
+
+from __future__ import annotations
+
+import uuid
+
+import grpc
+from aiohttp import web
+
+__all__ = ["http_request_id_middleware", "GrpcRequestIdInterceptor"]
+
+HEADER = "x-request-id"
+
+
+@web.middleware
+async def http_request_id_middleware(request: web.Request, handler):
+    request_id = request.headers.get(HEADER) or uuid.uuid4().hex
+    request["request_id"] = request_id
+    try:
+        response = await handler(request)
+    except web.HTTPException as exc:
+        # Error responses (404/405/...) need the id most — stamp and re-raise.
+        exc.headers[HEADER] = request_id
+        raise
+    response.headers[HEADER] = request_id
+    return response
+
+
+class GrpcRequestIdInterceptor(grpc.aio.ServerInterceptor):
+    async def intercept_service(self, continuation, handler_call_details):
+        metadata = dict(handler_call_details.invocation_metadata or ())
+        request_id = metadata.get(HEADER) or uuid.uuid4().hex
+        handler = await continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+
+        inner = handler.unary_unary
+
+        async def wrapped(request, context):
+            await context.send_initial_metadata(((HEADER, request_id),))
+            return await inner(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )

@@ -135,12 +135,16 @@ class RlsService:
             )
         if result.limited:
             if self.metrics:
-                self.metrics.incr_limited_calls(namespace, result.limit_name)
+                self.metrics.incr_limited_calls(
+                    namespace, result.limit_name, ctx=ctx
+                )
             code = rls_pb2.RateLimitResponse.OVER_LIMIT
         else:
             if self.metrics:
-                self.metrics.incr_authorized_calls(namespace)
-                self.metrics.incr_authorized_hits(namespace, hits_addend)
+                self.metrics.incr_authorized_calls(namespace, ctx=ctx)
+                self.metrics.incr_authorized_hits(
+                    namespace, hits_addend, ctx=ctx
+                )
             code = rls_pb2.RateLimitResponse.OK
         return _response(code, result, with_headers)
 
@@ -163,11 +167,13 @@ class RlsService:
             )
         if result.limited:
             if self.metrics:
-                self.metrics.incr_limited_calls(namespace, result.limit_name)
+                self.metrics.incr_limited_calls(
+                    namespace, result.limit_name, ctx=ctx
+                )
             code = rls_pb2.RateLimitResponse.OVER_LIMIT
         else:
             if self.metrics:
-                self.metrics.incr_authorized_calls(namespace)
+                self.metrics.incr_authorized_calls(namespace, ctx=ctx)
             code = rls_pb2.RateLimitResponse.OK
         with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
         return _response(code, result, with_headers)
@@ -189,7 +195,7 @@ class RlsService:
         if self.metrics:
             # Report counts hits only (kuadrant_service.rs report path);
             # authorized_calls is counted by CheckRateLimit.
-            self.metrics.incr_authorized_hits(namespace, hits_addend)
+            self.metrics.incr_authorized_hits(namespace, hits_addend, ctx=ctx)
         return rls_pb2.RateLimitResponse(
             overall_code=rls_pb2.RateLimitResponse.OK
         )
@@ -259,19 +265,31 @@ async def serve_rls(
     metrics: Optional[PrometheusMetrics] = None,
     rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
     native_pipeline=None,
+    enable_reflection: bool = False,
 ) -> grpc.aio.Server:
     """Start the gRPC server (returns it started; caller owns shutdown).
 
     With ``native_pipeline`` set (and headers off), ShouldRateLimit runs the
     native columnar path; the Kuadrant service keeps the standard handlers.
     """
-    server = grpc.aio.server()
+    from .middleware import GrpcRequestIdInterceptor
+
+    server = grpc.aio.server(interceptors=(GrpcRequestIdInterceptor(),))
     service = RlsService(limiter, metrics, rate_limit_headers)
     envoy_handler, kuadrant_handler = make_rls_handlers(service)
     if native_pipeline is not None and rate_limit_headers == RATE_LIMIT_HEADERS_NONE:
         envoy_handler = make_native_should_rate_limit_handler(native_pipeline)
     server.add_generic_rpc_handlers((envoy_handler,))
     server.add_generic_rpc_handlers((kuadrant_handler,))
+    if enable_reflection:
+        # The generated _pb2 modules register in the default descriptor
+        # pool, which grpc reflection serves from.
+        from grpc_reflection.v1alpha import reflection
+
+        reflection.enable_server_reflection(
+            (_ENVOY_SERVICE, _KUADRANT_SERVICE, reflection.SERVICE_NAME),
+            server,
+        )
     server.add_insecure_port(address)
     await server.start()
     return server

@@ -103,6 +103,16 @@ class NativeRlsPipeline:
         self.limiter = limiter
         self.storage: TpuStorage = limiter._tpu.inner
         self.metrics = metrics
+        if metrics is not None and metrics.custom_label_names:
+            import sys as _sys
+
+            print(
+                "warning: --metric-labels values are not evaluated on the "
+                "native columnar path; custom labels will be empty for "
+                "requests it serves (use --pipeline compiled for per-request "
+                "label values)",
+                file=_sys.stderr,
+            )
         self.max_delay = max_delay
         self.max_batch = max_batch
 
@@ -355,9 +365,9 @@ class NativeRlsPipeline:
                 for local, r in enumerate(rows):
                     results[r] = self.OK_BLOB
                 if self.metrics:
-                    self.metrics.authorized_calls.labels(namespace).inc(m)
-                    self.metrics.authorized_hits.labels(namespace).inc(
-                        int(deltas_req.sum())
+                    self.metrics.incr_authorized_calls(namespace, n=m)
+                    self.metrics.incr_authorized_hits(
+                        namespace, int(deltas_req.sum())
                     )
                 return
 
@@ -398,8 +408,8 @@ class NativeRlsPipeline:
                 limited_rows.append(local)
         if self.metrics:
             if n_ok:
-                self.metrics.authorized_calls.labels(namespace).inc(n_ok)
-                self.metrics.authorized_hits.labels(namespace).inc(ok_hits)
+                self.metrics.incr_authorized_calls(namespace, n=n_ok)
+                self.metrics.incr_authorized_hits(namespace, ok_hits)
             for local in limited_rows:
                 # first failing hit in request order names the limit
                 name = None

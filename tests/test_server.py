@@ -167,34 +167,36 @@ class TestKuadrantService:
         assert resp.overall_code == rls_pb2.RateLimitResponse.OVER_LIMIT
 
 
-class TestHttpApi:
-    @pytest.fixture
-    def http_server(self):
-        limiter = RateLimiter(InMemoryStorage())
-        limiter.add_limit(
-            Limit(
-                "test_namespace", 2, 60,
-                ["descriptors[0]['req_method'] == 'GET'"],
-                ["descriptors[0].user"],
-            )
+@pytest.fixture
+def http_server():
+    limiter = RateLimiter(InMemoryStorage())
+    limiter.add_limit(
+        Limit(
+            "test_namespace", 2, 60,
+            ["descriptors[0]['req_method'] == 'GET'"],
+            ["descriptors[0].user"],
         )
-        metrics = PrometheusMetrics()
-        port = free_port()
-        loop = asyncio.new_event_loop()
-        runner = loop.run_until_complete(
-            run_http_server(
-                limiter, "127.0.0.1", port, metrics,
-                {"limits_file_version": 1},
-            )
+    )
+    metrics = PrometheusMetrics()
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    runner = loop.run_until_complete(
+        run_http_server(
+            limiter, "127.0.0.1", port, metrics,
+            {"limits_file_version": 1},
         )
-        import threading
+    )
+    import threading
 
-        t = threading.Thread(target=loop.run_forever, daemon=True)
-        t.start()
-        yield port, limiter
-        asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result()
-        loop.call_soon_threadsafe(loop.stop)
-        t.join(timeout=2)
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    yield port, limiter
+    asyncio.run_coroutine_threadsafe(runner.cleanup(), loop).result()
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=2)
+
+
+class TestHttpApi:
 
     def _post(self, port, path, body):
         import urllib.request
@@ -373,3 +375,59 @@ class TestReviewRegressions:
         # Check counts the call; Report counts only hits.
         assert 'authorized_calls_total{limitador_namespace="test_namespace"} 1.0' in text
         assert 'authorized_hits_total{limitador_namespace="test_namespace"} 2.0' in text
+
+
+class TestObservabilityExtras:
+    def test_custom_metric_labels(self):
+        from limitador_tpu.core.cel import Context as CelContext
+
+        metrics = PrometheusMetrics(
+            metric_labels="{'tenant': descriptors[0].tenant}"
+        )
+        ctx = CelContext()
+        ctx.list_binding("descriptors", [{"tenant": "acme", "u": "x"}])
+        metrics.incr_authorized_calls("ns", ctx=ctx)
+        metrics.incr_limited_calls("ns", None, ctx=ctx)
+        # missing tenant -> empty label, never an error
+        ctx2 = CelContext()
+        ctx2.list_binding("descriptors", [{"u": "y"}])
+        metrics.incr_authorized_calls("ns", ctx=ctx2)
+        text = metrics.render().decode()
+        assert 'authorized_calls_total{limitador_namespace="ns",tenant="acme"} 1.0' in text
+        assert 'authorized_calls_total{limitador_namespace="ns",tenant=""} 1.0' in text
+        assert 'limited_calls_total{limitador_namespace="ns",tenant="acme"} 1.0' in text
+
+    def test_metric_labels_reject_non_map(self):
+        with pytest.raises(ValueError):
+            PrometheusMetrics(metric_labels="descriptors[0].x")
+
+    def test_http_request_id_echo(self, http_server):
+        import urllib.request
+
+        port, _ = http_server
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/status",
+            headers={"x-request-id": "abc-123"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["x-request-id"] == "abc-123"
+        with urllib.request.urlopen(f"http://127.0.0.1:{port}/status") as resp:
+            assert len(resp.headers["x-request-id"]) == 32  # generated
+
+    def test_grpc_request_id_metadata(self, rls_server):
+        import grpc as grpc_mod
+
+        port, *_ = rls_server
+        with grpc_mod.insecure_channel(f"127.0.0.1:{port}") as channel:
+            fn = channel.unary_unary(
+                ENVOY_METHOD,
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+            call = fn.with_call(
+                make_request(entries={"req.method": "GET", "user": "rid"}),
+                metadata=(("x-request-id", "rid-42"),),
+                timeout=5,
+            )
+            initial = dict(call[1].initial_metadata())
+            assert initial.get("x-request-id") == "rid-42"
