@@ -2,7 +2,7 @@
 sandbox/; this drives ShouldRateLimit over N concurrent gRPC channels).
 
     python examples/loadtest.py --target 127.0.0.1:8081 --domain api \
-        --rps-report-every 2 --connections 8
+        --connections 8 --duration 10
 """
 
 import argparse

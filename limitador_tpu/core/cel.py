@@ -813,6 +813,19 @@ class _Evaluator:
 
     def _eval_Call(self, e: Call) -> Any:
         if e.target is None:
+            if e.function == "has":
+                # has() macro: presence test without raising NoSuchKey.
+                if len(e.args) != 1 or not isinstance(
+                    e.args[0], (Select, Index)
+                ):
+                    raise EvaluationError(
+                        "has() requires a field-selection argument"
+                    )
+                try:
+                    self.eval(e.args[0])
+                    return True
+                except NoSuchKey:
+                    return False
             return self._call_global(e.function, [self.eval(a) for a in e.args])
         recv = self.eval(e.target)
         return self._call_method(recv, e.function, [self.eval(a) for a in e.args])
@@ -881,8 +894,6 @@ class _Evaluator:
         if fn == "matches":
             s, pattern = args
             return self._call_method(s, "matches", [pattern])
-        if fn == "has":
-            raise EvaluationError("has() must be applied to a field selection")
         raise EvaluationError(f"unknown function {fn!r}")
 
     def _call_method(self, recv: Any, fn: str, args: List[Any]) -> Any:

@@ -106,21 +106,22 @@ class PrometheusMetrics:
         return [values.get(name, "") for name in self.custom_label_names]
 
     def incr_authorized_calls(
-        self, namespace: str, ctx=None, n: int = 1
+        self, namespace: str, ctx=None, n: int = 1, labels=None
     ) -> None:
-        self.authorized_calls.labels(
-            namespace, *self.custom_labels(ctx)
-        ).inc(n)
+        extra = labels if labels is not None else self.custom_labels(ctx)
+        self.authorized_calls.labels(namespace, *extra).inc(n)
 
-    def incr_authorized_hits(self, namespace: str, hits: int, ctx=None) -> None:
-        self.authorized_hits.labels(
-            namespace, *self.custom_labels(ctx)
-        ).inc(hits)
+    def incr_authorized_hits(
+        self, namespace: str, hits: int, ctx=None, labels=None
+    ) -> None:
+        extra = labels if labels is not None else self.custom_labels(ctx)
+        self.authorized_hits.labels(namespace, *extra).inc(hits)
 
     def incr_limited_calls(
-        self, namespace: str, limit_name: Optional[str] = None, ctx=None
+        self, namespace: str, limit_name: Optional[str] = None, ctx=None,
+        labels=None,
     ) -> None:
-        extra = self.custom_labels(ctx)
+        extra = labels if labels is not None else self.custom_labels(ctx)
         if self.use_limit_name_label:
             self.limited_calls.labels(namespace, limit_name or "", *extra).inc()
         else:

@@ -21,6 +21,7 @@ import os
 import signal
 import sys
 
+from ..core.cel import CelError
 from ..core.limiter import AsyncRateLimiter, RateLimiter
 from ..observability.metrics import PrometheusMetrics
 from .http_api import run_http_server
@@ -129,7 +130,8 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--peer", action="append", default=None,
-        help="distributed: peer address (repeatable)",
+        help="distributed/tpu: peer replication address (repeatable; with "
+        "tpu storage this enables the replicated device-table topology)",
     )
     p.add_argument("--node-id", default=_env("NODE_ID"))
     p.add_argument(
@@ -173,9 +175,20 @@ def build_limiter(args):
                         file=sys.stderr,
                     )
         if storage is None:
-            storage = TpuStorage(
-                capacity=args.tpu_capacity, cache_size=args.cache_size
-            )
+            if args.peer or args.listen_address:
+                from ..tpu.replicated import TpuReplicatedStorage
+
+                storage = TpuReplicatedStorage(
+                    node_id=args.node_id or "node",
+                    listen_address=args.listen_address or "0.0.0.0:5001",
+                    peers=args.peer or [],
+                    capacity=args.tpu_capacity,
+                    cache_size=args.cache_size,
+                )
+            else:
+                storage = TpuStorage(
+                    capacity=args.tpu_capacity, cache_size=args.cache_size
+                )
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6
         )
@@ -383,7 +396,7 @@ def main(argv=None) -> int:
         return asyncio.run(_amain(args))
     except KeyboardInterrupt:
         return 0
-    except (ValueError, LimitsFileError) as exc:
+    except (ValueError, LimitsFileError, CelError) as exc:
         print(f"configuration error: {exc}", file=sys.stderr)
         return 2
 

@@ -162,15 +162,17 @@ class _Api:
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
         headers = result.response_header() if want_headers else {}
+        if self.metrics:
+            extra = self.metrics.custom_labels(ctx)
         if result.limited:
             if self.metrics:
                 self.metrics.incr_limited_calls(
-                    namespace, result.limit_name, ctx=ctx
+                    namespace, result.limit_name, labels=extra
                 )
             return web.Response(status=429, headers=headers)
         if self.metrics:
-            self.metrics.incr_authorized_calls(namespace, ctx=ctx)
-            self.metrics.incr_authorized_hits(namespace, delta, ctx=ctx)
+            self.metrics.incr_authorized_calls(namespace, labels=extra)
+            self.metrics.incr_authorized_hits(namespace, delta, labels=extra)
         return web.Response(status=200, headers=headers)
 
 

@@ -133,17 +133,20 @@ class RlsService:
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
             )
+        if self.metrics:
+            # evaluate the custom label map once per request
+            extra = self.metrics.custom_labels(ctx)
         if result.limited:
             if self.metrics:
                 self.metrics.incr_limited_calls(
-                    namespace, result.limit_name, ctx=ctx
+                    namespace, result.limit_name, labels=extra
                 )
             code = rls_pb2.RateLimitResponse.OVER_LIMIT
         else:
             if self.metrics:
-                self.metrics.incr_authorized_calls(namespace, ctx=ctx)
+                self.metrics.incr_authorized_calls(namespace, labels=extra)
                 self.metrics.incr_authorized_hits(
-                    namespace, hits_addend, ctx=ctx
+                    namespace, hits_addend, labels=extra
                 )
             code = rls_pb2.RateLimitResponse.OK
         return _response(code, result, with_headers)

@@ -185,6 +185,14 @@ class _LimitsRegistry:
             per_ns = self._limits.pop(Namespace.of(namespace), None)
             return set(per_ns.values()) if per_ns else set()
 
+    def all_limits(self) -> Set[Limit]:
+        with self._lock:
+            return {
+                limit
+                for per_ns in self._limits.values()
+                for limit in per_ns.values()
+            }
+
     def clear(self) -> None:
         with self._lock:
             self._limits.clear()
@@ -196,6 +204,10 @@ class Storage:
     def __init__(self, counters: CounterStorage):
         self._registry = _LimitsRegistry()
         self.counters = counters
+        # Backends that reconstruct counters from wire keys (replicated
+        # stores) need visibility into the configured limits.
+        if hasattr(counters, "set_limits_provider"):
+            counters.set_limits_provider(self._registry.all_limits)
 
     def get_namespaces(self) -> Set[Namespace]:
         return self._registry.namespaces()
@@ -248,6 +260,8 @@ class AsyncStorage:
     def __init__(self, counters: AsyncCounterStorage):
         self._registry = _LimitsRegistry()
         self.counters = counters
+        if hasattr(counters, "set_limits_provider"):
+            counters.set_limits_provider(self._registry.all_limits)
 
     def get_namespaces(self) -> Set[Namespace]:
         return self._registry.namespaces()

@@ -120,6 +120,12 @@ class AsyncTpuStorage(AsyncCounterStorage):
             return Authorization.OK
         return await self.batcher.submit(counters, delta, load_counters)
 
+    def set_limits_provider(self, provider) -> None:
+        """Forwarded so the facade's registry reaches replicated inner
+        storages (wire-key decode of gossiped counters)."""
+        if hasattr(self.inner, "set_limits_provider"):
+            self.inner.set_limits_provider(provider)
+
     async def is_within_limits(self, counter: Counter, delta: int) -> bool:
         return self.inner.is_within_limits(counter, delta)
 

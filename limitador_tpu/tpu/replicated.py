@@ -1,0 +1,367 @@
+"""Replicated TPU counter storage: device-resident counts gossiped across
+nodes.
+
+The multi-host topology the brief calls for: each node keeps ITS OWN hit
+counts in the device table (exact local admission at device speed), while a
+CRDT gossip layer — the same wire protocol / Broker as the host-memory
+distributed mode (storage/distributed/broker.py) — replicates per-actor
+counts between nodes over DCN. Admission sees
+
+    value = own device count  +  sum of live remote actors' counts
+
+which is exactly the read-as-sum of the reference's CRDT mode
+(cr_counter_value.rs:38-46) with the local addend living in HBM. Remote
+sums sit in a second device array folded into the admission base by the
+shared kernel core's ``base_hook``; gossip merges per-actor by max (idempotent,
+commutative) on the host and scatters refreshed sums to the device.
+
+Consistency contract: local decisions are exact against (own + last gossiped
+remote) counts; cross-node over-admission is bounded by the gossip period —
+the reference's documented distributed-mode behavior (doc/topologies.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..core.counter import Counter
+from ..storage.keys import key_for_counter, partial_counter_from_key
+from ..ops import kernel as K
+from .storage import TpuStorage, _bucket
+
+__all__ = ["TpuReplicatedStorage"]
+
+DEFAULT_GOSSIP_PERIOD = 0.1
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
+                      windows_ms, req_ids, fresh, now_ms):
+    """check_and_update over (local + live remote) admission base; only the
+    LOCAL cells are written (remote counts belong to their actors)."""
+    def base_hook(v_local, s_slot):
+        r = remote_vals[s_slot]
+        live = now_ms < remote_exp[s_slot]
+        return v_local + K.jnp.where(live, r, 0)
+
+    nv, ne, admitted, ok, remaining, ttl = K.check_and_update_core(
+        state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
+        req_ids, fresh, now_ms, num_req=slots.shape[0], base_hook=base_hook,
+    )
+    return K.CounterTableState(nv, ne), K.BatchResult(admitted, ok, remaining, ttl)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _apply_remote(remote_vals, remote_exp, slots, sums, expiries):
+    return (
+        remote_vals.at[slots].set(sums),
+        remote_exp.at[slots].set(expiries),
+    )
+
+
+class TpuReplicatedStorage(TpuStorage):
+    def __init__(
+        self,
+        node_id: str,
+        listen_address: Optional[str] = None,
+        peers: Optional[List[str]] = None,
+        capacity: int = 1 << 20,
+        cache_size: Optional[int] = None,
+        gossip_period: float = DEFAULT_GOSSIP_PERIOD,
+        clock=time.time,
+    ):
+        super().__init__(capacity=capacity, cache_size=cache_size, clock=clock)
+        self.node_id = node_id
+        self.gossip_period = gossip_period
+        # device-side remote sums (slot-indexed, scratch row inert)
+        self._remote_vals = K.jnp.zeros((capacity + 1,), K.jnp.int32)
+        self._remote_exp = K.jnp.zeros((capacity + 1,), K.jnp.int32)
+        # host-side per-actor remote state: key -> {actor: (count, exp_ms)}
+        self._remote_actors: Dict[bytes, Dict[str, Tuple[int, int]]] = {}
+        self._dirty_remote: Dict[int, Tuple[int, int]] = {}  # slot -> (sum, exp)
+        self._touched: set = set()  # keys touched locally since last gossip
+        self.broker = None
+        self._gossip_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if listen_address is not None:
+            from ..storage.distributed.broker import Broker
+
+            self.broker = Broker(
+                peer_id=node_id,
+                listen_address=listen_address,
+                peer_urls=peers or [],
+                on_update=self._on_remote_update,
+                snapshot_provider=self._snapshot_for_peer,
+            )
+            self.broker.start()
+            self._gossip_thread = threading.Thread(
+                target=self._gossip_loop, daemon=True,
+                name=f"tpu-gossip-{node_id}",
+            )
+            self._gossip_thread.start()
+
+    # -- kernel dispatch with remote base ----------------------------------
+
+    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh, now_ms):
+        self._flush_dirty_remote()
+        # one vectorized unique, not a python loop over H hits
+        self._touched.update(
+            int(s) for s in np.unique(slots) if s != self._scratch
+        )
+        state, result = _replicated_check(
+            self._state, self._remote_vals, self._remote_exp,
+            slots, deltas, maxes, windows, req, fresh, now_ms,
+        )
+        return state, result
+
+    def _slot_for(self, counter: Counter, create: bool):
+        slot, fresh = super()._slot_for(counter, create)
+        if fresh and slot is not None:
+            # Remote updates that arrived before this counter's limit was
+            # configured locally parked in _remote_actors; adopt them now.
+            key = key_for_counter(counter)
+            if key in self._remote_actors:
+                self._queue_remote_sum(key, slot)
+        return slot, fresh
+
+    def _queue_remote_sum(self, key: bytes, slot: int) -> None:
+        """Recompute the live remote sum for a key and queue the device
+        scatter. Caller holds the lock."""
+        actors = self._remote_actors.get(key, {})
+        now_ms = self._now_ms()
+        epoch_ms = self._epoch * 1000
+        live = [(c, e) for c, e in actors.values() if e - epoch_ms > now_ms]
+        total = sum(c for c, _e in live)
+        exp_rel = max((int(e - epoch_ms) for _c, e in live), default=0)
+        self._dirty_remote[slot] = (
+            min(total, K.MAX_VALUE_CAP),
+            max(0, min(exp_rel, (1 << 31) - 1)),
+        )
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        super().update_counter(counter, delta)
+        # unconditional updates bypass _kernel_check; still gossip them
+        with self._lock:
+            slot, _ = self._slot_for(counter, create=False)
+            if slot is not None:
+                self._touched.add(slot)
+
+    def _now_ms(self) -> int:
+        # The parent rebases the local table's epoch on long uptimes; the
+        # remote arrays share that epoch and must shift identically.
+        prev_epoch = self._epoch
+        now = super()._now_ms()
+        if self._epoch != prev_epoch:
+            shift = int((self._epoch - prev_epoch) * 1000)
+            self._remote_exp = K.jnp.maximum(self._remote_exp - shift, 0)
+        return now
+
+    def _flush_dirty_remote(self) -> None:
+        if not self._dirty_remote:
+            return
+        items = list(self._dirty_remote.items())
+        self._dirty_remote = {}
+        slots = np.asarray([s for s, _ in items], np.int32)
+        sums = np.asarray([v for _, (v, _e) in items], np.int32)
+        exps = np.asarray([e for _, (_v, e) in items], np.int32)
+        self._remote_vals, self._remote_exp = _apply_remote(
+            self._remote_vals, self._remote_exp, slots, sums, exps
+        )
+
+    # -- reads include remote counts ----------------------------------------
+
+    def _remote_value(self, slot: int, now_ms: int) -> int:
+        self._flush_dirty_remote()
+        r = int(np.asarray(self._remote_vals[slot]))
+        e = int(np.asarray(self._remote_exp[slot]))
+        return r if now_ms < e else 0
+
+    def set_limits_provider(self, provider) -> None:
+        """Wired by the Storage facade: lets wire-key decoding see limits
+        configured locally before any counter touched them."""
+        self._limits_provider = provider
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        with self._lock:
+            now_ms = self._now_ms()
+            create = key_for_counter(counter) in self._remote_actors
+            slot, _ = self._slot_for(counter, create=create)
+            if slot is None:
+                return delta <= counter.max_value
+            v, _ttl = K.read_slots(
+                self._state, np.asarray([slot], np.int32), np.int32(now_ms)
+            )
+            value = int(np.asarray(v)[0]) + self._remote_value(slot, now_ms)
+        return value + delta <= counter.max_value
+
+    def get_counters(self, limits):
+        out = super().get_counters(limits)
+        with self._lock:
+            now_ms = self._now_ms()
+            for c in out:
+                qualified_slot = self._table.qualified.get(self._key_of(c))
+                slot = (
+                    qualified_slot
+                    if qualified_slot is not None
+                    else self._table.simple.get(self._key_of(c))
+                )
+                if slot is not None and c.remaining is not None:
+                    c.remaining -= self._remote_value(slot, now_ms)
+        return out
+
+    # -- gossip plumbing ----------------------------------------------------
+
+    def _on_remote_update(
+        self, key: bytes, values: Dict[str, int], expires_at_ms: int
+    ) -> None:
+        """Merge a peer's snapshot: per-actor max (idempotent), recompute the
+        slot's remote sum, queue the device scatter."""
+        now_abs_ms = self._clock() * 1000
+        with self._lock:
+            actors = self._remote_actors.setdefault(key, {})
+            for actor, count in values.items():
+                if actor == self.node_id:
+                    continue
+                old = actors.get(actor)
+                if old is None or old[1] <= now_abs_ms:
+                    # No live state (or the old window expired): adopt the
+                    # incoming count wholesale — per-actor windows RESET on
+                    # expiry (cr_counter_value.rs merge_at), max-merge only
+                    # applies within a live window.
+                    actors[actor] = (count, expires_at_ms)
+                elif expires_at_ms > now_abs_ms:
+                    actors[actor] = (
+                        max(count, old[0]),
+                        max(expires_at_ms, old[1]),
+                    )
+            # locate / allocate the slot for this counter
+            counter = self._decode_counter(key)
+            if counter is None:
+                # Limit not configured here yet: the per-actor state stays
+                # parked and is adopted when the slot is first allocated.
+                return
+            slot, _fresh = self._slot_for(counter, create=True)
+            self._queue_remote_sum(key, slot)
+
+    def _decode_counter(self, key: bytes) -> Optional[Counter]:
+        # Counters decode against the configured limits (registry provider,
+        # O(#limits)); an unknown limit's updates park in _remote_actors
+        # until the limit is configured here. The O(#slots) info scan is
+        # only the providerless fallback (bare-storage tests).
+        try:
+            limits = self._known_limits()
+            if not limits:
+                limits = {info[1].limit for info in self._table.info.values()}
+            return partial_counter_from_key(key, limits)
+        except Exception:
+            return None
+
+    _limits_provider = None  # set by the server: () -> iterable of limits
+
+    def _known_limits(self):
+        if self._limits_provider is None:
+            return set()
+        try:
+            return set(self._limits_provider())
+        except Exception:
+            return set()
+
+    def _snapshot_for_peer(self):
+        """Re-sync: ship our own live counts for every live local counter."""
+        out = []
+        with self._lock:
+            now_ms = self._now_ms()
+            values = np.asarray(self._state.values)
+            expiry = np.asarray(self._state.expiry_ms)
+            for slot, (_key, counter) in self._table.info.items():
+                if expiry[slot] > now_ms:
+                    expires_at = int(
+                        self._epoch * 1000 + int(expiry[slot])
+                    )
+                    out.append(
+                        (
+                            key_for_counter(counter),
+                            {self.node_id: int(values[slot])},
+                            expires_at,
+                        )
+                    )
+        return out
+
+    def _gossip_loop(self) -> None:
+        ticks = 0
+        while not self._stop.wait(self.gossip_period):
+            self._publish_touched()
+            ticks += 1
+            if ticks % 100 == 0:
+                self._prune_remote_actors()
+
+    def _prune_remote_actors(self) -> None:
+        """Drop expired per-actor entries and empty keys so long-running
+        nodes with churning qualified counters don't grow host memory
+        without bound."""
+        now_abs_ms = self._clock() * 1000
+        with self._lock:
+            doomed_keys = []
+            for key, actors in self._remote_actors.items():
+                dead = [a for a, (_c, e) in actors.items() if e <= now_abs_ms]
+                for a in dead:
+                    del actors[a]
+                if not actors:
+                    doomed_keys.append(key)
+            for key in doomed_keys:
+                del self._remote_actors[key]
+
+    def _publish_touched(self) -> None:
+        if self.broker is None:
+            return
+        with self._lock:
+            touched, self._touched = self._touched, set()
+            if not touched:
+                return
+            now_ms = self._now_ms()
+            slots = []
+            wire_keys = []
+            for slot in touched:
+                info = self._table.info.get(slot)
+                if info is not None:
+                    slots.append(slot)
+                    wire_keys.append(key_for_counter(info[1]))
+            if not slots:
+                return
+            v, ttl = K.read_slots(
+                self._state, np.asarray(slots, np.int32), np.int32(now_ms)
+            )
+            v = np.asarray(v)
+            ttl = np.asarray(ttl)
+            epoch_ms = self._epoch * 1000
+        for i, key in enumerate(wire_keys):
+            if ttl[i] <= 0:
+                continue
+            expires_at = int(epoch_ms + now_ms + int(ttl[i]))
+            self.broker.publish(
+                key, {self.node_id: int(v[i])}, expires_at
+            )
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def clear(self) -> None:
+        super().clear()
+        with self._lock:
+            self._remote_vals = K.jnp.zeros_like(self._remote_vals)
+            self._remote_exp = K.jnp.zeros_like(self._remote_exp)
+            self._remote_actors.clear()
+            self._dirty_remote.clear()
+            self._touched.clear()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._gossip_thread is not None:
+            self._gossip_thread.join(timeout=2)
+        if self.broker is not None:
+            self.broker.stop()

@@ -177,6 +177,13 @@ class TpuStorage(CounterStorage):
 
     # -- the shared batched check path -------------------------------------
 
+    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh, now_ms):
+        """Kernel dispatch point; the replicated subclass swaps in a kernel
+        that folds remote (gossiped) counts into the admission base."""
+        return K.check_and_update_batch(
+            self._state, slots, deltas, maxes, windows, req, fresh, now_ms
+        )
+
     def check_many(self, requests: List[_Request]) -> List[Authorization]:
         """Run a batch of check-all-then-update-all requests in one kernel
         launch, in list order (== serial order for exactness). Applies
@@ -228,9 +235,8 @@ class TpuStorage(CounterStorage):
             req = np.asarray(req_l + [H - 1] * pad, np.int32)
             fresh = np.asarray(fresh_l + [False] * pad, bool)
 
-            self._state, result = K.check_and_update_batch(
-                self._state, slots, deltas, maxes, windows, req, fresh,
-                np.int32(now_ms),
+            self._state, result = self._kernel_check(
+                slots, deltas, maxes, windows, req, fresh, np.int32(now_ms)
             )
             # One transfer for all three outputs (matters over remote links).
             hit_ok, remaining, ttl_ms = jax.device_get(

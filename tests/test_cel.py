@@ -166,3 +166,19 @@ class TestExpression:
         assert a < b
         assert a == Expression.parse("a")
         assert hash(a) == hash(Expression.parse("a"))
+
+
+class TestHasMacro:
+    def test_has_presence(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}])
+        assert Predicate.parse("has(descriptors[0].a)").test(ctx) is True
+        assert Predicate.parse("has(descriptors[0].b)").test(ctx) is False
+        assert Predicate.parse(
+            "has(descriptors[0].b) || descriptors[0].a == '1'"
+        ).test(ctx) is True
+
+    def test_has_requires_selection(self):
+        ctx = ctx_of({"x": "1"})
+        with pytest.raises(EvaluationError):
+            Predicate.parse("has('literal')").test(ctx)

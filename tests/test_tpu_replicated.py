@@ -1,0 +1,207 @@
+"""Replicated TPU storage: device-resident counts gossiped across nodes."""
+
+import socket
+import time
+
+import pytest
+
+from limitador_tpu import Context, Limit, RateLimiter
+from limitador_tpu.tpu.replicated import TpuReplicatedStorage
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def eventually(cond, timeout=10.0, tick=0.1):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(tick)
+    return False
+
+
+def test_standalone_behaves_exactly():
+    storage = TpuReplicatedStorage("n1", capacity=256)
+    try:
+        limiter = RateLimiter(storage)
+        limiter.add_limit(Limit("ns", 3, 60, [], ["u"]))
+        ctx = Context({"u": "a"})
+        outs = [
+            limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+            for _ in range(4)
+        ]
+        assert outs == [False, False, False, True]
+    finally:
+        storage.close()
+
+
+def test_two_tpu_nodes_converge():
+    """distributed_rate_limited over device tables: alternate hits across
+    nodes, both must converge to limited (integration_tests.rs:1286-1342)."""
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [f"127.0.0.1:{p1}"],
+        capacity=256, gossip_period=0.03,
+    )
+    b = TpuReplicatedStorage(
+        "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+        capacity=256, gossip_period=0.03,
+    )
+    try:
+        limit = Limit("ns", 3, 60, ["m == 'GET'"], ["u"])
+        la, lb = RateLimiter(a), RateLimiter(b)
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"m": "GET", "u": "app"})
+        limiters = [la, lb]
+        for i in range(3):
+            lim = limiters[i % 2]
+            assert not lim.is_rate_limited("ns", ctx, 1).limited, f"hit {i}"
+            lim.update_counters("ns", ctx, 1)
+        assert eventually(
+            lambda: la.is_rate_limited("ns", ctx, 1).limited
+        ), "node A never saw B's hits"
+        assert eventually(
+            lambda: lb.is_rate_limited("ns", ctx, 1).limited
+        ), "node B never saw A's hits"
+    finally:
+        a.close()
+        b.close()
+
+
+def test_late_joiner_resyncs_device_counts():
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [], capacity=256, gossip_period=0.03
+    )
+    try:
+        limit = Limit("ns", 10, 60, [], ["u"])
+        la = RateLimiter(a)
+        la.add_limit(limit)
+        la.update_counters("ns", Context({"u": "x"}), 7)
+
+        b = TpuReplicatedStorage(
+            "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+            capacity=256, gossip_period=0.03,
+        )
+        try:
+            lb = RateLimiter(b)
+            lb.add_limit(limit)
+            # B's admission must see A's 7 hits after re-sync: 4 more at
+            # delta 1 pushes past max 10 on the 8th check.
+            assert eventually(
+                lambda: not lb.is_rate_limited("ns", Context({"u": "x"}), 3)
+                .limited
+                and lb.is_rate_limited("ns", Context({"u": "x"}), 4).limited
+            ), "late joiner never absorbed A's device counts"
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_local_exactness_with_remote_base():
+    """Remote counts raise the admission base; local all-or-nothing batch
+    semantics stay exact on top of it."""
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [f"127.0.0.1:{p1}"],
+        capacity=256, gossip_period=0.02,
+    )
+    b = TpuReplicatedStorage(
+        "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+        capacity=256, gossip_period=0.02,
+    )
+    try:
+        limit = Limit("ns", 5, 60, [], ["u"])
+        la, lb = RateLimiter(a), RateLimiter(b)
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"u": "k"})
+        for _ in range(3):
+            assert not la.check_rate_limited_and_update("ns", ctx, 1).limited
+        # wait for B to see A's 3
+        assert eventually(
+            lambda: lb.is_rate_limited("ns", ctx, 3).limited
+        ), "B never saw A's count"
+        # B locally admits exactly 2 more (5 - 3 remote)
+        assert not lb.check_rate_limited_and_update("ns", ctx, 1).limited
+        assert not lb.check_rate_limited_and_update("ns", ctx, 1).limited
+        assert lb.check_rate_limited_and_update("ns", ctx, 1).limited
+    finally:
+        a.close()
+        b.close()
+
+
+def test_remote_actor_window_reset():
+    """Regression: a peer's one-window peak must not inflate the remote sum
+    after its window expires (per-actor windows reset, not max-forever)."""
+    class FakeClock:
+        def __init__(self):
+            self.now = 1_700_000_000.0
+        def __call__(self):
+            return self.now
+
+    clock = FakeClock()
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limit = Limit("ns", 10, 60, [], ["u"])
+        limiter.add_limit(limit)
+        from limitador_tpu.storage.keys import key_for_counter
+        from limitador_tpu.core.counter import Counter as C
+
+        key = key_for_counter(C(limit, {"u": "x"}))
+        now_ms = clock.now * 1000
+        # busy window: peer at 100 (expires in 60s)
+        storage._on_remote_update(key, {"peer": 100}, int(now_ms + 60_000))
+        assert storage._remote_actors[key]["peer"][0] == 100
+        # window rolls; peer publishes a fresh small count
+        clock.now += 61
+        now_ms = clock.now * 1000
+        storage._on_remote_update(key, {"peer": 1}, int(now_ms + 60_000))
+        assert storage._remote_actors[key]["peer"][0] == 1  # reset, not max
+        # admission reflects the fresh window: 10 - 1 remote = 9 locally
+        ctx = Context({"u": "x"})
+        outs = [
+            limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+            for _ in range(10)
+        ]
+        assert outs == [False] * 9 + [True]
+    finally:
+        storage.close()
+
+
+def test_remote_actor_pruning():
+    class FakeClock:
+        def __init__(self):
+            self.now = 1_700_000_000.0
+        def __call__(self):
+            return self.now
+
+    clock = FakeClock()
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limit = Limit("ns", 10, 60, [], ["u"])
+        limiter.add_limit(limit)
+        from limitador_tpu.storage.keys import key_for_counter
+        from limitador_tpu.core.counter import Counter as C
+
+        for i in range(20):
+            key = key_for_counter(C(limit, {"u": str(i)}))
+            storage._on_remote_update(
+                key, {"peer": 1}, int(clock.now * 1000 + 60_000)
+            )
+        assert len(storage._remote_actors) == 20
+        clock.now += 120  # everything expired
+        storage._prune_remote_actors()
+        assert len(storage._remote_actors) == 0
+    finally:
+        storage.close()
