@@ -1,0 +1,21 @@
+"""Error hierarchy.
+
+Mirrors /root/reference/limitador/src/errors.rs: a single library-level
+error type wrapping storage and expression-interpreter failures, so callers
+can catch ``LimitadorError`` uniformly.
+"""
+
+from .core.cel import CelError, EvaluationError, ParseError
+from .storage.base import StorageError
+
+__all__ = [
+    "LimitadorError",
+    "StorageError",
+    "CelError",
+    "EvaluationError",
+    "ParseError",
+]
+
+# StorageError and CelError both already derive from Exception; expose the
+# union under the reference's name for uniform handling.
+LimitadorError = (StorageError, CelError)
