@@ -47,7 +47,12 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 
-class CelError(Exception):
+class LimitadorError(Exception):
+    """Library-wide error base (mirrors the reference's LimitadorError,
+    errors.rs): storage and expression failures both derive from it."""
+
+
+class CelError(LimitadorError):
     """Base class for CEL errors."""
 
 
@@ -557,42 +562,59 @@ def parse(source: str) -> Expr:
     return _Parser(source).parse()
 
 
+_MACRO_NAMES = ("all", "exists", "exists_one", "map", "filter")
+
+
 def references(node: Expr) -> set:
-    """Root identifiers referenced by an expression (cel crate references())."""
-    out: set = set()
+    """Root identifiers referenced by an expression (cel crate references()).
+    Comprehension-macro loop variables are scope-local, not references."""
 
-    def walk(e: Expr) -> None:
+    def walk(e: Expr, bound: frozenset) -> set:
         if isinstance(e, Ident):
-            out.add(e.name)
-        elif isinstance(e, Select):
-            walk(e.operand)
-        elif isinstance(e, Index):
-            walk(e.operand)
-            walk(e.index)
-        elif isinstance(e, Call):
+            return set() if e.name in bound else {e.name}
+        if isinstance(e, Select):
+            return walk(e.operand, bound)
+        if isinstance(e, Index):
+            return walk(e.operand, bound) | walk(e.index, bound)
+        if isinstance(e, Call):
+            out: set = set()
             if e.target is not None:
-                walk(e.target)
+                out |= walk(e.target, bound)
+                if (
+                    e.function in _MACRO_NAMES
+                    and e.args
+                    and isinstance(e.args[0], Ident)
+                ):
+                    inner_bound = bound | {e.args[0].name}
+                    for a in e.args[1:]:
+                        out |= walk(a, inner_bound)
+                    return out
             for a in e.args:
-                walk(a)
-        elif isinstance(e, Unary):
-            walk(e.operand)
-        elif isinstance(e, Binary):
-            walk(e.left)
-            walk(e.right)
-        elif isinstance(e, Ternary):
-            walk(e.cond)
-            walk(e.then)
-            walk(e.otherwise)
-        elif isinstance(e, ListExpr):
+                out |= walk(a, bound)
+            return out
+        if isinstance(e, Unary):
+            return walk(e.operand, bound)
+        if isinstance(e, Binary):
+            return walk(e.left, bound) | walk(e.right, bound)
+        if isinstance(e, Ternary):
+            return (
+                walk(e.cond, bound)
+                | walk(e.then, bound)
+                | walk(e.otherwise, bound)
+            )
+        if isinstance(e, ListExpr):
+            out = set()
             for it in e.items:
-                walk(it)
-        elif isinstance(e, MapExpr):
+                out |= walk(it, bound)
+            return out
+        if isinstance(e, MapExpr):
+            out = set()
             for k, v in e.entries:
-                walk(k)
-                walk(v)
+                out |= walk(k, bound) | walk(v, bound)
+            return out
+        return set()
 
-    walk(node)
-    return out
+    return walk(node, frozenset())
 
 
 # ---------------------------------------------------------------------------
@@ -811,7 +833,102 @@ class _Evaluator:
 
     # -- functions ---------------------------------------------------------
 
+    _MACROS = _MACRO_NAMES
+
+    def _eval_macro(self, e: Call) -> Any:
+        """Comprehension macros: receiver.all(x, pred) etc. The loop
+        variable binds in a child context; args are NOT pre-evaluated."""
+        if not e.args or not isinstance(e.args[0], Ident):
+            raise EvaluationError(
+                f"{e.function}() requires a loop variable identifier"
+            )
+        var = e.args[0].name
+        recv = self.eval(e.target)
+        if isinstance(recv, dict):
+            items = list(recv.keys())
+        elif isinstance(recv, list):
+            items = recv
+        else:
+            raise EvaluationError(
+                f"{e.function}() requires a list or map receiver"
+            )
+
+        child_ctx = Context()
+        child_ctx.variables = set(self.ctx.variables) | {var}
+        child_ctx._bindings = dict(self.ctx._bindings)
+        child = _Evaluator(child_ctx)
+
+        def run(expr: Expr, item: Any) -> Any:
+            child_ctx._bindings[var] = item
+            return child.eval(expr)
+
+        if e.function in ("all", "exists", "exists_one"):
+            if len(e.args) != 2:
+                raise EvaluationError(f"{e.function}() takes (var, predicate)")
+            pred = e.args[1]
+            # CEL aggregation semantics: `all` short-circuits on false and
+            # `exists` on true, ABSORBING per-item evaluation errors; an
+            # error only surfaces when no absorbing value was found.
+            # `exists_one` does not absorb errors (cel-spec macros).
+            results = []
+            first_error: Optional[EvaluationError] = None
+            for item in items:
+                try:
+                    v = run(pred, item)
+                except EvaluationError as exc:
+                    if e.function == "exists_one":
+                        raise
+                    first_error = first_error or exc
+                    continue
+                if not isinstance(v, bool):
+                    raise EvaluationError(
+                        f"{e.function}() predicate must be bool"
+                    )
+                if e.function == "all" and not v:
+                    return False
+                if e.function == "exists" and v:
+                    return True
+                results.append(v)
+            if first_error is not None:
+                raise first_error
+            if e.function == "all":
+                return True
+            if e.function == "exists":
+                return False
+            return sum(results) == 1
+        if e.function == "map":
+            if len(e.args) == 2:
+                return [run(e.args[1], item) for item in items]
+            if len(e.args) == 3:  # map(x, filter, transform)
+                out = []
+                for item in items:
+                    keep = run(e.args[1], item)
+                    if not isinstance(keep, bool):
+                        raise EvaluationError("map() filter must be bool")
+                    if keep:
+                        out.append(run(e.args[2], item))
+                return out
+            raise EvaluationError("map() takes (var, fn) or (var, filter, fn)")
+        # filter
+        if len(e.args) != 2:
+            raise EvaluationError("filter() takes (var, predicate)")
+        out = []
+        for item in items:
+            keep = run(e.args[1], item)
+            if not isinstance(keep, bool):
+                raise EvaluationError("filter() predicate must be bool")
+            if keep:
+                out.append(item)
+        return out
+
     def _eval_Call(self, e: Call) -> Any:
+        if (
+            e.target is not None
+            and e.function in self._MACROS
+            and e.args
+            and isinstance(e.args[0], Ident)
+        ):
+            return self._eval_macro(e)
         if e.target is None:
             if e.function == "has":
                 # has() macro: presence test without raising NoSuchKey.
@@ -910,6 +1027,11 @@ class _Evaluator:
                 return re.search(args[0], recv) is not None
             except re.error as err:
                 raise EvaluationError(f"invalid regex: {err}") from None
+        if fn in self._MACROS:
+            raise EvaluationError(
+                f"{fn}() requires a loop-variable identifier as its first "
+                "argument, e.g. list.all(x, x > 0)"
+            )
         if fn == "size" and not args:
             return self._call_global("size", [recv])
         if fn in ("lowerAscii", "upperAscii"):

@@ -5,7 +5,7 @@ error type wrapping storage and expression-interpreter failures, so callers
 can catch ``LimitadorError`` uniformly.
 """
 
-from .core.cel import CelError, EvaluationError, ParseError
+from .core.cel import CelError, EvaluationError, LimitadorError, ParseError
 from .storage.base import StorageError
 
 __all__ = [
@@ -15,7 +15,3 @@ __all__ = [
     "EvaluationError",
     "ParseError",
 ]
-
-# StorageError and CelError both already derive from Exception; expose the
-# union under the reference's name for uniform handling.
-LimitadorError = (StorageError, CelError)

@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
         help="add limit names to prometheus labels",
     )
     p.add_argument(
+        "--tracing-endpoint",
+        default=_env("TRACING_ENDPOINT"),
+        help="OTLP endpoint for span export (requires opentelemetry-sdk)",
+    )
+    p.add_argument(
         "--metric-labels",
         default=_env("METRIC_LABELS"),
         help="CEL map literal evaluated per request for extra prometheus "
@@ -230,6 +235,12 @@ def build_limiter(args):
 
 
 async def _amain(args) -> int:
+    from ..observability.tracing import configure_tracing
+
+    tracing_err = configure_tracing(args.tracing_endpoint)
+    if tracing_err:
+        print(tracing_err, file=sys.stderr)
+
     limiter = build_limiter(args)
     metrics = PrometheusMetrics(
         use_limit_name_label=args.limit_name_in_labels,

@@ -30,6 +30,7 @@ _NULLCONTEXT = contextlib.nullcontext()
 from ..core.cel import Context
 from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
 from ..observability.metrics import PrometheusMetrics
+from ..observability.tracing import should_rate_limit_span
 from ..storage.base import StorageError
 from .proto import rls_pb2
 
@@ -125,14 +126,16 @@ class RlsService:
         ctx = _context_from_request(request)
         hits_addend = _hits_addend(request)
         with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
-        try:
-            result = await self._check_and_update(
-                namespace, ctx, hits_addend, with_headers
-            )
-        except StorageError as exc:
-            await context.abort(
-                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
-            )
+        with should_rate_limit_span(namespace, hits_addend) as record:
+            try:
+                result = await self._check_and_update(
+                    namespace, ctx, hits_addend, with_headers
+                )
+            except StorageError as exc:
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
+                )
+            record(result.limited, result.limit_name)
         if self.metrics:
             # evaluate the custom label map once per request
             extra = self.metrics.custom_labels(ctx)

@@ -18,6 +18,7 @@ from abc import ABC, abstractmethod
 from dataclasses import dataclass
 from typing import ClassVar, Dict, Iterable, List, Optional, Set
 
+from ..core.cel import LimitadorError
 from ..core.counter import Counter
 from ..core.limit import Limit, Namespace
 
@@ -48,7 +49,7 @@ class Authorization:
 Authorization.OK = Authorization(False, None)
 
 
-class StorageError(Exception):
+class StorageError(LimitadorError):
     """Counter-storage failure; ``transient`` mirrors StorageErr::transient
     (storage/mod.rs:312-317) and drives the partitioned/fail-open behavior."""
 

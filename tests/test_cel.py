@@ -182,3 +182,87 @@ class TestHasMacro:
         ctx = ctx_of({"x": "1"})
         with pytest.raises(EvaluationError):
             Predicate.parse("has('literal')").test(ctx)
+
+
+class TestComprehensionMacros:
+    def test_all_exists(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1", "b": "2"}])
+        # over a map, the loop variable binds each KEY
+        assert Predicate.parse(
+            "descriptors[0].all(k, k != 'z')"
+        ).test(ctx) is True
+        assert Predicate.parse(
+            "descriptors[0].exists(k, k == 'a')"
+        ).test(ctx) is True
+        assert Predicate.parse(
+            "descriptors[0].exists_one(k, k == 'a')"
+        ).test(ctx) is True
+
+    def test_list_macros(self):
+        ctx = ctx_of({})
+        assert Predicate.parse("[1, 2, 3].all(x, x > 0)").test(ctx)
+        assert not Predicate.parse("[1, -2, 3].all(x, x > 0)").test(ctx)
+        assert Predicate.parse("[1, 2].exists(x, x == 2)").test(ctx)
+        assert Predicate.parse(
+            "size([1, 2, 3].filter(x, x > 1)) == 2"
+        ).test(ctx)
+        assert Predicate.parse(
+            "[1, 2].map(x, x * 10) == [10, 20]"
+        ).test(ctx)
+        assert Predicate.parse(
+            "[1, 2, 3].map(x, x > 1, x * 10) == [20, 30]"
+        ).test(ctx)
+
+    def test_loop_variable_not_a_reference(self):
+        p = Predicate.parse("[1, 2].all(x, x > 0)")
+        assert p.variables() == []  # 'x' is scope-local
+        # and the macro works without 'x' in the context
+        assert p.test(ctx_of({})) is True
+
+    def test_outer_variables_visible_inside_macro(self):
+        p = Predicate.parse("[1, 2].exists(x, string(x) == target)")
+        assert p.variables() == ["target"]
+        assert p.test(ctx_of({"target": "2"})) is True
+        assert p.test(ctx_of({})) is False  # missing root var -> False
+
+    def test_non_bool_macro_predicate_errors(self):
+        with pytest.raises(EvaluationError):
+            Predicate.parse("[1].all(x, x)").test(ctx_of({}))
+
+
+class TestMacroErrorAbsorption:
+    def test_exists_absorbs_item_errors(self):
+        """CEL spec: true absorbs later (and earlier) item errors."""
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}, {"b": "2"}])
+        assert Predicate.parse(
+            "descriptors.exists(d, d['a'] == '1')"
+        ).test(ctx) is True
+        # no matching item + an erroring item -> error -> predicate False
+        assert Predicate.parse(
+            "descriptors.exists(d, d['a'] == 'nope')"
+        ).test(ctx) is False
+
+    def test_all_absorbs_item_errors_on_false(self):
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"a": "1"}, {"b": "2"}])
+        # second item errors, but first item is False -> all() = False
+        assert Predicate.parse(
+            "descriptors.all(d, d['a'] == 'nope')"
+        ).test(ctx) is False
+        # all items pass or error -> error surfaces -> predicate False
+        assert Predicate.parse(
+            "descriptors.all(d, d['a'] == '1')"
+        ).test(ctx) is False
+
+    def test_errors_base_class(self):
+        from limitador_tpu.errors import LimitadorError, StorageError
+        from limitador_tpu.core.cel import EvaluationError
+
+        assert issubclass(StorageError, LimitadorError)
+        assert issubclass(EvaluationError, LimitadorError)
+        try:
+            raise LimitadorError("raisable")
+        except LimitadorError as e:
+            assert str(e) == "raisable"
