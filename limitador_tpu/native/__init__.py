@@ -8,6 +8,7 @@ pure-Python fallback, so a missing toolchain only costs speed.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,13 +22,37 @@ _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__
 _SRC = os.path.join(_ROOT, "native", "hostpath.cc")
 _BUILD_DIR = os.path.join(_ROOT, "native", "build")
 _SO = os.path.join(_BUILD_DIR, "libhostpath.so")
+_STAMP = _SO + ".sha256"
 
 _lock = threading.Lock()
 _lib = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
+def _src_digest() -> Optional[str]:
+    try:
+        with open(_SRC, "rb") as f:
+            return hashlib.sha256(f.read()).hexdigest()
+    except OSError:
+        return None
+
+
+def _stale(digest: Optional[str]) -> bool:
+    """Content-based staleness: the .so is valid only if it carries a stamp
+    matching the current source hash (mtime ordering is unreliable across
+    checkouts)."""
+    if not os.path.exists(_SO):
+        return True
+    if digest is None:
+        return False  # no source available; trust the existing binary
+    try:
+        with open(_STAMP) as f:
+            return f.read().strip() != digest
+    except OSError:
+        return True
+
+
+def _build(digest: Optional[str]) -> Optional[str]:
     os.makedirs(_BUILD_DIR, exist_ok=True)
     cmd = [
         "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
@@ -41,6 +66,9 @@ def _build() -> Optional[str]:
         return f"g++ invocation failed: {exc}"
     if proc.returncode != 0:
         return f"g++ failed: {proc.stderr[-2000:]}"
+    if digest is not None:
+        with open(_STAMP, "w") as f:
+            f.write(digest)
     return None
 
 
@@ -49,11 +77,9 @@ def _load():
     with _lock:
         if _lib is not None or _build_error is not None:
             return _lib
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
-            _build_error = _build()
+        digest = _src_digest()
+        if _stale(digest):
+            _build_error = _build(digest)
             if _build_error is not None:
                 return None
         try:

@@ -299,10 +299,31 @@ def update_batch(
     fresh_slot = jnp.zeros(values.shape, bool).at[slots].max(fresh)
     cell_expired = jnp.logical_or(now_ms >= expiry, fresh_slot)
     base = jnp.where(cell_expired, 0, values)
-    add = jnp.zeros_like(values).at[slots].add(deltas)
+    # A plain int32 scatter-add wraps when many large deltas land on one
+    # slot in a single batch (each delta is <= MAX_DELTA_CAP but sums are
+    # not). Accumulate four 8-bit lanes separately (exact for any batch up
+    # to ~8M hits) and recombine with carries, saturating at MAX_VALUE_CAP
+    # so a saturated cell can never re-admit against a cap-sized max_value.
+    d = jnp.minimum(deltas, MAX_DELTA_CAP)
+    zeros = jnp.zeros_like(values)
+    s0 = zeros.at[slots].add(d & 0xFF)
+    s1 = zeros.at[slots].add((d >> 8) & 0xFF)
+    s2 = zeros.at[slots].add((d >> 16) & 0xFF)
+    s3 = zeros.at[slots].add(d >> 24)
+    t1 = s1 + (s0 >> 8)
+    t2 = s2 + (t1 >> 8)
+    t3 = s3 + (t2 >> 8)
+    exact = (
+        (s0 & 0xFF) + ((t1 & 0xFF) << 8) + ((t2 & 0xFF) << 16) + (t3 << 24)
+    )
+    add = jnp.where(t3 >= 64, MAX_VALUE_CAP, jnp.minimum(exact, MAX_VALUE_CAP))
     touched = jnp.zeros_like(values).at[slots].add(1) > 0
     win = jnp.zeros_like(values).at[slots].max(windows_ms)
-    new_values = jnp.where(touched, jnp.minimum(base + add, _NEVER), values)
+    base_c = jnp.minimum(base, MAX_VALUE_CAP)
+    headroom = MAX_VALUE_CAP - base_c
+    new_values = jnp.where(
+        touched, base_c + jnp.minimum(add, headroom), values
+    )
     new_expiry = jnp.where(
         jnp.logical_and(touched, cell_expired), now_ms + win, expiry
     )

@@ -28,6 +28,7 @@ authority (in-memory, disk, TPU table — the analogue of Redis here).
 from __future__ import annotations
 
 import asyncio
+import logging
 import time
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -38,6 +39,8 @@ from .expiring_value import ExpiringValue
 from .keys import key_for_counter
 
 __all__ = ["CachedCounterStorage", "DEFAULT_FLUSH_PERIOD", "DEFAULT_BATCH_SIZE"]
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_FLUSH_PERIOD = 1.0   # seconds (redis/mod.rs:10-13)
 DEFAULT_BATCH_SIZE = 100
@@ -79,6 +82,10 @@ class CachedCounterStorage(AsyncCounterStorage):
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        # Operational counters (counters_cache.rs:49,267,368-371), readable
+        # by a metrics layer.
+        self.evicted_pending_writes = 0
+        self.flush_errors = 0
 
     # -- flush loop --------------------------------------------------------
 
@@ -97,7 +104,15 @@ class CachedCounterStorage(AsyncCounterStorage):
                 pass
             self._wake.clear()
             if self._batch:
-                await self.flush()
+                try:
+                    await self.flush()
+                except Exception:
+                    # One bad flush must not kill write-behind; deltas for a
+                    # non-transient failure are re-queued below so the next
+                    # round retries them (the reference's loop lives forever,
+                    # redis_cached.rs:192-203).
+                    self.flush_errors += 1
+                    logger.exception("write-behind flush failed; will retry")
 
     async def flush(self) -> None:
         """One write-behind flush: push pending deltas, reconcile
@@ -106,34 +121,54 @@ class CachedCounterStorage(AsyncCounterStorage):
         batch, self._batch = self._batch, {}
         if not batch:
             return
-        items = [(self._counters[key], delta) for key, delta in batch.items()]
+        # Keys whose identity is gone (delete_counters raced the swap) are
+        # dropped; everything else must survive any error path below.
+        items: List[Tuple[Counter, int]] = []
+        keys: List[bytes] = []
+        for key, delta in batch.items():
+            counter = self._counters.get(key)
+            if counter is None:
+                continue
+            items.append((counter, delta))
+            keys.append(key)
+        if not items:
+            return
         loop = asyncio.get_running_loop()
         try:
             authoritative = await loop.run_in_executor(
                 None, self._apply_to_authority, items
             )
-        except StorageError as exc:
-            if exc.transient:
-                # Partition: revert in-flight deltas into the cache and
-                # keep serving locally (redis_cached.rs:363-388).
+        except BaseException as exc:
+            # Return the in-flight deltas to the batch so nothing is lost —
+            # for a partition we keep serving locally (redis_cached.rs:363-388),
+            # for any other failure the next round retries. entry.pending
+            # still includes these deltas (they are only consumed on a
+            # successful reconcile), so the local view stays correct.
+            for key, (counter, delta) in zip(keys, items):
+                self._batch[key] = self._batch.get(key, 0) + delta
+                self._counters.setdefault(key, counter)
+            if isinstance(exc, StorageError) and exc.transient:
                 self._set_partitioned(True)
-                now = self._clock()
-                for (counter, delta), (key, _d) in zip(items, batch.items()):
-                    entry = self._entry(counter, key, now)
-                    entry.pending += delta
-                    self._batch[key] = self._batch.get(key, 0) + delta
                 return
             raise
         self._set_partitioned(False)
         now = self._clock()
-        for (counter, _delta), (key, _d), (value, ttl) in zip(
-            items, batch.items(), authoritative
+        for key, (counter, flushed), (value, ttl) in zip(
+            keys, items, authoritative
         ):
             entry = self._cache.get(key)
             if entry is None:
+                # Evicted while in flight: the authority has the delta; drop
+                # the identity unless new deltas queued behind it.
+                if key not in self._batch:
+                    self._counters.pop(key, None)
                 continue
-            # Remote replicas' increments arrive here: authoritative value
-            # + still-unflushed local pending is the new local view.
+            # The flushed amount is now part of the authoritative value;
+            # deltas queued while the flush was in flight remain pending and
+            # are layered on top (add_from_authority semantics,
+            # counters_cache.rs:303-331 — remote increments become visible,
+            # local unflushed writes are preserved).
+            entry.pending = max(entry.pending - flushed, 0)
             entry.value.set(value + entry.pending, ttl, now)
             entry.from_authority = True
 
@@ -164,16 +199,33 @@ class CachedCounterStorage(AsyncCounterStorage):
                 ExpiringValue(0, now + counter.window_seconds),
                 from_authority=False,
             )
+            # If the key was evicted with deltas still queued, those deltas
+            # are this counter's unflushed local writes — re-adopt them so
+            # the post-flush reconcile stays exact.
+            entry.pending = self._batch.get(key, 0)
             self._cache[key] = entry
             self._counters[key] = counter.key()
             if len(self._cache) > self.max_cached:
                 evict = next(iter(self._cache))
                 if evict != key:
                     self._cache.pop(evict, None)
-                    self._counters.pop(evict, None)
+                    if evict in self._batch:
+                        # Keep the identity alive: the batcher still owns a
+                        # pending delta and the next flush must be able to
+                        # deliver it (counters_cache.rs:278-301,
+                        # evicted_pending_writes).
+                        self.evicted_pending_writes += 1
+                    else:
+                        self._counters.pop(evict, None)
         return entry
 
     def _queue(self, counter: Counter, key: bytes, delta: int) -> None:
+        entry = self._cache.get(key)
+        if entry is not None:
+            # Track the unflushed local delta so the flush reconcile can
+            # preserve writes that race an in-flight batch
+            # (pending_writes_and_value, counters_cache.rs:71-98).
+            entry.pending += delta
         self._batch[key] = self._batch.get(key, 0) + delta
         if len(self._batch) >= self.batch_size and self._wake is not None:
             self._wake.set()

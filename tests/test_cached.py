@@ -147,6 +147,116 @@ def test_batch_coalesces_per_counter():
     assert sorted(applied[0]) == [("a", 10), ("b", 1)]
 
 
+def test_eviction_with_pending_writes_survives_flush():
+    """Regression: evicting a key with unflushed deltas must not kill the
+    flush loop nor lose the delta (counters_cache.rs:278-301,
+    evicted_pending_writes)."""
+
+    async def main():
+        authority = FlakyAuthority()
+        cached = CachedCounterStorage(
+            authority, flush_period=10.0, max_cached=2
+        )
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 1000, 60, [], ["u"])
+        limiter.add_limit(limit)
+        for u in ("a", "b", "c", "d"):
+            await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": u}), 1
+            )
+        assert cached.evicted_pending_writes >= 1
+        await cached.flush()  # must not raise, must deliver all four deltas
+        auth = {
+            c.set_variables["u"]: c.remaining
+            for c in authority.get_counters({limit})
+        }
+        await cached.close()
+        return auth
+
+    assert run(main()) == {"a": 999, "b": 999, "c": 999, "d": 999}
+
+
+def test_writes_during_inflight_flush_are_preserved():
+    """Regression: deltas applied while a flush is awaiting the authority
+    must survive the reconcile (the reference only ADDS remote deltas and
+    keeps local pending, counters_cache.rs:303-331)."""
+    import threading
+
+    class SlowAuthority(InMemoryStorage):
+        def __init__(self):
+            super().__init__()
+            self.gate = threading.Event()
+            self.entered = threading.Event()
+
+        def apply_deltas(self, items):
+            self.entered.set()
+            assert self.gate.wait(5.0)
+            return super().apply_deltas(items)
+
+    async def main():
+        authority = SlowAuthority()
+        cached = CachedCounterStorage(authority, flush_period=10.0)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 100, 60, [], ["u"])
+        limiter.add_limit(limit)
+        ctx = Context({"u": "a"})
+        await limiter.check_rate_limited_and_update("ns", ctx, 5)
+        flush = asyncio.get_running_loop().create_task(cached.flush())
+        await asyncio.get_running_loop().run_in_executor(
+            None, authority.entered.wait
+        )
+        # The flush is now blocked inside the authority: land 3 more hits.
+        await limiter.check_rate_limited_and_update("ns", ctx, 3)
+        authority.gate.set()
+        await flush
+        # Local view must be authoritative(5) + still-pending(3) = 8.
+        r = await limiter.check_rate_limited_and_update("ns", ctx, 1, True)
+        local_remaining = r.counters[0].remaining
+        # And the next flush delivers the remaining 3 to the authority.
+        await cached.flush()
+        auth = next(iter(authority.get_counters({limit}))).remaining
+        await cached.close()
+        return local_remaining, auth
+
+    local_remaining, auth_remaining = run(main())
+    assert local_remaining == 100 - 9  # 5 + 3 + 1
+    assert auth_remaining == 100 - 9
+
+
+def test_flush_loop_survives_nontransient_error():
+    """Regression: a non-transient flush failure re-queues the batch and the
+    background loop keeps running (redis_cached.rs:192-203)."""
+
+    class BrokenOnce(InMemoryStorage):
+        def __init__(self):
+            super().__init__()
+            self.calls = 0
+
+        def apply_deltas(self, items):
+            self.calls += 1
+            if self.calls == 1:
+                raise StorageError("corrupt frame", transient=False)
+            return super().apply_deltas(items)
+
+    async def main():
+        authority = BrokenOnce()
+        cached = CachedCounterStorage(authority, flush_period=0.01)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 100, 60, [], ["u"])
+        limiter.add_limit(limit)
+        await limiter.check_rate_limited_and_update("ns", Context({"u": "a"}), 7)
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while authority.calls < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert cached.flush_errors >= 1
+        auth = next(iter(authority.get_counters({limit}))).remaining
+        await cached.close()
+        return auth
+
+    assert run(main()) == 93
+
+
 def test_tpu_authority():
     """The device table as the shared authority (Redis role)."""
     from limitador_tpu.tpu.storage import TpuStorage

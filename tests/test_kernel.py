@@ -1,0 +1,60 @@
+"""Device-kernel edge cases (ops/kernel.py)."""
+
+import numpy as np
+
+from limitador_tpu.ops import kernel as K
+
+
+def _update(state, slots, deltas, windows=None, fresh=None, now_ms=1000):
+    H = len(slots)
+    if windows is None:
+        windows = np.full(H, 60_000, np.int32)
+    if fresh is None:
+        fresh = np.zeros(H, bool)
+    return K.update_batch(
+        state,
+        np.asarray(slots, np.int32),
+        np.asarray(deltas, np.int32),
+        np.asarray(windows, np.int32),
+        np.asarray(fresh, bool),
+        np.int32(now_ms),
+    )
+
+
+def test_update_batch_exact_small_sums():
+    state = K.make_table(8)
+    state = _update(state, [3, 3, 3, 5], [7, 11, 13, 2])
+    vals = np.asarray(state.values)
+    assert vals[3] == 31
+    assert vals[5] == 2
+
+
+def test_update_batch_large_deltas_saturate_no_wraparound():
+    """Regression: several near-cap deltas scattered onto one slot in one
+    batch must saturate at MAX_VALUE_CAP, not wrap int32 negative (which
+    would make subsequent checks over-admit)."""
+    state = K.make_table(8)
+    big = K.MAX_DELTA_CAP
+    state = _update(state, [2, 2, 2, 2], [big, big, big, big])
+    vals = np.asarray(state.values)
+    assert vals[2] == K.MAX_VALUE_CAP
+    # and the cell keeps saturating, never goes negative
+    state = _update(state, [2], [big])
+    assert np.asarray(state.values)[2] == K.MAX_VALUE_CAP
+
+
+def test_update_batch_sum_just_below_cap_is_exact():
+    state = K.make_table(8)
+    a = (1 << 29) - 123
+    b = (1 << 29) - 456
+    state = _update(state, [1, 1], [a, b])
+    assert np.asarray(state.values)[1] == a + b  # < 2^30, must be exact
+
+
+def test_update_batch_carry_propagation_exact():
+    """Byte-lane recombination must carry correctly across lanes."""
+    rng = np.random.default_rng(7)
+    deltas = rng.integers(1, 5000, 64).astype(np.int32)
+    state = K.make_table(8)
+    state = _update(state, np.full(64, 4), deltas)
+    assert np.asarray(state.values)[4] == int(deltas.sum())
