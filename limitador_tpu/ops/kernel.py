@@ -76,9 +76,11 @@ __all__ = [
     "check_and_update_batch",
     "check_and_update_core",
     "update_batch",
+    "update_core",
     "read_slots",
     "clear_slots",
     "rebase_epoch",
+    "rebase_epoch_chunked",
     "MAX_VALUE_CAP",
     "MAX_DELTA_CAP",
     "WINDOW_MS_CAP",
@@ -284,18 +286,19 @@ check_and_update_batch = functools.partial(jax.jit, donate_argnums=(0,))(
 )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def update_batch(
-    state: CounterTableState,
+def update_core(
+    values: jax.Array,
+    expiry: jax.Array,
     slots: jax.Array,
     deltas: jax.Array,
     windows_ms: jax.Array,
     fresh: jax.Array,
     now_ms: jax.Array,
-) -> CounterTableState:
+) -> Tuple[jax.Array, jax.Array]:
     """Unconditional increments (the reference's ``update_counter`` path):
-    apply every delta, resetting expired windows, no admission check."""
-    values, expiry = state.values, state.expiry_ms
+    apply every delta, resetting expired windows, no admission check.
+    Traceable core shared by the single-chip ``update_batch`` wrapper and
+    the per-shard body of the multi-chip ``sharded_update``."""
     fresh_slot = jnp.zeros(values.shape, bool).at[slots].max(fresh)
     cell_expired = jnp.logical_or(now_ms >= expiry, fresh_slot)
     base = jnp.where(cell_expired, 0, values)
@@ -329,7 +332,23 @@ def update_batch(
     )
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
-    return CounterTableState(new_values, new_expiry)
+    return new_values, new_expiry
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def update_batch(
+    state: CounterTableState,
+    slots: jax.Array,
+    deltas: jax.Array,
+    windows_ms: jax.Array,
+    fresh: jax.Array,
+    now_ms: jax.Array,
+) -> CounterTableState:
+    nv, ne = update_core(
+        state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
+        now_ms,
+    )
+    return CounterTableState(nv, ne)
 
 
 @jax.jit
@@ -348,6 +367,17 @@ def clear_slots(state: CounterTableState, slots: jax.Array) -> CounterTableState
     values = state.values.at[slots].set(0)
     expiry = state.expiry_ms.at[slots].set(0)
     return CounterTableState(values, expiry)
+
+
+def rebase_epoch_chunked(expiry_ms: jax.Array, shift: int) -> jax.Array:
+    """Shift an int32 expiry array by -shift, where shift may exceed int32
+    (month-long idle gaps): applied in int32-sized chunks, clamping at 0.
+    Shared by the single-chip and sharded storages."""
+    while shift > 0:
+        step = min(shift, (1 << 31) - 1)
+        expiry_ms = jnp.maximum(expiry_ms - jnp.int32(step), 0)
+        shift -= step
+    return expiry_ms
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

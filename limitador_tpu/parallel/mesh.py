@@ -35,7 +35,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.kernel import check_and_update_core
+from ..ops.kernel import check_and_update_core, update_core
 
 __all__ = [
     "ShardedCounterState",
@@ -43,6 +43,7 @@ __all__ = [
     "make_sharded_table",
     "make_mesh",
     "sharded_check_and_update",
+    "sharded_update",
 ]
 
 _NEVER = jnp.iinfo(jnp.int32).max
@@ -157,3 +158,39 @@ def sharded_check_and_update(
         ShardedCounterState(nv, ne),
         ShardedBatchResult(admitted, ok, remaining, ttl),
     )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis"), donate_argnums=(1,),
+)
+def sharded_update(
+    mesh: Mesh,
+    state: ShardedCounterState,
+    slots: jax.Array,       # int32[n, H_local]
+    deltas: jax.Array,      # int32[n, H_local]
+    windows_ms: jax.Array,  # int32[n, H_local]
+    fresh: jax.Array,       # bool[n, H_local]
+    now_ms: jax.Array,      # int32 scalar
+    axis: str = "shard",
+) -> ShardedCounterState:
+    """Unconditional batched increments over the sharded table (the
+    Report/update and write-behind-authority path): per-shard saturating
+    scatter-adds, no admission, no cross-device coupling — a global
+    counter's delta simply lands in one shard's partial."""
+
+    def fn(values, expiry, slots, deltas, windows, fresh):
+        nv, ne = update_core(
+            values[0], expiry[0], slots[0], deltas[0], windows[0], fresh[0],
+            now_ms,
+        )
+        return nv[None], ne[None]
+
+    spec = P(axis, None)
+    nv, ne = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(spec,) * 6,
+        out_specs=(spec, spec),
+        check_vma=False,
+    )(state.values, state.expiry_ms, slots, deltas, windows_ms, fresh)
+    return ShardedCounterState(nv, ne)

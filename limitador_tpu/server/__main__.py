@@ -54,9 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
         "storage",
         nargs="?",
         default=_env("STORAGE", "tpu"),
-        choices=["tpu", "memory", "disk", "distributed", "cached"],
+        choices=["tpu", "sharded", "memory", "disk", "distributed", "cached"],
         help="counter storage backend (default: tpu); 'cached' is the "
-        "write-behind topology over a disk authority (--disk-path)",
+        "write-behind topology over a disk authority (--disk-path); "
+        "'sharded' splits the counter table over every visible device "
+        "(keys routed by hash, global namespaces psum-replicated)",
     )
     p.add_argument("--rls-host", default=_env("ENVOY_RLS_HOST", "0.0.0.0"))
     p.add_argument(
@@ -121,6 +123,16 @@ def build_parser() -> argparse.ArgumentParser:
         "vectorized masks (compiled), or the C++ columnar host path for "
         "ShouldRateLimit (native; falls back to compiled when the native "
         "library is unavailable)",
+    )
+    p.add_argument(
+        "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
+        help="sharded: comma-separated namespaces whose counters are "
+        "psum-replicated across shards (one budget mesh-wide)",
+    )
+    p.add_argument(
+        "--global-region", type=int,
+        default=int(_env("GLOBAL_REGION", "1024")),
+        help="sharded: per-shard slots reserved for global counters",
     )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
@@ -198,6 +210,39 @@ def build_limiter(args):
             storage, max_delay=args.batch_delay_us / 1e6
         )
         if args.pipeline in ("compiled", "native"):
+            from ..tpu.pipeline import CompiledTpuLimiter
+
+            return CompiledTpuLimiter(async_storage)
+        return AsyncRateLimiter(async_storage)
+    if args.storage == "sharded":
+        from ..tpu.batcher import AsyncTpuStorage
+        from ..tpu.sharded import TpuShardedStorage
+
+        if args.snapshot_path:
+            print(
+                "warning: --snapshot-path is not yet supported by the "
+                "sharded storage; counters will not persist across restarts",
+                file=sys.stderr,
+            )
+
+        storage = TpuShardedStorage(
+            local_capacity=args.tpu_capacity,
+            cache_size=args.cache_size,
+            global_namespaces=[
+                ns for ns in (args.global_namespaces or "").split(",") if ns
+            ],
+            global_region=args.global_region,
+        )
+        async_storage = AsyncTpuStorage(
+            storage, max_delay=args.batch_delay_us / 1e6
+        )
+        if args.pipeline in ("compiled", "native"):
+            if args.pipeline == "native":
+                print(
+                    "native pipeline is single-chip only; using the "
+                    "compiled pipeline with sharded storage",
+                    file=sys.stderr,
+                )
             from ..tpu.pipeline import CompiledTpuLimiter
 
             return CompiledTpuLimiter(async_storage)

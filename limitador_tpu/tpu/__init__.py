@@ -1,4 +1,10 @@
 from .batcher import AsyncTpuStorage, MicroBatcher
+from .sharded import TpuShardedStorage
 from .storage import TpuStorage
 
-__all__ = ["TpuStorage", "AsyncTpuStorage", "MicroBatcher"]
+__all__ = [
+    "TpuStorage",
+    "TpuShardedStorage",
+    "AsyncTpuStorage",
+    "MicroBatcher",
+]

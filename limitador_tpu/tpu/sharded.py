@@ -1,0 +1,514 @@
+"""TpuShardedStorage — the multi-chip counter backend.
+
+Serves the `CounterStorage` protocol over the sharded mesh kernel
+(parallel/mesh.py): the counter table is split over the mesh's "shard"
+axis, the host routes every counter to its owner shard by key hash (the
+ICI analogue of Redis-cluster hash-tag sharding,
+/root/reference/limitador/src/storage/keys.rs:1-13), and each
+``check_many`` batch is ONE ``shard_map`` launch:
+
+- per-shard hit arrays `[n_shards, H]`, requests coupled across shards by
+  ``pmin`` over the replicated request vector (a request spanning shards
+  is admitted all-or-nothing — exactness preserved);
+- namespaces named in ``global_namespaces`` live in the psum global
+  region: one slot index shared by every shard, each shard holding a
+  per-device partial, the admission base read as ``psum`` of live
+  partials (the CRDT read-as-sum of cr_counter_value.rs:38-46 riding
+  ICI). Over-admission for those is bounded by one in-flight batch per
+  remote shard — the same contract the reference documents for its
+  distributed mode (redis_cached.rs:25-41).
+
+The existing MicroBatcher serves this class unchanged (it only needs
+``check_many``), so the gRPC/HTTP planes can run multi-chip by swapping
+the storage (BASELINE.json config 5, doc/topologies.md:1-37).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+from ..storage.base import Authorization, CounterStorage, StorageError
+from ..ops import kernel as K
+from ..parallel.mesh import (
+    ShardedCounterState,
+    make_mesh,
+    make_sharded_table,
+    sharded_check_and_update,
+    sharded_update,
+)
+from .storage import _bucket, _clamp_window_ms, _Request, _SlotTable
+
+__all__ = ["TpuShardedStorage"]
+
+_INT32_MAX = int(np.iinfo(np.int32).max)
+
+
+def _stable_hash(key: tuple) -> int:
+    """Deterministic (process-independent) hash for shard routing."""
+    return zlib.crc32(repr(key).encode())
+
+
+class TpuShardedStorage(CounterStorage):
+    def __init__(
+        self,
+        mesh=None,
+        local_capacity: int = 1 << 17,
+        cache_size: Optional[int] = None,
+        global_namespaces: Sequence[str] = (),
+        global_region: int = 1024,
+        clock=time.time,
+    ):
+        """``local_capacity`` sizes each shard's table (8 bytes/counter of
+        HBM per shard); slots below ``global_region`` are reserved for
+        psum-replicated global counters. ``cache_size`` caps qualified
+        counters across the whole mesh."""
+        self._mesh = mesh if mesh is not None else make_mesh()
+        self._n = self._mesh.shape["shard"]
+        if global_region >= local_capacity:
+            raise ValueError("global_region must be < local_capacity")
+        self._lock = threading.RLock()
+        self._clock = clock
+        self._local_capacity = int(local_capacity)
+        self._global_region = int(global_region)
+        self._global_ns = set(global_namespaces)
+        total_local = self._n * (local_capacity - global_region)
+        self._cache_size = int(cache_size) if cache_size else total_local
+        self._per_shard_cache = max(self._cache_size // self._n, 1)
+        self._scratch = self._local_capacity  # padding slot (row L)
+        self._tables: List[_SlotTable] = []
+        self._gtable = _SlotTable(self._global_region)
+        self._rr = 0  # round-robin shard for global-counter deltas
+        self._reset_tables()
+        self._state = make_sharded_table(self._mesh, self._local_capacity)
+        self._epoch = clock()
+
+    def _reset_tables(self) -> None:
+        self._tables = []
+        for _ in range(self._n):
+            t = _SlotTable(self._local_capacity)
+            # Shard-local slots live in [global_region, local_capacity).
+            t.free = list(
+                range(self._local_capacity - 1, self._global_region - 1, -1)
+            )
+            self._tables.append(t)
+        self._gtable = _SlotTable(self._global_region)
+
+    # -- time ---------------------------------------------------------------
+
+    def _now_ms(self) -> int:
+        now = int((self._clock() - self._epoch) * 1000)
+        if now > (1 << 30):
+            shift = now - 1000
+            self._state = ShardedCounterState(
+                self._state.values,
+                K.rebase_epoch_chunked(self._state.expiry_ms, shift),
+            )
+            self._epoch += shift / 1000.0
+            now -= shift
+        return now
+
+    # -- slot routing -------------------------------------------------------
+
+    @staticmethod
+    def _key_of(counter: Counter) -> tuple:
+        return (counter.limit._identity, tuple(counter.set_variables.items()))
+
+    def _is_global(self, counter: Counter) -> bool:
+        return counter.namespace in self._global_ns
+
+    def _zero_global_slots(self, slots: List[int]) -> None:
+        """A recycled global slot must not inherit stale partials on any
+        shard (the kernel's psum base reads the whole global region, not
+        just table-reachable cells)."""
+        idx = np.asarray(slots, np.int32)
+        self._state = ShardedCounterState(
+            self._state.values.at[:, idx].set(0),
+            self._state.expiry_ms.at[:, idx].set(0),
+        )
+
+    def _evict_local(self, table: _SlotTable) -> None:
+        if not table.qualified:
+            raise StorageError("TPU shard table full (no evictable slots)")
+        key, slot = next(iter(table.qualified.items()))
+        table.release(slot, key, qualified=True)
+
+    def _evict_global(self) -> None:
+        if not self._gtable.qualified:
+            raise StorageError("TPU global region full (no evictable slots)")
+        key, slot = next(iter(self._gtable.qualified.items()))
+        self._gtable.release(slot, key, qualified=True)
+        self._zero_global_slots([slot])
+
+    def _slot_for(
+        self, counter: Counter, create: bool
+    ) -> Tuple[Optional[int], Optional[int], bool, bool]:
+        """Return (shard, slot, fresh, is_global). Global counters return
+        shard=None (the caller picks an application shard)."""
+        key = self._key_of(counter)
+        qualified = counter.is_qualified()
+        if self._is_global(counter):
+            slot = self._gtable.lookup(key, qualified)
+            if slot is not None:
+                return None, slot, False, True
+            if not create:
+                return None, None, False, True
+            if qualified and len(self._gtable.qualified) >= self._global_region:
+                self._evict_global()
+            if not self._gtable.free:
+                self._evict_global()
+            slot = self._gtable.free.pop()
+            if qualified:
+                self._gtable.qualified[key] = slot
+            else:
+                self._gtable.simple[key] = slot
+            self._gtable.info[slot] = (key, counter.key())
+            return None, slot, True, True
+        shard = _stable_hash(key) % self._n
+        table = self._tables[shard]
+        slot = table.lookup(key, qualified)
+        if slot is not None:
+            return shard, slot, False, False
+        if not create:
+            return shard, None, False, False
+        if qualified:
+            while len(table.qualified) >= self._per_shard_cache:
+                self._evict_local(table)
+        if not table.free:
+            self._evict_local(table)
+        slot = table.free.pop()
+        if qualified:
+            table.qualified[key] = slot
+        else:
+            table.simple[key] = slot
+        table.info[slot] = (key, counter.key())
+        return shard, slot, True, False
+
+    def _app_shard(self) -> int:
+        """Application shard for a global-counter delta (any shard works —
+        the read is psum); round-robin spreads partials."""
+        s = self._rr
+        self._rr = (self._rr + 1) % self._n
+        return s
+
+    # -- the shared batched check path --------------------------------------
+
+    def check_many(self, requests: List[_Request]) -> List[Authorization]:
+        """One shard_map launch deciding a batch of requests in list order
+        (same exactness contract as TpuStorage.check_many; cross-shard
+        requests couple via pmin)."""
+        import jax
+
+        n = self._n
+        with self._lock:
+            now_ms = self._now_ms()
+            # rows: (slot, delta, max, window_ms, req_id, fresh, is_global)
+            per_shard: List[
+                List[Tuple[int, int, int, int, int, bool, bool]]
+            ] = [[] for _ in range(n)]
+            # per request: hit locations [(shard, pos_in_shard)], in order
+            locs_by_req: List[List[Tuple[int, int]]] = []
+            fresh_by_req: List[List[Tuple[int, Counter, int, int, bool]]] = []
+            use_count: Dict[Tuple[int, int], int] = {}
+            for r, request in enumerate(requests):
+                delta = min(int(request.delta), K.MAX_DELTA_CAP)
+                locs: List[Tuple[int, int]] = []
+                fresh_hits: List[Tuple[int, Counter, int, int, bool]] = []
+                for j, c in enumerate(request.ordered):
+                    shard, slot, is_fresh, is_g = self._slot_for(
+                        c, create=True
+                    )
+                    if is_g:
+                        shard = self._app_shard()
+                    row = per_shard[shard]
+                    locs.append((shard, len(row)))
+                    row.append((
+                        slot,
+                        delta,
+                        min(c.max_value, K.MAX_VALUE_CAP),
+                        _clamp_window_ms(c.window_seconds),
+                        r,
+                        is_fresh,
+                        is_g,
+                    ))
+                    use = (1 if is_g else 0, slot if is_g else shard, slot)
+                    use_count[use] = use_count.get(use, 0) + 1
+                    if is_fresh:
+                        fresh_hits.append((j, c, shard, slot, is_g))
+                locs_by_req.append(locs)
+                fresh_by_req.append(fresh_hits)
+
+            H = _bucket(max(max(len(p) for p in per_shard), 1))
+            slots = np.full((n, H), self._scratch, np.int32)
+            deltas = np.zeros((n, H), np.int32)
+            maxes = np.full((n, H), _INT32_MAX, np.int32)
+            windows = np.zeros((n, H), np.int32)
+            req_ids = np.full((n, H), n * H - 1, np.int32)
+            fresh = np.zeros((n, H), bool)
+            is_global = np.zeros((n, H), bool)
+            for s in range(n):
+                rows = per_shard[s]
+                if not rows:
+                    continue
+                # One vectorized store per column (per-element numpy scalar
+                # stores dominate the host loop otherwise — same reasoning
+                # as the single-chip builder, storage.py check_many).
+                m = len(rows)
+                cols = list(zip(*rows))
+                slots[s, :m] = cols[0]
+                deltas[s, :m] = cols[1]
+                maxes[s, :m] = cols[2]
+                windows[s, :m] = cols[3]
+                req_ids[s, :m] = cols[4]
+                fresh[s, :m] = cols[5]
+                is_global[s, :m] = cols[6]
+
+            self._state, result = sharded_check_and_update(
+                self._mesh, self._state, slots, deltas, maxes, windows,
+                req_ids, fresh, is_global, np.int32(now_ms),
+                global_region=self._global_region,
+            )
+            admitted, hit_ok, remaining, ttl_ms = jax.device_get((
+                result.admitted, result.hit_ok, result.remaining,
+                result.ttl_ms,
+            ))
+
+            auths: List[Authorization] = []
+            for r, request in enumerate(requests):
+                locs = locs_by_req[r]
+                ok = bool(admitted[r]) if locs else True
+                if request.load:
+                    for (s, i), c in zip(locs, request.ordered):
+                        c.remaining = int(remaining[s, i])
+                        c.expires_in = float(ttl_ms[s, i]) / 1000.0
+                if ok:
+                    auths.append(Authorization.OK)
+                    continue
+                oks = [bool(hit_ok[s, i]) for s, i in locs]
+                first = oks.index(False) if False in oks else 0
+                auths.append(
+                    Authorization.limited_by(request.ordered[first].limit.name)
+                )
+                if not request.load:
+                    # Non-load early-return semantics (in_memory.rs:110-133):
+                    # drop qualified slots allocated past the first limited
+                    # hit, when no other hit in the batch shares them.
+                    for j, c, shard, slot, is_g in fresh_by_req[r]:
+                        use = (1 if is_g else 0, slot if is_g else shard, slot)
+                        if j > first and use_count.get(use) == 1:
+                            self._release(c, shard, slot, is_g)
+        return auths
+
+    def _release(self, counter: Counter, shard: int, slot: int, is_g: bool):
+        key = self._key_of(counter)
+        if is_g:
+            self._gtable.release(slot, key, counter.is_qualified())
+            self._zero_global_slots([slot])
+        else:
+            self._tables[shard].release(slot, key, counter.is_qualified())
+
+    # -- host reads ---------------------------------------------------------
+
+    def _read_value(
+        self, shard: Optional[int], slot: int, is_g: bool, now_ms: int
+    ) -> Tuple[int, int]:
+        """(live value, ttl_ms) — psum of live partials for global slots."""
+        if is_g:
+            vals = np.asarray(self._state.values[:, slot])
+            exps = np.asarray(self._state.expiry_ms[:, slot])
+            live = exps > now_ms
+            value = int(vals[live].sum())
+            ttl = int(exps.max() - now_ms) if live.any() else 0
+            return value, max(ttl, 0)
+        v = int(self._state.values[shard, slot])
+        e = int(self._state.expiry_ms[shard, slot])
+        if e <= now_ms:
+            return 0, 0
+        return v, e - now_ms
+
+    # -- CounterStorage ------------------------------------------------------
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        with self._lock:
+            now_ms = self._now_ms()
+            shard, slot, _f, is_g = self._slot_for(counter, create=False)
+            if slot is None:
+                value = 0
+            else:
+                value, _ttl = self._read_value(shard, slot, is_g, now_ms)
+        return value + delta <= counter.max_value
+
+    def add_counter(self, limit: Limit) -> None:
+        if not limit.variables:
+            with self._lock:
+                self._slot_for(Counter(limit, {}), create=True)
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        self.apply_deltas([(counter, delta)])
+
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        if not counters:
+            return Authorization.OK
+        return self.check_many([_Request(counters, delta, load_counters)])[0]
+
+    def apply_deltas(self, items):
+        """Unconditional batched increments (the Report/update path and the
+        write-behind authority role): one ``sharded_update`` launch — the
+        same saturating scatter-add as the single-chip authority — then two
+        batched gathers (one for shard-local slots, one for the global
+        region) for the authoritative values."""
+        with self._lock:
+            now_ms = self._now_ms()
+            # rows: (slot, delta, window_ms, fresh)
+            per_shard: List[List[Tuple[int, int, int, bool]]] = [
+                [] for _ in range(self._n)
+            ]
+            locs: List[Tuple[Optional[int], int, bool]] = []
+            for counter, delta in items:
+                shard, slot, is_fresh, is_g = self._slot_for(
+                    counter, create=True
+                )
+                app = self._app_shard() if is_g else shard
+                per_shard[app].append((
+                    slot,
+                    min(int(delta), K.MAX_DELTA_CAP),
+                    _clamp_window_ms(counter.window_seconds),
+                    is_fresh,
+                ))
+                locs.append((shard, slot, is_g))
+            n = self._n
+            H = _bucket(max(max(len(p) for p in per_shard), 1))
+            slots = np.full((n, H), self._scratch, np.int32)
+            deltas = np.zeros((n, H), np.int32)
+            windows = np.zeros((n, H), np.int32)
+            fresh = np.zeros((n, H), bool)
+            for s in range(n):
+                rows = per_shard[s]
+                if not rows:
+                    continue
+                m = len(rows)
+                cols = list(zip(*rows))
+                slots[s, :m] = cols[0]
+                deltas[s, :m] = cols[1]
+                windows[s, :m] = cols[2]
+                fresh[s, :m] = cols[3]
+            self._state = sharded_update(
+                self._mesh, self._state, slots, deltas, windows, fresh,
+                np.int32(now_ms),
+            )
+            # Batched authoritative reads: one gather per slot family.
+            lsh = np.asarray(
+                [s for s, _sl, g in locs if not g], np.int32
+            )
+            lsl = np.asarray(
+                [sl for _s, sl, g in locs if not g], np.int32
+            )
+            gsl = np.asarray(
+                sorted({sl for _s, sl, g in locs if g}), np.int32
+            )
+            lv = le = gv = ge = None
+            if lsh.size:
+                lv = np.asarray(self._state.values[lsh, lsl])
+                le = np.asarray(self._state.expiry_ms[lsh, lsl])
+            if gsl.size:
+                gv = np.asarray(self._state.values[:, gsl])
+                ge = np.asarray(self._state.expiry_ms[:, gsl])
+            gpos = {int(sl): i for i, sl in enumerate(gsl)}
+            out = []
+            li = 0
+            for shard, slot, is_g in locs:
+                if is_g:
+                    col = gpos[slot]
+                    live = ge[:, col] > now_ms
+                    value = int(gv[live, col].sum())
+                    ttl = (
+                        max(int(ge[:, col].max()) - now_ms, 0)
+                        if live.any() else 0
+                    )
+                else:
+                    value = int(lv[li]) if le[li] > now_ms else 0
+                    ttl = max(int(le[li]) - now_ms, 0)
+                    li += 1
+                out.append((value, ttl / 1000.0))
+        return out
+
+    def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
+        out: Set[Counter] = set()
+        with self._lock:
+            now_ms = self._now_ms()
+            namespaces = {limit.namespace for limit in limits}
+            values = np.asarray(self._state.values)
+            expiry = np.asarray(self._state.expiry_ms)
+
+            def emit(counter: Counter, shard, slot, is_g):
+                if is_g:
+                    exps = expiry[:, slot]
+                    live = exps > now_ms
+                    if not live.any():
+                        return
+                    value = int(values[live, slot].sum())
+                    ttl = int(exps.max()) - now_ms
+                else:
+                    ttl = int(expiry[shard, slot]) - now_ms
+                    if ttl <= 0:
+                        return
+                    value = int(values[shard, slot])
+                c = counter.key()
+                c.remaining = c.max_value - value
+                c.expires_in = ttl / 1000.0
+                out.add(c)
+
+            for slot, (_key, counter) in self._gtable.info.items():
+                if counter.limit in limits or counter.namespace in namespaces:
+                    emit(counter, None, slot, True)
+            for shard, table in enumerate(self._tables):
+                for slot, (_key, counter) in table.info.items():
+                    if (
+                        counter.limit in limits
+                        or counter.namespace in namespaces
+                    ):
+                        emit(counter, shard, slot, False)
+        return out
+
+    def delete_counters(self, limits: Set[Limit]) -> None:
+        with self._lock:
+            doomed_global: List[int] = []
+            for slot, (key, counter) in list(self._gtable.info.items()):
+                if counter.limit in limits:
+                    self._gtable.release(slot, key, counter.is_qualified())
+                    doomed_global.append(slot)
+            shard_idx: List[int] = []
+            slot_idx: List[int] = []
+            for shard, table in enumerate(self._tables):
+                for slot, (key, counter) in list(table.info.items()):
+                    if counter.limit in limits:
+                        table.release(slot, key, counter.is_qualified())
+                        shard_idx.append(shard)
+                        slot_idx.append(slot)
+            if doomed_global:
+                self._zero_global_slots(doomed_global)
+            if shard_idx:
+                si = np.asarray(shard_idx, np.int32)
+                li = np.asarray(slot_idx, np.int32)
+                self._state = ShardedCounterState(
+                    self._state.values.at[si, li].set(0),
+                    self._state.expiry_ms.at[si, li].set(0),
+                )
+
+    def clear(self) -> None:
+        with self._lock:
+            self._reset_tables()
+            self._state = make_sharded_table(
+                self._mesh, self._local_capacity
+            )
+
+    def close(self) -> None:
+        pass

@@ -132,7 +132,10 @@ class TpuStorage(CounterStorage):
         if now > (1 << 30):
             # Rebase before now_ms + WINDOW_MS_CAP could overflow int32.
             shift = now - 1000
-            self._state = K.rebase_epoch(self._state, np.int32(shift))
+            self._state = K.CounterTableState(
+                self._state.values,
+                K.rebase_epoch_chunked(self._state.expiry_ms, shift),
+            )
             self._epoch += shift / 1000.0
             now -= shift
         return now

@@ -74,11 +74,23 @@ def _distributed() -> TestsLimiter:
     return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
 
 
+def _sharded() -> TestsLimiter:
+    import jax
+
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    if len(jax.devices()) < 2:
+        raise ImportError("sharded backend needs a multi-device mesh")
+    storage = TpuShardedStorage(local_capacity=2048, global_region=64)
+    return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
+
+
 FACTORIES: Dict[str, Callable[[], TestsLimiter]] = {
     "memory": _memory,
     "tpu": _tpu,
     "disk": _disk,
     "distributed": _distributed,
+    "sharded": _sharded,
 }
 
 

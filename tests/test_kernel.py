@@ -58,3 +58,19 @@ def test_update_batch_carry_propagation_exact():
     state = K.make_table(8)
     state = _update(state, np.full(64, 4), deltas)
     assert np.asarray(state.values)[4] == int(deltas.sum())
+
+
+def test_epoch_rebase_survives_month_long_idle(fake_clock):
+    """Regression: a shift larger than int32 must rebase in chunks, not
+    raise OverflowError."""
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    storage = TpuStorage(capacity=64, clock=fake_clock)
+    limit = Limit("ns", 10, 60, [], ["u"])
+    c = Counter(limit, {"u": "a"})
+    storage.update_counter(c, 3)
+    fake_clock.advance(40 * 24 * 3600)  # 40 days > 2^31 ms
+    assert storage.is_within_limits(c, 10)  # window long expired
+    storage.update_counter(c, 1)  # and the table still works
