@@ -37,17 +37,16 @@ def zipf_keys(n_keys: int, n_samples: int, s: float, rng) -> np.ndarray:
     return np.searchsorted(cdf, u).astype(np.int32)
 
 
-def emit(metric: str, value: float, unit: str, baseline: float) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(value, 1),
-                "unit": unit,
-                "vs_baseline": round(value / baseline, 4),
-            }
-        )
-    )
+def emit(metric: str, value: float, unit: str, baseline: float,
+         **extra) -> None:
+    payload = {
+        "metric": metric,
+        "value": round(value, 1),
+        "unit": unit,
+        "vs_baseline": round(value / baseline, 4),
+    }
+    payload.update(extra)
+    print(json.dumps(payload))
 
 
 def bench_memory():
@@ -309,13 +308,170 @@ def bench_sharded():
     emit("sharded_decisions_per_sec", rate, "decisions/s", 1e7)
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
+                     batch_delay_us: int = 200):
+    """End-to-end gRPC latency evidence: a real server process, a real
+    socket, concurrent ShouldRateLimit — the closed-loop p50/p99 the 2ms
+    target is judged against (BASELINE.json). Returns
+    (rps, p50_ms, p99_ms, floor_p50_ms) where the floor is the same loop
+    against an empty-domain request (no storage touched): pure
+    gRPC+loop+socket overhead, isolating the device/tunnel share."""
+    import asyncio
+    import os
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    import grpc
+
+    from limitador_tpu.server.proto import rls_pb2
+
+    limits = tempfile.NamedTemporaryFile(
+        "w", suffix=".yaml", delete=False
+    )
+    limits.write(
+        "- namespace: api\n  max_value: 1000000000\n  seconds: 60\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+    limits.close()
+    rls_port, http_port = _free_port(), _free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "limitador_tpu.server", limits.name, "tpu",
+         "--pipeline", "native", "--rls-port", str(rls_port),
+         "--http-port", str(http_port),
+         "--batch-delay-us", str(batch_delay_us)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        for _ in range(240):
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"bench server exited early (rc={proc.returncode}) — "
+                    "device already held by this process?"
+                )
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/status", timeout=1
+                )
+                break
+            except Exception:
+                time.sleep(0.5)
+        else:
+            raise RuntimeError("bench server never came up")
+
+        async def drive():
+            channel = grpc.aio.insecure_channel(f"127.0.0.1:{rls_port}")
+            method = channel.unary_unary(
+                "/envoy.service.ratelimit.v3.RateLimitService"
+                "/ShouldRateLimit",
+                request_serializer=lambda m: m.SerializeToString(),
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+
+            def make_req(domain, user):
+                req = rls_pb2.RateLimitRequest(domain=domain)
+                d = req.descriptors.add()
+                e = d.entries.add()
+                e.key = "u"
+                e.value = user
+                return req
+
+            reqs = [make_req("api", f"user-{i}") for i in range(512)]
+            floor_req = make_req("", "x")  # empty domain: no storage
+
+            async def worker(n, req_of, out):
+                for i in range(n):
+                    t0 = time.perf_counter()
+                    await method(req_of(i))
+                    out.append(time.perf_counter() - t0)
+
+            # Warmup: compiles kernel buckets, fills the slot table.
+            warm = []
+            await asyncio.gather(*[
+                worker(30, lambda i, w=w: reqs[(w * 31 + i) % 512], warm)
+                for w in range(concurrency)
+            ])
+            lat: list = []
+            t0 = time.perf_counter()
+            await asyncio.gather(*[
+                worker(
+                    per_worker,
+                    lambda i, w=w: reqs[(w * per_worker + i) % 512],
+                    lat,
+                )
+                for w in range(concurrency)
+            ])
+            wall = time.perf_counter() - t0
+            floor: list = []
+            await asyncio.gather(*[
+                worker(50, lambda i: floor_req, floor)
+                for w in range(min(concurrency, 16))
+            ])
+            await channel.close()
+            return lat, wall, floor
+
+        lat, wall, floor = asyncio.new_event_loop().run_until_complete(
+            drive()
+        )
+        lat_ms = np.asarray(lat) * 1e3
+        floor_ms = np.asarray(floor) * 1e3
+        rps = len(lat) / wall
+        return (
+            rps,
+            float(np.percentile(lat_ms, 50)),
+            float(np.percentile(lat_ms, 99)),
+            float(np.percentile(floor_ms, 50)),
+        )
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        os.unlink(limits.name)
+
+
+def bench_grpc():
+    """Closed-loop gRPC ShouldRateLimit over a real socket: p99 vs the 2ms
+    BASELINE target (value = p99_ms, vs_baseline = 2.0 / p99 so >= 1.0
+    beats the target)."""
+    rps, p50, p99, floor_p50 = grpc_closed_loop()
+    print(
+        f"grpc closed-loop: {rps/1e3:.1f}k req/s, p50 {p50:.2f}ms "
+        f"p99 {p99:.2f}ms | no-storage floor p50 {floor_p50:.2f}ms "
+        "(gRPC+loop overhead; the device share under axon includes the "
+        "remote-chip tunnel RTT)",
+        file=sys.stderr,
+    )
+    payload = {
+        "metric": "grpc_should_rate_limit_p99_ms",
+        "value": round(p99, 3),
+        "unit": "ms",
+        "vs_baseline": round(2.0 / p99, 4) if p99 > 0 else 0.0,
+        "rps": round(rps, 1),
+        "p50_ms": round(p50, 3),
+        "floor_p50_ms": round(floor_p50, 3),
+    }
+    print(json.dumps(payload))
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument(
         "--config",
         default="device",
         choices=["device", "memory", "pipeline", "native", "tenants",
-                 "sharded", "backends"],
+                 "sharded", "backends", "grpc"],
     )
     args = parser.parse_args()
 
@@ -329,6 +485,33 @@ def main():
         return bench_native()
     if args.config == "sharded":
         return bench_sharded()
+    if args.config == "grpc":
+        return bench_grpc()
+
+    # End-to-end gRPC latency evidence rides along with the headline run.
+    # It runs FIRST — before this process initializes jax — because the
+    # server subprocess needs the device and some TPU runtimes are
+    # single-process-exclusive.
+    extra = {}
+    try:
+        rps, p50, p99, floor_p50 = grpc_closed_loop(
+            concurrency=64, per_worker=120
+        )
+        print(
+            f"grpc closed-loop: {rps/1e3:.1f}k req/s, p50 {p50:.2f}ms "
+            f"p99 {p99:.2f}ms | no-storage floor p50 {floor_p50:.2f}ms "
+            "(the floor is gRPC+loop overhead; under axon the device share "
+            "includes the remote-chip tunnel RTT)",
+            file=sys.stderr,
+        )
+        extra = {
+            "grpc_rps": round(rps, 1),
+            "grpc_p50_ms": round(p50, 3),
+            "grpc_p99_ms": round(p99, 3),
+            "grpc_floor_p50_ms": round(floor_p50, 3),
+        }
+    except Exception as exc:
+        print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
 
     import jax
 
@@ -428,6 +611,7 @@ def main():
         decisions_per_sec,
         "decisions/s",
         1e7,
+        **extra,
     )
 
 

@@ -9,6 +9,23 @@ Batcher (/root/reference/limitador/src/storage/redis/counters_cache.rs:183-238)
 — except here the flush IS the decision, not an async reconciliation, so
 admission stays exact.
 
+Two properties keep the event loop responsive and the device busy:
+
+- **Off-loop dispatch**: every device interaction runs on dedicated
+  executor threads; the asyncio loop only builds batches and resolves
+  futures (the reference's tonic path is fully async the same way,
+  envoy_rls/server.rs:238-272).
+- **Double buffering**: when the storage exposes the
+  ``begin_check_many``/``finish_check_many`` split (TpuStorage does),
+  batch N+1 is assembled and its kernel launched while batch N's
+  device->host transfer is still in flight; up to ``max_inflight``
+  transfers overlap.
+
+``UpdateBatcher`` gives the unconditional Report/update path the same
+treatment: concurrent ``update_counter`` calls coalesce per counter into
+one vectorized ``apply_deltas`` launch instead of a device round trip per
+call (counters_cache.rs:143-247 is the reference blueprint).
+
 Within a batch, requests keep their enqueue order and the kernel decides
 admission exactly as if they were processed serially; all hit-building and
 result-decoding semantics live in ``TpuStorage.check_many`` — the batcher
@@ -18,14 +35,15 @@ only owns the coalescing.
 from __future__ import annotations
 
 import asyncio
-from typing import List, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
 from ..storage.base import AsyncCounterStorage, Authorization
 from .storage import TpuStorage, _Request
 
-__all__ = ["MicroBatcher", "AsyncTpuStorage"]
+__all__ = ["MicroBatcher", "UpdateBatcher", "AsyncTpuStorage"]
 
 
 class MicroBatcher:
@@ -34,15 +52,26 @@ class MicroBatcher:
         storage: TpuStorage,
         max_batch_hits: int = 8192,
         max_delay: float = 0.0005,
+        max_inflight: int = 2,
     ):
         self.storage = storage
         self.max_batch_hits = max_batch_hits
         self.max_delay = max_delay
+        self.max_inflight = max_inflight
         self._pending: List[tuple] = []  # (_Request, Future)
         self._pending_hits = 0
         self._wakeup: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
+        # Dispatch thread: serializes begin_check_many in batch order.
+        # Collect threads: device->host transfers, may overlap.
+        self._dispatch_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="tpu-dispatch"
+        )
+        self._collect_pool = ThreadPoolExecutor(
+            max_inflight, thread_name_prefix="tpu-collect"
+        )
+        self._finishers: set = set()
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -61,7 +90,35 @@ class MicroBatcher:
         self._wakeup.set()
         return await future
 
+    @staticmethod
+    def _fail(batch, exc) -> None:
+        for _r, future in batch:
+            if not future.done():
+                future.set_exception(exc)
+
+    @staticmethod
+    def _resolve(batch, auths) -> None:
+        for (_r, future), auth in zip(batch, auths):
+            if not future.done():
+                future.set_result(auth)
+
+    async def _finish_inflight(self, batch, handle, finish, sem, loop):
+        try:
+            auths = await loop.run_in_executor(
+                self._collect_pool, finish, handle
+            )
+            self._resolve(batch, auths)
+        except Exception as exc:
+            self._fail(batch, exc)
+        finally:
+            sem.release()
+
     async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        begin = getattr(self.storage, "begin_check_many", None)
+        finish = getattr(self.storage, "finish_check_many", None)
+        pipelined = begin is not None and finish is not None
+        sem = asyncio.Semaphore(self.max_inflight)
         while not self._closed:
             while not self._pending:
                 self._wakeup.clear()
@@ -78,15 +135,30 @@ class MicroBatcher:
             batch = self._pending
             self._pending = []
             self._pending_hits = 0
-            try:
-                auths = self.storage.check_many([r for r, _f in batch])
-                for (_r, future), auth in zip(batch, auths):
-                    if not future.done():
-                        future.set_result(auth)
-            except Exception as exc:  # propagate to every waiter
-                for _r, future in batch:
-                    if not future.done():
-                        future.set_exception(exc)
+            requests = [r for r, _f in batch]
+            if pipelined:
+                await sem.acquire()
+                try:
+                    handle = await loop.run_in_executor(
+                        self._dispatch_pool, begin, requests
+                    )
+                except Exception as exc:
+                    sem.release()
+                    self._fail(batch, exc)
+                    continue
+                t = loop.create_task(
+                    self._finish_inflight(batch, handle, finish, sem, loop)
+                )
+                self._finishers.add(t)
+                t.add_done_callback(self._finishers.discard)
+            else:
+                try:
+                    auths = await loop.run_in_executor(
+                        self._dispatch_pool, self.storage.check_many, requests
+                    )
+                    self._resolve(batch, auths)
+                except Exception as exc:
+                    self._fail(batch, exc)
 
     async def close(self) -> None:
         self._closed = True
@@ -97,11 +169,125 @@ class MicroBatcher:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        if self._finishers:
+            await asyncio.gather(*list(self._finishers), return_exceptions=True)
+        # Requests that slipped in while the last flush was off-loop would
+        # otherwise await forever: decide them in one final batch.
+        if self._pending:
+            batch, self._pending = self._pending, []
+            self._pending_hits = 0
+            try:
+                self._resolve(
+                    batch, self.storage.check_many([r for r, _f in batch])
+                )
+            except Exception as exc:
+                self._fail(batch, exc)
+        self._dispatch_pool.shutdown(wait=False)
+        self._collect_pool.shutdown(wait=False)
+
+
+class UpdateBatcher:
+    """Coalesces unconditional increments (the Kuadrant Report path /
+    ``update_counter``) into vectorized ``apply_deltas`` launches: deltas
+    sum per counter identity, one device call per flush instead of one per
+    request."""
+
+    def __init__(
+        self,
+        storage,
+        max_batch: int = 4096,
+        max_delay: float = 0.0005,
+    ):
+        self.storage = storage
+        self.max_batch = max_batch
+        self.max_delay = max_delay
+        self._pending: Dict[Counter, int] = {}
+        self._waiters: List[asyncio.Future] = []
+        self._wakeup: Optional[asyncio.Event] = None
+        self._task: Optional[asyncio.Task] = None
+        self._closed = False
+        self._pool = ThreadPoolExecutor(1, thread_name_prefix="tpu-update")
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._wakeup = asyncio.Event()
+            self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def submit(self, counter: Counter, delta: int) -> None:
+        self._ensure_started()
+        future = asyncio.get_running_loop().create_future()
+        self._pending[counter] = self._pending.get(counter, 0) + int(delta)
+        self._waiters.append(future)
+        self._wakeup.set()
+        await future
+
+    def _apply(self, items: List[Tuple[Counter, int]]) -> None:
+        apply = getattr(self.storage, "apply_deltas", None)
+        if apply is not None:
+            apply(items)
+            return
+        for counter, delta in items:
+            self.storage.update_counter(counter, delta)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while not self._closed:
+            while not self._pending:
+                self._wakeup.clear()
+                if self._closed:
+                    return
+                try:
+                    await asyncio.wait_for(self._wakeup.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    if self._closed:
+                        return
+            if len(self._pending) < self.max_batch:
+                await asyncio.sleep(self.max_delay)
+            items = list(self._pending.items())
+            waiters = self._waiters
+            self._pending = {}
+            self._waiters = []
+            try:
+                await loop.run_in_executor(self._pool, self._apply, items)
+            except Exception as exc:
+                for future in waiters:
+                    if not future.done():
+                        future.set_exception(exc)
+            else:
+                for future in waiters:
+                    if not future.done():
+                        future.set_result(None)
+
+    async def close(self) -> None:
+        self._closed = True
+        if self._wakeup is not None:
+            self._wakeup.set()
+        if self._task is not None:
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self._pending:
+            items = list(self._pending.items())
+            waiters, self._waiters = self._waiters, []
+            self._pending = {}
+            try:
+                self._apply(items)
+            except Exception as exc:
+                for future in waiters:
+                    if not future.done():
+                        future.set_exception(exc)
+            else:
+                for future in waiters:
+                    if not future.done():
+                        future.set_result(None)
+        self._pool.shutdown(wait=False)
 
 
 class AsyncTpuStorage(AsyncCounterStorage):
     """AsyncCounterStorage over TpuStorage + MicroBatcher: the hot
-    check_and_update path batches; admin operations delegate inline."""
+    check_and_update path batches, the Report/update path batches through
+    ``UpdateBatcher``; admin operations delegate inline."""
 
     def __init__(
         self,
@@ -112,6 +298,7 @@ class AsyncTpuStorage(AsyncCounterStorage):
     ):
         self.inner = storage or TpuStorage(**kwargs)
         self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
+        self.update_batcher = UpdateBatcher(self.inner, max_delay=max_delay)
 
     async def check_and_update(
         self, counters: List[Counter], delta: int, load_counters: bool
@@ -133,7 +320,7 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.inner.add_counter(limit)
 
     async def update_counter(self, counter: Counter, delta: int) -> None:
-        self.inner.update_counter(counter, delta)
+        await self.update_batcher.submit(counter, delta)
 
     async def get_counters(self, limits) -> set:
         return self.inner.get_counters(limits)
@@ -146,3 +333,4 @@ class AsyncTpuStorage(AsyncCounterStorage):
 
     async def close(self) -> None:
         await self.batcher.close()
+        await self.update_batcher.close()

@@ -152,6 +152,18 @@ class TpuReplicatedStorage(TpuStorage):
             if slot is not None:
                 self._touched.add(slot)
 
+    def apply_deltas(self, items):
+        # The batched Report path (UpdateBatcher) and write-behind
+        # authorities land here; like update_counter, these increments
+        # bypass _kernel_check and must still gossip.
+        out = super().apply_deltas(items)
+        with self._lock:
+            for counter, _delta in items:
+                slot, _ = self._slot_for(counter, create=False)
+                if slot is not None:
+                    self._touched.add(slot)
+        return out
+
     def _now_ms(self) -> int:
         # The parent rebases the local table's epoch on long uptimes; the
         # remote arrays share that epoch and must shift identically.

@@ -107,6 +107,28 @@ class _Request:
         self.load = load
 
 
+class _CheckHandle:
+    """In-flight batch: kernel launched, results not yet transferred.
+    Produced by ``begin_check_many``, consumed by ``finish_check_many`` —
+    the split lets the batcher dispatch batch N+1 while N's device->host
+    transfer is still in flight (double buffering)."""
+
+    __slots__ = ("requests", "fresh_hits_by_req", "slot_use_count",
+                 "result", "seq", "watch_touches")
+
+    def __init__(self, requests, fresh_hits_by_req, slot_use_count, result,
+                 seq, watch_touches):
+        self.requests = requests
+        self.fresh_hits_by_req = fresh_hits_by_req
+        self.slot_use_count = slot_use_count
+        self.result = result
+        self.seq = seq
+        # Every slot whose _watched_slots entry this batch wrote; the
+        # finish pass deletes the ones still carrying this batch's seq so
+        # the watch map stays bounded by in-flight work.
+        self.watch_touches = watch_touches
+
+
 class TpuStorage(CounterStorage):
     def __init__(
         self,
@@ -124,6 +146,10 @@ class TpuStorage(CounterStorage):
         self._state = K.make_table(self._capacity)
         self._epoch = clock()  # device time 0 in host seconds
         self._scratch = self._capacity  # padding slot
+        # Pipelining bookkeeping: batch sequence number + last-touch seq of
+        # slots watched for deferred release (see finish_check_many).
+        self._seq = 0
+        self._watched_slots: Dict[int, int] = {}
 
     # -- time --------------------------------------------------------------
 
@@ -187,16 +213,11 @@ class TpuStorage(CounterStorage):
             self._state, slots, deltas, maxes, windows, req, fresh, now_ms
         )
 
-    def check_many(self, requests: List[_Request]) -> List[Authorization]:
-        """Run a batch of check-all-then-update-all requests in one kernel
-        launch, in list order (== serial order for exactness). Applies
-        load_counters side effects and the reference's non-load
-        early-return semantics (a limited non-load request does not create
-        qualified counters past its first limited hit, in_memory.rs:110-133
-        — only safe to undo when no other request in the batch shares the
-        freshly-allocated slot)."""
-        import jax
-
+    def begin_check_many(self, requests: List[_Request]) -> _CheckHandle:
+        """Build hit arrays and launch the kernel WITHOUT waiting for the
+        device->host transfer. Table mutations are serialized under the
+        lock in call order, which is also device program order, so batch
+        N+1 may begin while N's results are still in flight."""
         nhits = sum(len(r.ordered) for r in requests)
         H = _bucket(max(nhits, 1))
         # Build as Python lists (then one vectorized pad+convert): per-element
@@ -210,7 +231,11 @@ class TpuStorage(CounterStorage):
 
         with self._lock:
             now_ms = self._now_ms()
+            self._seq += 1
+            seq = self._seq
+            watched = self._watched_slots
             fresh_hits_by_req: List[List[Tuple[int, Counter, int]]] = []
+            watch_touches: List[int] = []
             slot_use_count: Dict[int, int] = {}
             slot_for = self._slot_for
             for r, request in enumerate(requests):
@@ -227,6 +252,13 @@ class TpuStorage(CounterStorage):
                     slot_use_count[slot] = slot_use_count.get(slot, 0) + 1
                     if is_fresh:
                         fresh_hits.append((j, c, slot))
+                        watch_touches.append(slot)
+                        watched[slot] = seq
+                    elif slot in watched:
+                        # A later batch re-used a slot an earlier in-flight
+                        # batch may want to release: the re-use wins.
+                        watched[slot] = seq
+                        watch_touches.append(slot)
                 fresh_hits_by_req.append(fresh_hits)
 
             pad = H - nhits
@@ -241,38 +273,77 @@ class TpuStorage(CounterStorage):
             self._state, result = self._kernel_check(
                 slots, deltas, maxes, windows, req, fresh, np.int32(now_ms)
             )
-            # One transfer for all three outputs (matters over remote links).
-            hit_ok, remaining, ttl_ms = jax.device_get(
-                (result.hit_ok, result.remaining, result.ttl_ms)
-            )
+        return _CheckHandle(
+            requests, fresh_hits_by_req, slot_use_count, result, seq,
+            watch_touches,
+        )
 
-            auths: List[Authorization] = []
-            base = 0
-            for r, request in enumerate(requests):
-                n = len(request.ordered)
-                oks = hit_ok[base : base + n]
-                all_ok = bool(np.all(oks))
-                if request.load:
-                    for j, c in enumerate(request.ordered):
-                        c.remaining = int(remaining[base + j])
-                        c.expires_in = float(ttl_ms[base + j]) / 1000.0
-                if all_ok:
-                    auths.append(Authorization.OK)
-                else:
-                    first = int(np.argmin(oks))
-                    auths.append(
-                        Authorization.limited_by(
-                            request.ordered[first].limit.name
-                        )
+    def finish_check_many(self, handle: _CheckHandle) -> List[Authorization]:
+        """Transfer and decode one in-flight batch: load_counters side
+        effects, first-limited naming, and the reference's non-load
+        early-return semantics (a limited non-load request does not create
+        qualified counters past its first limited hit, in_memory.rs:110-133
+        — only safe to undo when no other request in the batch shares the
+        freshly-allocated slot and no later batch has re-used it)."""
+        import jax
+
+        result = handle.result
+        # One transfer for all three outputs (matters over remote links).
+        hit_ok, remaining, ttl_ms = jax.device_get(
+            (result.hit_ok, result.remaining, result.ttl_ms)
+        )
+
+        auths: List[Authorization] = []
+        releases: List[Tuple[Counter, int]] = []
+        base = 0
+        for r, request in enumerate(handle.requests):
+            n = len(request.ordered)
+            oks = hit_ok[base : base + n]
+            all_ok = bool(np.all(oks))
+            if request.load:
+                for j, c in enumerate(request.ordered):
+                    c.remaining = int(remaining[base + j])
+                    c.expires_in = float(ttl_ms[base + j]) / 1000.0
+            if all_ok:
+                auths.append(Authorization.OK)
+            else:
+                first = int(np.argmin(oks))
+                auths.append(
+                    Authorization.limited_by(
+                        request.ordered[first].limit.name
                     )
-                    if not request.load:
-                        for j, c, slot in fresh_hits_by_req[r]:
-                            if j > first and slot_use_count.get(slot) == 1:
-                                self._table.release(
-                                    slot, self._key_of(c), c.is_qualified()
-                                )
-                base += n
+                )
+                if not request.load:
+                    for j, c, slot in handle.fresh_hits_by_req[r]:
+                        if j > first and handle.slot_use_count.get(slot) == 1:
+                            releases.append((c, slot))
+            base += n
+        with self._lock:
+            watched = self._watched_slots
+            for c, slot in releases:
+                if watched.get(slot) != handle.seq:
+                    continue
+                # The table must still map this key to this slot — an
+                # intervening delete/evict/clear means the slot was already
+                # freed (releasing again would double-free it).
+                key = self._key_of(c)
+                qualified = c.is_qualified()
+                mapped = (
+                    self._table.qualified.get(key) == slot
+                    if qualified
+                    else self._table.simple.get(key) == slot
+                )
+                if mapped:
+                    self._table.release(slot, key, qualified)
+            for slot in handle.watch_touches:
+                if watched.get(slot) == handle.seq:
+                    del watched[slot]
         return auths
+
+    def check_many(self, requests: List[_Request]) -> List[Authorization]:
+        """Run a batch of check-all-then-update-all requests in one kernel
+        launch, in list order (== serial order for exactness)."""
+        return self.finish_check_many(self.begin_check_many(requests))
 
     # -- CounterStorage ----------------------------------------------------
 
@@ -398,6 +469,7 @@ class TpuStorage(CounterStorage):
         with self._lock:
             self._table = _SlotTable(self._capacity)
             self._state = K.make_table(self._capacity)
+            self._watched_slots.clear()
 
     def apply_deltas(self, items):
         """Authority-side batch apply for write-behind caches: one

@@ -124,3 +124,66 @@ def test_batcher_exception_propagates():
 
     results = run(main())
     assert all(isinstance(r, Exception) for r in results)
+
+
+def test_update_batcher_coalesces_report_path():
+    """Concurrent update_counter calls land as ONE vectorized apply_deltas
+    per flush (the Report path must not do per-call device round trips)."""
+
+    class CountingStorage(TpuStorage):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.apply_calls = 0
+
+        def apply_deltas(self, items):
+            self.apply_calls += 1
+            return super().apply_deltas(items)
+
+    async def main():
+        inner = CountingStorage(capacity=1 << 10)
+        storage = AsyncTpuStorage(inner, max_delay=0.005)
+        limiter = AsyncRateLimiter(storage)
+        limit = Limit("ns", 1000, 60, [], ["u"])
+        limiter.add_limit(limit)
+        ctx_a, ctx_b = Context({"u": "a"}), Context({"u": "b"})
+        await asyncio.gather(*(
+            [limiter.update_counters("ns", ctx_a, 2) for _ in range(50)]
+            + [limiter.update_counters("ns", ctx_b, 1) for _ in range(30)]
+        ))
+        counts = {
+            c.set_variables["u"]: 1000 - c.remaining
+            for c in await limiter.get_counters("ns")
+        }
+        calls = inner.apply_calls
+        await storage.close()
+        return counts, calls
+
+    counts, calls = run(main())
+    assert counts == {"a": 100, "b": 30}
+    assert calls <= 5  # 80 updates coalesced into a handful of launches
+
+
+def test_pipelined_batches_stay_exact_under_backpressure():
+    """Many small overlapping batches (double-buffered dispatch) must still
+    admit exactly max in total."""
+
+    async def main():
+        storage = AsyncTpuStorage(
+            TpuStorage(capacity=1 << 10), max_delay=0.0001
+        )
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 40, 60, [], ["u"]))
+        admitted = 0
+        # Sequential waves -> consecutive batches overlap in the pipeline.
+        for _wave in range(20):
+            results = await asyncio.gather(*[
+                limiter.check_rate_limited_and_update(
+                    "ns", Context({"u": "p"}), 1
+                )
+                for _ in range(10)
+            ])
+            admitted += sum(1 for r in results if not r.limited)
+        await storage.close()
+        return admitted
+
+    assert run(main()) == 40

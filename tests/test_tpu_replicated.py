@@ -205,3 +205,21 @@ def test_remote_actor_pruning():
         assert len(storage._remote_actors) == 0
     finally:
         storage.close()
+
+
+def test_apply_deltas_marks_slots_for_gossip():
+    """Regression: the batched Report path (UpdateBatcher -> apply_deltas)
+    must queue its slots for gossip exactly like update_counter does."""
+    from limitador_tpu.core.counter import Counter
+
+    storage = TpuReplicatedStorage("n1", capacity=256)
+    try:
+        limit = Limit("ns", 100, 60, [], ["u"])
+        c1, c2 = Counter(limit, {"u": "a"}), Counter(limit, {"u": "b"})
+        storage.apply_deltas([(c1, 2), (c2, 5)])
+        slots = {
+            storage._slot_for(c, create=False)[0] for c in (c1, c2)
+        }
+        assert slots <= storage._touched and len(slots) == 2
+    finally:
+        storage.close()
