@@ -488,30 +488,31 @@ def main():
     if args.config == "grpc":
         return bench_grpc()
 
-    # End-to-end gRPC latency evidence rides along with the headline run.
-    # It runs FIRST — before this process initializes jax — because the
-    # server subprocess needs the device and some TPU runtimes are
-    # single-process-exclusive.
+    # End-to-end gRPC latency evidence rides along with the headline
+    # (device) run only. It runs FIRST — before this process initializes
+    # jax — because the server subprocess needs the device and some TPU
+    # runtimes are single-process-exclusive.
     extra = {}
-    try:
-        rps, p50, p99, floor_p50 = grpc_closed_loop(
-            concurrency=64, per_worker=120
-        )
-        print(
-            f"grpc closed-loop: {rps/1e3:.1f}k req/s, p50 {p50:.2f}ms "
-            f"p99 {p99:.2f}ms | no-storage floor p50 {floor_p50:.2f}ms "
-            "(the floor is gRPC+loop overhead; under axon the device share "
-            "includes the remote-chip tunnel RTT)",
-            file=sys.stderr,
-        )
-        extra = {
-            "grpc_rps": round(rps, 1),
-            "grpc_p50_ms": round(p50, 3),
-            "grpc_p99_ms": round(p99, 3),
-            "grpc_floor_p50_ms": round(floor_p50, 3),
-        }
-    except Exception as exc:
-        print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
+    if args.config == "device":
+        try:
+            rps, p50, p99, floor_p50 = grpc_closed_loop(
+                concurrency=64, per_worker=120
+            )
+            print(
+                f"grpc closed-loop: {rps/1e3:.1f}k req/s, p50 {p50:.2f}ms "
+                f"p99 {p99:.2f}ms | no-storage floor p50 {floor_p50:.2f}ms "
+                "(the floor is gRPC+loop overhead; under axon the device "
+                "share includes the remote-chip tunnel RTT)",
+                file=sys.stderr,
+            )
+            extra = {
+                "grpc_rps": round(rps, 1),
+                "grpc_p50_ms": round(p50, 3),
+                "grpc_p99_ms": round(p99, 3),
+                "grpc_floor_p50_ms": round(floor_p50, 3),
+            }
+        except Exception as exc:
+            print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
 
     import jax
 

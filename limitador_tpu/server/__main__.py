@@ -134,6 +134,18 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(_env("GLOBAL_REGION", "1024")),
         help="sharded: per-shard slots reserved for global counters",
     )
+    p.add_argument(
+        "--authority-listen", default=_env("AUTHORITY_LISTEN"),
+        help="serve this process's counter storage as a shared authority "
+        "for remote write-behind replicas (the out-of-process Redis role), "
+        "e.g. 0.0.0.0:5101",
+    )
+    p.add_argument(
+        "--authority-url", default=_env("AUTHORITY_URL"),
+        help="cached: flush write-behind deltas to a remote authority "
+        "(host:port of another server's --authority-listen) instead of a "
+        "local disk store",
+    )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
         "--snapshot-path", default=_env("TPU_SNAPSHOT_PATH"),
@@ -160,6 +172,12 @@ def build_parser() -> argparse.ArgumentParser:
 
 def build_limiter(args):
     """Limiter::new equivalent (main.rs:93-185): pick + build the backend."""
+    if args.authority_url and args.storage != "cached":
+        raise SystemExit(
+            f"--authority-url only applies to the 'cached' storage "
+            f"(got {args.storage!r}); run the replica as: "
+            "... cached --authority-url HOST:PORT"
+        )
     if args.storage == "memory":
         from ..storage.in_memory import DEFAULT_CACHE_SIZE, InMemoryStorage
 
@@ -257,10 +275,16 @@ def build_limiter(args):
         return RateLimiter(DiskStorage(path))
     if args.storage == "cached":
         from ..storage.cached import CachedCounterStorage
-        from ..storage.disk import DiskStorage
 
-        path = args.disk_path or "limitador_counters.db"
-        return AsyncRateLimiter(CachedCounterStorage(DiskStorage(path)))
+        if args.authority_url:
+            from ..storage.authority import RemoteAuthority
+
+            authority = RemoteAuthority(args.authority_url)
+        else:
+            from ..storage.disk import DiskStorage
+
+            authority = DiskStorage(args.disk_path or "limitador_counters.db")
+        return AsyncRateLimiter(CachedCounterStorage(authority))
     if args.storage == "distributed":
         try:
             from ..storage.distributed import CrInMemoryStorage
@@ -353,6 +377,26 @@ async def _amain(args) -> int:
                 file=sys.stderr,
             )
 
+    authority_server = None
+    if args.authority_listen:
+        from ..storage.authority import serve_authority
+
+        sync_storage = limiter.storage.counters
+        inner = getattr(sync_storage, "inner", None)
+        if inner is not None:
+            sync_storage = inner  # AsyncTpuStorage -> the device table
+        if not hasattr(sync_storage, "apply_deltas"):
+            raise SystemExit(
+                f"--authority-listen: storage {args.storage!r} cannot act "
+                "as a shared authority (no apply_deltas)"
+            )
+        authority_server = serve_authority(sync_storage, args.authority_listen)
+        print(
+            f"limitador-tpu: shared authority on {args.authority_listen} "
+            f"(port {authority_server.port})",
+            file=sys.stderr,
+        )
+
     rls_server = await serve_rls(
         limiter,
         f"{args.rls_host}:{args.rls_port}",
@@ -428,6 +472,8 @@ async def _amain(args) -> int:
 
     if watcher:
         watcher.stop()
+    if authority_server is not None:
+        authority_server.stop()
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
     if isinstance(limiter, AsyncRateLimiter):

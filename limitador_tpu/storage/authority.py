@@ -1,0 +1,282 @@
+"""Network shared-authority protocol — the out-of-process Redis role.
+
+The reference's topologies 2/3 let N limitador replicas share one counter
+authority over the network (doc/topologies.md; the Redis transport:
+redis_async.rs:67-147, Lua batch apply scripts.rs:28-45). Here the
+authority is any of our own storages exposing ``apply_deltas`` — the TPU
+table, the in-memory oracle, the disk store — served over a tiny gRPC
+surface, so the write-behind ``CachedCounterStorage`` deploys across
+processes:
+
+    replica A ─┐
+    replica B ─┼─ gRPC ApplyDeltas ──> authority process (TPU/memory/disk)
+    replica C ─┘
+
+Wire format: msgpack payloads over raw-bytes unary gRPC methods (no
+protoc codegen needed; grpc_python_plugin is not available in this
+image). Each item is self-contained — full limit identity + variables +
+delta — exactly as Redis carries TTLs inline, so the authority needs no
+shared limits registry. Transient network failures surface as
+``StorageError(transient=True)``, driving the cached storage's
+partition-revert machinery (redis_cached.rs:363-388).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import msgpack
+
+from ..core.counter import Counter
+from ..core.limit import Limit
+from .base import CounterStorage, StorageError
+
+__all__ = ["RemoteAuthority", "AuthorityServer", "serve_authority"]
+
+logger = logging.getLogger(__name__)
+
+_SERVICE = "limitador.authority.v1.Authority"
+
+_TRANSIENT_CODES = None  # populated lazily (grpc import deferred)
+
+
+def _limit_to_wire(limit: Limit) -> list:
+    return [
+        str(limit.namespace),
+        limit.max_value,
+        limit.seconds,
+        sorted(c.source for c in limit.conditions),
+        sorted(v.source for v in limit.variables),
+        limit.name,
+        limit.id,
+    ]
+
+
+def _limit_from_wire(data: list) -> Limit:
+    namespace, max_value, seconds, conditions, variables, name, id_ = data
+    return Limit(
+        namespace, max_value, seconds, conditions, variables,
+        name=name, id=id_,
+    )
+
+
+def _raw(x: bytes) -> bytes:
+    return x
+
+
+class RemoteAuthority(CounterStorage):
+    """Client-side authority: a CounterStorage whose ``apply_deltas`` /
+    ``delete_counters`` / ``clear`` execute on a remote authority server.
+    Used as the ``authority`` of a CachedCounterStorage; called from the
+    flush executor thread, so the channel is synchronous."""
+
+    def __init__(self, target: str, timeout: float = 0.35):
+        # 350ms: the reference's Redis response timeout (redis/mod.rs:13).
+        import grpc
+
+        self._grpc = grpc
+        self.target = target
+        self.timeout = timeout
+        self._channel = grpc.insecure_channel(target)
+        self._apply = self._channel.unary_unary(
+            f"/{_SERVICE}/ApplyDeltas",
+            request_serializer=_raw,
+            response_deserializer=_raw,
+        )
+        self._delete = self._channel.unary_unary(
+            f"/{_SERVICE}/DeleteCounters",
+            request_serializer=_raw,
+            response_deserializer=_raw,
+        )
+        self._clear = self._channel.unary_unary(
+            f"/{_SERVICE}/Clear",
+            request_serializer=_raw,
+            response_deserializer=_raw,
+        )
+
+    def _call(self, method, payload: bytes) -> dict:
+        try:
+            raw = method(payload, timeout=self.timeout)
+        except self._grpc.RpcError as exc:
+            code = exc.code()
+            transient = code in (
+                self._grpc.StatusCode.UNAVAILABLE,
+                self._grpc.StatusCode.DEADLINE_EXCEEDED,
+                self._grpc.StatusCode.RESOURCE_EXHAUSTED,
+                self._grpc.StatusCode.ABORTED,
+                self._grpc.StatusCode.CANCELLED,
+            )
+            raise StorageError(
+                f"authority {self.target}: {code.name}: {exc.details()}",
+                transient=transient,
+            ) from None
+        reply = msgpack.unpackb(raw, raw=False)
+        if "err" in reply:
+            raise StorageError(
+                f"authority {self.target}: {reply['err']}",
+                transient=bool(reply.get("transient")),
+            )
+        return reply
+
+    # -- the authority surface ---------------------------------------------
+
+    def apply_deltas(self, items: List[Tuple[Counter, int]]):
+        payload = msgpack.packb(
+            [
+                [
+                    _limit_to_wire(counter.limit),
+                    sorted(counter.set_variables.items()),
+                    int(delta),
+                ]
+                for counter, delta in items
+            ],
+            use_bin_type=True,
+        )
+        reply = self._call(self._apply, payload)
+        return [(int(v), float(t)) for v, t in reply["ok"]]
+
+    def delete_counters(self, limits) -> None:
+        payload = msgpack.packb(
+            [_limit_to_wire(limit) for limit in limits], use_bin_type=True
+        )
+        self._call(self._delete, payload)
+
+    def clear(self) -> None:
+        self._call(self._clear, msgpack.packb(None))
+
+    def close(self) -> None:
+        self._channel.close()
+
+    # -- unused CounterStorage surface (reads stay replica-local in the
+    # write-behind topology; the authority only applies deltas) ------------
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        raise StorageError(
+            "RemoteAuthority is write-only (wrap it in a "
+            "CachedCounterStorage)"
+        )
+
+    def add_counter(self, limit: Limit) -> None:
+        pass
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        self.apply_deltas([(counter, delta)])
+
+    def check_and_update(self, counters, delta, load_counters):
+        raise StorageError(
+            "RemoteAuthority is write-only (wrap it in a "
+            "CachedCounterStorage)"
+        )
+
+    def get_counters(self, limits) -> set:
+        return set()
+
+
+class AuthorityServer:
+    """Server side: expose a local storage's ``apply_deltas`` (and
+    delete/clear) to remote replicas. Runs a sync gRPC server on its own
+    thread pool — storage implementations serialize internally, and the
+    flush batches are coarse, so a small pool suffices."""
+
+    def __init__(self, storage, address: str, max_workers: int = 8):
+        import grpc
+
+        self.storage = storage
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers,
+                thread_name_prefix="authority",
+            )
+        )
+        self._lock = threading.Lock()
+        self._limit_cache: Dict[bytes, Limit] = {}
+
+        def apply_deltas(payload: bytes, _ctx) -> bytes:
+            try:
+                entries = msgpack.unpackb(payload, raw=False)
+                items = []
+                for limit_wire, vars_list, delta in entries:
+                    items.append(
+                        (Counter(self._limit_of(limit_wire),
+                                 dict(vars_list)), delta)
+                    )
+                out = self.storage.apply_deltas(items)
+                return msgpack.packb(
+                    {"ok": [[int(v), float(t)] for v, t in out]},
+                    use_bin_type=True,
+                )
+            except StorageError as exc:
+                return msgpack.packb(
+                    {"err": str(exc), "transient": exc.transient}
+                )
+            except Exception as exc:  # defensive: never kill the RPC thread
+                logger.exception("authority apply_deltas failed")
+                return msgpack.packb({"err": str(exc), "transient": False})
+
+        def delete_counters(payload: bytes, _ctx) -> bytes:
+            try:
+                limits = {
+                    self._limit_of(w)
+                    for w in msgpack.unpackb(payload, raw=False)
+                }
+                self.storage.delete_counters(limits)
+                return msgpack.packb({"ok": []})
+            except Exception as exc:
+                return msgpack.packb({"err": str(exc), "transient": False})
+
+        def clear(_payload: bytes, _ctx) -> bytes:
+            try:
+                self.storage.clear()
+                return msgpack.packb({"ok": []})
+            except Exception as exc:
+                return msgpack.packb({"err": str(exc), "transient": False})
+
+        handlers = {
+            "ApplyDeltas": grpc.unary_unary_rpc_method_handler(
+                apply_deltas,
+                request_deserializer=_raw,
+                response_serializer=_raw,
+            ),
+            "DeleteCounters": grpc.unary_unary_rpc_method_handler(
+                delete_counters,
+                request_deserializer=_raw,
+                response_serializer=_raw,
+            ),
+            "Clear": grpc.unary_unary_rpc_method_handler(
+                clear,
+                request_deserializer=_raw,
+                response_serializer=_raw,
+            ),
+        }
+        self._server.add_generic_rpc_handlers(
+            (grpc.method_handlers_generic_handler(_SERVICE, handlers),)
+        )
+        self.port = self._server.add_insecure_port(address)
+        if self.port == 0:
+            raise StorageError(f"cannot bind authority on {address}")
+
+    def _limit_of(self, wire: list) -> Limit:
+        """Intern decoded limits so hot counters share one Limit object
+        (CEL re-parse per RPC would dominate otherwise)."""
+        key = msgpack.packb(wire, use_bin_type=True)
+        limit = self._limit_cache.get(key)
+        if limit is None:
+            limit = _limit_from_wire(wire)
+            with self._lock:
+                self._limit_cache[key] = limit
+        return limit
+
+    def start(self) -> "AuthorityServer":
+        self._server.start()
+        return self
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)
+
+
+def serve_authority(storage, address: str) -> AuthorityServer:
+    """Start serving ``storage`` as a shared authority on ``address``."""
+    return AuthorityServer(storage, address).start()

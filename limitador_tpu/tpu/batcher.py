@@ -229,6 +229,23 @@ class UpdateBatcher:
         for counter, delta in items:
             self.storage.update_counter(counter, delta)
 
+    @staticmethod
+    def _settle(waiters, exc) -> None:
+        for future in waiters:
+            if future.done():
+                continue
+            if exc is not None:
+                future.set_exception(exc)
+            else:
+                future.set_result(None)
+
+    def _swap(self):
+        items = list(self._pending.items())
+        waiters = self._waiters
+        self._pending = {}
+        self._waiters = []
+        return items, waiters
+
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while not self._closed:
@@ -243,20 +260,13 @@ class UpdateBatcher:
                         return
             if len(self._pending) < self.max_batch:
                 await asyncio.sleep(self.max_delay)
-            items = list(self._pending.items())
-            waiters = self._waiters
-            self._pending = {}
-            self._waiters = []
+            items, waiters = self._swap()
             try:
                 await loop.run_in_executor(self._pool, self._apply, items)
             except Exception as exc:
-                for future in waiters:
-                    if not future.done():
-                        future.set_exception(exc)
+                self._settle(waiters, exc)
             else:
-                for future in waiters:
-                    if not future.done():
-                        future.set_result(None)
+                self._settle(waiters, None)
 
     async def close(self) -> None:
         self._closed = True
@@ -268,19 +278,13 @@ class UpdateBatcher:
             except asyncio.CancelledError:
                 pass
         if self._pending:
-            items = list(self._pending.items())
-            waiters, self._waiters = self._waiters, []
-            self._pending = {}
+            items, waiters = self._swap()
             try:
                 self._apply(items)
             except Exception as exc:
-                for future in waiters:
-                    if not future.done():
-                        future.set_exception(exc)
+                self._settle(waiters, exc)
             else:
-                for future in waiters:
-                    if not future.done():
-                        future.set_result(None)
+                self._settle(waiters, None)
         self._pool.shutdown(wait=False)
 
 

@@ -1,0 +1,279 @@
+"""Network shared-authority mode: N write-behind replicas flushing to one
+authority over gRPC — the out-of-process Redis topology
+(doc/topologies.md, redis_async.rs:67-147)."""
+
+import asyncio
+import socket
+
+import pytest
+
+from limitador_tpu import AsyncRateLimiter, Context, Limit
+from limitador_tpu.storage.authority import (
+    RemoteAuthority,
+    serve_authority,
+)
+from limitador_tpu.storage.base import StorageError
+from limitador_tpu.storage.cached import CachedCounterStorage
+from limitador_tpu.storage.in_memory import InMemoryStorage
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_replicas_converge_over_the_network():
+    """Two replicas in different event loops share one gRPC authority:
+    flushes deliver each replica's deltas, reconciliation makes the
+    other's traffic visible (integration_tests.rs cached-Redis
+    convergence, flush tightened)."""
+    backend = InMemoryStorage()
+    port = free_port()
+    server = serve_authority(backend, f"127.0.0.1:{port}")
+    try:
+
+        async def main():
+            a = CachedCounterStorage(
+                RemoteAuthority(f"127.0.0.1:{port}"), flush_period=0.02
+            )
+            b = CachedCounterStorage(
+                RemoteAuthority(f"127.0.0.1:{port}"), flush_period=0.02
+            )
+            la, lb = AsyncRateLimiter(a), AsyncRateLimiter(b)
+            limit = Limit("ns", 4, 60, [], ["u"])
+            la.add_limit(limit)
+            lb.add_limit(limit)
+            ctx = Context({"u": "x"})
+            for _ in range(2):
+                assert not (
+                    await la.check_rate_limited_and_update("ns", ctx, 1)
+                ).limited
+                assert not (
+                    await lb.check_rate_limited_and_update("ns", ctx, 1)
+                ).limited
+            await a.flush()
+            await b.flush()
+            # The authority saw all 4 hits.
+            auth = next(iter(backend.get_counters({limit})))
+            assert auth.remaining == 0
+            # One more reconcile round and replica a sees the global count.
+            first = await la.check_rate_limited_and_update("ns", ctx, 1)
+            await a.flush()
+            second = await la.check_rate_limited_and_update("ns", ctx, 1)
+            await a.close()
+            await b.close()
+            return first.limited, second.limited
+
+        assert run(main()) == (False, True)
+    finally:
+        server.stop()
+
+
+def test_partition_revert_and_recovery_over_the_network():
+    """Killing the authority flips the replica to partitioned (deltas
+    revert locally); restarting it on the same port recovers and the
+    reverted deltas reach the authority."""
+    backend = InMemoryStorage()
+    port = free_port()
+    server = serve_authority(backend, f"127.0.0.1:{port}")
+
+    async def main():
+        flags = []
+        cached = CachedCounterStorage(
+            RemoteAuthority(f"127.0.0.1:{port}", timeout=0.5),
+            flush_period=0.02,
+            on_partitioned=flags.append,
+        )
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 100, 60, [], ["u"])
+        limiter.add_limit(limit)
+
+        await limiter.check_rate_limited_and_update("ns", Context({"u": "a"}), 5)
+        server.stop(grace=0)
+        await cached.flush()
+        assert cached.partitioned is True
+        # Local serving continues through the partition.
+        r = await limiter.check_rate_limited_and_update(
+            "ns", Context({"u": "a"}), 1, True
+        )
+        assert not r.limited and r.counters[0].remaining == 94
+
+        server2 = serve_authority(backend, f"127.0.0.1:{port}")
+        try:
+            # The sync channel reconnects with backoff; retry until healed.
+            deadline = asyncio.get_running_loop().time() + 10.0
+            while cached.partitioned:
+                assert asyncio.get_running_loop().time() < deadline
+                await asyncio.sleep(0.1)
+                await cached.flush()
+            assert cached.partitioned is False
+            auth = next(iter(backend.get_counters({limit})))
+            await cached.close()
+            return flags, auth.remaining
+        finally:
+            server2.stop()
+
+    flags, remaining = run(main())
+    assert flags == [True, False]
+    assert remaining == 94
+
+
+def test_authority_delete_and_clear_propagate():
+    backend = InMemoryStorage()
+    port = free_port()
+    server = serve_authority(backend, f"127.0.0.1:{port}")
+    try:
+
+        async def main():
+            cached = CachedCounterStorage(
+                RemoteAuthority(f"127.0.0.1:{port}"), flush_period=10.0
+            )
+            limiter = AsyncRateLimiter(cached)
+            limit = Limit("ns", 50, 60, [], ["u"])
+            limiter.add_limit(limit)
+            await limiter.check_rate_limited_and_update(
+                "ns", Context({"u": "a"}), 3
+            )
+            await cached.flush()
+            assert len(backend.get_counters({limit})) == 1
+            await limiter.delete_limit(limit)
+            out = len(backend.get_counters({limit}))
+            await cached.close()
+            return out
+
+        assert run(main()) == 0
+    finally:
+        server.stop()
+
+
+def test_tpu_table_as_network_authority():
+    """The device table itself as the shared authority: replicas flush to
+    the TPU across the network (the north-star deployment of topology 2/3
+    with the TPU playing Redis)."""
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    backend = TpuStorage(capacity=512)
+    port = free_port()
+    server = serve_authority(backend, f"127.0.0.1:{port}")
+    try:
+
+        async def main():
+            cached = CachedCounterStorage(
+                RemoteAuthority(f"127.0.0.1:{port}"), flush_period=0.02
+            )
+            limiter = AsyncRateLimiter(cached)
+            limit = Limit("ns", 10, 60, [], ["u"])
+            limiter.add_limit(limit)
+            for _ in range(4):
+                await limiter.check_rate_limited_and_update(
+                    "ns", Context({"u": "z"}), 1
+                )
+            await cached.flush()
+            auth = next(iter(backend.get_counters({limit})))
+            await cached.close()
+            return auth.remaining
+
+        assert run(main()) == 6
+    finally:
+        server.stop()
+
+
+def test_two_server_processes_share_one_authority():
+    """Full deployment shape: two limitador server PROCESSES (memory
+    storage is irrelevant — they run 'cached' with --authority-url) flush
+    to a third process serving --authority-listen; hits on either replica
+    converge at the authority."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import time
+    import urllib.request
+
+    limits = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    limits.write(
+        "- namespace: ns\n  max_value: 100\n  seconds: 60\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+    limits.close()
+    auth_port = free_port()
+    procs = []
+
+    def spawn(argv):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "limitador_tpu.server"] + argv,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_http(port):
+        for _ in range(120):
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=1
+                )
+                return
+            except Exception:
+                time.sleep(0.5)
+        raise AssertionError("server never came up")
+
+    try:
+        auth_http = free_port()
+        spawn([limits.name, "memory", "--rls-port", str(free_port()),
+               "--http-port", str(auth_http),
+               "--authority-listen", f"127.0.0.1:{auth_port}"])
+        wait_http(auth_http)
+        replicas = []
+        for _ in range(2):
+            http = free_port()
+            spawn([limits.name, "cached", "--rls-port", str(free_port()),
+                   "--http-port", str(http),
+                   "--authority-url", f"127.0.0.1:{auth_port}"])
+            replicas.append(http)
+        for http in replicas:
+            wait_http(http)
+        body = json.dumps(
+            {"namespace": "ns", "values": {"u": "shared"}, "delta": 5}
+        ).encode()
+        for http in replicas:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http}/check_and_report", body,
+                {"Content-Type": "application/json"},
+            )
+            assert urllib.request.urlopen(req).status == 200
+        # Write-behind default flush is 1s; poll the authority's view.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            counters = json.loads(
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{auth_http}/counters/ns"
+                ).read()
+            )
+            if counters and counters[0]["remaining"] == 90:
+                break
+            time.sleep(0.25)
+        assert counters and counters[0]["remaining"] == 90
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        os.unlink(limits.name)
