@@ -93,6 +93,64 @@ class PrometheusMetrics:
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
             ),
         )
+        # Library-side operational metrics (the reference's metrics-facade
+        # gauges, counters_cache.rs:49,173,207,267,368-371): polled from
+        # attached sources at render time.
+        self.batcher_size = Gauge(
+            "batcher_size", "Pending counter updates in the batcher",
+            registry=self.registry,
+        )
+        self.cache_size = Gauge(
+            "cache_size", "Locally cached counters",
+            registry=self.registry,
+        )
+        self.batcher_flush_size = Histogram(
+            "batcher_flush_size", "Counters per batcher flush",
+            registry=self.registry,
+            buckets=(1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 10000),
+        )
+        self.counter_overshoot = Counter(
+            "counter_overshoot",
+            "Amount admitted beyond a limit due to write-behind staleness",
+            registry=self.registry,
+        )
+        self.evicted_pending_writes = Counter(
+            "evicted_pending_writes",
+            "Counters evicted from the cache while holding unflushed deltas",
+            registry=self.registry,
+        )
+        self._library_sources: list = []
+        self._counter_baselines: dict = {}
+
+    def attach_library_source(self, source) -> None:
+        """Attach an object exposing ``library_stats() -> dict``; polled on
+        every render. Recognized keys: ``batcher_size`` / ``cache_size``
+        (levels, summed over sources), ``counter_overshoot`` /
+        ``evicted_pending_writes`` (cumulative counts, converted to
+        increments), ``flush_sizes`` (list drained into the histogram)."""
+        self._library_sources.append(source)
+
+    def _poll_library_sources(self) -> None:
+        batcher_size = 0
+        cache_size = 0
+        for i, source in enumerate(self._library_sources):
+            try:
+                stats = source.library_stats()
+            except Exception:
+                continue
+            batcher_size += int(stats.get("batcher_size", 0))
+            cache_size += int(stats.get("cache_size", 0))
+            for key in ("counter_overshoot", "evicted_pending_writes"):
+                if key in stats:
+                    seen = int(stats[key])
+                    baseline = self._counter_baselines.get((i, key), 0)
+                    if seen > baseline:
+                        getattr(self, key).inc(seen - baseline)
+                        self._counter_baselines[(i, key)] = seen
+            for size in stats.get("flush_sizes", ()):
+                self.batcher_flush_size.observe(size)
+        self.batcher_size.set(batcher_size)
+        self.cache_size.set(cache_size)
 
     def custom_labels(self, ctx) -> list:
         """Evaluate the CEL label map against a request context; absent /
@@ -136,4 +194,5 @@ class PrometheusMetrics:
             self.datastore_latency.observe(time.perf_counter() - start)
 
     def render(self) -> bytes:
+        self._poll_library_sources()
         return generate_latest(self.registry)

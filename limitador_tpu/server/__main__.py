@@ -170,8 +170,10 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def build_limiter(args):
-    """Limiter::new equivalent (main.rs:93-185): pick + build the backend."""
+def build_limiter(args, on_partitioned=None):
+    """Limiter::new equivalent (main.rs:93-185): pick + build the backend.
+    ``on_partitioned`` reaches storages that track authority partitions
+    (the datastore_partitioned gauge)."""
     if args.authority_url and args.storage != "cached":
         raise SystemExit(
             f"--authority-url only applies to the 'cached' storage "
@@ -284,7 +286,9 @@ def build_limiter(args):
             from ..storage.disk import DiskStorage
 
             authority = DiskStorage(args.disk_path or "limitador_counters.db")
-        return AsyncRateLimiter(CachedCounterStorage(authority))
+        return AsyncRateLimiter(
+            CachedCounterStorage(authority, on_partitioned=on_partitioned)
+        )
     if args.storage == "distributed":
         try:
             from ..storage.distributed import CrInMemoryStorage
@@ -310,11 +314,19 @@ async def _amain(args) -> int:
     if tracing_err:
         print(tracing_err, file=sys.stderr)
 
-    limiter = build_limiter(args)
     metrics = PrometheusMetrics(
         use_limit_name_label=args.limit_name_in_labels,
         metric_labels=args.metric_labels,
     )
+    limiter = build_limiter(
+        args,
+        on_partitioned=(
+            lambda v: metrics.datastore_partitioned.set(1 if v else 0)
+        ),
+    )
+    counters_storage = limiter.storage.counters
+    if hasattr(counters_storage, "library_stats"):
+        metrics.attach_library_source(counters_storage)
     reflection_enabled = False
     if args.grpc_reflection_service:
         try:

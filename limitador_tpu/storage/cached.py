@@ -51,12 +51,13 @@ class _CachedValue:
     """Local view of one counter: last authoritative value + local deltas
     not yet flushed (CachedCounterValue, counters_cache.rs:71-120)."""
 
-    __slots__ = ("value", "pending", "from_authority")
+    __slots__ = ("value", "pending", "from_authority", "auth_overshoot")
 
     def __init__(self, value: ExpiringValue, from_authority: bool):
         self.value = value
         self.pending = 0
         self.from_authority = from_authority
+        self.auth_overshoot = 0  # excess over max at the last reconcile
 
 
 class CachedCounterStorage(AsyncCounterStorage):
@@ -66,6 +67,7 @@ class CachedCounterStorage(AsyncCounterStorage):
         flush_period: float = DEFAULT_FLUSH_PERIOD,
         batch_size: int = DEFAULT_BATCH_SIZE,
         max_cached: int = DEFAULT_MAX_CACHED,
+        max_pending: Optional[int] = None,
         clock=time.time,
         on_partitioned: Optional[Callable[[bool], None]] = None,
     ):
@@ -73,6 +75,11 @@ class CachedCounterStorage(AsyncCounterStorage):
         self.flush_period = flush_period
         self.batch_size = batch_size
         self.max_cached = max_cached
+        # Pending-write bound (the reference Batcher's semaphore cap,
+        # counters_cache.rs:143-247): past this many distinct pending
+        # counters, writers flush inline — backpressure instead of
+        # unbounded growth under a slow/partitioned authority.
+        self.max_pending = max_pending or batch_size * 100
         self._clock = clock
         self._on_partitioned = on_partitioned
         self.partitioned = False
@@ -82,10 +89,22 @@ class CachedCounterStorage(AsyncCounterStorage):
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
-        # Operational counters (counters_cache.rs:49,267,368-371), readable
-        # by a metrics layer.
+        # Operational counters (counters_cache.rs:49,267,368-371), polled
+        # by the metrics layer via library_stats().
         self.evicted_pending_writes = 0
         self.flush_errors = 0
+        self.counter_overshoot = 0
+        self._flush_sizes: List[int] = []
+
+    def library_stats(self) -> dict:
+        flush_sizes, self._flush_sizes = self._flush_sizes, []
+        return {
+            "batcher_size": len(self._batch),
+            "cache_size": len(self._cache),
+            "counter_overshoot": self.counter_overshoot,
+            "evicted_pending_writes": self.evicted_pending_writes,
+            "flush_sizes": flush_sizes,
+        }
 
     # -- flush loop --------------------------------------------------------
 
@@ -133,6 +152,8 @@ class CachedCounterStorage(AsyncCounterStorage):
             keys.append(key)
         if not items:
             return
+        self._flush_sizes.append(len(items))
+        del self._flush_sizes[:-1000]
         loop = asyncio.get_running_loop()
         try:
             authoritative = await loop.run_in_executor(
@@ -171,6 +192,15 @@ class CachedCounterStorage(AsyncCounterStorage):
             entry.pending = max(entry.pending - flushed, 0)
             entry.value.set(value + entry.pending, ttl, now)
             entry.from_authority = True
+            # Overshoot: how far the replica fleet admitted past the limit
+            # while views were stale (counters_cache.rs:368-371). Count only
+            # the growth between THIS entry's consecutive reconciles — a
+            # freshly (re)created entry first establishes a baseline, so an
+            # evict/recreate cycle cannot re-count the same standing excess.
+            excess = max(value - counter.max_value, 0)
+            if entry.from_authority and excess > entry.auth_overshoot:
+                self.counter_overshoot += excess - entry.auth_overshoot
+            entry.auth_overshoot = excess
 
     def _apply_to_authority(self, items: List[Tuple[Counter, int]]):
         apply = getattr(self.authority, "apply_deltas", None)
@@ -219,7 +249,9 @@ class CachedCounterStorage(AsyncCounterStorage):
                         self._counters.pop(evict, None)
         return entry
 
-    def _queue(self, counter: Counter, key: bytes, delta: int) -> None:
+    def _queue(
+        self, counter: Counter, key: bytes, delta: int, now: float
+    ) -> None:
         entry = self._cache.get(key)
         if entry is not None:
             # Track the unflushed local delta so the flush reconcile can
@@ -227,8 +259,32 @@ class CachedCounterStorage(AsyncCounterStorage):
             # (pending_writes_and_value, counters_cache.rs:71-98).
             entry.pending += delta
         self._batch[key] = self._batch.get(key, 0) + delta
-        if len(self._batch) >= self.batch_size and self._wake is not None:
+        if self._wake is None:
+            return
+        # Flush triggers: batch full | priority (counters_cache.rs:138-247)
+        # — a counter the authority has never seen, or one whose window
+        # expires before the next interval flush could deliver it.
+        if (
+            len(self._batch) >= self.batch_size
+            or entry is None
+            or not entry.from_authority
+            or entry.value.ttl(now) <= 2 * self.flush_period
+        ):
             self._wake.set()
+
+    async def _backpressure(self) -> None:
+        """Bound pending writes (the reference Batcher's semaphore): past
+        max_pending distinct counters, the writer flushes inline instead of
+        queueing further. Never during a partition (deltas re-queue anyway
+        and the replica must keep serving from local state), and a flush
+        failure here is counted, not surfaced — the request was already
+        admitted locally."""
+        if len(self._batch) >= self.max_pending and not self.partitioned:
+            try:
+                await self.flush()
+            except Exception:
+                self.flush_errors += 1
+                logger.exception("inline backpressure flush failed")
 
     # -- AsyncCounterStorage -------------------------------------------------
 
@@ -247,7 +303,8 @@ class CachedCounterStorage(AsyncCounterStorage):
         key = key_for_counter(counter)
         entry = self._entry(counter, key, now)
         entry.value.update(delta, counter.window_seconds, now)
-        self._queue(counter, key, delta)
+        self._queue(counter, key, delta, now)
+        await self._backpressure()
 
     async def check_and_update(
         self, counters: List[Counter], delta: int, load_counters: bool
@@ -274,7 +331,8 @@ class CachedCounterStorage(AsyncCounterStorage):
             return first_limited
         for counter, key, entry in staged:
             entry.value.update(delta, counter.window_seconds, now)
-            self._queue(counter, key, delta)
+            self._queue(counter, key, delta, now)
+        await self._backpressure()
         return Authorization.OK
 
     async def get_counters(self, limits: Set[Limit]) -> Set[Counter]:

@@ -46,6 +46,8 @@ log = logging.getLogger("limitador_tpu.distributed")
 _SERVICE = "limitador.service.distributed.v1.Replication"
 _METHOD = f"/{_SERVICE}/Stream"
 _RECONNECT_SECONDS = 1.0
+PING_INTERVAL_SECONDS = 5.0   # periodic RTT/skew refresh (grpc/mod.rs:625-746)
+PEER_PRUNE_SECONDS = 30.0     # forget gossip-learned peers silent this long
 
 OnUpdate = Callable[[bytes, Dict[str, int], int], None]
 SnapshotProvider = Callable[[], Iterable[Tuple[bytes, Dict[str, int], int]]]
@@ -63,6 +65,8 @@ class _Session:
         self.initiated = initiated
         self.clock_skew_ms = 0
         self.latency_ms = 0
+        self.ping_sent_ms: Optional[int] = None
+        self.pongs_received = 0
         self._pending: Dict[bytes, Tuple[Dict[str, int], int]] = {}
         self._wakeup = asyncio.Event()
         self.closed = asyncio.Event()
@@ -102,6 +106,11 @@ class Broker:
         self.snapshot_provider = snapshot_provider
         self.sessions: Dict[str, _Session] = {}
         self.known_peers: Dict[str, List[str]] = {}  # peer_id -> urls
+        # Peers learned via membership gossip (pruned when silent, unlike
+        # the configured peer_urls which are dialed forever) and the last
+        # time any packet arrived from each peer.
+        self._gossip_peers: set = set()
+        self.peer_last_seen: Dict[str, float] = {}
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
         self._server: Optional[grpc.aio.Server] = None
@@ -142,7 +151,8 @@ class Broker:
             self._spawn_dialer(url)
         self._started.set()
         while not self._stopping.is_set():
-            await asyncio.sleep(0.1)
+            await asyncio.sleep(0.5)
+            self._prune_dead_peers()
         for d in self._dialers.values():
             d.cancel()
         await asyncio.gather(*self._dialers.values(), return_exceptions=True)
@@ -175,11 +185,51 @@ class Broker:
     # -- session protocol ------------------------------------------------------
 
     def _membership_packet(self) -> pb.Packet:
-        peers = [
-            pb.Peer(peer_id=pid, urls=urls, latency=0)
-            for pid, urls in self.known_peers.items()
-        ]
+        peers = []
+        for pid, urls in self.known_peers.items():
+            session = self.sessions.get(pid)
+            latency = session.latency_ms if session is not None else 0
+            peers.append(pb.Peer(peer_id=pid, urls=urls, latency=latency))
         return pb.Packet(membership_update=pb.MembershipUpdate(peers=peers))
+
+    def _prune_dead_peers(self) -> None:
+        """Forget gossip-learned peers with no live session that have been
+        silent past the prune window (the reference tracks session health
+        per peer; configured peers keep their 1s redial loop forever)."""
+        now = time.monotonic()
+        for pid in list(self._gossip_peers):
+            session = self.sessions.get(pid)
+            if session is not None and not session.closed.is_set():
+                continue
+            if now - self.peer_last_seen.get(pid, now) < PEER_PRUNE_SECONDS:
+                continue
+            urls = self.known_peers.pop(pid, []) or []
+            self._gossip_peers.discard(pid)
+            self.peer_last_seen.pop(pid, None)
+            for url in urls:
+                if url in self.peer_urls:
+                    # Configured urls keep their forever-redial loop even
+                    # when a gossip-learned peer_id advertised the same url.
+                    continue
+                dialer = self._dialers.pop(url, None)
+                if dialer is not None:
+                    dialer.cancel()
+            log.debug("pruned dead peer %s", pid)
+
+    @staticmethod
+    def _apply_pong(session: _Session, remote_time_ms: int, now_ms: int) -> None:
+        """RTT + skew from one ping/pong round (ClockSkew, grpc/mod.rs:33-63):
+        latency is half the round trip; skew compares the remote clock to
+        the estimated local clock at the instant the peer stamped it."""
+        session.pongs_received += 1
+        if session.ping_sent_ms is not None:
+            rtt = max(now_ms - session.ping_sent_ms, 0)
+            session.latency_ms = rtt // 2
+            session.ping_sent_ms = None
+            session.clock_skew_ms = remote_time_ms - (now_ms - rtt // 2)
+        else:
+            # Handshake pong: no in-flight ping, skew only.
+            session.clock_skew_ms = remote_time_ms - now_ms
 
     def _register(self, session: _Session) -> bool:
         """Duplicate-session tiebreak (grpc/mod.rs:678-709): when two
@@ -213,12 +263,23 @@ class Broker:
                 for packet in await session.drain():
                     await send(packet)
 
+        async def pinger():
+            # Periodic RTT/skew refresh so long sessions don't drift
+            # (grpc/mod.rs:625-746 re-pings on an interval).
+            while not session.closed.is_set():
+                await asyncio.sleep(PING_INTERVAL_SECONDS)
+                if session.ping_sent_ms is None:
+                    session.ping_sent_ms = _now_ms()
+                    await send(pb.Packet(ping=pb.Empty()))
+
         send_task = asyncio.ensure_future(sender())
+        ping_task = asyncio.ensure_future(pinger())
         try:
             while True:
                 packet = await recv()
                 if packet is None:
                     break
+                self.peer_last_seen[session.peer_id] = time.monotonic()
                 kind = packet.WhichOneof("message")
                 if kind == "counter_update":
                     cu = packet.counter_update
@@ -228,7 +289,9 @@ class Broker:
                 elif kind == "ping":
                     await send(pb.Packet(pong=pb.Pong(current_time=_now_ms())))
                 elif kind == "pong":
-                    session.clock_skew_ms = packet.pong.current_time - _now_ms()
+                    self._apply_pong(
+                        session, packet.pong.current_time, _now_ms()
+                    )
                 elif kind == "membership_update":
                     for peer in packet.membership_update.peers:
                         if (
@@ -236,12 +299,17 @@ class Broker:
                             and peer.peer_id not in self.known_peers
                         ):
                             self.known_peers[peer.peer_id] = list(peer.urls)
+                            self._gossip_peers.add(peer.peer_id)
+                            self.peer_last_seen[peer.peer_id] = (
+                                time.monotonic()
+                            )
                             for url in peer.urls:
                                 self._spawn_dialer(url)
                 # re_sync_end / hello: nothing to do post-handshake
         finally:
             session.closed.set()
             send_task.cancel()
+            ping_task.cancel()
             if self.sessions.get(session.peer_id) is session:
                 del self.sessions[session.peer_id]
 
@@ -270,6 +338,7 @@ class Broker:
             self.known_peers.setdefault(
                 peer_id, list(hello_pkt.hello.sender_urls)
             )
+            self.peer_last_seen[peer_id] = time.monotonic()
             session = _Session(peer_id, initiated=False)
             if not self._register(session):
                 await out.put(None)
@@ -328,6 +397,7 @@ class Broker:
             peer_id = hello_pkt.hello.sender_peer_id
             if peer_id == self.peer_id:
                 return  # configured to dial ourselves
+            self.peer_last_seen[peer_id] = time.monotonic()
             session = _Session(peer_id, initiated=True)
             if not self._register(session):
                 # A healthy session to this peer already exists (tiebreak

@@ -72,6 +72,7 @@ class MicroBatcher:
             max_inflight, thread_name_prefix="tpu-collect"
         )
         self._finishers: set = set()
+        self.flush_sizes: List[int] = []  # drained by library_stats
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -133,9 +134,14 @@ class MicroBatcher:
                 # Linger briefly to let concurrent requests coalesce.
                 await asyncio.sleep(self.max_delay)
             batch = self._pending
+            flush_hits = self._pending_hits
             self._pending = []
             self._pending_hits = 0
             requests = [r for r, _f in batch]
+            # Recorded in COUNTERS (hits), matching the shared
+            # batcher_flush_size histogram's unit.
+            self.flush_sizes.append(flush_hits)
+            del self.flush_sizes[:-1000]
             if pipelined:
                 await sem.acquire()
                 try:
@@ -325,6 +331,27 @@ class AsyncTpuStorage(AsyncCounterStorage):
 
     async def update_counter(self, counter: Counter, delta: int) -> None:
         await self.update_batcher.submit(counter, delta)
+
+    def library_stats(self) -> dict:
+        """Operational metrics for the /metrics library gauges."""
+        flush_sizes, self.batcher.flush_sizes = self.batcher.flush_sizes, []
+        cache_size = 0
+        table = getattr(self.inner, "_table", None)
+        if table is not None:
+            cache_size = len(table.qualified) + len(table.simple)
+        else:  # sharded: per-shard tables + the psum global region
+            for t in getattr(self.inner, "_tables", ()):
+                cache_size += len(t.qualified) + len(t.simple)
+            gtable = getattr(self.inner, "_gtable", None)
+            if gtable is not None:
+                cache_size += len(gtable.qualified) + len(gtable.simple)
+        return {
+            "batcher_size": (
+                self.batcher._pending_hits + len(self.update_batcher._pending)
+            ),
+            "cache_size": cache_size,
+            "flush_sizes": flush_sizes,
+        }
 
     async def get_counters(self, limits) -> set:
         return self.inner.get_counters(limits)

@@ -67,7 +67,9 @@ def test_replicas_converge_over_the_network():
             # The authority saw all 4 hits.
             auth = next(iter(backend.get_counters({limit})))
             assert auth.remaining == 0
-            # One more reconcile round and replica a sees the global count.
+            # Bounded over-admission: replica a may admit AT MOST one more
+            # hit from a stale view (priority flush often reconciles before
+            # it); after one more flush round the view has converged.
             first = await la.check_rate_limited_and_update("ns", ctx, 1)
             await a.flush()
             second = await la.check_rate_limited_and_update("ns", ctx, 1)
@@ -75,7 +77,8 @@ def test_replicas_converge_over_the_network():
             await b.close()
             return first.limited, second.limited
 
-        assert run(main()) == (False, True)
+        _first, second = run(main())
+        assert second is True  # converged within one reconcile round
     finally:
         server.stop()
 

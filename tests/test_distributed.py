@@ -150,3 +150,86 @@ class TestReplication:
                 n1.close()
         finally:
             n0.close()
+
+
+class TestBrokerHealth:
+    """Ping/RTT/skew measurement + dead-peer pruning (grpc/mod.rs:625-746)."""
+
+    def test_pong_arithmetic_updates_latency_and_skew(self):
+        from limitador_tpu.storage.distributed.broker import Broker, _Session
+
+        s = _Session("peer", initiated=True)
+        # Handshake pong (no in-flight ping): pure skew.
+        Broker._apply_pong(s, remote_time_ms=10_500, now_ms=10_000)
+        assert s.clock_skew_ms == 500 and s.latency_ms == 0
+        # Ping round: rtt 80ms -> latency 40ms; the remote stamped its
+        # clock at our (now - 40ms), so skew = remote - (now - 40).
+        s.ping_sent_ms = 20_000
+        Broker._apply_pong(s, remote_time_ms=20_541, now_ms=20_080)
+        assert s.latency_ms == 40
+        assert s.clock_skew_ms == 20_541 - (20_080 - 40)
+        assert s.ping_sent_ms is None  # consumed; next ping re-arms
+
+    def test_live_ping_round_measures_latency(self, monkeypatch):
+        from limitador_tpu.storage.distributed import broker as broker_mod
+
+        monkeypatch.setattr(broker_mod, "PING_INTERVAL_SECONDS", 0.1)
+        ports = [free_port(), free_port()]
+        urls = [f"127.0.0.1:{p}" for p in ports]
+        a = CrInMemoryStorage("nodeA", listen_address=urls[0], peers=[urls[1]])
+        b = CrInMemoryStorage("nodeB", listen_address=urls[1], peers=[urls[0]])
+        try:
+            deadline = time.time() + 10
+            seen = False
+            while time.time() < deadline and not seen:
+                for storage in (a, b):
+                    for sess in storage.broker.sessions.values():
+                        # >= 2 pongs = the handshake pong AND at least one
+                        # periodic ping round-trip (which measures latency
+                        # and refreshes skew).
+                        if sess.pongs_received >= 2:
+                            assert storage.broker.peer_last_seen
+                            seen = True
+                time.sleep(0.05)
+            assert seen, "no periodic ping round completed"
+        finally:
+            a.close()
+            b.close()
+
+    def test_gossip_learned_dead_peer_is_pruned(self):
+        from limitador_tpu.storage.distributed import broker as broker_mod
+        from limitador_tpu.storage.distributed.broker import Broker
+
+        broker = Broker(
+            "me", f"127.0.0.1:{free_port()}", [],
+            on_update=lambda *a: None, snapshot_provider=lambda: [],
+        )
+        # A peer learned via membership gossip that went silent long ago.
+        broker.known_peers["ghost"] = ["127.0.0.1:1"]
+        broker._gossip_peers.add("ghost")
+        broker.peer_last_seen["ghost"] = (
+            time.monotonic() - broker_mod.PEER_PRUNE_SECONDS - 1
+        )
+        # A configured peer is never pruned even when silent.
+        broker.known_peers["configured"] = ["127.0.0.1:2"]
+        broker.peer_last_seen["configured"] = (
+            time.monotonic() - broker_mod.PEER_PRUNE_SECONDS - 1
+        )
+        broker._prune_dead_peers()
+        assert "ghost" not in broker.known_peers
+        assert "configured" in broker.known_peers
+
+    def test_membership_packet_carries_measured_latency(self):
+        from limitador_tpu.storage.distributed.broker import Broker, _Session
+
+        broker = Broker(
+            "me", f"127.0.0.1:{free_port()}", [],
+            on_update=lambda *a: None, snapshot_provider=lambda: [],
+        )
+        session = _Session("peer1", initiated=True)
+        session.latency_ms = 7
+        broker.known_peers["peer1"] = ["127.0.0.1:3"]
+        broker.sessions["peer1"] = session
+        packet = broker._membership_packet()
+        peers = {p.peer_id: p.latency for p in packet.membership_update.peers}
+        assert peers["peer1"] == 7
