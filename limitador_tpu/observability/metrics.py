@@ -44,18 +44,9 @@ class PrometheusMetrics:
         self.labels_expr = None
         self.custom_label_names: list = []
         if metric_labels:
-            from ..core.cel import Expression, MapExpr, Literal
-
-            expr = Expression.parse(metric_labels)
-            if not isinstance(expr.ast, MapExpr):
-                raise ValueError("metric labels must be a CEL map literal")
-            names = []
-            for k, _v in expr.ast.entries:
-                if not (isinstance(k, Literal) and isinstance(k.value, str)):
-                    raise ValueError("metric label names must be string literals")
-                names.append(k.value)
-            self.labels_expr = expr
-            self.custom_label_names = names
+            self.labels_expr, self.custom_label_names = self._parse_labels(
+                metric_labels
+            )
         labels = [NAMESPACE_LABEL] + self.custom_label_names
         limited_labels = (
             [NAMESPACE_LABEL, LIMIT_NAME_LABEL]
@@ -151,6 +142,37 @@ class PrometheusMetrics:
                 self.batcher_flush_size.observe(size)
         self.batcher_size.set(batcher_size)
         self.cache_size.set(cache_size)
+
+    @staticmethod
+    def _parse_labels(metric_labels: str):
+        """Parse a CEL map literal into (expr, [label names])."""
+        from ..core.cel import Expression, Literal, MapExpr
+
+        expr = Expression.parse(metric_labels)
+        if not isinstance(expr.ast, MapExpr):
+            raise ValueError("metric labels must be a CEL map literal")
+        names = []
+        for k, _v in expr.ast.entries:
+            if not (isinstance(k, Literal) and isinstance(k.value, str)):
+                raise ValueError("metric label names must be string literals")
+            names.append(k.value)
+        return expr, names
+
+    def reload_labels(self, metric_labels: str) -> None:
+        """Hot-swap the label VALUE expressions (the reference's watched
+        labels file, main.rs:287-300,359-390). Prometheus label NAMES are
+        fixed per metric at startup, so new names require a restart —
+        expressions for a subset of the configured names are fine (absent
+        names render empty)."""
+        expr, names = self._parse_labels(metric_labels)
+        unknown = [n for n in names if n not in self.custom_label_names]
+        if unknown:
+            raise ValueError(
+                f"metric label names {unknown} were not configured at "
+                f"startup (configured: {self.custom_label_names}); label "
+                "names are fixed per process"
+            )
+        self.labels_expr = expr
 
     def custom_labels(self, ctx) -> list:
         """Evaluate the CEL label map against a request context; absent /

@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
         "labels, e.g. \"{'tenant': descriptors[0].tenant}\"",
     )
     p.add_argument(
+        "--metric-labels-file",
+        default=_env("METRIC_LABELS_FILE"),
+        help="file holding the CEL label map; watched and hot-reloaded "
+        "(label NAMES are fixed at startup, value expressions may change)",
+    )
+    p.add_argument(
         "--grpc-reflection-service",
         action="store_true",
         help="enable gRPC server reflection (requires grpcio-reflection)",
@@ -314,10 +320,47 @@ async def _amain(args) -> int:
     if tracing_err:
         print(tracing_err, file=sys.stderr)
 
+    initial_labels = args.metric_labels
+    if args.metric_labels_file:
+        try:
+            with open(args.metric_labels_file) as f:
+                content = f.read().strip()
+            if content:
+                initial_labels = content
+        except OSError as exc:
+            print(
+                f"metric labels file unreadable ({exc}); "
+                "using --metric-labels",
+                file=sys.stderr,
+            )
     metrics = PrometheusMetrics(
         use_limit_name_label=args.limit_name_in_labels,
-        metric_labels=args.metric_labels,
+        metric_labels=initial_labels,
     )
+    labels_watcher = None
+    if args.metric_labels_file:
+
+        def _load_labels(path):
+            with open(path) as f:
+                return f.read().strip()
+
+        def _labels_changed(content):
+            try:
+                if content:
+                    metrics.reload_labels(content)
+                    print("metric labels reloaded", file=sys.stderr)
+            except Exception as exc:  # bad CEL must not kill the watcher
+                print(f"metric labels reload rejected: {exc}", file=sys.stderr)
+
+        labels_watcher = LimitsFileWatcher(
+            args.metric_labels_file,
+            _labels_changed,
+            on_error=lambda exc: print(
+                f"metric labels file reload failed: {exc}", file=sys.stderr
+            ),
+            loader=_load_labels,
+        )
+        labels_watcher.start()
     limiter = build_limiter(
         args,
         on_partitioned=(
@@ -484,6 +527,8 @@ async def _amain(args) -> int:
 
     if watcher:
         watcher.stop()
+    if labels_watcher is not None:
+        labels_watcher.stop()
     if authority_server is not None:
         authority_server.stop()
     await rls_server.stop(grace=1.0)

@@ -56,6 +56,172 @@ def _counter_dto(counter) -> dict:
     }
 
 
+def _openapi_spec() -> dict:
+    """OpenAPI 3 document mirroring the reference's paperclip spec surface
+    (request_types.rs:10-97, http_api/server.rs:77-260)."""
+    limit_schema = {
+        "type": "object",
+        "required": ["namespace", "max_value", "seconds"],
+        "properties": {
+            "id": {"type": "string", "nullable": True},
+            "namespace": {"type": "string"},
+            "max_value": {"type": "integer", "format": "int64"},
+            "seconds": {"type": "integer", "format": "int64"},
+            "name": {"type": "string", "nullable": True},
+            "conditions": {"type": "array", "items": {"type": "string"}},
+            "variables": {"type": "array", "items": {"type": "string"}},
+        },
+    }
+    counter_schema = {
+        "type": "object",
+        "properties": {
+            "limit": {"$ref": "#/components/schemas/Limit"},
+            "set_variables": {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+            },
+            "remaining": {
+                "type": "integer", "format": "int64", "nullable": True,
+            },
+            "expires_in_seconds": {
+                "type": "number", "nullable": True,
+            },
+        },
+    }
+    info_schema = {
+        "type": "object",
+        "required": ["namespace", "values"],
+        "properties": {
+            "namespace": {"type": "string"},
+            "values": {
+                "type": "object",
+                "additionalProperties": {"type": "string"},
+            },
+            "delta": {"type": "integer", "format": "int64"},
+            "response_headers": {
+                "type": "string",
+                "nullable": True,
+                "enum": [None, "none", "draft_version_03"],
+            },
+        },
+    }
+    check_responses = {
+        "200": {"description": "not rate limited"},
+        "429": {"description": "rate limited"},
+        "500": {"description": "storage error"},
+    }
+    ns_param = {
+        "name": "namespace",
+        "in": "path",
+        "required": True,
+        "schema": {"type": "string"},
+    }
+    info_body = {
+        "required": True,
+        "content": {
+            "application/json": {
+                "schema": {"$ref": "#/components/schemas/CheckAndReportInfo"}
+            }
+        },
+    }
+    return {
+        "openapi": "3.0.3",
+        "info": {
+            "title": "Limitador server endpoint",
+            "version": "1.0.0",
+        },
+        "paths": {
+            "/status": {
+                "get": {
+                    "summary": "Health / config status",
+                    "responses": {"200": {"description": "running"}},
+                }
+            },
+            "/metrics": {
+                "get": {
+                    "summary": "Prometheus metrics",
+                    "responses": {
+                        "200": {"description": "prometheus exposition"}
+                    },
+                }
+            },
+            "/limits/{namespace}": {
+                "get": {
+                    "summary": "Limits configured for a namespace",
+                    "parameters": [ns_param],
+                    "responses": {
+                        "200": {
+                            "description": "limits",
+                            "content": {
+                                "application/json": {
+                                    "schema": {
+                                        "type": "array",
+                                        "items": {
+                                            "$ref": "#/components/schemas/Limit"
+                                        },
+                                    }
+                                }
+                            },
+                        }
+                    },
+                }
+            },
+            "/counters/{namespace}": {
+                "get": {
+                    "summary": "Live counters of a namespace",
+                    "parameters": [ns_param],
+                    "responses": {
+                        "200": {
+                            "description": "counters",
+                            "content": {
+                                "application/json": {
+                                    "schema": {
+                                        "type": "array",
+                                        "items": {
+                                            "$ref": "#/components/schemas/Counter"
+                                        },
+                                    }
+                                }
+                            },
+                        }
+                    },
+                }
+            },
+            "/check": {
+                "post": {
+                    "summary": "Check only (no counter update)",
+                    "requestBody": info_body,
+                    "responses": check_responses,
+                }
+            },
+            "/report": {
+                "post": {
+                    "summary": "Update counters only (no check)",
+                    "requestBody": info_body,
+                    "responses": {
+                        "200": {"description": "counters updated"},
+                        "500": {"description": "storage error"},
+                    },
+                }
+            },
+            "/check_and_report": {
+                "post": {
+                    "summary": "Check and update atomically",
+                    "requestBody": info_body,
+                    "responses": check_responses,
+                }
+            },
+        },
+        "components": {
+            "schemas": {
+                "Limit": limit_schema,
+                "Counter": counter_schema,
+                "CheckAndReportInfo": info_schema,
+            }
+        },
+    }
+
+
 class _Api:
     def __init__(self, limiter, metrics: Optional[PrometheusMetrics], status):
         self.limiter = limiter
@@ -80,6 +246,12 @@ class _Api:
 
     async def get_status(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok", **self.status})
+
+    async def get_spec(self, request: web.Request) -> web.Response:
+        """OpenAPI document for the admin/check API (the reference serves
+        a paperclip-generated spec at /api/spec,
+        http_api/server.rs:282-330)."""
+        return web.json_response(_openapi_spec())
 
     async def get_metrics(self, request: web.Request) -> web.Response:
         body = self.metrics.render() if self.metrics else b""
@@ -186,6 +358,7 @@ def make_http_app(
     api = _Api(limiter, metrics, status)
     app = web.Application(middlewares=[http_request_id_middleware])
     app.router.add_get("/status", api.get_status)
+    app.router.add_get("/api/spec", api.get_spec)
     app.router.add_get("/metrics", api.get_metrics)
     app.router.add_get("/limits/{namespace}", api.get_limits)
     app.router.add_get("/counters/{namespace}", api.get_counters)

@@ -55,8 +55,12 @@ def load_limits_file(path: str) -> List[Limit]:
 
 
 class LimitsFileWatcher:
-    """Polls (resolved path, mtime) and fires ``on_change(limits)`` — or
-    ``on_error(exc)`` — when the file content version changes."""
+    """Polls (resolved path, mtime) and fires ``on_change(loaded)`` — or
+    ``on_error(exc)`` — when the file content version changes. ``loader``
+    defaults to the limits-YAML parser; pass another callable to watch any
+    config file with the same ConfigMap-symlink-aware stamping (the
+    reference watches its metric-labels file the same way,
+    main.rs:287-300,359-390)."""
 
     def __init__(
         self,
@@ -64,11 +68,13 @@ class LimitsFileWatcher:
         on_change: Callable[[List[Limit]], None],
         on_error: Optional[Callable[[Exception], None]] = None,
         poll_interval: float = 1.0,
+        loader: Callable[[str], object] = None,
     ):
         self.path = path
         self.on_change = on_change
         self.on_error = on_error
         self.poll_interval = poll_interval
+        self.loader = loader or load_limits_file
         self._stamp = self._current_stamp()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -88,14 +94,21 @@ class LimitsFileWatcher:
             return
         self._stamp = stamp
         try:
-            limits = load_limits_file(self.path)
-        except LimitsFileError as exc:
+            loaded = self.loader(self.path)
+        except Exception as exc:
             self.errors += 1
             if self.on_error:
                 self.on_error(exc)
             return
         self.version += 1
-        self.on_change(limits)
+        try:
+            self.on_change(loaded)
+        except Exception as exc:
+            # A throwing consumer must not kill the watcher thread — the
+            # next edit would then never be observed.
+            self.errors += 1
+            if self.on_error:
+                self.on_error(exc)
 
     def _run(self) -> None:
         while not self._stop.wait(self.poll_interval):

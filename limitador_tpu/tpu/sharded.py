@@ -35,6 +35,7 @@ import numpy as np
 from ..core.counter import Counter
 from ..core.limit import Limit
 from ..storage.base import Authorization, CounterStorage, StorageError
+from ..storage.expiring_value import ExpiringValue
 from ..ops import kernel as K
 from ..parallel.mesh import (
     ShardedCounterState,
@@ -43,7 +44,13 @@ from ..parallel.mesh import (
     sharded_check_and_update,
     sharded_update,
 )
-from .storage import _bucket, _clamp_window_ms, _Request, _SlotTable
+from .storage import (
+    _BigLimitMixin,
+    _bucket,
+    _clamp_window_ms,
+    _Request,
+    _SlotTable,
+)
 
 __all__ = ["TpuShardedStorage"]
 
@@ -55,7 +62,7 @@ def _stable_hash(key: tuple) -> int:
     return zlib.crc32(repr(key).encode())
 
 
-class TpuShardedStorage(CounterStorage):
+class TpuShardedStorage(_BigLimitMixin, CounterStorage):
     def __init__(
         self,
         mesh=None,
@@ -85,6 +92,8 @@ class TpuShardedStorage(CounterStorage):
         self._tables: List[_SlotTable] = []
         self._gtable = _SlotTable(self._global_region)
         self._rr = 0  # round-robin shard for global-counter deltas
+        # Host-side fallback for max_value > device cap (_BigLimitMixin).
+        self._init_big(self._cache_size)
         self._reset_tables()
         self._state = make_sharded_table(self._mesh, self._local_capacity)
         self._epoch = clock()
@@ -202,12 +211,16 @@ class TpuShardedStorage(CounterStorage):
     def check_many(self, requests: List[_Request]) -> List[Authorization]:
         """One shard_map launch deciding a batch of requests in list order
         (same exactness contract as TpuStorage.check_many; cross-shard
-        requests couple via pmin)."""
+        requests couple via pmin). Counters with max_value beyond the
+        device cap are decided host-side in exact Python ints, coupled
+        into the all-or-nothing decision exactly as in
+        TpuStorage.begin_check_many."""
         import jax
 
         n = self._n
         with self._lock:
             now_ms = self._now_ms()
+            now = self._clock()
             # rows: (slot, delta, max, window_ms, req_id, fresh, is_global)
             per_shard: List[
                 List[Tuple[int, int, int, int, int, bool, bool]]
@@ -215,12 +228,25 @@ class TpuShardedStorage(CounterStorage):
             # per request: hit locations [(shard, pos_in_shard)], in order
             locs_by_req: List[List[Tuple[int, int]]] = []
             fresh_by_req: List[List[Tuple[int, Counter, int, int, bool]]] = []
+            big_by_req: List[list] = []
+            dev_j_by_req: List[List[Tuple[int, int]]] = []
+            big_projected: List[Tuple[tuple, int]] = []
             use_count: Dict[Tuple[int, int], int] = {}
             for r, request in enumerate(requests):
-                delta = min(int(request.delta), K.MAX_DELTA_CAP)
+                raw_delta = int(request.delta)
+                delta = min(raw_delta, K.MAX_DELTA_CAP)
                 locs: List[Tuple[int, int]] = []
                 fresh_hits: List[Tuple[int, Counter, int, int, bool]] = []
+                dev_j: List[Tuple[int, int]] = []
+                bigs, big_failed, projected = self._eval_big_hits(
+                    request.ordered, raw_delta, now
+                )
+                big_projected.extend(projected)
+                dev_delta = 0 if big_failed else delta
+                adjust = delta if big_failed else 0
                 for j, c in enumerate(request.ordered):
+                    if self._is_big(c):
+                        continue
                     shard, slot, is_fresh, is_g = self._slot_for(
                         c, create=True
                     )
@@ -228,9 +254,10 @@ class TpuShardedStorage(CounterStorage):
                         shard = self._app_shard()
                     row = per_shard[shard]
                     locs.append((shard, len(row)))
+                    dev_j.append((j, adjust))
                     row.append((
                         slot,
-                        delta,
+                        dev_delta,
                         min(c.max_value, K.MAX_VALUE_CAP),
                         _clamp_window_ms(c.window_seconds),
                         r,
@@ -243,8 +270,16 @@ class TpuShardedStorage(CounterStorage):
                         fresh_hits.append((j, c, shard, slot, is_g))
                 locs_by_req.append(locs)
                 fresh_by_req.append(fresh_hits)
+                big_by_req.append(bigs)
+                dev_j_by_req.append(dev_j)
 
-            H = _bucket(max(max(len(p) for p in per_shard), 1))
+            # n*H must cover every request id (big-only requests still
+            # consume an id even with zero device hits).
+            H = _bucket(max(
+                max(len(p) for p in per_shard),
+                (len(requests) + n - 1) // n,
+                1,
+            ))
             slots = np.full((n, H), self._scratch, np.int32)
             deltas = np.zeros((n, H), np.int32)
             maxes = np.full((n, H), _INT32_MAX, np.int32)
@@ -269,29 +304,51 @@ class TpuShardedStorage(CounterStorage):
                 fresh[s, :m] = cols[5]
                 is_global[s, :m] = cols[6]
 
-            self._state, result = sharded_check_and_update(
-                self._mesh, self._state, slots, deltas, maxes, windows,
-                req_ids, fresh, is_global, np.int32(now_ms),
-                global_region=self._global_region,
-            )
-            admitted, hit_ok, remaining, ttl_ms = jax.device_get((
-                result.admitted, result.hit_ok, result.remaining,
-                result.ttl_ms,
-            ))
+            try:
+                self._state, result = sharded_check_and_update(
+                    self._mesh, self._state, slots, deltas, maxes, windows,
+                    req_ids, fresh, is_global, np.int32(now_ms),
+                    global_region=self._global_region,
+                )
+                admitted, hit_ok, remaining, ttl_ms = jax.device_get((
+                    result.admitted, result.hit_ok, result.remaining,
+                    result.ttl_ms,
+                ))
+            except BaseException:
+                # Projection reservations must not leak on a failed launch.
+                self._unproject_big(big_projected)
+                raise
 
             auths: List[Authorization] = []
+            big_applies: List[Tuple[tuple, int, int]] = []
             for r, request in enumerate(requests):
                 locs = locs_by_req[r]
-                ok = bool(admitted[r]) if locs else True
+                dev_j = dev_j_by_req[r]
+                bigs = big_by_req[r]
+                dev_ok = bool(admitted[r]) if locs else True
+                big_ok = all(ok for _j, ok, *_rest in bigs)
                 if request.load:
-                    for (s, i), c in zip(locs, request.ordered):
-                        c.remaining = int(remaining[s, i])
+                    for (s, i), (j, adjust) in zip(locs, dev_j):
+                        c = request.ordered[j]
+                        c.remaining = max(int(remaining[s, i]) - adjust, 0)
                         c.expires_in = float(ttl_ms[s, i]) / 1000.0
-                if ok:
+                    for j, _ok, rem, ttl, _key, _c, _d in bigs:
+                        c = request.ordered[j]
+                        c.remaining = rem
+                        c.expires_in = ttl
+                if dev_ok and big_ok:
                     auths.append(Authorization.OK)
+                    for _j, _ok, _rem, _ttl, key, c, d in bigs:
+                        big_applies.append((key, d, c.window_seconds))
                     continue
-                oks = [bool(hit_ok[s, i]) for s, i in locs]
-                first = oks.index(False) if False in oks else 0
+                oks_by_j = {
+                    j: bool(hit_ok[s, i])
+                    for (s, i), (j, _a) in zip(locs, dev_j)
+                }
+                for j, ok, *_rest in bigs:
+                    oks_by_j[j] = ok
+                limited_js = [j for j, ok in oks_by_j.items() if not ok]
+                first = min(limited_js) if limited_js else 0
                 auths.append(
                     Authorization.limited_by(request.ordered[first].limit.name)
                 )
@@ -303,6 +360,8 @@ class TpuShardedStorage(CounterStorage):
                         use = (1 if is_g else 0, slot if is_g else shard, slot)
                         if j > first and use_count.get(use) == 1:
                             self._release(c, shard, slot, is_g)
+            self._unproject_big(big_projected)
+            self._apply_big(big_applies, now)
         return auths
 
     def _release(self, counter: Counter, shard: int, slot: int, is_g: bool):
@@ -337,6 +396,13 @@ class TpuShardedStorage(CounterStorage):
     def is_within_limits(self, counter: Counter, delta: int) -> bool:
         with self._lock:
             now_ms = self._now_ms()
+            if self._is_big(counter):
+                entry = self._big.get(self._key_of(counter))
+                value = (
+                    entry[0].value_at(self._clock())
+                    if entry is not None else 0
+                )
+                return value + delta <= counter.max_value
             shard, slot, _f, is_g = self._slot_for(counter, create=False)
             if slot is None:
                 value = 0
@@ -347,7 +413,11 @@ class TpuShardedStorage(CounterStorage):
     def add_counter(self, limit: Limit) -> None:
         if not limit.variables:
             with self._lock:
-                self._slot_for(Counter(limit, {}), create=True)
+                counter = Counter(limit, {})
+                if self._is_big(counter):
+                    self._big_cell(counter, self._key_of(counter))
+                else:
+                    self._slot_for(counter, create=True)
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         self.apply_deltas([(counter, delta)])
@@ -367,12 +437,21 @@ class TpuShardedStorage(CounterStorage):
         region) for the authoritative values."""
         with self._lock:
             now_ms = self._now_ms()
+            now = self._clock()
             # rows: (slot, delta, window_ms, fresh)
             per_shard: List[List[Tuple[int, int, int, bool]]] = [
                 [] for _ in range(self._n)
             ]
-            locs: List[Tuple[Optional[int], int, bool]] = []
+            # loc: (shard, slot, is_global) or ("big", value, ttl) resolved
+            locs: List[tuple] = []
             for counter, delta in items:
+                if self._is_big(counter):
+                    cell = self._big_cell(counter, self._key_of(counter))
+                    value = cell.update(
+                        int(delta), counter.window_seconds, now
+                    )
+                    locs.append(("big", value, cell.ttl(now)))
+                    continue
                 shard, slot, is_fresh, is_g = self._slot_for(
                     counter, create=True
                 )
@@ -405,14 +484,15 @@ class TpuShardedStorage(CounterStorage):
                 np.int32(now_ms),
             )
             # Batched authoritative reads: one gather per slot family.
+            dev_locs = [loc for loc in locs if loc[0] != "big"]
             lsh = np.asarray(
-                [s for s, _sl, g in locs if not g], np.int32
+                [s for s, _sl, g in dev_locs if not g], np.int32
             )
             lsl = np.asarray(
-                [sl for _s, sl, g in locs if not g], np.int32
+                [sl for _s, sl, g in dev_locs if not g], np.int32
             )
             gsl = np.asarray(
-                sorted({sl for _s, sl, g in locs if g}), np.int32
+                sorted({sl for _s, sl, g in dev_locs if g}), np.int32
             )
             lv = le = gv = ge = None
             if lsh.size:
@@ -424,7 +504,12 @@ class TpuShardedStorage(CounterStorage):
             gpos = {int(sl): i for i, sl in enumerate(gsl)}
             out = []
             li = 0
-            for shard, slot, is_g in locs:
+            for loc in locs:
+                if loc[0] == "big":
+                    _tag, value, ttl_s = loc
+                    out.append((value, ttl_s))
+                    continue
+                shard, slot, is_g = loc
                 if is_g:
                     col = gpos[slot]
                     live = ge[:, col] > now_ms
@@ -476,6 +561,7 @@ class TpuShardedStorage(CounterStorage):
                         or counter.namespace in namespaces
                     ):
                         emit(counter, shard, slot, False)
+            self._emit_big_counters(limits, namespaces, self._clock(), out)
         return out
 
     def delete_counters(self, limits: Set[Limit]) -> None:
@@ -502,10 +588,12 @@ class TpuShardedStorage(CounterStorage):
                     self._state.values.at[si, li].set(0),
                     self._state.expiry_ms.at[si, li].set(0),
                 )
+            self._delete_big(limits)
 
     def clear(self) -> None:
         with self._lock:
             self._reset_tables()
+            self._clear_big()
             self._state = make_sharded_table(
                 self._mesh, self._local_capacity
             )

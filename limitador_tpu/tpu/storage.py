@@ -35,6 +35,7 @@ import numpy as np
 from ..core.counter import Counter
 from ..core.limit import Limit
 from ..storage.base import Authorization, CounterStorage, StorageError
+from ..storage.expiring_value import ExpiringValue
 from ..ops import kernel as K
 
 __all__ = ["TpuStorage"]
@@ -92,6 +93,112 @@ class _SlotTable:
             self.on_native_release(native_key)
 
 
+class _BigLimitMixin:
+    """Host-side exact counters for limits whose max_value exceeds the
+    int32 device cap (the reference's max_value is u64, limit.rs:34).
+    Shared by the single-chip and sharded storages; every method assumes
+    the caller holds the storage lock.
+
+    Admission projection (``_big_inflight``) spans in-flight batches: a
+    hit admitted at begin time reserves its delta immediately, so a
+    second pipelined batch can never over-admit against a stale value;
+    the reservation is released (and the delta actually applied when the
+    whole request was admitted) at finish."""
+
+    def _init_big(self, cap: int) -> None:
+        self._big: "OrderedDict[tuple, Tuple[ExpiringValue, Counter]]" = (
+            OrderedDict()
+        )
+        self._big_inflight: Dict[tuple, int] = {}
+        self._big_cap = max(int(cap), 1)
+
+    @staticmethod
+    def _is_big(counter: Counter) -> bool:
+        return counter.max_value > K.MAX_VALUE_CAP
+
+    def _big_cell(self, counter: Counter, key: tuple) -> ExpiringValue:
+        entry = self._big.get(key)
+        if entry is not None:
+            self._big.move_to_end(key)
+            return entry[0]
+        cell = ExpiringValue(0, 0.0)
+        self._big[key] = (cell, counter.key())
+        while len(self._big) > self._big_cap:
+            evicted = False
+            for k in self._big:
+                if k != key and k not in self._big_inflight:
+                    del self._big[k]
+                    evicted = True
+                    break
+            if not evicted:
+                break
+        return cell
+
+    def _eval_big_hits(self, ordered, raw_delta: int, now: float):
+        """First pass of a request: decide its big hits host-side.
+        Returns (bigs, failed, projected) where each big is
+        (j, ok, remaining, ttl_s, key, counter, delta) and projected lists
+        (key, delta) reservations to release at finish."""
+        bigs: list = []
+        projected: List[Tuple[tuple, int]] = []
+        failed = False
+        for j, c in enumerate(ordered):
+            if not self._is_big(c):
+                continue
+            key = self._key_of(c)
+            cell = self._big_cell(c, key)
+            value = cell.value_at(now) + self._big_inflight.get(key, 0)
+            ok = value + raw_delta <= c.max_value
+            remaining = max(c.max_value - (value + raw_delta), 0)
+            ttl = (
+                float(c.window_seconds)
+                if cell.is_expired(now) else cell.ttl(now)
+            )
+            bigs.append((j, ok, remaining, ttl, key, c, raw_delta))
+            if ok:
+                self._big_inflight[key] = (
+                    self._big_inflight.get(key, 0) + raw_delta
+                )
+                projected.append((key, raw_delta))
+            else:
+                failed = True
+        return bigs, failed, projected
+
+    def _unproject_big(self, projected) -> None:
+        for key, delta in projected:
+            cur = self._big_inflight.get(key, 0) - delta
+            if cur > 0:
+                self._big_inflight[key] = cur
+            else:
+                self._big_inflight.pop(key, None)
+
+    def _apply_big(self, applies, now: float) -> None:
+        for key, delta, window in applies:
+            entry = self._big.get(key)
+            if entry is not None:
+                entry[0].update(delta, window, now)
+
+    def _emit_big_counters(self, limits, namespaces, now: float, out) -> None:
+        for _key, (cell, counter) in self._big.items():
+            if (
+                counter.limit in limits
+                or counter.namespace in namespaces
+            ) and not cell.is_expired(now):
+                c = counter.key()
+                c.remaining = c.max_value - cell.value_at(now)
+                c.expires_in = cell.ttl(now)
+                out.add(c)
+
+    def _delete_big(self, limits) -> None:
+        for key, (_cell, counter) in list(self._big.items()):
+            if counter.limit in limits:
+                del self._big[key]
+
+    def _clear_big(self) -> None:
+        self._big.clear()
+        self._big_inflight.clear()
+
+
 class _Request:
     """One logical check inside a ``check_many`` batch."""
 
@@ -114,10 +221,12 @@ class _CheckHandle:
     transfer is still in flight (double buffering)."""
 
     __slots__ = ("requests", "fresh_hits_by_req", "slot_use_count",
-                 "result", "seq", "watch_touches")
+                 "result", "seq", "watch_touches", "big_by_req",
+                 "dev_info_by_req", "now", "big_projected")
 
     def __init__(self, requests, fresh_hits_by_req, slot_use_count, result,
-                 seq, watch_touches):
+                 seq, watch_touches, big_by_req, dev_info_by_req, now,
+                 big_projected=()):
         self.requests = requests
         self.fresh_hits_by_req = fresh_hits_by_req
         self.slot_use_count = slot_use_count
@@ -127,9 +236,17 @@ class _CheckHandle:
         # finish pass deletes the ones still carrying this batch's seq so
         # the watch map stays bounded by in-flight work.
         self.watch_touches = watch_touches
+        # Host-side (max_value > device cap) hits, per request:
+        # (j, ok, remaining, ttl_s, key, counter, delta).
+        self.big_by_req = big_by_req
+        # Device hits per request: (j, delta_adjust) in device-array order.
+        self.dev_info_by_req = dev_info_by_req
+        self.now = now
+        # (key, delta) reservations in _big_inflight, released at finish.
+        self.big_projected = big_projected
 
 
-class TpuStorage(CounterStorage):
+class TpuStorage(_BigLimitMixin, CounterStorage):
     def __init__(
         self,
         capacity: int = 1 << 20,
@@ -150,6 +267,10 @@ class TpuStorage(CounterStorage):
         # slots watched for deferred release (see finish_check_many).
         self._seq = 0
         self._watched_slots: Dict[int, int] = {}
+        # Host-side fallback for limits whose max_value exceeds the int32
+        # device cap: these counters never get a device slot (see
+        # _BigLimitMixin); LRU-capped like the device's qualified cache.
+        self._init_big(self._cache_size)
 
     # -- time --------------------------------------------------------------
 
@@ -217,9 +338,14 @@ class TpuStorage(CounterStorage):
         """Build hit arrays and launch the kernel WITHOUT waiting for the
         device->host transfer. Table mutations are serialized under the
         lock in call order, which is also device program order, so batch
-        N+1 may begin while N's results are still in flight."""
-        nhits = sum(len(r.ordered) for r in requests)
-        H = _bucket(max(nhits, 1))
+        N+1 may begin while N's results are still in flight.
+
+        Counters whose max_value exceeds the device cap are decided
+        host-side here (exact Python ints): a failing big hit strips the
+        request's device deltas before the launch, so admission stays
+        all-or-nothing; passing big hits apply at finish only when the
+        device also admits (projected within the batch so concurrent big
+        hits never over-admit)."""
         # Build as Python lists (then one vectorized pad+convert): per-element
         # numpy scalar stores dominate the host loop otherwise.
         slots_l: List[int] = []
@@ -231,25 +357,40 @@ class TpuStorage(CounterStorage):
 
         with self._lock:
             now_ms = self._now_ms()
+            now = self._clock()
             self._seq += 1
             seq = self._seq
             watched = self._watched_slots
             fresh_hits_by_req: List[List[Tuple[int, Counter, int]]] = []
+            big_by_req: List[list] = []
+            dev_info_by_req: List[List[Tuple[int, int]]] = []
+            big_projected: List[Tuple[tuple, int]] = []
             watch_touches: List[int] = []
             slot_use_count: Dict[int, int] = {}
             slot_for = self._slot_for
             for r, request in enumerate(requests):
                 fresh_hits: List[Tuple[int, Counter, int]] = []
-                delta = min(int(request.delta), K.MAX_DELTA_CAP)
+                dev_info: List[Tuple[int, int]] = []
+                raw_delta = int(request.delta)
+                delta = min(raw_delta, K.MAX_DELTA_CAP)
+                bigs, big_failed, projected = self._eval_big_hits(
+                    request.ordered, raw_delta, now
+                )
+                big_projected.extend(projected)
+                dev_delta = 0 if big_failed else delta
+                adjust = delta if big_failed else 0
                 for j, c in enumerate(request.ordered):
+                    if c.max_value > K.MAX_VALUE_CAP:
+                        continue
                     slot, is_fresh = slot_for(c, create=True)
                     slots_l.append(slot)
-                    deltas_l.append(delta)
+                    deltas_l.append(dev_delta)
                     maxes_l.append(min(c.max_value, K.MAX_VALUE_CAP))
                     windows_l.append(_clamp_window_ms(c.window_seconds))
                     req_l.append(r)
                     fresh_l.append(is_fresh)
                     slot_use_count[slot] = slot_use_count.get(slot, 0) + 1
+                    dev_info.append((j, adjust))
                     if is_fresh:
                         fresh_hits.append((j, c, slot))
                         watch_touches.append(slot)
@@ -260,7 +401,11 @@ class TpuStorage(CounterStorage):
                         watched[slot] = seq
                         watch_touches.append(slot)
                 fresh_hits_by_req.append(fresh_hits)
+                big_by_req.append(bigs)
+                dev_info_by_req.append(dev_info)
 
+            nhits = len(slots_l)
+            H = _bucket(max(nhits, len(requests), 1))
             pad = H - nhits
             slots = np.asarray(
                 slots_l + [self._scratch] * pad, np.int32)
@@ -275,7 +420,7 @@ class TpuStorage(CounterStorage):
             )
         return _CheckHandle(
             requests, fresh_hits_by_req, slot_use_count, result, seq,
-            watch_touches,
+            watch_touches, big_by_req, dev_info_by_req, now, big_projected,
         )
 
     def finish_check_many(self, handle: _CheckHandle) -> List[Authorization]:
@@ -288,26 +433,47 @@ class TpuStorage(CounterStorage):
         import jax
 
         result = handle.result
-        # One transfer for all three outputs (matters over remote links).
-        hit_ok, remaining, ttl_ms = jax.device_get(
-            (result.hit_ok, result.remaining, result.ttl_ms)
-        )
+        try:
+            # One transfer for all three outputs (matters over remote links).
+            hit_ok, remaining, ttl_ms = jax.device_get(
+                (result.hit_ok, result.remaining, result.ttl_ms)
+            )
+        except BaseException:
+            # The projection reservations must not leak when the transfer
+            # fails, else those big counters under-admit forever.
+            with self._lock:
+                self._unproject_big(handle.big_projected)
+            raise
 
         auths: List[Authorization] = []
         releases: List[Tuple[Counter, int]] = []
+        big_applies: List[Tuple[tuple, int, int]] = []  # key, delta, window
         base = 0
         for r, request in enumerate(handle.requests):
-            n = len(request.ordered)
-            oks = hit_ok[base : base + n]
-            all_ok = bool(np.all(oks))
+            dev_info = handle.dev_info_by_req[r]
+            bigs = handle.big_by_req[r]
+            n_dev = len(dev_info)
+            oks_by_j: Dict[int, bool] = {}
+            for i, (j, _adjust) in enumerate(dev_info):
+                oks_by_j[j] = bool(hit_ok[base + i])
+            for j, ok, _rem, _ttl, _key, _c, _delta in bigs:
+                oks_by_j[j] = ok
+            all_ok = all(oks_by_j.values())
             if request.load:
-                for j, c in enumerate(request.ordered):
-                    c.remaining = int(remaining[base + j])
-                    c.expires_in = float(ttl_ms[base + j]) / 1000.0
+                for i, (j, adjust) in enumerate(dev_info):
+                    c = request.ordered[j]
+                    c.remaining = max(int(remaining[base + i]) - adjust, 0)
+                    c.expires_in = float(ttl_ms[base + i]) / 1000.0
+                for j, _ok, rem, ttl, _key, _c, _delta in bigs:
+                    c = request.ordered[j]
+                    c.remaining = rem
+                    c.expires_in = ttl
             if all_ok:
                 auths.append(Authorization.OK)
+                for _j, _ok, _rem, _ttl, key, c, delta in bigs:
+                    big_applies.append((key, delta, c.window_seconds))
             else:
-                first = int(np.argmin(oks))
+                first = min(j for j, ok in oks_by_j.items() if not ok)
                 auths.append(
                     Authorization.limited_by(
                         request.ordered[first].limit.name
@@ -317,8 +483,10 @@ class TpuStorage(CounterStorage):
                     for j, c, slot in handle.fresh_hits_by_req[r]:
                         if j > first and handle.slot_use_count.get(slot) == 1:
                             releases.append((c, slot))
-            base += n
+            base += n_dev
         with self._lock:
+            self._unproject_big(handle.big_projected)
+            self._apply_big(big_applies, handle.now)
             watched = self._watched_slots
             for c, slot in releases:
                 if watched.get(slot) != handle.seq:
@@ -350,6 +518,13 @@ class TpuStorage(CounterStorage):
     def is_within_limits(self, counter: Counter, delta: int) -> bool:
         with self._lock:
             now_ms = self._now_ms()
+            if self._is_big(counter):
+                entry = self._big.get(self._key_of(counter))
+                value = (
+                    entry[0].value_at(self._clock())
+                    if entry is not None else 0
+                )
+                return value + delta <= counter.max_value
             slot, _ = self._slot_for(counter, create=False)
             if slot is None:
                 value = 0
@@ -363,11 +538,19 @@ class TpuStorage(CounterStorage):
     def add_counter(self, limit: Limit) -> None:
         if not limit.variables:
             with self._lock:
-                self._slot_for(Counter(limit, {}), create=True)
+                counter = Counter(limit, {})
+                if self._is_big(counter):
+                    self._big_cell(counter, self._key_of(counter))
+                else:
+                    self._slot_for(counter, create=True)
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         with self._lock:
             now_ms = self._now_ms()
+            if self._is_big(counter):
+                cell = self._big_cell(counter, self._key_of(counter))
+                cell.update(int(delta), counter.window_seconds, self._clock())
+                return
             slot, is_fresh = self._slot_for(counter, create=True)
             H = _bucket(1)
             slots = np.full(H, self._scratch, np.int32)
@@ -436,6 +619,7 @@ class TpuStorage(CounterStorage):
         out: Set[Counter] = set()
         with self._lock:
             now_ms = self._now_ms()
+            now = self._clock()
             values = np.asarray(self._state.values)
             expiry = np.asarray(self._state.expiry_ms)
             namespaces = {limit.namespace for limit in limits}
@@ -451,6 +635,7 @@ class TpuStorage(CounterStorage):
                     c.remaining = c.max_value - int(values[slot])
                     c.expires_in = ttl / 1000.0
                     out.add(c)
+            self._emit_big_counters(limits, namespaces, now, out)
         return out
 
     def delete_counters(self, limits: Set[Limit]) -> None:
@@ -464,42 +649,58 @@ class TpuStorage(CounterStorage):
                 self._state = K.clear_slots(
                     self._state, np.asarray(doomed, np.int32)
                 )
+            self._delete_big(limits)
 
     def clear(self) -> None:
         with self._lock:
             self._table = _SlotTable(self._capacity)
             self._state = K.make_table(self._capacity)
             self._watched_slots.clear()
+            self._clear_big()
 
     def apply_deltas(self, items):
         """Authority-side batch apply for write-behind caches: one
         update_batch + one read, vectorized (the device table playing the
         shared-Redis role of the reference's cached topology)."""
-        n = len(items)
-        H = _bucket(max(n, 1))
-        slots = np.full(H, self._scratch, np.int32)
-        deltas = np.zeros(H, np.int32)
-        windows = np.zeros(H, np.int32)
-        fresh = np.zeros(H, bool)
         with self._lock:
             now_ms = self._now_ms()
+            now = self._clock()
+            dev_items: List[Tuple[int, Counter, int]] = []
+            results: List[Optional[Tuple[int, float]]] = [None] * len(items)
             for i, (counter, delta) in enumerate(items):
-                slot, is_fresh = self._slot_for(counter, create=True)
-                slots[i] = slot
-                deltas[i] = min(int(delta), K.MAX_DELTA_CAP)
-                windows[i] = _clamp_window_ms(counter.window_seconds)
-                fresh[i] = is_fresh
-            self._state = K.update_batch(
-                self._state, slots, deltas, windows, fresh, np.int32(now_ms)
-            )
-            values, ttls = K.read_slots(
-                self._state, slots[:n], np.int32(now_ms)
-            )
-            values = np.asarray(values)
-            ttls = np.asarray(ttls)
-        return [
-            (int(values[i]), float(ttls[i]) / 1000.0) for i in range(n)
-        ]
+                if self._is_big(counter):
+                    cell = self._big_cell(counter, self._key_of(counter))
+                    value = cell.update(
+                        int(delta), counter.window_seconds, now
+                    )
+                    results[i] = (value, cell.ttl(now))
+                else:
+                    dev_items.append((i, counter, delta))
+            if dev_items:
+                n = len(dev_items)
+                H = _bucket(n)
+                slots = np.full(H, self._scratch, np.int32)
+                deltas = np.zeros(H, np.int32)
+                windows = np.zeros(H, np.int32)
+                fresh = np.zeros(H, bool)
+                for k, (_i, counter, delta) in enumerate(dev_items):
+                    slot, is_fresh = self._slot_for(counter, create=True)
+                    slots[k] = slot
+                    deltas[k] = min(int(delta), K.MAX_DELTA_CAP)
+                    windows[k] = _clamp_window_ms(counter.window_seconds)
+                    fresh[k] = is_fresh
+                self._state = K.update_batch(
+                    self._state, slots, deltas, windows, fresh,
+                    np.int32(now_ms),
+                )
+                values, ttls = K.read_slots(
+                    self._state, slots[:n], np.int32(now_ms)
+                )
+                values = np.asarray(values)
+                ttls = np.asarray(ttls)
+                for k, (i, _counter, _delta) in enumerate(dev_items):
+                    results[i] = (int(values[k]), float(ttls[k]) / 1000.0)
+        return results
 
     # -- checkpoint / resume (SURVEY.md §5) ---------------------------------
 
@@ -521,6 +722,10 @@ class TpuStorage(CounterStorage):
                 "simple": dict(self._table.simple),
                 "qualified": list(self._table.qualified.items()),
                 "info": dict(self._table.info),
+                "big": {
+                    key: (cell.value_raw, cell.expiry, counter)
+                    for key, (cell, counter) in self._big.items()
+                },
             }
         with open(path, "wb") as f:
             pickle.dump({"values": values, "expiry": expiry, "table": table},
@@ -548,6 +753,8 @@ class TpuStorage(CounterStorage):
         self._table.simple = dict(table["simple"])
         self._table.qualified.update(table["qualified"])
         self._table.info = dict(table["info"])
+        for key, (value, expiry, counter) in table.get("big", {}).items():
+            self._big[key] = (ExpiringValue(value, expiry), counter)
         return self
 
     def close(self) -> None:

@@ -1,0 +1,131 @@
+"""max_value beyond the int32 device cap (the reference's max_value is
+u64, limit.rs:34): device-backed storages fall back to exact host-side
+counting for such limits."""
+
+import jax
+import pytest
+
+from limitador_tpu import Context, Limit, RateLimiter
+from limitador_tpu.core.counter import Counter
+from limitador_tpu.ops import kernel as K
+from limitador_tpu.tpu.storage import TpuStorage
+
+BIG = 1 << 40
+
+
+def make_limiter(storage):
+    limiter = RateLimiter(storage)
+    return limiter
+
+
+@pytest.fixture(params=["tpu", "sharded"])
+def storage(request):
+    if request.param == "tpu":
+        yield TpuStorage(capacity=256)
+    else:
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multiple devices")
+        from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+        s = TpuShardedStorage(local_capacity=512, global_region=16)
+        yield s
+        s.close()
+
+
+def test_big_limit_admits_and_reports_exactly(storage):
+    limiter = make_limiter(storage)
+    limiter.add_limit(Limit("ns", BIG, 60, [], ["u"]))
+    ctx = Context({"u": "a"})
+    for i in range(5):
+        r = limiter.check_rate_limited_and_update(
+            "ns", ctx, 1, load_counters=True
+        )
+        assert not r.limited
+        assert r.counters[0].remaining == BIG - (i + 1)
+    counters = limiter.get_counters("ns")
+    assert next(iter(counters)).remaining == BIG - 5
+
+
+def test_big_limit_enforces_at_the_real_boundary(storage):
+    """A huge max still rejects exactly past max (seeded near the edge)."""
+    limiter = make_limiter(storage)
+    limit = Limit("ns", BIG, 60, [], ["u"])
+    limiter.add_limit(limit)
+    counter = Counter(limit, {"u": "edge"})
+    storage.update_counter(counter, BIG - 2)
+    ctx = Context({"u": "edge"})
+    assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    assert limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    # The device path would have clamped max to 2^30 and rejected far
+    # earlier (or admitted forever past saturation); host math is exact.
+    assert storage.is_within_limits(counter, 0)
+    assert not storage.is_within_limits(counter, 1)
+
+
+def test_mixed_big_and_device_limits_all_or_nothing(storage):
+    """One request touching a big-max and a device counter: a reject on
+    either side must leave the other untouched."""
+    limiter = make_limiter(storage)
+    big = Limit("ns", BIG, 3600, [], ["u"], name="big")
+    small = Limit("ns", 2, 60, [], ["u"], name="small")
+    limiter.add_limit(big)
+    limiter.add_limit(small)
+    ctx = Context({"u": "mix"})
+    for _ in range(2):
+        assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    r = limiter.check_rate_limited_and_update("ns", ctx, 1)
+    assert r.limited and r.limit_name == "small"
+    by_name = {c.limit.name: c for c in limiter.get_counters("ns")}
+    # The big counter saw exactly the two admitted hits.
+    assert by_name["big"].remaining == BIG - 2
+
+
+def test_big_reject_strips_device_delta(storage):
+    """Symmetric: a failing big hit must not increment device counters."""
+    limiter = make_limiter(storage)
+    big = Limit("ns", BIG, 3600, [], ["u"], name="big")
+    small = Limit("ns", 100, 60, [], ["u"], name="small")
+    limiter.add_limit(big)
+    limiter.add_limit(small)
+    counter = Counter(big, {"u": "strip"})
+    storage.update_counter(counter, BIG)  # big budget exhausted
+    ctx = Context({"u": "strip"})
+    r = limiter.check_rate_limited_and_update("ns", ctx, 1)
+    assert r.limited and r.limit_name == "big"
+    by_name = {c.limit.name: c for c in limiter.get_counters("ns")}
+    assert by_name.get("small") is None or by_name["small"].remaining == 100
+
+
+def test_big_window_expiry(storage, fake_clock=None):
+    limiter = make_limiter(storage)
+    limiter.add_limit(Limit("ns", BIG, 1, [], ["u"]))  # 1s window
+    ctx = Context({"u": "w"})
+    import time
+
+    assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    time.sleep(1.1)
+    r = limiter.check_rate_limited_and_update("ns", ctx, 1, True)
+    assert not r.limited
+    assert r.counters[0].remaining == BIG - 1  # fresh window
+
+
+def test_big_apply_deltas_and_delete(storage):
+    limit = Limit("ns", BIG, 60, [], ["u"])
+    c = Counter(limit, {"u": "d"})
+    out = storage.apply_deltas([(c, 7)])
+    assert out[0][0] == 7
+    storage.delete_counters({limit})
+    assert storage.is_within_limits(c, BIG)
+
+
+def test_big_snapshot_roundtrip(tmp_path):
+    storage = TpuStorage(capacity=128)
+    limit = Limit("ns", BIG, 3600, [], ["u"])
+    c = Counter(limit, {"u": "snap"})
+    storage.update_counter(c, 123)
+    path = str(tmp_path / "ckpt.pkl")
+    storage.snapshot(path)
+    restored = TpuStorage.restore(path)
+    assert not restored.is_within_limits(c, BIG - 122)
+    assert restored.is_within_limits(c, BIG - 123)

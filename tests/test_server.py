@@ -431,3 +431,96 @@ class TestObservabilityExtras:
             )
             initial = dict(call[1].initial_metadata())
             assert initial.get("x-request-id") == "rid-42"
+
+
+def test_api_spec_served():
+    """/api/spec serves an OpenAPI doc covering every endpoint
+    (http_api/server.rs:282-330)."""
+    import asyncio
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    async def main():
+        app = make_http_app(RateLimiter(), None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/api/spec")
+        spec = await resp.json()
+        await client.close()
+        return resp.status, spec
+
+    loop = asyncio.new_event_loop()
+    try:
+        status, spec = loop.run_until_complete(main())
+    finally:
+        loop.close()
+    assert status == 200
+    assert spec["openapi"].startswith("3.")
+    for path in ("/status", "/metrics", "/limits/{namespace}",
+                 "/counters/{namespace}", "/check", "/report",
+                 "/check_and_report"):
+        assert path in spec["paths"], path
+    assert set(spec["components"]["schemas"]) == {
+        "Limit", "Counter", "CheckAndReportInfo"
+    }
+
+
+def test_metric_labels_reload(tmp_path):
+    """Label value expressions hot-swap; new names are rejected (prometheus
+    label names are fixed per process)."""
+    from limitador_tpu import Context
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+
+    metrics = PrometheusMetrics(
+        metric_labels="{'tenant': descriptors[0].t}"
+    )
+    ctx = Context()
+    ctx.list_binding("descriptors", [{"t": "acme", "other": "x"}])
+    assert metrics.custom_labels(ctx) == ["acme"]
+    metrics.reload_labels("{'tenant': descriptors[0].other}")
+    assert metrics.custom_labels(ctx) == ["x"]
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        metrics.reload_labels("{'brand_new': descriptors[0].t}")
+
+
+def test_metric_labels_file_watcher(tmp_path):
+    """Editing the labels file takes effect without restart (the watcher
+    path used by the server's --metric-labels-file)."""
+    import time
+
+    from limitador_tpu import Context
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+    from limitador_tpu.server.limits_file import LimitsFileWatcher
+
+    path = tmp_path / "labels.cel"
+    path.write_text("{'tenant': descriptors[0].t}")
+    metrics = PrometheusMetrics(metric_labels=path.read_text())
+
+    def _load(p):
+        with open(p) as f:
+            return f.read().strip()
+
+    watcher = LimitsFileWatcher(
+        str(path),
+        lambda content: metrics.reload_labels(content),
+        poll_interval=0.05,
+        loader=_load,
+    )
+    watcher.start()
+    try:
+        ctx = Context()
+        ctx.list_binding("descriptors", [{"t": "acme", "other": "x"}])
+        assert metrics.custom_labels(ctx) == ["acme"]
+        time.sleep(0.1)
+        path.write_text("{'tenant': descriptors[0].other}")
+        deadline = time.time() + 5
+        while metrics.custom_labels(ctx) != ["x"]:
+            assert time.time() < deadline, "labels never reloaded"
+            time.sleep(0.05)
+    finally:
+        watcher.stop()
