@@ -176,6 +176,19 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _preserve_rejected_snapshot(path: str) -> None:
+    """A checkpoint we could not restore must be moved aside, NOT left in
+    place: the fresh table's periodic snapshot loop would overwrite it,
+    destroying counters that a correctly-configured restart could still
+    recover."""
+    rejected = path + ".rejected"
+    try:
+        os.replace(path, rejected)
+        print(f"preserved rejected snapshot as {rejected}", file=sys.stderr)
+    except OSError as exc:
+        print(f"could not preserve rejected snapshot: {exc}", file=sys.stderr)
+
+
 def build_limiter(args, on_partitioned=None):
     """Limiter::new equivalent (main.rs:93-185): pick + build the backend.
     ``on_partitioned`` reaches storages that track authority partitions
@@ -206,6 +219,7 @@ def build_limiter(args, on_partitioned=None):
                     "starting with a fresh table",
                     file=sys.stderr,
                 )
+                _preserve_rejected_snapshot(args.snapshot_path)
             else:
                 print(
                     f"restored counter table from {args.snapshot_path}",
@@ -244,21 +258,58 @@ def build_limiter(args, on_partitioned=None):
         from ..tpu.batcher import AsyncTpuStorage
         from ..tpu.sharded import TpuShardedStorage
 
-        if args.snapshot_path:
-            print(
-                "warning: --snapshot-path is not yet supported by the "
-                "sharded storage; counters will not persist across restarts",
-                file=sys.stderr,
+        storage = None
+        if args.snapshot_path and os.path.exists(args.snapshot_path):
+            try:
+                storage = TpuShardedStorage.restore(args.snapshot_path)
+            except Exception as exc:
+                print(
+                    f"snapshot {args.snapshot_path} unreadable ({exc}); "
+                    "starting with a fresh sharded table",
+                    file=sys.stderr,
+                )
+                _preserve_rejected_snapshot(args.snapshot_path)
+            else:
+                print(
+                    f"restored sharded counter table from "
+                    f"{args.snapshot_path}",
+                    file=sys.stderr,
+                )
+                cli_global_ns = {
+                    ns
+                    for ns in (args.global_namespaces or "").split(",")
+                    if ns
+                }
+                overrides = [
+                    (name, cli, snap)
+                    for name, cli, snap in (
+                        ("--tpu-capacity", args.tpu_capacity,
+                         storage._local_capacity),
+                        ("--global-region", args.global_region,
+                         storage._global_region),
+                        ("--global-namespaces", cli_global_ns,
+                         storage._global_ns),
+                    )
+                    if cli != snap
+                ]
+                for name, cli, snap in overrides:
+                    print(
+                        f"warning: snapshot {name}={snap!r} overrides the "
+                        f"command line's {cli!r} (key routing must match "
+                        "the checkpoint)",
+                        file=sys.stderr,
+                    )
+        if storage is None:
+            storage = TpuShardedStorage(
+                local_capacity=args.tpu_capacity,
+                cache_size=args.cache_size,
+                global_namespaces=[
+                    ns
+                    for ns in (args.global_namespaces or "").split(",")
+                    if ns
+                ],
+                global_region=args.global_region,
             )
-
-        storage = TpuShardedStorage(
-            local_capacity=args.tpu_capacity,
-            cache_size=args.cache_size,
-            global_namespaces=[
-                ns for ns in (args.global_namespaces or "").split(",") if ns
-            ],
-            global_region=args.global_region,
-        )
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6
         )
@@ -471,7 +522,7 @@ async def _amain(args) -> int:
     )
 
     snapshot_task = None
-    if args.storage == "tpu" and args.snapshot_path:
+    if args.storage in ("tpu", "sharded") and args.snapshot_path:
         tpu_storage = limiter.storage.counters.inner
 
         import threading

@@ -530,37 +530,44 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             namespaces = {limit.namespace for limit in limits}
-            values = np.asarray(self._state.values)
-            expiry = np.asarray(self._state.expiry_ms)
-
-            def emit(counter: Counter, shard, slot, is_g):
-                if is_g:
-                    exps = expiry[:, slot]
-                    live = exps > now_ms
+            g_matching = [
+                (slot, counter)
+                for slot, (_key, counter) in self._gtable.info.items()
+                if counter.limit in limits or counter.namespace in namespaces
+            ]
+            l_matching = [
+                (shard, slot, counter)
+                for shard, table in enumerate(self._tables)
+                for slot, (_key, counter) in table.info.items()
+                if counter.limit in limits or counter.namespace in namespaces
+            ]
+            # Device-side gathers of only the matching cells: O(matching)
+            # transferred, not the whole [n_shards, capacity] table.
+            if g_matching:
+                gsl = np.asarray([s for s, _c in g_matching], np.int32)
+                gv = np.asarray(self._state.values[:, gsl])
+                ge = np.asarray(self._state.expiry_ms[:, gsl])
+                for col, (_slot, counter) in enumerate(g_matching):
+                    live = ge[:, col] > now_ms
                     if not live.any():
-                        return
-                    value = int(values[live, slot].sum())
-                    ttl = int(exps.max()) - now_ms
-                else:
-                    ttl = int(expiry[shard, slot]) - now_ms
+                        continue
+                    c = counter.key()
+                    c.remaining = c.max_value - int(gv[live, col].sum())
+                    c.expires_in = (int(ge[:, col].max()) - now_ms) / 1000.0
+                    out.add(c)
+            if l_matching:
+                lsh = np.asarray([s for s, _sl, _c in l_matching], np.int32)
+                lsl = np.asarray([sl for _s, sl, _c in l_matching], np.int32)
+                lv = np.asarray(self._state.values[lsh, lsl])
+                le = np.asarray(self._state.expiry_ms[lsh, lsl])
+                for i, (_shard, _slot, counter) in enumerate(l_matching):
+                    ttl = int(le[i]) - now_ms
                     if ttl <= 0:
-                        return
-                    value = int(values[shard, slot])
-                c = counter.key()
-                c.remaining = c.max_value - value
-                c.expires_in = ttl / 1000.0
-                out.add(c)
-
-            for slot, (_key, counter) in self._gtable.info.items():
-                if counter.limit in limits or counter.namespace in namespaces:
-                    emit(counter, None, slot, True)
-            for shard, table in enumerate(self._tables):
-                for slot, (_key, counter) in table.info.items():
-                    if (
-                        counter.limit in limits
-                        or counter.namespace in namespaces
-                    ):
-                        emit(counter, shard, slot, False)
+                        continue
+                    c = counter.key()
+                    c.remaining = c.max_value - int(lv[i])
+                    c.expires_in = ttl / 1000.0
+                    out.add(c)
             self._emit_big_counters(limits, namespaces, self._clock(), out)
         return out
 
@@ -597,6 +604,98 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             self._state = make_sharded_table(
                 self._mesh, self._local_capacity
             )
+
+    # -- checkpoint / resume -------------------------------------------------
+
+    def snapshot(self, path: str) -> None:
+        """Sparse checkpoint of the sharded table: occupied shard-local
+        cells + the global region's per-shard partials + the host key
+        space (same reopen semantics as TpuStorage.snapshot)."""
+        import pickle
+
+        with self._lock:
+            locs = [
+                (shard, slot)
+                for shard, table in enumerate(self._tables)
+                for slot in table.info
+            ]
+            gslots = np.asarray(sorted(self._gtable.info), np.int32)
+            if locs:
+                lsh = np.asarray([s for s, _ in locs], np.int32)
+                lsl = np.asarray([sl for _, sl in locs], np.int32)
+                lvalues = np.asarray(self._state.values[lsh, lsl])
+                lexpiry = np.asarray(self._state.expiry_ms[lsh, lsl])
+            else:
+                lvalues = lexpiry = np.zeros(0, np.int32)
+            if gslots.size:
+                gvalues = np.asarray(self._state.values[:, gslots])
+                gexpiry = np.asarray(self._state.expiry_ms[:, gslots])
+            else:
+                gvalues = gexpiry = np.zeros((self._n, 0), np.int32)
+            payload = {
+                "format": 1,
+                "n_shards": self._n,
+                "local_capacity": self._local_capacity,
+                "global_region": self._global_region,
+                "global_namespaces": sorted(self._global_ns),
+                "cache_size": self._cache_size,
+                "epoch": self._epoch,
+                "locs": locs,
+                "lvalues": lvalues,
+                "lexpiry": lexpiry,
+                "gslots": gslots,
+                "gvalues": gvalues,
+                "gexpiry": gexpiry,
+                "tables": [t.dump() for t in self._tables],
+                "gtable": self._gtable.dump(),
+                "big": {
+                    key: (cell.value_raw, cell.expiry, counter)
+                    for key, (cell, counter) in self._big.items()
+                },
+            }
+        with open(path, "wb") as f:
+            pickle.dump(payload, f)
+
+    @classmethod
+    def restore(
+        cls, path: str, mesh=None, clock=time.time
+    ) -> "TpuShardedStorage":
+        import pickle
+
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        self = cls(
+            mesh=mesh,
+            local_capacity=data["local_capacity"],
+            cache_size=data["cache_size"],
+            global_namespaces=data["global_namespaces"],
+            global_region=data["global_region"],
+            clock=clock,
+        )
+        if self._n != data["n_shards"]:
+            raise StorageError(
+                f"snapshot was taken on {data['n_shards']} shards, mesh "
+                f"has {self._n} (key routing would change)"
+            )
+        self._epoch = data["epoch"]
+        values, expiry = self._state.values, self._state.expiry_ms
+        locs = data["locs"]
+        if locs:
+            lsh = np.asarray([s for s, _ in locs], np.int32)
+            lsl = np.asarray([sl for _, sl in locs], np.int32)
+            values = values.at[lsh, lsl].set(np.asarray(data["lvalues"]))
+            expiry = expiry.at[lsh, lsl].set(np.asarray(data["lexpiry"]))
+        gslots = np.asarray(data["gslots"], np.int32)
+        if gslots.size:
+            values = values.at[:, gslots].set(np.asarray(data["gvalues"]))
+            expiry = expiry.at[:, gslots].set(np.asarray(data["gexpiry"]))
+        self._state = ShardedCounterState(values, expiry)
+        for table, dump in zip(self._tables, data["tables"]):
+            table.load(dump, self._global_region, self._local_capacity)
+        self._gtable.load(data["gtable"], 0, self._global_region)
+        for key, (value, exp, counter) in data.get("big", {}).items():
+            self._big[key] = (ExpiringValue(value, exp), counter)
+        return self
 
     def close(self) -> None:
         pass

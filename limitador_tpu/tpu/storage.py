@@ -79,6 +79,29 @@ class _SlotTable:
             return slot
         return self.simple.get(key)
 
+    def dump(self) -> dict:
+        """Checkpoint form. The free list is NOT persisted (it would be
+        O(capacity)); ``load`` derives it from the occupied set."""
+        return {
+            "simple": dict(self.simple),
+            "qualified": list(self.qualified.items()),
+            "info": dict(self.info),
+        }
+
+    def load(self, data: dict, lo: int, hi: int) -> None:
+        """Restore from ``dump`` output; slots of this table live in
+        [lo, hi)."""
+        self.simple = dict(data["simple"])
+        self.qualified.update(data["qualified"])
+        self.info = dict(data["info"])
+        if "free" in data:  # older checkpoints persisted the free list
+            self.free = list(data["free"])
+        else:
+            occupied = set(self.info)
+            self.free = [
+                s for s in range(hi - 1, lo - 1, -1) if s not in occupied
+            ]
+
     def release(self, slot: int, key: tuple, qualified: bool) -> None:
         self.info.pop(slot, None)
         if qualified:
@@ -620,20 +643,29 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()
-            values = np.asarray(self._state.values)
-            expiry = np.asarray(self._state.expiry_ms)
             namespaces = {limit.namespace for limit in limits}
-            for slot, (_key, counter) in self._table.info.items():
-                if (
-                    counter.limit in limits
-                    or counter.namespace in namespaces
-                ):
-                    ttl = int(expiry[slot]) - now_ms
-                    if ttl <= 0:
+            # Gather ONLY the matching live slots — O(matching counters)
+            # transferred, not O(capacity) (the reference iterates a
+            # namespace prefix the same way, rocksdb_storage.rs:91-130).
+            matching: List[Tuple[int, Counter]] = [
+                (slot, counter)
+                for slot, (_key, counter) in self._table.info.items()
+                if counter.limit in limits or counter.namespace in namespaces
+            ]
+            if matching:
+                slot_arr = np.asarray([s for s, _c in matching], np.int32)
+                values, ttls = K.read_slots(
+                    self._state, slot_arr, np.int32(now_ms)
+                )
+                values = np.asarray(values)
+                ttls = np.asarray(ttls)
+                for i, (_slot, counter) in enumerate(matching):
+                    ttl_ms = int(ttls[i])
+                    if ttl_ms <= 0:
                         continue
                     c = counter.key()
-                    c.remaining = c.max_value - int(values[slot])
-                    c.expires_in = ttl / 1000.0
+                    c.remaining = c.max_value - int(values[i])
+                    c.expires_in = ttl_ms / 1000.0
                     out.add(c)
             self._emit_big_counters(limits, namespaces, now, out)
         return out
@@ -705,31 +737,45 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
     # -- checkpoint / resume (SURVEY.md §5) ---------------------------------
 
     def snapshot(self, path: str) -> None:
-        """Persist the full counter state (device arrays + host key space)
-        so a restart resumes counting — the reopen semantics the reference
-        gets from RocksDB (rocksdb_storage.rs:237-287), for the device
-        table."""
+        """Persist the counter state (device cells + host key space) so a
+        restart resumes counting — the reopen semantics the reference gets
+        from RocksDB (rocksdb_storage.rs:237-287), for the device table.
+
+        Sparse: only occupied slots are transferred and written, so the
+        checkpoint costs O(live counters), not O(capacity)."""
         import pickle
 
         with self._lock:
-            values = np.asarray(self._state.values)
-            expiry = np.asarray(self._state.expiry_ms)
+            occupied = np.asarray(sorted(self._table.info), np.int32)
+            if occupied.size:
+                # Device-side gather: only the occupied cells cross the
+                # host link, not the whole table.
+                values = np.asarray(self._state.values[occupied])
+                expiry = np.asarray(self._state.expiry_ms[occupied])
+            else:
+                values = np.zeros(0, np.int32)
+                expiry = np.zeros(0, np.int32)
             table = {
                 "capacity": self._capacity,
                 "cache_size": self._cache_size,
                 "epoch": self._epoch,
-                "free": list(self._table.free),
-                "simple": dict(self._table.simple),
-                "qualified": list(self._table.qualified.items()),
-                "info": dict(self._table.info),
+                **self._table.dump(),
                 "big": {
                     key: (cell.value_raw, cell.expiry, counter)
                     for key, (cell, counter) in self._big.items()
                 },
             }
         with open(path, "wb") as f:
-            pickle.dump({"values": values, "expiry": expiry, "table": table},
-                        f)
+            pickle.dump(
+                {
+                    "format": 2,
+                    "slots": occupied,
+                    "values": values,
+                    "expiry": expiry,
+                    "table": table,
+                },
+                f,
+            )
 
     @classmethod
     def restore(cls, path: str, clock=time.time) -> "TpuStorage":
@@ -745,14 +791,23 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         # Keep the saved epoch so absolute expiries stay correct; _now_ms
         # rebases on its own schedule afterwards.
         self._epoch = table["epoch"]
-        self._state = K.CounterTableState(
-            values=K.jnp.asarray(data["values"]),
-            expiry_ms=K.jnp.asarray(data["expiry"]),
-        )
-        self._table.free = list(table["free"])
-        self._table.simple = dict(table["simple"])
-        self._table.qualified.update(table["qualified"])
-        self._table.info = dict(table["info"])
+        if data.get("format", 1) >= 2:
+            slots = np.asarray(data["slots"], np.int32)
+            if slots.size:
+                self._state = K.CounterTableState(
+                    values=self._state.values.at[slots].set(
+                        K.jnp.asarray(data["values"])
+                    ),
+                    expiry_ms=self._state.expiry_ms.at[slots].set(
+                        K.jnp.asarray(data["expiry"])
+                    ),
+                )
+        else:  # round-1 dense checkpoints
+            self._state = K.CounterTableState(
+                values=K.jnp.asarray(data["values"]),
+                expiry_ms=K.jnp.asarray(data["expiry"]),
+            )
+        self._table.load(table, 0, self._capacity)
         for key, (value, expiry, counter) in table.get("big", {}).items():
             self._big[key] = (ExpiringValue(value, expiry), counter)
         return self

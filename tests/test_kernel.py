@@ -74,3 +74,27 @@ def test_epoch_rebase_survives_month_long_idle(fake_clock):
     fake_clock.advance(40 * 24 * 3600)  # 40 days > 2^31 ms
     assert storage.is_within_limits(c, 10)  # window long expired
     storage.update_counter(c, 1)  # and the table still works
+
+
+def test_sparse_snapshot_size_scales_with_live_counters(tmp_path):
+    """Checkpoint size is O(live counters), not O(capacity)."""
+    import os
+
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    limit = Limit("ns", 100, 600, [], ["u"])
+    big_table = TpuStorage(capacity=1 << 18)  # 262k slots
+    for u in range(10):
+        big_table.update_counter(Counter(limit, {"u": str(u)}), 1)
+    path = str(tmp_path / "sparse.ckpt")
+    big_table.snapshot(path)
+    size = os.path.getsize(path)
+    # A dense dump of 2 x int32 x 262k slots alone would be ~2MB.
+    assert size < 64 * 1024, size
+
+    restored = TpuStorage.restore(path)
+    c = Counter(limit, {"u": "3"})  # restored with value 1
+    assert not restored.is_within_limits(c, 100)
+    assert restored.is_within_limits(c, 99)

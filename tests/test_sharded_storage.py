@@ -171,6 +171,41 @@ def test_epoch_rebase_survives_month_long_idle(fake_clock):
     assert out[0][0] == 2  # fresh window after the idle gap
 
 
+def test_snapshot_restore_roundtrip(tmp_path):
+    """Sharded checkpoint: local cells, psum global partials, and the key
+    space all survive a restart."""
+    storage = make_storage(global_namespaces=["g"])
+    limiter = RateLimiter(storage)
+    limit = Limit("ns", 10, 600, [], ["u"])
+    glimit = Limit("g", 20, 600, [], [])
+    limiter.add_limit(limit)
+    limiter.add_limit(glimit)
+    for u in ("a", "b"):
+        for _ in range(3):
+            limiter.check_rate_limited_and_update("ns", Context({"u": u}), 1)
+    for _ in range(5):
+        limiter.check_rate_limited_and_update("g", Context({}), 1)
+    path = str(tmp_path / "sharded.ckpt")
+    storage.snapshot(path)
+
+    restored = TpuShardedStorage.restore(path)
+    limiter2 = RateLimiter(restored)
+    limiter2.add_limit(limit)
+    limiter2.add_limit(glimit)
+    counters = {
+        (c.namespace, c.set_variables.get("u")): c.remaining
+        for c in limiter2.get_counters("ns") | limiter2.get_counters("g")
+    }
+    assert counters[("ns", "a")] == 7
+    assert counters[("ns", "b")] == 7
+    assert counters[("g", None)] == 15
+    # And counting continues exactly from the restored state.
+    for _ in range(15):
+        r = limiter2.check_rate_limited_and_update("g", Context({}), 1)
+        assert not r.limited
+    assert limiter2.check_rate_limited_and_update("g", Context({}), 1).limited
+
+
 def test_qualified_eviction_and_revival():
     storage = make_storage(cache_size=8)  # 1 qualified slot per shard
     limiter = RateLimiter(storage)
