@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, List, Optional, Set, Union
 
+from ..observability.tracing import datastore_span
 from ..storage.base import (
     AsyncCounterStorage,
     AsyncStorage,
@@ -129,17 +130,19 @@ class RateLimiter:
     ) -> CheckResult:
         """Read-only check (lib.rs:362-385)."""
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
-        for counter in counters:
-            if not self.storage.is_within_limits(counter, delta):
-                return CheckResult(True, [], counter.limit.name)
+        with datastore_span("is_within_limits"):
+            for counter in counters:
+                if not self.storage.is_within_limits(counter, delta):
+                    return CheckResult(True, [], counter.limit.name)
         return CheckResult(False, [], None)
 
     def update_counters(
         self, namespace: Union[str, Namespace], ctx: Context, delta: int
     ) -> None:
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
-        for counter in counters:
-            self.storage.update_counter(counter, delta)
+        with datastore_span("update_counter"):
+            for counter in counters:
+                self.storage.update_counter(counter, delta)
 
     def check_rate_limited_and_update(
         self,
@@ -152,7 +155,10 @@ class RateLimiter:
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
         if not counters:
             return CheckResult(False, counters, None)
-        auth = self.storage.check_and_update(counters, delta, load_counters)
+        with datastore_span("check_and_update"):
+            auth = self.storage.check_and_update(
+                counters, delta, load_counters
+            )
         loaded = counters if load_counters else []
         if auth.limited:
             return CheckResult(True, loaded, auth.limit_name)
@@ -206,17 +212,19 @@ class AsyncRateLimiter:
         self, namespace: Union[str, Namespace], ctx: Context, delta: int
     ) -> CheckResult:
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
-        for counter in counters:
-            if not await self.storage.is_within_limits(counter, delta):
-                return CheckResult(True, [], counter.limit.name)
+        with datastore_span("is_within_limits"):
+            for counter in counters:
+                if not await self.storage.is_within_limits(counter, delta):
+                    return CheckResult(True, [], counter.limit.name)
         return CheckResult(False, [], None)
 
     async def update_counters(
         self, namespace: Union[str, Namespace], ctx: Context, delta: int
     ) -> None:
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
-        for counter in counters:
-            await self.storage.update_counter(counter, delta)
+        with datastore_span("update_counter"):
+            for counter in counters:
+                await self.storage.update_counter(counter, delta)
 
     async def check_rate_limited_and_update(
         self,
@@ -228,7 +236,10 @@ class AsyncRateLimiter:
         counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
         if not counters:
             return CheckResult(False, counters, None)
-        auth = await self.storage.check_and_update(counters, delta, load_counters)
+        with datastore_span("check_and_update"):
+            auth = await self.storage.check_and_update(
+                counters, delta, load_counters
+            )
         loaded = counters if load_counters else []
         if auth.limited:
             return CheckResult(True, loaded, auth.limit_name)

@@ -110,6 +110,18 @@ class PrometheusMetrics:
             "Counters evicted from the cache while holding unflushed deltas",
             registry=self.registry,
         )
+        self.cel_vectorized_evals = Counter(
+            "cel_vectorized_evals",
+            "(request, limit) evaluations served by the vectorized "
+            "compiler",
+            registry=self.registry,
+        )
+        self.cel_fallback_evals = Counter(
+            "cel_fallback_evals",
+            "(request, limit) evaluations that fell back to the CEL "
+            "interpreter",
+            registry=self.registry,
+        )
         self._library_sources: list = []
         self._counter_baselines: dict = {}
 
@@ -131,7 +143,12 @@ class PrometheusMetrics:
                 continue
             batcher_size += int(stats.get("batcher_size", 0))
             cache_size += int(stats.get("cache_size", 0))
-            for key in ("counter_overshoot", "evicted_pending_writes"):
+            for key in (
+                "counter_overshoot",
+                "evicted_pending_writes",
+                "cel_vectorized_evals",
+                "cel_fallback_evals",
+            ):
                 if key in stats:
                     seen = int(stats[key])
                     baseline = self._counter_baselines.get((i, key), 0)

@@ -26,7 +26,7 @@ except Exception:  # pragma: no cover - otel API absent
 # path, which is not "free" at 10^5 req/s.
 _enabled = False
 
-__all__ = ["configure_tracing", "should_rate_limit_span"]
+__all__ = ["configure_tracing", "should_rate_limit_span", "datastore_span"]
 
 
 def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
@@ -61,6 +61,20 @@ def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
 
 def _noop_record(limited, name):
     pass
+
+
+@contextmanager
+def datastore_span(op: str):
+    """Span around one storage I/O (the reference instruments every
+    storage method and wraps backend I/O in info_span!("datastore"),
+    in_memory.rs:19-71, redis_async.rs:42-87). No-op unless an exporter
+    is configured."""
+    if _tracer is None or not _enabled:
+        yield
+        return
+    with _tracer.start_as_current_span("datastore") as span:
+        span.set_attribute("datastore.operation", op)
+        yield
 
 
 @contextmanager

@@ -176,6 +176,25 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _try_restore(path, restore_fn, what: str):
+    """Restore-or-None with rejected-checkpoint preservation (shared by
+    the tpu and sharded branches)."""
+    if not (path and os.path.exists(path)):
+        return None
+    try:
+        storage = restore_fn(path)
+    except Exception as exc:
+        print(
+            f"snapshot {path} unreadable ({exc}); starting with a fresh "
+            f"{what}",
+            file=sys.stderr,
+        )
+        _preserve_rejected_snapshot(path)
+        return None
+    print(f"restored {what} from {path}", file=sys.stderr)
+    return storage
+
+
 def _preserve_rejected_snapshot(path: str) -> None:
     """A checkpoint we could not restore must be moved aside, NOT left in
     place: the fresh table's periodic snapshot loop would overwrite it,
@@ -209,28 +228,17 @@ def build_limiter(args, on_partitioned=None):
         from ..tpu.batcher import AsyncTpuStorage
         from ..tpu.storage import TpuStorage
 
-        storage = None
-        if args.snapshot_path and os.path.exists(args.snapshot_path):
-            try:
-                storage = TpuStorage.restore(args.snapshot_path)
-            except Exception as exc:
-                print(
-                    f"snapshot {args.snapshot_path} unreadable ({exc}); "
-                    "starting with a fresh table",
-                    file=sys.stderr,
-                )
-                _preserve_rejected_snapshot(args.snapshot_path)
-            else:
-                print(
-                    f"restored counter table from {args.snapshot_path}",
-                    file=sys.stderr,
-                )
-                if storage._capacity != args.tpu_capacity:
-                    print(
-                        f"warning: snapshot capacity {storage._capacity} "
-                        f"overrides --tpu-capacity {args.tpu_capacity}",
-                        file=sys.stderr,
-                    )
+        storage = _try_restore(
+            args.snapshot_path,
+            lambda p: TpuStorage.restore(p, cache_size=args.cache_size),
+            "counter table",
+        )
+        if storage is not None and storage._capacity != args.tpu_capacity:
+            print(
+                f"warning: snapshot capacity {storage._capacity} "
+                f"overrides --tpu-capacity {args.tpu_capacity}",
+                file=sys.stderr,
+            )
         if storage is None:
             if args.peer or args.listen_address:
                 from ..tpu.replicated import TpuReplicatedStorage
@@ -258,56 +266,41 @@ def build_limiter(args, on_partitioned=None):
         from ..tpu.batcher import AsyncTpuStorage
         from ..tpu.sharded import TpuShardedStorage
 
-        storage = None
-        if args.snapshot_path and os.path.exists(args.snapshot_path):
-            try:
-                storage = TpuShardedStorage.restore(args.snapshot_path)
-            except Exception as exc:
+        cli_global_ns = {
+            ns for ns in (args.global_namespaces or "").split(",") if ns
+        }
+        storage = _try_restore(
+            args.snapshot_path,
+            lambda p: TpuShardedStorage.restore(
+                p, cache_size=args.cache_size
+            ),
+            "sharded counter table",
+        )
+        if storage is not None:
+            overrides = [
+                (name, cli, snap)
+                for name, cli, snap in (
+                    ("--tpu-capacity", args.tpu_capacity,
+                     storage._local_capacity),
+                    ("--global-region", args.global_region,
+                     storage._global_region),
+                    ("--global-namespaces", cli_global_ns,
+                     storage._global_ns),
+                )
+                if cli != snap
+            ]
+            for name, cli, snap in overrides:
                 print(
-                    f"snapshot {args.snapshot_path} unreadable ({exc}); "
-                    "starting with a fresh sharded table",
+                    f"warning: snapshot {name}={snap!r} overrides the "
+                    f"command line's {cli!r} (key routing must match "
+                    "the checkpoint)",
                     file=sys.stderr,
                 )
-                _preserve_rejected_snapshot(args.snapshot_path)
-            else:
-                print(
-                    f"restored sharded counter table from "
-                    f"{args.snapshot_path}",
-                    file=sys.stderr,
-                )
-                cli_global_ns = {
-                    ns
-                    for ns in (args.global_namespaces or "").split(",")
-                    if ns
-                }
-                overrides = [
-                    (name, cli, snap)
-                    for name, cli, snap in (
-                        ("--tpu-capacity", args.tpu_capacity,
-                         storage._local_capacity),
-                        ("--global-region", args.global_region,
-                         storage._global_region),
-                        ("--global-namespaces", cli_global_ns,
-                         storage._global_ns),
-                    )
-                    if cli != snap
-                ]
-                for name, cli, snap in overrides:
-                    print(
-                        f"warning: snapshot {name}={snap!r} overrides the "
-                        f"command line's {cli!r} (key routing must match "
-                        "the checkpoint)",
-                        file=sys.stderr,
-                    )
         if storage is None:
             storage = TpuShardedStorage(
                 local_capacity=args.tpu_capacity,
                 cache_size=args.cache_size,
-                global_namespaces=[
-                    ns
-                    for ns in (args.global_namespaces or "").split(",")
-                    if ns
-                ],
+                global_namespaces=sorted(cli_global_ns),
                 global_region=args.global_region,
             )
         async_storage = AsyncTpuStorage(
@@ -419,8 +412,17 @@ async def _amain(args) -> int:
         ),
     )
     counters_storage = limiter.storage.counters
-    if hasattr(counters_storage, "library_stats"):
-        metrics.attach_library_source(counters_storage)
+    # Prefer the limiter (the compiled pipeline aggregates its storage's
+    # stats and adds compiler eval counters); otherwise the storage itself.
+    stats_source = (
+        limiter if hasattr(limiter, "library_stats") else counters_storage
+    )
+    if hasattr(stats_source, "library_stats"):
+        metrics.attach_library_source(stats_source)
+    for target in (limiter, counters_storage):
+        if hasattr(target, "set_metrics"):
+            target.set_metrics(metrics)
+            break
     reflection_enabled = False
     if args.grpc_reflection_service:
         try:

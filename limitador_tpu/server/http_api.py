@@ -227,11 +227,21 @@ class _Api:
         self.limiter = limiter
         self.metrics = metrics
         self.status = status or {}
+        self._self_timed = getattr(
+            limiter, "reports_datastore_latency", False
+        ) or getattr(
+            getattr(limiter.storage, "counters", None),
+            "reports_datastore_latency",
+            False,
+        )
 
-    async def _call(self, thunk):
+    async def _call(self, thunk, batched: bool = False):
         """Invoke (and await if needed) under a datastore-latency span; the
-        thunk defers sync-limiter work into the timed region."""
-        if self.metrics is not None:
+        thunk defers sync-limiter work into the timed region. ``batched``
+        marks operations the batched storages time themselves (queue
+        excluded) — only those skip the wrapper; inline admin/read paths
+        keep their wall-clock sample either way."""
+        if self.metrics is not None and not (batched and self._self_timed):
             with self.metrics.time_datastore():
                 value = thunk()
                 if asyncio.iscoroutine(value):
@@ -312,7 +322,8 @@ class _Api:
             return web.json_response({"error": f"bad request: {exc}"}, status=400)
         try:
             await self._call(
-                lambda: self.limiter.update_counters(namespace, ctx, delta)
+                lambda: self.limiter.update_counters(namespace, ctx, delta),
+                batched=True,
             )
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
@@ -329,7 +340,8 @@ class _Api:
             result = await self._call(
                 lambda: self.limiter.check_rate_limited_and_update(
                     namespace, ctx, delta, want_headers
-                )
+                ),
+                batched=True,
             )
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)

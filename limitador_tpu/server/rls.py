@@ -82,16 +82,28 @@ class RlsService:
         self.metrics = metrics
         self.rate_limit_headers = rate_limit_headers
         self._is_async = isinstance(limiter, AsyncRateLimiter)
+        # Batched storages time their own device round trips (the busy-time
+        # semantics of the reference's MetricsLayer, metrics.rs:100-211);
+        # wrapping here would add queue wait on top.
+        self._self_timed = getattr(
+            limiter, "reports_datastore_latency", False
+        ) or getattr(
+            getattr(limiter.storage, "counters", None),
+            "reports_datastore_latency",
+            False,
+        )
 
-    def _timed(self):
-        """datastore_latency span around storage calls (the MetricsLayer
-        busy-time aggregation of the reference, metrics.rs:100-211)."""
-        if self.metrics is not None:
+    def _timed(self, batched: bool = False):
+        """datastore_latency span around storage calls. ``batched`` marks
+        operations the batched storages time themselves (queue excluded) —
+        only those skip the wrapper; inline read paths keep their
+        wall-clock sample either way."""
+        if self.metrics is not None and not (batched and self._self_timed):
             return self.metrics.time_datastore()
         return _NULLCONTEXT
 
     async def _check_and_update(self, namespace, ctx, delta, load):
-        with self._timed():
+        with self._timed(batched=True):
             if self._is_async:
                 return await self.limiter.check_rate_limited_and_update(
                     namespace, ctx, delta, load
@@ -109,7 +121,7 @@ class RlsService:
             return self.limiter.is_rate_limited(namespace, ctx, delta)
 
     async def _update_counters(self, namespace, ctx, delta):
-        with self._timed():
+        with self._timed(batched=True):
             if self._is_async:
                 await self.limiter.update_counters(namespace, ctx, delta)
             else:

@@ -35,6 +35,7 @@ only owns the coalescing.
 from __future__ import annotations
 
 import asyncio
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -73,6 +74,17 @@ class MicroBatcher:
         )
         self._finishers: set = set()
         self.flush_sizes: List[int] = []  # drained by library_stats
+        # When set, per-request datastore latency (the device batch round
+        # trip each request waited on, queue/linger excluded) is observed
+        # here — the busy-time semantics of the reference's MetricsLayer
+        # (metrics.rs:100-211) instead of handler wall clock.
+        self.metrics = None
+
+    def _observe_batch(self, n_requests: int, dt: float) -> None:
+        if self.metrics is not None:
+            observe = self.metrics.datastore_latency.observe
+            for _ in range(n_requests):
+                observe(dt)
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -103,11 +115,12 @@ class MicroBatcher:
             if not future.done():
                 future.set_result(auth)
 
-    async def _finish_inflight(self, batch, handle, finish, sem, loop):
+    async def _finish_inflight(self, batch, handle, finish, sem, loop, t0):
         try:
             auths = await loop.run_in_executor(
                 self._collect_pool, finish, handle
             )
+            self._observe_batch(len(batch), time.perf_counter() - t0)
             self._resolve(batch, auths)
         except Exception as exc:
             self._fail(batch, exc)
@@ -144,6 +157,7 @@ class MicroBatcher:
             del self.flush_sizes[:-1000]
             if pipelined:
                 await sem.acquire()
+                t0 = time.perf_counter()
                 try:
                     handle = await loop.run_in_executor(
                         self._dispatch_pool, begin, requests
@@ -153,15 +167,19 @@ class MicroBatcher:
                     self._fail(batch, exc)
                     continue
                 t = loop.create_task(
-                    self._finish_inflight(batch, handle, finish, sem, loop)
+                    self._finish_inflight(
+                        batch, handle, finish, sem, loop, t0
+                    )
                 )
                 self._finishers.add(t)
                 t.add_done_callback(self._finishers.discard)
             else:
+                t0 = time.perf_counter()
                 try:
                     auths = await loop.run_in_executor(
                         self._dispatch_pool, self.storage.check_many, requests
                     )
+                    self._observe_batch(len(batch), time.perf_counter() - t0)
                     self._resolve(batch, auths)
                 except Exception as exc:
                     self._fail(batch, exc)
@@ -213,6 +231,7 @@ class UpdateBatcher:
         self._task: Optional[asyncio.Task] = None
         self._closed = False
         self._pool = ThreadPoolExecutor(1, thread_name_prefix="tpu-update")
+        self.metrics = None
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -267,11 +286,16 @@ class UpdateBatcher:
             if len(self._pending) < self.max_batch:
                 await asyncio.sleep(self.max_delay)
             items, waiters = self._swap()
+            t0 = time.perf_counter()
             try:
                 await loop.run_in_executor(self._pool, self._apply, items)
             except Exception as exc:
                 self._settle(waiters, exc)
             else:
+                if self.metrics is not None:
+                    dt = time.perf_counter() - t0
+                    for _ in waiters:
+                        self.metrics.datastore_latency.observe(dt)
                 self._settle(waiters, None)
 
     async def close(self) -> None:
@@ -299,6 +323,8 @@ class AsyncTpuStorage(AsyncCounterStorage):
     check_and_update path batches, the Report/update path batches through
     ``UpdateBatcher``; admin operations delegate inline."""
 
+    reports_datastore_latency = False
+
     def __init__(
         self,
         storage: Optional[TpuStorage] = None,
@@ -309,6 +335,14 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.inner = storage or TpuStorage(**kwargs)
         self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
         self.update_batcher = UpdateBatcher(self.inner, max_delay=max_delay)
+
+    def set_metrics(self, metrics) -> None:
+        """Have the batchers observe per-request datastore latency (device
+        batch round trips, queue wait excluded) instead of the serving
+        plane's handler wall clock."""
+        self.batcher.metrics = metrics
+        self.update_batcher.metrics = metrics
+        self.reports_datastore_latency = True
 
     async def check_and_update(
         self, counters: List[Counter], delta: int, load_counters: bool

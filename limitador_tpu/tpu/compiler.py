@@ -242,6 +242,8 @@ class NamespaceCompiler:
         # first-limited naming follows that order.
         ordered = sorted(limits, key=lambda l: (bool(l.variables),) + l._identity)
         self.limits = [CompiledLimit(l, i) for i, l in enumerate(ordered)]
+        self.vectorized_evals = 0
+        self.fallback_evals = 0
         self.columns_needed: set = set()
         for cl in self.limits:
             if cl.vectorized:
@@ -323,6 +325,7 @@ class NamespaceCompiler:
         cols = self.build_columns(batch)
         for cl in self.limits:
             if cl.vectorized:
+                self.vectorized_evals += n
                 applies = np.ones(n, bool)
                 for m in cl.mask:
                     applies &= m.verdict(cols, self.interner, n)
@@ -335,6 +338,7 @@ class NamespaceCompiler:
                     )
             else:
                 # Exact interpreter fallback, one request at a time.
+                self.fallback_evals += n
                 for r, values in enumerate(batch):
                     ctx = C.Context()
                     ctx.list_binding("descriptors", [values])
@@ -358,4 +362,9 @@ class NamespaceCompiler:
             "limits": len(self.limits),
             "vectorized": vec,
             "fallback": len(self.limits) - vec,
+            # Runtime counts: (request, limit) evaluations served by each
+            # path — exported as metrics so a production namespace that
+            # silently drops limits to the interpreter is visible.
+            "vectorized_evals": self.vectorized_evals,
+            "fallback_evals": self.fallback_evals,
         }

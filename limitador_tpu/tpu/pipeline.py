@@ -16,6 +16,7 @@ that namespace's limits change.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional, Tuple, Union
 
 from ..core.cel import Context
@@ -69,8 +70,13 @@ class CompiledTpuLimiter(AsyncRateLimiter):
     inherited per-request path.
     """
 
+    reports_datastore_latency = False
+
     def __init__(self, storage: Optional[AsyncTpuStorage] = None, **kwargs):
         super().__init__(storage or AsyncTpuStorage(**kwargs))
+        self._metrics = None
+        self._retired_vec_evals = 0
+        self._retired_fb_evals = 0
         self._tpu: AsyncTpuStorage = self.storage.counters
         self._compilers: Dict[Namespace, NamespaceCompiler] = {}
         self._rev: Dict[Namespace, List[str]] = {}
@@ -82,7 +88,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
     # -- compiler cache invalidation ----------------------------------------
 
     def _invalidate(self, namespace: Namespace) -> None:
-        self._compilers.pop(namespace, None)
+        self._retire_compiler(self._compilers.pop(namespace, None))
 
     def add_limit(self, limit: Limit) -> bool:
         self._invalidate(limit.namespace)
@@ -101,8 +107,39 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         await super().delete_limits(namespace)
 
     async def configure_with(self, limits) -> None:
+        for compiler in self._compilers.values():
+            self._retire_compiler(compiler)
         self._compilers.clear()
         await super().configure_with(limits)
+
+    def set_metrics(self, metrics) -> None:
+        """Report device-batch datastore latency + compiler eval counters
+        through the server's metrics layer."""
+        self._metrics = metrics
+        self.reports_datastore_latency = True
+        if hasattr(self._tpu, "set_metrics"):
+            # Requests with exotic context shapes fall back to the standard
+            # micro-batcher, which then reports its own device time.
+            self._tpu.set_metrics(metrics)
+
+    def _retire_compiler(self, compiler) -> None:
+        if compiler is not None:
+            self._retired_vec_evals += compiler.vectorized_evals
+            self._retired_fb_evals += compiler.fallback_evals
+
+    def library_stats(self) -> dict:
+        stats = (
+            self._tpu.library_stats()
+            if hasattr(self._tpu, "library_stats")
+            else {}
+        )
+        vec, fb = self._retired_vec_evals, self._retired_fb_evals
+        for compiler in self._compilers.values():
+            vec += compiler.vectorized_evals
+            fb += compiler.fallback_evals
+        stats["cel_vectorized_evals"] = vec
+        stats["cel_fallback_evals"] = fb
+        return stats
 
     def _compiler_for(self, namespace: Namespace) -> NamespaceCompiler:
         compiler = self._compilers.get(namespace)
@@ -157,13 +194,13 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             return
         try:
             requests = self._evaluate_batch(batch)
+            await self._decide(requests)
         except Exception as exc:
+            # Nothing may escape: an exception lost inside the flush task
+            # would strand every submitter of this batch on its future.
             for p in batch:
                 if not p.future.done():
                     p.future.set_exception(exc)
-            return
-
-        await self._decide(requests)
 
     def _evaluate_batch(
         self, batch: List[_RawPending]
@@ -215,6 +252,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             return
         reqs = [_Request(c, p.delta, p.load) for p, c in live]
         loop = asyncio.get_running_loop()
+        t0 = time.perf_counter()
         try:
             auths = await loop.run_in_executor(
                 None, self._tpu.inner.check_many, reqs
@@ -224,6 +262,14 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 if not p.future.done():
                     p.future.set_exception(exc)
             return
+        if self._metrics is not None:
+            # Per-request datastore time: the device batch round trip each
+            # of these requests waited on (queue/linger excluded) — the
+            # busy-time semantics of the reference's MetricsLayer
+            # (metrics.rs:100-211).
+            dt = time.perf_counter() - t0
+            for _ in live:
+                self._metrics.datastore_latency.observe(dt)
         for (p, counters), auth in zip(live, auths):
             loaded = counters if p.load else []
             result = CheckResult(auth.limited, loaded, auth.limit_name)

@@ -658,8 +658,10 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
 
     @classmethod
     def restore(
-        cls, path: str, mesh=None, clock=time.time
+        cls, path: str, mesh=None, cache_size=None, clock=time.time
     ) -> "TpuShardedStorage":
+        """``cache_size`` (unlike capacity/region/namespaces, which govern
+        key routing and must match the checkpoint) may be overridden."""
         import pickle
 
         with open(path, "rb") as f:
@@ -667,7 +669,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         self = cls(
             mesh=mesh,
             local_capacity=data["local_capacity"],
-            cache_size=data["cache_size"],
+            cache_size=cache_size or data["cache_size"],
             global_namespaces=data["global_namespaces"],
             global_region=data["global_region"],
             clock=clock,

@@ -778,14 +778,19 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             )
 
     @classmethod
-    def restore(cls, path: str, clock=time.time) -> "TpuStorage":
+    def restore(
+        cls, path: str, cache_size=None, clock=time.time
+    ) -> "TpuStorage":
+        """``cache_size`` may be overridden; capacity is fixed by the
+        checkpoint (slot indices would shift otherwise)."""
         import pickle
 
         with open(path, "rb") as f:
             data = pickle.load(f)
         table = data["table"]
         self = cls(
-            capacity=table["capacity"], cache_size=table["cache_size"],
+            capacity=table["capacity"],
+            cache_size=cache_size or table["cache_size"],
             clock=clock,
         )
         # Keep the saved epoch so absolute expiries stay correct; _now_ms

@@ -56,7 +56,10 @@ class TestCompiledForms:
             {},
         ]
         compiler = NamespaceCompiler(limits)
-        assert compiler.stats() == {"limits": 2, "vectorized": 2, "fallback": 0}
+        stats = compiler.stats()
+        assert stats["limits"] == 2
+        assert stats["vectorized"] == 2
+        assert stats["fallback"] == 0
         assert_equivalent(limits, batch)
 
     def test_membership_and_logic(self):

@@ -176,3 +176,74 @@ def test_interner_reset_keeps_semantics():
             assert len(hits) == 1
             _limit, tokens = hits[0]
             assert strings[tokens[0]] == f"user-{round_i}-{j}"
+
+
+def test_compiler_eval_counters_reach_metrics():
+    """Runtime vectorized/fallback eval counts surface through
+    library_stats into the prometheus counters (the production visibility
+    for namespaces silently dropping limits to the interpreter)."""
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+
+    async def main():
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        )
+        metrics = PrometheusMetrics()
+        limiter.set_metrics(metrics)
+        metrics.attach_library_source(limiter)
+        limiter.add_limit(
+            Limit("ns", 100, 60, ["descriptors[0].m == 'GET'"],
+                  ["descriptors[0].u"])
+        )
+        # A limit shape the vectorizer cannot compile -> interpreter path.
+        limiter.add_limit(
+            Limit("ns", 100, 60,
+                  ["descriptors[0].m.startsWith('P')"], ["descriptors[0].u"])
+        )
+        for i in range(4):
+            await limiter.check_rate_limited_and_update(
+                "ns", {"m": "GET", "u": f"u{i}"}, 1
+            )
+        text = metrics.render().decode()
+        stats = limiter.library_stats()
+        await limiter.storage.counters.close()
+        return text, stats
+
+    text, stats = run(main())
+    assert stats["cel_vectorized_evals"] >= 4
+    assert stats["cel_fallback_evals"] >= 4
+    assert "cel_vectorized_evals_total" in text
+    assert "cel_fallback_evals_total" in text
+
+
+def test_batcher_reports_datastore_latency():
+    """With set_metrics, per-request device-batch latency lands in the
+    datastore_latency histogram (queue wait excluded) and the storage
+    flags itself as self-timed so the serving plane won't double-count."""
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+    from limitador_tpu import AsyncRateLimiter
+
+    async def main():
+        storage = AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+        metrics = PrometheusMetrics()
+        storage.set_metrics(metrics)
+        assert storage.reports_datastore_latency
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 100, 60, [], ["u"]))
+        import asyncio as aio
+
+        await aio.gather(*[
+            limiter.check_rate_limited_and_update("ns", Context({"u": "x"}), 1)
+            for _ in range(10)
+        ])
+        await limiter.update_counters("ns", Context({"u": "x"}), 1)
+        text = metrics.render().decode()
+        await storage.close()
+        return text
+
+    text = run(main())
+    count = [
+        l for l in text.splitlines()
+        if l.startswith("datastore_latency_count")
+    ][0]
+    assert float(count.split()[-1]) >= 11  # 10 checks + 1 update
