@@ -21,7 +21,17 @@ from prometheus_client import (
     generate_latest,
 )
 
-__all__ = ["PrometheusMetrics"]
+__all__ = ["PrometheusMetrics", "storage_self_timed"]
+
+
+def storage_self_timed(limiter) -> bool:
+    """True when the limiter's batched storage reports its own
+    (queue-excluded) datastore latency, so serving-plane wall-clock
+    wrappers around batched operations would double-count."""
+    if getattr(limiter, "reports_datastore_latency", False):
+        return True
+    counters = getattr(getattr(limiter, "storage", None), "counters", None)
+    return getattr(counters, "reports_datastore_latency", False)
 
 NAMESPACE_LABEL = "limitador_namespace"
 LIMIT_NAME_LABEL = "limitador_limit_name"

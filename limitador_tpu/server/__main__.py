@@ -228,29 +228,49 @@ def build_limiter(args, on_partitioned=None):
         from ..tpu.batcher import AsyncTpuStorage
         from ..tpu.storage import TpuStorage
 
-        storage = _try_restore(
-            args.snapshot_path,
-            lambda p: TpuStorage.restore(p, cache_size=args.cache_size),
-            "counter table",
-        )
-        if storage is not None and storage._capacity != args.tpu_capacity:
-            print(
-                f"warning: snapshot capacity {storage._capacity} "
-                f"overrides --tpu-capacity {args.tpu_capacity}",
-                file=sys.stderr,
-            )
-        if storage is None:
-            if args.peer or args.listen_address:
-                from ..tpu.replicated import TpuReplicatedStorage
+        if args.peer or args.listen_address:
+            # Replicated node: the constructor owns broker wiring, so the
+            # checkpoint loads INTO the instance — restoring a plain
+            # TpuStorage here would silently drop the node out of the
+            # gossip mesh.
+            from ..tpu.replicated import TpuReplicatedStorage
 
-                storage = TpuReplicatedStorage(
-                    node_id=args.node_id or "node",
-                    listen_address=args.listen_address or "0.0.0.0:5001",
-                    peers=args.peer or [],
-                    capacity=args.tpu_capacity,
-                    cache_size=args.cache_size,
+            storage = TpuReplicatedStorage(
+                node_id=args.node_id or "node",
+                listen_address=args.listen_address or "0.0.0.0:5001",
+                peers=args.peer or [],
+                capacity=args.tpu_capacity,
+                cache_size=args.cache_size,
+            )
+            if args.snapshot_path and os.path.exists(args.snapshot_path):
+                try:
+                    storage.load_snapshot(args.snapshot_path)
+                except Exception as exc:
+                    print(
+                        f"snapshot {args.snapshot_path} unreadable "
+                        f"({exc}); starting with a fresh replicated table",
+                        file=sys.stderr,
+                    )
+                    _preserve_rejected_snapshot(args.snapshot_path)
+                else:
+                    print(
+                        f"restored replicated counter table from "
+                        f"{args.snapshot_path}",
+                        file=sys.stderr,
+                    )
+        else:
+            storage = _try_restore(
+                args.snapshot_path,
+                lambda p: TpuStorage.restore(p, cache_size=args.cache_size),
+                "counter table",
+            )
+            if storage is not None and storage._capacity != args.tpu_capacity:
+                print(
+                    f"warning: snapshot capacity {storage._capacity} "
+                    f"overrides --tpu-capacity {args.tpu_capacity}",
+                    file=sys.stderr,
                 )
-            else:
+            if storage is None:
                 storage = TpuStorage(
                     capacity=args.tpu_capacity, cache_size=args.cache_size
                 )

@@ -227,13 +227,9 @@ class _Api:
         self.limiter = limiter
         self.metrics = metrics
         self.status = status or {}
-        self._self_timed = getattr(
-            limiter, "reports_datastore_latency", False
-        ) or getattr(
-            getattr(limiter.storage, "counters", None),
-            "reports_datastore_latency",
-            False,
-        )
+        from ..observability.metrics import storage_self_timed
+
+        self._self_timed = storage_self_timed(limiter)
 
     async def _call(self, thunk, batched: bool = False):
         """Invoke (and await if needed) under a datastore-latency span; the

@@ -85,13 +85,9 @@ class RlsService:
         # Batched storages time their own device round trips (the busy-time
         # semantics of the reference's MetricsLayer, metrics.rs:100-211);
         # wrapping here would add queue wait on top.
-        self._self_timed = getattr(
-            limiter, "reports_datastore_latency", False
-        ) or getattr(
-            getattr(limiter.storage, "counters", None),
-            "reports_datastore_latency",
-            False,
-        )
+        from ..observability.metrics import storage_self_timed
+
+        self._self_timed = storage_self_timed(limiter)
 
     def _timed(self, batched: bool = False):
         """datastore_latency span around storage calls. ``batched`` marks

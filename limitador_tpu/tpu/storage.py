@@ -777,6 +777,51 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 f,
             )
 
+    def _apply_snapshot(self, data: dict) -> None:
+        """Load checkpoint contents into THIS storage (caller holds no
+        lock; capacities already verified to match)."""
+        table = data["table"]
+        with self._lock:
+            # Keep the saved epoch so absolute expiries stay correct;
+            # _now_ms rebases on its own schedule afterwards.
+            self._epoch = table["epoch"]
+            if data.get("format", 1) >= 2:
+                slots = np.asarray(data["slots"], np.int32)
+                if slots.size:
+                    self._state = K.CounterTableState(
+                        values=self._state.values.at[slots].set(
+                            K.jnp.asarray(data["values"])
+                        ),
+                        expiry_ms=self._state.expiry_ms.at[slots].set(
+                            K.jnp.asarray(data["expiry"])
+                        ),
+                    )
+            else:  # round-1 dense checkpoints
+                self._state = K.CounterTableState(
+                    values=K.jnp.asarray(data["values"]),
+                    expiry_ms=K.jnp.asarray(data["expiry"]),
+                )
+            self._table = _SlotTable(self._capacity)
+            self._table.load(table, 0, self._capacity)
+            for key, (value, expiry, counter) in table.get("big", {}).items():
+                self._big[key] = (ExpiringValue(value, expiry), counter)
+
+    def load_snapshot(self, path: str) -> None:
+        """Restore a checkpoint into an already-constructed storage (the
+        replicated subclass restores this way: its constructor owns the
+        broker wiring, then state loads in)."""
+        import pickle
+
+        with open(path, "rb") as f:
+            data = pickle.load(f)
+        capacity = data["table"]["capacity"]
+        if capacity != self._capacity:
+            raise StorageError(
+                f"snapshot capacity {capacity} != storage capacity "
+                f"{self._capacity} (slot indices would shift)"
+            )
+        self._apply_snapshot(data)
+
     @classmethod
     def restore(
         cls, path: str, cache_size=None, clock=time.time
@@ -793,28 +838,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             cache_size=cache_size or table["cache_size"],
             clock=clock,
         )
-        # Keep the saved epoch so absolute expiries stay correct; _now_ms
-        # rebases on its own schedule afterwards.
-        self._epoch = table["epoch"]
-        if data.get("format", 1) >= 2:
-            slots = np.asarray(data["slots"], np.int32)
-            if slots.size:
-                self._state = K.CounterTableState(
-                    values=self._state.values.at[slots].set(
-                        K.jnp.asarray(data["values"])
-                    ),
-                    expiry_ms=self._state.expiry_ms.at[slots].set(
-                        K.jnp.asarray(data["expiry"])
-                    ),
-                )
-        else:  # round-1 dense checkpoints
-            self._state = K.CounterTableState(
-                values=K.jnp.asarray(data["values"]),
-                expiry_ms=K.jnp.asarray(data["expiry"]),
-            )
-        self._table.load(table, 0, self._capacity)
-        for key, (value, expiry, counter) in table.get("big", {}).items():
-            self._big[key] = (ExpiringValue(value, expiry), counter)
+        self._apply_snapshot(data)
         return self
 
     def close(self) -> None:

@@ -39,7 +39,9 @@ class TestsLimiter:
 
     def cleanup(self):
         if self._cleanup:
-            self._cleanup()
+            value = self._cleanup()
+            if asyncio.iscoroutine(value):
+                self._loop.run_until_complete(value)
         if self._loop is not None:
             self._loop.close()
 
@@ -85,12 +87,33 @@ def _sharded() -> TestsLimiter:
     return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
 
 
+def _cached() -> TestsLimiter:
+    # Write-behind over an in-memory authority, flush tightened so the
+    # matrix converges in-test (the reference runs cached-Redis with a 2ms
+    # flush the same way, integration_tests.rs:61-71). A single replica's
+    # local view is exact, so the behavioral contract holds.
+    from limitador_tpu.storage.cached import CachedCounterStorage
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    storage = CachedCounterStorage(InMemoryStorage(), flush_period=0.002)
+    return TestsLimiter(AsyncRateLimiter(storage), cleanup=storage.close)
+
+
+def _replicated() -> TestsLimiter:
+    from limitador_tpu.tpu.replicated import TpuReplicatedStorage
+
+    storage = TpuReplicatedStorage("matrix-node", capacity=4096)
+    return TestsLimiter(RateLimiter(storage), cleanup=storage.close)
+
+
 FACTORIES: Dict[str, Callable[[], TestsLimiter]] = {
     "memory": _memory,
     "tpu": _tpu,
     "disk": _disk,
     "distributed": _distributed,
     "sharded": _sharded,
+    "cached": _cached,
+    "replicated": _replicated,
 }
 
 

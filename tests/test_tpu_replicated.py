@@ -223,3 +223,56 @@ def test_apply_deltas_marks_slots_for_gossip():
         assert slots <= storage._touched and len(slots) == 2
     finally:
         storage.close()
+
+
+def test_snapshot_loads_into_replicated_storage():
+    """A replicated node restores its checkpoint INTO the constructed
+    storage (restore-as-plain-TpuStorage would drop it from the mesh)."""
+    import tempfile
+
+    a = TpuReplicatedStorage("n1", capacity=256)
+    try:
+        limiter = RateLimiter(a)
+        limiter.add_limit(Limit("ns", 10, 600, [], ["u"]))
+        ctx = Context({"u": "snap"})
+        for _ in range(4):
+            limiter.check_rate_limited_and_update("ns", ctx, 1)
+        path = tempfile.mktemp(suffix=".ckpt")
+        a.snapshot(path)
+    finally:
+        a.close()
+
+    b = TpuReplicatedStorage("n1", capacity=256)
+    try:
+        b.load_snapshot(path)
+        limiter2 = RateLimiter(b)
+        limiter2.add_limit(Limit("ns", 10, 600, [], ["u"]))
+        counters = limiter2.get_counters("ns")
+        assert next(iter(counters)).remaining == 6
+        # Counting continues from the restored value on the replicated
+        # subclass (whose gossip wiring the constructor owns).
+        r = limiter2.check_rate_limited_and_update("ns", Context({"u": "snap"}), 1)
+        assert not r.limited
+    finally:
+        b.close()
+
+
+def test_load_snapshot_rejects_capacity_mismatch():
+    import tempfile
+
+    import pytest as _pytest
+
+    from limitador_tpu.storage.base import StorageError
+
+    a = TpuReplicatedStorage("n1", capacity=256)
+    try:
+        path = tempfile.mktemp(suffix=".ckpt")
+        a.snapshot(path)
+    finally:
+        a.close()
+    b = TpuReplicatedStorage("n1", capacity=512)
+    try:
+        with _pytest.raises(StorageError):
+            b.load_snapshot(path)
+    finally:
+        b.close()
