@@ -216,6 +216,7 @@ class TpuReplicatedStorage(TpuStorage):
         out = super().get_counters(limits)
         with self._lock:
             now_ms = self._now_ms()
+            self._flush_dirty_remote()
             for c in out:
                 qualified_slot = self._table.qualified.get(self._key_of(c))
                 slot = (
@@ -225,6 +226,34 @@ class TpuReplicatedStorage(TpuStorage):
                 )
                 if slot is not None and c.remaining is not None:
                     c.remaining -= self._remote_value(slot, now_ms)
+            # Remote-only counters: gossiped from peers, never locally hit —
+            # the local cell is expired so the base pass skipped them, but
+            # the merged view must list them (the reference's distributed
+            # get_counters reads the CRDT sum, distributed/mod.rs). One
+            # batched device gather for all candidates, like the parent.
+            seen = set(out)
+            namespaces = {limit.namespace for limit in limits}
+            candidates = []
+            for slot, (_key, counter) in self._table.info.items():
+                if (
+                    counter.limit not in limits
+                    and counter.namespace not in namespaces
+                ):
+                    continue
+                probe = counter.key()
+                if probe not in seen:
+                    candidates.append((slot, probe))
+            if candidates:
+                slot_arr = np.asarray([s for s, _p in candidates], np.int32)
+                rvals = np.asarray(self._remote_vals[slot_arr])
+                rexps = np.asarray(self._remote_exp[slot_arr])
+                for i, (_slot, probe) in enumerate(candidates):
+                    r, e = int(rvals[i]), int(rexps[i])
+                    if e <= now_ms or r <= 0:
+                        continue
+                    probe.remaining = probe.max_value - r
+                    probe.expires_in = (e - now_ms) / 1000.0
+                    out.add(probe)
         return out
 
     # -- gossip plumbing ----------------------------------------------------

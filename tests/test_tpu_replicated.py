@@ -276,3 +276,30 @@ def test_load_snapshot_rejects_capacity_mismatch():
             b.load_snapshot(path)
     finally:
         b.close()
+
+
+def test_remote_only_counters_visible_in_get_counters():
+    """A counter gossiped from a peer that this node never served locally
+    must still appear in the merged admin view (the CRDT read-as-sum)."""
+    port0, port1 = free_port(), free_port()
+    urls = [f"127.0.0.1:{port0}", f"127.0.0.1:{port1}"]
+    a = TpuReplicatedStorage("a", listen_address=urls[0], peers=[urls[1]],
+                             capacity=256)
+    b = TpuReplicatedStorage("b", listen_address=urls[1], peers=[urls[0]],
+                             capacity=256)
+    try:
+        la, lb = RateLimiter(a), RateLimiter(b)
+        limit = Limit("ns", 10, 600, [], ["u"])
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        for _ in range(3):
+            la.check_rate_limited_and_update("ns", Context({"u": "ghost"}), 1)
+
+        def b_view():
+            counters = lb.get_counters("ns")
+            return {c.set_variables["u"]: c.remaining for c in counters}
+
+        assert eventually(lambda: b_view().get("ghost") == 7), b_view()
+    finally:
+        a.close()
+        b.close()
