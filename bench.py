@@ -19,6 +19,13 @@ The other BASELINE configs run with --config:
                         the default headline)
     --config sharded    keys sharded over all devices, psum global region
                         (config 5; multi-chip on a virtual mesh off-TPU)
+    --config grpc       closed-loop ShouldRateLimit over a real socket:
+                        p50/p99 vs the 2ms target (also rides along with
+                        the default device run as grpc_* fields)
+    --config fleet      N replica processes sharing one RLS port via
+                        SO_REUSEPORT over one network authority (the
+                        N-limitadors-one-Redis topology)
+    --config backends   reference criterion scenarios per backend
 """
 
 import argparse
@@ -441,6 +448,209 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
         os.unlink(limits.name)
 
 
+def bench_fleet(n_replicas: int = 3):
+    """Horizontal serving topology (the reference's N-limitadors-one-Redis
+    deployment, doc/topologies.md): N replica processes share ONE gRPC
+    port via SO_REUSEPORT, each deciding from its local write-behind view,
+    all flushing to one shared authority over the network-authority
+    protocol (a memory authority here so the bench isolates the serving
+    plane; production points --authority-url at a TPU-table server).
+    Reported: closed-loop aggregate throughput with 1 replica vs N — the
+    scale-out that lifts the per-process Python gRPC ceiling."""
+    import os
+    import subprocess
+    import tempfile
+    import urllib.request
+
+    limits = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    limits.write(
+        "- namespace: api\n  max_value: 1000000000\n  seconds: 60\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+    limits.close()
+    rls_port = _free_port()
+    auth_port, auth_http = _free_port(), _free_port()
+    procs = []
+
+    def spawn(argv):
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "limitador_tpu.server"] + argv,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
+        )
+        procs.append(proc)
+        return proc
+
+    def wait_http(port, proc, tries=240):
+        for _ in range(tries):
+            if proc.poll() is not None:
+                # Fail fast with the real cause instead of polling a corpse.
+                err = (proc.stderr.read() or "")[-1000:] if proc.stderr else ""
+                raise RuntimeError(
+                    f"server on :{port} exited rc={proc.returncode}: {err}"
+                )
+            try:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/status", timeout=1
+                )
+                return
+            except Exception:
+                time.sleep(0.5)
+        raise RuntimeError(f"server on :{port} never came up")
+
+    # One Python client process tops out near the server's per-process
+    # rate, so the load comes from several CLIENT processes; each reports
+    # its own JSON line on stdout and the parent aggregates.
+    _CLIENT = r"""
+import asyncio, json, sys, time
+import numpy as np
+import grpc
+sys.path.insert(0, {repo!r})
+from limitador_tpu.server.proto import rls_pb2
+
+PORT, CHANNELS, CONCURRENCY, PER_WORKER, SEED = (
+    int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]),
+    int(sys.argv[4]), int(sys.argv[5]),
+)
+
+async def main():
+    chans = [
+        grpc.aio.insecure_channel(
+            f"127.0.0.1:{{PORT}}", options=[("bench.chan", SEED * 100 + i)]
+        )
+        for i in range(CHANNELS)
+    ]
+    methods = [
+        ch.unary_unary(
+            "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+            request_serializer=lambda m: m.SerializeToString(),
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        for ch in chans
+    ]
+    def make_req(user):
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d = req.descriptors.add()
+        e = d.entries.add(); e.key = "u"; e.value = user
+        return req
+    reqs = [make_req(f"user-{{i}}") for i in range(256)]
+    async def worker(w, n, out):
+        method = methods[w % CHANNELS]
+        for i in range(n):
+            t0 = time.perf_counter()
+            await method(reqs[(SEED + w * n + i) % 256])
+            out.append(time.perf_counter() - t0)
+    warm = []
+    await asyncio.gather(*[worker(w, 15, warm) for w in range(CONCURRENCY)])
+    lat = []
+    t0 = time.perf_counter()
+    await asyncio.gather(*[
+        worker(w, PER_WORKER, lat) for w in range(CONCURRENCY)
+    ])
+    wall = time.perf_counter() - t0
+    for ch in chans:
+        await ch.close()
+    lat_ms = np.asarray(lat) * 1e3
+    print(json.dumps({{
+        "n": len(lat), "wall": wall,
+        "p50": float(np.percentile(lat_ms, 50)),
+        "p99": float(np.percentile(lat_ms, 99)),
+    }}))
+
+asyncio.run(main())
+""".format(repo=os.path.dirname(os.path.abspath(__file__)))
+
+    def drive(client_procs=4, concurrency=32, per_worker=120, channels=4):
+        clients = [
+            subprocess.Popen(
+                [sys.executable, "-c", _CLIENT, str(rls_port),
+                 str(channels), str(concurrency), str(per_worker), str(k)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True,
+            )
+            for k in range(client_procs)
+        ]
+        results = []
+        failures = []
+        for proc in clients:
+            out, _ = proc.communicate(timeout=300)
+            if proc.returncode == 0 and out.strip():
+                results.append(json.loads(out.strip().splitlines()[-1]))
+            else:
+                failures.append(proc.returncode)
+        if failures:
+            # A silently-dropped client would skew the aggregate without
+            # any trace; refuse to report a partial number.
+            raise RuntimeError(
+                f"{len(failures)}/{len(clients)} fleet clients failed "
+                f"(rcs {failures})"
+            )
+        total = sum(r["n"] for r in results)
+        wall = max(r["wall"] for r in results)
+        p50 = float(np.median([r["p50"] for r in results]))
+        p99 = max(r["p99"] for r in results)
+        return total / wall, p50, p99
+
+    try:
+        auth_proc = spawn(
+            [limits.name, "memory", "--rls-port", str(_free_port()),
+             "--http-port", str(auth_http),
+             "--authority-listen", f"127.0.0.1:{auth_port}"])
+        wait_http(auth_http, auth_proc)
+
+        def add_replica():
+            http = _free_port()
+            proc = spawn([limits.name, "cached",
+                          "--rls-port", str(rls_port),
+                          "--http-port", str(http),
+                          "--authority-url", f"127.0.0.1:{auth_port}"])
+            wait_http(http, proc)
+
+        add_replica()
+        solo_rps, solo_p50, solo_p99 = drive()
+        for _ in range(n_replicas - 1):
+            add_replica()
+        fleet_rps, fleet_p50, fleet_p99 = drive()
+        scaling = fleet_rps / solo_rps if solo_rps else 0.0
+        cores = os.cpu_count() or 1
+        note = (
+            "SO_REUSEPORT fan-in, one shared authority"
+            if cores > n_replicas
+            else f"topology validated; host has {cores} core(s), so "
+            "replicas+clients contend and the ratio cannot show scale-out "
+            "here — replicas are independent processes, so on one core per "
+            "replica the aggregate scales with the replica count"
+        )
+        print(
+            f"fleet: 1 replica {solo_rps/1e3:.1f}k req/s "
+            f"(p50 {solo_p50:.2f}ms p99 {solo_p99:.2f}ms) -> "
+            f"{n_replicas} replicas {fleet_rps/1e3:.1f}k req/s "
+            f"(p50 {fleet_p50:.2f}ms p99 {fleet_p99:.2f}ms), "
+            f"{scaling:.2f}x — {note}",
+            file=sys.stderr,
+        )
+        emit(
+            "fleet_should_rate_limit_per_sec",
+            fleet_rps,
+            "decisions/s",
+            1e7,
+            replicas=n_replicas,
+            solo_rps=round(solo_rps, 1),
+            scaling=round(scaling, 2),
+            host_cores=cores,
+            p50_ms=round(fleet_p50, 3),
+            p99_ms=round(fleet_p99, 3),
+        )
+    finally:
+        for proc in procs:
+            proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        os.unlink(limits.name)
+
+
 def bench_grpc():
     """Closed-loop gRPC ShouldRateLimit over a real socket: p99 vs the 2ms
     BASELINE target (value = p99_ms, vs_baseline = 2.0 / p99 so >= 1.0
@@ -471,7 +681,7 @@ def main():
         "--config",
         default="device",
         choices=["device", "memory", "pipeline", "native", "tenants",
-                 "sharded", "backends", "grpc"],
+                 "sharded", "backends", "grpc", "fleet"],
     )
     args = parser.parse_args()
 
@@ -487,6 +697,8 @@ def main():
         return bench_sharded()
     if args.config == "grpc":
         return bench_grpc()
+    if args.config == "fleet":
+        return bench_fleet()
 
     # End-to-end gRPC latency evidence rides along with the headline
     # (device) run only. It runs FIRST — before this process initializes

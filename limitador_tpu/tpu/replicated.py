@@ -217,6 +217,7 @@ class TpuReplicatedStorage(TpuStorage):
         with self._lock:
             now_ms = self._now_ms()
             self._flush_dirty_remote()
+            merged = []
             for c in out:
                 qualified_slot = self._table.qualified.get(self._key_of(c))
                 slot = (
@@ -225,7 +226,17 @@ class TpuReplicatedStorage(TpuStorage):
                     else self._table.simple.get(self._key_of(c))
                 )
                 if slot is not None and c.remaining is not None:
-                    c.remaining -= self._remote_value(slot, now_ms)
+                    merged.append((slot, c))
+            if merged:
+                # One batched gather for every local counter's remote share
+                # (scalar _remote_value fetches would serialize 2 device
+                # round trips per counter under the storage lock).
+                slot_arr = np.asarray([s for s, _c in merged], np.int32)
+                rvals = np.asarray(self._remote_vals[slot_arr])
+                rexps = np.asarray(self._remote_exp[slot_arr])
+                for i, (_slot, c) in enumerate(merged):
+                    if int(rexps[i]) > now_ms:
+                        c.remaining -= int(rvals[i])
             # Remote-only counters: gossiped from peers, never locally hit —
             # the local cell is expired so the base pass skipped them, but
             # the merged view must list them (the reference's distributed
