@@ -325,6 +325,59 @@ def _free_port() -> int:
     return port
 
 
+_BENCH_LIMITS_YAML = (
+    "- namespace: api\n  max_value: 1000000000\n  seconds: 60\n"
+    "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+)
+
+
+def _write_limits_file() -> str:
+    import tempfile
+
+    f = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
+    f.write(_BENCH_LIMITS_YAML)
+    f.close()
+    return f.name
+
+
+def _spawn_server(argv, stderr_path: str):
+    """Launch a server subprocess with stderr captured to a FILE (a pipe
+    nobody drains would deadlock a chatty server)."""
+    import subprocess
+
+    return subprocess.Popen(
+        [sys.executable, "-m", "limitador_tpu.server"] + argv,
+        stdout=subprocess.DEVNULL,
+        stderr=open(stderr_path, "w"),
+    )
+
+
+def _wait_http(port, proc, stderr_path=None, tries=240):
+    import urllib.request
+
+    for _ in range(tries):
+        if proc.poll() is not None:
+            tail = ""
+            if stderr_path:
+                try:
+                    with open(stderr_path) as f:
+                        tail = f.read()[-1000:]
+                except OSError:
+                    pass
+            raise RuntimeError(
+                f"bench server on :{port} exited rc={proc.returncode}: "
+                f"{tail}"
+            )
+        try:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/status", timeout=1
+            )
+            return
+        except Exception:
+            time.sleep(0.5)
+    raise RuntimeError(f"bench server on :{port} never came up")
+
+
 def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
                      batch_delay_us: int = 200):
     """End-to-end gRPC latency evidence: a real server process, a real
@@ -337,44 +390,23 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
     import os
     import subprocess
     import tempfile
-    import urllib.request
 
     import grpc
 
     from limitador_tpu.server.proto import rls_pb2
 
-    limits = tempfile.NamedTemporaryFile(
-        "w", suffix=".yaml", delete=False
-    )
-    limits.write(
-        "- namespace: api\n  max_value: 1000000000\n  seconds: 60\n"
-        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
-    )
-    limits.close()
+    limits_path = _write_limits_file()
+    stderr_path = tempfile.mktemp(suffix=".log")
     rls_port, http_port = _free_port(), _free_port()
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "limitador_tpu.server", limits.name, "tpu",
-         "--pipeline", "native", "--rls-port", str(rls_port),
-         "--http-port", str(http_port),
+    proc = _spawn_server(
+        [limits_path, "tpu", "--pipeline", "native",
+         "--rls-port", str(rls_port), "--http-port", str(http_port),
          "--batch-delay-us", str(batch_delay_us)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        stderr_path,
     )
     try:
-        for _ in range(240):
-            if proc.poll() is not None:
-                raise RuntimeError(
-                    f"bench server exited early (rc={proc.returncode}) — "
-                    "device already held by this process?"
-                )
-            try:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{http_port}/status", timeout=1
-                )
-                break
-            except Exception:
-                time.sleep(0.5)
-        else:
-            raise RuntimeError("bench server never came up")
+        # jax/device init through the tunnel can take minutes on a bad day.
+        _wait_http(http_port, proc, stderr_path, tries=480)
 
         async def drive():
             channel = grpc.aio.insecure_channel(f"127.0.0.1:{rls_port}")
@@ -445,7 +477,7 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
-        os.unlink(limits.name)
+        os.unlink(limits_path)
 
 
 def bench_fleet(n_replicas: int = 3):
@@ -460,42 +492,17 @@ def bench_fleet(n_replicas: int = 3):
     import os
     import subprocess
     import tempfile
-    import urllib.request
 
-    limits = tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False)
-    limits.write(
-        "- namespace: api\n  max_value: 1000000000\n  seconds: 60\n"
-        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
-    )
-    limits.close()
+    limits_path = _write_limits_file()
     rls_port = _free_port()
     auth_port, auth_http = _free_port(), _free_port()
     procs = []
 
     def spawn(argv):
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "limitador_tpu.server"] + argv,
-            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE, text=True,
-        )
+        stderr_path = tempfile.mktemp(suffix=".log")
+        proc = _spawn_server(argv, stderr_path)
         procs.append(proc)
-        return proc
-
-    def wait_http(port, proc, tries=240):
-        for _ in range(tries):
-            if proc.poll() is not None:
-                # Fail fast with the real cause instead of polling a corpse.
-                err = (proc.stderr.read() or "")[-1000:] if proc.stderr else ""
-                raise RuntimeError(
-                    f"server on :{port} exited rc={proc.returncode}: {err}"
-                )
-            try:
-                urllib.request.urlopen(
-                    f"http://127.0.0.1:{port}/status", timeout=1
-                )
-                return
-            except Exception:
-                time.sleep(0.5)
-        raise RuntimeError(f"server on :{port} never came up")
+        return proc, stderr_path
 
     # One Python client process tops out near the server's per-process
     # rate, so the load comes from several CLIENT processes; each reports
@@ -571,12 +578,17 @@ asyncio.run(main())
         ]
         results = []
         failures = []
-        for proc in clients:
-            out, _ = proc.communicate(timeout=300)
-            if proc.returncode == 0 and out.strip():
-                results.append(json.loads(out.strip().splitlines()[-1]))
-            else:
-                failures.append(proc.returncode)
+        try:
+            for proc in clients:
+                out, _ = proc.communicate(timeout=300)
+                if proc.returncode == 0 and out.strip():
+                    results.append(json.loads(out.strip().splitlines()[-1]))
+                else:
+                    failures.append(proc.returncode)
+        finally:
+            for proc in clients:  # a timed-out reap must not leak clients
+                if proc.poll() is None:
+                    proc.kill()
         if failures:
             # A silently-dropped client would skew the aggregate without
             # any trace; refuse to report a partial number.
@@ -591,19 +603,19 @@ asyncio.run(main())
         return total / wall, p50, p99
 
     try:
-        auth_proc = spawn(
-            [limits.name, "memory", "--rls-port", str(_free_port()),
+        auth_proc, auth_err = spawn(
+            [limits_path, "memory", "--rls-port", str(_free_port()),
              "--http-port", str(auth_http),
              "--authority-listen", f"127.0.0.1:{auth_port}"])
-        wait_http(auth_http, auth_proc)
+        _wait_http(auth_http, auth_proc, auth_err)
 
         def add_replica():
             http = _free_port()
-            proc = spawn([limits.name, "cached",
-                          "--rls-port", str(rls_port),
-                          "--http-port", str(http),
-                          "--authority-url", f"127.0.0.1:{auth_port}"])
-            wait_http(http, proc)
+            proc, err = spawn([limits_path, "cached",
+                               "--rls-port", str(rls_port),
+                               "--http-port", str(http),
+                               "--authority-url", f"127.0.0.1:{auth_port}"])
+            _wait_http(http, proc, err)
 
         add_replica()
         solo_rps, solo_p50, solo_p99 = drive()
@@ -648,7 +660,7 @@ asyncio.run(main())
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        os.unlink(limits.name)
+        os.unlink(limits_path)
 
 
 def bench_grpc():

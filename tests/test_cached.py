@@ -6,7 +6,7 @@ import asyncio
 
 import pytest
 
-from limitador_tpu import AsyncRateLimiter, Context, Limit
+from limitador_tpu import AsyncRateLimiter, Context, Limit, RateLimiter
 from limitador_tpu.storage.base import StorageError
 from limitador_tpu.storage.cached import CachedCounterStorage
 from limitador_tpu.storage.in_memory import InMemoryStorage
@@ -334,6 +334,49 @@ def test_library_stats_feed_prometheus_gauges():
     assert "evicted_pending_writes_total" in text
     assert "batcher_flush_size_count 1.0" in text
     assert "cache_size 2.0" in text  # max_cached bound respected
+
+
+def test_randomized_single_replica_parity_vs_oracle():
+    """A lone write-behind replica's local view is EXACT (authoritative
+    base + its own pending deltas), so a randomized op stream must match
+    the in-memory oracle decision-for-decision, flushes interleaved."""
+    import random
+
+    async def main():
+        rng = random.Random(11)
+        authority = InMemoryStorage()
+        cached = CachedCounterStorage(authority, flush_period=1000.0)
+        mem = RateLimiter(InMemoryStorage())
+        limiter = AsyncRateLimiter(cached)
+        limits = [
+            Limit("ns", 5, 60, [], ["u"], name="l5"),
+            Limit("ns", 12, 3600, [], ["u"], name="l12"),
+        ]
+        for lim in limits:
+            mem.add_limit(lim)
+            limiter.add_limit(lim)
+        users = [str(i) for i in range(5)]
+        for step in range(250):
+            op = rng.random()
+            ctx = Context({"u": rng.choice(users)})
+            delta = rng.choice([1, 1, 2])
+            if op < 0.65:
+                r1 = mem.check_rate_limited_and_update("ns", ctx, delta)
+                r2 = await limiter.check_rate_limited_and_update(
+                    "ns", ctx, delta
+                )
+                assert r1.limited == r2.limited, f"step {step}"
+                assert r1.limit_name == r2.limit_name, f"step {step}"
+            elif op < 0.85:
+                mem.update_counters("ns", ctx, delta)
+                await limiter.update_counters("ns", ctx, delta)
+            else:
+                # Interleaved flushes must not perturb the local view.
+                await cached.flush()
+        await cached.close()
+        return True
+
+    assert run(main())
 
 
 def test_tpu_authority():

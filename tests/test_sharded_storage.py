@@ -160,6 +160,70 @@ def test_served_through_micro_batcher():
         loop.close()
 
 
+def test_randomized_op_stream_parity_vs_oracle():
+    """The multi-chip storage is bit-exact with the in-memory oracle over
+    a randomized op stream spanning shards, a mesh-global namespace
+    handled as shard-LOCAL by the oracle comparison (so exact), and a
+    beyond-device-cap limit (the host big-limit path)."""
+    import random
+
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    class FakeClock:
+        def __init__(self):
+            self.now = 1_700_000_000.0
+
+        def __call__(self):
+            return self.now
+
+        def advance(self, s):
+            self.now += s
+
+    clock = FakeClock()
+    mem = RateLimiter(InMemoryStorage(10_000, clock=clock))
+    sharded = RateLimiter(
+        TpuShardedStorage(local_capacity=1024, global_region=32, clock=clock)
+    )
+    limits = [
+        Limit("ns", 5, 60, [], ["u"], name="l5"),
+        Limit("ns", 12, 10, [], ["u"], name="l12"),
+        Limit("ns", 30, 3600, [], [], name="l30"),
+        Limit("big", 1 << 40, 60, [], ["u"]),
+    ]
+    for limiter in (mem, sharded):
+        for lim in limits:
+            limiter.add_limit(lim)
+
+    rng = random.Random(7)
+    users = [str(i) for i in range(8)]
+    for step in range(300):
+        op = rng.random()
+        ns = rng.choice(["ns", "ns", "ns", "big"])
+        ctx = Context({"u": rng.choice(users)})
+        delta = rng.choice([1, 1, 2, 5])
+        if op < 0.6:
+            r1 = mem.check_rate_limited_and_update(ns, ctx, delta)
+            r2 = sharded.check_rate_limited_and_update(ns, ctx, delta)
+            assert r1.limited == r2.limited, f"step {step}: diverged"
+            assert r1.limit_name == r2.limit_name, f"step {step}: name"
+        elif op < 0.75:
+            mem.update_counters(ns, ctx, delta)
+            sharded.update_counters(ns, ctx, delta)
+        elif op < 0.9:
+            r1 = mem.is_rate_limited(ns, ctx, delta)
+            r2 = sharded.is_rate_limited(ns, ctx, delta)
+            assert r1.limited == r2.limited, f"step {step}: is_rate_limited"
+        else:
+            clock.advance(rng.choice([0.3, 1.0, 5.0, 11.0]))
+
+    for ns in ("ns", "big"):
+        c1 = {(tuple(c.set_variables.items()), c.window_seconds): c.remaining
+              for c in mem.get_counters(ns)}
+        c2 = {(tuple(c.set_variables.items()), c.window_seconds): c.remaining
+              for c in sharded.get_counters(ns)}
+        assert c1 == c2, f"{ns}: final counters diverged"
+
+
 def test_epoch_rebase_survives_month_long_idle(fake_clock):
     storage = make_storage(clock=fake_clock)
     limit = Limit("ns", 10, 60, [], ["u"])
