@@ -340,16 +340,27 @@ def _write_limits_file() -> str:
     return f.name
 
 
+def _stderr_log_path() -> str:
+    import tempfile
+
+    f = tempfile.NamedTemporaryFile(
+        "w", suffix=".log", prefix="bench-server-", delete=False
+    )
+    f.close()
+    return f.name
+
+
 def _spawn_server(argv, stderr_path: str):
     """Launch a server subprocess with stderr captured to a FILE (a pipe
     nobody drains would deadlock a chatty server)."""
     import subprocess
 
-    return subprocess.Popen(
-        [sys.executable, "-m", "limitador_tpu.server"] + argv,
-        stdout=subprocess.DEVNULL,
-        stderr=open(stderr_path, "w"),
-    )
+    with open(stderr_path, "w") as stderr_file:
+        return subprocess.Popen(
+            [sys.executable, "-m", "limitador_tpu.server"] + argv,
+            stdout=subprocess.DEVNULL,
+            stderr=stderr_file,
+        )
 
 
 def _wait_http(port, proc, stderr_path=None, tries=240):
@@ -389,14 +400,13 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
     import asyncio
     import os
     import subprocess
-    import tempfile
 
     import grpc
 
     from limitador_tpu.server.proto import rls_pb2
 
     limits_path = _write_limits_file()
-    stderr_path = tempfile.mktemp(suffix=".log")
+    stderr_path = _stderr_log_path()
     rls_port, http_port = _free_port(), _free_port()
     proc = _spawn_server(
         [limits_path, "tpu", "--pipeline", "native",
@@ -478,6 +488,10 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
         except subprocess.TimeoutExpired:
             proc.kill()
         os.unlink(limits_path)
+        try:
+            os.unlink(stderr_path)
+        except OSError:
+            pass
 
 
 def bench_fleet(n_replicas: int = 3):
@@ -491,15 +505,17 @@ def bench_fleet(n_replicas: int = 3):
     scale-out that lifts the per-process Python gRPC ceiling."""
     import os
     import subprocess
-    import tempfile
 
     limits_path = _write_limits_file()
     rls_port = _free_port()
     auth_port, auth_http = _free_port(), _free_port()
     procs = []
 
+    stderr_paths = []
+
     def spawn(argv):
-        stderr_path = tempfile.mktemp(suffix=".log")
+        stderr_path = _stderr_log_path()
+        stderr_paths.append(stderr_path)
         proc = _spawn_server(argv, stderr_path)
         procs.append(proc)
         return proc, stderr_path
@@ -661,6 +677,11 @@ asyncio.run(main())
             except subprocess.TimeoutExpired:
                 proc.kill()
         os.unlink(limits_path)
+        for path in stderr_paths:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
 
 
 def bench_grpc():

@@ -187,3 +187,42 @@ def test_pipelined_batches_stay_exact_under_backpressure():
         return admitted
 
     assert run(main()) == 40
+
+
+def test_threaded_begin_finish_interleaving_stays_exact():
+    """Storage-level race test: pipelined begin/finish handles crossing
+    between threads, with qualified-slot eviction churn, must keep the
+    contended counter exact (the lock + generation-watch discipline)."""
+    import threading
+
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.tpu.storage import TpuStorage, _Request
+
+    storage = TpuStorage(capacity=128, cache_size=16)
+    limit = Limit("ns", 50, 600, [], ["u"])
+    contended = [Counter(limit, {"u": "hot"})]
+    admitted = []
+    admitted_lock = threading.Lock()
+    errors = []
+
+    def hammer(tid):
+        try:
+            for i in range(25):
+                reqs = [_Request(contended, 1, False)]
+                # churn: unique users force allocations + LRU evictions
+                churn = Counter(limit, {"u": f"t{tid}-{i}"})
+                reqs.append(_Request([churn], 1, False))
+                handle = storage.begin_check_many(reqs)
+                auths = storage.finish_check_many(handle)
+                with admitted_lock:
+                    admitted.append(not auths[0].limited)
+        except Exception as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer, args=(t,)) for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert sum(admitted) == 50  # 4x25=100 attempts, exactly max admitted
