@@ -389,6 +389,38 @@ def _wait_http(port, proc, stderr_path=None, tries=240):
     raise RuntimeError(f"bench server on :{port} never came up")
 
 
+def _device_available(timeout_s: float = 180.0, retries: int = 2) -> bool:
+    """Probe device/backend init in a SUBPROCESS: a dead remote-chip
+    tunnel makes jax.devices() hang indefinitely, which would leave the
+    bench with no output at all. Retries ride out short tunnel blips."""
+    import subprocess
+
+    for attempt in range(retries):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(jax.devices()[0].platform)"],
+                capture_output=True, text=True, timeout=timeout_s,
+            )
+        except subprocess.TimeoutExpired:
+            probe = None
+        platform = probe.stdout.strip() if probe is not None else ""
+        if probe is not None and probe.returncode == 0 and platform != "cpu":
+            return True
+        # rc==0 with platform "cpu" means jax silently fell back to the
+        # host backend — that must NOT pass as "device available" or CPU
+        # numbers would masquerade as the device headline.
+        print(
+            f"device probe attempt {attempt + 1}/{retries} failed "
+            f"(got {platform!r}; tunnel down, backend init hung, or "
+            "cpu-only fallback)",
+            file=sys.stderr,
+        )
+        if attempt + 1 < retries:
+            time.sleep(30)
+    return False
+
+
 def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
                      batch_delay_us: int = 200):
     """End-to-end gRPC latency evidence: a real server process, a real
@@ -407,6 +439,7 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
 
     limits_path = _write_limits_file()
     stderr_path = _stderr_log_path()
+    success = False
     rls_port, http_port = _free_port(), _free_port()
     proc = _spawn_server(
         [limits_path, "tpu", "--pipeline", "native",
@@ -472,6 +505,7 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
         lat, wall, floor = asyncio.new_event_loop().run_until_complete(
             drive()
         )
+        success = True
         lat_ms = np.asarray(lat) * 1e3
         floor_ms = np.asarray(floor) * 1e3
         rps = len(lat) / wall
@@ -488,10 +522,15 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
         except subprocess.TimeoutExpired:
             proc.kill()
         os.unlink(limits_path)
-        try:
-            os.unlink(stderr_path)
-        except OSError:
-            pass
+        if success:
+            try:
+                os.unlink(stderr_path)
+            except OSError:
+                pass
+        else:
+            # The server log is the only server-side evidence of a failed
+            # run; keep it and say where it is.
+            print(f"server stderr kept at {stderr_path}", file=sys.stderr)
 
 
 def bench_fleet(n_replicas: int = 3):
@@ -512,6 +551,7 @@ def bench_fleet(n_replicas: int = 3):
     procs = []
 
     stderr_paths = []
+    success = False
 
     def spawn(argv):
         stderr_path = _stderr_log_path()
@@ -668,6 +708,7 @@ asyncio.run(main())
             p50_ms=round(fleet_p50, 3),
             p99_ms=round(fleet_p99, 3),
         )
+        success = True
     finally:
         for proc in procs:
             proc.terminate()
@@ -677,11 +718,17 @@ asyncio.run(main())
             except subprocess.TimeoutExpired:
                 proc.kill()
         os.unlink(limits_path)
-        for path in stderr_paths:
-            try:
-                os.unlink(path)
-            except OSError:
-                pass
+        if success:
+            for path in stderr_paths:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+        else:
+            print(
+                f"server stderr kept at: {', '.join(stderr_paths)}",
+                file=sys.stderr,
+            )
 
 
 def bench_grpc():
@@ -738,7 +785,17 @@ def main():
     # jax — because the server subprocess needs the device and some TPU
     # runtimes are single-process-exclusive.
     extra = {}
+    device_ok = True
     if args.config == "device":
+        device_ok = _device_available()
+        if not device_ok:
+            print(
+                "WARNING: device backend unavailable; headline will run on "
+                "the CPU backend (see the platform field) rather than hang "
+                "with no recorded result",
+                file=sys.stderr,
+            )
+    if args.config == "device" and device_ok:
         try:
             rps, p50, p99, floor_p50 = grpc_closed_loop(
                 concurrency=64, per_worker=120
@@ -760,6 +817,9 @@ def main():
             print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
 
     import jax
+
+    if not device_ok:
+        jax.config.update("jax_platforms", "cpu")
 
     from limitador_tpu.ops.kernel import (
         check_and_update_batch,
@@ -861,6 +921,7 @@ def main():
         decisions_per_sec,
         "decisions/s",
         1e7,
+        platform=dev.platform,
         **extra,
     )
 
