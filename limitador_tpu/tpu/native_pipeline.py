@@ -34,6 +34,7 @@ from ..core.limit import Namespace
 from ..observability.metrics import PrometheusMetrics
 from ..storage.base import StorageError
 from .. import native
+from ..ops import kernel as K
 from .compiler import NamespaceCompiler
 from .pipeline import CompiledTpuLimiter
 from .storage import TpuStorage
@@ -146,7 +147,14 @@ class NativeRlsPipeline:
         namespace = Namespace.of(self.hp.string(domain_token))
         limits = self.limiter.get_limits(namespace)
         compiler = NamespaceCompiler(limits, interner=self._interner)
-        if not limits or not compiler.fully_vectorized:
+        native_ok = compiler.fully_vectorized and all(
+            # Beyond-device-cap limits are decided host-side by the
+            # storage's big-limit fallback, which the columnar kernel
+            # path bypasses — such namespaces take the exact path.
+            limit.max_value <= K.MAX_VALUE_CAP
+            for limit in limits
+        )
+        if not limits or not native_ok:
             # Namespace needs the exact path (or has no limits -> cheap OK,
             # handled by an empty plan).
             plan = _NsPlan(namespace, compiler, self.hp) if not limits else None
@@ -341,13 +349,13 @@ class NativeRlsPipeline:
             for limit, idx, slots, fresh, max_value, window_s in staged:
                 hit_slots.append(slots.astype(np.int32))
                 deltas_l = np.minimum(
-                    deltas_req[idx], (1 << 30) - 1
+                    deltas_req[idx], K.MAX_DELTA_CAP
                 ).astype(np.int32)
                 if failed_reqs:
                     deltas_l[np.isin(idx, list(failed_reqs))] = 0
                 hit_deltas.append(deltas_l)
                 hit_maxes.append(
-                    np.full(idx.size, min(max_value, 1 << 30), np.int32)
+                    np.full(idx.size, max_value, np.int32)
                 )
                 hit_windows.append(
                     np.full(

@@ -43,6 +43,7 @@ def native_server():
     )
     limiter.add_limit(Limit("slowns", 2, 60,
                             [f"{D}.p.matches('^/v1/')"], [f"{D}.u"]))
+    limiter.add_limit(Limit("bigns", 1 << 40, 60, [], [f"{D}.u"]))
     metrics = PrometheusMetrics(use_limit_name_label=True)
     port = free_port()
     loop = asyncio.new_event_loop()
@@ -334,3 +335,20 @@ class TestReviewRegressions:
         code = loop.run_until_complete(main())
         loop.close()
         assert code == rls_pb2.RateLimitResponse.OVER_LIMIT
+
+
+def test_big_limit_namespace_routes_exact(native_server):
+    """A namespace containing a beyond-device-cap limit must take the
+    exact path (the columnar kernel would clamp its max to 2^30)."""
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit as L
+
+    port, limiter, *_ = native_server
+    big = L("bigns", 1 << 40, 60, [], [f"{D}.u"])
+    # Seed the counter one below the REAL boundary; the clamped device max
+    # would have rejected everything from here on.
+    storage = limiter.storage.counters.inner
+    storage.update_counter(Counter(big, {f"{D}.u": "edge"}), (1 << 40) - 1)
+    entries = {"u": "edge"}
+    codes = [call(port, "bigns", entries) for _ in range(2)]
+    assert codes == [OK, OVER]
