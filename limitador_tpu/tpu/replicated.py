@@ -18,6 +18,12 @@ commutative) on the host and scatters refreshed sums to the device.
 Consistency contract: local decisions are exact against (own + last gossiped
 remote) counts; cross-node over-admission is bounded by the gossip period —
 the reference's documented distributed-mode behavior (doc/topologies.md).
+
+Known limitation: counters of limits whose max_value exceeds the int32
+device cap (2^30) live in the host-side big-limit fallback, which has no
+device slot and is NOT gossiped — in this topology such counters are
+node-local. Practically irrelevant (a >1B-per-window limit rarely needs
+cross-node accounting), but documented for honesty.
 """
 
 from __future__ import annotations
