@@ -307,7 +307,9 @@ def update_core(
     # not). Accumulate four 8-bit lanes separately (exact for any batch up
     # to ~8M hits) and recombine with carries, saturating at MAX_VALUE_CAP
     # so a saturated cell can never re-admit against a cap-sized max_value.
-    d = jnp.minimum(deltas, MAX_DELTA_CAP)
+    # Negative deltas would corrupt the lane split (shift/mask of a negative
+    # int32); they are rejected host-side and clamped here as a backstop.
+    d = jnp.clip(deltas, 0, MAX_DELTA_CAP)
     zeros = jnp.zeros_like(values)
     s0 = zeros.at[slots].add(d & 0xFF)
     s1 = zeros.at[slots].add((d >> 8) & 0xFF)

@@ -29,7 +29,17 @@ __all__ = [
     "AsyncCounterStorage",
     "Storage",
     "AsyncStorage",
+    "require_nonnegative_delta",
 ]
+
+
+def require_nonnegative_delta(delta: int) -> None:
+    """Deltas are unsigned in the reference (limit.rs:34, u64 throughout);
+    a negative delta would decrement counters — and on the device paths the
+    byte-lane scatter is undefined for negatives. One contract, enforced at
+    every entry surface."""
+    if delta < 0:
+        raise ValueError("delta must be >= 0")
 
 
 @dataclass

@@ -34,7 +34,13 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
-from .base import AsyncCounterStorage, Authorization, CounterStorage, StorageError
+from .base import (
+    AsyncCounterStorage,
+    Authorization,
+    CounterStorage,
+    StorageError,
+    require_nonnegative_delta,
+)
 from .expiring_value import ExpiringValue
 from .keys import key_for_counter
 
@@ -51,13 +57,12 @@ class _CachedValue:
     """Local view of one counter: last authoritative value + local deltas
     not yet flushed (CachedCounterValue, counters_cache.rs:71-120)."""
 
-    __slots__ = ("value", "pending", "from_authority", "auth_overshoot")
+    __slots__ = ("value", "pending", "from_authority")
 
     def __init__(self, value: ExpiringValue, from_authority: bool):
         self.value = value
         self.pending = 0
         self.from_authority = from_authority
-        self.auth_overshoot = 0  # excess over max at the last reconcile
 
 
 class CachedCounterStorage(AsyncCounterStorage):
@@ -85,7 +90,21 @@ class CachedCounterStorage(AsyncCounterStorage):
         self.partitioned = False
         self._cache: Dict[bytes, _CachedValue] = {}
         self._counters: Dict[bytes, Counter] = {}  # key -> identity counter
+        # Last observed excess-over-limit per key. Lives OUTSIDE the cache so
+        # an evict/recreate cycle cannot re-count a standing excess, while a
+        # genuinely new counter (baseline 0) has its first-reconcile excess
+        # counted — the reference records overshoot on every reconcile
+        # (counters_cache.rs:46-53). Only counters sitting above their limit
+        # have entries; pruned on excess==0 / delete / clear, size-capped.
+        self._overshoot_baseline: Dict[bytes, int] = {}
         self._batch: Dict[bytes, int] = {}  # pending flush deltas
+        # All flushes (periodic loop + inline backpressure) serialize here:
+        # each flush swaps a disjoint batch, but without ordering a later
+        # batch's authority reply could reconcile before an earlier one and
+        # overwrite entry.value with a stale authoritative total (the
+        # reference runs every flush in the one loop task,
+        # redis_cached.rs:192-203).
+        self._flush_lock = asyncio.Lock()
         self._wake: Optional[asyncio.Event] = None
         self._task: Optional[asyncio.Task] = None
         self._closed = False
@@ -137,6 +156,10 @@ class CachedCounterStorage(AsyncCounterStorage):
         """One write-behind flush: push pending deltas, reconcile
         authoritative values (flush_batcher_and_update_counters,
         redis_cached.rs:344-394)."""
+        async with self._flush_lock:
+            await self._flush_locked()
+
+    async def _flush_locked(self) -> None:
         batch, self._batch = self._batch, {}
         if not batch:
             return
@@ -193,14 +216,27 @@ class CachedCounterStorage(AsyncCounterStorage):
             entry.value.set(value + entry.pending, ttl, now)
             entry.from_authority = True
             # Overshoot: how far the replica fleet admitted past the limit
-            # while views were stale (counters_cache.rs:368-371). Count only
-            # the growth between THIS entry's consecutive reconciles — a
-            # freshly (re)created entry first establishes a baseline, so an
-            # evict/recreate cycle cannot re-count the same standing excess.
+            # while views were stale (counters_cache.rs:46-53). Count the
+            # growth of the excess since this KEY's last reconcile — the
+            # baseline survives eviction (see _overshoot_baseline), so a
+            # new counter's first burst is counted but an evict/recreate
+            # cycle never re-counts the same standing excess.
             excess = max(value - counter.max_value, 0)
-            if entry.from_authority and excess > entry.auth_overshoot:
-                self.counter_overshoot += excess - entry.auth_overshoot
-            entry.auth_overshoot = excess
+            baseline = self._overshoot_baseline.get(key, 0)
+            if excess > baseline:
+                self.counter_overshoot += excess - baseline
+            if excess > 0:
+                # pop-then-set refreshes dict insertion order, so the size
+                # cap evicts the stalest baseline, not a hot key whose
+                # re-count would inflate the metric.
+                self._overshoot_baseline.pop(key, None)
+                self._overshoot_baseline[key] = excess
+                if len(self._overshoot_baseline) > 4 * self.max_cached:
+                    self._overshoot_baseline.pop(
+                        next(iter(self._overshoot_baseline))
+                    )
+            else:
+                self._overshoot_baseline.pop(key, None)
 
     def _apply_to_authority(self, items: List[Tuple[Counter, int]]):
         apply = getattr(self.authority, "apply_deltas", None)
@@ -281,7 +317,13 @@ class CachedCounterStorage(AsyncCounterStorage):
         admitted locally."""
         if len(self._batch) >= self.max_pending and not self.partitioned:
             try:
-                await self.flush()
+                async with self._flush_lock:
+                    # Re-check after the wait: a writer queued behind an
+                    # in-flight flush usually finds the batch already
+                    # drained — don't pay an authority round-trip for the
+                    # couple of deltas that trickled in meanwhile.
+                    if len(self._batch) >= self.max_pending:
+                        await self._flush_locked()
             except Exception:
                 self.flush_errors += 1
                 logger.exception("inline backpressure flush failed")
@@ -298,6 +340,10 @@ class CachedCounterStorage(AsyncCounterStorage):
         pass
 
     async def update_counter(self, counter: Counter, delta: int) -> None:
+        # Reject at enqueue: a negative delta queued into the batch would
+        # poison every subsequent flush against an authority that enforces
+        # unsigned deltas (the re-queue-on-error path retries the batch).
+        require_nonnegative_delta(delta)
         self._ensure_started()
         now = self._clock()
         key = key_for_counter(counter)
@@ -309,6 +355,7 @@ class CachedCounterStorage(AsyncCounterStorage):
     async def check_and_update(
         self, counters: List[Counter], delta: int, load_counters: bool
     ) -> Authorization:
+        require_nonnegative_delta(delta)
         self._ensure_started()
         now = self._clock()
         first_limited: Optional[Authorization] = None
@@ -360,12 +407,14 @@ class CachedCounterStorage(AsyncCounterStorage):
             self._cache.pop(key, None)
             self._counters.pop(key, None)
             self._batch.pop(key, None)
+            self._overshoot_baseline.pop(key, None)
         self.authority.delete_counters(limits)
 
     async def clear(self) -> None:
         self._cache.clear()
         self._counters.clear()
         self._batch.clear()
+        self._overshoot_baseline.clear()
         self.authority.clear()
 
     async def close(self) -> None:

@@ -41,7 +41,11 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
-from ..storage.base import AsyncCounterStorage, Authorization
+from ..storage.base import (
+    AsyncCounterStorage,
+    Authorization,
+    require_nonnegative_delta,
+)
 from .storage import TpuStorage, _Request
 
 __all__ = ["MicroBatcher", "UpdateBatcher", "AsyncTpuStorage"]
@@ -95,6 +99,7 @@ class MicroBatcher:
         self, counters: List[Counter], delta: int, load: bool
     ) -> Authorization:
         """Enqueue one request; resolves when its batch has been decided."""
+        require_nonnegative_delta(delta)
         self._ensure_started()
         future = asyncio.get_running_loop().create_future()
         request = _Request(counters, delta, load)
@@ -239,6 +244,9 @@ class UpdateBatcher:
             self._task = asyncio.get_running_loop().create_task(self._run())
 
     async def submit(self, counter: Counter, delta: int) -> None:
+        # Reject before coalescing: a negative delta inside the batch
+        # would fail the whole apply and drop other requests' updates.
+        require_nonnegative_delta(delta)
         self._ensure_started()
         future = asyncio.get_running_loop().create_future()
         self._pending[counter] = self._pending.get(counter, 0) + int(delta)

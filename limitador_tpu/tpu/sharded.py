@@ -34,7 +34,12 @@ import numpy as np
 
 from ..core.counter import Counter
 from ..core.limit import Limit
-from ..storage.base import Authorization, CounterStorage, StorageError
+from ..storage.base import (
+    Authorization,
+    CounterStorage,
+    StorageError,
+    require_nonnegative_delta,
+)
 from ..storage.expiring_value import ExpiringValue
 from ..ops import kernel as K
 from ..parallel.mesh import (
@@ -217,6 +222,8 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         TpuStorage.begin_check_many."""
         import jax
 
+        for request in requests:
+            require_nonnegative_delta(request.delta)
         n = self._n
         with self._lock:
             now_ms = self._now_ms()
@@ -435,6 +442,8 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         same saturating scatter-add as the single-chip authority — then two
         batched gathers (one for shard-local slots, one for the global
         region) for the authoritative values."""
+        for _counter, delta in items:
+            require_nonnegative_delta(delta)
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()

@@ -34,7 +34,12 @@ import numpy as np
 
 from ..core.counter import Counter
 from ..core.limit import Limit
-from ..storage.base import Authorization, CounterStorage, StorageError
+from ..storage.base import (
+    Authorization,
+    CounterStorage,
+    StorageError,
+    require_nonnegative_delta,
+)
 from ..storage.expiring_value import ExpiringValue
 from ..ops import kernel as K
 
@@ -369,6 +374,8 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         all-or-nothing; passing big hits apply at finish only when the
         device also admits (projected within the batch so concurrent big
         hits never over-admit)."""
+        for request in requests:
+            require_nonnegative_delta(request.delta)
         # Build as Python lists (then one vectorized pad+convert): per-element
         # numpy scalar stores dominate the host loop otherwise.
         slots_l: List[int] = []
@@ -568,6 +575,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     self._slot_for(counter, create=True)
 
     def update_counter(self, counter: Counter, delta: int) -> None:
+        require_nonnegative_delta(delta)
         with self._lock:
             now_ms = self._now_ms()
             if self._is_big(counter):
@@ -694,6 +702,8 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         """Authority-side batch apply for write-behind caches: one
         update_batch + one read, vectorized (the device table playing the
         shared-Redis role of the reference's cached topology)."""
+        for _counter, delta in items:
+            require_nonnegative_delta(delta)
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()

@@ -129,3 +129,65 @@ def test_big_snapshot_roundtrip(tmp_path):
     restored = TpuStorage.restore(path)
     assert not restored.is_within_limits(c, BIG - 122)
     assert restored.is_within_limits(c, BIG - 123)
+
+
+def test_negative_delta_rejected():
+    """The device byte-lane scatter is defined for non-negative deltas only
+    (reference deltas are u64, limit.rs:34): a negative delta raises instead
+    of corrupting lane sums."""
+    s = TpuStorage(capacity=64)
+    limit = Limit("ns", 10, 60, [], ["u"])
+    counter = Counter(limit, {"u": "a"})
+    with pytest.raises(ValueError):
+        s.update_counter(counter, -1)
+    with pytest.raises(ValueError):
+        s.apply_deltas([(counter, -5)])
+    s.update_counter(counter, 2)  # non-negative still works
+
+
+def test_negative_delta_rejected_sharded_and_cached():
+    """The guard lives on every entry surface, not just the single-chip
+    table: the sharded topology and the write-behind cache reject negative
+    deltas before they can decrement big cells or poison a flush batch."""
+    import asyncio
+
+    from limitador_tpu.storage.cached import CachedCounterStorage
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+    from limitador_tpu.tpu.batcher import UpdateBatcher
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    big = Limit("ns", 1 << 40, 60, [], ["u"])
+    counter = Counter(big, {"u": "a"})
+    sharded = TpuShardedStorage(local_capacity=2048)
+    with pytest.raises(ValueError):
+        sharded.apply_deltas([(counter, -5)])
+    with pytest.raises(ValueError):
+        sharded.update_counter(counter, -1)
+
+    # check paths reject too (they scatter the delta into device cells)
+    small = Counter(Limit("ns", 10, 60, [], ["u"]), {"u": "a"})
+    with pytest.raises(ValueError):
+        TpuStorage(capacity=64).check_and_update([small], -1, False)
+    with pytest.raises(ValueError):
+        sharded.check_and_update([small], -1, False)
+
+    async def drive_async():
+        cached = CachedCounterStorage(InMemoryStorage(), flush_period=10.0)
+        with pytest.raises(ValueError):
+            await cached.update_counter(counter, -1)
+        with pytest.raises(ValueError):
+            await cached.check_and_update([counter], -1, False)
+        assert not cached._batch  # nothing was queued
+        await cached.close()
+        batcher = UpdateBatcher(TpuStorage(capacity=64))
+        with pytest.raises(ValueError):
+            await batcher.submit(counter, -1)
+        assert not batcher._pending  # rejected before coalescing
+        from limitador_tpu.tpu.batcher import MicroBatcher
+
+        micro = MicroBatcher(TpuStorage(capacity=64))
+        with pytest.raises(ValueError):
+            await micro.submit([small], -1, False)
+        assert not micro._pending
+
+    asyncio.run(drive_async())

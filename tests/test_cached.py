@@ -399,3 +399,72 @@ def test_tpu_authority():
         return next(iter(auth)).remaining
 
     assert run(main()) == 2
+
+
+def test_overshoot_counts_once_including_first_reconcile():
+    """A standing excess over the limit is counted exactly ONCE: a brand-new
+    counter's first-reconcile burst IS overshoot (the reference records it,
+    counters_cache.rs:46-53), but after an evict/recreate cycle the surviving
+    baseline prevents re-counting the same excess."""
+
+    async def main():
+        authority = InMemoryStorage()
+        cached = CachedCounterStorage(authority, flush_period=10.0)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 10, 60, [], ["u"])
+        limiter.add_limit(limit)
+        ctx = Context({"u": "a"})
+        # A first-window burst past the limit is real over-admission.
+        await limiter.update_counters("ns", ctx, 15)
+        await cached.flush()
+        assert cached.counter_overshoot == 5
+        # Growth between consecutive reconciles is counted incrementally.
+        await limiter.update_counters("ns", ctx, 3)
+        await cached.flush()
+        assert cached.counter_overshoot == 8
+        # Evict + recreate: the standing excess (8) must not be re-counted.
+        cached._cache.clear()
+        await limiter.update_counters("ns", ctx, 0)
+        await cached.flush()
+        assert cached.counter_overshoot == 8
+        await cached.close()
+        return True
+
+    assert run(main())
+
+
+def test_concurrent_flushes_serialize():
+    """Inline backpressure flushes and the periodic loop serialize: a later
+    batch's authority reply can never reconcile before an earlier one (the
+    reference runs all flushes in one task, redis_cached.rs:192-203)."""
+
+    class SlowAuthority(InMemoryStorage):
+        def __init__(self):
+            super().__init__()
+            self.order = []
+
+        def apply_deltas(self, items):
+            import time as _t
+
+            self.order.append(sum(d for _c, d in items))
+            _t.sleep(0.01)
+            return super().apply_deltas(items)
+
+    async def main():
+        authority = SlowAuthority()
+        cached = CachedCounterStorage(authority, flush_period=10.0)
+        limiter = AsyncRateLimiter(cached)
+        limit = Limit("ns", 10_000, 60, [], ["u"])
+        limiter.add_limit(limit)
+        ctx = Context({"u": "a"})
+        await limiter.update_counters("ns", ctx, 1)
+        flushes = [asyncio.create_task(cached.flush()) for _ in range(3)]
+        await limiter.update_counters("ns", ctx, 2)
+        await asyncio.gather(*flushes)
+        await cached.flush()
+        auth = authority.get_counters({limit})
+        remaining = next(iter(auth)).remaining
+        await cached.close()
+        return remaining
+
+    assert run(main()) == 10_000 - 3
