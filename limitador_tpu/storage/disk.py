@@ -25,7 +25,7 @@ from typing import List, Optional, Set
 from ..core.counter import Counter
 from ..core.limit import Limit
 from .base import Authorization, CounterStorage, StorageError
-from .keys import key_for_counter, partial_counter_from_key
+from .keys import LimitKeyIndex, key_for_counter, partial_counter_from_key
 
 __all__ = ["DiskStorage"]
 
@@ -157,6 +157,17 @@ class DiskStorage(CounterStorage):
             except sqlite3.Error as exc:
                 self._fail(exc)
 
+    @staticmethod
+    def _decode(key: bytes, index) -> Optional[Counter]:
+        """Skip rows whose key this codec can't read (e.g. written by a
+        pre-postcard build): they expire through the sweep; a scan must
+        not crash on them (the distributed backend tolerates foreign keys
+        the same way)."""
+        try:
+            return partial_counter_from_key(key, index)
+        except Exception:
+            return None
+
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
         now = self._clock()
         out: Set[Counter] = set()
@@ -168,8 +179,9 @@ class DiskStorage(CounterStorage):
                 " AND expiry > ?",
                 (*namespaces, now),
             ).fetchall()
+        index = LimitKeyIndex(limits)  # O(1) re-attach per scanned key
         for key, value, expiry in rows:
-            counter = partial_counter_from_key(bytes(key), limits)
+            counter = self._decode(bytes(key), index)
             if counter is None:
                 continue
             counter.remaining = counter.max_value - int(value)
@@ -187,8 +199,9 @@ class DiskStorage(CounterStorage):
                 tuple(namespaces),
             ).fetchall()
             doomed = []
+            index = LimitKeyIndex(limits)
             for (key,) in rows:
-                counter = partial_counter_from_key(bytes(key), limits)
+                counter = self._decode(bytes(key), index)
                 if counter is not None:
                     doomed.append(key)
             if doomed:

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional, Set
 from ...core.counter import Counter
 from ...core.limit import Limit
 from ..base import Authorization, CounterStorage
-from ..keys import key_for_counter, partial_counter_from_key
+from ..keys import LimitKeyIndex, key_for_counter, partial_counter_from_key
 from .cr_counter_value import CrCounterValue
 
 __all__ = ["CrInMemoryStorage", "CrCounterValue"]
@@ -179,8 +179,9 @@ class CrInMemoryStorage(CounterStorage):
                 for entry in self._counters.values()
                 if not entry.value.expired_at(now)
             ]
+        index = LimitKeyIndex(limits)
         for key, value, ttl in live:
-            counter = self._decode(key, limits)
+            counter = self._decode(key, index)
             if counter is None:
                 continue
             counter.remaining = counter.max_value - value
@@ -190,10 +191,11 @@ class CrInMemoryStorage(CounterStorage):
 
     def delete_counters(self, limits: Set[Limit]) -> None:
         with self._lock:
+            index = LimitKeyIndex(limits)
             doomed = [
                 key
                 for key in self._counters
-                if self._decode(key, limits) is not None
+                if self._decode(key, index) is not None
             ]
             for key in doomed:
                 del self._counters[key]

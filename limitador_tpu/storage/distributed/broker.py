@@ -3,11 +3,11 @@
 The distributed communication backend, mirroring
 /root/reference/limitador/src/storage/distributed/grpc/mod.rs over grpc.aio:
 
-- bidirectional ``Replication.Stream(stream Packet)`` sessions (same wire
-  messages / field numbers as the reference's proto; note counter KEYS are
-  this implementation's msgpack codec — mixing with Rust-limitador peers
-  (postcard keys) parses but does not merge counters, so clusters must be
-  homogeneous);
+- bidirectional ``Replication.Stream(stream Packet)`` sessions: same wire
+  messages / field numbers as the reference's proto, and counter KEYS use
+  the postcard-compatible codec (storage/keys.py, byte-identical to
+  keys.rs:236-249), so a mixed Rust/Python cluster's updates land on the
+  SAME key and merge;
 - handshake: both sides send Hello, answer with Pong carrying wall-clock
   ms; the receiver derives per-peer clock skew used to map remote expiry
   timestamps into the local clock (grpc/mod.rs:33-77, 625-746);

@@ -37,7 +37,11 @@ import jax
 import numpy as np
 
 from ..core.counter import Counter
-from ..storage.keys import key_for_counter, partial_counter_from_key
+from ..storage.keys import (
+    LimitKeyIndex,
+    key_for_counter,
+    partial_counter_from_key,
+)
 from ..ops import kernel as K
 from .storage import TpuStorage, _bucket
 
@@ -308,19 +312,26 @@ class TpuReplicatedStorage(TpuStorage):
             self._queue_remote_sum(key, slot)
 
     def _decode_counter(self, key: bytes) -> Optional[Counter]:
-        # Counters decode against the configured limits (registry provider,
-        # O(#limits)); an unknown limit's updates park in _remote_actors
-        # until the limit is configured here. The O(#slots) info scan is
-        # only the providerless fallback (bare-storage tests).
+        # Counters decode against the configured limits (registry provider);
+        # an unknown limit's updates park in _remote_actors until the limit
+        # is configured here. The O(#slots) info scan is only the
+        # providerless fallback (bare-storage tests). Gossip floods decode
+        # one key per update, so the LimitKeyIndex is cached and only
+        # rebuilt when the provider's limit set actually changes.
         try:
             limits = self._known_limits()
             if not limits:
                 limits = {info[1].limit for info in self._table.info.values()}
-            return partial_counter_from_key(key, limits)
+            cached = self._decode_index
+            if cached is None or cached[0] != limits:
+                cached = (limits, LimitKeyIndex(limits))
+                self._decode_index = cached
+            return partial_counter_from_key(key, cached[1])
         except Exception:
             return None
 
     _limits_provider = None  # set by the server: () -> iterable of limits
+    _decode_index = None  # (limits set, LimitKeyIndex) decode cache
 
     def _known_limits(self):
         if self._limits_provider is None:

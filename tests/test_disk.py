@@ -102,3 +102,27 @@ class TestDiskPersistence:
         rows = storage._db.execute("SELECT COUNT(*) FROM counters").fetchone()
         assert rows[0] == 1  # expired x swept, y remains
         storage.close()
+
+
+def test_scan_tolerates_undecodable_keys(tmp_path):
+    """Rows whose key bytes this codec can't read (foreign codec, corrupt
+    row) are skipped by scans, not fatal — they age out via the sweep."""
+    import sqlite3
+
+    from limitador_tpu.storage.disk import DiskStorage
+
+    path = str(tmp_path / "c.db")
+    storage = DiskStorage(path)
+    limit = Limit("ns", 10, 60, [], ["u"])
+    storage.update_counter(Counter(limit, {"u": "a"}), 3)
+    # Inject a legacy/corrupt row in the same namespace.
+    storage._db.execute(
+        "INSERT INTO counters (key, namespace, value, expiry) VALUES (?,?,?,?)",
+        (b"\x01\x93\xa2ns*junk", "ns", 1, time.time() + 60),
+    )
+    storage._db.commit()
+    counters = storage.get_counters({limit})
+    assert len(counters) == 1
+    assert next(iter(counters)).remaining == 7
+    storage.delete_counters({limit})  # must not raise either
+    storage.close()

@@ -1,0 +1,191 @@
+"""Binary counter-key codec: postcard compatibility with the reference.
+
+The reference serializes keys with the postcard crate
+(keys.rs:188-307); these tests pin our encoder to the reference's OWN
+test vectors (keys.rs:449-459: 46-byte flat, 19-byte v2-with-id,
+47-byte v2-without-id) — byte-for-byte, not just length — plus
+round-trip and varint properties. Byte-identical keys are what make a
+mixed Rust/Python cluster merge counters instead of keeping disjoint
+CRDT cells per implementation.
+"""
+
+import pytest
+
+from limitador_tpu import Limit
+from limitador_tpu.core.counter import Counter
+from limitador_tpu.storage import postcard as pc
+from limitador_tpu.storage.keys import (
+    LimitKeyIndex,
+    key_for_counter,
+    key_for_counter_rocksdb,
+    partial_counter_from_key,
+    partial_counter_from_rocksdb_key,
+    prefix_for_namespace_bin,
+)
+
+
+def _ref_limit(with_id=False):
+    # keys.rs:419-437: ns "ns_counter:", 1/1s, one condition, one variable
+    return Limit(
+        "ns_counter:",
+        1,
+        1,
+        ["req_method == 'GET'"],
+        ["app_id"],
+        id="id200" if with_id else None,
+    )
+
+
+def _enc_str(s: str) -> bytes:
+    raw = s.encode()
+    return bytes([len(raw)]) + raw
+
+
+class TestReferenceVectors:
+    def test_flat_key_bytes(self):
+        """keys.rs:449 — 46 bytes, and exactly postcard(CounterKey)."""
+        counter = Counter(_ref_limit(), {"app_id": "foo"})
+        key = key_for_counter_rocksdb(counter)
+        expected = (
+            _enc_str("ns_counter:")      # ns
+            + bytes([1])                 # seconds: varint(1)
+            + bytes([1])                 # conditions: len 1
+            + _enc_str("req_method == 'GET'")
+            + bytes([1])                 # variables: len 1
+            + _enc_str("app_id")
+            + _enc_str("foo")
+        )
+        assert key == expected
+        assert len(key) == 46
+
+    def test_v2_with_id_bytes(self):
+        """keys.rs:453 — 19 bytes: version 2 + IdCounterKey."""
+        counter = Counter(_ref_limit(with_id=True), {"app_id": "foo"})
+        key = key_for_counter(counter)
+        expected = (
+            b"\x02"
+            + _enc_str("id200")
+            + bytes([1])
+            + _enc_str("app_id")
+            + _enc_str("foo")
+        )
+        assert key == expected
+        assert len(key) == 19
+
+    def test_v2_without_id_bytes(self):
+        """keys.rs:457 — 47 bytes: version 1 + full CounterKey."""
+        counter = Counter(_ref_limit(), {"app_id": "foo"})
+        key = key_for_counter(counter)
+        assert key[:1] == b"\x01"
+        assert key[1:] == key_for_counter_rocksdb(counter)
+        assert len(key) == 47
+
+    def test_namespace_prefix(self):
+        """keys.rs:398-415 — flat keys start with postcard(namespace)."""
+        counter = Counter(_ref_limit(), {"app_id": "foo"})
+        prefix = prefix_for_namespace_bin("ns_counter:")
+        assert key_for_counter_rocksdb(counter)[: len(prefix)] == prefix
+
+
+class TestRoundTrip:
+    def test_v1_round_trip(self):
+        limit = Limit("ns", 10, 60, ["a == '1'"], ["u", "z"])
+        counter = Counter(limit, {"u": "alice", "z": "9"})
+        back = partial_counter_from_key(key_for_counter(counter), [limit])
+        assert back == counter
+        assert back.set_variables == {"u": "alice", "z": "9"}
+
+    def test_v2_round_trip(self):
+        limit = Limit("ns", 10, 60, [], ["u"], id="lim-1")
+        counter = Counter(limit, {"u": "bob"})
+        back = partial_counter_from_key(key_for_counter(counter), [limit])
+        assert back == counter
+
+    def test_rocksdb_round_trip(self):
+        limit = Limit("ns", 10, 60, [], ["u"])
+        counter = Counter(limit, {"u": "x"})
+        back = partial_counter_from_rocksdb_key(
+            key_for_counter_rocksdb(counter), [limit]
+        )
+        assert back == counter
+
+    def test_unknown_limit_returns_none(self):
+        limit = Limit("ns", 10, 60, [], ["u"])
+        other = Limit("other", 10, 60, [], ["u"])
+        key = key_for_counter(Counter(limit, {"u": "x"}))
+        assert partial_counter_from_key(key, [other]) is None
+
+    def test_unknown_version_raises(self):
+        with pytest.raises(ValueError):
+            partial_counter_from_key(b"\x09junk", [])
+
+    def test_multibyte_strings_and_long_values(self):
+        """UTF-8 and >127-byte strings force multi-byte varints."""
+        limit = Limit("nämespace", 10, 60, [], ["u"])
+        counter = Counter(limit, {"u": "x" * 300})
+        key = key_for_counter(counter)
+        back = partial_counter_from_key(key, [limit])
+        assert back == counter
+
+    def test_randomized_round_trip(self):
+        import random
+
+        rng = random.Random(7)
+        alphabet = "abcXYZ018_:-/ é¢"
+        for i in range(200):
+            ns = "".join(rng.choices(alphabet, k=rng.randint(1, 20)))
+            n_vars = rng.randint(0, 4)
+            names = [f"v{j}" for j in range(n_vars)]
+            conds = [f"c{j} == '{j}'" for j in range(rng.randint(0, 3))]
+            has_id = rng.random() < 0.5
+            limit = Limit(
+                ns, rng.randint(1, 1 << 40), rng.randint(1, 10**6),
+                conds, names, id=f"id{i}" if has_id else None,
+            )
+            variables = {
+                n: "".join(rng.choices(alphabet, k=rng.randint(0, 200)))
+                for n in names
+            }
+            counter = Counter(limit, variables)
+            back = partial_counter_from_key(key_for_counter(counter), [limit])
+            assert back == counter, (ns, variables)
+            assert back.set_variables == variables
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "n,raw",
+        [
+            (0, b"\x00"),
+            (1, b"\x01"),
+            (127, b"\x7f"),
+            (128, b"\x80\x01"),
+            (300, b"\xac\x02"),
+            (16384, b"\x80\x80\x01"),
+            ((1 << 64) - 1, b"\xff" * 9 + b"\x01"),
+        ],
+    )
+    def test_known_encodings(self, n, raw):
+        assert pc.encode_varint(n) == raw
+        assert pc.decode_varint(raw, 0) == (n, len(raw))
+
+    def test_truncated_raises(self):
+        with pytest.raises(ValueError):
+            pc.decode_varint(b"\x80", 0)
+        with pytest.raises(ValueError):
+            pc.decode_str(b"\x05ab", 0)
+
+
+class TestIndex:
+    def test_index_matches_linear(self):
+        limits = [
+            Limit(f"ns{i}", 10, 60 + i, [f"c == '{i}'"], ["u"],
+                  id=f"id{i}" if i % 2 else None)
+            for i in range(50)
+        ]
+        index = LimitKeyIndex(limits)
+        for limit in limits:
+            counter = Counter(limit, {"u": "x"})
+            key = key_for_counter(counter)
+            assert partial_counter_from_key(key, index) == counter
+            assert partial_counter_from_key(key, limits) == counter
