@@ -19,11 +19,12 @@ Consistency contract: local decisions are exact against (own + last gossiped
 remote) counts; cross-node over-admission is bounded by the gossip period —
 the reference's documented distributed-mode behavior (doc/topologies.md).
 
-Known limitation: counters of limits whose max_value exceeds the int32
-device cap (2^30) live in the host-side big-limit fallback, which has no
-device slot and is NOT gossiped — in this topology such counters are
-node-local. Practically irrelevant (a >1B-per-window limit rarely needs
-cross-node accounting), but documented for honesty.
+Counters of limits whose max_value exceeds the int32 device cap (2^30)
+live in the host-side big-limit fallback (exact Python ints, no device
+slot); their local counts gossip through the same broker stream and the
+remote per-actor sums fold into host-side admission via the
+``_big_remote_sum`` hook — the u64 scale of the reference's CRDT mode
+(cr_counter_value.rs:34-46) without the device cap ever applying.
 """
 
 from __future__ import annotations
@@ -96,6 +97,12 @@ class TpuReplicatedStorage(TpuStorage):
         self._remote_actors: Dict[bytes, Dict[str, Tuple[int, int]]] = {}
         self._dirty_remote: Dict[int, Tuple[int, int]] = {}  # slot -> (sum, exp)
         self._touched: set = set()  # keys touched locally since last gossip
+        # big-limit (host-side) cells: identity tuple <-> wire key, plus
+        # the set touched locally since the last gossip tick
+        self._big_wire: Dict[tuple, bytes] = {}
+        self._touched_big: set = set()
+        # wire keys whose limit wasn't configured when gossip arrived
+        self._parked_wires: set = set()
         self.broker = None
         self._gossip_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -154,8 +161,106 @@ class TpuReplicatedStorage(TpuStorage):
             max(0, min(exp_rel, (1 << 31) - 1)),
         )
 
+    def _on_big_write(self, key: tuple) -> None:
+        # Caller holds the lock (mixin contract); the gossip tick publishes.
+        self._touched_big.add(key)
+
+    def _wire_for(self, key: tuple, counter: Counter) -> bytes:
+        """Identity-tuple -> wire-key mapping, filled on first use (the
+        codec is deterministic, so a locally computed wire key equals the
+        bytes a peer gossips for the same counter). Caller holds the
+        lock."""
+        wire = self._big_wire.get(key)
+        if wire is None:
+            wire = key_for_counter(counter)
+            self._big_wire[key] = wire
+        return wire
+
+    def _big_cell(self, counter: Counter, key: tuple):
+        cell = super()._big_cell(counter, key)
+        # Mapping doubles as ADOPTION: per-actor state that gossiped in
+        # before this limit was configured locally parked under the wire
+        # key in _remote_actors and becomes visible to _big_remote now.
+        self._wire_for(key, counter)
+        return cell
+
+    def _big_remote(self, key: tuple, now: float):
+        """(live remote sum, max live expiry abs-ms), one actors pass."""
+        wire = self._big_wire.get(key)
+        actors = self._remote_actors.get(wire) if wire is not None else None
+        if not actors:
+            return 0, 0
+        now_abs_ms = now * 1000
+        total = 0
+        max_exp = 0
+        for count, exp in actors.values():
+            if exp > now_abs_ms:
+                total += count
+                if exp > max_exp:
+                    max_exp = exp
+        return total, max_exp
+
+    def _big_remote_sum(self, key: tuple, now: float) -> int:
+        return self._big_remote(key, now)[0]
+
+    def _adopt_parked(self) -> None:
+        """Fold gossip that arrived before its limit was configured:
+        decode parked wire keys; decodable big counters get a host cell
+        (+ wire mapping), device counters a slot — so admission and the
+        merged view see re-sync/gossip regardless of arrival order.
+        Caller holds the lock."""
+        for wire in list(self._parked_wires):
+            counter = self._decode_counter(wire)
+            if counter is None:
+                continue
+            self._parked_wires.discard(wire)
+            if self._is_big(counter):
+                key_t = self._key_of(counter)
+                self._big_wire[key_t] = wire
+                self._big_cell(counter, key_t)
+            else:
+                slot, _fresh = self._slot_for(counter, create=True)
+                self._queue_remote_sum(wire, slot)
+
+    def _emit_big_counters(self, limits, namespaces, now, out) -> None:
+        """Merged (local + live remote) view of big counters, including
+        remote-only ones whose local cell never fired."""
+        self._adopt_parked()
+        for key, (cell, counter) in list(self._big.items()):
+            if not (
+                counter.limit in limits or counter.namespace in namespaces
+            ):
+                continue
+            local = 0 if cell.is_expired(now) else cell.value_at(now)
+            remote, remote_exp = self._big_remote(key, now)
+            if cell.is_expired(now) and remote <= 0:
+                continue
+            ttl = cell.ttl(now) if not cell.is_expired(now) else 0.0
+            if remote_exp:
+                ttl = max(ttl, remote_exp / 1000.0 - now)
+            c = counter.key()
+            c.remaining = c.max_value - local - remote
+            c.expires_in = ttl
+            out.add(c)
+
+    def _delete_big(self, limits) -> None:
+        with self._lock:
+            doomed = [
+                key
+                for key, (_cell, counter) in self._big.items()
+                if counter.limit in limits
+            ]
+            for key in doomed:
+                wire = self._big_wire.pop(key, None)
+                if wire is not None:
+                    self._remote_actors.pop(wire, None)
+                self._touched_big.discard(key)
+        super()._delete_big(limits)
+
     def update_counter(self, counter: Counter, delta: int) -> None:
         super().update_counter(counter, delta)
+        if self._is_big(counter):
+            return  # _on_big_write already queued the gossip
         # unconditional updates bypass _kernel_check; still gossip them
         with self._lock:
             slot, _ = self._slot_for(counter, create=False)
@@ -169,6 +274,8 @@ class TpuReplicatedStorage(TpuStorage):
         out = super().apply_deltas(items)
         with self._lock:
             for counter, _delta in items:
+                if self._is_big(counter):
+                    continue  # _on_big_write already queued the gossip
                 slot, _ = self._slot_for(counter, create=False)
                 if slot is not None:
                     self._touched.add(slot)
@@ -210,6 +317,15 @@ class TpuReplicatedStorage(TpuStorage):
         self._limits_provider = provider
 
     def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        if self._is_big(counter):
+            # Host-side cell; the parent's big branch folds the gossiped
+            # remote share via _big_remote_sum. Ensure parked gossip for
+            # this counter is adopted first (the device branch's
+            # `create = wire in _remote_actors` analogue).
+            with self._lock:
+                if key_for_counter(counter) in self._remote_actors:
+                    self._big_cell(counter, self._key_of(counter))
+            return super().is_within_limits(counter, delta)
         with self._lock:
             now_ms = self._now_ms()
             create = key_for_counter(counter) in self._remote_actors
@@ -306,7 +422,17 @@ class TpuReplicatedStorage(TpuStorage):
             counter = self._decode_counter(key)
             if counter is None:
                 # Limit not configured here yet: the per-actor state stays
-                # parked and is adopted when the slot is first allocated.
+                # parked (tracked in _parked_wires) and is adopted lazily —
+                # at first local touch or by _adopt_parked.
+                self._parked_wires.add(key)
+                return
+            self._parked_wires.discard(key)
+            if self._is_big(counter):
+                # Host-side cell: ensure it exists so reads/emission see
+                # the remote share; admission folds it via _big_remote_sum.
+                key_t = self._key_of(counter)
+                self._big_cell(counter, key_t)
+                self._big_wire[key_t] = key
                 return
             slot, _fresh = self._slot_for(counter, create=True)
             self._queue_remote_sum(key, slot)
@@ -360,6 +486,19 @@ class TpuReplicatedStorage(TpuStorage):
                             expires_at,
                         )
                     )
+            now = self._clock()
+            for key, (cell, counter) in self._big.items():
+                if cell.is_expired(now):
+                    continue
+                wire = self._wire_for(key, counter)
+                out.append(
+                    (
+                        wire,
+                        {self.node_id: min(int(cell.value_at(now)),
+                                           (1 << 63) - 1)},
+                        int(now * 1000 + cell.ttl(now) * 1000),
+                    )
+                )
         return out
 
     def _gossip_loop(self) -> None:
@@ -385,10 +524,18 @@ class TpuReplicatedStorage(TpuStorage):
                     doomed_keys.append(key)
             for key in doomed_keys:
                 del self._remote_actors[key]
+            self._parked_wires &= set(self._remote_actors)
+            # A mapping is live while its cell exists or remote state does.
+            self._big_wire = {
+                k: w
+                for k, w in self._big_wire.items()
+                if k in self._big or w in self._remote_actors
+            }
 
     def _publish_touched(self) -> None:
         if self.broker is None:
             return
+        self._publish_touched_big()
         with self._lock:
             touched, self._touched = self._touched, set()
             if not touched:
@@ -417,6 +564,28 @@ class TpuReplicatedStorage(TpuStorage):
                 key, {self.node_id: int(v[i])}, expires_at
             )
 
+    def _publish_touched_big(self) -> None:
+        """Gossip locally-written big cells: exact Python-int counts on
+        the same wire stream (the proto carries u64; a count past that is
+        clamped — it exceeds any expressible max_value anyway)."""
+        to_send = []
+        with self._lock:
+            touched, self._touched_big = self._touched_big, set()
+            now = self._clock()
+            for key in touched:
+                entry = self._big.get(key)
+                if entry is None:
+                    continue
+                cell, counter = entry
+                if cell.is_expired(now):
+                    continue
+                wire = self._wire_for(key, counter)
+                expires_at = int(now * 1000 + cell.ttl(now) * 1000)
+                count = min(int(cell.value_at(now)), (1 << 63) - 1)
+                to_send.append((wire, count, expires_at))
+        for wire, count, expires_at in to_send:
+            self.broker.publish(wire, {self.node_id: count}, expires_at)
+
     # -- lifecycle -----------------------------------------------------------
 
     def clear(self) -> None:
@@ -427,6 +596,9 @@ class TpuReplicatedStorage(TpuStorage):
             self._remote_actors.clear()
             self._dirty_remote.clear()
             self._touched.clear()
+            self._big_wire.clear()
+            self._touched_big.clear()
+            self._parked_wires.clear()
 
     def close(self) -> None:
         self._stop.set()

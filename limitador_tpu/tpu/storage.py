@@ -162,6 +162,16 @@ class _BigLimitMixin:
                 break
         return cell
 
+    def _big_remote_sum(self, key: tuple, now: float) -> int:
+        """Live remote contribution to a big cell's admission base —
+        0 here; the replicated topology overrides it with the gossiped
+        per-actor sum (tpu/replicated.py)."""
+        return 0
+
+    def _on_big_write(self, key: tuple) -> None:
+        """Hook: a big cell was locally incremented (caller holds the
+        lock). The replicated topology queues it for gossip."""
+
     def _eval_big_hits(self, ordered, raw_delta: int, now: float):
         """First pass of a request: decide its big hits host-side.
         Returns (bigs, failed, projected) where each big is
@@ -175,7 +185,11 @@ class _BigLimitMixin:
                 continue
             key = self._key_of(c)
             cell = self._big_cell(c, key)
-            value = cell.value_at(now) + self._big_inflight.get(key, 0)
+            value = (
+                cell.value_at(now)
+                + self._big_inflight.get(key, 0)
+                + self._big_remote_sum(key, now)
+            )
             ok = value + raw_delta <= c.max_value
             remaining = max(c.max_value - (value + raw_delta), 0)
             ttl = (
@@ -205,6 +219,7 @@ class _BigLimitMixin:
             entry = self._big.get(key)
             if entry is not None:
                 entry[0].update(delta, window, now)
+                self._on_big_write(key)
 
     def _emit_big_counters(self, limits, namespaces, now: float, out) -> None:
         for _key, (cell, counter) in self._big.items():
@@ -549,11 +564,12 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             if self._is_big(counter):
-                entry = self._big.get(self._key_of(counter))
+                key = self._key_of(counter)
+                entry = self._big.get(key)
                 value = (
                     entry[0].value_at(self._clock())
                     if entry is not None else 0
-                )
+                ) + self._big_remote_sum(key, self._clock())
                 return value + delta <= counter.max_value
             slot, _ = self._slot_for(counter, create=False)
             if slot is None:
@@ -579,8 +595,10 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             if self._is_big(counter):
-                cell = self._big_cell(counter, self._key_of(counter))
+                key = self._key_of(counter)
+                cell = self._big_cell(counter, key)
                 cell.update(int(delta), counter.window_seconds, self._clock())
+                self._on_big_write(key)
                 return
             slot, is_fresh = self._slot_for(counter, create=True)
             H = _bucket(1)
@@ -711,10 +729,12 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             results: List[Optional[Tuple[int, float]]] = [None] * len(items)
             for i, (counter, delta) in enumerate(items):
                 if self._is_big(counter):
-                    cell = self._big_cell(counter, self._key_of(counter))
+                    key = self._key_of(counter)
+                    cell = self._big_cell(counter, key)
                     value = cell.update(
                         int(delta), counter.window_seconds, now
                     )
+                    self._on_big_write(key)
                     results[i] = (value, cell.ttl(now))
                 else:
                     dev_items.append((i, counter, delta))

@@ -303,3 +303,125 @@ def test_remote_only_counters_visible_in_get_counters():
     finally:
         a.close()
         b.close()
+
+
+def test_big_limit_counters_gossip():
+    """Counters with max_value beyond the device cap (host-side exact
+    cells) replicate like any other: B's admission and merged view absorb
+    A's hits, at u64 scale (cr_counter_value.rs:34-46 — the reference's
+    CRDT counters are u64 end-to-end; round 2 left these node-local)."""
+    BIG = (1 << 40) + 10  # far past the int32 device cap
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [f"127.0.0.1:{p1}"],
+        capacity=256, gossip_period=0.02,
+    )
+    b = TpuReplicatedStorage(
+        "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+        capacity=256, gossip_period=0.02,
+    )
+    try:
+        limit = Limit("ns", BIG, 60, [], ["u"])
+        la, lb = RateLimiter(a), RateLimiter(b)
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"u": "whale"})
+        # A takes a bite that only fits at u64 scale.
+        la.update_counters("ns", ctx, BIG - 3)
+
+        def b_sees_remote():
+            counters = lb.get_counters("ns")
+            if not counters:
+                return False
+            return next(iter(counters)).remaining == 3
+
+        assert eventually(b_sees_remote), "B never absorbed A's big count"
+        # B's admission: 3 left globally -> delta 3 fits, delta 4 doesn't.
+        assert not lb.is_rate_limited("ns", ctx, 3).limited
+        assert lb.is_rate_limited("ns", ctx, 4).limited
+        # B spends the remainder; both nodes converge on remaining 0.
+        assert not lb.check_rate_limited_and_update("ns", ctx, 3).limited
+        assert lb.check_rate_limited_and_update("ns", ctx, 1).limited
+
+        def a_sees_spent():
+            counters = la.get_counters("ns")
+            return bool(counters) and (
+                next(iter(counters)).remaining == 0
+            )
+
+        assert eventually(a_sees_spent), "A never absorbed B's big spend"
+        assert la.is_rate_limited("ns", ctx, 1).limited
+    finally:
+        a.close()
+        b.close()
+
+
+def test_big_limit_late_joiner_resync():
+    """A late-joining node receives big cells in the re-sync snapshot."""
+    BIG = 1 << 40
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [], capacity=256, gossip_period=0.03
+    )
+    try:
+        limit = Limit("ns", BIG, 60, [], ["u"])
+        la = RateLimiter(a)
+        la.add_limit(limit)
+        la.update_counters("ns", Context({"u": "x"}), BIG - 5)
+        b = TpuReplicatedStorage(
+            "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+            capacity=256, gossip_period=0.03,
+        )
+        try:
+            lb = RateLimiter(b)
+            lb.add_limit(limit)
+            assert eventually(
+                lambda: not lb.is_rate_limited(
+                    "ns", Context({"u": "x"}), 5
+                ).limited
+                and lb.is_rate_limited(
+                    "ns", Context({"u": "x"}), 6
+                ).limited
+            ), "late joiner never absorbed A's big cell"
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_big_gossip_before_limit_configured_is_adopted():
+    """Re-sync/gossip can land before the local node has the limit
+    configured: the parked per-actor state must fold into admission and
+    the merged view once the limit appears (the device path adopts via
+    _slot_for; this is the big-cell analogue)."""
+    from limitador_tpu.storage.keys import key_for_counter
+    from limitador_tpu.core.counter import Counter
+
+    BIG = 1 << 40
+    b = TpuReplicatedStorage("B", capacity=256)
+    try:
+        limit = Limit("ns", BIG, 60, [], ["u"])
+        counter = Counter(limit, {"u": "x"})
+        wire = key_for_counter(counter)
+        # Peer's update arrives while the limit is unknown here.
+        b._on_remote_update(
+            wire, {"A": BIG - 5}, int(time.time() * 1000) + 60_000
+        )
+        lb = RateLimiter(b)
+        lb.add_limit(limit)
+        # Admission adopts the parked remote count.
+        assert not lb.is_rate_limited("ns", Context({"u": "x"}), 5).limited
+        assert lb.is_rate_limited("ns", Context({"u": "x"}), 6).limited
+        # The merged view lists the remote-only counter.
+        counters = lb.get_counters("ns")
+        assert len(counters) == 1
+        assert next(iter(counters)).remaining == 5
+        # The full check path agrees.
+        assert not lb.check_rate_limited_and_update(
+            "ns", Context({"u": "x"}), 5
+        ).limited
+        assert lb.check_rate_limited_and_update(
+            "ns", Context({"u": "x"}), 1
+        ).limited
+    finally:
+        b.close()
