@@ -25,6 +25,7 @@ interpreter on the host.
 from __future__ import annotations
 
 import datetime as _dt
+import math
 import re
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
@@ -725,22 +726,33 @@ class _Evaluator:
 
     def _eval_Binary(self, e: Binary) -> Any:
         op = e.op
-        if op == "||":
-            left = self.eval(e.left)
-            if left is True:
-                return True
+        if op in ("||", "&&"):
+            # cel-spec logic semantics: commutative short-circuit — an
+            # error on one side (raised OR a non-bool operand) is ABSORBED
+            # when the other side alone decides the result
+            # (logic.AndShortCircuit/OrShortCircuit); NoSuchKey stays
+            # special (it drives predicate-false).
+            decider = op == "||"  # True decides ||, False decides &&
+            err: Optional[EvaluationError] = None
+            try:
+                left = self.eval(e.left)
+            except NoSuchKey:
+                raise
+            except EvaluationError as exc:
+                err = exc
+            else:
+                if left is decider:
+                    return decider
+                if not isinstance(left, bool):
+                    err = EvaluationError(f"'{op}' requires bool operands")
             right = self.eval(e.right)
-            if not isinstance(left, bool) or not isinstance(right, bool):
-                raise EvaluationError("'||' requires bool operands")
-            return left or right
-        if op == "&&":
-            left = self.eval(e.left)
-            if left is False:
-                return False
-            right = self.eval(e.right)
-            if not isinstance(left, bool) or not isinstance(right, bool):
-                raise EvaluationError("'&&' requires bool operands")
-            return left and right
+            if right is decider:
+                return decider
+            if err is not None:
+                raise err
+            if not isinstance(right, bool):
+                raise EvaluationError(f"'{op}' requires bool operands")
+            return right
 
         a = self.eval(e.left)
         b = self.eval(e.right)
@@ -751,11 +763,11 @@ class _Evaluator:
         if op in ("<", "<=", ">", ">="):
             return _cmp(op, a, b)
         if op == "in":
+            # cel-spec: `in` is list membership / map key presence only
+            # (substring tests are `.contains()`).
             if isinstance(b, list):
                 return any(_eq(a, x) for x in b)
             if isinstance(b, dict):
-                return a in b
-            if isinstance(b, str) and isinstance(a, str):
                 return a in b
             raise EvaluationError(f"cannot test membership in {_type_name(b)}")
         if op == "+":
@@ -796,11 +808,21 @@ class _Evaluator:
             )
         if op == "/":
             if _is_num(a) and _is_num(b):
-                if b == 0:
-                    raise EvaluationError("division by zero")
                 if isinstance(a, int) and isinstance(b, int):
+                    if b == 0:
+                        raise EvaluationError("division by zero")
                     q = abs(a) // abs(b)  # CEL int division truncates toward zero
                     return q if (a >= 0) == (b >= 0) else -q
+                if b == 0:
+                    # doubles follow IEEE 754 (cel-spec): x/0.0 is ±inf
+                    # with the sign from the SIGN BITS (so -0.0 divides
+                    # negative), and nan/0.0 or 0.0/0.0 is nan.
+                    if math.isnan(a) or a == 0:
+                        return float("nan")
+                    same_sign = (
+                        math.copysign(1.0, a) == math.copysign(1.0, b)
+                    )
+                    return float("inf") if same_sign else float("-inf")
                 return a / b
             raise EvaluationError(
                 f"cannot divide {_type_name(a)} by {_type_name(b)}"
@@ -955,6 +977,13 @@ class _Evaluator:
             raise EvaluationError(f"size() not supported for {_type_name(v)}")
         if fn == "string":
             (v,) = args
+            if isinstance(v, bytes):
+                # cel-spec: string(bytes) decodes UTF-8, erroring on
+                # invalid sequences (not a repr like format_value).
+                try:
+                    return v.decode("utf-8")
+                except UnicodeDecodeError as err:
+                    raise EvaluationError(str(err)) from None
             return format_value(v)
         if fn == "int":
             (v,) = args

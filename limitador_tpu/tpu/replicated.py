@@ -251,9 +251,13 @@ class TpuReplicatedStorage(TpuStorage):
                 if counter.limit in limits
             ]
             for key in doomed:
-                wire = self._big_wire.pop(key, None)
-                if wire is not None:
-                    self._remote_actors.pop(wire, None)
+                # Drop the mapping and pending publish but KEEP the
+                # per-actor remote state — the device delete path leaves
+                # _remote_actors intact too, so a live peer's window is
+                # re-adopted at the next local touch instead of being
+                # over-admitted away (the mapping recomputes
+                # deterministically via _wire_for).
+                self._big_wire.pop(key, None)
                 self._touched_big.discard(key)
         super()._delete_big(limits)
 

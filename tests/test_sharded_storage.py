@@ -282,3 +282,51 @@ def test_qualified_eviction_and_revival():
     # Revived key restarts fresh (recycled slot must not leak a stale value).
     r = limiter.check_rate_limited_and_update("ns", Context({"u": "u0"}), 1)
     assert not r.limited
+
+
+def test_global_overadmission_bound_within_one_batch():
+    """The documented inaccuracy contract for psum global counters
+    (parallel/mesh.py: 'over-admission is bounded by one batch per remote
+    device', the bounded-staleness analogue of redis_cached.rs:25-41):
+    hits landing on different shards within ONE launch each see the
+    pre-batch psum plus only their own shard's in-batch prefix, so the
+    total admitted past the limit is at most what the other (n-1) shards
+    admitted from this batch. Across launches the psum is fresh — a
+    follow-up batch must admit nothing."""
+    storage = make_storage(global_namespaces=["gns"])
+    n = storage._n
+    limiter = RateLimiter(storage)
+    max_value = 50
+    limit = Limit("gns", max_value, 60, [], ["u"])
+    limiter.add_limit(limit)
+    counter = Counter(limit, {"u": "g"})
+    # Exact pre-charge: 45 of 50 spent (psum'd across partials).
+    storage.update_counter(counter, 45)
+    budget = max_value - 45
+
+    # ONE batch of 40 single-delta requests on the same global counter,
+    # round-robin across all shards.
+    from limitador_tpu.tpu.storage import _Request
+
+    requests = [_Request([counter.key()], 1, False) for _ in range(40)]
+    auths = storage.check_many(requests)
+    admitted = sum(1 for a in auths if not a.limited)
+
+    # No under-admission: the remaining budget is always granted.
+    assert admitted >= budget
+    # Bound: each of the n shards admits at most `budget` from this batch
+    # (it sees base=45 plus its own prefix), so the overshoot past the
+    # limit is at most (n-1) * budget.
+    overshoot = admitted - budget
+    assert overshoot <= (n - 1) * budget, (admitted, n)
+
+    # The partials converged at the launch boundary: a second batch sees
+    # the full psum and admits nothing.
+    auths2 = storage.check_many(
+        [_Request([counter.key()], 1, False) for _ in range(8)]
+    )
+    assert all(a.limited for a in auths2)
+    # And the merged read agrees with what was actually admitted.
+    counters = storage.get_counters({limit})
+    value = max_value - next(iter(counters)).remaining
+    assert value == 45 + admitted

@@ -425,3 +425,30 @@ def test_big_gossip_before_limit_configured_is_adopted():
         ).limited
     finally:
         b.close()
+
+
+def test_big_delete_keeps_remote_state_for_readoption():
+    """delete_counters drops the local big cell but not peers' gossiped
+    windows (device parity): the next touch re-adopts the live remote
+    count instead of over-admitting it away."""
+    from limitador_tpu.storage.keys import key_for_counter
+    from limitador_tpu.core.counter import Counter
+
+    BIG = 1 << 40
+    b = TpuReplicatedStorage("B", capacity=256)
+    try:
+        limit = Limit("ns", BIG, 60, [], ["u"])
+        counter = Counter(limit, {"u": "x"})
+        b._on_remote_update(
+            key_for_counter(counter), {"A": BIG - 5},
+            int(time.time() * 1000) + 60_000,
+        )
+        lb = RateLimiter(b)
+        lb.add_limit(limit)
+        assert lb.is_rate_limited("ns", Context({"u": "x"}), 6).limited
+        b.delete_counters({limit})
+        # A's window is still live on the peer: admission re-adopts it.
+        assert lb.is_rate_limited("ns", Context({"u": "x"}), 6).limited
+        assert not lb.is_rate_limited("ns", Context({"u": "x"}), 5).limited
+    finally:
+        b.close()
