@@ -94,6 +94,19 @@ class PrometheusMetrics:
                 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
             ),
         )
+        # Queue-excluded device batch round trip — the slice of
+        # datastore_latency each batched request actually spent on the
+        # device (no reference equivalent; the MetricsLayer aggregate
+        # above is the parity metric, this one localizes the device).
+        self.datastore_device_latency = Histogram(
+            "datastore_device_latency",
+            "Device batch round-trip latency (queue excluded)",
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
         # Library-side operational metrics (the reference's metrics-facade
         # gauges, counters_cache.rs:49,173,207,267,368-371): polled from
         # attached sources at render time.
@@ -233,6 +246,12 @@ class PrometheusMetrics:
             self.limited_calls.labels(namespace, limit_name or "", *extra).inc()
         else:
             self.limited_calls.labels(namespace, *extra).inc()
+
+    def record_datastore_latency(self, timings) -> None:
+        """MetricsLayer consumer (prometheus_metrics.rs:131-133): the
+        aggregated busy+idle duration of all ``datastore`` child spans
+        under one aggregate root."""
+        self.datastore_latency.observe(timings.duration)
 
     @contextmanager
     def time_datastore(self):

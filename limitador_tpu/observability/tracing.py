@@ -13,6 +13,8 @@ from __future__ import annotations
 from contextlib import contextmanager
 from typing import Optional
 
+from .metrics_layer import metrics_span
+
 try:
     from opentelemetry import trace as _trace
 
@@ -67,31 +69,35 @@ def _noop_record(limited, name):
 def datastore_span(op: str):
     """Span around one storage I/O (the reference instruments every
     storage method and wraps backend I/O in info_span!("datastore"),
-    in_memory.rs:19-71, redis_async.rs:42-87). No-op unless an exporter
-    is configured."""
-    if _tracer is None or not _enabled:
-        yield
-        return
-    with _tracer.start_as_current_span("datastore") as span:
-        span.set_attribute("datastore.operation", op)
-        yield
+    in_memory.rs:19-71, redis_async.rs:42-87). Feeds both the OTLP
+    exporter (when configured) and the MetricsLayer span-tree
+    aggregation (when installed); no-op otherwise."""
+    with metrics_span("datastore"):
+        if _tracer is None or not _enabled:
+            yield
+            return
+        with _tracer.start_as_current_span("datastore") as span:
+            span.set_attribute("datastore.operation", op)
+            yield
 
 
 @contextmanager
 def should_rate_limit_span(namespace: str, hits_addend: int):
     """Span around one decision with the reference's attribute names
     (envoy_rls/server.rs:81-90); records limited/limit_name via the
-    returned setter."""
-    if _tracer is None or not _enabled:
-        yield _noop_record
-        return
-    with _tracer.start_as_current_span("should_rate_limit") as span:
-        span.set_attribute("ratelimit.namespace", namespace)
-        span.set_attribute("ratelimit.hits_addend", hits_addend)
+    returned setter. Doubles as the ``should_rate_limit`` MetricsLayer
+    aggregate root (main.rs:908-913)."""
+    with metrics_span("should_rate_limit"):
+        if _tracer is None or not _enabled:
+            yield _noop_record
+            return
+        with _tracer.start_as_current_span("should_rate_limit") as span:
+            span.set_attribute("ratelimit.namespace", namespace)
+            span.set_attribute("ratelimit.hits_addend", hits_addend)
 
-        def record(limited: bool, limit_name):
-            span.set_attribute("ratelimit.limited", limited)
-            if limit_name:
-                span.set_attribute("ratelimit.limit_name", limit_name)
+            def record(limited: bool, limit_name):
+                span.set_attribute("ratelimit.limited", limited)
+                if limit_name:
+                    span.set_attribute("ratelimit.limit_name", limit_name)
 
-        yield record
+            yield record

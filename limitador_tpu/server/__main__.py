@@ -401,6 +401,25 @@ async def _amain(args) -> int:
         use_limit_name_label=args.limit_name_in_labels,
         metric_labels=initial_labels,
     )
+    # Span-tree latency aggregation — the same two aggregates the
+    # reference's subscriber registers (main.rs:908-917): request-path
+    # datastore spans roll up under should_rate_limit, write-behind
+    # authority I/O under flush_batcher_and_update_counters.
+    from ..observability.metrics_layer import MetricsLayer, install
+
+    install(
+        MetricsLayer()
+        .gather(
+            "should_rate_limit",
+            metrics.record_datastore_latency,
+            ["datastore"],
+        )
+        .gather(
+            "flush_batcher_and_update_counters",
+            metrics.record_datastore_latency,
+            ["datastore"],
+        )
+    )
     labels_watcher = None
     if args.metric_labels_file:
 

@@ -27,6 +27,7 @@ from aiohttp import web
 from ..core.cel import Context
 from ..core.limit import Limit
 from ..observability.metrics import PrometheusMetrics
+from ..observability.metrics_layer import installed as _metrics_layer_installed
 from ..storage.base import StorageError
 from .rls import RATE_LIMIT_HEADERS_DRAFT03
 
@@ -233,10 +234,19 @@ class _Api:
 
     async def _call(self, thunk, batched: bool = False):
         """Invoke (and await if needed) under a datastore-latency span; the
-        thunk defers sync-limiter work into the timed region. ``batched``
-        marks operations the batched storages time themselves (queue
-        excluded) — only those skip the wrapper; inline admin/read paths
-        keep their wall-clock sample either way."""
+        thunk defers sync-limiter work into the timed region. With a
+        MetricsLayer installed the wrapper stands down — in the reference
+        the HTTP handlers carry non-aggregate span names
+        (http_api/server.rs:82-185), so only the should_rate_limit and
+        flush aggregates feed datastore_latency. ``batched`` marks
+        operations the batched storages time themselves (queue excluded)
+        — only those skip the wrapper; inline admin/read paths keep
+        their wall-clock sample either way."""
+        if _metrics_layer_installed() is not None:
+            value = thunk()
+            if asyncio.iscoroutine(value):
+                return await value
+            return value
         if self.metrics is not None and not (batched and self._self_timed):
             with self.metrics.time_datastore():
                 value = thunk()

@@ -30,6 +30,7 @@ _NULLCONTEXT = contextlib.nullcontext()
 from ..core.cel import Context
 from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
 from ..observability.metrics import PrometheusMetrics
+from ..observability.metrics_layer import installed as _metrics_layer_installed
 from ..observability.tracing import should_rate_limit_span
 from ..storage.base import StorageError
 from .proto import rls_pb2
@@ -90,10 +91,18 @@ class RlsService:
         self._self_timed = storage_self_timed(limiter)
 
     def _timed(self, batched: bool = False):
-        """datastore_latency span around storage calls. ``batched`` marks
-        operations the batched storages time themselves (queue excluded) —
-        only those skip the wrapper; inline read paths keep their
-        wall-clock sample either way."""
+        """datastore_latency fallback around storage calls. With a
+        MetricsLayer installed (the server default), the reference's
+        aggregates own the histogram — only the should_rate_limit and
+        flush roots feed it (main.rs:908-917; the Kuadrant/HTTP handlers
+        are instrumented with non-aggregate names there too) — so this
+        wrapper stands down. Without one (bare-library embedding), the
+        wall-clock sample is kept. ``batched`` marks operations the
+        batched storages time themselves (queue excluded, into
+        datastore_latency when no layer is installed) — those skip the
+        wrapper too."""
+        if _metrics_layer_installed() is not None:
+            return _NULLCONTEXT
         if self.metrics is not None and not (batched and self._self_timed):
             return self.metrics.time_datastore()
         return _NULLCONTEXT

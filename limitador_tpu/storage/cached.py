@@ -34,6 +34,8 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
+from ..observability.metrics_layer import metrics_span
+from ..observability.tracing import datastore_span
 from .base import (
     AsyncCounterStorage,
     Authorization,
@@ -155,9 +157,16 @@ class CachedCounterStorage(AsyncCounterStorage):
     async def flush(self) -> None:
         """One write-behind flush: push pending deltas, reconcile
         authoritative values (flush_batcher_and_update_counters,
-        redis_cached.rs:344-394)."""
-        async with self._flush_lock:
-            await self._flush_locked()
+        redis_cached.rs:344-394). The span doubles as the
+        ``flush_batcher_and_update_counters`` MetricsLayer aggregate
+        (main.rs:914-917): authority I/O below lands in
+        datastore_latency even though it happens off the request path.
+        Detached (inherit=False): an inline backpressure flush runs under
+        a request's own datastore span, and inheriting would fold the
+        authority I/O into the should_rate_limit aggregate twice."""
+        with metrics_span("flush_batcher_and_update_counters", inherit=False):
+            async with self._flush_lock:
+                await self._flush_locked()
 
     async def _flush_locked(self) -> None:
         batch, self._batch = self._batch, {}
@@ -179,9 +188,10 @@ class CachedCounterStorage(AsyncCounterStorage):
         del self._flush_sizes[:-1000]
         loop = asyncio.get_running_loop()
         try:
-            authoritative = await loop.run_in_executor(
-                None, self._apply_to_authority, items
-            )
+            with datastore_span("apply_deltas"):
+                authoritative = await loop.run_in_executor(
+                    None, self._apply_to_authority, items
+                )
         except BaseException as exc:
             # Return the in-flight deltas to the batch so nothing is lost —
             # for a partition we keep serving locally (redis_cached.rs:363-388),

@@ -41,6 +41,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
+from ..observability.metrics_layer import installed as _metrics_layer_installed
 from ..storage.base import (
     AsyncCounterStorage,
     Authorization,
@@ -49,6 +50,22 @@ from ..storage.base import (
 from .storage import TpuStorage, _Request
 
 __all__ = ["MicroBatcher", "UpdateBatcher", "AsyncTpuStorage"]
+
+
+def _latency_hists(metrics) -> list:
+    """Histograms a device batch round trip should be observed into.
+    The queue-excluded device view always lands in
+    ``datastore_device_latency`` when the sink provides it; without a
+    MetricsLayer installed (bare-library embedding — the server installs
+    one) the sample also feeds ``datastore_latency`` directly, since no
+    span aggregation is there to populate it."""
+    hists = []
+    dev = getattr(metrics, "datastore_device_latency", None)
+    if dev is not None:
+        hists.append(dev)
+    if _metrics_layer_installed() is None:
+        hists.append(metrics.datastore_latency)
+    return hists
 
 
 class MicroBatcher:
@@ -86,9 +103,10 @@ class MicroBatcher:
 
     def _observe_batch(self, n_requests: int, dt: float) -> None:
         if self.metrics is not None:
-            observe = self.metrics.datastore_latency.observe
-            for _ in range(n_requests):
-                observe(dt)
+            for hist in _latency_hists(self.metrics):
+                observe = hist.observe
+                for _ in range(n_requests):
+                    observe(dt)
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -302,8 +320,9 @@ class UpdateBatcher:
             else:
                 if self.metrics is not None:
                     dt = time.perf_counter() - t0
-                    for _ in waiters:
-                        self.metrics.datastore_latency.observe(dt)
+                    for hist in _latency_hists(self.metrics):
+                        for _ in waiters:
+                            hist.observe(dt)
                 self._settle(waiters, None)
 
     async def close(self) -> None:

@@ -1,0 +1,377 @@
+"""MetricsLayer span-tree aggregation tests.
+
+Mirrors the reference's unit suite (metrics.rs:213-293: timings_add,
+timings_add_assign, span_state_increment, metrics_layer) and extends it
+with the lifecycle walk the Rust tests leave to tracing-subscriber:
+nested record spans, sibling accumulation, intermediate spans,
+second-level aggregators, and the two server aggregates
+(should_rate_limit, flush_batcher_and_update_counters — main.rs:908-917)
+driven end-to-end through the instrumented code paths.
+"""
+
+import asyncio
+
+import pytest
+
+from limitador_tpu.observability.metrics_layer import (
+    MetricsLayer,
+    SpanState,
+    Timings,
+    install,
+    installed,
+    metrics_span,
+)
+
+
+@pytest.fixture(autouse=True)
+def _uninstall():
+    yield
+    install(None)
+
+
+# -- Timings / SpanState units (metrics.rs:218-285) ------------------------
+
+
+def test_timings_add():
+    t1 = Timings(idle=5, busy=5, last=100)
+    t2 = Timings(idle=3, busy=5, last=100)
+    t3 = t1 + t2
+    assert t3 == Timings(idle=8, busy=10, last=100, updated=False)
+
+
+def test_timings_add_keeps_max_last_and_updated():
+    t1 = Timings(idle=1, busy=1, last=50, updated=True)
+    t2 = Timings(idle=1, busy=1, last=80)
+    t3 = t1 + t2
+    assert t3.last == 80
+    assert t3.updated is True
+
+
+def test_timings_duration_is_idle_plus_busy():
+    assert Timings(idle=1_500_000_000, busy=500_000_000, last=0).duration == 2.0
+
+
+def test_span_state_increment():
+    state = SpanState("group")
+    t1 = Timings(idle=5, busy=5, last=7, updated=True)
+    state.increment("group", t1)
+    got = state.group_times["group"]
+    assert got.idle == 5
+    assert got.busy == 5
+    assert got.updated is True
+
+
+def test_metrics_layer_gather_registers_records():
+    ml = MetricsLayer().gather("group", lambda t: None, ["record"])
+    assert ml.groups["group"].records == ["record"]
+
+
+def test_gather_does_not_overwrite_existing_aggregate():
+    first = lambda t: None  # noqa: E731
+    ml = (
+        MetricsLayer()
+        .gather("group", first, ["a"])
+        .gather("group", lambda t: None, ["b"])
+    )
+    assert ml.groups["group"].consumer is first
+    assert ml.groups["group"].records == ["a"]
+
+
+# -- span-tree lifecycle ----------------------------------------------------
+
+
+def test_aggregator_with_one_record_child():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    root = ml.new_span("root")
+    with root:
+        with ml.new_span("datastore", parent=root):
+            pass
+    assert len(out) == 1
+    t = out[0]
+    assert t.updated is True
+    assert t.busy >= 0 and t.idle >= 0
+
+
+def test_sibling_records_accumulate():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("root") as root:
+        with ml.new_span("datastore", parent=root):
+            pass
+        with ml.new_span("datastore", parent=root):
+            pass
+    assert len(out) == 1
+    # two records folded into one group total: busy includes both spans
+    assert out[0].updated is True
+
+
+def test_record_under_intermediate_span_still_aggregates():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("root") as root:
+        with ml.new_span("handler", parent=root) as mid:
+            with ml.new_span("datastore", parent=mid):
+                pass
+    assert len(out) == 1
+
+
+def test_record_without_aggregator_is_ignored():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("datastore"):  # no root above it
+        pass
+    assert out == []
+
+
+def test_aggregator_without_updated_records_does_not_fire():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("root"):
+        with ml.new_span("unrelated"):
+            pass
+    assert out == []
+
+
+def test_nonrecord_spans_carry_no_timings():
+    ml = MetricsLayer().gather("root", lambda t: None, ["datastore"])
+    with ml.new_span("root") as root:
+        mid = ml.new_span("handler", parent=root)
+        assert mid.timings is None
+        rec = ml.new_span("datastore", parent=mid)
+        assert rec.timings is not None
+        rec.close()
+        mid.close()
+
+
+def test_two_groups_one_record_name():
+    """A record name shared by two groups increments both aggregates
+    (metrics.rs:186-195 iterates every group of the span state)."""
+    a_out, b_out = [], []
+    ml = (
+        MetricsLayer()
+        .gather("a", a_out.append, ["datastore"])
+        .gather("b", b_out.append, ["datastore"])
+    )
+    with ml.new_span("a") as a:
+        with ml.new_span("b", parent=a) as b:  # second-level aggregator
+            with ml.new_span("datastore", parent=b):
+                pass
+    assert len(a_out) == 1
+    assert len(b_out) == 1
+
+
+def test_second_level_aggregator_keeps_parent_group():
+    """A nested aggregator appends itself to the inherited state
+    (metrics.rs:119-127) instead of replacing it."""
+    ml = (
+        MetricsLayer()
+        .gather("outer", lambda t: None, ["x"])
+        .gather("inner", lambda t: None, ["y"])
+    )
+    with ml.new_span("outer") as outer:
+        inner = ml.new_span("inner", parent=outer)
+        assert set(inner.state.group_times) == {"outer", "inner"}
+        inner.close()
+
+
+def test_multiple_enter_exit_cycles_split_busy_and_idle():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("root") as root:
+        rec = ml.new_span("datastore", parent=root)
+        rec.enter()
+        rec.exit()
+        rec.enter()
+        rec.exit()
+        rec.close()
+    assert len(out) == 1
+    assert out[0].updated is True
+    # both busy (entered twice) and idle (created->entered, exited->closed)
+    # accumulated something
+    assert out[0].busy > 0
+    assert out[0].idle > 0
+
+
+def test_consumer_receives_copy_not_live_state():
+    out = []
+    ml = MetricsLayer().gather("root", out.append, ["datastore"])
+    with ml.new_span("root") as root:
+        with ml.new_span("datastore", parent=root):
+            pass
+    before = (out[0].idle, out[0].busy)
+    out[0].idle += 999
+    assert (out[0].idle - 999, out[0].busy) == before
+
+
+# -- contextvar parenting (async handler -> storage spans) ------------------
+
+
+def test_metrics_span_contextvar_parenting():
+    out = []
+    install(MetricsLayer().gather("root", out.append, ["datastore"]))
+    with metrics_span("root"):
+        with metrics_span("datastore"):  # parent discovered via contextvar
+            pass
+    assert len(out) == 1
+
+
+def test_metrics_span_noop_without_installed_layer():
+    assert installed() is None
+    with metrics_span("root") as span:
+        assert span is None
+
+
+def test_async_tasks_do_not_cross_parent():
+    """Two concurrent request handlers each see only their own root."""
+    out = []
+    install(MetricsLayer().gather("root", out.append, ["datastore"]))
+
+    async def handler():
+        with metrics_span("root"):
+            with metrics_span("datastore"):
+                await asyncio.sleep(0)
+
+    async def main():
+        await asyncio.gather(*(handler() for _ in range(4)))
+
+    asyncio.run(main())
+    assert len(out) == 4
+
+
+def test_await_time_counts_into_duration():
+    """The datastore span is open across the await: queue/await time is
+    idle, not lost — duration covers the full storage wait."""
+    out = []
+    install(MetricsLayer().gather("root", out.append, ["datastore"]))
+
+    async def handler():
+        with metrics_span("root"):
+            with metrics_span("datastore"):
+                await asyncio.sleep(0.02)
+
+    asyncio.run(handler())
+    assert out[0].duration >= 0.02
+
+
+# -- instrumented code paths ------------------------------------------------
+
+
+def test_limiter_datastore_spans_feed_aggregate():
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    out = []
+    install(
+        MetricsLayer().gather("should_rate_limit", out.append, ["datastore"])
+    )
+    limiter = RateLimiter(InMemoryStorage())
+    limiter.add_limit(Limit("ns", 10, 60, [], ["user"]))
+    from limitador_tpu.observability.tracing import should_rate_limit_span
+
+    with should_rate_limit_span("ns", 1) as record:
+        result = limiter.check_rate_limited_and_update(
+            "ns", Context({"user": "u1"}), 1, False
+        )
+        record(result.limited, result.limit_name)
+    assert len(out) == 1
+    assert out[0].updated is True
+
+
+def test_cached_flush_feeds_flush_aggregate():
+    from limitador_tpu import Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.storage.cached import CachedCounterStorage
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    out = []
+    install(
+        MetricsLayer().gather(
+            "flush_batcher_and_update_counters", out.append, ["datastore"]
+        )
+    )
+    limit = Limit("ns", 10, 60, [], [])
+    counter = Counter(limit, {})
+
+    async def run():
+        cached = CachedCounterStorage(InMemoryStorage(), flush_period=3600.0)
+        await cached.check_and_update([counter], 1, False)
+        await cached.flush()
+        await cached.close()
+
+    asyncio.run(run())
+    assert len(out) == 1
+    assert out[0].updated is True
+
+
+def test_inline_flush_does_not_double_count_request_aggregate():
+    """A backpressure flush awaited inside a request's storage call is a
+    detached aggregate: its authority I/O must not fold into the
+    should_rate_limit group a second time (the request's own datastore
+    span already covers the elapsed wait)."""
+    from limitador_tpu import Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.storage.cached import CachedCounterStorage
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    req_out, flush_out = [], []
+    install(
+        MetricsLayer()
+        .gather("should_rate_limit", req_out.append, ["datastore"])
+        .gather(
+            "flush_batcher_and_update_counters", flush_out.append,
+            ["datastore"],
+        )
+    )
+    limit = Limit("ns", 1000, 60, [], [])
+    counter = Counter(limit, {})
+
+    async def run():
+        cached = CachedCounterStorage(InMemoryStorage(), flush_period=3600.0)
+        start = asyncio.get_event_loop().time()
+        with metrics_span("should_rate_limit"):
+            from limitador_tpu.observability.tracing import datastore_span
+
+            with datastore_span("check_and_update"):
+                await cached.check_and_update([counter], 1, False)
+                await cached.flush()  # stands in for inline backpressure
+        elapsed = asyncio.get_event_loop().time() - start
+        await cached.close()
+        return elapsed
+
+    elapsed = asyncio.run(run())
+    assert len(req_out) == 1
+    assert len(flush_out) == 1
+    # the request aggregate cannot exceed the request's wall clock — with
+    # inherited flush spans it would count the authority I/O twice
+    assert req_out[0].duration <= elapsed + 0.05
+
+
+def test_batcher_feeds_datastore_latency_without_layer():
+    """Bare-library embedding (no MetricsLayer): the batched storage's
+    self-timed samples keep landing in datastore_latency (plus the device
+    histogram), so the metric does not silently go dark."""
+    from limitador_tpu.observability import PrometheusMetrics
+    from limitador_tpu.tpu.batcher import _latency_hists
+
+    m = PrometheusMetrics()
+    assert installed() is None
+    hists = _latency_hists(m)
+    assert m.datastore_latency in hists
+    assert m.datastore_device_latency in hists
+    install(MetricsLayer())
+    hists = _latency_hists(m)
+    assert m.datastore_latency not in hists
+    assert m.datastore_device_latency in hists
+
+
+def test_prometheus_record_datastore_latency():
+    from limitador_tpu.observability import PrometheusMetrics
+
+    m = PrometheusMetrics()
+    m.record_datastore_latency(
+        Timings(idle=1_000_000, busy=1_000_000, last=0, updated=True)
+    )
+    body = m.render().decode()
+    assert "datastore_latency_count 1.0" in body
+    assert "datastore_latency_sum 0.002" in body

@@ -218,8 +218,10 @@ def test_compiler_eval_counters_reach_metrics():
 
 def test_batcher_reports_datastore_latency():
     """With set_metrics, per-request device-batch latency lands in the
-    datastore_latency histogram (queue wait excluded) and the storage
-    flags itself as self-timed so the serving plane won't double-count."""
+    datastore_device_latency histogram (queue wait excluded; the
+    MetricsLayer span aggregation owns datastore_latency) and the
+    storage flags itself as self-timed so the serving plane won't
+    double-count."""
     from limitador_tpu.observability.metrics import PrometheusMetrics
     from limitador_tpu import AsyncRateLimiter
 
@@ -244,6 +246,6 @@ def test_batcher_reports_datastore_latency():
     text = run(main())
     count = [
         l for l in text.splitlines()
-        if l.startswith("datastore_latency_count")
+        if l.startswith("datastore_device_latency_count")
     ][0]
     assert float(count.split()[-1]) >= 11  # 10 checks + 1 update
