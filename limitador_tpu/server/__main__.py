@@ -106,6 +106,22 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="validate the limits file and exit",
     )
+    def _positive_interval(value: str) -> float:
+        interval = float(value)
+        if interval <= 0:
+            raise argparse.ArgumentTypeError(
+                "poll interval must be > 0 seconds"
+            )
+        return interval
+
+    p.add_argument(
+        "--limits-poll-interval", type=_positive_interval,
+        default=_positive_interval(_env("LIMITS_FILE_POLL_INTERVAL", "1.0")),
+        help="limits/labels file change-poll interval in seconds, > 0 "
+        "(the reference watches via inotify, main.rs limits_file "
+        "watcher; polling is filesystem-agnostic — ConfigMap symlink "
+        "swaps included)",
+    )
     # storage tuning
     p.add_argument(
         "--cache-size", type=int, default=None,
@@ -442,6 +458,7 @@ async def _amain(args) -> int:
                 f"metric labels file reload failed: {exc}", file=sys.stderr
             ),
             loader=_load_labels,
+            poll_interval=args.limits_poll_interval,
         )
         labels_watcher.start()
     limiter = build_limiter(
@@ -500,7 +517,10 @@ async def _amain(args) -> int:
         # Construct the watcher (capturing its baseline stamp) BEFORE the
         # initial load, so a file replaced between load and watch (e.g. a
         # ConfigMap symlink flip during startup) still triggers a reload.
-        watcher = LimitsFileWatcher(args.limits_file, on_change, on_error)
+        watcher = LimitsFileWatcher(
+            args.limits_file, on_change, on_error,
+            poll_interval=args.limits_poll_interval,
+        )
         limits = load_limits_file(args.limits_file)
         await apply_limits(limits)
         status["limits_file_version"] = 1

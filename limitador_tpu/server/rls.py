@@ -30,7 +30,10 @@ _NULLCONTEXT = contextlib.nullcontext()
 from ..core.cel import Context
 from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
 from ..observability.metrics import PrometheusMetrics
-from ..observability.metrics_layer import installed as _metrics_layer_installed
+from ..observability.metrics_layer import (
+    installed as _metrics_layer_installed,
+    metrics_span,
+)
 from ..observability.tracing import should_rate_limit_span
 from ..storage.base import StorageError
 from .proto import rls_pb2
@@ -264,7 +267,14 @@ def make_native_should_rate_limit_handler(native_pipeline):
 
     async def handler(blob: bytes, context) -> bytes:
         try:
-            return await native_pipeline.submit(blob)
+            # MetricsLayer aggregate for the native path: the one storage
+            # wait (parse -> device -> response blob) is the request's
+            # datastore time. metrics_span (not the OTel wrapper) keeps
+            # this a pair of module-global checks when no layer is
+            # installed — nothing else rides the raw-bytes hot path.
+            with metrics_span("should_rate_limit"):
+                with metrics_span("datastore"):
+                    return await native_pipeline.submit(blob)
         except StorageError as exc:
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"

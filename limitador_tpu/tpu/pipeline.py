@@ -23,8 +23,9 @@ from ..core.cel import Context
 from ..core.counter import Counter
 from ..core.limiter import AsyncRateLimiter, CheckResult
 from ..core.limit import Limit, Namespace
+from ..observability.tracing import datastore_span
 from ..storage.base import Authorization
-from .batcher import AsyncTpuStorage
+from .batcher import AsyncTpuStorage, _latency_hists
 from .compiler import NamespaceCompiler
 
 __all__ = ["CompiledTpuLimiter"]
@@ -172,9 +173,14 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._flush_soon()
             )
-        if len(self._pending) >= self.max_batch:
-            await self._flush()
-        return await future
+        # The wait for the batched device decision IS this request's
+        # datastore time: a record span here rolls it up under the
+        # should_rate_limit aggregate (queue/linger counts as idle, the
+        # reference's semantics for awaited storage futures).
+        with datastore_span("check_and_update"):
+            if len(self._pending) >= self.max_batch:
+                await self._flush()
+            return await future
 
     async def _flush_soon(self) -> None:
         await asyncio.sleep(self.max_delay)
@@ -263,13 +269,14 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                     p.future.set_exception(exc)
             return
         if self._metrics is not None:
-            # Per-request datastore time: the device batch round trip each
-            # of these requests waited on (queue/linger excluded) — the
-            # busy-time semantics of the reference's MetricsLayer
-            # (metrics.rs:100-211).
+            # Queue-excluded device batch round trip each of these
+            # requests waited on; the span opened in
+            # check_rate_limited_and_update feeds datastore_latency via
+            # the MetricsLayer when one is installed.
             dt = time.perf_counter() - t0
-            for _ in live:
-                self._metrics.datastore_latency.observe(dt)
+            for hist in _latency_hists(self._metrics):
+                for _ in live:
+                    hist.observe(dt)
         for (p, counters), auth in zip(live, auths):
             loaded = counters if p.load else []
             result = CheckResult(auth.limited, loaded, auth.limit_name)

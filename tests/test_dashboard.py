@@ -1,0 +1,75 @@
+"""The Grafana dashboard must only query metrics this server exports.
+
+Counterpart hygiene for the reference's
+kubernetes/limitador-grafanadashboard.json: every metric name referenced
+in a panel expression (ignoring PromQL functions/labels and the
+kube-state/cAdvisor families we intentionally replaced) must exist in
+the PrometheusMetrics exposition.
+"""
+
+import json
+import re
+from pathlib import Path
+
+DASHBOARD = Path(__file__).parent.parent / "examples" / "grafana-dashboard.json"
+
+PROMQL_BUILTINS = {
+    "rate", "irate", "sum", "by", "le", "topk", "clamp_min",
+    "histogram_quantile", "label_values", "m", "s",
+    "e",  # exponent marker in numeric literals (1e-9)
+}
+
+
+def exported_names():
+    from limitador_tpu.observability import PrometheusMetrics
+
+    names = set()
+    for fam in PrometheusMetrics().registry.collect():
+        names.add(fam.name)
+        for s in fam.samples:
+            names.add(s.name)
+    return names
+
+
+def dashboard_exprs():
+    doc = json.loads(DASHBOARD.read_text())
+    exprs = []
+
+    def walk(panels):
+        for p in panels:
+            for t in p.get("targets", []) or []:
+                if t.get("expr"):
+                    exprs.append(t["expr"])
+            walk(p.get("panels", []) or [])
+
+    walk(doc["panels"])
+    for var in doc.get("templating", {}).get("list", []):
+        q = var.get("query")
+        if isinstance(q, str) and "(" in q:
+            exprs.append(q)
+    return exprs
+
+
+def test_dashboard_is_valid_json_with_panels():
+    doc = json.loads(DASHBOARD.read_text())
+    assert doc["uid"] == "limitador-tpu"
+    assert len(doc["panels"]) >= 10
+
+
+def test_dashboard_metrics_all_exported():
+    names = exported_names()
+    missing = set()
+    for expr in dashboard_exprs():
+        for ident in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr):
+            if ident in PROMQL_BUILTINS or ident.startswith("$"):
+                continue
+            if ident in ("limitador_namespace",):  # label, not a metric
+                continue
+            # identifiers followed by ( are function calls; filter by
+            # checking against the metric-shaped remainder
+            if ident in names:
+                continue
+            if f"{ident}_total" in names or ident.removesuffix("_total") in names:
+                continue
+            missing.add(ident)
+    assert not missing, f"dashboard references unexported metrics: {missing}"

@@ -1,0 +1,169 @@
+"""End-to-end limits-file reload against a real server subprocess.
+
+Mirrors the reference's e2e/file-watcher scenario
+(limitador-server/e2e/file-watcher/: a ConfigMap serving limits.yaml with
+namespace ``test`` max_value 1000 is updated to 2000 and the change is
+observed through ``GET /limits/test`` on the running pod) — here the
+kubernetes plumbing is replaced by a subprocess and direct file edits,
+including the ConfigMap symlink-swap layout the watcher special-cases.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+LIMITS_V1 = """\
+- namespace: test
+  max_value: 1000
+  seconds: 1
+  conditions: []
+  variables: ["user_id"]
+"""
+
+LIMITS_V2 = LIMITS_V1.replace("1000", "2000")
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def http_get(port, path, timeout=2.0):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def wait_for(predicate, timeout=15.0, interval=0.1):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = predicate()
+            if last:
+                return last
+        except Exception as exc:  # server still booting / mid-reload
+            last = exc
+        time.sleep(interval)
+    raise AssertionError(f"condition not met within {timeout}s: {last!r}")
+
+
+@pytest.fixture
+def server(tmp_path):
+    """Boot ``python -m limitador_tpu.server <limits> memory`` for the
+    given limits path; yields (proc, http_port, limits_path)."""
+    procs = []
+
+    def boot(limits_path, poll_s="0.05"):
+        http_port, rls_port = free_port(), free_port()
+        env = dict(os.environ, PYTHONPATH="/root/repo")
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "limitador_tpu.server",
+                str(limits_path), "memory",
+                "--rls-port", str(rls_port),
+                "--http-port", str(http_port),
+                "--limits-poll-interval", poll_s,
+            ],
+            cwd="/root/repo",
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        procs.append(proc)
+        wait_for(lambda: http_get(http_port, "/status")["status"] == "ok")
+        return proc, http_port
+
+    yield boot
+    for proc in procs:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+
+
+def test_plain_file_edit_reloads(server, tmp_path):
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(LIMITS_V1)
+    _proc, port = server(limits)
+
+    got = http_get(port, "/limits/test")
+    assert [l["max_value"] for l in got] == [1000]
+    v0 = http_get(port, "/status")["limits_file_version"]
+
+    limits.write_text(LIMITS_V2)
+    wait_for(
+        lambda: http_get(port, "/limits/test")[0]["max_value"] == 2000
+    )
+    status = http_get(port, "/status")
+    assert status["limits_file_version"] > v0
+    assert status["limits_file_errors"] == 0
+
+
+def test_configmap_symlink_swap_reloads(server, tmp_path):
+    """The kubernetes ConfigMap update model: the mounted file is a
+    symlink through a ``..data`` directory that is atomically re-pointed
+    (what e2e/file-watcher exercises via `kubectl apply`)."""
+    mount = tmp_path / "mount"
+    mount.mkdir()
+    v1 = mount / "..v1"
+    v1.mkdir()
+    (v1 / "limits.yaml").write_text(LIMITS_V1)
+    data = mount / "..data"
+    data.symlink_to("..v1")
+    limits = mount / "limits.yaml"
+    limits.symlink_to("..data/limits.yaml")
+
+    _proc, port = server(limits)
+    assert http_get(port, "/limits/test")[0]["max_value"] == 1000
+
+    v2 = mount / "..v2"
+    v2.mkdir()
+    (v2 / "limits.yaml").write_text(LIMITS_V2)
+    tmp_link = mount / "..data_tmp"
+    tmp_link.symlink_to("..v2")
+    tmp_link.rename(data)  # atomic re-point, as kubelet does
+
+    wait_for(
+        lambda: http_get(port, "/limits/test")[0]["max_value"] == 2000
+    )
+    assert http_get(port, "/status")["limits_file_errors"] == 0
+
+
+def test_bad_edit_keeps_serving_and_counts_error(server, tmp_path):
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(LIMITS_V1)
+    _proc, port = server(limits)
+
+    limits.write_text("][ not yaml {{{")
+    wait_for(
+        lambda: http_get(port, "/status")["limits_file_errors"] >= 1
+    )
+    # old limits still served, server still answers checks
+    assert http_get(port, "/limits/test")[0]["max_value"] == 1000
+    body = json.dumps(
+        {"namespace": "test", "values": {"user_id": "e2e"}, "delta": 1}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/check_and_report",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=2) as resp:
+        assert resp.status == 200
+
+    # recovery: a good edit reloads and the error counter stops growing
+    limits.write_text(LIMITS_V2)
+    wait_for(
+        lambda: http_get(port, "/limits/test")[0]["max_value"] == 2000
+    )
