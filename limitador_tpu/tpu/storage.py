@@ -636,18 +636,53 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         per-hit Python objects). Caller pads to a bucket (use
         ``pad_hits``); returns host arrays (admitted, hit_ok, remaining,
         ttl_ms)."""
-        import jax
+        return self.finish_check_columnar(
+            self.begin_check_columnar(
+                slots, deltas, maxes, windows_ms, req_ids, fresh
+            )
+        )
 
+    def begin_check_columnar(
+        self,
+        slots: np.ndarray,
+        deltas: np.ndarray,
+        maxes: np.ndarray,
+        windows_ms: np.ndarray,
+        req_ids: np.ndarray,
+        fresh: np.ndarray,
+    ):
+        """Launch the columnar kernel and return the in-flight device
+        result (JAX async dispatch: this does not block on the device).
+        ``finish_check_columnar`` collects it. Launches are ordered by
+        the storage lock; the state array threads through launches, so a
+        later begin is correct even while earlier results are still in
+        flight — this is what lets a caller overlap batch N's device
+        round trip with batch N+1's host work."""
         with self._lock:
             now_ms = self._now_ms()
             self._state, result = K.check_and_update_batch(
                 self._state, slots, deltas, maxes, windows_ms, req_ids,
                 fresh, np.int32(now_ms),
             )
-            return jax.device_get(
-                (result.admitted, result.hit_ok, result.remaining,
-                 result.ttl_ms)
+            return result
+
+    def finish_check_columnar(self, result, with_remaining: bool = True):
+        """Block on a begin_check_columnar launch; returns host arrays
+        (admitted, hit_ok, remaining, ttl_ms). ``with_remaining=False``
+        transfers only the decision arrays (remaining/ttl come back as
+        None) — on a high-RTT link the device->host copy is the round
+        trip, so callers that don't load counters halve it."""
+        import jax
+
+        if not with_remaining:
+            admitted, hit_ok = jax.device_get(
+                (result.admitted, result.hit_ok)
             )
+            return admitted, hit_ok, None, None
+        return jax.device_get(
+            (result.admitted, result.hit_ok, result.remaining,
+             result.ttl_ms)
+        )
 
     def pad_hits(self, arrays: Tuple[np.ndarray, ...], nhits: int):
         """Pad (slots, deltas, maxes, windows, req_ids, fresh) to the next

@@ -15,8 +15,11 @@ import subprocess
 import sys
 import time
 import urllib.request
+from pathlib import Path
 
 import pytest
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
 
 LIMITS_V1 = """\
 - namespace: test
@@ -64,7 +67,7 @@ def server(tmp_path):
 
     def boot(limits_path, poll_s="0.05"):
         http_port, rls_port = free_port(), free_port()
-        env = dict(os.environ, PYTHONPATH="/root/repo")
+        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "limitador_tpu.server",
@@ -73,7 +76,7 @@ def server(tmp_path):
                 "--http-port", str(http_port),
                 "--limits-poll-interval", poll_s,
             ],
-            cwd="/root/repo",
+            cwd=REPO_ROOT,
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,

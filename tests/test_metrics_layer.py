@@ -375,3 +375,27 @@ def test_prometheus_record_datastore_latency():
     body = m.render().decode()
     assert "datastore_latency_count 1.0" in body
     assert "datastore_latency_sum 0.002" in body
+
+
+def test_detached_spawn_does_not_inherit_request_span():
+    """Background tasks spawned from under a request span (the native
+    pipeline's flush loop and slow-path decides) must run in a fresh
+    context — inheriting would parent them under one arbitrary request's
+    aggregate."""
+    from limitador_tpu.tpu.native_pipeline import _spawn_detached
+    from limitador_tpu.observability.metrics_layer import current_span
+
+    install(MetricsLayer().gather("root", lambda t: None, ["datastore"]))
+    seen = []
+
+    async def background():
+        seen.append(current_span())
+
+    async def main():
+        with metrics_span("root") as span:
+            assert current_span() is span
+            task = _spawn_detached(background())
+            await task
+
+    asyncio.run(main())
+    assert seen == [None]
