@@ -1,0 +1,290 @@
+"""ctypes binding + batch pump for the vendored HTTP/2 gRPC ingress
+(native/h2ingress.cc).
+
+The C++ side owns every socket: accept, HTTP/2 framing, HPACK, flow
+control, and response frames all happen on one epoll thread with zero
+Python per request. Python sees the ingress as a batch queue: the pump
+thread takes whole batches of raw RateLimitRequest payloads, runs them
+through ``NativeRlsPipeline.decide_many`` (parse -> masks -> slots ->
+device kernel -> response blobs), and answers the batch in one call.
+Rows the columnar engine can't take (multi-descriptor, exact-path
+namespaces) are fed to the asyncio ``submit`` path on the server's loop
+and answered individually as they resolve.
+
+Replaces the Python ``grpc.aio`` floor for ShouldRateLimit (the
+reference's tonic ingress, envoy_rls/server.rs:238-272); the Kuadrant
+service and the HTTP API keep the Python server.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+__all__ = ["ingress_available", "ingress_build_error", "NativeIngress"]
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_ROOT, "native", "h2ingress.cc")
+_TABLES = os.path.join(_ROOT, "native", "h2_hpack_tables.h")
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libh2ingress.so")
+_STAMP = _SO + ".sha256"
+
+TARGET_PATH = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
+
+GRPC_UNAVAILABLE = 14
+GRPC_INTERNAL = 13
+
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _src_digest() -> Optional[str]:
+    try:
+        h = hashlib.sha256()
+        for path in (_SRC, _TABLES):
+            with open(path, "rb") as f:
+                h.update(f.read())
+        return h.hexdigest()
+    except OSError:
+        return None
+
+
+def _stale(digest: Optional[str]) -> bool:
+    if not os.path.exists(_SO):
+        return True
+    if digest is None:
+        return False
+    try:
+        with open(_STAMP) as f:
+            return f.read().strip() != digest
+    except OSError:
+        return True
+
+
+def _build(digest: Optional[str]) -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=180)
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        return f"g++ invocation failed: {exc}"
+    if proc.returncode != 0:
+        return f"g++ failed: {proc.stderr[-2000:]}"
+    if digest is not None:
+        with open(_STAMP, "w") as f:
+            f.write(digest)
+    return None
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        digest = _src_digest()
+        if _stale(digest):
+            _build_error = _build(digest)
+            if _build_error is not None:
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as exc:
+            _build_error = str(exc)
+            return None
+        lib.h2i_create.restype = ctypes.c_void_p
+        lib.h2i_create.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+        ]
+        lib.h2i_port.restype = ctypes.c_int
+        lib.h2i_port.argtypes = [ctypes.c_void_p]
+        lib.h2i_take.restype = ctypes.c_int
+        lib.h2i_take.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.h2i_respond.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
+        lib.h2i_stat.restype = ctypes.c_uint64
+        lib.h2i_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.h2i_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def ingress_available() -> bool:
+    return _load() is not None
+
+
+def ingress_build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+class NativeIngress:
+    """Owns one C++ ingress context and its pump thread.
+
+    ``loop`` (an asyncio loop running elsewhere) enables the exact
+    fallback for rows decide_many can't take; without one they answer
+    UNIMPLEMENTED."""
+
+    def __init__(
+        self,
+        pipeline,
+        host: str = "0.0.0.0",
+        port: int = 0,
+        loop=None,
+        max_batch: int = 8192,
+        poll_ms: int = 20,
+    ):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(
+                f"native ingress unavailable: {_build_error}"
+            )
+        self._lib = lib
+        self.pipeline = pipeline
+        self.loop = loop
+        self.max_batch = max_batch
+        self.poll_ms = poll_ms
+        self._ctx = ctypes.c_void_p(
+            lib.h2i_create(host.encode(), port, TARGET_PATH.encode())
+        )
+        if not self._ctx:
+            raise OSError(f"could not bind native ingress to {host}:{port}")
+        self.port = lib.h2i_port(self._ctx)
+        self._stopping = False
+        # Serializes every h2i_* call against close(): slow-path done
+        # callbacks fire on the server loop thread and must never reach a
+        # freed context.
+        self._ctx_lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._pump, name="h2-ingress-pump", daemon=True
+        )
+        self._thread.start()
+
+    # -- stats --------------------------------------------------------------
+
+    def stats(self) -> dict:
+        s = self._lib.h2i_stat
+        return {
+            "connections": s(self._ctx, 0),
+            "requests": s(self._ctx, 1),
+            "responses": s(self._ctx, 2),
+            "protocol_errors": s(self._ctx, 3),
+        }
+
+    # -- pump ---------------------------------------------------------------
+
+    def _pump(self) -> None:
+        n_max = self.max_batch
+        ids = (ctypes.c_uint64 * n_max)()
+        ptrs = (ctypes.c_void_p * n_max)()
+        lens = (ctypes.c_uint32 * n_max)()
+        while not self._stopping:
+            n = self._lib.h2i_take(
+                self._ctx, n_max, self.poll_ms,
+                ids,
+                ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                lens,
+            )
+            if n <= 0:
+                continue
+            rids = [ids[i] for i in range(n)]
+            blobs = [
+                ctypes.string_at(ptrs[i], lens[i]) for i in range(n)
+            ]
+            try:
+                results = self.pipeline.decide_many(blobs, chunk=len(blobs))
+            except Exception as exc:  # answer the batch, don't die
+                self._respond(
+                    [(rid, GRPC_INTERNAL, str(exc).encode()[:100])
+                     for rid in rids]
+                )
+                continue
+            out = []
+            for rid, blob, res in zip(rids, blobs, results):
+                if res is None:
+                    self._submit_slow(rid, blob)
+                elif res is self.pipeline.STORAGE_ERROR:
+                    out.append(
+                        (rid, GRPC_UNAVAILABLE, b"storage unavailable")
+                    )
+                else:
+                    out.append((rid, 0, res))
+            if out:
+                self._respond(out)
+
+    def _submit_slow(self, rid: int, blob: bytes) -> None:
+        """Exact-path row: run it through the pipeline's asyncio submit
+        on the server loop, answer when it resolves."""
+        import asyncio
+
+        from ..storage.base import StorageError
+
+        if self.loop is None:
+            self._respond([(rid, 12, b"method variant not supported")])
+            return
+
+        def done(fut):
+            try:
+                self._respond([(rid, 0, fut.result())])
+            except StorageError:
+                self._respond(
+                    [(rid, GRPC_UNAVAILABLE, b"Service unavailable")]
+                )
+            except Exception as exc:
+                self._respond([(rid, GRPC_INTERNAL, str(exc).encode()[:100])])
+
+        try:
+            cfut = asyncio.run_coroutine_threadsafe(
+                self.pipeline.submit(blob), self.loop
+            )
+        except RuntimeError as exc:  # loop closed
+            self._respond([(rid, GRPC_UNAVAILABLE, str(exc).encode()[:100])])
+            return
+        cfut.add_done_callback(done)
+
+    def _respond(self, items: List[tuple]) -> None:
+        if not items:
+            return
+        n = len(items)
+        ids = (ctypes.c_uint64 * n)(*[it[0] for it in items])
+        statuses = (ctypes.c_int * n)(*[it[1] for it in items])
+        payloads = (ctypes.c_char_p * n)(*[it[2] for it in items])
+        lens = (ctypes.c_uint32 * n)(*[len(it[2]) for it in items])
+        with self._ctx_lock:
+            if self._ctx is None:  # closed: peers are gone anyway
+                return
+            self._lib.h2i_respond(self._ctx, n, ids, statuses, payloads,
+                                  lens)
+
+    def close(self) -> None:
+        if self._stopping:
+            return
+        self._stopping = True
+        # No timeout: the pump may legitimately sit inside a multi-second
+        # device round trip; freeing the context under it would be a
+        # use-after-free. It re-checks _stopping after every take.
+        self._thread.join()
+        with self._ctx_lock:
+            self._lib.h2i_close(self._ctx)
+            self._ctx = None

@@ -147,6 +147,15 @@ def build_parser() -> argparse.ArgumentParser:
         "library is unavailable)",
     )
     p.add_argument(
+        "--native-ingress",
+        action="store_true",
+        default=_env("TPU_NATIVE_INGRESS", "") == "1",
+        help="serve ShouldRateLimit through the vendored C++ HTTP/2 "
+        "ingress on --rls-port (requires tpu storage, --pipeline native, "
+        "headers NONE); the Python gRPC server (Kuadrant + Envoy with "
+        "headers) moves to --rls-port + 1",
+    )
+    p.add_argument(
         "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
         help="sharded: comma-separated namespaces whose counters are "
         "psum-replicated across shards (one budget mesh-wide)",
@@ -564,9 +573,45 @@ async def _amain(args) -> int:
             file=sys.stderr,
         )
 
+    native_ingress = None
+    rls_grpc_port = args.rls_port
+    if args.native_ingress:
+        from ..native.ingress import (
+            NativeIngress,
+            ingress_available,
+            ingress_build_error,
+        )
+
+        if native_pipeline is None:
+            print(
+                "--native-ingress requires tpu storage with --pipeline "
+                "native (and the native library); serving Python gRPC only",
+                file=sys.stderr,
+            )
+        elif args.rate_limit_headers != "NONE":
+            print(
+                "--native-ingress does not build response headers; use "
+                "--rate-limit-headers NONE (serving Python gRPC only)",
+                file=sys.stderr,
+            )
+        elif not ingress_available():
+            print(
+                f"native ingress unavailable ({ingress_build_error()}); "
+                "serving Python gRPC only",
+                file=sys.stderr,
+            )
+        else:
+            native_ingress = NativeIngress(
+                native_pipeline,
+                host=args.rls_host,
+                port=args.rls_port,
+                loop=asyncio.get_running_loop(),
+            )
+            rls_grpc_port = args.rls_port + 1
+
     rls_server = await serve_rls(
         limiter,
-        f"{args.rls_host}:{args.rls_port}",
+        f"{args.rls_host}:{rls_grpc_port}",
         metrics,
         args.rate_limit_headers,
         native_pipeline=native_pipeline,
@@ -576,8 +621,13 @@ async def _amain(args) -> int:
         limiter, args.http_host, args.http_port, metrics, status
     )
     print(
-        f"limitador-tpu: RLS gRPC on {args.rls_host}:{args.rls_port}, "
-        f"HTTP on {args.http_host}:{args.http_port}, "
+        f"limitador-tpu: RLS gRPC on {args.rls_host}:{rls_grpc_port}"
+        + (
+            f", native HTTP/2 ingress on {args.rls_host}:{native_ingress.port}"
+            if native_ingress is not None
+            else ""
+        )
+        + f", HTTP on {args.http_host}:{args.http_port}, "
         f"storage={args.storage}",
         file=sys.stderr,
     )
@@ -643,6 +693,8 @@ async def _amain(args) -> int:
         labels_watcher.stop()
     if authority_server is not None:
         authority_server.stop()
+    if native_ingress is not None:
+        native_ingress.close()
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
     if isinstance(limiter, AsyncRateLimiter):
