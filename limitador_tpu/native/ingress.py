@@ -184,13 +184,19 @@ class NativeIngress:
     # -- stats --------------------------------------------------------------
 
     def stats(self) -> dict:
-        s = self._lib.h2i_stat
-        return {
-            "connections": s(self._ctx, 0),
-            "requests": s(self._ctx, 1),
-            "responses": s(self._ctx, 2),
-            "protocol_errors": s(self._ctx, 3),
-        }
+        with self._ctx_lock:
+            if self._ctx is None:
+                return {
+                    "connections": 0, "requests": 0, "responses": 0,
+                    "protocol_errors": 0,
+                }
+            s = self._lib.h2i_stat
+            return {
+                "connections": s(self._ctx, 0),
+                "requests": s(self._ctx, 1),
+                "responses": s(self._ctx, 2),
+                "protocol_errors": s(self._ctx, 3),
+            }
 
     # -- pump ---------------------------------------------------------------
 

@@ -28,7 +28,17 @@ except Exception:  # pragma: no cover - otel API absent
 # path, which is not "free" at 10^5 req/s.
 _enabled = False
 
-__all__ = ["configure_tracing", "should_rate_limit_span", "datastore_span"]
+__all__ = [
+    "configure_tracing",
+    "should_rate_limit_span",
+    "datastore_span",
+    "tracing_enabled",
+]
+
+
+def tracing_enabled() -> bool:
+    """True once an OTLP exporter is installed (configure_tracing)."""
+    return _enabled
 
 
 def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
@@ -82,16 +92,28 @@ def datastore_span(op: str):
 
 
 @contextmanager
-def should_rate_limit_span(namespace: str, hits_addend: int):
+def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):
     """Span around one decision with the reference's attribute names
     (envoy_rls/server.rs:81-90); records limited/limit_name via the
     returned setter. Doubles as the ``should_rate_limit`` MetricsLayer
-    aggregate root (main.rs:908-913)."""
+    aggregate root (main.rs:908-913). ``carrier`` (a mapping of incoming
+    gRPC metadata) parents the span on the caller's W3C trace context
+    (envoy_rls/server.rs:100-104)."""
     with metrics_span("should_rate_limit"):
         if _tracer is None or not _enabled:
             yield _noop_record
             return
-        with _tracer.start_as_current_span("should_rate_limit") as span:
+        parent = None
+        if carrier:
+            try:
+                from opentelemetry.propagate import extract
+
+                parent = extract(carrier)
+            except Exception:  # malformed traceparent must not 500
+                parent = None
+        with _tracer.start_as_current_span(
+            "should_rate_limit", context=parent
+        ) as span:
             span.set_attribute("ratelimit.namespace", namespace)
             span.set_attribute("ratelimit.hits_addend", hits_addend)
 

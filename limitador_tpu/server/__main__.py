@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
+import logging
 import os
 import signal
 import sys
@@ -33,6 +35,51 @@ from .rls import (
 )
 
 __all__ = ["main", "build_parser"]
+
+log = logging.getLogger("limitador")
+
+
+class _JsonFormatter(logging.Formatter):
+    """Structured JSON log lines, shaped like the reference's
+    tracing_subscriber json layer (main.rs:922-957): timestamp, level,
+    target, fields.message."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "timestamp": self.formatTime(record, "%Y-%m-%dT%H:%M:%S%z"),
+            "level": record.levelname,
+            "target": record.name,
+            "fields": {"message": record.getMessage()},
+        }
+        if record.exc_info:
+            entry["fields"]["exception"] = self.formatException(
+                record.exc_info
+            )
+        return json.dumps(entry)
+
+
+_LEVELS = {
+    "trace": logging.DEBUG,
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def _setup_logging(structured: bool, level: str) -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    if structured:
+        handler.setFormatter(_JsonFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: "
+                              "%(message)s")
+        )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(_LEVELS.get(level.lower(), logging.INFO))
 
 
 def _env(name, default=None):
@@ -105,6 +152,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--validate",
         action="store_true",
         help="validate the limits file and exit",
+    )
+    p.add_argument(
+        "--structured-logs",
+        action="store_true",
+        default=_env("STRUCTURED_LOGS", "") == "1",
+        help="emit structured JSON log lines (main.rs:577-580)",
+    )
+    p.add_argument(
+        "--log-level",
+        default=_env("LIMITADOR_LOG", _env("RUST_LOG", "info")),
+        help="log level: trace|debug|info|warn|error",
     )
     def _positive_interval(value: str) -> float:
         interval = float(value)
@@ -209,14 +267,12 @@ def _try_restore(path, restore_fn, what: str):
     try:
         storage = restore_fn(path)
     except Exception as exc:
-        print(
+        log.warning(
             f"snapshot {path} unreadable ({exc}); starting with a fresh "
-            f"{what}",
-            file=sys.stderr,
-        )
+            f"{what}")
         _preserve_rejected_snapshot(path)
         return None
-    print(f"restored {what} from {path}", file=sys.stderr)
+    log.info(f"restored {what} from {path}")
     return storage
 
 
@@ -228,9 +284,9 @@ def _preserve_rejected_snapshot(path: str) -> None:
     rejected = path + ".rejected"
     try:
         os.replace(path, rejected)
-        print(f"preserved rejected snapshot as {rejected}", file=sys.stderr)
+        log.warning(f"preserved rejected snapshot as {rejected}")
     except OSError as exc:
-        print(f"could not preserve rejected snapshot: {exc}", file=sys.stderr)
+        log.warning(f"could not preserve rejected snapshot: {exc}")
 
 
 def build_limiter(args, on_partitioned=None):
@@ -271,18 +327,14 @@ def build_limiter(args, on_partitioned=None):
                 try:
                     storage.load_snapshot(args.snapshot_path)
                 except Exception as exc:
-                    print(
+                    log.warning(
                         f"snapshot {args.snapshot_path} unreadable "
-                        f"({exc}); starting with a fresh replicated table",
-                        file=sys.stderr,
-                    )
+                        f"({exc}); starting with a fresh replicated table")
                     _preserve_rejected_snapshot(args.snapshot_path)
                 else:
-                    print(
+                    log.info(
                         f"restored replicated counter table from "
-                        f"{args.snapshot_path}",
-                        file=sys.stderr,
-                    )
+                        f"{args.snapshot_path}")
         else:
             storage = _try_restore(
                 args.snapshot_path,
@@ -290,11 +342,9 @@ def build_limiter(args, on_partitioned=None):
                 "counter table",
             )
             if storage is not None and storage._capacity != args.tpu_capacity:
-                print(
+                log.warning(
                     f"warning: snapshot capacity {storage._capacity} "
-                    f"overrides --tpu-capacity {args.tpu_capacity}",
-                    file=sys.stderr,
-                )
+                    f"overrides --tpu-capacity {args.tpu_capacity}")
             if storage is None:
                 storage = TpuStorage(
                     capacity=args.tpu_capacity, cache_size=args.cache_size
@@ -335,12 +385,10 @@ def build_limiter(args, on_partitioned=None):
                 if cli != snap
             ]
             for name, cli, snap in overrides:
-                print(
+                log.warning(
                     f"warning: snapshot {name}={snap!r} overrides the "
                     f"command line's {cli!r} (key routing must match "
-                    "the checkpoint)",
-                    file=sys.stderr,
-                )
+                    "the checkpoint)")
         if storage is None:
             storage = TpuShardedStorage(
                 local_capacity=args.tpu_capacity,
@@ -353,11 +401,9 @@ def build_limiter(args, on_partitioned=None):
         )
         if args.pipeline in ("compiled", "native"):
             if args.pipeline == "native":
-                print(
+                log.warning(
                     "native pipeline is single-chip only; using the "
-                    "compiled pipeline with sharded storage",
-                    file=sys.stderr,
-                )
+                    "compiled pipeline with sharded storage")
             from ..tpu.pipeline import CompiledTpuLimiter
 
             return CompiledTpuLimiter(async_storage)
@@ -407,7 +453,7 @@ async def _amain(args) -> int:
 
     tracing_err = configure_tracing(args.tracing_endpoint)
     if tracing_err:
-        print(tracing_err, file=sys.stderr)
+        log.warning(tracing_err)
 
     initial_labels = args.metric_labels
     if args.metric_labels_file:
@@ -417,11 +463,9 @@ async def _amain(args) -> int:
             if content:
                 initial_labels = content
         except OSError as exc:
-            print(
+            log.warning(
                 f"metric labels file unreadable ({exc}); "
-                "using --metric-labels",
-                file=sys.stderr,
-            )
+                "using --metric-labels")
     metrics = PrometheusMetrics(
         use_limit_name_label=args.limit_name_in_labels,
         metric_labels=initial_labels,
@@ -456,16 +500,15 @@ async def _amain(args) -> int:
             try:
                 if content:
                     metrics.reload_labels(content)
-                    print("metric labels reloaded", file=sys.stderr)
+                    log.info("metric labels reloaded")
             except Exception as exc:  # bad CEL must not kill the watcher
-                print(f"metric labels reload rejected: {exc}", file=sys.stderr)
+                log.warning(f"metric labels reload rejected: {exc}")
 
         labels_watcher = LimitsFileWatcher(
             args.metric_labels_file,
             _labels_changed,
-            on_error=lambda exc: print(
-                f"metric labels file reload failed: {exc}", file=sys.stderr
-            ),
+            on_error=lambda exc: log.warning(
+                f"metric labels file reload failed: {exc}"),
             loader=_load_labels,
             poll_interval=args.limits_poll_interval,
         )
@@ -495,11 +538,9 @@ async def _amain(args) -> int:
 
             reflection_enabled = True
         except ImportError:
-            print(
+            log.info(
                 "grpc reflection requested but grpcio-reflection is not "
-                "installed; continuing without it",
-                file=sys.stderr,
-            )
+                "installed; continuing without it")
     status = {"limits_file_version": 0, "limits_file_errors": 0}
     pipelines_to_invalidate = []
 
@@ -521,7 +562,7 @@ async def _amain(args) -> int:
 
         def on_error(exc):
             status["limits_file_errors"] += 1
-            print(f"limits file reload failed: {exc}", file=sys.stderr)
+            log.warning(f"limits file reload failed: {exc}")
 
         # Construct the watcher (capturing its baseline stamp) BEFORE the
         # initial load, so a file replaced between load and watch (e.g. a
@@ -547,11 +588,9 @@ async def _amain(args) -> int:
             )
             pipelines_to_invalidate.append(native_pipeline)
         else:
-            print(
+            log.warning(
                 f"native hostpath unavailable "
-                f"({native_mod.build_error()}); using compiled pipeline",
-                file=sys.stderr,
-            )
+                f"({native_mod.build_error()}); using compiled pipeline")
 
     authority_server = None
     if args.authority_listen:
@@ -567,11 +606,9 @@ async def _amain(args) -> int:
                 "as a shared authority (no apply_deltas)"
             )
         authority_server = serve_authority(sync_storage, args.authority_listen)
-        print(
+        log.info(
             f"limitador-tpu: shared authority on {args.authority_listen} "
-            f"(port {authority_server.port})",
-            file=sys.stderr,
-        )
+            f"(port {authority_server.port})")
 
     native_ingress = None
     rls_grpc_port = args.rls_port
@@ -583,23 +620,17 @@ async def _amain(args) -> int:
         )
 
         if native_pipeline is None:
-            print(
+            log.warning(
                 "--native-ingress requires tpu storage with --pipeline "
-                "native (and the native library); serving Python gRPC only",
-                file=sys.stderr,
-            )
+                "native (and the native library); serving Python gRPC only")
         elif args.rate_limit_headers != "NONE":
-            print(
+            log.warning(
                 "--native-ingress does not build response headers; use "
-                "--rate-limit-headers NONE (serving Python gRPC only)",
-                file=sys.stderr,
-            )
+                "--rate-limit-headers NONE (serving Python gRPC only)")
         elif not ingress_available():
-            print(
+            log.warning(
                 f"native ingress unavailable ({ingress_build_error()}); "
-                "serving Python gRPC only",
-                file=sys.stderr,
-            )
+                "serving Python gRPC only")
         else:
             native_ingress = NativeIngress(
                 native_pipeline,
@@ -620,7 +651,7 @@ async def _amain(args) -> int:
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status
     )
-    print(
+    log.info(
         f"limitador-tpu: RLS gRPC on {args.rls_host}:{rls_grpc_port}"
         + (
             f", native HTTP/2 ingress on {args.rls_host}:{native_ingress.port}"
@@ -628,9 +659,7 @@ async def _amain(args) -> int:
             else ""
         )
         + f", HTTP on {args.http_host}:{args.http_port}, "
-        f"storage={args.storage}",
-        file=sys.stderr,
-    )
+        f"storage={args.storage}")
 
     snapshot_task = None
     if args.storage in ("tpu", "sharded") and args.snapshot_path:
@@ -659,7 +688,7 @@ async def _amain(args) -> int:
                 except Exception as exc:
                     # A failed checkpoint (disk full, ...) must not end
                     # periodic checkpointing for the process lifetime.
-                    print(f"snapshot failed: {exc}", file=sys.stderr)
+                    log.warning(f"snapshot failed: {exc}")
 
         snapshot_task = asyncio.get_running_loop().create_task(snapshot_loop())
 
@@ -685,7 +714,7 @@ async def _amain(args) -> int:
                 None, take_snapshot
             )
         except Exception as exc:
-            print(f"final snapshot failed: {exc}", file=sys.stderr)
+            log.warning(f"final snapshot failed: {exc}")
 
     if watcher:
         watcher.stop()
@@ -704,23 +733,24 @@ async def _amain(args) -> int:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    _setup_logging(args.structured_logs, args.log_level)
     if args.validate:
         if not args.limits_file:
-            print("--validate requires a limits file", file=sys.stderr)
+            log.error("--validate requires a limits file")
             return 2
         try:
             limits = load_limits_file(args.limits_file)
         except LimitsFileError as exc:
-            print(f"INVALID: {exc}", file=sys.stderr)
+            log.error(f"INVALID: {exc}")
             return 1
-        print(f"OK: {len(limits)} limits")
+        log.info(f"OK: {len(limits)} limits")
         return 0
     try:
         return asyncio.run(_amain(args))
     except KeyboardInterrupt:
         return 0
     except (ValueError, LimitsFileError, CelError) as exc:
-        print(f"configuration error: {exc}", file=sys.stderr)
+        log.error(f"configuration error: {exc}")
         return 2
 
 

@@ -34,7 +34,7 @@ from ..observability.metrics_layer import (
     installed as _metrics_layer_installed,
     metrics_span,
 )
-from ..observability.tracing import should_rate_limit_span
+from ..observability.tracing import should_rate_limit_span, tracing_enabled
 from ..storage.base import StorageError
 from .proto import rls_pb2
 
@@ -146,7 +146,13 @@ class RlsService:
         ctx = _context_from_request(request)
         hits_addend = _hits_addend(request)
         with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
-        with should_rate_limit_span(namespace, hits_addend) as record:
+        # W3C trace-context from gRPC metadata parents the span
+        # (envoy_rls/server.rs:100-104); only materialized when an
+        # exporter is actually installed.
+        carrier = None
+        if tracing_enabled():
+            carrier = dict(context.invocation_metadata() or ())
+        with should_rate_limit_span(namespace, hits_addend, carrier) as record:
             try:
                 result = await self._check_and_update(
                     namespace, ctx, hits_addend, with_headers

@@ -322,6 +322,7 @@ struct Conn {
   std::unordered_map<uint32_t, Stream> streams;
   int64_t send_win = 65535;
   int64_t initial_stream_win = 65535;
+  size_t max_frame = 16384;  // client's SETTINGS_MAX_FRAME_SIZE
   uint32_t cont_stream = 0;  // nonzero: collecting CONTINUATION for it
   uint8_t cont_flags = 0;
   std::string cont_block;
@@ -408,8 +409,43 @@ void goaway(Ctx* c, Conn* conn, uint32_t err) {
   flush_writes(c, conn);
 }
 
-// Build a response onto conn->wbuf. status < 0 means trailers-only HTTP
-// error is impossible here — all errors are grpc trailers-only.
+// Emit as much of the parked response queue as the peer's frame-size
+// and flow-control limits allow. DATA splits into <= max_frame chunks
+// and partial window credit makes partial progress; a response whose
+// window is exhausted stays at the queue head until WINDOW_UPDATE
+// (FIFO per connection — responses here are tiny, head-of-line across
+// streams is accepted for boundedness).
+void drain_parked(Conn* conn) {
+  while (!conn->parked.empty()) {
+    Parked& p = conn->parked.front();
+    auto it = conn->streams.find(p.stream);
+    if (it == conn->streams.end()) {  // stream reset while parked
+      conn->parked.pop_front();
+      continue;
+    }
+    Stream& st = it->second;
+    while (!p.data_payload.empty()) {
+      int64_t allow = conn->send_win < st.send_win ? conn->send_win
+                                                   : st.send_win;
+      if (allow <= 0) return;  // wait for WINDOW_UPDATE / SETTINGS
+      size_t chunk = p.data_payload.size();
+      if (chunk > (size_t)allow) chunk = (size_t)allow;
+      if (chunk > conn->max_frame) chunk = conn->max_frame;
+      put_frame_header(&conn->wbuf, chunk, F_DATA, 0, p.stream);
+      conn->wbuf.append(p.data_payload, 0, chunk);
+      p.data_payload.erase(0, chunk);
+      conn->send_win -= (int64_t)chunk;
+      st.send_win -= (int64_t)chunk;
+    }
+    conn->wbuf += p.trailer_frame;
+    conn->streams.erase(it);
+    conn->parked.pop_front();
+  }
+}
+
+// Build a response: headers immediately (not flow-controlled), the
+// grpc-framed DATA + trailers through the parked queue so frame-size
+// and window limits apply uniformly.
 void write_response(Conn* conn, uint32_t stream, int status,
                     const std::string& payload) {
   if (status == 0) {
@@ -432,20 +468,8 @@ void write_response(Conn* conn, uint32_t stream, int status,
                      FL_END_HEADERS | FL_END_STREAM, stream);
     tf += tb;
 
-    auto it = conn->streams.find(stream);
-    int64_t swin = it != conn->streams.end() ? it->second.send_win : 65535;
-    if ((int64_t)data.size() <= conn->send_win &&
-        (int64_t)data.size() <= swin) {
-      put_frame_header(&conn->wbuf, data.size(), F_DATA, 0, stream);
-      conn->wbuf += data;
-      conn->send_win -= (int64_t)data.size();
-      if (it != conn->streams.end())
-        it->second.send_win -= (int64_t)data.size();
-      conn->wbuf += tf;
-      if (it != conn->streams.end()) conn->streams.erase(it);
-    } else {
-      conn->parked.push_back(Parked{stream, std::move(data), std::move(tf)});
-    }
+    conn->parked.push_back(Parked{stream, std::move(data), std::move(tf)});
+    drain_parked(conn);
   } else {
     // trailers-only (grpc error): one HEADERS with END_STREAM
     std::string hb;
@@ -458,27 +482,6 @@ void write_response(Conn* conn, uint32_t stream, int status,
                      FL_END_HEADERS | FL_END_STREAM, stream);
     conn->wbuf += hb;
     conn->streams.erase(stream);
-  }
-}
-
-void drain_parked(Conn* conn) {
-  while (!conn->parked.empty()) {
-    Parked& p = conn->parked.front();
-    auto it = conn->streams.find(p.stream);
-    int64_t swin = it != conn->streams.end() ? it->second.send_win : 65535;
-    if ((int64_t)p.data_payload.size() > conn->send_win ||
-        (int64_t)p.data_payload.size() > swin)
-      return;
-    put_frame_header(&conn->wbuf, p.data_payload.size(), F_DATA, 0,
-                     p.stream);
-    conn->wbuf += p.data_payload;
-    conn->send_win -= (int64_t)p.data_payload.size();
-    if (it != conn->streams.end()) {
-      it->second.send_win -= (int64_t)p.data_payload.size();
-      conn->streams.erase(it);
-    }
-    conn->wbuf += p.trailer_frame;
-    conn->parked.pop_front();
   }
 }
 
@@ -558,6 +561,8 @@ void handle_frame(Ctx* c, Conn* conn, uint8_t type, uint8_t flags,
           int64_t delta = (int64_t)value - conn->initial_stream_win;
           conn->initial_stream_win = value;
           for (auto& kv : conn->streams) kv.second.send_win += delta;
+        } else if (ident == 5 && value >= 16384 && value <= 0xffffff) {
+          conn->max_frame = value;  // MAX_FRAME_SIZE
         }
         // HEADER_TABLE_SIZE (1) would cap OUR encoder's dynamic table;
         // we never index, so nothing to do.

@@ -170,3 +170,49 @@ def test_bad_edit_keeps_serving_and_counts_error(server, tmp_path):
     wait_for(
         lambda: http_get(port, "/limits/test")[0]["max_value"] == 2000
     )
+
+
+def test_structured_logs_emit_json(tmp_path):
+    """--structured-logs renders every log line as JSON (the reference's
+    tracing_subscriber json layer, main.rs:922-957)."""
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(LIMITS_V1)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "limitador_tpu.server",
+            str(limits), "--validate", "--structured-logs",
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    lines = [l for l in proc.stderr.splitlines() if l.strip()]
+    assert lines, "expected at least the OK log line"
+    entry = json.loads(lines[-1])
+    assert entry["level"] == "INFO"
+    assert "1 limits" in entry["fields"]["message"]
+    assert entry["target"] == "limitador"
+
+
+def test_plain_logs_not_json(tmp_path):
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(LIMITS_V1)
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "limitador_tpu.server",
+            str(limits), "--validate",
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    last = [l for l in proc.stderr.splitlines() if l.strip()][-1]
+    assert "OK: 1 limits" in last
+    with pytest.raises(ValueError):
+        json.loads(last)

@@ -399,3 +399,18 @@ def test_detached_spawn_does_not_inherit_request_span():
 
     asyncio.run(main())
     assert seen == [None]
+
+
+def test_should_rate_limit_span_accepts_carrier_without_tracing():
+    """The W3C carrier argument must be inert when no exporter is
+    installed (the server only materializes it when tracing_enabled)."""
+    from limitador_tpu.observability.tracing import (
+        should_rate_limit_span,
+        tracing_enabled,
+    )
+
+    assert tracing_enabled() is False
+    carrier = {"traceparent":
+               "00-0af7651916cd43dd8448eb211c80319c-b7ad6b7169203331-01"}
+    with should_rate_limit_span("ns", 1, carrier) as record:
+        record(False, None)

@@ -233,3 +233,31 @@ def test_stats_and_clean_close():
         await limiter.storage.counters.close()
 
     asyncio.new_event_loop().run_until_complete(shutdown())
+
+
+def test_large_response_chunks_through_flow_control():
+    """A response bigger than both the 16384 max frame size and the
+    65535 connection window must split into frames and make progress as
+    the client grants window — not kill the connection or park forever."""
+    big = bytes(range(256)) * 1024  # 256 KiB
+
+    class BigPipeline:
+        STORAGE_ERROR = object()
+
+        def decide_many(self, blobs, chunk=None):
+            return [big for _ in blobs]
+
+    ing = NativeIngress(BigPipeline(), host="127.0.0.1", port=0, poll_ms=2)
+    ch = grpc.insecure_channel(f"127.0.0.1:{ing.port}")
+    call = ch.unary_unary(
+        ENVOY_METHOD,
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=bytes,
+    )
+    out = call(make_blob(entries={"u": "x"}), timeout=20)
+    assert out == big
+    # twice: the second response rides window credit returned by the first
+    assert call(make_blob(entries={"u": "x"}), timeout=20) == big
+    assert ing.stats()["protocol_errors"] == 0
+    ch.close()
+    ing.close()
