@@ -29,7 +29,7 @@ aggregates feed ``datastore`` child spans into the
 from __future__ import annotations
 
 import time
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from contextvars import ContextVar
 from typing import Callable, Dict, List, Optional, Sequence
 
@@ -281,18 +281,11 @@ def installed() -> Optional[MetricsLayer]:
     return _installed
 
 
+_NULLCONTEXT = nullcontext()
+
+
 @contextmanager
-def metrics_span(name: str, inherit: bool = True):
-    """Open a span on the installed layer (no-op when none is installed:
-    a module-global check and a ``yield``, nothing else on the hot path).
-    ``inherit=False`` detaches from any contextvar parent — for
-    conceptually-background aggregates (the write-behind flush) that can
-    run inline under a request span, where inheriting would fold the
-    same wall clock into the request's aggregate twice."""
-    layer = _installed
-    if layer is None:
-        yield None
-        return
+def _live_span(layer: "MetricsLayer", name: str, inherit: bool):
     span = layer.new_span(name, inherit=inherit)
     span.enter()
     try:
@@ -300,3 +293,18 @@ def metrics_span(name: str, inherit: bool = True):
     finally:
         span.exit()
         span.close()
+
+
+def metrics_span(name: str, inherit: bool = True):
+    """Open a span on the installed layer. With none installed this is a
+    module-global check returning a shared nullcontext — no generator
+    machinery on the hot path (a @contextmanager no-op still costs ~5us
+    per request at serving rates). ``inherit=False`` detaches from any
+    contextvar parent — for conceptually-background aggregates (the
+    write-behind flush) that can run inline under a request span, where
+    inheriting would fold the same wall clock into the request's
+    aggregate twice."""
+    layer = _installed
+    if layer is None:
+        return _NULLCONTEXT
+    return _live_span(layer, name, inherit)

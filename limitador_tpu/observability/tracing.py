@@ -10,9 +10,10 @@ exports real spans without code changes.
 
 from __future__ import annotations
 
-from contextlib import contextmanager
+from contextlib import contextmanager, nullcontext
 from typing import Optional
 
+from .metrics_layer import installed as metrics_layer_installed
 from .metrics_layer import metrics_span
 
 try:
@@ -75,13 +76,23 @@ def _noop_record(limited, name):
     pass
 
 
-@contextmanager
+_NULLCONTEXT = nullcontext()
+
+
 def datastore_span(op: str):
     """Span around one storage I/O (the reference instruments every
     storage method and wraps backend I/O in info_span!("datastore"),
     in_memory.rs:19-71, redis_async.rs:42-87). Feeds both the OTLP
     exporter (when configured) and the MetricsLayer span-tree
-    aggregation (when installed); no-op otherwise."""
+    aggregation (when installed). With neither active this returns a
+    shared nullcontext — no per-request generator cost."""
+    if not _enabled and metrics_layer_installed() is None:
+        return _NULLCONTEXT
+    return _datastore_span(op)
+
+
+@contextmanager
+def _datastore_span(op: str):
     with metrics_span("datastore"):
         if _tracer is None or not _enabled:
             yield
@@ -92,6 +103,10 @@ def datastore_span(op: str):
 
 
 @contextmanager
+def _noop_record_span():
+    yield _noop_record
+
+
 def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):
     """Span around one decision with the reference's attribute names
     (envoy_rls/server.rs:81-90); records limited/limit_name via the
@@ -99,6 +114,13 @@ def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):
     aggregate root (main.rs:908-913). ``carrier`` (a mapping of incoming
     gRPC metadata) parents the span on the caller's W3C trace context
     (envoy_rls/server.rs:100-104)."""
+    if not _enabled and metrics_layer_installed() is None:
+        return _noop_record_span()
+    return _should_rate_limit_span(namespace, hits_addend, carrier)
+
+
+@contextmanager
+def _should_rate_limit_span(namespace, hits_addend, carrier):
     with metrics_span("should_rate_limit"):
         if _tracer is None or not _enabled:
             yield _noop_record

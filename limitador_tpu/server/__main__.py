@@ -726,6 +726,12 @@ async def _amain(args) -> int:
         native_ingress.close()
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
+    if native_pipeline is not None:
+        await native_pipeline.close()
+    if hasattr(limiter, "close"):
+        # Compiled pipeline: final flush + drain in-flight collects +
+        # release worker pools before the storage goes away.
+        await limiter.close()
     if isinstance(limiter, AsyncRateLimiter):
         await limiter.storage.counters.close()
     return 0
@@ -743,7 +749,9 @@ def main(argv=None) -> int:
         except LimitsFileError as exc:
             log.error(f"INVALID: {exc}")
             return 1
-        log.info(f"OK: {len(limits)} limits")
+        # Success goes to STDOUT (script-parseable contract, independent
+        # of the log format); diagnostics ride the stderr log handler.
+        print(f"OK: {len(limits)} limits")
         return 0
     try:
         return asyncio.run(_amain(args))

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple, Union
 
 from ..core.cel import Context
@@ -85,6 +86,18 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         self._flush_task: Optional[asyncio.Task] = None
         self.max_delay = self._tpu.batcher.max_delay
         self.max_batch = 4096
+        #: dispatched-but-uncollected batches (the MicroBatcher pattern):
+        #: batch N+1's evaluate + kernel launch overlaps batch N's
+        #: device round trip.
+        self.max_inflight = 2
+        self._dispatch_pool = ThreadPoolExecutor(
+            1, thread_name_prefix="compiled-dispatch"
+        )
+        self._collect_pool = ThreadPoolExecutor(
+            self.max_inflight, thread_name_prefix="compiled-collect"
+        )
+        self._inflight: set = set()
+        self._inflight_sem: Optional[asyncio.Semaphore] = None
 
     # -- compiler cache invalidation ----------------------------------------
 
@@ -198,15 +211,80 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         batch, self._pending = self._pending, []
         if not batch:
             return
+        loop = asyncio.get_running_loop()
+        if self._inflight_sem is None:
+            self._inflight_sem = asyncio.Semaphore(self.max_inflight)
+        live: List[Tuple[_RawPending, List[Counter]]] = []
         try:
+            # Columnar evaluation stays ON the loop thread: the compiler
+            # cache and the limits registry are only ever touched here,
+            # so a concurrent limits reload cannot hand a batch a
+            # half-rebuilt plan. Only the kernel launch (dispatch thread,
+            # launch order = device program order) and the device
+            # transfer (collect threads) go off-loop — that's where the
+            # round-trip time lives.
+            from .storage import _Request
+
             requests = self._evaluate_batch(batch)
-            await self._decide(requests)
-        except Exception as exc:
-            # Nothing may escape: an exception lost inside the flush task
-            # would strand every submitter of this batch on its future.
-            for p in batch:
-                if not p.future.done():
-                    p.future.set_exception(exc)
+            for p, counters in requests:
+                if not counters:
+                    if not p.future.done():
+                        p.future.set_result(CheckResult(False, [], None))
+                else:
+                    live.append((p, counters))
+            if not live:
+                return
+            reqs = [_Request(c, p.delta, p.load) for p, c in live]
+            await self._inflight_sem.acquire()
+        except BaseException as exc:
+            # Nothing may escape silently: an exception (INCLUDING a
+            # cancellation of the submitter awaiting this flush) lost here
+            # would strand every other submitter of this batch.
+            _fail_futures(batch, exc)
+            raise
+        try:
+            handle = await loop.run_in_executor(
+                self._dispatch_pool, self._tpu.inner.begin_check_many, reqs
+            )
+        except BaseException as exc:
+            self._inflight_sem.release()
+            _fail_futures([p for p, _c in live], exc)
+            if not isinstance(exc, Exception):
+                raise
+            return
+        t0 = time.perf_counter()
+        task = loop.run_in_executor(
+            self._collect_pool, self._collect_batch, handle, live, t0
+        )
+        self._inflight.add(task)
+
+        def _collected(t):
+            self._inflight.discard(t)
+            self._inflight_sem.release()
+            exc = t.exception()
+            if exc is not None:
+                _fail_futures([p for p, _c in live], exc)
+
+        task.add_done_callback(_collected)
+
+    def _collect_batch(self, handle, live, t0: float) -> None:
+        """Collect-thread phase: device transfer, decode, resolve every
+        future in one loop callback per loop."""
+        auths = self._tpu.inner.finish_check_many(handle)
+        if self._metrics is not None:
+            dt = time.perf_counter() - t0
+            for hist in _latency_hists(self._metrics):
+                for _ in live:
+                    hist.observe(dt)
+        by_loop: Dict[object, list] = {}
+        for (p, counters), auth in zip(live, auths):
+            loaded = counters if p.load else []
+            result = CheckResult(auth.limited, loaded, auth.limit_name)
+            by_loop.setdefault(p.future.get_loop(), []).append(
+                (p.future, result)
+            )
+        for floop, pairs in by_loop.items():
+            floop.call_soon_threadsafe(_settle_results, pairs)
 
     def _evaluate_batch(
         self, batch: List[_RawPending]
@@ -238,47 +316,35 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 requests.append((batch[i], counters))
         return requests
 
-    async def _decide(
-        self, requests: List[Tuple[_RawPending, List[Counter]]]
-    ) -> None:
-        # The whole evaluated batch is already in hand: go straight to the
-        # storage's batched kernel path (no second trip through the
-        # micro-batcher). The blocking device call runs in a worker thread
-        # so concurrent submissions keep accumulating for the next flush.
-        from .storage import _Request
+    async def close(self) -> None:
+        """Drain in-flight collects and release the worker pools."""
+        await self._flush()
+        if self._inflight:
+            await asyncio.gather(*self._inflight, return_exceptions=True)
+        self._dispatch_pool.shutdown(wait=False)
+        self._collect_pool.shutdown(wait=False)
 
-        live: List[Tuple[_RawPending, List[Counter]]] = []
-        for p, counters in requests:
-            if not counters:
-                if not p.future.done():
-                    p.future.set_result(CheckResult(False, [], None))
-            else:
-                live.append((p, counters))
-        if not live:
-            return
-        reqs = [_Request(c, p.delta, p.load) for p, c in live]
-        loop = asyncio.get_running_loop()
-        t0 = time.perf_counter()
-        try:
-            auths = await loop.run_in_executor(
-                None, self._tpu.inner.check_many, reqs
-            )
-        except Exception as exc:
-            for p, _c in live:
-                if not p.future.done():
-                    p.future.set_exception(exc)
-            return
-        if self._metrics is not None:
-            # Queue-excluded device batch round trip each of these
-            # requests waited on; the span opened in
-            # check_rate_limited_and_update feeds datastore_latency via
-            # the MetricsLayer when one is installed.
-            dt = time.perf_counter() - t0
-            for hist in _latency_hists(self._metrics):
-                for _ in live:
-                    hist.observe(dt)
-        for (p, counters), auth in zip(live, auths):
-            loaded = counters if p.load else []
-            result = CheckResult(auth.limited, loaded, auth.limit_name)
-            if not p.future.done():
-                p.future.set_result(result)
+
+def _settle_results(pairs) -> None:
+    for future, result in pairs:
+        if not future.done():
+            future.set_result(result)
+
+
+def _fail_futures(pendings, exc) -> None:
+    """Fail every unresolved pending, routed through each future's own
+    loop (callers may run on a different loop's thread or a pool
+    thread; set_exception is only safe from the owning loop)."""
+    by_loop: Dict[object, list] = {}
+    for p in pendings:
+        future = p.future
+        if not future.done():
+            by_loop.setdefault(future.get_loop(), []).append(future)
+
+    for floop, futures in by_loop.items():
+        def _do(futures=futures):
+            for future in futures:
+                if not future.done():
+                    future.set_exception(exc)
+
+        floop.call_soon_threadsafe(_do)

@@ -173,8 +173,9 @@ def test_bad_edit_keeps_serving_and_counts_error(server, tmp_path):
 
 
 def test_structured_logs_emit_json(tmp_path):
-    """--structured-logs renders every log line as JSON (the reference's
-    tracing_subscriber json layer, main.rs:922-957)."""
+    """--structured-logs renders diagnostics as JSON lines on stderr
+    (the reference's tracing_subscriber json layer, main.rs:922-957);
+    --validate success stays a plain stdout line for scripts."""
     limits = tmp_path / "limits.yaml"
     limits.write_text(LIMITS_V1)
     proc = subprocess.run(
@@ -189,17 +190,32 @@ def test_structured_logs_emit_json(tmp_path):
         timeout=60,
     )
     assert proc.returncode == 0, proc.stderr
-    lines = [l for l in proc.stderr.splitlines() if l.strip()]
-    assert lines, "expected at least the OK log line"
-    entry = json.loads(lines[-1])
-    assert entry["level"] == "INFO"
-    assert "1 limits" in entry["fields"]["message"]
+    assert "OK: 1 limits" in proc.stdout
+    # an INVALID file produces a structured ERROR diagnostic
+    limits.write_text("][ not yaml {{{")
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "limitador_tpu.server",
+            str(limits), "--validate", "--structured-logs",
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert proc.returncode == 1
+    entry = json.loads(
+        [l for l in proc.stderr.splitlines() if l.strip()][-1]
+    )
+    assert entry["level"] == "ERROR"
+    assert "INVALID" in entry["fields"]["message"]
     assert entry["target"] == "limitador"
 
 
 def test_plain_logs_not_json(tmp_path):
     limits = tmp_path / "limits.yaml"
-    limits.write_text(LIMITS_V1)
+    limits.write_text("][ not yaml {{{")
     proc = subprocess.run(
         [
             sys.executable, "-m", "limitador_tpu.server",
@@ -211,8 +227,8 @@ def test_plain_logs_not_json(tmp_path):
         text=True,
         timeout=60,
     )
-    assert proc.returncode == 0, proc.stderr
-    last = [l for l in proc.stderr.splitlines() if l.strip()][-1]
-    assert "OK: 1 limits" in last
+    assert proc.returncode == 1
+    assert "INVALID" in proc.stderr  # multi-line plain diagnostic
+    first = [l for l in proc.stderr.splitlines() if l.strip()][0]
     with pytest.raises(ValueError):
-        json.loads(last)
+        json.loads(first)
