@@ -205,39 +205,113 @@ class NativeIngress:
         ids = (ctypes.c_uint64 * n_max)()
         ptrs = (ctypes.c_void_p * n_max)()
         lens = (ctypes.c_uint32 * n_max)()
-        while not self._stopping:
-            n = self._lib.h2i_take(
-                self._ctx, n_max, self.poll_ms,
-                ids,
-                ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
-                lens,
+        # Engine pipelining: when the pipeline exposes its begin/finish
+        # split, the pump launches batch N+1's host phase while batch N's
+        # device round trip is still in flight (bounded window) — under a
+        # high-RTT device link the round trip, not the host, then gates
+        # batch cadence. Pipelines without the split (tests, fakes) take
+        # the serial decide_many path.
+        pipelined = hasattr(self.pipeline, "_begin_batch") and hasattr(
+            self.pipeline, "_finish_namespace"
+        )
+        finish_pool = None
+        sem = None
+        if pipelined:
+            from concurrent.futures import ThreadPoolExecutor
+
+            finish_pool = ThreadPoolExecutor(
+                2, thread_name_prefix="h2-ingress-finish"
             )
-            if n <= 0:
-                continue
-            rids = [ids[i] for i in range(n)]
-            blobs = [
-                ctypes.string_at(ptrs[i], lens[i]) for i in range(n)
-            ]
-            try:
-                results = self.pipeline.decide_many(blobs, chunk=len(blobs))
-            except Exception as exc:  # answer the batch, don't die
-                self._respond(
-                    [(rid, GRPC_INTERNAL, str(exc).encode()[:100])
-                     for rid in rids]
+            sem = threading.BoundedSemaphore(2)
+        try:
+            while not self._stopping:
+                n = self._lib.h2i_take(
+                    self._ctx, n_max, self.poll_ms,
+                    ids,
+                    ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                    lens,
                 )
-                continue
-            out = []
-            for rid, blob, res in zip(rids, blobs, results):
-                if res is None:
-                    self._submit_slow(rid, blob)
-                elif res is self.pipeline.STORAGE_ERROR:
-                    out.append(
-                        (rid, GRPC_UNAVAILABLE, b"storage unavailable")
-                    )
+                if n <= 0:
+                    continue
+                rids = [ids[i] for i in range(n)]
+                blobs = [
+                    ctypes.string_at(ptrs[i], lens[i]) for i in range(n)
+                ]
+                if pipelined:
+                    self._decide_pipelined(rids, blobs, finish_pool, sem)
                 else:
-                    out.append((rid, 0, res))
-            if out:
-                self._respond(out)
+                    self._decide_serial(rids, blobs)
+        finally:
+            if finish_pool is not None:
+                finish_pool.shutdown(wait=True)
+
+    def _map_results(self, rids, results, skip=frozenset()):
+        """(rid, status, payload) triples for every decided row; rows in
+        ``skip`` (slow-path) are answered elsewhere."""
+        out = []
+        for i, (rid, res) in enumerate(zip(rids, results)):
+            if i in skip or res is None:
+                continue
+            if res is self.pipeline.STORAGE_ERROR:
+                out.append((rid, GRPC_UNAVAILABLE, b"storage unavailable"))
+            else:
+                out.append((rid, 0, res))
+        return out
+
+    def _decide_serial(self, rids, blobs) -> None:
+        try:
+            results = self.pipeline.decide_many(blobs, chunk=len(blobs))
+        except Exception as exc:  # answer the batch, don't die
+            self._respond(
+                [(rid, GRPC_INTERNAL, str(exc).encode()[:100])
+                 for rid in rids]
+            )
+            return
+        for rid, blob, res in zip(rids, blobs, results):
+            if res is None:
+                self._submit_slow(rid, blob)
+        self._respond(self._map_results(rids, results))
+
+    def _decide_pipelined(self, rids, blobs, finish_pool, sem) -> None:
+        sem.acquire()
+        submitted = False
+        slow: set = set()
+        try:
+            results, slow_rows, pendings = self.pipeline._begin_batch(blobs)
+            slow = set(slow_rows)
+            for r in slow_rows:
+                self._submit_slow(rids[r], blobs[r])
+            finish_pool.submit(
+                self._finish_decided, rids, slow, results, pendings, sem
+            )
+            submitted = True
+        except Exception as exc:
+            # Slow rows already handed to the asyncio path answer through
+            # it — answering them INTERNAL here would beat (and mask)
+            # their real decision via first-respond-wins.
+            self._respond(
+                [(rid, GRPC_INTERNAL, str(exc).encode()[:100])
+                 for i, rid in enumerate(rids) if i not in slow]
+            )
+        finally:
+            if not submitted:
+                sem.release()
+
+    def _finish_decided(self, rids, slow, results, pendings, sem) -> None:
+        """Collect one launched batch (device transfer) and answer it.
+        Rows in ``slow`` were handed to the asyncio exact path at begin
+        time; every other row is decided here."""
+        try:
+            for pending in pendings:
+                self.pipeline._finish_namespace(pending, results)
+            self._respond(self._map_results(rids, results, skip=slow))
+        except Exception as exc:
+            self._respond(
+                [(rid, GRPC_INTERNAL, str(exc).encode()[:100])
+                 for i, rid in enumerate(rids) if i not in slow]
+            )
+        finally:
+            sem.release()
 
     def _submit_slow(self, rid: int, blob: bytes) -> None:
         """Exact-path row: run it through the pipeline's asyncio submit
