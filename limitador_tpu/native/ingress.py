@@ -40,6 +40,17 @@ TARGET_PATH = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
 
 GRPC_UNAVAILABLE = 14
 GRPC_INTERNAL = 13
+GRPC_UNIMPLEMENTED = 12
+
+
+class GrpcHandlerError(Exception):
+    """Raised by a registered method handler to answer with a specific
+    grpc status (the context.abort of this serving model)."""
+
+    def __init__(self, status: int, message: bytes = b""):
+        super().__init__(status, message)
+        self.status = status
+        self.message = message
 
 _lock = threading.Lock()
 _lib = None
@@ -115,6 +126,8 @@ def _load():
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.POINTER(ctypes.c_void_p),
             ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_void_p),
+            ctypes.POINTER(ctypes.c_uint32),
         ]
         lib.h2i_respond.argtypes = [
             ctypes.c_void_p, ctypes.c_int,
@@ -144,6 +157,10 @@ class NativeIngress:
 
     ``loop`` (an asyncio loop running elsewhere) enables the exact
     fallback for rows decide_many can't take; without one they answer
+    UNIMPLEMENTED. ``handlers`` maps non-hot method paths (e.g. the
+    Kuadrant check/report split) to ``async (request_bytes) ->
+    response_bytes`` callables run on the same loop, making the ingress
+    a complete single-port server; unregistered methods answer
     UNIMPLEMENTED."""
 
     def __init__(
@@ -154,6 +171,7 @@ class NativeIngress:
         loop=None,
         max_batch: int = 8192,
         poll_ms: int = 20,
+        handlers=None,
     ):
         lib = _load()
         if lib is None:
@@ -163,6 +181,7 @@ class NativeIngress:
         self._lib = lib
         self.pipeline = pipeline
         self.loop = loop
+        self.handlers = dict(handlers or {})
         self.max_batch = max_batch
         self.poll_ms = poll_ms
         self._ctx = ctypes.c_void_p(
@@ -205,6 +224,8 @@ class NativeIngress:
         ids = (ctypes.c_uint64 * n_max)()
         ptrs = (ctypes.c_void_p * n_max)()
         lens = (ctypes.c_uint32 * n_max)()
+        path_ptrs = (ctypes.c_void_p * n_max)()
+        path_lens = (ctypes.c_uint32 * n_max)()
         # Engine pipelining: when the pipeline exposes its begin/finish
         # split, the pump launches batch N+1's host phase while batch N's
         # device round trip is still in flight (bounded window) — under a
@@ -230,13 +251,30 @@ class NativeIngress:
                     ids,
                     ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
                     lens,
+                    ctypes.cast(path_ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                    path_lens,
                 )
                 if n <= 0:
                     continue
-                rids = [ids[i] for i in range(n)]
-                blobs = [
-                    ctypes.string_at(ptrs[i], lens[i]) for i in range(n)
-                ]
+                rids, blobs, unknown = [], [], []
+                for i in range(n):
+                    blob = ctypes.string_at(ptrs[i], lens[i])
+                    if path_ptrs[i]:  # non-target method: route by path
+                        path = ctypes.string_at(
+                            path_ptrs[i], path_lens[i]
+                        ).decode("utf-8", "replace")
+                        if not self._dispatch_method(ids[i], path, blob):
+                            unknown.append(
+                                (ids[i], GRPC_UNIMPLEMENTED,
+                                 b"unknown method")
+                            )
+                    else:
+                        rids.append(ids[i])
+                        blobs.append(blob)
+                if unknown:
+                    self._respond(unknown)
+                if not rids:
+                    continue
                 if pipelined:
                     self._decide_pipelined(rids, blobs, finish_pool, sem)
                 else:
@@ -313,6 +351,32 @@ class NativeIngress:
         finally:
             sem.release()
 
+    def _dispatch_method(self, rid: int, path: str, blob: bytes) -> bool:
+        """Cold-path method routing: a registered handler coroutine runs
+        on the server loop. Returns False when no handler is registered
+        (the caller batches the UNIMPLEMENTED answers)."""
+        import asyncio
+
+        handler = self.handlers.get(path)
+        if handler is None or self.loop is None:
+            return False
+
+        def done(fut):
+            try:
+                self._respond([(rid, 0, fut.result())])
+            except GrpcHandlerError as exc:
+                self._respond([(rid, exc.status, exc.message)])
+            except BaseException as exc:  # incl. CancelledError: always answer
+                self._respond([(rid, GRPC_INTERNAL, str(exc).encode()[:100])])
+
+        try:
+            cfut = asyncio.run_coroutine_threadsafe(handler(blob), self.loop)
+        except RuntimeError as exc:  # loop closed
+            self._respond([(rid, GRPC_UNAVAILABLE, str(exc).encode()[:100])])
+            return True
+        cfut.add_done_callback(done)
+        return True
+
     def _submit_slow(self, rid: int, blob: bytes) -> None:
         """Exact-path row: run it through the pipeline's asyncio submit
         on the server loop, answer when it resolves."""
@@ -331,7 +395,7 @@ class NativeIngress:
                 self._respond(
                     [(rid, GRPC_UNAVAILABLE, b"Service unavailable")]
                 )
-            except Exception as exc:
+            except BaseException as exc:  # incl. CancelledError: always answer
                 self._respond([(rid, GRPC_INTERNAL, str(exc).encode()[:100])])
 
         try:

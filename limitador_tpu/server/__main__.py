@@ -632,11 +632,20 @@ async def _amain(args) -> int:
                 f"native ingress unavailable ({ingress_build_error()}); "
                 "serving Python gRPC only")
         else:
+            # Cold-path methods (Kuadrant check/report) route through the
+            # same RlsService the Python gRPC server uses, so one port
+            # serves the whole surface.
+            from .rls import RlsService, make_native_method_handlers
+
+            ingress_service = RlsService(
+                limiter, metrics, args.rate_limit_headers
+            )
             native_ingress = NativeIngress(
                 native_pipeline,
                 host=args.rls_host,
                 port=args.rls_port,
                 loop=asyncio.get_running_loop(),
+                handlers=make_native_method_handlers(ingress_service),
             )
             rls_grpc_port = args.rls_port + 1
 

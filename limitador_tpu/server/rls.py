@@ -298,6 +298,46 @@ def make_native_should_rate_limit_handler(native_pipeline):
     )
 
 
+def make_native_method_handlers(service: "RlsService"):
+    """Cold-path method table for the native ingress: the Kuadrant
+    check/report split (and Envoy ShouldRateLimit as a fallback entry for
+    completeness) served through the same RlsService the Python gRPC
+    server uses, adapted to raw request/response bytes. Makes the C++
+    ingress a complete single-port server (kuadrant_service.rs parity);
+    the hot Envoy path never reaches these — it rides the columnar
+    engine in C++/numpy."""
+    from ..native.ingress import GrpcHandlerError
+
+    class _ShimContext:
+        """The slice of grpc.ServicerContext the handlers use."""
+
+        @staticmethod
+        async def abort(code, details=""):
+            raise GrpcHandlerError(
+                code.value[0], str(details).encode()[:100]
+            )
+
+        @staticmethod
+        def invocation_metadata():
+            return ()
+
+    def adapt(method):
+        async def handler(blob: bytes) -> bytes:
+            request = rls_pb2.RateLimitRequest.FromString(blob)
+            response = await method(request, _ShimContext())
+            return response.SerializeToString()
+
+        return handler
+
+    # No ShouldRateLimit entry: the ingress nulls the target path in C++
+    # and routes it to the columnar engine — an entry here could never
+    # fire and would mislead about which code serves the hot path.
+    return {
+        f"/{_KUADRANT_SERVICE}/CheckRateLimit": adapt(service.check_rate_limit),
+        f"/{_KUADRANT_SERVICE}/Report": adapt(service.report),
+    }
+
+
 async def serve_rls(
     limiter,
     address: str = "0.0.0.0:8081",

@@ -333,6 +333,7 @@ struct InflightReq {
   uint64_t conn_id;
   uint32_t stream;
   std::string payload;
+  std::string path;  // ":path"; the app routes non-target methods
 };
 
 struct Resp {
@@ -489,10 +490,6 @@ void write_response(Conn* conn, uint32_t stream, int status,
 void complete_stream(Ctx* c, Conn* conn, uint32_t sid, Stream* st) {
   if (st->responded) return;
   st->responded = true;
-  if (st->path != c->target_path) {
-    write_response(conn, sid, 12, "unknown method");  // UNIMPLEMENTED
-    return;
-  }
   if (st->body.size() < 5 || st->body[0] != 0) {
     write_response(conn, sid, 12,
                    st->body.empty() ? "missing grpc frame"
@@ -505,12 +502,15 @@ void complete_stream(Ctx* c, Conn* conn, uint32_t sid, Stream* st) {
     write_response(conn, sid, 13, "bad grpc frame length");  // INTERNAL
     return;
   }
+  // Every well-framed unary request reaches the app; the pump routes the
+  // hot target path into the columnar engine and everything else to its
+  // registered Python handler (or UNIMPLEMENTED).
   uint64_t rid;
   {
     std::lock_guard<std::mutex> lk(c->mu);
     rid = c->next_rid++;
-    c->inflight.emplace(rid,
-                        InflightReq{conn->id, sid, st->body.substr(5)});
+    c->inflight.emplace(
+        rid, InflightReq{conn->id, sid, st->body.substr(5), st->path});
     c->ready.push_back(rid);
   }
   c->stat_reqs++;
@@ -872,7 +872,8 @@ void* h2i_create(const char* host, int port, const char* target_path) {
 int h2i_port(void* vc) { return ((Ctx*)vc)->port; }
 
 int h2i_take(void* vc, int max_n, int timeout_ms, uint64_t* ids,
-             const uint8_t** ptrs, uint32_t* lens) {
+             const uint8_t** ptrs, uint32_t* lens,
+             const char** path_ptrs, uint32_t* path_lens) {
   Ctx* c = (Ctx*)vc;
   std::unique_lock<std::mutex> lk(c->mu);
   if (c->ready.empty()) {
@@ -888,6 +889,13 @@ int h2i_take(void* vc, int max_n, int timeout_ms, uint64_t* ids,
     ids[n] = rid;
     ptrs[n] = (const uint8_t*)it->second.payload.data();
     lens[n] = (uint32_t)it->second.payload.size();
+    if (it->second.path == c->target_path) {
+      path_ptrs[n] = nullptr;  // hot path: no string copy needed
+      path_lens[n] = 0;
+    } else {
+      path_ptrs[n] = it->second.path.data();
+      path_lens[n] = (uint32_t)it->second.path.size();
+    }
     n++;
   }
   return n;

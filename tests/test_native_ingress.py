@@ -261,3 +261,72 @@ def test_large_response_chunks_through_flow_control():
     assert ing.stats()["protocol_errors"] == 0
     ch.close()
     ing.close()
+
+
+def test_kuadrant_methods_served_on_ingress_port():
+    """Registered cold-path handlers make the ingress a complete
+    single-port server: CheckRateLimit (read-only) and Report (update)
+    behave per the Kuadrant split, sharing counters with the hot path."""
+    from limitador_tpu.server.rls import RlsService, make_native_method_handlers
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+    )
+    limiter.add_limit(Limit("api", 2, 60, [], [f"{D}.u"]))
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001)
+    service = RlsService(limiter)
+    ing = NativeIngress(
+        pipeline, host="127.0.0.1", port=0, loop=loop, poll_ms=2,
+        handlers=make_native_method_handlers(service),
+    )
+    ch = grpc.insecure_channel(f"127.0.0.1:{ing.port}")
+
+    def method(name):
+        return ch.unary_unary(
+            f"/kuadrant.service.ratelimit.v1.RateLimitService/{name}",
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+
+    check, report = method("CheckRateLimit"), method("Report")
+    req = make_blob(entries={"u": "kc"})
+    # check is read-only: repeated checks stay OK
+    for _ in range(4):
+        assert check(req, timeout=10).overall_code == OK
+    # reports consume; the third check sees the limit reached
+    report(req, timeout=10)
+    report(req, timeout=10)
+    assert check(req, timeout=10).overall_code == OVER
+    # hot path (engine) shares the same counters
+    envoy = ch.unary_unary(
+        ENVOY_METHOD,
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    assert envoy(req, timeout=10).overall_code == OVER
+    # still-unknown methods answer UNIMPLEMENTED
+    other = ch.unary_unary(
+        "/foo.Bar/Baz",
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    with pytest.raises(grpc.RpcError) as exc:
+        other(req, timeout=10)
+    assert exc.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+    ch.close()
+    ing.close()
+
+    async def shutdown():
+        await pipeline.close()
+        await limiter.close()
+        await limiter.storage.counters.close()
+
+    asyncio.run_coroutine_threadsafe(shutdown(), loop).result(timeout=10)
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+    loop.close()
