@@ -351,61 +351,53 @@ class NativeIngress:
         finally:
             sem.release()
 
-    def _dispatch_method(self, rid: int, path: str, blob: bytes) -> bool:
-        """Cold-path method routing: a registered handler coroutine runs
-        on the server loop. Returns False when no handler is registered
-        (the caller batches the UNIMPLEMENTED answers)."""
+    def _answer_from_loop(self, rid: int, coro) -> None:
+        """Run a coroutine on the server loop and answer ``rid`` with its
+        result, mapping GrpcHandlerError/StorageError to their statuses.
+        ALWAYS answers — including on cancellation at shutdown."""
         import asyncio
 
-        handler = self.handlers.get(path)
-        if handler is None or self.loop is None:
-            return False
+        from ..storage.base import StorageError
 
         def done(fut):
             try:
                 self._respond([(rid, 0, fut.result())])
             except GrpcHandlerError as exc:
                 self._respond([(rid, exc.status, exc.message)])
-            except BaseException as exc:  # incl. CancelledError: always answer
+            except StorageError:
+                self._respond(
+                    [(rid, GRPC_UNAVAILABLE, b"Service unavailable")]
+                )
+            except BaseException as exc:  # incl. CancelledError
                 self._respond([(rid, GRPC_INTERNAL, str(exc).encode()[:100])])
 
         try:
-            cfut = asyncio.run_coroutine_threadsafe(handler(blob), self.loop)
+            cfut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         except RuntimeError as exc:  # loop closed
+            coro.close()
             self._respond([(rid, GRPC_UNAVAILABLE, str(exc).encode()[:100])])
-            return True
+            return
         cfut.add_done_callback(done)
+
+    def _dispatch_method(self, rid: int, path: str, blob: bytes) -> bool:
+        """Cold-path method routing: a registered handler coroutine runs
+        on the server loop. Returns False when no handler is registered
+        (the caller batches the UNIMPLEMENTED answers)."""
+        handler = self.handlers.get(path)
+        if handler is None or self.loop is None:
+            return False
+        self._answer_from_loop(rid, handler(blob))
         return True
 
     def _submit_slow(self, rid: int, blob: bytes) -> None:
         """Exact-path row: run it through the pipeline's asyncio submit
         on the server loop, answer when it resolves."""
-        import asyncio
-
-        from ..storage.base import StorageError
-
         if self.loop is None:
-            self._respond([(rid, 12, b"method variant not supported")])
-            return
-
-        def done(fut):
-            try:
-                self._respond([(rid, 0, fut.result())])
-            except StorageError:
-                self._respond(
-                    [(rid, GRPC_UNAVAILABLE, b"Service unavailable")]
-                )
-            except BaseException as exc:  # incl. CancelledError: always answer
-                self._respond([(rid, GRPC_INTERNAL, str(exc).encode()[:100])])
-
-        try:
-            cfut = asyncio.run_coroutine_threadsafe(
-                self.pipeline.submit(blob), self.loop
+            self._respond(
+                [(rid, GRPC_UNIMPLEMENTED, b"method variant not supported")]
             )
-        except RuntimeError as exc:  # loop closed
-            self._respond([(rid, GRPC_UNAVAILABLE, str(exc).encode()[:100])])
             return
-        cfut.add_done_callback(done)
+        self._answer_from_loop(rid, self.pipeline.submit(blob))
 
     def _respond(self, items: List[tuple]) -> None:
         if not items:

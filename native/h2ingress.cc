@@ -510,7 +510,8 @@ void complete_stream(Ctx* c, Conn* conn, uint32_t sid, Stream* st) {
     std::lock_guard<std::mutex> lk(c->mu);
     rid = c->next_rid++;
     c->inflight.emplace(
-        rid, InflightReq{conn->id, sid, st->body.substr(5), st->path});
+        rid, InflightReq{conn->id, sid, st->body.substr(5),
+                         std::move(st->path)});
     c->ready.push_back(rid);
   }
   c->stat_reqs++;
