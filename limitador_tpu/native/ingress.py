@@ -139,12 +139,75 @@ def _load():
         lib.h2i_stat.restype = ctypes.c_uint64
         lib.h2i_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
         lib.h2i_close.argtypes = [ctypes.c_void_p]
+        lib.h2i_hpack_decoder_new.restype = ctypes.c_void_p
+        lib.h2i_hpack_decoder_free.argtypes = [ctypes.c_void_p]
+        lib.h2i_hpack_dyn_size.restype = ctypes.c_uint64
+        lib.h2i_hpack_dyn_size.argtypes = [ctypes.c_void_p]
+        lib.h2i_hpack_decode_test.restype = ctypes.c_int
+        lib.h2i_hpack_decode_test.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
+        ]
         _lib = lib
         return _lib
 
 
 def ingress_available() -> bool:
     return _load() is not None
+
+
+class HpackDecoder:
+    """Test surface over the ingress's HPACK decoder: dynamic table state
+    persists across ``decode`` calls, as on a connection (the RFC 7541
+    Appendix C sequences exercise exactly that)."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native ingress unavailable: {_build_error}")
+        self._lib = lib
+        self._d = ctypes.c_void_p(lib.h2i_hpack_decoder_new())
+
+    def decode(self, block: bytes):
+        """Decode one header block; returns [(name, value)] byte pairs,
+        raises ValueError on malformed input."""
+        if self._d is None:
+            raise ValueError("decoder is closed")
+        out = (ctypes.c_uint8 * 65536)()
+        n = self._lib.h2i_hpack_decode_test(
+            self._d, block, len(block), out, len(out)
+        )
+        if n == -1:
+            raise ValueError("malformed HPACK block")
+        if n < 0:
+            raise RuntimeError("decode buffer too small")
+        # u32le length-prefixed fields (HPACK strings are arbitrary octet
+        # strings — a separator byte would be ambiguous)
+        buf = bytes(out[:n])
+        fields, off = [], 0
+        while off < len(buf):
+            flen = int.from_bytes(buf[off:off + 4], "little")
+            off += 4
+            fields.append(buf[off:off + flen])
+            off += flen
+        return list(zip(fields[0::2], fields[1::2]))
+
+    @property
+    def dynamic_table_size(self) -> int:
+        if self._d is None:
+            raise ValueError("decoder is closed")
+        return self._lib.h2i_hpack_dyn_size(self._d)
+
+    def close(self):
+        if self._d:
+            self._lib.h2i_hpack_decoder_free(self._d)
+            self._d = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def ingress_build_error() -> Optional[str]:

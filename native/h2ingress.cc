@@ -929,6 +929,41 @@ uint64_t h2i_stat(void* vc, int what) {
   }
 }
 
+// Test hooks: a standalone HPACK decoder whose dynamic table persists
+// across blocks (the RFC 7541 Appendix C sequences exercise exactly
+// that). Output is a flat "name\x00value\x00..." buffer; returns bytes
+// written, -1 on decode error, -2 if out_cap is too small.
+void* h2i_hpack_decoder_new() { return new HpackDecoder(); }
+
+void h2i_hpack_decoder_free(void* d) { delete (HpackDecoder*)d; }
+
+uint64_t h2i_hpack_dyn_size(void* d) {
+  return ((HpackDecoder*)d)->dyn_size;
+}
+
+int h2i_hpack_decode_test(void* d, const uint8_t* block, uint32_t len,
+                          uint8_t* out, uint32_t out_cap) {
+  HpackDecoder* dec = (HpackDecoder*)d;
+  std::vector<Header> headers;
+  if (!hpack_decode(dec, block, len, &headers)) return -1;
+  size_t off = 0;
+  // Length-prefixed framing (u32le len + bytes per field): HPACK strings
+  // are arbitrary octet strings, so a separator byte would be ambiguous.
+  auto put = [&](const std::string& s) -> bool {
+    if (off + 4 + s.size() > out_cap) return false;
+    uint32_t n = (uint32_t)s.size();
+    memcpy(out + off, &n, 4);
+    off += 4;
+    memcpy(out + off, s.data(), s.size());
+    off += s.size();
+    return true;
+  };
+  for (auto& h : headers) {
+    if (!put(h.name) || !put(h.value)) return -2;
+  }
+  return (int)off;
+}
+
 void h2i_close(void* vc) {
   Ctx* c = (Ctx*)vc;
   c->stop.store(true);

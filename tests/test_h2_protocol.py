@@ -1,0 +1,291 @@
+"""HTTP/2 + HPACK protocol conformance for the vendored ingress.
+
+Two layers below the grpcio conformance in test_native_ingress.py:
+
+- HPACK decoder driven directly with RFC 7541 vectors (Appendix C
+  literal/indexed forms, the C.4 Huffman request sequence) and a
+  connection-long sequence produced by the python-hyper ``hpack``
+  reference encoder (tests/data/hpack_vectors.json) that evolves the
+  dynamic table across blocks and alternates Huffman on/off.
+- Raw-socket adversarial framing: bad preface, oversized frames,
+  malformed HPACK, unknown frame types, PING — the server must answer
+  correct frames with correct frames and fail malformed input at the
+  connection level without dying.
+"""
+
+import json
+import socket
+import struct
+import time
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu import native
+from limitador_tpu.native.ingress import (
+    HpackDecoder,
+    NativeIngress,
+    ingress_available,
+)
+from limitador_tpu.server.proto import rls_pb2
+
+pytestmark = pytest.mark.skipif(
+    not (native.available() and ingress_available()),
+    reason="native hostpath/ingress unavailable",
+)
+
+VECTORS = json.loads(
+    (Path(__file__).parent / "data" / "hpack_vectors.json").read_text()
+)
+
+
+# -- HPACK unit conformance -------------------------------------------------
+
+
+def test_rfc7541_c2_literal_forms():
+    d = HpackDecoder()
+    # C.2.1 literal with incremental indexing, new name
+    assert d.decode(
+        bytes.fromhex("400a637573746f6d2d6b65790d637573746f6d2d686561646572")
+    ) == [(b"custom-key", b"custom-header")]
+    assert d.dynamic_table_size == 55
+    # C.2.2 literal without indexing, indexed name (:path)
+    assert d.decode(bytes.fromhex("040c2f73616d706c652f70617468")) == [
+        (b":path", b"/sample/path")
+    ]
+    # C.2.3 literal never indexed
+    assert d.decode(
+        bytes.fromhex("100870617373776f726406736563726574")
+    ) == [(b"password", b"secret")]
+    # C.2.4 indexed header field (static 2)
+    assert d.decode(bytes.fromhex("82")) == [(b":method", b"GET")]
+    # only C.2.1 entered the dynamic table
+    assert d.dynamic_table_size == 55
+
+
+def test_rfc7541_c4_huffman_request_sequence():
+    """The three-request Huffman sequence of Appendix C.4: dynamic-table
+    references must resolve across blocks."""
+    d = HpackDecoder()
+    first = d.decode(
+        bytes.fromhex("828684418cf1e3c2e5f23a6ba0ab90f4ff")
+    )
+    assert first == [
+        (b":method", b"GET"),
+        (b":scheme", b"http"),
+        (b":path", b"/"),
+        (b":authority", b"www.example.com"),
+    ]
+    second = d.decode(bytes.fromhex("828684be5886a8eb10649cbf"))
+    assert second == [
+        (b":method", b"GET"),
+        (b":scheme", b"http"),
+        (b":path", b"/"),
+        (b":authority", b"www.example.com"),
+        (b"cache-control", b"no-cache"),
+    ]
+    third = d.decode(
+        bytes.fromhex(
+            "828785bf408825a849e95ba97d7f8925a849e95bb8e8b4bf"
+        )
+    )
+    assert third == [
+        (b":method", b"GET"),
+        (b":scheme", b"https"),
+        (b":path", b"/index.html"),
+        (b":authority", b"www.example.com"),
+        (b"custom-key", b"custom-value"),
+    ]
+
+
+def test_reference_encoder_sequence():
+    """Connection-long sequence from the python-hyper reference encoder:
+    dynamic-table evolution, Huffman on/off, multi-byte length varints,
+    300-byte values, non-ASCII bytes."""
+    d = HpackDecoder()
+    for i, case in enumerate(VECTORS["sequence"]):
+        got = d.decode(bytes.fromhex(case["block"]))
+        want = [
+            (n.encode("latin1"), v.encode("latin1"))
+            for n, v in case["headers"]
+        ]
+        assert got == want, f"block {i} mismatch"
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "80",            # indexed field with index 0
+        "ffffffffff7f",  # runaway integer
+        "418c f1e3".replace(" ", ""),  # truncated huffman string
+        "4184ffffffff",  # huffman: EOS-ish garbage / bad padding
+        "be",            # dynamic reference into an empty table
+        "40",            # literal with nothing after it
+    ],
+)
+def test_malformed_hpack_rejected(bad):
+    with pytest.raises(ValueError):
+        HpackDecoder().decode(bytes.fromhex(bad))
+
+
+def test_dynamic_table_size_update_evicts():
+    d = HpackDecoder()
+    d.decode(
+        bytes.fromhex("400a637573746f6d2d6b65790d637573746f6d2d686561646572")
+    )
+    assert d.dynamic_table_size == 55
+    # size update to 0 evicts everything (0x20 | 0)
+    d.decode(bytes.fromhex("20"))
+    assert d.dynamic_table_size == 0
+    # the evicted entry is no longer referencable
+    with pytest.raises(ValueError):
+        d.decode(bytes.fromhex("be"))
+
+
+# -- raw-socket framing ----------------------------------------------------
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+
+@pytest.fixture
+def raw_ingress():
+    OK = rls_pb2.RateLimitResponse(
+        overall_code=rls_pb2.RateLimitResponse.OK
+    ).SerializeToString()
+
+    class Fake:
+        STORAGE_ERROR = object()
+
+        def decide_many(self, blobs, chunk=None):
+            return [OK for _ in blobs]
+
+    ing = NativeIngress(Fake(), host="127.0.0.1", port=0, poll_ms=2)
+    yield ing
+    ing.close()
+
+
+def connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.settimeout(5)
+    return s
+
+
+def read_frame(sock):
+    hdr = b""
+    while len(hdr) < 9:
+        chunk = sock.recv(9 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    length = int.from_bytes(hdr[:3], "big")
+    body = b""
+    while len(body) < length:
+        chunk = sock.recv(length - len(body))
+        if not chunk:
+            return None
+        body += chunk
+    return hdr[3], hdr[4], int.from_bytes(hdr[5:9], "big") & 0x7FFFFFFF, body
+
+
+def frame(ftype, flags, stream, payload=b""):
+    return (
+        len(payload).to_bytes(3, "big")
+        + bytes([ftype, flags])
+        + stream.to_bytes(4, "big")
+        + payload
+    )
+
+
+def test_bad_preface_closes_connection(raw_ingress):
+    s = connect(raw_ingress.port)
+    s.sendall(b"GET / HTTP/1.1\r\nHost: x\r\n\r\n")
+    assert s.recv(1024) == b""  # closed without a response
+    s.close()
+
+
+def test_server_settings_and_ping_ack(raw_ingress):
+    s = connect(raw_ingress.port)
+    s.sendall(PREFACE + frame(4, 0, 0))  # client SETTINGS
+    ftype, flags, stream, _body = read_frame(s)
+    assert (ftype, stream) == (4, 0)  # server SETTINGS first
+    ftype, flags, stream, body = read_frame(s)
+    assert (ftype, flags) == (4, 1)  # ack of ours
+    s.sendall(frame(6, 0, 0, b"12345678"))  # PING
+    ftype, flags, stream, body = read_frame(s)
+    assert (ftype, flags, body) == (6, 1, b"12345678")
+    s.close()
+
+
+def test_oversized_frame_goaway(raw_ingress):
+    s = connect(raw_ingress.port)
+    s.sendall(PREFACE + frame(4, 0, 0))
+    read_frame(s)
+    read_frame(s)
+    # declared length 1MB > max frame size
+    s.sendall((1 << 20).to_bytes(3, "big") + bytes([0, 0]) + (1).to_bytes(4, "big"))
+    ftype, *_ = read_frame(s)
+    assert ftype == 7  # GOAWAY
+    assert raw_ingress.stats()["protocol_errors"] >= 1
+    s.close()
+
+
+def test_malformed_hpack_goaway_compression_error(raw_ingress):
+    s = connect(raw_ingress.port)
+    s.sendall(PREFACE + frame(4, 0, 0))
+    read_frame(s)
+    read_frame(s)
+    # HEADERS with garbage block (dynamic ref into empty table)
+    s.sendall(frame(1, 0x4 | 0x1, 1, bytes.fromhex("be")))
+    ftype, flags, stream, body = read_frame(s)
+    assert ftype == 7  # GOAWAY
+    assert int.from_bytes(body[4:8], "big") == 9  # COMPRESSION_ERROR
+    s.close()
+
+
+def test_unknown_frame_type_ignored(raw_ingress):
+    s = connect(raw_ingress.port)
+    s.sendall(PREFACE + frame(4, 0, 0))
+    read_frame(s)
+    read_frame(s)
+    s.sendall(frame(0xFA, 0, 0, b"junk"))  # unknown type: must be ignored
+    s.sendall(frame(6, 0, 0, b"abcdefgh"))
+    ftype, flags, _s, body = read_frame(s)
+    assert (ftype, flags, body) == (6, 1, b"abcdefgh")
+    s.close()
+
+
+def test_server_survives_abrupt_disconnects(raw_ingress):
+    for _ in range(5):
+        s = connect(raw_ingress.port)
+        s.sendall(PREFACE + frame(4, 0, 0))
+        s.close()  # mid-handshake hangup
+    # still serving
+    import grpc
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{raw_ingress.port}")
+    call = ch.unary_unary(
+        "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    req = rls_pb2.RateLimitRequest(domain="x")
+    assert call(req, timeout=10).overall_code == rls_pb2.RateLimitResponse.OK
+    ch.close()
+
+
+def test_embedded_nul_bytes_round_trip():
+    """HPACK strings are arbitrary octet strings: NUL bytes in values
+    must survive the decode surface."""
+    d = HpackDecoder()
+    # literal without indexing, new name "k" (len 1), value "a\x00b" (len 3)
+    block = bytes.fromhex("00016b") + bytes([3]) + b"a\x00b"
+    assert d.decode(block) == [(b"k", b"a\x00b")]
+
+
+def test_decoder_closed_raises():
+    d = HpackDecoder()
+    d.close()
+    with pytest.raises(ValueError):
+        d.decode(b"\x82")
+    with pytest.raises(ValueError):
+        _ = d.dynamic_table_size
