@@ -931,8 +931,9 @@ uint64_t h2i_stat(void* vc, int what) {
 
 // Test hooks: a standalone HPACK decoder whose dynamic table persists
 // across blocks (the RFC 7541 Appendix C sequences exercise exactly
-// that). Output is a flat "name\x00value\x00..." buffer; returns bytes
-// written, -1 on decode error, -2 if out_cap is too small.
+// that). Output is u32le length-prefixed fields (len+name, len+value,
+// repeated); returns bytes written, -1 on decode error, -2 if out_cap
+// is too small.
 void* h2i_hpack_decoder_new() { return new HpackDecoder(); }
 
 void h2i_hpack_decoder_free(void* d) { delete (HpackDecoder*)d; }

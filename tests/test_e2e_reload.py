@@ -64,10 +64,15 @@ def server(tmp_path):
     """Boot ``python -m limitador_tpu.server <limits> memory`` for the
     given limits path; yields (proc, http_port, limits_path)."""
     procs = []
+    logs = []
 
     def boot(limits_path, poll_s="0.05"):
         http_port, rls_port = free_port(), free_port()
         env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        # log to a file, not an undrained PIPE (a full pipe buffer blocks
+        # the server's event loop on the next log write)
+        log = open(tmp_path / f"server-{http_port}.log", "wb")
+        logs.append(log)
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "limitador_tpu.server",
@@ -78,7 +83,7 @@ def server(tmp_path):
             ],
             cwd=REPO_ROOT,
             env=env,
-            stdout=subprocess.PIPE,
+            stdout=log,
             stderr=subprocess.STDOUT,
         )
         procs.append(proc)
@@ -93,6 +98,8 @@ def server(tmp_path):
         except subprocess.TimeoutExpired:
             proc.kill()
             proc.wait()
+    for log in logs:
+        log.close()
 
 
 def test_plain_file_edit_reloads(server, tmp_path):

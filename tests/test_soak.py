@@ -1,0 +1,215 @@
+"""Bounded mixed-surface soak: one live server, every ingest surface at
+once, exactness invariants checked at the end.
+
+The reference's sandbox drives ghz/goose load against docker-compose
+stacks (sandbox/README.md); this is the in-repo equivalent sized for CI:
+concurrent writers hammer the HTTP check/report endpoints and both gRPC
+services over real sockets while the limits file hot-reloads mid-flight,
+then the counter state must satisfy the never-over-admit contract.
+"""
+
+import json
+import random
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from collections import defaultdict
+from pathlib import Path
+
+import grpc
+import pytest
+
+from limitador_tpu.server.proto import rls_pb2
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+ENVOY = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
+KUADRANT_CHECK = "/kuadrant.service.ratelimit.v1.RateLimitService/CheckRateLimit"
+MAX_VALUE = 25
+USERS = [f"soak-{i}" for i in range(8)]
+
+
+def free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture
+def server(tmp_path):
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(
+        f"- namespace: soak\n  max_value: {MAX_VALUE}\n  seconds: 3600\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+        "- namespace: other\n  max_value: 1000000\n  seconds: 3600\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+    http_port, rls_port = free_port(), free_port()
+    import os
+
+    # Logs go to a file, never a PIPE nobody drains: the access log fills
+    # a 64KB pipe buffer mid-soak and freezes the server's event loop on
+    # a blocking stderr write (exactly the hang this soak would then
+    # blame on the server).
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "limitador_tpu.server",
+            str(limits), "memory",
+            "--rls-port", str(rls_port), "--http-port", str(http_port),
+            "--limits-poll-interval", "0.1",
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{http_port}/status", timeout=1
+            ):
+                break
+        except Exception:
+            time.sleep(0.1)
+    else:
+        pytest.fail(
+            "server did not become ready; see "
+            f"{tmp_path / 'server.log'}"
+        )
+    yield limits, http_port, rls_port
+    proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+    log.close()
+
+
+def test_mixed_surface_soak(server):
+    limits, http_port, rls_port = server
+    stop = time.monotonic() + 7.0
+    admitted = defaultdict(int)  # user -> hits admitted on namespace "soak"
+    errors = []
+    lock = threading.Lock()
+
+    def envoy_worker(seed):
+        rng = random.Random(seed)
+        ch = grpc.insecure_channel(f"127.0.0.1:{rls_port}")
+        call = ch.unary_unary(
+            ENVOY,
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        while time.monotonic() < stop:
+            time.sleep(0.01)
+            user = rng.choice(USERS)
+            ns = "soak" if rng.random() < 0.8 else "other"
+            req = rls_pb2.RateLimitRequest(domain=ns)
+            d = req.descriptors.add()
+            e = d.entries.add()
+            e.key = "u"
+            e.value = user
+            try:
+                resp = call(req, timeout=30)
+            except Exception as exc:  # noqa: BLE001 - recorded, not fatal
+                with lock:
+                    errors.append(f"envoy: {exc}")
+                continue
+            if ns == "soak" and resp.overall_code == rls_pb2.RateLimitResponse.OK:
+                with lock:
+                    admitted[user] += 1
+        ch.close()
+
+    def kuadrant_worker(seed):
+        rng = random.Random(seed)
+        ch = grpc.insecure_channel(f"127.0.0.1:{rls_port}")
+        call = ch.unary_unary(
+            KUADRANT_CHECK,
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        while time.monotonic() < stop:
+            time.sleep(0.01)
+            req = rls_pb2.RateLimitRequest(domain="soak")
+            d = req.descriptors.add()
+            e = d.entries.add()
+            e.key = "u"
+            e.value = rng.choice(USERS)
+            try:
+                call(req, timeout=30)  # read-only: consumes nothing
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(f"kuadrant: {exc}")
+        ch.close()
+
+    def http_worker(seed):
+        rng = random.Random(seed)
+        while time.monotonic() < stop:
+            time.sleep(0.01)
+            user = rng.choice(USERS)
+            body = json.dumps(
+                {"namespace": "soak", "values": {"u": user}, "delta": 1}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{http_port}/check_and_report",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    if resp.status == 200:
+                        with lock:
+                            admitted[user] += 1
+            except urllib.error.HTTPError as exc:
+                if exc.code != 429:
+                    with lock:
+                        errors.append(f"http: {exc}")
+            except Exception as exc:  # noqa: BLE001
+                with lock:
+                    errors.append(f"http: {exc}")
+
+    def reload_worker():
+        # mid-soak hot reloads that do NOT change the soak limit identity:
+        # counters must survive (configure_with reconcile)
+        original = limits.read_text()
+        while time.monotonic() < stop:
+            time.sleep(1.0)
+            limits.write_text(
+                original + "- namespace: extra\n  max_value: 5\n"
+                "  seconds: 60\n  conditions: []\n  variables: [\"u\"]\n"
+            )
+            time.sleep(1.0)
+            limits.write_text(original)
+
+    threads = (
+        [threading.Thread(target=envoy_worker, args=(i,)) for i in range(2)]
+        + [threading.Thread(target=kuadrant_worker, args=(10 + i,)) for i in range(1)]
+        + [threading.Thread(target=http_worker, args=(20 + i,)) for i in range(1)]
+        + [threading.Thread(target=reload_worker)]
+    )
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+
+    assert not errors, errors[:5]
+    assert sum(admitted.values()) > 0, "soak admitted nothing"
+    # The exactness contract: no user may be admitted past the limit.
+    for user, count in admitted.items():
+        assert count <= MAX_VALUE, (user, count)
+    # The server's own view agrees (counters endpoint).
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/counters/soak", timeout=5
+    ) as resp:
+        counters = json.loads(resp.read())
+    for c in counters:
+        # remaining is max - value: never negative means never over-admitted
+        assert c["remaining"] >= 0, c
+    # Most users should have reached the limit under 6s of load.
+    maxed = sum(1 for v in admitted.values() if v == MAX_VALUE)
+    assert maxed >= len(USERS) // 2, admitted
