@@ -43,11 +43,16 @@ def tracing_enabled() -> bool:
 
 
 def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
-    """Install an OTLP pipeline when an endpoint is configured and the SDK
-    is available. Returns an error string (for the caller to log) when the
-    endpoint was requested but the SDK/exporter is missing."""
+    """Install an OTLP pipeline when an endpoint is configured. Prefers
+    the real opentelemetry-sdk + OTLP/gRPC exporter when installed (the
+    reference's exact stack, main.rs:973-999); otherwise falls back to
+    the vendored SDK-free OTLP/HTTP+JSON pipeline (`otlp.py`), so span
+    export works in this image too. Returns an informational string for
+    the caller to log when falling back, or an error string when even
+    the fallback could not start."""
     if not endpoint:
         return None
+    global _enabled
     try:
         from opentelemetry.sdk.resources import Resource
         from opentelemetry.sdk.trace import TracerProvider
@@ -55,21 +60,32 @@ def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
         from opentelemetry.exporter.otlp.proto.grpc.trace_exporter import (
             OTLPSpanExporter,
         )
-    except ImportError as exc:
-        return (
-            f"--tracing-endpoint requires opentelemetry-sdk + OTLP "
-            f"exporter ({exc}); continuing without span export"
+
+        provider = TracerProvider(
+            resource=Resource.create({"service.name": "limitador"})
         )
-    provider = TracerProvider(
-        resource=Resource.create({"service.name": "limitador"})
-    )
-    provider.add_span_processor(
-        BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
-    )
-    _trace.set_tracer_provider(provider)
-    global _enabled
+        provider.add_span_processor(
+            BatchSpanProcessor(OTLPSpanExporter(endpoint=endpoint))
+        )
+        _trace.set_tracer_provider(provider)
+        _enabled = True
+        return None
+    except ImportError:
+        pass
+    try:
+        from .otlp import install_vendored_pipeline
+
+        install_vendored_pipeline(endpoint)
+    except Exception as exc:  # noqa: BLE001 - never take the server down
+        return (
+            f"--tracing-endpoint: vendored OTLP pipeline failed to start "
+            f"({exc}); continuing without span export"
+        )
     _enabled = True
-    return None
+    return (
+        "opentelemetry-sdk not installed; exporting spans via the "
+        f"vendored OTLP/HTTP+JSON pipeline to {endpoint}/v1/traces"
+    )
 
 
 def _noop_record(limited, name):

@@ -1,0 +1,316 @@
+"""Vendored OTLP span export, proven against a live collector.
+
+The reference's OTLP install (limitador-server/src/main.rs:973-999) ships
+spans to a collector; this image has no opentelemetry SDK, so
+``observability/otlp.py`` implements the pipeline from scratch
+(OTLP/HTTP+JSON).  These tests stand up a real in-process collector and
+assert the wire payloads — closing the "OTLP export unexercisable"
+partial from rounds 1-2.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu.observability.otlp import (
+    BatchExporter,
+    MiniTracerProvider,
+)
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+class _Collector:
+    """Minimal OTLP/HTTP trace collector: records every POST body."""
+
+    def __init__(self):
+        self.requests = []
+        self.lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                body = self.rfile.read(int(self.headers["Content-Length"]))
+                with outer.lock:
+                    outer.requests.append((self.path, json.loads(body)))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(b"{}")
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def spans(self):
+        with self.lock:
+            out = []
+            for _path, body in self.requests:
+                for rs in body.get("resourceSpans", []):
+                    for ss in rs.get("scopeSpans", []):
+                        out.extend(ss.get("spans", []))
+            return out
+
+    def wait_spans(self, n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            got = self.spans()
+            if len(got) >= n:
+                return got
+            time.sleep(0.05)
+        raise AssertionError(
+            f"collector got {len(self.spans())} spans, wanted {n}"
+        )
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def collector():
+    c = _Collector()
+    yield c
+    c.close()
+
+
+def _attr(span, key):
+    for kv in span.get("attributes", []):
+        if kv["key"] == key:
+            return kv["value"]
+    return None
+
+
+def test_nested_spans_export_with_parentage(collector):
+    provider = MiniTracerProvider(
+        BatchExporter(f"http://127.0.0.1:{collector.port}",
+                      flush_interval_s=0.1)
+    )
+    tracer = provider.get_tracer("test")
+    with tracer.start_as_current_span("root") as root:
+        root.set_attribute("ratelimit.namespace", "ns")
+        root.set_attribute("ratelimit.hits_addend", 2)
+        root.set_attribute("ratelimit.limited", True)
+        with tracer.start_as_current_span("datastore") as child:
+            child.set_attribute("datastore.operation", "check_and_update")
+    provider.force_flush()
+    spans = collector.wait_spans(2)
+    by_name = {s["name"]: s for s in spans}
+    root_s, child_s = by_name["root"], by_name["datastore"]
+    # Same trace; child parented under root; ids are proto3-JSON hex.
+    assert child_s["traceId"] == root_s["traceId"]
+    assert len(root_s["traceId"]) == 32 and len(root_s["spanId"]) == 16
+    assert child_s["parentSpanId"] == root_s["spanId"]
+    assert "parentSpanId" not in root_s
+    # Attribute encodings: string / int64-as-string / bool.
+    assert _attr(root_s, "ratelimit.namespace") == {"stringValue": "ns"}
+    assert _attr(root_s, "ratelimit.hits_addend") == {"intValue": "2"}
+    assert _attr(root_s, "ratelimit.limited") == {"boolValue": True}
+    assert _attr(child_s, "datastore.operation") == {
+        "stringValue": "check_and_update"
+    }
+    # Timestamps are nanosecond strings and ordered.
+    assert int(child_s["startTimeUnixNano"]) >= int(
+        root_s["startTimeUnixNano"]
+    )
+    assert int(child_s["endTimeUnixNano"]) <= int(root_s["endTimeUnixNano"])
+    provider.shutdown()
+
+
+def test_resource_carries_service_name(collector):
+    provider = MiniTracerProvider(
+        BatchExporter(f"http://127.0.0.1:{collector.port}",
+                      flush_interval_s=0.1)
+    )
+    with provider.get_tracer("t").start_as_current_span("s"):
+        pass
+    provider.force_flush()
+    collector.wait_spans(1)
+    _path, body = collector.requests[0]
+    assert _path == "/v1/traces"
+    res_attrs = body["resourceSpans"][0]["resource"]["attributes"]
+    assert {"key": "service.name",
+            "value": {"stringValue": "limitador"}} in res_attrs
+    provider.shutdown()
+
+
+def test_unreachable_collector_never_blocks():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    exporter = BatchExporter(
+        f"http://127.0.0.1:{dead_port}", flush_interval_s=0.05,
+        timeout_s=0.5,
+    )
+    provider = MiniTracerProvider(exporter)
+    tracer = provider.get_tracer("t")
+    start = time.monotonic()
+    for _ in range(50):
+        with tracer.start_as_current_span("s"):
+            pass
+    # Span creation/end is queue-only; the dead endpoint costs nothing
+    # on the instrumented path.
+    assert time.monotonic() - start < 1.0
+    deadline = time.monotonic() + 5.0
+    while exporter.export_errors == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert exporter.export_errors > 0
+    provider.shutdown()
+
+
+def test_queue_overflow_drops_not_blocks():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        dead_port = s.getsockname()[1]
+    exporter = BatchExporter(
+        f"http://127.0.0.1:{dead_port}", max_queue=8, flush_interval_s=30,
+        timeout_s=0.2,
+    )
+    provider = MiniTracerProvider(exporter)
+    tracer = provider.get_tracer("t")
+    for _ in range(64):
+        with tracer.start_as_current_span("s"):
+            pass
+    assert exporter.dropped > 0
+    provider.shutdown()
+
+
+def test_tracing_module_spans_with_w3c_parent(collector):
+    """configure_tracing falls back to the vendored pipeline and the
+    server's span helpers parent on an incoming traceparent
+    (envoy_rls/server.rs:100-104)."""
+    from limitador_tpu.observability import tracing
+
+    msg = tracing.configure_tracing(f"http://127.0.0.1:{collector.port}")
+    try:
+        assert tracing.tracing_enabled()
+        # In this image the SDK is absent, so the fallback reports itself.
+        assert msg is None or "vendored" in msg
+        trace_id = "0af7651916cd43dd8448eb211c80319c"
+        parent_id = "b7ad6b7169203331"
+        carrier = {"traceparent": f"00-{trace_id}-{parent_id}-01"}
+        with tracing.should_rate_limit_span("ns", 1, carrier) as record:
+            with tracing.datastore_span("check_and_update"):
+                pass
+            record(True, "my-limit")
+        import opentelemetry.trace as otel_trace
+
+        otel_trace.get_tracer_provider().force_flush()
+        spans = collector.wait_spans(2)
+        by_name = {s["name"]: s for s in spans}
+        root = by_name["should_rate_limit"]
+        child = by_name["datastore"]
+        assert root["traceId"] == trace_id
+        assert root["parentSpanId"] == parent_id
+        assert child["traceId"] == trace_id
+        assert child["parentSpanId"] == root["spanId"]
+        assert _attr(root, "ratelimit.limited") == {"boolValue": True}
+        assert _attr(root, "ratelimit.limit_name") == {
+            "stringValue": "my-limit"
+        }
+    finally:
+        tracing._enabled = False
+
+
+def test_server_subprocess_exports_spans(collector, tmp_path):
+    """E2E: a real server with --tracing-endpoint ships spans for a
+    served ShouldRateLimit to a live collector, parented on the
+    client's W3C traceparent (envoy_rls/server.rs:100-104 +
+    main.rs:973-999, SDK-free)."""
+    grpc = pytest.importorskip("grpc")
+    from limitador_tpu.server.proto import rls_pb2
+
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(
+        "- namespace: test\n  max_value: 10\n  seconds: 60\n"
+        "  conditions: []\n"
+        "  variables: [\"descriptors[0].user_id\"]\n"
+    )
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        http_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rls_port = s.getsockname()[1]
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "limitador_tpu.server",
+            str(limits), "memory",
+            "--rls-port", str(rls_port),
+            "--http-port", str(http_port),
+            "--tracing-endpoint", f"http://127.0.0.1:{collector.port}",
+        ],
+        cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/status", timeout=1
+                ) as resp:
+                    if json.loads(resp.read())["status"] == "ok":
+                        break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        req = rls_pb2.RateLimitRequest(domain="test", hits_addend=1)
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "user_id", "alice"
+        trace_id = "4bf92f3577b34da6a3ce929d0e0e4736"
+        parent_id = "00f067aa0ba902b7"
+        with grpc.insecure_channel(f"127.0.0.1:{rls_port}") as channel:
+            call = channel.unary_unary(
+                "/envoy.service.ratelimit.v3.RateLimitService"
+                "/ShouldRateLimit",
+                request_serializer=(
+                    rls_pb2.RateLimitRequest.SerializeToString
+                ),
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+            resp = call(
+                req,
+                timeout=10,
+                metadata=(
+                    ("traceparent", f"00-{trace_id}-{parent_id}-01"),
+                ),
+            )
+        assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+        # Batch exporter flushes on its interval (2s default).
+        spans = collector.wait_spans(2, timeout=15)
+        by_name = {s["name"]: s for s in spans}
+        root = by_name["should_rate_limit"]
+        assert root["traceId"] == trace_id
+        assert root["parentSpanId"] == parent_id
+        assert _attr(root, "ratelimit.namespace") == {"stringValue": "test"}
+        child = by_name["datastore"]
+        assert child["parentSpanId"] == root["spanId"]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        log.close()

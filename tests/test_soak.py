@@ -76,6 +76,11 @@ def server(tmp_path):
         except Exception:
             time.sleep(0.1)
     else:
+        # pytest.fail raises before yield: kill the server here or the
+        # orphan holds its ports for the rest of the session.
+        proc.kill()
+        proc.wait()
+        log.close()
         pytest.fail(
             "server did not become ready; see "
             f"{tmp_path / 'server.log'}"
