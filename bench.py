@@ -349,8 +349,12 @@ def bench_tenants(device_step):
 
 
 def bench_sharded():
-    """Config 5: keys sharded across all local devices with a psum global
-    region (virtual mesh off-TPU; on a real pod this rides ICI)."""
+    """Config 5: 10M keys sharded across all local devices with a psum
+    global region (virtual mesh off-TPU; on a real pod this rides ICI).
+    A fill phase populates >=10M distinct live counters (1.25M+/shard x 8
+    shards, ~60% load factor of the 2^21-slot shards); the timed batches
+    then draw from the full populated range — 10M counters resident, a
+    random subset hot per batch."""
     import jax
 
     from limitador_tpu.parallel import (
@@ -359,12 +363,36 @@ def bench_sharded():
 
     n = len(jax.devices())
     mesh = make_mesh()
-    local_cap = 1 << 17
+    local_cap = 1 << 21
     state = make_sharded_table(mesh, local_cap)
     rng = np.random.default_rng(3)
+
+    # Fill: sequential distinct slots, 8 x 65536 per batch x 20 batches
+    # = 10.5M live counters before anything is timed.
+    H_fill = 1 << 16
+    fill_deltas = np.ones((n, H_fill), np.int32)
+    fill_maxes = np.full((n, H_fill), 10**9, np.int32)
+    fill_windows = np.full((n, H_fill), 3_600_000, np.int32)
+    fill_req = np.arange(n * H_fill, dtype=np.int32).reshape(n, H_fill)
+    fill_fresh = np.zeros((n, H_fill), bool)
+    fill_global = np.zeros((n, H_fill), bool)
+    for b in range(20):
+        base = b * H_fill
+        fill_slots = np.broadcast_to(
+            np.arange(base, base + H_fill, dtype=np.int32) % local_cap,
+            (n, H_fill),
+        ).copy()
+        state, res = sharded_check_and_update(
+            mesh, state, fill_slots, fill_deltas, fill_maxes,
+            fill_windows, fill_req, fill_fresh, fill_global, np.int32(100),
+        )
+    jax.block_until_ready(res.admitted)
+
     H = 1 << 12
     batches = 16
-    slots = rng.integers(1024, local_cap, (batches, n, H)).astype(np.int32)
+    # Timed draws stay inside the filled range so every hit lands on a
+    # live counter (the "10M keys resident, random subset hot" reading).
+    slots = rng.integers(1024, 20 * H_fill, (batches, n, H)).astype(np.int32)
     deltas = np.ones((n, H), np.int32)
     maxes = np.full((n, H), 1000, np.int32)
     windows = np.full((n, H), 60_000, np.int32)
@@ -987,9 +1015,18 @@ def main():
         except Exception as exc:
             print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
         try:
-            rps, p50, p99, floor_p50 = grpc_closed_loop(
-                concurrency=64, per_worker=120, native_ingress=True
-            )
+            # One retry: jax device init through the axon tunnel
+            # sporadically hangs past the boot window; a second boot
+            # usually comes straight up (observed r3), and losing the
+            # ingress_* fields to one bad boot wastes the whole capture.
+            try:
+                rps, p50, p99, floor_p50 = grpc_closed_loop(
+                    concurrency=64, per_worker=120, native_ingress=True
+                )
+            except RuntimeError:
+                rps, p50, p99, floor_p50 = grpc_closed_loop(
+                    concurrency=64, per_worker=120, native_ingress=True
+                )
             print(
                 f"native ingress closed-loop: {rps/1e3:.1f}k req/s, "
                 f"p50 {p50:.2f}ms p99 {p99:.2f}ms | no-storage floor "
@@ -1018,13 +1055,25 @@ def main():
         and os.environ.get("BENCH_SKIP_MATRIX") != "1"
     ):
         for config, env in (
+            ("memory", {"BENCH_FORCE_CPU": "1"}),
             ("pipeline", None),
             ("native", None),
+            ("tenants", None),
             ("sharded", {
                 "BENCH_FORCE_CPU": "1",
                 "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
             }),
         ):
+            # The tunnel can die mid-matrix (observed r3: healthy headline,
+            # then every later boot hung). Re-probe with a short window
+            # before each device-touching row: skipping a row beats
+            # burning its full subprocess timeout on a hung jax init.
+            if env is None and not _device_available(window_s=60.0):
+                print(
+                    f"matrix config {config}: device gone, skipped",
+                    file=sys.stderr,
+                )
+                continue
             row = _run_matrix_config(config, env=env)
             if row is None:
                 continue

@@ -122,6 +122,22 @@ def _segmented_exclusive_prefix(contrib: jax.Array, seg_start_idx: jax.Array) ->
     return pre - pre[seg_start_idx]
 
 
+def _sort_segments(slots: jax.Array):
+    """Stable sort of hits by slot plus the segment structure over the
+    sorted order: (order, s_slot, is_start, is_end, seg_id) where a
+    segment is a run of hits on one slot. Shared by the check and update
+    cores — both write per-cell aggregates back with one scatter at each
+    segment's last hit."""
+    H = slots.shape[0]
+    order = jnp.argsort(slots, stable=True)
+    s_slot = slots[order]
+    boundary = s_slot[1:] != s_slot[:-1]
+    is_start = jnp.concatenate([jnp.ones((1,), bool), boundary])
+    is_end = jnp.concatenate([boundary, jnp.ones((1,), bool)])
+    seg_id = jnp.cumsum(is_start) - 1  # 0..n_segments-1, sorted
+    return order, s_slot, is_start, is_end, seg_id
+
+
 def check_and_update_core(
     values: jax.Array,
     expiry: jax.Array,
@@ -148,13 +164,12 @@ def check_and_update_core(
     """
     H = slots.shape[0]
 
-    order = jnp.argsort(slots, stable=True)      # by slot, then request order
+    order, s_slot, is_start, is_end, seg_id = _sort_segments(slots)
     # inverse permutation via scatter (O(H), vs a second O(H log H) sort)
     inv_order = jnp.zeros_like(order).at[order].set(
         jnp.arange(H, dtype=order.dtype)
     )
 
-    s_slot = slots[order]
     s_delta = deltas[order]
     s_max = maxes[order]
     s_req = req_ids[order]
@@ -170,10 +185,7 @@ def check_and_update_core(
     v_local = jnp.where(jnp.logical_or(expired, s_fresh), 0, v_raw)
     v_eff = v_local if base_hook is None else base_hook(v_local, s_slot)
 
-    # Segment starts: first sorted hit of each distinct slot.
-    is_start = jnp.concatenate(
-        [jnp.ones((1,), bool), s_slot[1:] != s_slot[:-1]]
-    )
+    # Index of each sorted hit's segment start (for the prefix sums).
     idx = jnp.arange(H, dtype=jnp.int32)
     seg_start_idx = lax.cummax(jnp.where(is_start, idx, 0))
 
@@ -220,29 +232,50 @@ def check_and_update_core(
     )
 
     # ---- scatter updates ------------------------------------------------
+    # O(H), not O(C): every per-cell aggregate (delta sum, any-admitted,
+    # any-fresh, window max) is computed over the sorted hits with one
+    # segment reduction each, then written back with ONE scatter-set at
+    # each segment's last hit. Full-table passes here were the kernel's
+    # HBM bound — ~10 x C x 4B of traffic per batch dwarfed the O(H)
+    # admission work for large tables (and made batch cost scale with
+    # table capacity instead of batch size).
     is_admitted_hit = admitted[s_req]
-    add = jnp.zeros_like(values).at[s_slot].add(contrib_final)
-    touched = (
-        jnp.zeros_like(values).at[s_slot].add(is_admitted_hit.astype(jnp.int32))
-        > 0
+    scratch = values.shape[0] - 1
+    seg_total = jax.ops.segment_sum(
+        contrib_final, seg_id, num_segments=H, indices_are_sorted=True
     )
-    fresh_slot = jnp.zeros(values.shape, bool).at[s_slot].max(s_fresh)
-    win = jnp.zeros_like(values).at[s_slot].max(
-        jnp.where(jnp.logical_or(is_admitted_hit, s_fresh), s_win, 0)
+    seg_adm = jax.ops.segment_max(
+        is_admitted_hit.astype(jnp.int32), seg_id, num_segments=H,
+        indices_are_sorted=True,
+    ).astype(bool)
+    seg_fresh = jax.ops.segment_max(
+        s_fresh.astype(jnp.int32), seg_id, num_segments=H,
+        indices_are_sorted=True,
+    ).astype(bool)
+    seg_win = jax.ops.segment_max(
+        jnp.where(jnp.logical_or(is_admitted_hit, s_fresh), s_win, 0),
+        seg_id, num_segments=H, indices_are_sorted=True,
     )
-    cell_expired = now_ms >= expiry
-    reset = jnp.logical_or(
-        jnp.logical_and(touched, jnp.logical_or(cell_expired, fresh_slot)),
-        fresh_slot,
+    # Per-hit views of the segment aggregates (only end hits matter).
+    h_total = seg_total[seg_id]
+    h_adm = seg_adm[seg_id]
+    h_fresh = seg_fresh[seg_id]
+    h_win = seg_win[seg_id]
+    cell_expired_h = now_ms >= e_raw  # per-hit read of the cell's expiry
+    starts_fresh = jnp.logical_or(cell_expired_h, h_fresh)
+    val_new = jnp.minimum(
+        jnp.where(starts_fresh, 0, v_raw) + h_total, _NEVER
     )
-    base = jnp.where(jnp.logical_or(cell_expired, fresh_slot), 0, values)
-    new_values = jnp.where(
-        jnp.logical_or(touched, fresh_slot),
-        jnp.minimum(base + add, _NEVER),
-        values,
+    write_val = jnp.logical_and(is_end, jnp.logical_or(h_adm, h_fresh))
+    reset = jnp.logical_and(
+        is_end,
+        jnp.logical_or(jnp.logical_and(h_adm, starts_fresh), h_fresh),
     )
-    new_expiry = jnp.where(reset, now_ms + win, expiry)
-    # Scratch cell stays inert.
+    idx_val = jnp.where(write_val, s_slot, scratch)
+    idx_exp = jnp.where(reset, s_slot, scratch)
+    new_values = values.at[idx_val].set(val_new)
+    new_expiry = expiry.at[idx_exp].set(now_ms + h_win)
+    # Scratch cell stays inert (it also absorbed every masked-off write).
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
 
@@ -298,40 +331,68 @@ def update_core(
     """Unconditional increments (the reference's ``update_counter`` path):
     apply every delta, resetting expired windows, no admission check.
     Traceable core shared by the single-chip ``update_batch`` wrapper and
-    the per-shard body of the multi-chip ``sharded_update``."""
-    fresh_slot = jnp.zeros(values.shape, bool).at[slots].max(fresh)
-    cell_expired = jnp.logical_or(now_ms >= expiry, fresh_slot)
-    base = jnp.where(cell_expired, 0, values)
-    # A plain int32 scatter-add wraps when many large deltas land on one
-    # slot in a single batch (each delta is <= MAX_DELTA_CAP but sums are
-    # not). Accumulate four 8-bit lanes separately (exact for any batch up
-    # to ~8M hits) and recombine with carries, saturating at MAX_VALUE_CAP
-    # so a saturated cell can never re-admit against a cap-sized max_value.
-    # Negative deltas would corrupt the lane split (shift/mask of a negative
-    # int32); they are rejected host-side and clamped here as a backstop.
-    d = jnp.clip(deltas, 0, MAX_DELTA_CAP)
-    zeros = jnp.zeros_like(values)
-    s0 = zeros.at[slots].add(d & 0xFF)
-    s1 = zeros.at[slots].add((d >> 8) & 0xFF)
-    s2 = zeros.at[slots].add((d >> 16) & 0xFF)
-    s3 = zeros.at[slots].add(d >> 24)
-    t1 = s1 + (s0 >> 8)
-    t2 = s2 + (t1 >> 8)
-    t3 = s3 + (t2 >> 8)
+    the per-shard body of the multi-chip ``sharded_update``.
+
+    O(H log H): hits are sorted by slot and every per-cell aggregate is a
+    segment reduction, written back with one scatter-set at each
+    segment's last hit (same scheme as check_and_update_core — full-table
+    passes made batch cost scale with table capacity).
+
+    A plain int32 per-segment delta sum wraps when many large deltas land
+    on one slot in one batch (each delta is <= MAX_DELTA_CAP but sums are
+    not). Sum four 8-bit lanes separately (exact for any batch up to ~8M
+    hits) and recombine with carries, saturating at MAX_VALUE_CAP so a
+    saturated cell can never re-admit against a cap-sized max_value.
+    Negative deltas would corrupt the lane split (shift/mask of a
+    negative int32); they are rejected host-side and clamped here as a
+    backstop."""
+    H = slots.shape[0]
+    scratch = values.shape[0] - 1
+    order, s_slot, _is_start, is_end, seg_id = _sort_segments(slots)
+    d = jnp.clip(deltas[order], 0, MAX_DELTA_CAP)
+    s_win = windows_ms[order]
+    s_fresh = fresh[order]
+
+    def seg_sum(x):
+        return jax.ops.segment_sum(
+            x, seg_id, num_segments=H, indices_are_sorted=True
+        )
+
+    l0 = seg_sum(d & 0xFF)
+    l1 = seg_sum((d >> 8) & 0xFF)
+    l2 = seg_sum((d >> 16) & 0xFF)
+    l3 = seg_sum(d >> 24)
+    t1 = l1 + (l0 >> 8)
+    t2 = l2 + (t1 >> 8)
+    t3 = l3 + (t2 >> 8)
     exact = (
-        (s0 & 0xFF) + ((t1 & 0xFF) << 8) + ((t2 & 0xFF) << 16) + (t3 << 24)
+        (l0 & 0xFF) + ((t1 & 0xFF) << 8) + ((t2 & 0xFF) << 16) + (t3 << 24)
     )
-    add = jnp.where(t3 >= 64, MAX_VALUE_CAP, jnp.minimum(exact, MAX_VALUE_CAP))
-    touched = jnp.zeros_like(values).at[slots].add(1) > 0
-    win = jnp.zeros_like(values).at[slots].max(windows_ms)
-    base_c = jnp.minimum(base, MAX_VALUE_CAP)
+    seg_add = jnp.where(
+        t3 >= 64, MAX_VALUE_CAP, jnp.minimum(exact, MAX_VALUE_CAP)
+    )
+    seg_fresh = jax.ops.segment_max(
+        s_fresh.astype(jnp.int32), seg_id, num_segments=H,
+        indices_are_sorted=True,
+    ).astype(bool)
+    seg_win = jax.ops.segment_max(
+        s_win, seg_id, num_segments=H, indices_are_sorted=True
+    )
+
+    v_raw = values[s_slot]
+    e_raw = expiry[s_slot]
+    h_fresh = seg_fresh[seg_id]
+    cell_expired = jnp.logical_or(now_ms >= e_raw, h_fresh)
+    base_c = jnp.minimum(jnp.where(cell_expired, 0, v_raw), MAX_VALUE_CAP)
     headroom = MAX_VALUE_CAP - base_c
-    new_values = jnp.where(
-        touched, base_c + jnp.minimum(add, headroom), values
+    val_new = base_c + jnp.minimum(seg_add[seg_id], headroom)
+
+    idx_val = jnp.where(is_end, s_slot, scratch)
+    idx_exp = jnp.where(
+        jnp.logical_and(is_end, cell_expired), s_slot, scratch
     )
-    new_expiry = jnp.where(
-        jnp.logical_and(touched, cell_expired), now_ms + win, expiry
-    )
+    new_values = values.at[idx_val].set(val_new)
+    new_expiry = expiry.at[idx_exp].set(now_ms + seg_win[seg_id])
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
     return new_values, new_expiry

@@ -124,7 +124,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--tracing-endpoint",
         default=_env("TRACING_ENDPOINT"),
-        help="OTLP endpoint for span export (requires opentelemetry-sdk)",
+        help="OTLP endpoint for span export (uses opentelemetry-sdk when "
+        "installed, else the vendored OTLP/HTTP+JSON pipeline)",
     )
     p.add_argument(
         "--metric-labels",
@@ -234,6 +235,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="cached: flush write-behind deltas to a remote authority "
         "(host:port of another server's --authority-listen) instead of a "
         "local disk store",
+    )
+    p.add_argument(
+        "--batch-size", type=int,
+        default=int(_env("REDIS_LOCAL_CACHE_BATCH_SIZE", "100")),
+        help="cached: max deltas per authority flush (main.rs:651-658; "
+        "default 100, redis/mod.rs:10-13)",
+    )
+    p.add_argument(
+        "--flush-period", type=float,
+        default=float(_env("REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS", "1000"))
+        / 1000.0,
+        help="cached: write-behind flush period in seconds "
+        "(main.rs:663-670; default 1s)",
+    )
+    p.add_argument(
+        "--max-cached", type=int, default=int(_env("MAX_CACHED", "10000")),
+        help="cached: max locally cached counters (default 10000)",
+    )
+    p.add_argument(
+        "--response-timeout", type=float,
+        default=float(_env("RESPONSE_TIMEOUT", "350")) / 1000.0,
+        help="cached: remote-authority response timeout in seconds "
+        "(default 0.35, redis/mod.rs:13); applies with --authority-url",
     )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
@@ -422,13 +446,21 @@ def build_limiter(args, on_partitioned=None):
         if args.authority_url:
             from ..storage.authority import RemoteAuthority
 
-            authority = RemoteAuthority(args.authority_url)
+            authority = RemoteAuthority(
+                args.authority_url, timeout=args.response_timeout
+            )
         else:
             from ..storage.disk import DiskStorage
 
             authority = DiskStorage(args.disk_path or "limitador_counters.db")
         return AsyncRateLimiter(
-            CachedCounterStorage(authority, on_partitioned=on_partitioned)
+            CachedCounterStorage(
+                authority,
+                flush_period=args.flush_period,
+                batch_size=args.batch_size,
+                max_cached=args.max_cached,
+                on_partitioned=on_partitioned,
+            )
         )
     if args.storage == "distributed":
         try:

@@ -525,3 +525,29 @@ def test_metric_labels_file_watcher(tmp_path):
             time.sleep(0.05)
     finally:
         watcher.stop()
+
+
+def test_cached_cli_knobs_wire_through(tmp_path):
+    """The reference's redis_cached tuning flags (--batch-size,
+    --flush-period, --max-cached, --response-timeout;
+    main.rs:651-690) reach the cached storage and its authority."""
+    from limitador_tpu.server.__main__ import build_limiter, build_parser
+
+    args = build_parser().parse_args([
+        "nonexistent.yaml", "cached",
+        "--disk-path", str(tmp_path / "c.db"),
+        "--batch-size", "7",
+        "--flush-period", "0.25",
+        "--max-cached", "123",
+    ])
+    limiter = build_limiter(args)
+    storage = limiter.storage.counters
+    assert storage.batch_size == 7
+    assert storage.flush_period == 0.25
+    assert storage.max_cached == 123
+    # Defaults mirror redis/mod.rs:10-13.
+    d = build_parser().parse_args(["x.yaml", "cached"])
+    assert d.batch_size == 100
+    assert d.flush_period == 1.0
+    assert d.max_cached == 10000
+    assert d.response_timeout == 0.35
