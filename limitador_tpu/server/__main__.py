@@ -244,10 +244,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--flush-period", type=float,
-        default=float(_env("REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS", "1000"))
-        / 1000.0,
-        help="cached: write-behind flush period in seconds "
-        "(main.rs:663-670; default 1s)",
+        default=float(_env("REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS", "1000")),
+        help="cached: write-behind flush period in MILLISECONDS, same "
+        "unit as the flag's env var and the reference CLI "
+        "(main.rs:664-674; default 1000)",
     )
     p.add_argument(
         "--max-cached", type=int, default=int(_env("MAX_CACHED", "10000")),
@@ -255,9 +255,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--response-timeout", type=float,
-        default=float(_env("RESPONSE_TIMEOUT", "350")) / 1000.0,
-        help="cached: remote-authority response timeout in seconds "
-        "(default 0.35, redis/mod.rs:13); applies with --authority-url",
+        default=float(_env("RESPONSE_TIMEOUT", "350")),
+        help="cached: remote-authority response timeout in MILLISECONDS "
+        "(main.rs:684-691; default 350, redis/mod.rs:13); applies with "
+        "--authority-url",
     )
     p.add_argument("--disk-path", default=_env("DISK_PATH"))
     p.add_argument(
@@ -447,7 +448,7 @@ def build_limiter(args, on_partitioned=None):
             from ..storage.authority import RemoteAuthority
 
             authority = RemoteAuthority(
-                args.authority_url, timeout=args.response_timeout
+                args.authority_url, timeout=args.response_timeout / 1000.0
             )
         else:
             from ..storage.disk import DiskStorage
@@ -456,7 +457,7 @@ def build_limiter(args, on_partitioned=None):
         return AsyncRateLimiter(
             CachedCounterStorage(
                 authority,
-                flush_period=args.flush_period,
+                flush_period=args.flush_period / 1000.0,
                 batch_size=args.batch_size,
                 max_cached=args.max_cached,
                 on_partitioned=on_partitioned,

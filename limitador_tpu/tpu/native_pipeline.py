@@ -324,27 +324,44 @@ class NativeRlsPipeline:
         n = len(blobs)
         domains, hits, cols, _ndesc, extra = self.hp.parse_batch(blobs)
 
-        slow_rows: List[int] = []
         results: List[Optional[bytes]] = [None] * n
 
-        # Group rows by domain token.
-        by_domain: Dict[int, List[int]] = {}
-        for r in range(n):
-            if domains[r] < 0:
-                results[r] = self.UNKNOWN_BLOB
-            elif extra[r] > 0:
-                slow_rows.append(r)  # results[r] stays None (slow path)
+        # Group rows by domain token — vectorized: the per-row Python
+        # dict/append loop profiled as the single largest host cost of
+        # decide_many (131k dict ops per 4x32k rows).
+        unknown = domains < 0
+        for r in np.nonzero(unknown)[0].tolist():
+            results[r] = self.UNKNOWN_BLOB
+        slow_mask = np.logical_and(~unknown, extra > 0)
+        slow_rows: List[int] = np.nonzero(slow_mask)[0].tolist()
+        norm_idx = np.nonzero(
+            np.logical_and(~unknown, ~slow_mask)
+        )[0].astype(np.int32)
+        groups: List[Tuple[int, np.ndarray]] = []
+        if norm_idx.size:
+            toks = domains[norm_idx]
+            first = int(toks[0])
+            if bool((toks == first).all()):  # common case: one namespace
+                groups = [(first, norm_idx)]
             else:
-                by_domain.setdefault(int(domains[r]), []).append(r)
+                order = np.argsort(toks, kind="stable")
+                si, st = norm_idx[order], toks[order]
+                starts = np.nonzero(
+                    np.concatenate([[True], st[1:] != st[:-1]])
+                )[0]
+                ends = np.append(starts[1:], st.size)
+                groups = [
+                    (int(st[a]), si[a:b]) for a, b in zip(starts, ends)
+                ]
 
         pendings = []
-        for token, rows in by_domain.items():
+        for token, rows in groups:
             plan = self._plan_for(token)
             if plan is None:
-                slow_rows.extend(rows)  # results stay None (slow path)
+                slow_rows.extend(rows.tolist())  # results stay None (slow)
                 continue
             if not plan.limits_meta:
-                for r in rows:
+                for r in rows.tolist():
                     results[r] = self.OK_BLOB
                 continue
             pending = self._begin_namespace(
@@ -507,22 +524,29 @@ class NativeRlsPipeline:
         admitted, hit_ok, _rem, _ttl = self.storage.finish_check_columnar(
             pending.inflight, with_remaining=False
         )
-        admitted_by_local = dict(
-            zip(participating.tolist(), admitted[: participating.size])
-        )
-        n_ok = 0
-        ok_hits = 0
-        limited_rows = []
-        for local, r in enumerate(rows):
-            if local in failed_reqs:
-                results[r] = _STORAGE_ERROR
-            elif admitted_by_local.get(local, True):
-                results[r] = self.OK_BLOB
-                n_ok += 1
-                ok_hits += int(deltas_req[local])
-            else:
-                results[r] = self.OVER_BLOB
-                limited_rows.append(local)
+        # Requests without hits default to admitted (no counter applied);
+        # fill via flat arrays — the per-row dict build/get profiled as
+        # the second-largest host cost of decide_many.
+        m = len(rows)
+        admitted_full = np.ones(m, bool)
+        admitted_full[participating] = admitted[: participating.size]
+        ok_blob, over_blob = self.OK_BLOB, self.OVER_BLOB
+        rows_list = rows.tolist() if isinstance(rows, np.ndarray) else rows
+        for r, a in zip(rows_list, admitted_full.tolist()):
+            results[r] = ok_blob if a else over_blob
+        ok_mask = admitted_full
+        if failed_reqs:
+            failed = sorted(failed_reqs)
+            for local in failed:
+                results[rows_list[local]] = _STORAGE_ERROR
+            ok_mask = admitted_full.copy()
+            ok_mask[failed] = False
+        n_ok = int(ok_mask.sum())
+        ok_hits = int(deltas_req[ok_mask].sum())
+        limited_rows = [
+            local for local in np.nonzero(~admitted_full)[0].tolist()
+            if local not in failed_reqs
+        ]
         if self.metrics:
             if n_ok:
                 self.metrics.incr_authorized_calls(namespace, n=n_ok)

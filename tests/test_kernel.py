@@ -98,3 +98,116 @@ def test_sparse_snapshot_size_scales_with_live_counters(tmp_path):
     c = Counter(limit, {"u": "3"})  # restored with value 1
     assert not restored.is_within_limits(c, 100)
     assert restored.is_within_limits(c, 99)
+
+
+def _check(state, slots, deltas, maxes, now_ms=1000, windows=None,
+           fresh=None, req_ids=None):
+    H = len(slots)
+    if windows is None:
+        windows = np.full(H, 60_000, np.int32)
+    if fresh is None:
+        fresh = np.zeros(H, bool)
+    if req_ids is None:
+        req_ids = np.arange(H, dtype=np.int32)
+    return K.check_and_update_batch(
+        state,
+        np.asarray(slots, np.int32),
+        np.asarray(deltas, np.int32),
+        np.asarray(maxes, np.int32),
+        np.asarray(windows, np.int32),
+        np.asarray(req_ids, np.int32),
+        np.asarray(fresh, bool),
+        np.int32(now_ms),
+    )
+
+
+def test_check_padding_only_batch_is_inert():
+    """A batch of nothing but padding hits (slot C, delta 0, max NEVER)
+    must leave the table bit-identical — the segment-end writes all
+    redirect to the scratch row."""
+    state = K.make_table(8)
+    state = _update(state, [1, 2], [5, 7])
+    before_v = np.asarray(state.values).copy()
+    before_e = np.asarray(state.expiry_ms).copy()
+    C = 8
+    never = np.iinfo(np.int32).max
+    state, res = _check(state, [C, C, C, C], [0, 0, 0, 0],
+                        [never] * 4)
+    assert np.asarray(res.admitted).all()
+    np.testing.assert_array_equal(np.asarray(state.values), before_v)
+    np.testing.assert_array_equal(np.asarray(state.expiry_ms), before_e)
+
+
+def test_check_single_hot_slot_admits_exactly_max():
+    """Whole batch on one slot: serial in-batch admission admits exactly
+    max_value hits and the cell lands exactly on max_value."""
+    state = K.make_table(8)
+    H, MAX = 64, 10
+    state, res = _check(state, np.full(H, 3), np.ones(H, np.int32),
+                        np.full(H, MAX, np.int32))
+    admitted = np.asarray(res.admitted)
+    assert admitted.sum() == MAX
+    # serial semantics: the FIRST max_value requests are the admitted ones
+    assert admitted[:MAX].all() and not admitted[MAX:].any()
+    assert np.asarray(state.values)[3] == MAX
+
+
+def test_check_rejected_only_batch_leaves_cell_untouched():
+    """All-rejected hits on a live cell must not write the cell (the
+    reference's check-all-then-update-all: rejected requests update
+    nothing, in_memory.rs:72-156)."""
+    state = K.make_table(8)
+    state, _ = _check(state, [5], [4], [5], now_ms=1000)
+    e_before = np.asarray(state.expiry_ms)[5]
+    state, res = _check(state, [5, 5], [3, 3], [5, 5], now_ms=2000)
+    assert not np.asarray(res.admitted).any()
+    assert np.asarray(state.values)[5] == 4
+    assert np.asarray(state.expiry_ms)[5] == e_before
+
+
+def test_check_delta_zero_admitted_resets_expired_window():
+    """An admitted delta-0 hit on an expired cell still resets the
+    window (the old full-table epilogue's `touched` counted admitted
+    hits regardless of delta; the segment rewrite must too)."""
+    state = K.make_table(8)
+    state, _ = _check(state, [2], [1], [10], now_ms=1000,
+                      windows=[1_000])
+    # window [1000, 2000) expires; a delta-0 check at 5000 re-arms it
+    state, res = _check(state, [2], [0], [10], now_ms=5000,
+                        windows=[1_000])
+    assert np.asarray(res.admitted).all()
+    assert np.asarray(state.values)[2] == 0
+    assert np.asarray(state.expiry_ms)[2] == 6000
+
+
+def test_check_fresh_rejected_hit_still_arms_window():
+    """A fresh slot whose only hit is rejected still gets value 0 and a
+    fresh window — mirroring the reference's get-or-create of qualified
+    counters on the check path (in_memory.rs:122-127)."""
+    state = K.make_table(8)
+    state, res = _check(state, [6], [99], [10], now_ms=1000,
+                        windows=[2_000], fresh=[True])
+    assert not np.asarray(res.admitted).any()
+    assert np.asarray(state.values)[6] == 0
+    assert np.asarray(state.expiry_ms)[6] == 3000
+
+
+def test_check_multi_slot_interleaved_segments():
+    """Segments of different lengths interleaved with padding: per-slot
+    totals and window resets land on the right cells."""
+    state = K.make_table(8)
+    C = 8
+    never = np.iinfo(np.int32).max
+    slots = [1, 4, 1, C, 4, 1]
+    deltas = [1, 2, 1, 0, 2, 1]
+    maxes = [100, 3, 100, never, 3, 100]
+    state, res = _check(state, slots, deltas, maxes)
+    admitted = np.asarray(res.admitted)
+    # requests 0,2,5 on slot 1 all admitted; slot 4: first (delta 2,
+    # max 3) admitted, second rejected; padding admitted
+    np.testing.assert_array_equal(
+        admitted, [True, True, True, True, False, True]
+    )
+    assert np.asarray(state.values)[1] == 3
+    assert np.asarray(state.values)[4] == 2
+    assert np.asarray(state.values)[C] == 0

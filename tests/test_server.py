@@ -537,17 +537,17 @@ def test_cached_cli_knobs_wire_through(tmp_path):
         "nonexistent.yaml", "cached",
         "--disk-path", str(tmp_path / "c.db"),
         "--batch-size", "7",
-        "--flush-period", "0.25",
+        "--flush-period", "250",
         "--max-cached", "123",
     ])
     limiter = build_limiter(args)
     storage = limiter.storage.counters
     assert storage.batch_size == 7
-    assert storage.flush_period == 0.25
+    assert storage.flush_period == 0.25  # flag is ms, like the reference
     assert storage.max_cached == 123
-    # Defaults mirror redis/mod.rs:10-13.
+    # Defaults mirror redis/mod.rs:10-13 (periods/timeouts in ms).
     d = build_parser().parse_args(["x.yaml", "cached"])
     assert d.batch_size == 100
-    assert d.flush_period == 1.0
+    assert d.flush_period == 1000
     assert d.max_cached == 10000
-    assert d.response_timeout == 0.35
+    assert d.response_timeout == 350
