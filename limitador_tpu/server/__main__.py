@@ -318,6 +318,15 @@ def build_limiter(args, on_partitioned=None):
     """Limiter::new equivalent (main.rs:93-185): pick + build the backend.
     ``on_partitioned`` reaches storages that track authority partitions
     (the datastore_partitioned gauge)."""
+    platform = os.environ.get("LIMITADOR_TPU_PLATFORM")
+    if platform:
+        # Pin the jax backend before any storage initializes it. The axon
+        # site hook overrides the JAX_PLATFORMS env var, so this is the
+        # supported way to run the tpu storages on the host backend
+        # (accelerator-less validation, on-box serving measurements).
+        import jax
+
+        jax.config.update("jax_platforms", platform)
     if args.authority_url and args.storage != "cached":
         raise SystemExit(
             f"--authority-url only applies to the 'cached' storage "
