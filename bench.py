@@ -713,7 +713,8 @@ def bench_onbox():
     from limitador_tpu.server.proto import rls_pb2
 
     with _native_rls_server(
-        native_ingress=True, extra_env={"LIMITADOR_TPU_PLATFORM": "cpu"}
+        native_ingress=True, batch_delay_us=200,
+        extra_env={"LIMITADOR_TPU_PLATFORM": "cpu"},
     ) as (rls_port, _http_port, ok):
         channel = grpc.insecure_channel(f"127.0.0.1:{rls_port}")
         call = channel.unary_unary(

@@ -289,3 +289,105 @@ def test_decoder_closed_raises():
         d.decode(b"\x82")
     with pytest.raises(ValueError):
         _ = d.dynamic_table_size
+
+
+# -- CONTINUATION (RFC 7540 §6.10) ------------------------------------------
+
+ENVOY_PATH = b"/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
+
+
+def _request_header_block():
+    """Valid gRPC request headers, encoded with static-table forms only:
+    :method POST (idx 3), :scheme http (idx 6), :path literal (name idx
+    4), content-type literal (name idx 31)."""
+    block = bytes([0x83, 0x86])
+    block += bytes([0x04, len(ENVOY_PATH)]) + ENVOY_PATH
+    ct = b"application/grpc"
+    block += bytes([0x0F, 0x10, len(ct)]) + ct
+    return block
+
+
+def _handshake(sock):
+    sock.sendall(PREFACE + frame(4, 0, 0))
+    assert read_frame(sock)[0] == 4  # server SETTINGS
+    assert read_frame(sock)[1] == 1  # ack of ours
+
+
+def test_headers_split_across_continuation(raw_ingress):
+    """A header block split over HEADERS + 2 CONTINUATION frames must
+    decode as one block and serve the request."""
+    s = connect(raw_ingress.port)
+    _handshake(s)
+    block = _request_header_block()
+    a, b = len(block) // 3, 2 * len(block) // 3
+    s.sendall(frame(1, 0, 1, block[:a]))       # HEADERS, no END_HEADERS
+    s.sendall(frame(9, 0, 1, block[a:b]))      # CONTINUATION
+    s.sendall(frame(9, 0x4, 1, block[b:]))     # CONTINUATION + END_HEADERS
+    # empty RateLimitRequest in one grpc frame, END_STREAM
+    s.sendall(frame(0, 0x1, 1, b"\x00\x00\x00\x00\x00"))
+    got_data = None
+    for _ in range(6):
+        got = read_frame(s)
+        assert got is not None, "connection closed before a response"
+        ftype, flags, stream, body = got
+        assert ftype != 7, f"GOAWAY instead of a response: {body!r}"
+        if ftype == 0 and stream == 1:
+            got_data = body
+            break
+    assert got_data is not None
+    resp = rls_pb2.RateLimitResponse.FromString(got_data[5:])
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+    s.close()
+
+
+def test_continuation_interrupted_is_protocol_error(raw_ingress):
+    """Any frame other than CONTINUATION while a header block is open is
+    a connection error (RFC 7540 §6.10)."""
+    s = connect(raw_ingress.port)
+    _handshake(s)
+    block = _request_header_block()
+    s.sendall(frame(1, 0, 1, block[: len(block) // 2]))
+    s.sendall(frame(6, 0, 0, b"12345678"))  # PING mid-block
+    ftype, _fl, _st, body = read_frame(s)
+    assert ftype == 7  # GOAWAY
+    assert int.from_bytes(body[4:8], "big") == 1  # PROTOCOL_ERROR
+    s.close()
+
+
+def test_continuation_wrong_stream_is_protocol_error(raw_ingress):
+    s = connect(raw_ingress.port)
+    _handshake(s)
+    block = _request_header_block()
+    s.sendall(frame(1, 0, 1, block[: len(block) // 2]))
+    s.sendall(frame(9, 0x4, 3, block[len(block) // 2:]))  # wrong stream
+    ftype, _fl, _st, body = read_frame(s)
+    assert ftype == 7
+    assert int.from_bytes(body[4:8], "big") == 1
+    s.close()
+
+
+def test_padded_priority_headers_and_padded_data(raw_ingress):
+    """PADDED (0x8) and PRIORITY (0x20) flags: pad length byte and
+    5-byte priority prefix are stripped, trailing padding ignored
+    (RFC 7540 §6.1-6.2)."""
+    s = connect(raw_ingress.port)
+    _handshake(s)
+    block = _request_header_block()
+    pad = 7
+    payload = bytes([pad]) + b"\x00\x00\x00\x03\x10" + block + b"\x00" * pad
+    s.sendall(frame(1, 0x4 | 0x8 | 0x20, 1, payload))  # END_HEADERS too
+    data = b"\x00\x00\x00\x00\x00"
+    s.sendall(frame(0, 0x1 | 0x8, 1, bytes([3]) + data + b"\x00" * 3))
+    got_data = None
+    for _ in range(6):
+        got = read_frame(s)
+        assert got is not None, "connection closed before a response"
+        ftype, flags, stream, body = got
+        assert ftype != 7, f"GOAWAY instead of a response: {body!r}"
+        if ftype == 0 and stream == 1:
+            got_data = body
+            break
+    assert got_data is not None
+    resp = rls_pb2.RateLimitResponse.FromString(got_data[5:])
+    assert resp.overall_code == rls_pb2.RateLimitResponse.OK
+    s.close()
