@@ -1286,6 +1286,39 @@ def main():
         rates.append(n_batches * batch / (time.perf_counter() - t0))
     decisions_per_sec = max(rates)
 
+    # Prefetch variant: explicitly device_put batch i+depth's key column
+    # while batch i computes — double-buffered upload overlapping the
+    # host->device link with compute where plain dispatch serializes
+    # them. Both are legitimate serving dispatch disciplines; the
+    # recorded headline takes the better, and both appear in the
+    # artifact so the win (or absence of one) is visible per run.
+    depth = 2
+    prefetch_rates = []
+    for rep in range(2):
+        staged_q = [jax.device_put(keys[i]) for i in range(depth)]
+        # Priming uploads settle BEFORE the clock starts, so the timed
+        # window covers exactly the overlapped steady state (device_put
+        # is async; unsynced priming would straddle t0 run-to-run).
+        jax.block_until_ready(staged_q)
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            if i + depth < n_batches:
+                staged_q.append(jax.device_put(keys[i + depth]))
+            state, result = step(state, staged_q[i], 3000 + rep * 100 + i)
+        jax.block_until_ready(result.admitted)
+        prefetch_rates.append(
+            n_batches * batch / (time.perf_counter() - t0)
+        )
+    prefetch_rate = max(prefetch_rates)
+    print(
+        f"prefetch dispatch (double-buffered upload): "
+        f"{prefetch_rate/1e6:.2f}M decisions/s vs {decisions_per_sec/1e6:.2f}M plain",
+        file=sys.stderr,
+    )
+    extra["device_plain_decisions_per_sec"] = round(decisions_per_sec, 1)
+    extra["device_prefetch_decisions_per_sec"] = round(prefetch_rate, 1)
+    decisions_per_sec = max(decisions_per_sec, prefetch_rate)
+
     # Kernel-only ceiling: stage the key batches on device too, leaving
     # dispatch + compute + result download as the measured path.
     # Best-of-two for the same reason as the throughput pass. MUST run
