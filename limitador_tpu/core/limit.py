@@ -43,9 +43,12 @@ def _as_expression(e: Union[str, Expression]) -> Expression:
     return e if isinstance(e, Expression) else Expression.parse(e)
 
 
+POLICIES = ("fixed_window", "token_bucket")
+
+
 class Limit:
     __slots__ = ("id", "namespace", "max_value", "seconds", "name",
-                 "conditions", "variables", "_identity", "_hash")
+                 "conditions", "variables", "policy", "_identity", "_hash")
 
     def __init__(
         self,
@@ -56,12 +59,25 @@ class Limit:
         variables: Iterable[Union[str, Expression]] = (),
         name: Optional[str] = None,
         id: Optional[str] = None,
+        policy: str = "fixed_window",
     ):
+        """``policy`` extends the reference's fixed-window-only model
+        (limit.rs has no such field): ``token_bucket`` counts with a
+        GCRA token bucket — capacity ``max_value`` tokens refilling
+        continuously at ``max_value`` per ``seconds`` window — instead
+        of a fixed window. Identity includes the policy: a fixed-window
+        and a token-bucket limit over the same tuple hold separate
+        counters."""
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown limit policy {policy!r}; expected one of {POLICIES}"
+            )
         self.id = id
         self.namespace = Namespace.of(namespace)
         self.max_value = int(max_value)
         self.seconds = int(seconds)
         self.name = name
+        self.policy = policy
         # BTreeSet semantics: sorted, deduplicated, ordered by source text.
         self.conditions: Tuple[Predicate, ...] = tuple(
             sorted(set(_as_predicate(c) for c in conditions), key=lambda p: p.source)
@@ -76,8 +92,23 @@ class Limit:
             self.seconds,
             tuple(c.source for c in self.conditions),
             tuple(v.source for v in self.variables),
+            self.policy,
         )
         self._hash = hash(self._identity)
+
+    def __setstate__(self, state):
+        """Unpickle, accepting pre-policy pickles (old TPU snapshots):
+        a Limit without a ``policy`` slot is fixed-window, and its cached
+        4-tuple identity/hash are upgraded to the 5-tuple form so it
+        stays equal to freshly constructed limits."""
+        _dict, slots = state if isinstance(state, tuple) else (None, state)
+        for k, v in (slots or {}).items():
+            setattr(self, k, v)
+        if "policy" not in (slots or {}):
+            self.policy = "fixed_window"
+            if len(self._identity) == 4:
+                self._identity = self._identity + ("fixed_window",)
+                self._hash = hash(self._identity)
 
     @classmethod
     def with_id(
@@ -139,11 +170,12 @@ class Limit:
         return self._key() < other._key()
 
     def __repr__(self) -> str:
+        policy = "" if self.policy == "fixed_window" else f", policy={self.policy!r}"
         return (
             f"Limit(namespace={str(self.namespace)!r}, max_value={self.max_value}, "
             f"seconds={self.seconds}, conditions={[c.source for c in self.conditions]}, "
             f"variables={[v.source for v in self.variables]}, name={self.name!r}, "
-            f"id={self.id!r})"
+            f"id={self.id!r}{policy})"
         )
 
     # -- (de)serialization (YAML limits file / HTTP DTO schema) ------------
@@ -160,6 +192,8 @@ class Limit:
             d["name"] = self.name
         if self.id is not None:
             d["id"] = self.id
+        if self.policy != "fixed_window":
+            d["policy"] = self.policy
         return d
 
     @classmethod
@@ -172,4 +206,5 @@ class Limit:
             variables=d.get("variables") or (),
             name=d.get("name"),
             id=d.get("id"),
+            policy=d.get("policy", "fixed_window"),
         )

@@ -171,6 +171,12 @@ class RateLimiter:
 
     def configure_with(self, limits: Iterable[Limit]) -> None:
         keep = _classify_limits_by_namespace(limits)
+        # Pre-flight every limit BEFORE the delete/add mutation loop: a
+        # mid-apply rejection (e.g. a policy this storage can't count)
+        # must leave the previous config fully in force, not half-gone.
+        for per_ns in keep.values():
+            for limit in per_ns:
+                self.storage.check_policy_supported(limit)
         namespaces = self.get_namespaces() | set(keep.keys())
         for namespace in namespaces:
             existing = self.get_limits(namespace)
@@ -250,6 +256,10 @@ class AsyncRateLimiter:
 
     async def configure_with(self, limits: Iterable[Limit]) -> None:
         keep = _classify_limits_by_namespace(limits)
+        # Pre-flight before mutating (see RateLimiter.configure_with).
+        for per_ns in keep.values():
+            for limit in per_ns:
+                self.storage.check_policy_supported(limit)
         namespaces = self.get_namespaces() | set(keep.keys())
         for namespace in namespaces:
             existing = self.get_limits(namespace)

@@ -600,7 +600,17 @@ async def _amain(args) -> int:
 
         def on_change(limits):
             status["limits_file_version"] += 1
-            asyncio.run_coroutine_threadsafe(apply_limits(limits), loop)
+            fut = asyncio.run_coroutine_threadsafe(apply_limits(limits), loop)
+
+            def _applied(f):
+                exc = f.exception()
+                if exc is not None:
+                    # e.g. an edit adding a policy this storage rejects:
+                    # keep serving the previous config, count the error.
+                    status["limits_file_errors"] += 1
+                    log.warning(f"limits reload rejected: {exc}")
+
+            fut.add_done_callback(_applied)
 
         def on_error(exc):
             status["limits_file_errors"] += 1
@@ -614,7 +624,12 @@ async def _amain(args) -> int:
             poll_interval=args.limits_poll_interval,
         )
         limits = load_limits_file(args.limits_file)
-        await apply_limits(limits)
+        try:
+            await apply_limits(limits)
+        except ValueError as exc:
+            # e.g. a token_bucket limit on a storage whose cell format
+            # can't count it — a config error, not a crash.
+            raise SystemExit(f"limits file rejected: {exc}") from None
         status["limits_file_version"] = 1
         watcher.start()
 

@@ -35,7 +35,7 @@ __all__ = ["make_http_app", "run_http_server"]
 
 
 def _limit_dto(limit: Limit) -> dict:
-    return {
+    d = {
         "id": limit.id,
         "namespace": str(limit.namespace),
         "max_value": limit.max_value,
@@ -44,6 +44,12 @@ def _limit_dto(limit: Limit) -> dict:
         "conditions": sorted(c.source for c in limit.conditions),
         "variables": sorted(v.source for v in limit.variables),
     }
+    if limit.policy != "fixed_window":
+        # Reference DTOs (request_types.rs:18-97) have no policy field;
+        # emitted only for the token-bucket extension so fixed-window
+        # payloads stay byte-identical.
+        d["policy"] = limit.policy
+    return d
 
 
 def _counter_dto(counter) -> dict:

@@ -209,6 +209,23 @@ class _LimitsRegistry:
             self._limits.clear()
 
 
+def _check_policy_supported(counters, limit: Limit) -> None:
+    """Backends opt into non-fixed-window policies with a
+    ``supports_token_bucket = True`` class attribute (in-memory oracle
+    and the TPU storages). Persistence/replication backends whose cell
+    formats are fixed-window-shaped (disk rows, CRDT per-actor counts,
+    write-behind deltas) reject the limit up front rather than
+    mis-counting it."""
+    if limit.policy == "token_bucket" and not getattr(
+        counters, "supports_token_bucket", False
+    ):
+        raise ValueError(
+            f"limit policy 'token_bucket' is not supported by "
+            f"{type(counters).__name__}; supported on the in-memory and "
+            "tpu storages"
+        )
+
+
 class Storage:
     """Sync facade: limits registry + counter backend (storage/mod.rs:41-154)."""
 
@@ -223,11 +240,19 @@ class Storage:
     def get_namespaces(self) -> Set[Namespace]:
         return self._registry.namespaces()
 
+    def check_policy_supported(self, limit: Limit) -> None:
+        """Raise ValueError when the backend can't count this limit's
+        policy (configure_with pre-flights every limit through here
+        before mutating anything)."""
+        _check_policy_supported(self.counters, limit)
+
     def add_limit(self, limit: Limit) -> bool:
+        _check_policy_supported(self.counters, limit)
         self.counters.add_counter(limit)
         return self._registry.add(limit)
 
     def update_limit(self, update: Limit) -> bool:
+        _check_policy_supported(self.counters, update)
         return self._registry.update(update)
 
     def get_limits(self, namespace: Namespace) -> Set[Limit]:
@@ -277,10 +302,15 @@ class AsyncStorage:
     def get_namespaces(self) -> Set[Namespace]:
         return self._registry.namespaces()
 
+    def check_policy_supported(self, limit: Limit) -> None:
+        _check_policy_supported(self.counters, limit)
+
     def add_limit(self, limit: Limit) -> bool:
+        _check_policy_supported(self.counters, limit)
         return self._registry.add(limit)
 
     def update_limit(self, update: Limit) -> bool:
+        _check_policy_supported(self.counters, update)
         return self._registry.update(update)
 
     def get_limits(self, namespace: Namespace) -> Set[Limit]:

@@ -1,0 +1,115 @@
+"""GCRA token-bucket cell — the ``policy: token_bucket`` counter state.
+
+Beyond the reference (limitador is fixed-window only, limit.rs:34):
+BASELINE.json's config 4 names per-key token buckets, and a token
+bucket is the natural smoothing companion to fixed windows, so the
+framework supports both. The canonical semantics are the Generic Cell
+Rate Algorithm (virtual scheduling form) with ONE integer state — the
+Theoretical Arrival Time — which is what lets the device kernel reuse
+the fixed-window table layout and segmented-prefix admission:
+
+    capacity  B     = max_value tokens (burst size)
+    interval  I     = max(1, (seconds*1000) // max_value) ms/token
+    tolerance tau   = (B - 1) * I
+    arrival (t, d): conforms  iff  max(TAT, t) - t + (d - 1)*I <= tau
+                    on admit      TAT = max(TAT, t) + d*I
+
+Sustained rate is quantized to 1000/I tokens/sec (exactly
+max_value/seconds when it divides 1000*seconds; the quantization keeps
+every quantity an int so host oracle and device kernel agree bit-for-
+bit). Rejected arrivals do not advance TAT (a failed request spends
+nothing).
+
+``GcraValue`` speaks the same protocol as ``ExpiringValue``
+(value_at / update / ttl / is_expired) by mapping to "spent tokens":
+
+    available(t) = floor((tau - base_rel)/I) + 1,  base_rel = max(TAT-t, 0)
+    value_at(t)  = B - available(t)        (>= 0; > B-d means "reject d")
+
+so every storage check of the form ``value + delta <= max_value`` IS
+the GCRA conformance test, unchanged — including the TPU storage's
+host-side exact path with in-flight reservations (reservations add
+whole tokens, and available() is exactly linear in admitted tokens
+because contributions are multiples of I).
+"""
+
+from __future__ import annotations
+
+from .expiring_value import ExpiringValue
+
+__all__ = [
+    "GcraValue",
+    "emission_interval_ms",
+    "cell_for_limit",
+    "restore_cell",
+]
+
+
+def emission_interval_ms(max_value: int, seconds: int) -> int:
+    """Integer emission interval: ms per token, >= 1 (quantization rule)."""
+    if max_value <= 0:
+        # Degenerate: a zero-capacity bucket admits nothing; the interval
+        # is irrelevant but must be positive.
+        return max(seconds * 1000, 1)
+    return max(1, (seconds * 1000) // max_value)
+
+
+def cell_for_limit(limit, now: float = 0.0, fresh_window: bool = False):
+    """THE policy->cell mapping (single definition: the oracle, the TPU
+    big-path and snapshot restore all construct through here). Returns a
+    fixed-window ExpiringValue or a GCRA bucket; both speak the same
+    value_at/update/ttl/is_expired protocol, so callers are policy-blind
+    past this point."""
+    if limit.policy == "token_bucket":
+        return GcraValue(limit.max_value, limit.seconds)
+    if fresh_window:
+        return ExpiringValue(0, now + limit.seconds)
+    return ExpiringValue()
+
+
+def restore_cell(limit, a, b):
+    """Rebuild a checkpointed cell from its two persisted scalars:
+    (value, expiry) for fixed windows, (tat_ms, None) for buckets."""
+    if limit.policy == "token_bucket":
+        return GcraValue(limit.max_value, limit.seconds, tat_ms=a)
+    return ExpiringValue(a, b)
+
+
+class GcraValue:
+    """One token bucket, protocol-compatible with ExpiringValue."""
+
+    __slots__ = ("interval_ms", "capacity", "tau_ms", "tat_ms")
+
+    POLICY = "token_bucket"
+
+    def __init__(self, max_value: int, seconds: int, tat_ms: int = 0):
+        self.capacity = int(max_value)
+        self.interval_ms = emission_interval_ms(max_value, seconds)
+        self.tau_ms = (self.capacity - 1) * self.interval_ms
+        self.tat_ms = int(tat_ms)  # 0 = far past = full bucket
+
+    # -- ExpiringValue protocol -------------------------------------------
+
+    def value_at(self, now_s: float) -> int:
+        """Spent tokens: capacity - available(now), unclamped above
+        capacity so over-committed buckets keep rejecting any delta."""
+        base_rel = max(self.tat_ms - int(now_s * 1000), 0)
+        available = (self.tau_ms - base_rel) // self.interval_ms + 1
+        return self.capacity - available
+
+    def update(self, delta: int, _window_seconds: int, now_s: float) -> int:
+        """Admit ``delta`` tokens (unconditional, like ExpiringValue.update
+        — admission is the caller's check): TAT advances by delta*I from
+        max(TAT, now). Returns the post-update spent-token count."""
+        now_ms = int(now_s * 1000)
+        self.tat_ms = max(self.tat_ms, now_ms) + delta * self.interval_ms
+        return self.value_at(now_s)
+
+    def ttl(self, now_s: float) -> float:
+        """Seconds until the bucket is full again (0 = already full).
+        The token-bucket analogue of a window's expires_in."""
+        return max(self.tat_ms - int(now_s * 1000), 0) / 1000.0
+
+    def is_expired(self, now_s: float) -> bool:
+        """Full bucket == no live state (the expired-window analogue)."""
+        return self.tat_ms <= int(now_s * 1000)

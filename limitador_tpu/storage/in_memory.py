@@ -21,6 +21,7 @@ from ..core.counter import Counter
 from ..core.limit import Limit, Namespace
 from .base import Authorization, CounterStorage
 from .expiring_value import ExpiringValue
+from .gcra import cell_for_limit as _new_cell
 
 __all__ = ["InMemoryStorage"]
 
@@ -28,6 +29,8 @@ DEFAULT_CACHE_SIZE = 10_000
 
 
 class InMemoryStorage(CounterStorage):
+    supports_token_bucket = True
+
     def __init__(self, cache_size: int = DEFAULT_CACHE_SIZE, clock=time.time):
         self._lock = threading.RLock()
         self._clock = clock
@@ -50,7 +53,7 @@ class InMemoryStorage(CounterStorage):
         if ev is None:
             # Created with value 0 and a fresh window, even on a pure check
             # (in_memory.rs:122-127).
-            ev = ExpiringValue(0, now + counter.window_seconds)
+            ev = _new_cell(counter.limit, now, fresh_window=True)
             self._qualified[counter.key()] = ev
             while len(self._qualified) > self._cache_size:
                 self._qualified.popitem(last=False)
@@ -72,7 +75,7 @@ class InMemoryStorage(CounterStorage):
     def add_counter(self, limit: Limit) -> None:
         if not limit.variables:
             with self._lock:
-                self._simple.setdefault(limit, ExpiringValue())
+                self._simple.setdefault(limit, _new_cell(limit))
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         now = self._clock()
@@ -80,7 +83,7 @@ class InMemoryStorage(CounterStorage):
             if counter.is_qualified():
                 ev = self._qualified_get_or_create(counter, now)
             else:
-                ev = self._simple.setdefault(counter.limit, ExpiringValue())
+                ev = self._simple.setdefault(counter.limit, _new_cell(counter.limit))
             ev.update(delta, counter.window_seconds, now)
 
     def check_and_update(
@@ -107,7 +110,7 @@ class InMemoryStorage(CounterStorage):
             for counter in counters:
                 if counter.is_qualified():
                     continue
-                ev = self._simple.setdefault(counter.limit, ExpiringValue())
+                ev = self._simple.setdefault(counter.limit, _new_cell(counter.limit))
                 limited = process(counter, ev.value_at(now))
                 if limited is not None and not load_counters:
                     return limited
@@ -182,7 +185,7 @@ class InMemoryStorage(CounterStorage):
                 if counter.is_qualified():
                     ev = self._qualified_get_or_create(counter, now)
                 else:
-                    ev = self._simple.setdefault(counter.limit, ExpiringValue())
+                    ev = self._simple.setdefault(counter.limit, _new_cell(counter.limit))
                 value = ev.update(delta, counter.window_seconds, now)
                 out.append((value, ev.ttl(now)))
         return out

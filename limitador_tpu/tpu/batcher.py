@@ -352,6 +352,13 @@ class AsyncTpuStorage(AsyncCounterStorage):
 
     reports_datastore_latency = False
 
+    @property
+    def supports_token_bucket(self) -> bool:
+        # Defer to the wrapped storage: plain TpuStorage counts buckets
+        # on its exact host path (True); the replicated subclass rejects
+        # them (its gossip floods are fixed-window-shaped).
+        return getattr(self.inner, "supports_token_bucket", False)
+
     def __init__(
         self,
         storage: Optional[TpuStorage] = None,

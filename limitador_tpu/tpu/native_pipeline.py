@@ -167,10 +167,12 @@ class NativeRlsPipeline:
         limits = self.limiter.get_limits(namespace)
         compiler = NamespaceCompiler(limits, interner=self._interner)
         native_ok = compiler.fully_vectorized and all(
-            # Beyond-device-cap limits are decided host-side by the
-            # storage's big-limit fallback, which the columnar kernel
-            # path bypasses — such namespaces take the exact path.
+            # Beyond-device-cap and token-bucket limits are decided
+            # host-side by the storage's exact fallback, which the
+            # columnar kernel path bypasses — such namespaces take the
+            # exact path.
             limit.max_value <= K.MAX_VALUE_CAP
+            and limit.policy == "fixed_window"
             for limit in limits
         )
         if not limits or not native_ok:

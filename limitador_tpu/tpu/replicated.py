@@ -77,6 +77,10 @@ def _apply_remote(remote_vals, remote_exp, slots, sums, expiries):
 
 
 class TpuReplicatedStorage(TpuStorage):
+    # Big-cell gossip floods carry fixed-window (value, expiry) state; a
+    # GCRA TAT would be merged wrong by peers. Rejected up front instead.
+    supports_token_bucket = False
+
     def __init__(
         self,
         node_id: str,

@@ -68,6 +68,8 @@ def _stable_hash(key: tuple) -> int:
 
 
 class TpuShardedStorage(_BigLimitMixin, CounterStorage):
+    supports_token_bucket = True  # node-local exact host path (mixin)
+
     def __init__(
         self,
         mesh=None,

@@ -41,6 +41,7 @@ from ..storage.base import (
     require_nonnegative_delta,
 )
 from ..storage.expiring_value import ExpiringValue
+from ..storage.gcra import GcraValue, cell_for_limit, restore_cell
 from ..ops import kernel as K
 
 __all__ = ["TpuStorage"]
@@ -58,6 +59,18 @@ def _bucket(n: int, floor: int = 8) -> int:
 
 def _clamp_window_ms(seconds: int) -> int:
     return min(seconds * 1000, K.WINDOW_MS_CAP)
+
+
+def _migrate_key(key):
+    """Pre-policy checkpoints: limit identity was a 4-tuple
+    (ns, seconds, conditions, variables); current lookups build 5-tuples
+    ending in the policy. Old keys are fixed-window."""
+    if (
+        isinstance(key, tuple) and len(key) == 2
+        and isinstance(key[0], tuple) and len(key[0]) == 4
+    ):
+        return (key[0] + ("fixed_window",), key[1])
+    return key
 
 
 class _SlotTable:
@@ -96,9 +109,16 @@ class _SlotTable:
     def load(self, data: dict, lo: int, hi: int) -> None:
         """Restore from ``dump`` output; slots of this table live in
         [lo, hi)."""
-        self.simple = dict(data["simple"])
-        self.qualified.update(data["qualified"])
-        self.info = dict(data["info"])
+        self.simple = {
+            _migrate_key(k): v for k, v in dict(data["simple"]).items()
+        }
+        self.qualified.update(
+            (_migrate_key(k), v) for k, v in data["qualified"]
+        )
+        self.info = {
+            s: (_migrate_key(key), counter)
+            for s, (key, counter) in dict(data["info"]).items()
+        }
         if "free" in data:  # older checkpoints persisted the free list
             self.free = list(data["free"])
         else:
@@ -142,14 +162,20 @@ class _BigLimitMixin:
 
     @staticmethod
     def _is_big(counter: Counter) -> bool:
-        return counter.max_value > K.MAX_VALUE_CAP
+        # Token-bucket counters ride the same exact host path as
+        # beyond-cap limits: coupled all-or-nothing into batch
+        # admission, arbitrary-precision Python ints.
+        return (
+            counter.max_value > K.MAX_VALUE_CAP
+            or counter.limit.policy == "token_bucket"
+        )
 
     def _big_cell(self, counter: Counter, key: tuple) -> ExpiringValue:
         entry = self._big.get(key)
         if entry is not None:
             self._big.move_to_end(key)
             return entry[0]
-        cell = ExpiringValue(0, 0.0)
+        cell = cell_for_limit(counter.limit)
         self._big[key] = (cell, counter.key())
         while len(self._big) > self._big_cap:
             evicted = False
@@ -192,10 +218,15 @@ class _BigLimitMixin:
             )
             ok = value + raw_delta <= c.max_value
             remaining = max(c.max_value - (value + raw_delta), 0)
-            ttl = (
-                float(c.window_seconds)
-                if cell.is_expired(now) else cell.ttl(now)
-            )
+            if isinstance(cell, GcraValue):
+                # Token bucket: expires_in is time-to-full (0 = full);
+                # there is no "fresh window" display case.
+                ttl = cell.ttl(now)
+            else:
+                ttl = (
+                    float(c.window_seconds)
+                    if cell.is_expired(now) else cell.ttl(now)
+                )
             bigs.append((j, ok, remaining, ttl, key, c, raw_delta))
             if ok:
                 self._big_inflight[key] = (
@@ -290,6 +321,8 @@ class _CheckHandle:
 
 
 class TpuStorage(_BigLimitMixin, CounterStorage):
+    supports_token_bucket = True  # via the exact host (big-limit) path
+
     def __init__(
         self,
         capacity: int = 1 << 20,
@@ -425,7 +458,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 dev_delta = 0 if big_failed else delta
                 adjust = delta if big_failed else 0
                 for j, c in enumerate(request.ordered):
-                    if c.max_value > K.MAX_VALUE_CAP:
+                    if self._is_big(c):
                         continue
                     slot, is_fresh = slot_for(c, create=True)
                     slots_l.append(slot)
@@ -826,7 +859,11 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 "epoch": self._epoch,
                 **self._table.dump(),
                 "big": {
-                    key: (cell.value_raw, cell.expiry, counter)
+                    key: (
+                        (cell.tat_ms, None, counter)
+                        if isinstance(cell, GcraValue)
+                        else (cell.value_raw, cell.expiry, counter)
+                    )
                     for key, (cell, counter) in self._big.items()
                 },
             }
@@ -869,7 +906,11 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             self._table = _SlotTable(self._capacity)
             self._table.load(table, 0, self._capacity)
             for key, (value, expiry, counter) in table.get("big", {}).items():
-                self._big[key] = (ExpiringValue(value, expiry), counter)
+                # Same pre-policy key migration as _SlotTable.load: old
+                # checkpoints hold 4-tuple limit identities.
+                self._big[_migrate_key(key)] = (
+                    restore_cell(counter.limit, value, expiry), counter
+                )
 
     def load_snapshot(self, path: str) -> None:
         """Restore a checkpoint into an already-constructed storage (the

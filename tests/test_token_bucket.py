@@ -1,0 +1,357 @@
+"""Token-bucket limits (``policy: token_bucket``) — beyond the reference.
+
+The reference is fixed-window only (limit.rs:34); BASELINE.json config 4
+names per-key token buckets. Semantics are quantized GCRA
+(storage/gcra.py): capacity ``max_value`` tokens, continuous refill at
+one token per ``I = max(1, seconds*1000 // max_value)`` ms, rejected
+arrivals spend nothing. Supported on the in-memory oracle and the TPU
+storages (exact host path); cell-format-bound backends reject the
+policy up front.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from limitador_tpu import Context, Limit, RateLimiter
+from limitador_tpu.storage.gcra import GcraValue, emission_interval_ms
+from limitador_tpu.storage.in_memory import InMemoryStorage
+from limitador_tpu.tpu import TpuStorage
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def ctx_for(user="a"):
+    ctx = Context()
+    ctx.list_binding("descriptors", [{"u": user}])
+    return ctx
+
+
+TB = dict(conditions=[], variables=["descriptors[0].u"],
+          policy="token_bucket")
+
+
+# -- GcraValue unit laws -----------------------------------------------------
+
+
+def test_emission_interval_quantization():
+    assert emission_interval_ms(5, 1) == 200
+    assert emission_interval_ms(1000, 1) == 1
+    # sub-ms rates quantize to 1ms/token (documented: max sustained
+    # device/host rate is 1000 tokens/s/key)
+    assert emission_interval_ms(10**6, 1) == 1
+    assert emission_interval_ms(100, 60) == 600
+    assert emission_interval_ms(0, 60) == 60_000
+
+
+def test_burst_exactly_capacity_then_refill_cadence():
+    cell = GcraValue(5, 1)  # I=200ms
+    t = 1000.0
+    admitted = 0
+    for _ in range(8):
+        if cell.value_at(t) + 1 <= 5:
+            cell.update(1, 1, t)
+            admitted += 1
+    assert admitted == 5
+    # one token exactly every 200ms
+    for k in range(1, 4):
+        t_k = 1000.0 + 0.2 * k
+        assert cell.value_at(t_k) + 1 <= 5, f"token {k} not refilled"
+        cell.update(1, 1, t_k)
+        assert cell.value_at(t_k) + 1 > 5, f"extra token at {k}"
+
+
+def test_idle_bucket_refills_to_capacity_not_beyond():
+    cell = GcraValue(3, 1)
+    t = 1000.0
+    for _ in range(3):
+        cell.update(1, 1, t)
+    t += 100.0  # ages far beyond full refill
+    assert cell.value_at(t) == 0  # full
+    assert cell.value_at(t) + 4 > 3  # never more than capacity
+    assert cell.is_expired(t)
+    assert cell.ttl(t) == 0.0
+
+
+def test_multi_token_delta_and_rejection_spends_nothing():
+    cell = GcraValue(10, 1)  # I=100ms
+    t = 1000.0
+    assert cell.value_at(t) + 7 <= 10
+    cell.update(7, 1, t)
+    # 3 left: a delta-4 does not conform, and checking it changed nothing
+    assert cell.value_at(t) + 4 > 10
+    assert cell.value_at(t) + 3 <= 10
+    cell.update(3, 1, t)
+    assert cell.value_at(t) + 1 > 10
+
+
+def test_ttl_is_time_to_full():
+    cell = GcraValue(4, 2)  # I=500ms
+    t = 1000.0
+    cell.update(2, 2, t)
+    assert cell.ttl(t) == pytest.approx(1.0)  # 2 tokens x 500ms
+    assert cell.ttl(t + 0.4) == pytest.approx(0.6)
+
+
+# -- storage behavior, oracle vs TPU parity ---------------------------------
+
+
+@pytest.mark.parametrize("make", [
+    lambda c: InMemoryStorage(clock=c),
+    lambda c: TpuStorage(capacity=1 << 12, clock=c),
+], ids=["oracle", "tpu"])
+def test_burst_refill_and_headers(make):
+    clk = Clock()
+    rl = RateLimiter(make(clk))
+    rl.add_limit(Limit("tb", 5, 1, **TB))  # I=200ms
+    got = [rl.check_rate_limited_and_update("tb", ctx_for(), 1).limited
+           for _ in range(7)]
+    assert got == [False] * 5 + [True] * 2
+    clk.t += 0.45  # exactly 2 tokens back
+    got = [rl.check_rate_limited_and_update("tb", ctx_for(), 1).limited
+           for _ in range(3)]
+    assert got == [False, False, True]
+    clk.t += 60
+    res = rl.check_rate_limited_and_update(
+        "tb", ctx_for(), 2, load_counters=True
+    )
+    headers = res.response_header()
+    assert headers["X-RateLimit-Limit"].startswith("5")
+    assert headers["X-RateLimit-Remaining"] == "3"
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_randomized_parity_oracle_vs_tpu(seed):
+    """Same op stream against the oracle and the TPU storage: identical
+    admissions at every step."""
+    rng = np.random.default_rng(seed)
+    clk_a, clk_b = Clock(), Clock()
+    a = RateLimiter(InMemoryStorage(clock=clk_a))
+    b = RateLimiter(TpuStorage(capacity=1 << 12, clock=clk_b))
+    for rl in (a, b):
+        rl.add_limit(Limit("tb", 7, 2, **TB))
+        rl.add_limit(Limit("tb", 50, 10, name="slow",
+                           conditions=[], variables=["descriptors[0].u"],
+                           policy="token_bucket"))
+    users = ["u1", "u2", "u3"]
+    for step in range(120):
+        user = users[int(rng.integers(len(users)))]
+        delta = int(rng.integers(1, 4))
+        ra = a.check_rate_limited_and_update("tb", ctx_for(user), delta)
+        rb = b.check_rate_limited_and_update("tb", ctx_for(user), delta)
+        assert ra.limited == rb.limited, f"seed {seed} step {step}"
+        if rng.random() < 0.3:
+            dt = float(rng.random())
+            clk_a.t += dt
+            clk_b.t += dt
+
+
+def test_mixed_policies_couple_all_or_nothing():
+    """A namespace holding a fixed-window limit AND a token-bucket limit:
+    a request rejected by either spends from NEITHER (check-all-then-
+    update-all crosses policies)."""
+    clk = Clock()
+    rl = RateLimiter(TpuStorage(capacity=1 << 12, clock=clk))
+    rl.add_limit(Limit("m", 100, 60, conditions=[],
+                       variables=["descriptors[0].u"]))
+    rl.add_limit(Limit("m", 2, 1, name="bucket", **TB))
+    # exhaust the bucket
+    assert not rl.check_rate_limited_and_update("m", ctx_for(), 2).limited
+    # bucket rejects; the fixed-window counter must not advance
+    assert rl.check_rate_limited_and_update("m", ctx_for(), 1).limited
+    counters = {
+        c.limit.name: c for c in rl.get_counters("m")
+    }
+    fw = [c for c in rl.get_counters("m") if c.limit.policy == "fixed_window"]
+    assert fw and fw[0].remaining == 100 - 2
+
+
+def test_policy_is_part_of_identity():
+    fixed = Limit("ns", 5, 60, [], ["descriptors[0].u"])
+    bucket = Limit("ns", 5, 60, [], ["descriptors[0].u"],
+                   policy="token_bucket")
+    assert fixed != bucket
+    assert hash(fixed) != hash(bucket)
+    # max_value still excluded from identity within a policy
+    assert Limit("ns", 9, 60, [], ["descriptors[0].u"]) == fixed
+
+
+def test_unknown_policy_rejected():
+    with pytest.raises(ValueError, match="unknown limit policy"):
+        Limit("ns", 5, 60, policy="sliding_window")
+
+
+def test_yaml_and_dto_roundtrip():
+    limit = Limit.from_dict({
+        "namespace": "ns", "max_value": 5, "seconds": 1,
+        "policy": "token_bucket",
+    })
+    assert limit.policy == "token_bucket"
+    d = limit.to_dict()
+    assert d["policy"] == "token_bucket"
+    assert Limit.from_dict(d) == limit
+    # fixed-window dicts stay byte-identical to the reference schema
+    assert "policy" not in Limit("ns", 5, 1).to_dict()
+
+
+def test_unsupported_backends_reject_up_front(tmp_path):
+    from limitador_tpu.storage.disk import DiskStorage
+
+    rl = RateLimiter(DiskStorage(str(tmp_path / "c.db")))
+    with pytest.raises(ValueError, match="token_bucket"):
+        rl.add_limit(Limit("ns", 5, 1, **TB))
+
+
+def test_replicated_rejects_token_bucket():
+    from limitador_tpu.tpu.replicated import TpuReplicatedStorage
+
+    storage = TpuReplicatedStorage(node_id="n1", listen_address=None,
+                                   capacity=1 << 10)
+    rl = RateLimiter(storage)
+    try:
+        with pytest.raises(ValueError, match="token_bucket"):
+            rl.add_limit(Limit("ns", 5, 1, **TB))
+    finally:
+        storage.close()
+
+
+def test_snapshot_roundtrip_preserves_tat(tmp_path):
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("tb", 5, 1, **TB))
+    for _ in range(3):
+        rl.check_rate_limited_and_update("tb", ctx_for(), 1)
+    path = str(tmp_path / "tb.ckpt")
+    storage.snapshot(path)
+
+    restored = TpuStorage(capacity=1 << 12, clock=clk)
+    restored.load_snapshot(path)
+    rl2 = RateLimiter(restored)
+    rl2.add_limit(Limit("tb", 5, 1, **TB))
+    # 2 tokens left in the restored bucket
+    got = [rl2.check_rate_limited_and_update("tb", ctx_for(), 1).limited
+           for _ in range(3)]
+    assert got == [False, False, True]
+
+
+def test_get_counters_shows_bucket_state():
+    clk = Clock()
+    rl = RateLimiter(TpuStorage(capacity=1 << 12, clock=clk))
+    rl.add_limit(Limit("tb", 5, 1, **TB))
+    rl.check_rate_limited_and_update("tb", ctx_for(), 3)
+    counters = list(rl.get_counters("tb"))
+    assert len(counters) == 1
+    assert counters[0].remaining == 2
+    # expires_in = time to full = 3 tokens x 200ms
+    assert counters[0].expires_in == pytest.approx(0.6, abs=0.05)
+
+
+def test_server_e2e_token_bucket(tmp_path):
+    """Full server: token-bucket limit from YAML, served over HTTP and
+    gRPC with the native pipeline (which must route the namespace to the
+    exact path), DTO exposes the policy."""
+    import json
+    import os
+    import socket
+    import subprocess
+    import sys
+    import urllib.request
+    from pathlib import Path
+
+    import grpc
+
+    from limitador_tpu.server.proto import rls_pb2
+
+    repo = str(Path(__file__).resolve().parent.parent)
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(
+        "- namespace: tb\n  max_value: 3\n  seconds: 60\n"
+        "  policy: token_bucket\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+
+    def fp():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    hp, rp = fp(), fp()
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "limitador_tpu.server", str(limits), "tpu",
+         "--pipeline", "native",
+         "--rls-port", str(rp), "--http-port", str(hp)],
+        cwd=repo,
+        env=dict(os.environ, PYTHONPATH=repo, LIMITADOR_TPU_PLATFORM="cpu"),
+        stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hp}/status", timeout=1
+                ):
+                    break
+            except Exception:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.1)
+        # /limits DTO carries the policy
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{hp}/limits/tb", timeout=5
+        ) as resp:
+            dto = json.loads(resp.read())
+        assert dto[0]["policy"] == "token_bucket"
+        # gRPC: burst of 3, then OVER (refill is 1 per 20s — none during
+        # the test)
+        with grpc.insecure_channel(f"127.0.0.1:{rp}") as ch:
+            call = ch.unary_unary(
+                "/envoy.service.ratelimit.v3.RateLimitService"
+                "/ShouldRateLimit",
+                request_serializer=(
+                    rls_pb2.RateLimitRequest.SerializeToString
+                ),
+                response_deserializer=rls_pb2.RateLimitResponse.FromString,
+            )
+            codes = []
+            for _ in range(5):
+                req = rls_pb2.RateLimitRequest(domain="tb", hits_addend=1)
+                d = req.descriptors.add()
+                e = d.entries.add()
+                e.key, e.value = "u", "grpc-user"
+                codes.append(call(req, timeout=15).overall_code)
+        OK, OVER = (rls_pb2.RateLimitResponse.OK,
+                    rls_pb2.RateLimitResponse.OVER_LIMIT)
+        assert codes == [OK, OK, OK, OVER, OVER]
+        # HTTP surface against a different user
+        statuses = []
+        for _ in range(5):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{hp}/check_and_report",
+                data=json.dumps({"namespace": "tb",
+                                 "values": {"u": "http-user"},
+                                 "delta": 1}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                statuses.append(urllib.request.urlopen(req, timeout=5).status)
+            except urllib.error.HTTPError as exc:
+                statuses.append(exc.code)
+        assert statuses == [200, 200, 200, 429, 429]
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        log.close()
