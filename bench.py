@@ -382,6 +382,7 @@ def bench_sharded():
     fill_windows = np.full((n, H_fill), 3_600_000, np.int32)
     fill_req = np.arange(n * H_fill, dtype=np.int32).reshape(n, H_fill)
     fill_fresh = np.zeros((n, H_fill), bool)
+    fill_bucket = np.zeros((n, H_fill), bool)
     fill_global = np.zeros((n, H_fill), bool)
     for b in range(20):
         base = b * H_fill
@@ -391,7 +392,8 @@ def bench_sharded():
         ).copy()
         state, res = sharded_check_and_update(
             mesh, state, fill_slots, fill_deltas, fill_maxes,
-            fill_windows, fill_req, fill_fresh, fill_global, np.int32(100),
+            fill_windows, fill_req, fill_fresh, fill_bucket, fill_global,
+            np.int32(100),
         )
     jax.block_until_ready(res.admitted)
 
@@ -405,20 +407,21 @@ def bench_sharded():
     windows = np.full((n, H), 60_000, np.int32)
     req = np.arange(n * H, dtype=np.int32).reshape(n, H)
     fresh = np.zeros((n, H), bool)
+    bucket = np.zeros((n, H), bool)
     is_global = np.zeros((n, H), bool)
     is_global[:, 0] = True
     slots_g = slots.copy()
     slots_g[:, :, 0] = 7
     state, res = sharded_check_and_update(
         mesh, state, slots_g[0], deltas, maxes, windows, req, fresh,
-        is_global, np.int32(500),
+        bucket, is_global, np.int32(500),
     )
     jax.block_until_ready(res.admitted)
     t0 = time.perf_counter()
     for i in range(batches):
         state, res = sharded_check_and_update(
             mesh, state, slots_g[i], deltas, maxes, windows, req, fresh,
-            is_global, np.int32(1000 + i),
+            bucket, is_global, np.int32(1000 + i),
         )
     jax.block_until_ready(res.admitted)
     dt = time.perf_counter() - t0
@@ -1213,17 +1216,19 @@ def main():
             maxes = jax.device_put(np.full(batch, 1000, np.int32))
             req_ids = jax.device_put(np.arange(batch, dtype=np.int32))
             fresh = jax.device_put(np.zeros(batch, bool))
+            bucket = jax.device_put(np.zeros(batch, bool))
             windows = jax.device_put(windows)
-            jax.block_until_ready((deltas, maxes, req_ids, fresh, windows))
+            jax.block_until_ready(
+                (deltas, maxes, req_ids, fresh, bucket, windows))
             state, result = check_and_update_batch(
                 state, keys_batches[0], deltas, maxes, windows, req_ids,
-                fresh, np.int32(500))
+                fresh, bucket, np.int32(500))
             jax.block_until_ready(result.admitted)
             t0 = time.perf_counter()
             for i, keys in enumerate(keys_batches):
                 state, result = check_and_update_batch(
                     state, keys, deltas, maxes, windows, req_ids, fresh,
-                    np.int32(1000 + i))
+                    bucket, np.int32(1000 + i))
             jax.block_until_ready(result.admitted)
             return keys_batches.shape[0] * batch / (time.perf_counter() - t0)
 
@@ -1235,10 +1240,16 @@ def main():
     warmup = 4
     max_value = 1000
     window_ms = 60_000
+    # BASELINE config 4: per-key TOKEN BUCKET over the zipf key stream —
+    # capacity 1000 refilling at 1000/60s (GCRA interval 60ms/token), run
+    # on the device kernel's bucket lane (ops/kernel.py). The fixed-window
+    # variant rides along as an extra row for the r1-r3 trend.
+    interval_ms = window_ms // max_value
 
     dev = jax.devices()[0]
     print(
-        f"bench: {n_keys} keys zipf-0.99, {n_batches}x{batch} decisions "
+        f"bench: {n_keys} keys zipf-0.99 per-key token-bucket (GCRA device "
+        f"lane, I={interval_ms}ms), {n_batches}x{batch} decisions "
         f"on {dev.device_kind} ({dev.platform})",
         file=sys.stderr,
     )
@@ -1259,14 +1270,27 @@ def main():
     deltas = jax.device_put(np.ones(batch, np.int32))
     maxes = jax.device_put(np.full(batch, max_value, np.int32))
     windows = jax.device_put(np.full(batch, window_ms, np.int32))
+    intervals = jax.device_put(np.full(batch, interval_ms, np.int32))
     req_ids = jax.device_put(np.arange(batch, dtype=np.int32))
     fresh = jax.device_put(np.zeros(batch, bool))
-    jax.block_until_ready((deltas, maxes, windows, req_ids, fresh))
+    bucket_on = jax.device_put(np.ones(batch, bool))
+    bucket_off = jax.device_put(np.zeros(batch, bool))
+    jax.block_until_ready(
+        (deltas, maxes, windows, intervals, req_ids, fresh, bucket_on,
+         bucket_off)
+    )
 
     def step(state, slots, now_ms):
+        # headline: per-key token bucket (config 4) on the device lane
+        return check_and_update_batch(
+            state, slots, deltas, maxes, intervals, req_ids, fresh,
+            bucket_on, np.int32(now_ms),
+        )
+
+    def step_fw(state, slots, now_ms):
         return check_and_update_batch(
             state, slots, deltas, maxes, windows, req_ids, fresh,
-            np.int32(now_ms),
+            bucket_off, np.int32(now_ms),
         )
 
     # Warmup / compile
@@ -1360,6 +1384,28 @@ def main():
     )
 
     extra["device_kernel_decisions_per_sec"] = round(kernel_rate, 1)
+
+    # Fixed-window ride-along (same key stream, window cells) for the
+    # r1-r3 headline trend; separate table so policies don't share slots.
+    fw_state = make_table(n_keys)
+    for i in range(2):
+        fw_state, fw_res = step_fw(fw_state, keys[i], 1000 + i)
+    jax.block_until_ready(fw_res.admitted)
+    fw_rate = 0.0
+    for rep in range(2):
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            fw_state, fw_res = step_fw(fw_state, keys[i], 6000 + rep * 100 + i)
+        jax.block_until_ready(fw_res.admitted)
+        fw_rate = max(
+            fw_rate, n_batches * batch / (time.perf_counter() - t0)
+        )
+    print(
+        f"fixed-window ride-along: {fw_rate/1e6:.2f}M decisions/s",
+        file=sys.stderr,
+    )
+    extra["device_fixed_window_decisions_per_sec"] = round(fw_rate, 1)
+    extra["headline_policy"] = "token_bucket"
 
     emit(
         "should_rate_limit_decisions_per_sec",

@@ -72,6 +72,17 @@ class Limit:
             raise ValueError(
                 f"unknown limit policy {policy!r}; expected one of {POLICIES}"
             )
+        if policy == "token_bucket" and int(max_value) > int(seconds) * 10**9:
+            # GCRA ticks bottom out at 1ns/token (storage/gcra.py
+            # unit_scale): beyond that the sustained rate silently clamps
+            # to 1e9 tokens/s — surface it instead of under-admitting.
+            import warnings
+
+            warnings.warn(
+                f"token_bucket limit {max_value}/{seconds}s exceeds 1e9 "
+                "tokens/s; sustained rate clamps to 1e9 tokens/s per key",
+                stacklevel=2,
+            )
         self.id = id
         self.namespace = Namespace.of(namespace)
         self.max_value = int(max_value)
@@ -108,7 +119,11 @@ class Limit:
             self.policy = "fixed_window"
             if len(self._identity) == 4:
                 self._identity = self._identity + ("fixed_window",)
-                self._hash = hash(self._identity)
+        # The pickled _hash was computed under the saving process's
+        # PYTHONHASHSEED; str hashes are per-process, so always recompute —
+        # otherwise restored Limits compare == to fresh ones but hash apart
+        # and silently vanish from set/dict membership tests.
+        self._hash = hash(self._identity)
 
     @classmethod
     def with_id(

@@ -116,7 +116,7 @@ def _load():
             return None
         lib.h2i_create.restype = ctypes.c_void_p
         lib.h2i_create.argtypes = [
-            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
         ]
         lib.h2i_port.restype = ctypes.c_int
         lib.h2i_port.argtypes = [ctypes.c_void_p]
@@ -235,6 +235,7 @@ class NativeIngress:
         max_batch: int = 8192,
         poll_ms: int = 20,
         handlers=None,
+        stream_path: Optional[str] = None,
     ):
         lib = _load()
         if lib is None:
@@ -245,10 +246,18 @@ class NativeIngress:
         self.pipeline = pipeline
         self.loop = loop
         self.handlers = dict(handlers or {})
+        # One registered bidi-stream method (gRPC reflection): the C++
+        # layer dispatches each stream message on arrival (path) and the
+        # client's half-close as path + "#eos"; answering the eos event
+        # with status -1 closes the stream cleanly.
+        self.stream_path = stream_path
         self.max_batch = max_batch
         self.poll_ms = poll_ms
         self._ctx = ctypes.c_void_p(
-            lib.h2i_create(host.encode(), port, TARGET_PATH.encode())
+            lib.h2i_create(
+                host.encode(), port, TARGET_PATH.encode(),
+                stream_path.encode() if stream_path else None,
+            )
         )
         if not self._ctx:
             raise OSError(f"could not bind native ingress to {host}:{port}")
@@ -414,7 +423,7 @@ class NativeIngress:
         finally:
             sem.release()
 
-    def _answer_from_loop(self, rid: int, coro) -> None:
+    def _answer_from_loop(self, rid: int, coro, ok_status: int = 0) -> None:
         """Run a coroutine on the server loop and answer ``rid`` with its
         result, mapping GrpcHandlerError/StorageError to their statuses.
         ALWAYS answers — including on cancellation at shutdown."""
@@ -424,7 +433,7 @@ class NativeIngress:
 
         def done(fut):
             try:
-                self._respond([(rid, 0, fut.result())])
+                self._respond([(rid, ok_status, fut.result())])
             except GrpcHandlerError as exc:
                 self._respond([(rid, exc.status, exc.message)])
             except StorageError:
@@ -446,6 +455,18 @@ class NativeIngress:
         """Cold-path method routing: a registered handler coroutine runs
         on the server loop. Returns False when no handler is registered
         (the caller batches the UNIMPLEMENTED answers)."""
+        if self.stream_path is not None and path == self.stream_path + "#eos":
+            # Client half-closed the bidi stream: close it cleanly — via
+            # the loop when one exists, so the close is scheduled BEHIND
+            # any still-running message handlers of the same stream.
+            if self.loop is not None:
+                async def _close() -> bytes:
+                    return b""
+
+                self._answer_from_loop(rid, _close(), ok_status=-1)
+            else:
+                self._respond([(rid, -1, b"")])
+            return True
         handler = self.handlers.get(path)
         if handler is None or self.loop is None:
             return False

@@ -99,10 +99,11 @@ class MiniSpan(Span):
     __slots__ = (
         "name", "_context", "parent_span_id", "start_unix_nano",
         "end_unix_nano", "attributes", "_status_code", "_status_desc",
-        "events", "_exporter", "_ended", "_lock",
+        "events", "_exporter", "_ended", "_lock", "kind", "links",
     )
 
-    def __init__(self, name, span_context, parent_span_id, exporter):
+    def __init__(self, name, span_context, parent_span_id, exporter,
+                 kind=SpanKind.INTERNAL, links=()):
         self.name = name
         self._context = span_context
         self.parent_span_id = parent_span_id
@@ -115,6 +116,8 @@ class MiniSpan(Span):
         self._exporter = exporter
         self._ended = False
         self._lock = threading.Lock()
+        self.kind = kind
+        self.links = list(links or ())
 
     # --- abstract Span surface -------------------------------------------
     def get_span_context(self) -> SpanContext:
@@ -172,17 +175,32 @@ class MiniSpan(Span):
     # --- OTLP JSON -------------------------------------------------------
     def to_otlp_json(self) -> dict:
         ctx = self._context
+        # API SpanKind is 0-based (INTERNAL=0); the proto enum reserves 0
+        # for UNSPECIFIED, so the JSON mapping is value+1.
+        kind = self.kind
         span = {
             "traceId": format(ctx.trace_id, "032x"),
             "spanId": format(ctx.span_id, "016x"),
             "name": self.name,
-            "kind": 1,  # SPAN_KIND_INTERNAL
+            "kind": int(kind.value if hasattr(kind, "value") else kind) + 1,
             "startTimeUnixNano": str(self.start_unix_nano),
             "endTimeUnixNano": str(self.end_unix_nano),
             "attributes": _attrs_json(self.attributes),
         }
         if self.parent_span_id:
             span["parentSpanId"] = format(self.parent_span_id, "016x")
+        if self.links:
+            links = []
+            for link in self.links:
+                lctx = getattr(link, "context", link)
+                links.append({
+                    "traceId": format(lctx.trace_id, "032x"),
+                    "spanId": format(lctx.span_id, "016x"),
+                    "attributes": _attrs_json(
+                        dict(getattr(link, "attributes", None) or {})
+                    ),
+                })
+            span["links"] = links
         if self.events:
             span["events"] = [
                 {
@@ -236,6 +254,12 @@ class BatchExporter:
         self.dropped = 0
         self.exported = 0
         self.export_errors = 0
+        # Flush barrier: every span accepted into the queue is eventually
+        # counted processed (exported or errored), under one lock so the
+        # public counters are also coherent across threads.
+        self._count_lock = threading.Lock()
+        self._accepted = 0
+        self._processed = 0
         self._wake = threading.Event()
         self._stop = False
         self._max_batch = max_batch
@@ -248,8 +272,11 @@ class BatchExporter:
         try:
             self._queue.put_nowait(span)
         except queue.Full:
-            self.dropped += 1
+            with self._count_lock:
+                self.dropped += 1
             return
+        with self._count_lock:
+            self._accepted += 1
         if self._queue.qsize() >= self._max_batch:
             self._wake.set()
 
@@ -297,28 +324,39 @@ class BatchExporter:
                 )
                 resp = conn.getresponse()
                 resp.read()
-                if 200 <= resp.status < 300:
-                    self.exported += len(batch)
-                else:
-                    self.export_errors += 1
+                with self._count_lock:
+                    if 200 <= resp.status < 300:
+                        self.exported += len(batch)
+                    else:
+                        self.export_errors += 1
             finally:
                 conn.close()
         except Exception:  # noqa: BLE001 - a bad response (HTTPException)
             # must not kill the export thread for the process lifetime
-            self.export_errors += 1
+            with self._count_lock:
+                self.export_errors += 1
+        finally:
+            with self._count_lock:
+                self._processed += len(batch)
 
     def force_flush(self, timeout_s: float = 5.0) -> bool:
-        """Drain and export everything currently queued (tests/shutdown)."""
+        """Export everything enqueued before this call (tests/shutdown).
+
+        Waits on the processed counter, not queue emptiness: a batch that
+        has been drained but is mid-POST (up to ``timeout_s`` of socket
+        time) counts as unfinished until ``_export`` returns.
+        """
+        with self._count_lock:
+            target = self._accepted
         deadline = time.monotonic() + timeout_s
-        while not self._queue.empty():
+        while True:
+            with self._count_lock:
+                if self._processed >= target:
+                    return True
             if time.monotonic() >= deadline:
                 return False
             self._wake.set()
             time.sleep(0.01)
-        # One more pass so an in-flight batch finishes its POST.
-        self._wake.set()
-        time.sleep(0.05)
-        return True
 
     def shutdown(self):
         self.force_flush()
@@ -356,7 +394,8 @@ class MiniTracer(Tracer):
             is_remote=False,
             trace_flags=TraceFlags(TraceFlags.SAMPLED),
         )
-        span = MiniSpan(name, span_ctx, parent_span_id, self._exporter)
+        span = MiniSpan(name, span_ctx, parent_span_id, self._exporter,
+                        kind=kind, links=links)
         if start_time:
             span.start_unix_nano = start_time
         if attributes:

@@ -147,6 +147,7 @@ def check_and_update_core(
     windows_ms: jax.Array,
     req_ids: jax.Array,
     fresh: jax.Array,
+    bucket: jax.Array,
     now_ms: jax.Array,
     num_req: int,
     vote_combine=None,
@@ -158,6 +159,17 @@ def check_and_update_core(
     a mesh axis (identity on one chip). ``base_hook(v_local, s_slot)``
     returns the effective base value per sorted hit (identity reads the
     local cell; the sharded path substitutes psum'd global partials).
+
+    ``bucket`` marks GCRA token-bucket hits (storage/gcra.py): for those,
+    ``windows_ms`` carries the emission interval I instead of a window,
+    ``maxes`` the capacity B, and the cell's expiry lane holds the TAT
+    (ms, same host epoch — it rebases with the fixed windows). The
+    effective value is the spent-token count B - (floor((tau - base_rel)
+    / I) + 1), which is exactly linear in admitted tokens, so the
+    fixpoint's segmented-prefix admission applies UNCHANGED across both
+    policies — one sweep admits mixed fixed-window/bucket batches. The
+    values lane is unspecified for bucket cells (reads derive spent from
+    the TAT; the kernel writes 0).
 
     Returns (new_values, new_expiry, admitted[num_req], ok, remaining,
     ttl_ms) with the last three in input hit order.
@@ -175,6 +187,7 @@ def check_and_update_core(
     s_req = req_ids[order]
     s_win = windows_ms[order]
     s_fresh = fresh[order]
+    s_bucket = bucket[order]
 
     v_raw = values[s_slot]
     e_raw = expiry[s_slot]
@@ -182,7 +195,15 @@ def check_and_update_core(
     # (possibly stale, recycled) device contents.
     e_eff = jnp.where(s_fresh, now_ms + s_win, e_raw)
     expired = now_ms >= e_eff
-    v_local = jnp.where(jnp.logical_or(expired, s_fresh), 0, v_raw)
+    v_window = jnp.where(jnp.logical_or(expired, s_fresh), 0, v_raw)
+    # Bucket lanes: TAT lives in the expiry cell; fresh slots read a full
+    # bucket (stale TAT ignored). tau is masked to bucket lanes so the
+    # (B-1)*I product can't wrap for window hits with huge maxes.
+    base_rel = jnp.where(s_fresh, 0, jnp.maximum(e_raw - now_ms, 0))
+    s_ival = jnp.maximum(s_win, 1)
+    tau = (s_max - 1) * jnp.where(s_bucket, s_win, 0)
+    spent = s_max - ((tau - base_rel) // s_ival + 1)
+    v_local = jnp.where(s_bucket, spent, v_window)
     v_eff = v_local if base_hook is None else base_hook(v_local, s_slot)
 
     # Index of each sorted hit's segment start (for the prefix sums).
@@ -225,10 +246,15 @@ def check_and_update_core(
     # If the cell was expired and an earlier admitted hit already wrote it,
     # this hit observes the freshly reset window (serial semantics).
     reset_before = jnp.logical_and(expired, pending_final > 0)
-    ttl_ms = jnp.where(
+    ttl_window = jnp.where(
         jnp.logical_or(reset_before, s_fresh),
         s_win,
         jnp.maximum(e_raw - now_ms, 0),
+    )
+    # Bucket ttl = time-to-full observed at the hit's turn: earlier
+    # admitted hits in the segment each pushed the TAT by delta*I.
+    ttl_ms = jnp.where(
+        s_bucket, base_rel + pending_final * s_win, ttl_window
     )
 
     # ---- scatter updates ------------------------------------------------
@@ -263,18 +289,34 @@ def check_and_update_core(
     h_win = seg_win[seg_id]
     cell_expired_h = now_ms >= e_raw  # per-hit read of the cell's expiry
     starts_fresh = jnp.logical_or(cell_expired_h, h_fresh)
-    val_new = jnp.minimum(
-        jnp.where(starts_fresh, 0, v_raw) + h_total, _NEVER
+    val_new = jnp.where(
+        s_bucket,
+        0,  # bucket values lane is unspecified; all reads derive from TAT
+        jnp.minimum(jnp.where(starts_fresh, 0, v_raw) + h_total, _NEVER),
     )
     write_val = jnp.logical_and(is_end, jnp.logical_or(h_adm, h_fresh))
+    reset_window = jnp.logical_or(
+        jnp.logical_and(h_adm, starts_fresh), h_fresh
+    )
+    # Bucket TAT advance: serial application collapses to ONE write —
+    # max(TAT0, now) + total_admitted*I (fresh slots start from a full
+    # bucket, clearing any stale recycled TAT even on pure rejection).
+    # Admission bounds total_admitted <= B, so the write stays within
+    # now + B*I <= now + WINDOW_MS_CAP — no int32 overflow.
     reset = jnp.logical_and(
         is_end,
-        jnp.logical_or(jnp.logical_and(h_adm, starts_fresh), h_fresh),
+        jnp.where(
+            s_bucket, jnp.logical_or(h_adm, h_fresh), reset_window
+        ),
+    )
+    tat_base = jnp.maximum(jnp.where(h_fresh, 0, e_raw), now_ms)
+    exp_new = jnp.where(
+        s_bucket, tat_base + h_total * s_win, now_ms + h_win
     )
     idx_val = jnp.where(write_val, s_slot, scratch)
     idx_exp = jnp.where(reset, s_slot, scratch)
     new_values = values.at[idx_val].set(val_new)
-    new_expiry = expiry.at[idx_exp].set(now_ms + h_win)
+    new_expiry = expiry.at[idx_exp].set(exp_new)
     # Scratch cell stays inert (it also absorbed every masked-off write).
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
@@ -294,22 +336,24 @@ def check_and_update_impl(
     slots: jax.Array,       # int32[H] slot per hit (C for padding)
     deltas: jax.Array,      # int32[H]
     maxes: jax.Array,       # int32[H]
-    windows_ms: jax.Array,  # int32[H]
+    windows_ms: jax.Array,  # int32[H] window, or emission interval I for buckets
     req_ids: jax.Array,     # int32[H] nondecreasing request id per hit
     fresh: jax.Array,       # bool[H]  slot newly allocated/recycled this batch
+    bucket: jax.Array,      # bool[H]  GCRA token-bucket hit (TAT cell)
     now_ms: jax.Array,      # int32 scalar
 ) -> Tuple[CounterTableState, BatchResult]:
     """One fused check-all-then-update-all over a batch of requests (pure;
     ``check_and_update_batch`` is the jitted, donating production wrapper).
 
-    Padding hits must use slot C, delta 0, max INT32_MAX, fresh False.
-    ``req_ids`` must be nondecreasing (hits of one request contiguous) — the
-    batcher builds hits in request order, which also makes the stable sort
-    in the core preserve request order within a slot.
+    Padding hits must use slot C, delta 0, max INT32_MAX, fresh False,
+    bucket False. ``req_ids`` must be nondecreasing (hits of one request
+    contiguous) — the batcher builds hits in request order, which also
+    makes the stable sort in the core preserve request order within a
+    slot.
     """
     nv, ne, admitted, ok, remaining, ttl = check_and_update_core(
         state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
-        req_ids, fresh, now_ms, num_req=slots.shape[0],
+        req_ids, fresh, bucket, now_ms, num_req=slots.shape[0],
     )
     return CounterTableState(nv, ne), BatchResult(admitted, ok, remaining, ttl)
 
@@ -326,12 +370,20 @@ def update_core(
     deltas: jax.Array,
     windows_ms: jax.Array,
     fresh: jax.Array,
+    bucket: jax.Array,
     now_ms: jax.Array,
 ) -> Tuple[jax.Array, jax.Array]:
     """Unconditional increments (the reference's ``update_counter`` path):
     apply every delta, resetting expired windows, no admission check.
     Traceable core shared by the single-chip ``update_batch`` wrapper and
     the per-shard body of the multi-chip ``sharded_update``.
+
+    Bucket hits (``bucket`` True; ``windows_ms`` carries the emission
+    interval I) advance the TAT in the expiry lane by total*I from
+    max(TAT, now). Unconditional totals are unbounded, so the advance
+    clamps at the int32 horizon: tokens beyond it are dropped — the
+    bucket analogue of the fixed-window MAX_VALUE_CAP saturation (a
+    saturated TAT rejects everything and decays with real time).
 
     O(H log H): hits are sorted by slot and every per-cell aggregate is a
     segment reduction, written back with one scatter-set at each
@@ -352,6 +404,7 @@ def update_core(
     d = jnp.clip(deltas[order], 0, MAX_DELTA_CAP)
     s_win = windows_ms[order]
     s_fresh = fresh[order]
+    s_bucket = bucket[order]
 
     def seg_sum(x):
         return jax.ops.segment_sum(
@@ -385,14 +438,28 @@ def update_core(
     cell_expired = jnp.logical_or(now_ms >= e_raw, h_fresh)
     base_c = jnp.minimum(jnp.where(cell_expired, 0, v_raw), MAX_VALUE_CAP)
     headroom = MAX_VALUE_CAP - base_c
-    val_new = base_c + jnp.minimum(seg_add[seg_id], headroom)
+    val_new = jnp.where(
+        s_bucket, 0, base_c + jnp.minimum(seg_add[seg_id], headroom)
+    )
 
+    # Bucket TAT advance, clamped so max(TAT, now) + adv*I fits int32.
+    s_ival = jnp.maximum(s_win, 1)
+    tat_base = jnp.maximum(jnp.where(h_fresh, 0, e_raw), now_ms)
+    max_adv = (_NEVER - tat_base) // s_ival
+    adv = jnp.minimum(seg_add[seg_id], max_adv)
+    exp_new = jnp.where(
+        s_bucket, tat_base + adv * s_win, now_ms + seg_win[seg_id]
+    )
     idx_val = jnp.where(is_end, s_slot, scratch)
     idx_exp = jnp.where(
-        jnp.logical_and(is_end, cell_expired), s_slot, scratch
+        jnp.logical_and(
+            is_end, jnp.logical_or(cell_expired, s_bucket)
+        ),
+        s_slot,
+        scratch,
     )
     new_values = values.at[idx_val].set(val_new)
-    new_expiry = expiry.at[idx_exp].set(now_ms + seg_win[seg_id])
+    new_expiry = expiry.at[idx_exp].set(exp_new)
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
     return new_values, new_expiry
@@ -405,11 +472,12 @@ def update_batch(
     deltas: jax.Array,
     windows_ms: jax.Array,
     fresh: jax.Array,
+    bucket: jax.Array,
     now_ms: jax.Array,
 ) -> CounterTableState:
     nv, ne = update_core(
         state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
-        now_ms,
+        bucket, now_ms,
     )
     return CounterTableState(nv, ne)
 

@@ -78,7 +78,8 @@ def make_sharded_table(
 
 
 def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
-                fresh, is_global, now_ms, num_req, axis, global_region):
+                fresh, bucket, is_global, now_ms, num_req, axis,
+                global_region):
     """Per-device admission over the local shard; runs inside shard_map.
 
     Delegates to ops/kernel.py's shared ``check_and_update_core`` with two
@@ -108,7 +109,8 @@ def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
 
     return check_and_update_core(
         values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
-        now_ms, num_req, vote_combine=vote_combine, base_hook=base_hook,
+        bucket, now_ms, num_req, vote_combine=vote_combine,
+        base_hook=base_hook,
     )
 
 
@@ -125,20 +127,25 @@ def sharded_check_and_update(
     windows_ms: jax.Array,  # int32[n, H_local]
     req_ids: jax.Array,     # int32[n, H_local] global request ids
     fresh: jax.Array,       # bool[n, H_local]
+    bucket: jax.Array,      # bool[n, H_local] GCRA token-bucket hits
     is_global: jax.Array,   # bool[n, H_local] psum-replicated counter hits
     now_ms: jax.Array,      # int32 scalar
     axis: str = "shard",
     global_region: int = 1024,
 ) -> Tuple[ShardedCounterState, ShardedBatchResult]:
-    """One fused multi-chip check-and-update step over the sharded table."""
+    """One fused multi-chip check-and-update step over the sharded table.
+
+    Bucket hits are owner-sharded only (the host routes them like any
+    exact counter; a TAT cell cannot be a psum global partial, so bucket
+    counters in global namespaces stay on the host's exact path)."""
     num_req = slots.shape[0] * slots.shape[1]
 
     def fn(values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
-           is_global):
+           bucket, is_global):
         (nv, ne, admitted, ok, remaining, ttl) = _local_step(
             values[0], expiry[0], slots[0], deltas[0], maxes[0], windows[0],
-            req_ids[0], fresh[0], is_global[0], now_ms, num_req, axis,
-            global_region,
+            req_ids[0], fresh[0], bucket[0], is_global[0], now_ms, num_req,
+            axis, global_region,
         )
         return (
             nv[None], ne[None], admitted, ok[None], remaining[None], ttl[None]
@@ -149,11 +156,11 @@ def sharded_check_and_update(
     nv, ne, admitted, ok, remaining, ttl = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec,) * 9,
+        in_specs=(spec,) * 10,
         out_specs=(spec, spec, rep, spec, spec, spec),
         check_vma=False,
     )(state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
-      req_ids, fresh, is_global)
+      req_ids, fresh, bucket, is_global)
     return (
         ShardedCounterState(nv, ne),
         ShardedBatchResult(admitted, ok, remaining, ttl),
@@ -170,6 +177,7 @@ def sharded_update(
     deltas: jax.Array,      # int32[n, H_local]
     windows_ms: jax.Array,  # int32[n, H_local]
     fresh: jax.Array,       # bool[n, H_local]
+    bucket: jax.Array,      # bool[n, H_local]
     now_ms: jax.Array,      # int32 scalar
     axis: str = "shard",
 ) -> ShardedCounterState:
@@ -178,10 +186,10 @@ def sharded_update(
     scatter-adds, no admission, no cross-device coupling — a global
     counter's delta simply lands in one shard's partial."""
 
-    def fn(values, expiry, slots, deltas, windows, fresh):
+    def fn(values, expiry, slots, deltas, windows, fresh, bucket):
         nv, ne = update_core(
             values[0], expiry[0], slots[0], deltas[0], windows[0], fresh[0],
-            now_ms,
+            bucket[0], now_ms,
         )
         return nv[None], ne[None]
 
@@ -189,8 +197,9 @@ def sharded_update(
     nv, ne = jax.shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec,) * 6,
+        in_specs=(spec,) * 7,
         out_specs=(spec, spec),
         check_vma=False,
-    )(state.values, state.expiry_ms, slots, deltas, windows_ms, fresh)
+    )(state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
+      bucket)
     return ShardedCounterState(nv, ne)

@@ -573,16 +573,13 @@ async def _amain(args) -> int:
         if hasattr(target, "set_metrics"):
             target.set_metrics(metrics)
             break
-    reflection_enabled = False
+    # gRPC server reflection is always on, from the vendored SDK-free
+    # implementation (server/reflection.py) — the reference serves it
+    # unconditionally too (envoy_rls/server.rs:232-263). The historical
+    # --grpc-reflection-service flag is accepted and now a no-op.
     if args.grpc_reflection_service:
-        try:
-            import grpc_reflection  # noqa: F401
-
-            reflection_enabled = True
-        except ImportError:
-            log.info(
-                "grpc reflection requested but grpcio-reflection is not "
-                "installed; continuing without it")
+        log.info("grpc reflection is always enabled (vendored); "
+                 "--grpc-reflection-service is a no-op")
     status = {"limits_file_version": 0, "limits_file_errors": 0}
     pipelines_to_invalidate = []
 
@@ -692,17 +689,31 @@ async def _amain(args) -> int:
             # Cold-path methods (Kuadrant check/report) route through the
             # same RlsService the Python gRPC server uses, so one port
             # serves the whole surface.
-            from .rls import RlsService, make_native_method_handlers
+            from .rls import (
+                _ENVOY_SERVICE,
+                _KUADRANT_SERVICE,
+                RlsService,
+                make_native_method_handlers,
+            )
+            from .reflection import (
+                REFLECTION_METHOD,
+                native_reflection_handler,
+            )
 
             ingress_service = RlsService(
                 limiter, metrics, args.rate_limit_headers
+            )
+            ingress_handlers = make_native_method_handlers(ingress_service)
+            ingress_handlers[REFLECTION_METHOD] = native_reflection_handler(
+                (_ENVOY_SERVICE, _KUADRANT_SERVICE)
             )
             native_ingress = NativeIngress(
                 native_pipeline,
                 host=args.rls_host,
                 port=args.rls_port,
                 loop=asyncio.get_running_loop(),
-                handlers=make_native_method_handlers(ingress_service),
+                handlers=ingress_handlers,
+                stream_path=REFLECTION_METHOD,
             )
             rls_grpc_port = args.rls_port + 1
 
@@ -712,7 +723,6 @@ async def _amain(args) -> int:
         metrics,
         args.rate_limit_headers,
         native_pipeline=native_pipeline,
-        enable_reflection=reflection_enabled,
     )
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status

@@ -14,5 +14,8 @@ if _here not in sys.path:
 from envoy.service.ratelimit.v3 import rls_pb2  # noqa: E402
 from envoy.config.core.v3 import base_pb2  # noqa: E402
 from envoy.extensions.common.ratelimit.v3 import ratelimit_pb2  # noqa: E402
+# Proto package grpc.reflection.v1alpha lives under a non-colliding module
+# dir (the real `grpc` package would shadow a grpc/ tree).
+from reflection_v1alpha import reflection_pb2  # noqa: E402
 
-__all__ = ["rls_pb2", "base_pb2", "ratelimit_pb2"]
+__all__ = ["rls_pb2", "base_pb2", "ratelimit_pb2", "reflection_pb2"]

@@ -344,14 +344,19 @@ async def serve_rls(
     metrics: Optional[PrometheusMetrics] = None,
     rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
     native_pipeline=None,
-    enable_reflection: bool = False,
 ) -> grpc.aio.Server:
     """Start the gRPC server (returns it started; caller owns shutdown).
 
     With ``native_pipeline`` set (and headers off), ShouldRateLimit runs the
     native columnar path; the Kuadrant service keeps the standard handlers.
+
+    Server reflection is served unconditionally — the reference registers
+    tonic-reflection over its vendored descriptor sets the same way
+    (envoy_rls/server.rs:232-236,254-263) — via the vendored SDK-free
+    implementation in server/reflection.py.
     """
     from .middleware import GrpcRequestIdInterceptor
+    from .reflection import make_reflection_handler
 
     server = grpc.aio.server(interceptors=(GrpcRequestIdInterceptor(),))
     service = RlsService(limiter, metrics, rate_limit_headers)
@@ -360,15 +365,9 @@ async def serve_rls(
         envoy_handler = make_native_should_rate_limit_handler(native_pipeline)
     server.add_generic_rpc_handlers((envoy_handler,))
     server.add_generic_rpc_handlers((kuadrant_handler,))
-    if enable_reflection:
-        # The generated _pb2 modules register in the default descriptor
-        # pool, which grpc reflection serves from.
-        from grpc_reflection.v1alpha import reflection
-
-        reflection.enable_server_reflection(
-            (_ENVOY_SERVICE, _KUADRANT_SERVICE, reflection.SERVICE_NAME),
-            server,
-        )
+    server.add_generic_rpc_handlers(
+        (make_reflection_handler((_ENVOY_SERVICE, _KUADRANT_SERVICE)),)
+    )
     server.add_insecure_port(address)
     await server.start()
     return server

@@ -9,16 +9,26 @@ Theoretical Arrival Time — which is what lets the device kernel reuse
 the fixed-window table layout and segmented-prefix admission:
 
     capacity  B     = max_value tokens (burst size)
-    interval  I     = max(1, (seconds*1000) // max_value) ms/token
+    interval  I     = max(1, (seconds*1000*scale) // max_value) ticks/token
     tolerance tau   = (B - 1) * I
     arrival (t, d): conforms  iff  max(TAT, t) - t + (d - 1)*I <= tau
                     on admit      TAT = max(TAT, t) + d*I
 
-Sustained rate is quantized to 1000/I tokens/sec (exactly
-max_value/seconds when it divides 1000*seconds; the quantization keeps
-every quantity an int so host oracle and device kernel agree bit-for-
-bit). Rejected arrivals do not advance TAT (a failed request spends
-nothing).
+The tick unit scales with the limit's rate so quantization never
+clamps realistic rates (``unit_scale``): millisecond ticks up to
+1000 tokens/s/key, microsecond ticks up to 1e6/s, nanosecond ticks
+beyond. The unit is a pure function of (max_value, seconds), so the
+host oracle, the TPU host path and the device router always agree.
+Rates above 1e9 tokens/s/key still floor to 1ns/token; ``Limit``
+warns at construction. Rejected arrivals do not advance TAT (a
+failed request spends nothing).
+
+Millisecond-tick buckets additionally run ON DEVICE
+(``device_eligible``): the TAT is one int32 cell in the counter
+table's expiry lane, relative to the same host epoch as fixed
+windows (ops/kernel.py has the matching bucket lane in
+``check_and_update_core``). Finer-tick buckets keep the exact host
+path — sub-ms TAT cannot share the globally ms-rebased epoch.
 
 ``GcraValue`` speaks the same protocol as ``ExpiringValue``
 (value_at / update / ttl / is_expired) by mapping to "spent tokens":
@@ -39,19 +49,67 @@ from .expiring_value import ExpiringValue
 
 __all__ = [
     "GcraValue",
+    "unit_scale",
     "emission_interval_ms",
+    "emission_interval_ticks",
+    "device_eligible",
+    "spent_tokens",
     "cell_for_limit",
     "restore_cell",
 ]
 
 
-def emission_interval_ms(max_value: int, seconds: int) -> int:
-    """Integer emission interval: ms per token, >= 1 (quantization rule)."""
+def unit_scale(max_value: int, seconds: int) -> int:
+    """Ticks per millisecond for one bucket's state — 1 (ms ticks) while
+    the rate fits, then 1000 (µs) and 1_000_000 (ns). Deterministic in
+    the limit alone so every component derives the same unit."""
+    if max_value <= seconds * 1000:
+        return 1
+    if max_value <= seconds * 1_000_000:
+        return 1000
+    return 1_000_000
+
+
+def emission_interval_ticks(max_value: int, seconds: int, scale: int) -> int:
+    """Integer emission interval: ticks per token, >= 1."""
     if max_value <= 0:
         # Degenerate: a zero-capacity bucket admits nothing; the interval
         # is irrelevant but must be positive.
-        return max(seconds * 1000, 1)
-    return max(1, (seconds * 1000) // max_value)
+        return max(seconds * 1000 * scale, 1)
+    return max(1, (seconds * 1000 * scale) // max_value)
+
+
+def emission_interval_ms(max_value: int, seconds: int) -> int:
+    """Millisecond emission interval for DEVICE-tick buckets (scale 1).
+    Only meaningful when ``device_eligible``; the host cell uses
+    ``emission_interval_ticks`` with the limit's own unit."""
+    return emission_interval_ticks(max_value, seconds, 1)
+
+
+def device_eligible(max_value: int, seconds: int, value_cap: int,
+                    window_ms_cap: int) -> bool:
+    """Whether this bucket's TAT fits the device table's int32-ms epoch
+    representation: ms ticks (scale 1), capacity within the int32 value
+    cap, and the full-bucket horizon B*I (the farthest TAT runs ahead of
+    now) within the window cap — the exact analogue of the fixed-window
+    clamps documented in ops/kernel.py."""
+    if unit_scale(max_value, seconds) != 1:
+        return False
+    if max_value > value_cap:
+        return False
+    interval = emission_interval_ms(max_value, seconds)
+    return max_value * interval <= window_ms_cap
+
+
+def spent_tokens(max_value: int, seconds: int, base_rel_ms: int) -> int:
+    """Spent-token count of a DEVICE bucket cell from its observed
+    ``base_rel = max(TAT - now, 0)`` in ms (what ``read_slots`` returns
+    as the ttl lane). The device's values lane is unspecified for bucket
+    cells — every read derives from the TAT."""
+    interval = emission_interval_ms(max_value, seconds)
+    tau = (max_value - 1) * interval
+    available = (tau - base_rel_ms) // interval + 1
+    return max_value - available
 
 
 def cell_for_limit(limit, now: float = 0.0, fresh_window: bool = False):
@@ -69,47 +127,61 @@ def cell_for_limit(limit, now: float = 0.0, fresh_window: bool = False):
 
 def restore_cell(limit, a, b):
     """Rebuild a checkpointed cell from its two persisted scalars:
-    (value, expiry) for fixed windows, (tat_ms, None) for buckets."""
+    (value, expiry) for fixed windows, (tat_ticks, scale) for buckets.
+    Pre-r4 checkpoints stored (tat_ms, None); the ms value converts into
+    whatever unit the limit now derives."""
     if limit.policy == "token_bucket":
-        return GcraValue(limit.max_value, limit.seconds, tat_ms=a)
+        cell = GcraValue(limit.max_value, limit.seconds)
+        saved_scale = b if b else 1
+        if saved_scale == cell.scale:
+            cell.tat = int(a)
+        else:
+            cell.tat = int(a) * cell.scale // saved_scale
+        return cell
     return ExpiringValue(a, b)
 
 
 class GcraValue:
     """One token bucket, protocol-compatible with ExpiringValue."""
 
-    __slots__ = ("interval_ms", "capacity", "tau_ms", "tat_ms")
+    __slots__ = ("scale", "interval", "capacity", "tau", "tat")
 
     POLICY = "token_bucket"
 
     def __init__(self, max_value: int, seconds: int, tat_ms: int = 0):
         self.capacity = int(max_value)
-        self.interval_ms = emission_interval_ms(max_value, seconds)
-        self.tau_ms = (self.capacity - 1) * self.interval_ms
-        self.tat_ms = int(tat_ms)  # 0 = far past = full bucket
+        self.scale = unit_scale(max_value, seconds)
+        self.interval = emission_interval_ticks(max_value, seconds, self.scale)
+        self.tau = (self.capacity - 1) * self.interval
+        self.tat = int(tat_ms) * self.scale  # 0 = far past = full bucket
+
+    def _now_ticks(self, now_s: float) -> int:
+        # float64 keeps ms*scale exact through µs; at ns the ~hundreds-of-ns
+        # rounding is far below any wall clock's real resolution.
+        return int(now_s * (1000 * self.scale))
 
     # -- ExpiringValue protocol -------------------------------------------
 
     def value_at(self, now_s: float) -> int:
         """Spent tokens: capacity - available(now), unclamped above
         capacity so over-committed buckets keep rejecting any delta."""
-        base_rel = max(self.tat_ms - int(now_s * 1000), 0)
-        available = (self.tau_ms - base_rel) // self.interval_ms + 1
+        base_rel = max(self.tat - self._now_ticks(now_s), 0)
+        available = (self.tau - base_rel) // self.interval + 1
         return self.capacity - available
 
     def update(self, delta: int, _window_seconds: int, now_s: float) -> int:
         """Admit ``delta`` tokens (unconditional, like ExpiringValue.update
         — admission is the caller's check): TAT advances by delta*I from
         max(TAT, now). Returns the post-update spent-token count."""
-        now_ms = int(now_s * 1000)
-        self.tat_ms = max(self.tat_ms, now_ms) + delta * self.interval_ms
+        now_ticks = self._now_ticks(now_s)
+        self.tat = max(self.tat, now_ticks) + delta * self.interval
         return self.value_at(now_s)
 
     def ttl(self, now_s: float) -> float:
         """Seconds until the bucket is full again (0 = already full).
         The token-bucket analogue of a window's expires_in."""
-        return max(self.tat_ms - int(now_s * 1000), 0) / 1000.0
+        return max(self.tat - self._now_ticks(now_s), 0) / (1000.0 * self.scale)
 
     def is_expired(self, now_s: float) -> bool:
         """Full bucket == no live state (the expired-window analogue)."""
-        return self.tat_ms <= int(now_s * 1000)
+        return self.tat <= self._now_ticks(now_s)

@@ -37,6 +37,7 @@ from ..observability.metrics import PrometheusMetrics
 from ..storage.base import StorageError
 from .. import native
 from ..ops import kernel as K
+from ..storage.gcra import device_eligible, emission_interval_ms
 from .compiler import NamespaceCompiler
 from .pipeline import CompiledTpuLimiter
 from .storage import TpuStorage
@@ -167,12 +168,19 @@ class NativeRlsPipeline:
         limits = self.limiter.get_limits(namespace)
         compiler = NamespaceCompiler(limits, interner=self._interner)
         native_ok = compiler.fully_vectorized and all(
-            # Beyond-device-cap and token-bucket limits are decided
-            # host-side by the storage's exact fallback, which the
-            # columnar kernel path bypasses — such namespaces take the
-            # exact path.
-            limit.max_value <= K.MAX_VALUE_CAP
-            and limit.policy == "fixed_window"
+            # Limits the storage would route to its exact host fallback
+            # (beyond-device-cap windows, non-ms-tick buckets) bypass the
+            # columnar kernel — such namespaces take the exact path.
+            # Device-eligible token buckets ride the fast path: their
+            # hits carry the GCRA interval + bucket flag to the kernel.
+            (
+                limit.max_value <= K.MAX_VALUE_CAP
+                if limit.policy == "fixed_window"
+                else device_eligible(
+                    limit.max_value, limit.seconds,
+                    K.MAX_VALUE_CAP, K.WINDOW_MS_CAP,
+                )
+            )
             for limit in limits
         )
         if not limits or not native_ok:
@@ -418,6 +426,7 @@ class NativeRlsPipeline:
         hit_windows: List[np.ndarray] = []
         hit_req: List[np.ndarray] = []
         hit_fresh: List[np.ndarray] = []
+        hit_bucket: List[np.ndarray] = []
         hit_name: List[Tuple[object, np.ndarray]] = []  # (limit, local req idx)
         failed_reqs: set = set()  # local idx whose allocation errored
 
@@ -468,15 +477,16 @@ class NativeRlsPipeline:
                 hit_maxes.append(
                     np.full(idx.size, max_value, np.int32)
                 )
-                hit_windows.append(
-                    np.full(
-                        idx.size,
-                        min(window_s * 1000, 2**31 - 2**30 - 2),
-                        np.int32,
-                    )
-                )
+                if limit.policy == "token_bucket":
+                    win = emission_interval_ms(max_value, window_s)
+                    is_bucket = True
+                else:
+                    win = min(window_s * 1000, 2**31 - 2**30 - 2)
+                    is_bucket = False
+                hit_windows.append(np.full(idx.size, win, np.int32))
                 hit_req.append(idx)
                 hit_fresh.append(fresh)
+                hit_bucket.append(np.full(idx.size, is_bucket, bool))
                 hit_name.append((limit, idx))
 
             namespace = str(plan.namespace)
@@ -496,6 +506,7 @@ class NativeRlsPipeline:
             windows = np.concatenate(hit_windows)
             req = np.concatenate(hit_req)
             fresh = np.concatenate(hit_fresh)
+            bucket = np.concatenate(hit_bucket)
             # Kernel req ids must be dense in [0, H): requests without hits
             # don't participate, so compress local indices.
             order = np.argsort(req, kind="stable")
@@ -504,7 +515,7 @@ class NativeRlsPipeline:
             )
             arrays = self.storage.pad_hits(
                 (slots[order], deltas[order], maxes[order], windows[order],
-                 kernel_req.astype(np.int32), fresh[order]),
+                 kernel_req.astype(np.int32), fresh[order], bucket[order]),
                 slots.shape[0],
             )
             inflight = self.storage.begin_check_columnar(*arrays)

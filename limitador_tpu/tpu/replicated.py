@@ -53,7 +53,7 @@ DEFAULT_GOSSIP_PERIOD = 0.1
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
-                      windows_ms, req_ids, fresh, now_ms):
+                      windows_ms, req_ids, fresh, bucket, now_ms):
     """check_and_update over (local + live remote) admission base; only the
     LOCAL cells are written (remote counts belong to their actors)."""
     def base_hook(v_local, s_slot):
@@ -63,7 +63,8 @@ def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
 
     nv, ne, admitted, ok, remaining, ttl = K.check_and_update_core(
         state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
-        req_ids, fresh, now_ms, num_req=slots.shape[0], base_hook=base_hook,
+        req_ids, fresh, bucket, now_ms, num_req=slots.shape[0],
+        base_hook=base_hook,
     )
     return K.CounterTableState(nv, ne), K.BatchResult(admitted, ok, remaining, ttl)
 
@@ -129,7 +130,8 @@ class TpuReplicatedStorage(TpuStorage):
 
     # -- kernel dispatch with remote base ----------------------------------
 
-    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh, now_ms):
+    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh,
+                      bucket, now_ms):
         self._flush_dirty_remote()
         # one vectorized unique, not a python loop over H hits
         self._touched.update(
@@ -137,7 +139,7 @@ class TpuReplicatedStorage(TpuStorage):
         )
         state, result = _replicated_check(
             self._state, self._remote_vals, self._remote_exp,
-            slots, deltas, maxes, windows, req, fresh, now_ms,
+            slots, deltas, maxes, windows, req, fresh, bucket, now_ms,
         )
         return state, result
 

@@ -40,7 +40,7 @@ from ..storage.base import (
     StorageError,
     require_nonnegative_delta,
 )
-from ..storage.expiring_value import ExpiringValue
+from ..storage.gcra import GcraValue, restore_cell, spent_tokens
 from ..ops import kernel as K
 from ..parallel.mesh import (
     ShardedCounterState,
@@ -53,6 +53,7 @@ from .storage import (
     _BigLimitMixin,
     _bucket,
     _clamp_window_ms,
+    _hit_lane,
     _Request,
     _SlotTable,
 )
@@ -68,7 +69,18 @@ def _stable_hash(key: tuple) -> int:
 
 
 class TpuShardedStorage(_BigLimitMixin, CounterStorage):
-    supports_token_bucket = True  # node-local exact host path (mixin)
+    supports_token_bucket = True  # device bucket lane / exact host path
+
+    def _is_big(self, counter: Counter) -> bool:
+        # A TAT cell cannot be a psum global partial: token buckets in
+        # global namespaces stay on the node-local exact host path.
+        # Owner-sharded buckets ride the device lane like any counter.
+        if (
+            counter.limit.policy == "token_bucket"
+            and counter.namespace in self._global_ns
+        ):
+            return True
+        return _BigLimitMixin._is_big(counter)
 
     def __init__(
         self,
@@ -230,9 +242,10 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()
-            # rows: (slot, delta, max, window_ms, req_id, fresh, is_global)
+            # rows: (slot, delta, max, window_ms, req_id, fresh, bucket,
+            #        is_global)
             per_shard: List[
-                List[Tuple[int, int, int, int, int, bool, bool]]
+                List[Tuple[int, int, int, int, int, bool, bool, bool]]
             ] = [[] for _ in range(n)]
             # per request: hit locations [(shard, pos_in_shard)], in order
             locs_by_req: List[List[Tuple[int, int]]] = []
@@ -264,13 +277,15 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     row = per_shard[shard]
                     locs.append((shard, len(row)))
                     dev_j.append((j, adjust))
+                    win, is_bucket = _hit_lane(c)
                     row.append((
                         slot,
                         dev_delta,
                         min(c.max_value, K.MAX_VALUE_CAP),
-                        _clamp_window_ms(c.window_seconds),
+                        win,
                         r,
                         is_fresh,
+                        is_bucket,
                         is_g,
                     ))
                     use = (1 if is_g else 0, slot if is_g else shard, slot)
@@ -295,6 +310,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             windows = np.zeros((n, H), np.int32)
             req_ids = np.full((n, H), n * H - 1, np.int32)
             fresh = np.zeros((n, H), bool)
+            bucket = np.zeros((n, H), bool)
             is_global = np.zeros((n, H), bool)
             for s in range(n):
                 rows = per_shard[s]
@@ -311,12 +327,13 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 windows[s, :m] = cols[3]
                 req_ids[s, :m] = cols[4]
                 fresh[s, :m] = cols[5]
-                is_global[s, :m] = cols[6]
+                bucket[s, :m] = cols[6]
+                is_global[s, :m] = cols[7]
 
             try:
                 self._state, result = sharded_check_and_update(
                     self._mesh, self._state, slots, deltas, maxes, windows,
-                    req_ids, fresh, is_global, np.int32(now_ms),
+                    req_ids, fresh, bucket, is_global, np.int32(now_ms),
                     global_region=self._global_region,
                 )
                 admitted, hit_ok, remaining, ttl_ms = jax.device_get((
@@ -416,7 +433,13 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             if slot is None:
                 value = 0
             else:
-                value, _ttl = self._read_value(shard, slot, is_g, now_ms)
+                value, ttl = self._read_value(shard, slot, is_g, now_ms)
+                if counter.limit.policy == "token_bucket":
+                    # Bucket cells: ttl is base_rel = max(TAT - now, 0);
+                    # spent tokens derive from it (values lane unspecified).
+                    value = spent_tokens(
+                        counter.max_value, counter.window_seconds, ttl
+                    )
         return value + delta <= counter.max_value
 
     def add_counter(self, limit: Limit) -> None:
@@ -449,11 +472,11 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()
-            # rows: (slot, delta, window_ms, fresh)
-            per_shard: List[List[Tuple[int, int, int, bool]]] = [
+            # rows: (slot, delta, window_ms, fresh, bucket)
+            per_shard: List[List[Tuple[int, int, int, bool, bool]]] = [
                 [] for _ in range(self._n)
             ]
-            # loc: (shard, slot, is_global) or ("big", value, ttl) resolved
+            # loc: (shard, slot, is_global, counter) or ("big", value, ttl)
             locs: List[tuple] = []
             for counter, delta in items:
                 if self._is_big(counter):
@@ -467,19 +490,22 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     counter, create=True
                 )
                 app = self._app_shard() if is_g else shard
+                win, is_bucket = _hit_lane(counter)
                 per_shard[app].append((
                     slot,
                     min(int(delta), K.MAX_DELTA_CAP),
-                    _clamp_window_ms(counter.window_seconds),
+                    win,
                     is_fresh,
+                    is_bucket,
                 ))
-                locs.append((shard, slot, is_g))
+                locs.append((shard, slot, is_g, counter))
             n = self._n
             H = _bucket(max(max(len(p) for p in per_shard), 1))
             slots = np.full((n, H), self._scratch, np.int32)
             deltas = np.zeros((n, H), np.int32)
             windows = np.zeros((n, H), np.int32)
             fresh = np.zeros((n, H), bool)
+            bucket = np.zeros((n, H), bool)
             for s in range(n):
                 rows = per_shard[s]
                 if not rows:
@@ -490,20 +516,21 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 deltas[s, :m] = cols[1]
                 windows[s, :m] = cols[2]
                 fresh[s, :m] = cols[3]
+                bucket[s, :m] = cols[4]
             self._state = sharded_update(
                 self._mesh, self._state, slots, deltas, windows, fresh,
-                np.int32(now_ms),
+                bucket, np.int32(now_ms),
             )
             # Batched authoritative reads: one gather per slot family.
             dev_locs = [loc for loc in locs if loc[0] != "big"]
             lsh = np.asarray(
-                [s for s, _sl, g in dev_locs if not g], np.int32
+                [s for s, _sl, g, _c in dev_locs if not g], np.int32
             )
             lsl = np.asarray(
-                [sl for _s, sl, g in dev_locs if not g], np.int32
+                [sl for _s, sl, g, _c in dev_locs if not g], np.int32
             )
             gsl = np.asarray(
-                sorted({sl for _s, sl, g in dev_locs if g}), np.int32
+                sorted({sl for _s, sl, g, _c in dev_locs if g}), np.int32
             )
             lv = le = gv = ge = None
             if lsh.size:
@@ -520,7 +547,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     _tag, value, ttl_s = loc
                     out.append((value, ttl_s))
                     continue
-                shard, slot, is_g = loc
+                shard, slot, is_g, counter = loc
                 if is_g:
                     col = gpos[slot]
                     live = ge[:, col] > now_ms
@@ -530,8 +557,13 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                         if live.any() else 0
                     )
                 else:
-                    value = int(lv[li]) if le[li] > now_ms else 0
                     ttl = max(int(le[li]) - now_ms, 0)
+                    if counter.limit.policy == "token_bucket":
+                        value = spent_tokens(
+                            counter.max_value, counter.window_seconds, ttl
+                        )
+                    else:
+                        value = int(lv[li]) if le[li] > now_ms else 0
                     li += 1
                 out.append((value, ttl / 1000.0))
         return out
@@ -576,7 +608,12 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     if ttl <= 0:
                         continue
                     c = counter.key()
-                    c.remaining = c.max_value - int(lv[i])
+                    if c.limit.policy == "token_bucket":
+                        c.remaining = c.max_value - spent_tokens(
+                            c.max_value, c.window_seconds, ttl
+                        )
+                    else:
+                        c.remaining = c.max_value - int(lv[i])
                     c.expires_in = ttl / 1000.0
                     out.add(c)
             self._emit_big_counters(limits, namespaces, self._clock(), out)
@@ -660,7 +697,11 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 "tables": [t.dump() for t in self._tables],
                 "gtable": self._gtable.dump(),
                 "big": {
-                    key: (cell.value_raw, cell.expiry, counter)
+                    key: (
+                        (cell.tat, cell.scale, counter)
+                        if isinstance(cell, GcraValue)
+                        else (cell.value_raw, cell.expiry, counter)
+                    )
                     for key, (cell, counter) in self._big.items()
                 },
             }
@@ -707,7 +748,9 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             table.load(dump, self._global_region, self._local_capacity)
         self._gtable.load(data["gtable"], 0, self._global_region)
         for key, (value, exp, counter) in data.get("big", {}).items():
-            self._big[key] = (ExpiringValue(value, exp), counter)
+            self._big[key] = (
+                restore_cell(counter.limit, value, exp), counter
+            )
         return self
 
     def close(self) -> None:

@@ -41,7 +41,14 @@ from ..storage.base import (
     require_nonnegative_delta,
 )
 from ..storage.expiring_value import ExpiringValue
-from ..storage.gcra import GcraValue, cell_for_limit, restore_cell
+from ..storage.gcra import (
+    GcraValue,
+    cell_for_limit,
+    device_eligible,
+    emission_interval_ms,
+    restore_cell,
+    spent_tokens,
+)
 from ..ops import kernel as K
 
 __all__ = ["TpuStorage"]
@@ -59,6 +66,16 @@ def _bucket(n: int, floor: int = 8) -> int:
 
 def _clamp_window_ms(seconds: int) -> int:
     return min(seconds * 1000, K.WINDOW_MS_CAP)
+
+
+def _hit_lane(counter: Counter) -> Tuple[int, bool]:
+    """Per-hit (windows_ms lane, bucket flag) for a device-eligible
+    counter: the window for fixed windows, the GCRA emission interval
+    for token buckets (ops/kernel.py bucket lane)."""
+    limit = counter.limit
+    if limit.policy == "token_bucket":
+        return emission_interval_ms(limit.max_value, limit.seconds), True
+    return _clamp_window_ms(counter.window_seconds), False
 
 
 def _migrate_key(key):
@@ -162,13 +179,16 @@ class _BigLimitMixin:
 
     @staticmethod
     def _is_big(counter: Counter) -> bool:
-        # Token-bucket counters ride the same exact host path as
-        # beyond-cap limits: coupled all-or-nothing into batch
-        # admission, arbitrary-precision Python ints.
-        return (
-            counter.max_value > K.MAX_VALUE_CAP
-            or counter.limit.policy == "token_bucket"
-        )
+        # Token buckets run ON DEVICE (a TAT cell in the expiry lane,
+        # ops/kernel.py bucket lane) whenever the int32-ms representation
+        # fits; only finer-tick / beyond-cap buckets ride the exact host
+        # path, same as beyond-cap fixed windows.
+        if counter.limit.policy == "token_bucket":
+            return not device_eligible(
+                counter.max_value, counter.window_seconds,
+                K.MAX_VALUE_CAP, K.WINDOW_MS_CAP,
+            )
+        return counter.max_value > K.MAX_VALUE_CAP
 
     def _big_cell(self, counter: Counter, key: tuple) -> ExpiringValue:
         entry = self._big.get(key)
@@ -403,11 +423,13 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
 
     # -- the shared batched check path -------------------------------------
 
-    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh, now_ms):
+    def _kernel_check(self, slots, deltas, maxes, windows, req, fresh,
+                      bucket, now_ms):
         """Kernel dispatch point; the replicated subclass swaps in a kernel
         that folds remote (gossiped) counts into the admission base."""
         return K.check_and_update_batch(
-            self._state, slots, deltas, maxes, windows, req, fresh, now_ms
+            self._state, slots, deltas, maxes, windows, req, fresh, bucket,
+            now_ms,
         )
 
     def begin_check_many(self, requests: List[_Request]) -> _CheckHandle:
@@ -432,6 +454,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         windows_l: List[int] = []
         req_l: List[int] = []
         fresh_l: List[bool] = []
+        bucket_l: List[bool] = []
 
         with self._lock:
             now_ms = self._now_ms()
@@ -461,12 +484,14 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     if self._is_big(c):
                         continue
                     slot, is_fresh = slot_for(c, create=True)
+                    win, is_bucket = _hit_lane(c)
                     slots_l.append(slot)
                     deltas_l.append(dev_delta)
                     maxes_l.append(min(c.max_value, K.MAX_VALUE_CAP))
-                    windows_l.append(_clamp_window_ms(c.window_seconds))
+                    windows_l.append(win)
                     req_l.append(r)
                     fresh_l.append(is_fresh)
+                    bucket_l.append(is_bucket)
                     slot_use_count[slot] = slot_use_count.get(slot, 0) + 1
                     dev_info.append((j, adjust))
                     if is_fresh:
@@ -492,9 +517,11 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             windows = np.asarray(windows_l + [0] * pad, np.int32)
             req = np.asarray(req_l + [H - 1] * pad, np.int32)
             fresh = np.asarray(fresh_l + [False] * pad, bool)
+            bucket = np.asarray(bucket_l + [False] * pad, bool)
 
             self._state, result = self._kernel_check(
-                slots, deltas, maxes, windows, req, fresh, np.int32(now_ms)
+                slots, deltas, maxes, windows, req, fresh, bucket,
+                np.int32(now_ms),
             )
         return _CheckHandle(
             requests, fresh_hits_by_req, slot_use_count, result, seq,
@@ -608,10 +635,18 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             if slot is None:
                 value = 0
             else:
-                v, _ttl = K.read_slots(
+                v, ttl = K.read_slots(
                     self._state, np.asarray([slot], np.int32), np.int32(now_ms)
                 )
-                value = int(v[0])
+                if counter.limit.policy == "token_bucket":
+                    # Bucket cells: the ttl lane is base_rel = max(TAT-now,
+                    # 0); spent tokens derive from it (values lane is
+                    # unspecified for buckets).
+                    value = spent_tokens(
+                        counter.max_value, counter.window_seconds, int(ttl[0])
+                    )
+                else:
+                    value = int(v[0])
         return value + delta <= counter.max_value
 
     def add_counter(self, limit: Limit) -> None:
@@ -639,12 +674,16 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             deltas = np.zeros(H, np.int32)
             windows = np.zeros(H, np.int32)
             fresh = np.zeros(H, bool)
+            bucket = np.zeros(H, bool)
+            win, is_bucket = _hit_lane(counter)
             slots[0] = slot
             deltas[0] = min(int(delta), K.MAX_DELTA_CAP)
-            windows[0] = _clamp_window_ms(counter.window_seconds)
+            windows[0] = win
             fresh[0] = is_fresh
+            bucket[0] = is_bucket
             self._state = K.update_batch(
-                self._state, slots, deltas, windows, fresh, np.int32(now_ms)
+                self._state, slots, deltas, windows, fresh, bucket,
+                np.int32(now_ms),
             )
 
     def check_and_update(
@@ -664,6 +703,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         windows_ms: np.ndarray,
         req_ids: np.ndarray,
         fresh: np.ndarray,
+        bucket: Optional[np.ndarray] = None,
     ):
         """Run one kernel over pre-built, request-ordered hit arrays (no
         per-hit Python objects). Caller pads to a bucket (use
@@ -671,7 +711,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         ttl_ms)."""
         return self.finish_check_columnar(
             self.begin_check_columnar(
-                slots, deltas, maxes, windows_ms, req_ids, fresh
+                slots, deltas, maxes, windows_ms, req_ids, fresh, bucket
             )
         )
 
@@ -683,6 +723,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         windows_ms: np.ndarray,
         req_ids: np.ndarray,
         fresh: np.ndarray,
+        bucket: Optional[np.ndarray] = None,
     ):
         """Launch the columnar kernel and return the in-flight device
         result (JAX async dispatch: this does not block on the device).
@@ -690,12 +731,17 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         the storage lock; the state array threads through launches, so a
         later begin is correct even while earlier results are still in
         flight — this is what lets a caller overlap batch N's device
-        round trip with batch N+1's host work."""
+        round trip with batch N+1's host work.
+
+        ``bucket`` marks GCRA hits (``windows_ms`` then carries the
+        emission interval); None means all fixed-window."""
+        if bucket is None:
+            bucket = np.zeros(slots.shape, bool)
         with self._lock:
             now_ms = self._now_ms()
             self._state, result = K.check_and_update_batch(
                 self._state, slots, deltas, maxes, windows_ms, req_ids,
-                fresh, np.int32(now_ms),
+                fresh, bucket, np.int32(now_ms),
             )
             return result
 
@@ -718,12 +764,12 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         )
 
     def pad_hits(self, arrays: Tuple[np.ndarray, ...], nhits: int):
-        """Pad (slots, deltas, maxes, windows, req_ids, fresh) to the next
-        bucket with inert scratch hits."""
+        """Pad (slots, deltas, maxes, windows, req_ids, fresh[, bucket])
+        to the next bucket with inert scratch hits."""
         H = _bucket(max(nhits, 1))
         pad = H - nhits
-        slots, deltas, maxes, windows, req, fresh = arrays
-        return (
+        slots, deltas, maxes, windows, req, fresh = arrays[:6]
+        padded = (
             np.concatenate([slots, np.full(pad, self._scratch, np.int32)]),
             np.concatenate([deltas, np.zeros(pad, np.int32)]),
             np.concatenate([maxes, np.full(pad, _INT32_MAX, np.int32)]),
@@ -731,6 +777,9 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             np.concatenate([req, np.full(pad, H - 1, np.int32)]),
             np.concatenate([fresh, np.zeros(pad, bool)]),
         )
+        if len(arrays) > 6:
+            padded += (np.concatenate([arrays[6], np.zeros(pad, bool)]),)
+        return padded
 
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
         out: Set[Counter] = set()
@@ -756,9 +805,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 for i, (_slot, counter) in enumerate(matching):
                     ttl_ms = int(ttls[i])
                     if ttl_ms <= 0:
+                        # fixed window expired / bucket full: no live state
                         continue
                     c = counter.key()
-                    c.remaining = c.max_value - int(values[i])
+                    if c.limit.policy == "token_bucket":
+                        c.remaining = c.max_value - spent_tokens(
+                            c.max_value, c.window_seconds, ttl_ms
+                        )
+                    else:
+                        c.remaining = c.max_value - int(values[i])
                     c.expires_in = ttl_ms / 1000.0
                     out.add(c)
             self._emit_big_counters(limits, namespaces, now, out)
@@ -813,14 +868,17 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 deltas = np.zeros(H, np.int32)
                 windows = np.zeros(H, np.int32)
                 fresh = np.zeros(H, bool)
+                bucket = np.zeros(H, bool)
                 for k, (_i, counter, delta) in enumerate(dev_items):
                     slot, is_fresh = self._slot_for(counter, create=True)
+                    win, is_bucket = _hit_lane(counter)
                     slots[k] = slot
                     deltas[k] = min(int(delta), K.MAX_DELTA_CAP)
-                    windows[k] = _clamp_window_ms(counter.window_seconds)
+                    windows[k] = win
                     fresh[k] = is_fresh
+                    bucket[k] = is_bucket
                 self._state = K.update_batch(
-                    self._state, slots, deltas, windows, fresh,
+                    self._state, slots, deltas, windows, fresh, bucket,
                     np.int32(now_ms),
                 )
                 values, ttls = K.read_slots(
@@ -828,8 +886,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 )
                 values = np.asarray(values)
                 ttls = np.asarray(ttls)
-                for k, (i, _counter, _delta) in enumerate(dev_items):
-                    results[i] = (int(values[k]), float(ttls[k]) / 1000.0)
+                for k, (i, counter, _delta) in enumerate(dev_items):
+                    if bucket[k]:
+                        value = spent_tokens(
+                            counter.max_value, counter.window_seconds,
+                            int(ttls[k]),
+                        )
+                    else:
+                        value = int(values[k])
+                    results[i] = (value, float(ttls[k]) / 1000.0)
         return results
 
     # -- checkpoint / resume (SURVEY.md §5) ---------------------------------
@@ -860,7 +925,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 **self._table.dump(),
                 "big": {
                     key: (
-                        (cell.tat_ms, None, counter)
+                        (cell.tat, cell.scale, counter)
                         if isinstance(cell, GcraValue)
                         else (cell.value_raw, cell.expiry, counter)
                     )

@@ -301,13 +301,16 @@ struct Stream {
   bool headers_done = false;
   bool end_stream = false;
   bool responded = false;
+  bool streaming = false;         // registered bidi-stream path (reflection)
+  bool resp_headers_sent = false; // streaming: response HEADERS emitted
   int64_t send_win = 65535;
 };
 
-struct Parked {  // DATA+trailers waiting for send window
+struct Parked {  // DATA (+optional trailers) waiting for send window
   uint32_t stream;
   std::string data_payload;   // grpc-framed message (DATA frame payload)
-  std::string trailer_frame;  // fully framed trailers HEADERS
+  std::string trailer_frame;  // fully framed trailers HEADERS ("" = none)
+  bool close_stream = true;   // erase the stream after this item
 };
 
 struct Conn {
@@ -334,6 +337,7 @@ struct InflightReq {
   uint32_t stream;
   std::string payload;
   std::string path;  // ":path"; the app routes non-target methods
+  bool streaming = false;  // answer keeps the stream open (status -1 closes)
 };
 
 struct Resp {
@@ -348,6 +352,7 @@ struct Ctx {
   int wake_fd = -1;
   int port = 0;
   std::string target_path;
+  std::string stream_path;  // bidi-stream method ("" = none registered)
   std::thread io;
   std::atomic<bool> stop{false};
 
@@ -438,8 +443,8 @@ void drain_parked(Conn* conn) {
       conn->send_win -= (int64_t)chunk;
       st.send_win -= (int64_t)chunk;
     }
-    conn->wbuf += p.trailer_frame;
-    conn->streams.erase(it);
+    if (!p.trailer_frame.empty()) conn->wbuf += p.trailer_frame;
+    if (p.close_stream) conn->streams.erase(it);
     conn->parked.pop_front();
   }
 }
@@ -486,9 +491,110 @@ void write_response(Conn* conn, uint32_t stream, int status,
   }
 }
 
+// Streaming (bidi) responses: HEADERS once, then one grpc-framed DATA per
+// message through the parked queue WITHOUT trailers; close writes the
+// trailers (or a trailers-only error) and retires the stream.
+void ensure_stream_headers(Conn* conn, uint32_t sid, Stream* st) {
+  if (st->resp_headers_sent) return;
+  st->resp_headers_sent = true;
+  std::string hb;
+  hb.push_back((char)0x88);  // :status 200 (static 8)
+  put_literal(&hb, "content-type", "application/grpc");
+  put_frame_header(&conn->wbuf, hb.size(), F_HEADERS, FL_END_HEADERS, sid);
+  conn->wbuf += hb;
+}
+
+void write_stream_msg(Conn* conn, uint32_t sid, const std::string& payload) {
+  auto it = conn->streams.find(sid);
+  if (it == conn->streams.end()) return;  // reset while in flight
+  ensure_stream_headers(conn, sid, &it->second);
+  std::string data;
+  data.push_back((char)0);  // uncompressed
+  put_u32(&data, (uint32_t)payload.size());
+  data += payload;
+  conn->parked.push_back(Parked{sid, std::move(data), "", false});
+  drain_parked(conn);
+}
+
+void write_stream_close(Conn* conn, uint32_t sid, int status,
+                        const std::string& msg) {
+  auto it = conn->streams.find(sid);
+  if (it == conn->streams.end()) return;
+  Stream& st = it->second;
+  if (!st.resp_headers_sent && status != 0) {
+    write_response(conn, sid, status, msg);  // trailers-only error
+    return;
+  }
+  ensure_stream_headers(conn, sid, &st);
+  std::string tb;
+  put_literal(&tb, "grpc-status", std::to_string(status));
+  if (status != 0 && !msg.empty() && msg.size() < 120)
+    put_literal(&tb, "grpc-message", sanitize_field_value(msg));
+  std::string tf;
+  put_frame_header(&tf, tb.size(), F_HEADERS,
+                   FL_END_HEADERS | FL_END_STREAM, sid);
+  tf += tb;
+  conn->parked.push_back(Parked{sid, "", std::move(tf), true});
+  drain_parked(conn);
+}
+
+// Queue one stream event for the app. Messages carry the stream path;
+// the client's half-close arrives as path + "#eos" with an empty payload
+// (the app answers it with status -1 = "close the stream OK").
+void deliver_stream_event(Ctx* c, Conn* conn, uint32_t sid,
+                          std::string payload, bool eos) {
+  uint64_t rid;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    rid = c->next_rid++;
+    c->inflight.emplace(
+        rid, InflightReq{conn->id, sid, std::move(payload),
+                         eos ? c->stream_path + "#eos" : c->stream_path,
+                         true});
+    c->ready.push_back(rid);
+  }
+  c->stat_reqs++;
+  c->cv.notify_all();
+}
+
+// Extract complete grpc frames from a streaming upload; returns false
+// when the stream was answered with an error (caller stops processing).
+bool pump_stream_msgs(Ctx* c, Conn* conn, uint32_t sid, Stream* st) {
+  while (st->body.size() >= 5) {
+    if (st->body[0] != 0) {
+      st->responded = true;
+      write_response(conn, sid, 12, "compression not supported");
+      return false;
+    }
+    uint32_t mlen = ((uint8_t)st->body[1] << 24) |
+                    ((uint8_t)st->body[2] << 16) |
+                    ((uint8_t)st->body[3] << 8) | (uint8_t)st->body[4];
+    if ((size_t)mlen > MAX_BODY) {
+      st->responded = true;
+      write_response(conn, sid, 8, "message too large");  // RESOURCE_EXHAUSTED
+      return false;
+    }
+    if (st->body.size() < 5 + (size_t)mlen) break;  // partial frame
+    deliver_stream_event(c, conn, sid, st->body.substr(5, mlen), false);
+    st->body.erase(0, 5 + (size_t)mlen);
+  }
+  return true;
+}
+
 // A stream finished uploading: route it.
 void complete_stream(Ctx* c, Conn* conn, uint32_t sid, Stream* st) {
   if (st->responded) return;
+  if (st->streaming) {
+    // Half-close on a bidi stream: any complete frames were already
+    // delivered on arrival; leftover bytes are a framing error.
+    st->responded = true;
+    if (!st->body.empty()) {
+      write_response(conn, sid, 13, "bad grpc frame length");  // INTERNAL
+      return;
+    }
+    deliver_stream_event(c, conn, sid, "", true);
+    return;
+  }
   st->responded = true;
   if (st->body.size() < 5 || st->body[0] != 0) {
     write_response(conn, sid, 12,
@@ -532,6 +638,7 @@ void on_headers_block(Ctx* c, Conn* conn, uint32_t sid, uint8_t flags,
     st.send_win = conn->initial_stream_win;
     for (auto& h : headers)
       if (h.name == ":path") st.path = h.value;
+    st.streaming = !c->stream_path.empty() && st.path == c->stream_path;
   }
   // else: request trailers — decoded for HPACK consistency, nothing kept.
   if (flags & FL_END_STREAM) {
@@ -643,7 +750,15 @@ void handle_frame(Ctx* c, Conn* conn, uint8_t type, uint8_t flags,
           goaway(c, conn, 11);
           return;
         }
-        if (flags & FL_END_STREAM) {
+        // Bidi-stream path: complete messages dispatch on ARRIVAL (the
+        // client keeps the stream open awaiting answers — buffering to
+        // END_STREAM would deadlock well-behaved reflection clients).
+        // On a framing error pump_stream_msgs answers inline (which may
+        // erase the stream — `st` is then dead); fall through so the
+        // connection window refill below still runs.
+        bool stream_ok = true;
+        if (st.streaming) stream_ok = pump_stream_msgs(c, conn, sid, &st);
+        if (stream_ok && (flags & FL_END_STREAM)) {
           st.end_stream = true;
           complete_stream(c, conn, sid, &st);
           // complete_stream can answer inline (unknown method, bad grpc
@@ -774,7 +889,17 @@ void drain_responses(Ctx* c) {
     if (cit == c->conns.end()) continue;  // peer went away
     Conn* conn = cit->second;
     if (conn->dead) continue;
-    write_response(conn, req.stream, r.status, r.payload);
+    if (req.streaming) {
+      // status 0 = one response message (stream stays open);
+      // status -1 = clean close; status >0 = error close.
+      if (r.status == 0)
+        write_stream_msg(conn, req.stream, r.payload);
+      else
+        write_stream_close(conn, req.stream,
+                           r.status < 0 ? 0 : r.status, r.payload);
+    } else {
+      write_response(conn, req.stream, r.status, r.payload);
+    }
     c->stat_resps++;
   }
   // Flush every conn we touched (cheap: flush all with pending bytes).
@@ -833,9 +958,11 @@ void io_loop(Ctx* c) {
 
 extern "C" {
 
-void* h2i_create(const char* host, int port, const char* target_path) {
+void* h2i_create(const char* host, int port, const char* target_path,
+                 const char* stream_path) {
   Ctx* c = new Ctx();
   c->target_path = target_path;
+  if (stream_path != nullptr) c->stream_path = stream_path;
   c->listen_fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
   if (c->listen_fd < 0) {
     delete c;

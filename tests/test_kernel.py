@@ -5,18 +5,22 @@ import numpy as np
 from limitador_tpu.ops import kernel as K
 
 
-def _update(state, slots, deltas, windows=None, fresh=None, now_ms=1000):
+def _update(state, slots, deltas, windows=None, fresh=None, now_ms=1000,
+            bucket=None):
     H = len(slots)
     if windows is None:
         windows = np.full(H, 60_000, np.int32)
     if fresh is None:
         fresh = np.zeros(H, bool)
+    if bucket is None:
+        bucket = np.zeros(H, bool)
     return K.update_batch(
         state,
         np.asarray(slots, np.int32),
         np.asarray(deltas, np.int32),
         np.asarray(windows, np.int32),
         np.asarray(fresh, bool),
+        np.asarray(bucket, bool),
         np.int32(now_ms),
     )
 
@@ -101,7 +105,7 @@ def test_sparse_snapshot_size_scales_with_live_counters(tmp_path):
 
 
 def _check(state, slots, deltas, maxes, now_ms=1000, windows=None,
-           fresh=None, req_ids=None):
+           fresh=None, req_ids=None, bucket=None):
     H = len(slots)
     if windows is None:
         windows = np.full(H, 60_000, np.int32)
@@ -109,6 +113,8 @@ def _check(state, slots, deltas, maxes, now_ms=1000, windows=None,
         fresh = np.zeros(H, bool)
     if req_ids is None:
         req_ids = np.arange(H, dtype=np.int32)
+    if bucket is None:
+        bucket = np.zeros(H, bool)
     return K.check_and_update_batch(
         state,
         np.asarray(slots, np.int32),
@@ -117,6 +123,7 @@ def _check(state, slots, deltas, maxes, now_ms=1000, windows=None,
         np.asarray(windows, np.int32),
         np.asarray(req_ids, np.int32),
         np.asarray(fresh, bool),
+        np.asarray(bucket, bool),
         np.int32(now_ms),
     )
 

@@ -23,6 +23,7 @@ def _empty_batch(n, h, scratch):
         windows_ms=np.zeros((n, h), np.int32),
         req_ids=np.full((n, h), n * h - 1, np.int32),
         fresh=np.zeros((n, h), bool),
+        bucket=np.zeros((n, h), bool),
         is_global=np.zeros((n, h), bool),
     )
 

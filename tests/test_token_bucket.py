@@ -44,11 +44,35 @@ TB = dict(conditions=[], variables=["descriptors[0].u"],
 def test_emission_interval_quantization():
     assert emission_interval_ms(5, 1) == 200
     assert emission_interval_ms(1000, 1) == 1
-    # sub-ms rates quantize to 1ms/token (documented: max sustained
-    # device/host rate is 1000 tokens/s/key)
-    assert emission_interval_ms(10**6, 1) == 1
     assert emission_interval_ms(100, 60) == 600
     assert emission_interval_ms(0, 60) == 60_000
+
+
+def test_unit_scale_follows_rate():
+    """Sub-ms rates move to finer ticks instead of clamping at 1000/s
+    (ADVICE r3: a 10000/1s bucket must refill at 10000/s, not 1000/s)."""
+    from limitador_tpu.storage.gcra import unit_scale
+
+    assert unit_scale(1000, 1) == 1          # ms ticks
+    assert unit_scale(10_000, 1) == 1000     # µs ticks
+    assert unit_scale(10**6, 1) == 1000
+    assert unit_scale(10**7, 1) == 1_000_000  # ns ticks
+    assert unit_scale(60_000, 60) == 1       # 1000/s sustained fits ms
+
+    # A 10000/1s bucket: burst 10000, then sustained 10000/s — one
+    # second later the bucket must be FULL again, not 10% refilled.
+    cell = GcraValue(10_000, 1)
+    t = 1000.0
+    cell.update(10_000, 1, t)
+    assert cell.value_at(t) + 1 > 10_000  # empty
+    assert cell.value_at(t + 1.0) == 0    # fully refilled after 1s
+    # and half-full after half a second (not 500 tokens = 5%)
+    assert cell.value_at(t + 0.5) == pytest.approx(5000, abs=1)
+
+
+def test_beyond_ns_rate_warns():
+    with pytest.warns(UserWarning, match="1e9 tokens/s"):
+        Limit("ns", 2 * 10**9, 1, policy="token_bucket")
 
 
 def test_burst_exactly_capacity_then_refill_cadence():
@@ -151,6 +175,124 @@ def test_randomized_parity_oracle_vs_tpu(seed):
             dt = float(rng.random())
             clk_a.t += dt
             clk_b.t += dt
+
+
+def test_small_buckets_live_on_device_not_host():
+    """r4: device-eligible buckets get device slots (the kernel's TAT
+    lane), not host big-cells — the flagship config-4 path."""
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("tb", 5, 1, **TB))
+    rl.check_rate_limited_and_update("tb", ctx_for(), 2)
+    assert len(storage._big) == 0          # nothing on the host path
+    assert len(storage._table.qualified) == 1  # one device slot
+
+
+def test_high_rate_buckets_route_to_exact_host_path():
+    """µs/ns-tick buckets can't share the device's ms epoch: they stay
+    host-side and still count exactly."""
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("fast", 5000, 1, **TB))  # 5000/s -> µs ticks
+    got = [rl.check_rate_limited_and_update(
+        "fast", ctx_for(), 1000).limited for _ in range(7)]
+    assert got == [False] * 5 + [True] * 2
+    assert len(storage._big) == 1          # host cell
+    assert len(storage._table.qualified) == 0
+    clk.t += 0.2  # 1000 tokens back at 5000/s
+    assert not rl.check_rate_limited_and_update(
+        "fast", ctx_for(), 1000).limited
+    assert rl.check_rate_limited_and_update("fast", ctx_for(), 1).limited
+
+
+def test_device_bucket_update_counter_and_apply_deltas():
+    """The unconditional Report path advances the device TAT (update_core
+    bucket lane) and reads back spent tokens from it."""
+    from limitador_tpu.core.counter import Counter
+
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    limit = Limit("tb", 10, 1, **TB)  # I=100ms
+    c = Counter(limit, {"u": "a"})
+    storage.update_counter(c, 4)
+    assert storage.is_within_limits(c, 6)      # 4 spent + 6 == capacity
+    assert not storage.is_within_limits(c, 7)
+    out = storage.apply_deltas([(c, 3)])
+    assert out[0][0] == 7                      # spent after apply
+    assert out[0][1] == pytest.approx(0.7)     # time-to-full
+    clk.t += 0.2  # 2 tokens refill
+    assert storage.is_within_limits(c, 5)
+    assert not storage.is_within_limits(c, 6)
+
+
+def test_device_bucket_overcommit_keeps_rejecting_until_refill():
+    """Unconditional updates can push spent beyond capacity; admission
+    must reject everything until the TAT decays."""
+    from limitador_tpu.core.counter import Counter
+
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    limit = Limit("tb", 5, 1, **TB)  # I=200ms
+    c = Counter(limit, {"u": "a"})
+    storage.update_counter(c, 8)  # 3 beyond capacity
+
+    def limited():
+        return storage.check_and_update([c], 1, False).limited
+
+    assert limited()
+    clk.t += 0.6  # TAT decays 3 tokens: exactly full again, 0 available
+    assert limited()
+    clk.t += 0.2  # one token available
+    assert not limited()
+    assert limited()
+
+
+def test_sharded_device_bucket_burst_and_refill():
+    """Token buckets ride the sharded device lane (owner-sharded)."""
+    import jax
+
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    clk = Clock()
+    storage = TpuShardedStorage(
+        local_capacity=1 << 10, global_region=16, clock=clk
+    )
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("tb", 3, 1, **TB))
+    for user in ("a", "b"):
+        got = [rl.check_rate_limited_and_update(
+            "tb", ctx_for(user), 1).limited for _ in range(4)]
+        assert got == [False, False, False, True], user
+    assert len(storage._big) == 0
+    clk.t += 0.4  # one token back (I=333ms)
+    assert not rl.check_rate_limited_and_update("tb", ctx_for("a"), 1).limited
+    assert rl.check_rate_limited_and_update("tb", ctx_for("a"), 1).limited
+
+
+def test_sharded_global_namespace_bucket_stays_host_side():
+    """A TAT can't be a psum partial: global-namespace buckets use the
+    node-local exact path (documented topology rule)."""
+    import jax
+
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    clk = Clock()
+    storage = TpuShardedStorage(
+        local_capacity=1 << 10, global_region=16,
+        global_namespaces=["gtb"], clock=clk,
+    )
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("gtb", 2, 1, **TB))
+    got = [rl.check_rate_limited_and_update(
+        "gtb", ctx_for(), 1).limited for _ in range(3)]
+    assert got == [False, False, True]
+    assert len(storage._big) == 1  # exact host cell, not a device slot
 
 
 def test_mixed_policies_couple_all_or_nothing():

@@ -146,7 +146,8 @@ def _run_kernel(batch, capacity, now_ms, state=None):
     if state is None:
         state = K.make_table(capacity)
     state, result = K.check_and_update_batch(
-        state, slots, deltas, maxes, windows, req, fresh, np.int32(now_ms))
+        state, slots, deltas, maxes, windows, req, fresh,
+        np.zeros(H, bool), np.int32(now_ms))
     return state, np.asarray(result.admitted)[: len(batch)]
 
 
