@@ -251,6 +251,18 @@ class NativeIngress:
         # client's half-close as path + "#eos"; answering the eos event
         # with status -1 closes the stream cleanly.
         self.stream_path = stream_path
+        # Serializes stream-path answer COMPLETION (not just coroutine
+        # starts): a message handler that awaits mid-body must answer
+        # before a later message's answer or the eos close — once the
+        # close answers, write_stream_msg drops the stream and any late
+        # response silently. One lock for all streams is fine: the
+        # stream surface is cold-path (reflection), and global completion
+        # order implies per-stream order.
+        self._stream_serial = None
+        if stream_path is not None and loop is not None:
+            import asyncio
+
+            self._stream_serial = asyncio.Lock()
         self.max_batch = max_batch
         self.poll_ms = poll_ms
         self._ctx = ctypes.c_void_p(
@@ -457,11 +469,16 @@ class NativeIngress:
         (the caller batches the UNIMPLEMENTED answers)."""
         if self.stream_path is not None and path == self.stream_path + "#eos":
             # Client half-closed the bidi stream: close it cleanly — via
-            # the loop when one exists, so the close is scheduled BEHIND
-            # any still-running message handlers of the same stream.
+            # the loop when one exists, taking the stream-serial lock so
+            # the close ANSWERS behind every still-pending message
+            # handler of the stream (coroutine start order alone does not
+            # bound completion order once a handler awaits).
             if self.loop is not None:
+                serial = self._stream_serial
+
                 async def _close() -> bytes:
-                    return b""
+                    async with serial:
+                        return b""
 
                 self._answer_from_loop(rid, _close(), ok_status=-1)
             else:
@@ -470,7 +487,16 @@ class NativeIngress:
         handler = self.handlers.get(path)
         if handler is None or self.loop is None:
             return False
-        self._answer_from_loop(rid, handler(blob))
+        if self.stream_path is not None and path == self.stream_path:
+            serial = self._stream_serial
+
+            async def _serialized(blob=blob) -> bytes:
+                async with serial:
+                    return await handler(blob)
+
+            self._answer_from_loop(rid, _serialized())
+        else:
+            self._answer_from_loop(rid, handler(blob))
         return True
 
     def _submit_slow(self, rid: int, blob: bytes) -> None:

@@ -191,15 +191,27 @@ def check_and_update_core(
 
     v_raw = values[s_slot]
     e_raw = expiry[s_slot]
+    # Freshness is a SEGMENT property for reads: the storage marks only
+    # the hit that allocated/recycled the slot as fresh, but every hit of
+    # that slot in this batch must ignore the previous occupant's stale
+    # device contents (ADVICE r4: a second same-batch hit on a recycled
+    # slot read the old expiry lane — e.g. an old fixed-window expiry as
+    # a huge TAT — and was falsely rejected). The write path already
+    # broadcasts via the same segment max.
+    seg_fresh = jax.ops.segment_max(
+        s_fresh.astype(jnp.int32), seg_id, num_segments=H,
+        indices_are_sorted=True,
+    ).astype(bool)
+    h_fresh = seg_fresh[seg_id]
     # Fresh slots read as value 0 with a brand-new window regardless of the
     # (possibly stale, recycled) device contents.
-    e_eff = jnp.where(s_fresh, now_ms + s_win, e_raw)
+    e_eff = jnp.where(h_fresh, now_ms + s_win, e_raw)
     expired = now_ms >= e_eff
-    v_window = jnp.where(jnp.logical_or(expired, s_fresh), 0, v_raw)
+    v_window = jnp.where(jnp.logical_or(expired, h_fresh), 0, v_raw)
     # Bucket lanes: TAT lives in the expiry cell; fresh slots read a full
     # bucket (stale TAT ignored). tau is masked to bucket lanes so the
     # (B-1)*I product can't wrap for window hits with huge maxes.
-    base_rel = jnp.where(s_fresh, 0, jnp.maximum(e_raw - now_ms, 0))
+    base_rel = jnp.where(h_fresh, 0, jnp.maximum(e_raw - now_ms, 0))
     s_ival = jnp.maximum(s_win, 1)
     tau = (s_max - 1) * jnp.where(s_bucket, s_win, 0)
     spent = s_max - ((tau - base_rel) // s_ival + 1)
@@ -247,7 +259,7 @@ def check_and_update_core(
     # this hit observes the freshly reset window (serial semantics).
     reset_before = jnp.logical_and(expired, pending_final > 0)
     ttl_window = jnp.where(
-        jnp.logical_or(reset_before, s_fresh),
+        jnp.logical_or(reset_before, h_fresh),
         s_win,
         jnp.maximum(e_raw - now_ms, 0),
     )
@@ -274,10 +286,7 @@ def check_and_update_core(
         is_admitted_hit.astype(jnp.int32), seg_id, num_segments=H,
         indices_are_sorted=True,
     ).astype(bool)
-    seg_fresh = jax.ops.segment_max(
-        s_fresh.astype(jnp.int32), seg_id, num_segments=H,
-        indices_are_sorted=True,
-    ).astype(bool)
+    # seg_fresh/h_fresh computed above (shared by the read path).
     seg_win = jax.ops.segment_max(
         jnp.where(jnp.logical_or(is_admitted_hit, s_fresh), s_win, 0),
         seg_id, num_segments=H, indices_are_sorted=True,
@@ -285,7 +294,6 @@ def check_and_update_core(
     # Per-hit views of the segment aggregates (only end hits matter).
     h_total = seg_total[seg_id]
     h_adm = seg_adm[seg_id]
-    h_fresh = seg_fresh[seg_id]
     h_win = seg_win[seg_id]
     cell_expired_h = now_ms >= e_raw  # per-hit read of the cell's expiry
     starts_fresh = jnp.logical_or(cell_expired_h, h_fresh)

@@ -54,6 +54,7 @@ from .storage import (
     _bucket,
     _clamp_window_ms,
     _hit_lane,
+    _migrate_key,
     _Request,
     _SlotTable,
 )
@@ -747,9 +748,33 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         for table, dump in zip(self._tables, data["tables"]):
             table.load(dump, self._global_region, self._local_capacity)
         self._gtable.load(data["gtable"], 0, self._global_region)
+        seed: List[Tuple[int, int, int]] = []
         for key, (value, exp, counter) in data.get("big", {}).items():
-            self._big[key] = (
-                restore_cell(counter.limit, value, exp), counter
+            key = _migrate_key(key)
+            cell = restore_cell(counter.limit, value, exp)
+            if isinstance(cell, GcraValue) and not self._is_big(counter):
+                # Routing migration, same as TpuStorage._apply_snapshot:
+                # pre-r4 checkpoints kept device-eligible buckets in the
+                # big map; seed the owner shard's TAT cell instead of
+                # orphaning the state. Device-eligible buckets are never
+                # global (_is_big forces global-ns buckets host-side),
+                # so the returned shard is always concrete.
+                shard, slot, _fresh, _is_global = self._slot_for(
+                    counter, create=True
+                )
+                seed.append((shard, slot, min(
+                    max(int(cell.tat) - int(self._epoch * 1000), 0),
+                    _INT32_MAX,
+                )))
+                continue
+            self._big[key] = (cell, counter)
+        if seed:
+            sh = np.asarray([s for s, _, _ in seed], np.int32)
+            sl = np.asarray([s for _, s, _ in seed], np.int32)
+            tat = np.asarray([t for _, _, t in seed], np.int32)
+            self._state = ShardedCounterState(
+                self._state.values.at[sh, sl].set(0),
+                self._state.expiry_ms.at[sh, sl].set(tat),
             )
         return self
 

@@ -970,11 +970,39 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 )
             self._table = _SlotTable(self._capacity)
             self._table.load(table, 0, self._capacity)
+            seed_slots: List[int] = []
+            seed_tats: List[int] = []
             for key, (value, expiry, counter) in table.get("big", {}).items():
                 # Same pre-policy key migration as _SlotTable.load: old
                 # checkpoints hold 4-tuple limit identities.
-                self._big[_migrate_key(key)] = (
-                    restore_cell(counter.limit, value, expiry), counter
+                key = _migrate_key(key)
+                cell = restore_cell(counter.limit, value, expiry)
+                if isinstance(cell, GcraValue) and not self._is_big(counter):
+                    # Routing migration: pre-r4 checkpoints kept EVERY
+                    # token bucket in the big host map; device-eligible
+                    # buckets now live in the device table. Seed the
+                    # device TAT cell from the saved state — leaving the
+                    # entry in _big would orphan it (never consulted →
+                    # bucket silently resets to full) while
+                    # _emit_big_counters kept emitting the stale cell.
+                    slot, _fresh = self._slot_for(counter, create=True)
+                    seed_slots.append(slot)
+                    # GcraValue.tat is absolute ms (scale 1 when device
+                    # eligible); the device lane is relative to _epoch.
+                    # TAT <= now means "full bucket", same as 0.
+                    seed_tats.append(min(
+                        max(int(cell.tat) - int(self._epoch * 1000), 0),
+                        int(_INT32_MAX),
+                    ))
+                    continue
+                self._big[key] = (cell, counter)
+            if seed_slots:
+                idx = np.asarray(seed_slots, np.int32)
+                self._state = K.CounterTableState(
+                    values=self._state.values.at[idx].set(0),
+                    expiry_ms=self._state.expiry_ms.at[idx].set(
+                        np.asarray(seed_tats, np.int32)
+                    ),
                 )
 
     def load_snapshot(self, path: str) -> None:

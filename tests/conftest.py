@@ -31,6 +31,36 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import pytest  # noqa: E402
 
+# Every env var the server CLI layers under its flags
+# (limitador_tpu/server/__main__.py `_env(...)` defaults). Fixtures that
+# spawn server subprocesses must scrub these so a test's behavior never
+# depends on what leaked into the invoking shell — the r4 reflection e2e
+# only passed because TPU_NATIVE_INGRESS=1 was ambient.
+SERVER_ENV_VARS = frozenset({
+    "LIMITS_FILE", "STORAGE", "ENVOY_RLS_HOST", "ENVOY_RLS_PORT",
+    "HTTP_API_HOST", "HTTP_API_PORT", "LIMIT_NAME_IN_PROMETHEUS_LABELS",
+    "TRACING_ENDPOINT", "METRIC_LABELS", "METRIC_LABELS_FILE",
+    "RATE_LIMIT_HEADERS", "STRUCTURED_LOGS", "LIMITADOR_LOG", "RUST_LOG",
+    "LIMITS_FILE_POLL_INTERVAL", "TPU_TABLE_CAPACITY", "TPU_BATCH_DELAY_US",
+    "TPU_PIPELINE", "TPU_NATIVE_INGRESS", "GLOBAL_NAMESPACES",
+    "GLOBAL_REGION", "AUTHORITY_LISTEN", "AUTHORITY_URL",
+    "REDIS_LOCAL_CACHE_BATCH_SIZE", "REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS",
+    "MAX_CACHED", "RESPONSE_TIMEOUT", "DISK_PATH", "TPU_SNAPSHOT_PATH",
+    "TPU_SNAPSHOT_PERIOD", "NODE_ID", "LISTEN_ADDRESS",
+    "LIMITADOR_TPU_PLATFORM",
+})
+
+
+def server_env(repo_root, **extra):
+    """Environment for a spawned `limitador_tpu.server` subprocess: the
+    ambient environment minus every server config var (so only the flags
+    the test passes explicitly shape the server), plus PYTHONPATH and any
+    explicit overrides."""
+    env = {k: v for k, v in os.environ.items() if k not in SERVER_ENV_VARS}
+    env["PYTHONPATH"] = str(repo_root)
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
 
 @pytest.fixture
 def fake_clock():

@@ -19,6 +19,8 @@ from pathlib import Path
 
 import pytest
 
+from tests.conftest import server_env
+
 REPO_ROOT = str(Path(__file__).resolve().parent.parent)
 
 LIMITS_V1 = """\
@@ -68,7 +70,7 @@ def server(tmp_path):
 
     def boot(limits_path, poll_s="0.05"):
         http_port, rls_port = free_port(), free_port()
-        env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+        env = server_env(REPO_ROOT)
         # log to a file, not an undrained PIPE (a full pipe buffer blocks
         # the server's event loop on the next log write)
         log = open(tmp_path / f"server-{http_port}.log", "wb")
@@ -191,7 +193,7 @@ def test_structured_logs_emit_json(tmp_path):
             str(limits), "--validate", "--structured-logs",
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        env=server_env(REPO_ROOT),
         capture_output=True,
         text=True,
         timeout=60,
@@ -206,7 +208,7 @@ def test_structured_logs_emit_json(tmp_path):
             str(limits), "--validate", "--structured-logs",
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        env=server_env(REPO_ROOT),
         capture_output=True,
         text=True,
         timeout=60,
@@ -229,7 +231,7 @@ def test_plain_logs_not_json(tmp_path):
             str(limits), "--validate",
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        env=server_env(REPO_ROOT),
         capture_output=True,
         text=True,
         timeout=60,

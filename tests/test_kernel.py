@@ -199,6 +199,38 @@ def test_check_fresh_rejected_hit_still_arms_window():
     assert np.asarray(state.expiry_ms)[6] == 3000
 
 
+def test_check_recycled_slot_second_hit_ignores_stale_contents():
+    """ADVICE r4: freshness must broadcast over the whole segment for
+    READS. The storage marks only the allocating hit fresh; a second
+    same-batch hit on the recycled slot derived its base from the
+    previous occupant's stale cell (a huge old expiry read as TAT /
+    live window) and was falsely rejected — for both policies."""
+    def stale_state():
+        # previous occupant: fixed window live until t=61000 with value 9
+        state = K.make_table(8)
+        state, _ = _check(state, [4], [9], [10], now_ms=1000)
+        return state
+
+    # recycled as a BUCKET slot (I=100ms, B=10): stale expiry 61000 would
+    # read as TAT 60000ms ahead = deeply overdrawn → falsely reject hit 2
+    st, res = _check(
+        stale_state(), [4, 4], [1, 1], [10, 10], now_ms=1000,
+        windows=[100, 100], fresh=[True, False], bucket=[True, True],
+    )
+    assert np.asarray(res.admitted).tolist() == [True, True]
+    # both tokens recorded: TAT = now + 2*I
+    assert np.asarray(st.expiry_ms)[4] == 1200
+
+    # recycled as a FIXED-WINDOW slot: stale value 9 of max 10 would
+    # falsely reject the second hit's +5
+    st, res = _check(
+        stale_state(), [4, 4], [5, 5], [10, 10], now_ms=1000,
+        fresh=[True, False],
+    )
+    assert np.asarray(res.admitted).tolist() == [True, True]
+    assert np.asarray(st.values)[4] == 10
+
+
 def test_check_multi_slot_interleaved_segments():
     """Segments of different lengths interleaved with padding: per-slot
     totals and window resets land on the right cells."""

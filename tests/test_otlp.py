@@ -25,6 +25,7 @@ from limitador_tpu.observability.otlp import (
     BatchExporter,
     MiniTracerProvider,
 )
+from tests.conftest import server_env
 
 REPO_ROOT = str(Path(__file__).resolve().parent.parent)
 
@@ -257,7 +258,7 @@ def test_server_subprocess_exports_spans(collector, tmp_path):
             "--tracing-endpoint", f"http://127.0.0.1:{collector.port}",
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        env=server_env(REPO_ROOT),
         stdout=log,
         stderr=subprocess.STDOUT,
     )

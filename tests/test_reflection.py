@@ -20,11 +20,13 @@ from pathlib import Path
 
 import pytest
 
+from tests.conftest import server_env
 from limitador_tpu.server.proto import reflection_pb2 as rpb
 from limitador_tpu.server.reflection import (
     REFLECTION_METHOD,
     REFLECTION_SERVICE,
     ReflectionResponder,
+    native_reflection_handler,
 )
 
 ENVOY_SERVICE = "envoy.service.ratelimit.v3.RateLimitService"
@@ -133,10 +135,13 @@ def reflection_server(tmp_path_factory):
     log = open(tmp_path / "server.log", "wb")
     proc = subprocess.Popen(
         [sys.executable, "-m", "limitador_tpu.server", str(limits), "tpu",
-         "--pipeline", "native",
+         "--pipeline", "native", "--native-ingress",
          "--rls-port", str(rp), "--http-port", str(hp)],
         cwd=repo,
-        env=dict(os.environ, PYTHONPATH=repo, LIMITADOR_TPU_PLATFORM="cpu"),
+        # scrubbed env: the r4 version of this fixture inherited the full
+        # ambient environment and omitted --native-ingress, so it only
+        # passed when TPU_NATIVE_INGRESS=1 leaked in from the shell
+        env=server_env(repo, LIMITADOR_TPU_PLATFORM="cpu"),
         stdout=log, stderr=subprocess.STDOUT,
     )
     try:
@@ -154,6 +159,15 @@ def reflection_server(tmp_path_factory):
                         (tmp_path / "server.log").read_text()
                     )
                 time.sleep(0.1)
+        # The server downgrades to Python-gRPC-only (with a warning) when
+        # the native library is unavailable; that would silently point the
+        # [native] param at the Python plane. Refuse to run that way.
+        logged = (tmp_path / "server.log").read_text()
+        if f"native HTTP/2 ingress on 0.0.0.0:{rp}" not in logged:
+            raise RuntimeError(
+                "native ingress did not come up on the expected port:\n"
+                + logged
+            )
         yield {"native_port": rp, "grpc_port": rp + 1}
     finally:
         proc.terminate()
@@ -199,6 +213,228 @@ def test_e2e_list_and_describe(reflection_server, plane):
     assert responses[1].original_request.file_containing_symbol == (
         ENVOY_SERVICE
     )
+
+
+# -- direct NativeIngress stream-path coverage --------------------------------
+#
+# The e2e fixture above proves the full server wiring; these drive the C++
+# bidi-stream machinery (native/h2ingress.cc pump_stream_msgs /
+# write_stream_msg) in isolation, so a break in the stream path fails HERE
+# even if the Python plane still answers.
+
+
+@pytest.fixture
+def stream_ingress():
+    """Bare NativeIngress with stream_path registered — no RLS pipeline
+    involvement beyond a fake that answers nothing."""
+    import asyncio
+    import threading
+
+    from limitador_tpu import native
+    from limitador_tpu.native.ingress import NativeIngress, ingress_available
+
+    if not (native.available() and ingress_available()):
+        pytest.skip("native ingress unavailable")
+
+    class FakePipeline:
+        STORAGE_ERROR = object()
+
+        def decide_many(self, blobs, chunk=None):
+            return [b"" for _ in blobs]
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    ing = NativeIngress(
+        FakePipeline(), host="127.0.0.1", port=0, loop=loop, poll_ms=2,
+        handlers={
+            REFLECTION_METHOD: native_reflection_handler(
+                (ENVOY_SERVICE, KUADRANT_SERVICE)
+            )
+        },
+        stream_path=REFLECTION_METHOD,
+    )
+    yield ing
+    ing.close()
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+    loop.close()
+
+
+def _stream_call(channel):
+    import grpc  # noqa: F401
+
+    return channel.stream_stream(
+        REFLECTION_METHOD,
+        request_serializer=rpb.ServerReflectionRequest.SerializeToString,
+        response_deserializer=rpb.ServerReflectionResponse.FromString,
+    )
+
+
+def test_stream_path_batched_requests_answer_in_order(stream_ingress):
+    """All requests sent up front, then half-close: every message must be
+    answered (order preserved by request id) before the stream ends."""
+    import grpc
+
+    reqs = [
+        rpb.ServerReflectionRequest(list_services=""),
+        rpb.ServerReflectionRequest(file_containing_symbol=ENVOY_SERVICE),
+        rpb.ServerReflectionRequest(file_containing_symbol="nope.Nope"),
+        rpb.ServerReflectionRequest(
+            file_by_filename="envoy/service/ratelimit/v3/rls.proto"
+        ),
+    ]
+    with grpc.insecure_channel(f"127.0.0.1:{stream_ingress.port}") as ch:
+        responses = list(_stream_call(ch)(iter(reqs), timeout=20))
+    assert len(responses) == len(reqs)
+    assert responses[0].list_services_response.service
+    assert responses[1].file_descriptor_response.file_descriptor_proto
+    assert responses[2].error_response.error_code == 5
+    # correlation: each answer echoes its own request
+    for req, resp in zip(reqs, responses):
+        assert resp.original_request == req
+
+
+def test_stream_path_interleaved_lockstep(stream_ingress):
+    """grpcurl pattern: await each response before sending the next
+    request — requires the C++ side to flush answers mid-stream."""
+    import queue
+
+    import grpc
+
+    q: "queue.Queue" = queue.Queue()
+    DONE = object()
+
+    def gen():
+        while True:
+            item = q.get()
+            if item is DONE:
+                return
+            yield item
+
+    with grpc.insecure_channel(f"127.0.0.1:{stream_ingress.port}") as ch:
+        call = _stream_call(ch)(gen(), timeout=20)
+        for i in range(5):
+            q.put(rpb.ServerReflectionRequest(list_services=""))
+            resp = next(call)  # blocks: stream stays open
+            assert len(resp.list_services_response.service) == 3, i
+        q.put(DONE)
+        with pytest.raises(StopIteration):
+            next(call)
+
+
+def test_stream_path_abrupt_client_close_then_new_stream(stream_ingress):
+    """A client that vanishes mid-stream (TCP RST-ish: channel torn down
+    with the stream open) must not wedge the ingress — the next stream on
+    a fresh connection still answers."""
+    import queue
+
+    import grpc
+
+    q: "queue.Queue" = queue.Queue()
+
+    def gen():
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            yield item
+
+    ch = grpc.insecure_channel(f"127.0.0.1:{stream_ingress.port}")
+    call = _stream_call(ch)(gen(), timeout=20)
+    q.put(rpb.ServerReflectionRequest(list_services=""))
+    next(call)  # stream is live and mid-flight
+    ch.close()  # abrupt teardown, no half-close handshake
+    q.put(None)
+
+    with grpc.insecure_channel(f"127.0.0.1:{stream_ingress.port}") as ch2:
+        responses = list(_stream_call(ch2)(
+            iter([rpb.ServerReflectionRequest(list_services="")]), timeout=20
+        ))
+    assert len(responses) == 1
+    assert responses[0].list_services_response.service
+
+
+def test_stream_path_concurrent_streams(stream_ingress):
+    """Multiple reflection streams on separate connections at once; each
+    gets its own complete answer set."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import grpc
+
+    def one(i):
+        reqs = [
+            rpb.ServerReflectionRequest(list_services=""),
+            rpb.ServerReflectionRequest(
+                file_containing_symbol=KUADRANT_SERVICE
+            ),
+        ]
+        with grpc.insecure_channel(
+            f"127.0.0.1:{stream_ingress.port}"
+        ) as ch:
+            return list(_stream_call(ch)(iter(reqs), timeout=20))
+
+    with ThreadPoolExecutor(4) as pool:
+        for responses in pool.map(one, range(8)):
+            assert len(responses) == 2
+            assert responses[0].list_services_response.service
+            assert (
+                responses[1].file_descriptor_response.file_descriptor_proto
+            )
+
+
+def test_stream_path_awaiting_handler_answers_before_eos_close():
+    """ADVICE r4: run_coroutine_threadsafe only orders coroutine STARTS —
+    a stream handler that awaits mid-body could finish after the eos
+    close answered, and its response was then silently dropped
+    (write_stream_msg no-ops once the stream is erased). The stream
+    serial lock must make the close answer WAIT."""
+    import asyncio
+    import threading
+
+    from limitador_tpu import native
+    from limitador_tpu.native.ingress import NativeIngress, ingress_available
+
+    if not (native.available() and ingress_available()):
+        pytest.skip("native ingress unavailable")
+
+    import grpc
+
+    class FakePipeline:
+        STORAGE_ERROR = object()
+
+        def decide_many(self, blobs, chunk=None):
+            return [b"" for _ in blobs]
+
+    responder = ReflectionResponder((ENVOY_SERVICE, KUADRANT_SERVICE))
+
+    async def slow_handler(blob: bytes) -> bytes:
+        req = rpb.ServerReflectionRequest.FromString(blob)
+        await asyncio.sleep(0.3)  # the eos event arrives during this
+        return responder.answer(req).SerializeToString()
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    ing = NativeIngress(
+        FakePipeline(), host="127.0.0.1", port=0, loop=loop, poll_ms=2,
+        handlers={REFLECTION_METHOD: slow_handler},
+        stream_path=REFLECTION_METHOD,
+    )
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{ing.port}") as ch:
+            # request + immediate half-close: the eos chases the handler
+            responses = list(_stream_call(ch)(
+                iter([rpb.ServerReflectionRequest(list_services="")]),
+                timeout=20,
+            ))
+        assert len(responses) == 1  # answer arrived BEFORE the close
+        assert responses[0].list_services_response.service
+    finally:
+        ing.close()
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        loop.close()
 
 
 def test_e2e_native_interleaved_request_response(reflection_server):

@@ -270,6 +270,67 @@ def test_snapshot_restore_roundtrip(tmp_path):
     assert limiter2.check_rate_limited_and_update("g", Context({}), 1).limited
 
 
+def test_pre_r4_checkpoint_bucket_migrates_to_device(tmp_path, fake_clock):
+    """ADVICE r4 (medium), sharded variant: a pre-r4 checkpoint holds
+    device-eligible token buckets in the big host map; restore must seed
+    the owner shard's TAT cell rather than orphan the state in _big."""
+    import pickle
+
+    TB = dict(conditions=[], variables=["u"], policy="token_bucket")
+    storage = make_storage(clock=fake_clock)
+    limiter = RateLimiter(storage)
+    limiter.add_limit(Limit("tb", 5, 1, **TB))
+    for _ in range(3):
+        limiter.check_rate_limited_and_update("tb", Context({"u": "a"}), 1)
+    path = str(tmp_path / "sharded-tb.ckpt")
+    storage.snapshot(path)
+
+    # Rewrite into the pre-r4 layout: the bucket's device cell moves to
+    # the big map as (tat_abs_ms, None), the r3-era persisted form.
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    epoch_ms = int(data["epoch"] * 1000)
+    keep = []
+    moved = 0
+    for i, (shard, slot) in enumerate(data["locs"]):
+        key, counter = data["tables"][shard]["info"][slot]
+        if counter.limit.policy == "token_bucket":
+            data["big"][key] = (
+                int(data["lexpiry"][i]) + epoch_ms, None, counter
+            )
+            del data["tables"][shard]["info"][slot]
+            data["tables"][shard]["simple"].pop(key, None)
+            data["tables"][shard]["qualified"] = [
+                (k, v)
+                for k, v in data["tables"][shard]["qualified"]
+                if k != key
+            ]
+            moved += 1
+        else:
+            keep.append(i)
+    assert moved == 1
+    data["locs"] = [data["locs"][i] for i in keep]
+    data["lvalues"] = np.asarray(
+        [data["lvalues"][i] for i in keep], np.int32)
+    data["lexpiry"] = np.asarray(
+        [data["lexpiry"][i] for i in keep], np.int32)
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+
+    restored = TpuShardedStorage.restore(path, clock=fake_clock)
+    assert not restored._big
+    limiter2 = RateLimiter(restored)
+    limiter2.add_limit(Limit("tb", 5, 1, **TB))
+    got = [
+        limiter2.check_rate_limited_and_update(
+            "tb", Context({"u": "a"}), 1
+        ).limited
+        for _ in range(3)
+    ]
+    # 3 of 5 tokens were spent before the checkpoint
+    assert got == [False, False, True]
+
+
 def test_qualified_eviction_and_revival():
     storage = make_storage(cache_size=8)  # 1 qualified slot per shard
     limiter = RateLimiter(storage)

@@ -22,6 +22,8 @@ from pathlib import Path
 import grpc
 import pytest
 
+from tests.conftest import server_env
+
 from limitador_tpu.server.proto import rls_pb2
 
 REPO_ROOT = str(Path(__file__).resolve().parent.parent)
@@ -62,7 +64,7 @@ def server(tmp_path):
             "--limits-poll-interval", "0.1",
         ],
         cwd=REPO_ROOT,
-        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+        env=server_env(REPO_ROOT),
         stdout=log,
         stderr=subprocess.STDOUT,
     )

@@ -15,6 +15,8 @@ import numpy as np
 import pytest
 
 from limitador_tpu import Context, Limit, RateLimiter
+
+from tests.conftest import server_env
 from limitador_tpu.storage.gcra import GcraValue, emission_interval_ms
 from limitador_tpu.storage.in_memory import InMemoryStorage
 from limitador_tpu.tpu import TpuStorage
@@ -384,6 +386,96 @@ def test_snapshot_roundtrip_preserves_tat(tmp_path):
     assert got == [False, False, True]
 
 
+def _rewrite_snapshot_bucket_to_pre_r4_big(path):
+    """Rewrite a modern TpuStorage checkpoint into the pre-r4 layout:
+    every device-resident token bucket moves into the 'big' host map as a
+    (tat_ms, None) cell, exactly what r3-era snapshots persisted (buckets
+    gained their device lane — and the snapshot routing — in r4)."""
+    import pickle
+
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    table = data["table"]
+    epoch_ms = int(table["epoch"] * 1000)
+    slots = list(data["slots"])
+    keep = []
+    for i, slot in enumerate(slots):
+        key, counter = table["info"][int(slot)]
+        if counter.limit.policy == "token_bucket":
+            tat_abs_ms = int(data["expiry"][i]) + epoch_ms
+            table["big"][key] = (tat_abs_ms, None, counter)
+            del table["info"][int(slot)]
+            table["simple"].pop(key, None)
+            table["qualified"] = [
+                (k, v) for k, v in table["qualified"] if k != key
+            ] if isinstance(table["qualified"], list) else table["qualified"]
+            if isinstance(table["qualified"], dict):
+                table["qualified"].pop(key, None)
+        else:
+            keep.append(i)
+    data["slots"] = np.asarray([slots[i] for i in keep], np.int32)
+    data["values"] = np.asarray(
+        [data["values"][i] for i in keep], np.int32)
+    data["expiry"] = np.asarray(
+        [data["expiry"][i] for i in keep], np.int32)
+    with open(path, "wb") as f:
+        pickle.dump(data, f)
+
+
+def test_pre_r4_checkpoint_bucket_migrates_to_device(tmp_path):
+    """ADVICE r4 (medium): restoring a pre-r4 checkpoint must seed the
+    device TAT cell from the saved big-map bucket — not orphan it in
+    _big (bucket would silently reset to full, over-admitting up to
+    capacity) while get_counters kept emitting the stale host cell."""
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("tb", 5, 1, **TB))
+    for _ in range(3):
+        rl.check_rate_limited_and_update("tb", ctx_for(), 1)
+    path = str(tmp_path / "tb.ckpt")
+    storage.snapshot(path)
+    _rewrite_snapshot_bucket_to_pre_r4_big(path)
+
+    restored = TpuStorage(capacity=1 << 12, clock=clk)
+    restored.load_snapshot(path)
+    # the saved bucket state landed on device, nothing orphaned host-side
+    assert not restored._big
+    rl2 = RateLimiter(restored)
+    rl2.add_limit(Limit("tb", 5, 1, **TB))
+    # 3 of 5 tokens were spent before the checkpoint
+    got = [rl2.check_rate_limited_and_update("tb", ctx_for(), 1).limited
+           for _ in range(3)]
+    assert got == [False, False, True]
+    # single source of truth: exactly one counter emitted, device-backed
+    counters = list(rl2.get_counters("tb"))
+    assert len(counters) == 1
+    assert counters[0].remaining == 0
+
+
+def test_pre_r4_checkpoint_refilled_bucket_restores_full(tmp_path):
+    """A pre-r4 bucket whose TAT lies in the past (fully refilled during
+    the downtime) restores as a full bucket, not a rejecting one."""
+    clk = Clock()
+    storage = TpuStorage(capacity=1 << 12, clock=clk)
+    rl = RateLimiter(storage)
+    rl.add_limit(Limit("tb", 5, 1, **TB))
+    for _ in range(5):
+        rl.check_rate_limited_and_update("tb", ctx_for(), 1)
+    path = str(tmp_path / "tb.ckpt")
+    storage.snapshot(path)
+    _rewrite_snapshot_bucket_to_pre_r4_big(path)
+
+    clk.t += 10.0  # downtime long past the 1s refill horizon
+    restored = TpuStorage(capacity=1 << 12, clock=clk)
+    restored.load_snapshot(path)
+    rl2 = RateLimiter(restored)
+    rl2.add_limit(Limit("tb", 5, 1, **TB))
+    got = [rl2.check_rate_limited_and_update("tb", ctx_for(), 1).limited
+           for _ in range(6)]
+    assert got == [False] * 5 + [True]
+
+
 def test_get_counters_shows_bucket_state():
     clk = Clock()
     rl = RateLimiter(TpuStorage(capacity=1 << 12, clock=clk))
@@ -432,7 +524,7 @@ def test_server_e2e_token_bucket(tmp_path):
          "--pipeline", "native",
          "--rls-port", str(rp), "--http-port", str(hp)],
         cwd=repo,
-        env=dict(os.environ, PYTHONPATH=repo, LIMITADOR_TPU_PLATFORM="cpu"),
+        env=server_env(repo, LIMITADOR_TPU_PLATFORM="cpu"),
         stdout=log, stderr=subprocess.STDOUT,
     )
     try:
