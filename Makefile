@@ -1,0 +1,23 @@
+# Mirror of the reference's CI gate (.github/workflows/rust.yml:
+# fmt --check + clippy -D warnings + test matrix), for this stack.
+#
+# `test` skips the @pytest.mark.slow chaos/soak scenarios for a fast
+# gate; `test-all` (and `check-all`) runs everything.
+
+.PHONY: check check-all lint test test-all bench
+
+check: lint test
+
+check-all: lint test-all
+
+lint:
+	python -m limitador_tpu.tools.lint
+
+test:
+	python -m pytest tests/ -q -m "not slow"
+
+test-all:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py

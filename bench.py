@@ -1209,7 +1209,7 @@ def main():
                   and not device_ok):
                 extra[f"{config}_platform"] = "cpu"
 
-    import jax
+    import jax  # noqa: lazy per-branch (BENCH_FORCE_CPU may have imported it)
 
     if not device_ok:
         jax.config.update("jax_platforms", "cpu")

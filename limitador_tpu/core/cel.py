@@ -28,7 +28,7 @@ import datetime as _dt
 import math
 import re
 from dataclasses import dataclass
-from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = [
     "CelError",

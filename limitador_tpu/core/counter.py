@@ -8,7 +8,7 @@ the resolved variable values that qualify it, with transient ``remaining`` /
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple, Union
+from typing import Any, Dict, Optional, Tuple
 
 from .cel import Context
 from .limit import Limit, Namespace

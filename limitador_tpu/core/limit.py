@@ -15,9 +15,9 @@ Mirrors the reference's ``Limit``/``Namespace`` semantics
 
 from __future__ import annotations
 
-from typing import Any, Dict, Iterable, List, Optional, Set, Tuple, Union
+from typing import Any, Dict, Iterable, Optional, Set, Tuple, Union
 
-from .cel import Context, EvaluationError, Expression, Predicate
+from .cel import Context, Expression, Predicate
 
 __all__ = ["Namespace", "Limit"]
 

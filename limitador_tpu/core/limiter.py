@@ -14,7 +14,6 @@ from ..observability.tracing import datastore_span
 from ..storage.base import (
     AsyncCounterStorage,
     AsyncStorage,
-    Authorization,
     CounterStorage,
     Storage,
 )

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from contextlib import contextmanager
-from typing import Iterable, Optional
+from typing import Optional
 
 from prometheus_client import (
     CollectorRegistry,

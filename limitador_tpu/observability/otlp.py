@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import http.client
 import json
-import os
 import queue
 import random
 import threading
@@ -36,7 +35,6 @@ from typing import Optional, Sequence
 from opentelemetry import context as otel_context
 from opentelemetry import trace as otel_trace
 from opentelemetry.trace import (
-    NonRecordingSpan,
     Span,
     SpanContext,
     SpanKind,
@@ -44,7 +42,7 @@ from opentelemetry.trace import (
     Tracer,
     TracerProvider,
 )
-from opentelemetry.trace.status import Status, StatusCode
+from opentelemetry.trace.status import Status
 from opentelemetry.util import types as otel_types
 
 __all__ = [

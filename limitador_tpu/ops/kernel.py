@@ -62,7 +62,7 @@ buckets H into powers of two so XLA compiles a handful of programs total.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional, Tuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -152,6 +152,7 @@ def check_and_update_core(
     num_req: int,
     vote_combine=None,
     base_hook=None,
+    tat_floor_hook=None,
 ):
     """Shared admission + scatter body (see module docstring).
 
@@ -159,6 +160,14 @@ def check_and_update_core(
     a mesh axis (identity on one chip). ``base_hook(v_local, s_slot)``
     returns the effective base value per sorted hit (identity reads the
     local cell; the sharded path substitutes psum'd global partials).
+
+    ``tat_floor_hook(s_slot)`` returns a per-sorted-hit int32 floor folded
+    into bucket lanes' effective TAT (replicated topology: the max-merged
+    remote TAT rides here, tpu/replicated.py). Folding the floor into the
+    TAT — rather than adding a remote count — makes the merge the
+    join-semilattice max, so admission, remaining, ttl AND the write base
+    all see the merged bucket state at once; the local write then persists
+    the join (idempotent under re-gossip). Window lanes ignore it.
 
     ``bucket`` marks GCRA token-bucket hits (storage/gcra.py): for those,
     ``windows_ms`` carries the emission interval I instead of a window,
@@ -209,9 +218,16 @@ def check_and_update_core(
     expired = now_ms >= e_eff
     v_window = jnp.where(jnp.logical_or(expired, h_fresh), 0, v_raw)
     # Bucket lanes: TAT lives in the expiry cell; fresh slots read a full
-    # bucket (stale TAT ignored). tau is masked to bucket lanes so the
-    # (B-1)*I product can't wrap for window hits with huge maxes.
-    base_rel = jnp.where(h_fresh, 0, jnp.maximum(e_raw - now_ms, 0))
+    # LOCAL bucket (stale TAT ignored) but still respect the remote floor.
+    # tau is masked to bucket lanes so the (B-1)*I product can't wrap for
+    # window hits with huge maxes.
+    local_tat = jnp.where(h_fresh, 0, e_raw)
+    tat_eff = (
+        local_tat
+        if tat_floor_hook is None
+        else jnp.maximum(local_tat, tat_floor_hook(s_slot))
+    )
+    base_rel = jnp.maximum(tat_eff - now_ms, 0)
     s_ival = jnp.maximum(s_win, 1)
     tau = (s_max - 1) * jnp.where(s_bucket, s_win, 0)
     spent = s_max - ((tau - base_rel) // s_ival + 1)
@@ -317,7 +333,9 @@ def check_and_update_core(
             s_bucket, jnp.logical_or(h_adm, h_fresh), reset_window
         ),
     )
-    tat_base = jnp.maximum(jnp.where(h_fresh, 0, e_raw), now_ms)
+    # The write base starts from the EFFECTIVE (floor-merged) TAT, so the
+    # local cell persists the join of local and remote state.
+    tat_base = jnp.maximum(tat_eff, now_ms)
     exp_new = jnp.where(
         s_bucket, tat_base + h_total * s_win, now_ms + h_win
     )

@@ -392,7 +392,7 @@ def build_limiter(args, on_partitioned=None):
             return CompiledTpuLimiter(async_storage)
         return AsyncRateLimiter(async_storage)
     if args.storage == "sharded":
-        from ..tpu.batcher import AsyncTpuStorage
+        from ..tpu.batcher import AsyncTpuStorage  # noqa: lazy per-branch
         from ..tpu.sharded import TpuShardedStorage
 
         cli_global_ns = {
@@ -438,7 +438,7 @@ def build_limiter(args, on_partitioned=None):
                 log.warning(
                     "native pipeline is single-chip only; using the "
                     "compiled pipeline with sharded storage")
-            from ..tpu.pipeline import CompiledTpuLimiter
+            from ..tpu.pipeline import CompiledTpuLimiter  # noqa: lazy per-branch
 
             return CompiledTpuLimiter(async_storage)
         return AsyncRateLimiter(async_storage)
@@ -460,7 +460,7 @@ def build_limiter(args, on_partitioned=None):
                 args.authority_url, timeout=args.response_timeout / 1000.0
             )
         else:
-            from ..storage.disk import DiskStorage
+            from ..storage.disk import DiskStorage  # noqa: lazy per-branch
 
             authority = DiskStorage(args.disk_path or "limitador_counters.db")
         return AsyncRateLimiter(

@@ -28,7 +28,7 @@ import grpc
 _NULLCONTEXT = contextlib.nullcontext()
 
 from ..core.cel import Context
-from ..core.limiter import AsyncRateLimiter, CheckResult, RateLimiter
+from ..core.limiter import AsyncRateLimiter, CheckResult
 from ..observability.metrics import PrometheusMetrics
 from ..observability.metrics_layer import (
     installed as _metrics_layer_installed,

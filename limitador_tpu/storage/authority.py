@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 import threading
 from concurrent import futures
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import msgpack
 

@@ -16,7 +16,7 @@ from __future__ import annotations
 import threading
 from abc import ABC, abstractmethod
 from dataclasses import dataclass
-from typing import ClassVar, Dict, Iterable, List, Optional, Set
+from typing import ClassVar, Dict, List, Optional, Set
 
 from ..core.cel import LimitadorError
 from ..core.counter import Counter

@@ -36,7 +36,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import grpc
 
-from ...server import proto as _proto  # ensures generated modules importable
+from ...server import proto as _proto  # noqa: side-effect import (registers generated modules)
 from limitador.service.distributed.v1 import distributed_pb2 as pb
 
 __all__ = ["Broker"]
@@ -48,6 +48,17 @@ _METHOD = f"/{_SERVICE}/Stream"
 _RECONNECT_SECONDS = 1.0
 PING_INTERVAL_SECONDS = 5.0   # periodic RTT/skew refresh (grpc/mod.rs:625-746)
 PEER_PRUNE_SECONDS = 30.0     # forget gossip-learned peers silent this long
+# Dial-side handshake deadline: without one, a half-dead connection (TCP
+# up, stream wedged — observed under chaos when the peer's poller chokes)
+# parks the redial loop FOREVER on the hello read and the partition never
+# heals. Timed out attempts close the channel and retry on a fresh one.
+HANDSHAKE_TIMEOUT_SECONDS = 5.0
+# Session idle reaper: pings flow every PING_INTERVAL_SECONDS, so a
+# session with NOTHING arriving for several intervals is a zombie
+# (half-open stream whose peer vanished without FIN/RST); reap it so the
+# slot reopens for a fresh dial. Mirrors the reference's session-health
+# tracking (grpc/mod.rs:625-746).
+SESSION_IDLE_TIMEOUT_SECONDS = 30.0
 
 OnUpdate = Callable[[bytes, Dict[str, int], int], None]
 SnapshotProvider = Callable[[], Iterable[Tuple[bytes, Dict[str, int], int]]]
@@ -276,7 +287,18 @@ class Broker:
         ping_task = asyncio.ensure_future(pinger())
         try:
             while True:
-                packet = await recv()
+                try:
+                    packet = await asyncio.wait_for(
+                        recv(), SESSION_IDLE_TIMEOUT_SECONDS
+                    )
+                except asyncio.TimeoutError:
+                    # Nothing (not even a ping) for several ping
+                    # intervals: zombie half-open stream — reap it.
+                    log.debug(
+                        "session %s idle past %.0fs, reaping",
+                        session.peer_id, SESSION_IDLE_TIMEOUT_SECONDS,
+                    )
+                    break
                 if packet is None:
                     break
                 self.peer_last_seen[session.peer_id] = time.monotonic()
@@ -365,10 +387,17 @@ class Broker:
         while not self._stopping.is_set():
             try:
                 await self._dial_once(url)
-            except (grpc.RpcError, grpc.aio.AioRpcError, OSError) as exc:
-                log.debug("dial %s failed: %s", url, exc)
             except asyncio.CancelledError:
                 return
+            except Exception as exc:  # keep redialing on ANY failure
+                # An abruptly severed stream can surface exception types
+                # beyond RpcError/OSError (cython-layer errors, protocol
+                # violations mid-_run_session); a narrower catch let one
+                # such error kill this loop silently and the peer never
+                # reconnected (found by tests/test_chaos.py). The
+                # reference redials unconditionally every second
+                # (grpc/mod.rs:521-529).
+                log.debug("dial %s failed: %s", url, exc)
             await asyncio.sleep(_RECONNECT_SECONDS)
 
     async def _dial_once(self, url: str) -> None:
@@ -379,16 +408,21 @@ class Broker:
                 response_deserializer=pb.Packet.FromString,
             )
             call = stream()
-            await call.write(
-                pb.Packet(
-                    hello=pb.Hello(
-                        sender_peer_id=self.peer_id,
-                        sender_urls=[self.listen_address],
-                        receiver_url=url,
+            await asyncio.wait_for(
+                call.write(
+                    pb.Packet(
+                        hello=pb.Hello(
+                            sender_peer_id=self.peer_id,
+                            sender_urls=[self.listen_address],
+                            receiver_url=url,
+                        )
                     )
-                )
+                ),
+                HANDSHAKE_TIMEOUT_SECONDS,
             )
-            hello_pkt = await call.read()
+            hello_pkt = await asyncio.wait_for(
+                call.read(), HANDSHAKE_TIMEOUT_SECONDS
+            )
             if (
                 hello_pkt is grpc.aio.EOF
                 or hello_pkt.WhichOneof("message") != "hello"
@@ -408,8 +442,18 @@ class Broker:
                     await existing.closed.wait()
                 return
 
+            # _run_session has THREE writers (sender drain, pinger, pong
+            # replies from the recv loop); grpc.aio's call.write is not
+            # concurrency-safe — overlapping writes fail the whole RPC
+            # with GRPC_CALL_ERROR_TOO_MANY_OPERATIONS (found by
+            # tests/test_chaos.py: under load every redial died on it,
+            # leaving the partition permanent). The server side already
+            # serializes through its out-queue; serialize here too.
+            write_lock = asyncio.Lock()
+
             async def send(packet):
-                await call.write(packet)
+                async with write_lock:
+                    await call.write(packet)
 
             async def recv():
                 packet = await call.read()

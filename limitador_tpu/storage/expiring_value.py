@@ -13,7 +13,6 @@ Time is float seconds since the epoch throughout.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
 
 __all__ = ["ExpiringValue"]
 

@@ -18,7 +18,7 @@ from collections import OrderedDict
 from typing import Dict, List, Optional, Set
 
 from ..core.counter import Counter
-from ..core.limit import Limit, Namespace
+from ..core.limit import Limit
 from .base import Authorization, CounterStorage
 from .expiring_value import ExpiringValue
 from .gcra import cell_for_limit as _new_cell

@@ -1,0 +1,1 @@
+"""Operational tools (migration, fleet helpers)."""

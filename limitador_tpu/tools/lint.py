@@ -1,0 +1,231 @@
+"""Vendored AST linter — the fmt/clippy gate of this repo.
+
+The reference enforces ``cargo fmt --check`` and ``clippy -D warnings``
+in CI (/root/reference/.github/workflows/rust.yml). This image ships no
+Python linter (no ruff/pyflakes/flake8, and installs are off), so — by
+the same standard as the vendored HTTP/2, OTLP and reflection layers —
+the gate is implemented from scratch on ``ast``:
+
+* syntax errors (hard fail),
+* unused imports (pyflakes F401 class: a name imported but never
+  referenced in the module; ``__all__`` strings count as uses),
+* redefined imports (same name imported twice in one scope),
+* bare ``except:`` (clippy would call this a swallow-all),
+* mutable default arguments (list/dict/set literals),
+* comparisons to ``True``/``False``/``None`` with ``==``/``!=``,
+* duplicate literal keys in dict displays,
+* tabs in indentation and trailing whitespace.
+
+``# noqa`` anywhere on the offending line suppresses that finding.
+Run: ``python -m limitador_tpu.tools.lint [paths...]`` (defaults to the
+repo's lintable set); exit 1 on any finding — ``make check`` and
+``tests/test_lint.py`` both ride this.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+from typing import List, Tuple
+
+__all__ = ["lint_file", "lint_paths", "main"]
+
+DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
+                   "__graft_entry__.py")
+
+
+def _imported_bindings(tree: ast.AST):
+    """(lineno, bound_name, scope_id) for every import; scope_id keys
+    the nearest enclosing function/class/module, so a deliberate lazy
+    re-import inside a function never collides with the module scope
+    (pyflakes F811 is same-scope only too)."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = [id(tree)]
+
+        def visit_Import(self, node):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                # redef key keeps the dotted path: `import urllib.request`
+                # and `import urllib.error` both bind 'urllib' on purpose
+                out.append(
+                    (node.lineno, bound, alias.name, self.scope[-1])
+                )
+
+        def visit_ImportFrom(self, node):
+            if node.module == "__future__":
+                return  # compiler directive, not a binding
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                out.append(
+                    (node.lineno, bound, bound, self.scope[-1])
+                )
+
+        def _scoped(self, node):
+            self.scope.append(id(node))
+            self.generic_visit(node)
+            self.scope.pop()
+
+        visit_FunctionDef = _scoped
+        visit_AsyncFunctionDef = _scoped
+        visit_ClassDef = _scoped
+        visit_Lambda = _scoped
+
+    V().visit(tree)
+    return out
+
+
+def _used_names(tree: ast.AST):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            # "a.b.c" usage roots at the Name, already collected
+            pass
+        elif isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Name)
+                    and target.id == "__all__"
+                    and isinstance(node.value, (ast.List, ast.Tuple))
+                ):
+                    for elt in node.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            used.add(elt.value)
+    return used
+
+
+def lint_file(path: Path) -> List[Tuple[int, str]]:
+    src = path.read_text()
+    lines = src.splitlines()
+
+    def suppressed(lineno: int) -> bool:
+        return (
+            0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
+        )
+
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as exc:
+        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
+
+    findings: List[Tuple[int, str]] = []
+
+    # unused + same-scope-redefined imports
+    bindings = _imported_bindings(tree)
+    used = _used_names(tree)
+    seen: dict = {}
+    for lineno, name, full, scope in bindings:
+        key = (full, scope)
+        if key in seen and not suppressed(lineno):
+            findings.append(
+                (lineno, f"import '{name}' redefines line {seen[key]}")
+            )
+        seen.setdefault(key, lineno)
+    for lineno, name, _full, _scope in bindings:
+        if name not in used and not suppressed(lineno):
+            findings.append((lineno, f"unused import '{name}'"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not suppressed(node.lineno):
+                findings.append(
+                    (node.lineno, "bare 'except:' swallows everything")
+                )
+        elif isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            for default in (
+                list(node.args.defaults) + list(node.args.kw_defaults)
+            ):
+                if isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)
+                ) and not suppressed(default.lineno):
+                    findings.append((
+                        default.lineno,
+                        f"mutable default argument in '{node.name}'",
+                    ))
+        elif isinstance(node, ast.Compare):
+            for op, comp in zip(node.ops, node.comparators):
+                if (
+                    isinstance(op, (ast.Eq, ast.NotEq))
+                    and isinstance(comp, ast.Constant)
+                    and (comp.value is None or comp.value is True
+                         or comp.value is False)
+                    and not suppressed(node.lineno)
+                ):
+                    findings.append((
+                        node.lineno,
+                        f"comparison to {comp.value!r} with ==/!= "
+                        "(use is/is not or truthiness)",
+                    ))
+        elif isinstance(node, ast.Dict):
+            keys = [
+                k.value
+                for k in node.keys
+                if isinstance(k, ast.Constant)
+                and isinstance(k.value, (str, int))
+            ]
+            dupes = {k for k in keys if keys.count(k) > 1}
+            if dupes and not suppressed(node.lineno):
+                findings.append((
+                    node.lineno,
+                    f"duplicate dict keys: {sorted(map(repr, dupes))}",
+                ))
+
+    for i, line in enumerate(lines, 1):
+        if "# noqa" in line:
+            continue
+        stripped = line.rstrip("\n")
+        if stripped != stripped.rstrip():
+            findings.append((i, "trailing whitespace"))
+        indent = stripped[: len(stripped) - len(stripped.lstrip())]
+        if "\t" in indent:
+            findings.append((i, "tab in indentation"))
+
+    return sorted(findings)
+
+
+def _iter_files(targets) -> List[Path]:
+    files = []
+    for target in targets:
+        p = Path(target)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            files.append(p)
+    # generated protobuf output is protoc's style, not ours
+    return [f for f in files if not f.name.endswith("_pb2.py")
+            and not f.name.endswith("_pb2_grpc.py")]
+
+
+def lint_paths(targets) -> List[str]:
+    out = []
+    for f in _iter_files(targets):
+        for lineno, msg in lint_file(f):
+            out.append(f"{f}:{lineno}: {msg}")
+    return out
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    targets = argv or list(DEFAULT_TARGETS)
+    findings = lint_paths(targets)
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"{len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -25,7 +25,6 @@ from ..core.counter import Counter
 from ..core.limiter import AsyncRateLimiter, CheckResult
 from ..core.limit import Limit, Namespace
 from ..observability.tracing import datastore_span
-from ..storage.base import Authorization
 from .batcher import AsyncTpuStorage, _latency_hists
 from .compiler import NamespaceCompiler
 

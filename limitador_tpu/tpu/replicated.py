@@ -38,13 +38,14 @@ import jax
 import numpy as np
 
 from ..core.counter import Counter
+from ..storage.gcra import GcraValue, spent_tokens
 from ..storage.keys import (
     LimitKeyIndex,
     key_for_counter,
     partial_counter_from_key,
 )
 from ..ops import kernel as K
-from .storage import TpuStorage, _bucket
+from .storage import TpuStorage
 
 __all__ = ["TpuReplicatedStorage"]
 
@@ -54,17 +55,36 @@ DEFAULT_GOSSIP_PERIOD = 0.1
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
                       windows_ms, req_ids, fresh, bucket, now_ms):
-    """check_and_update over (local + live remote) admission base; only the
-    LOCAL cells are written (remote counts belong to their actors)."""
+    """check_and_update over the merged admission base; only the LOCAL
+    cells are written. Fixed windows fold the gossiped remote SUM into
+    the base (read-as-sum, cr_counter_value.rs:38-46); token buckets fold
+    the gossiped remote TAT as a FLOOR on the local TAT (max-merge join —
+    a shared TAT, not additive counts), which the kernel then persists
+    into the local cell on admitted writes so subsequent gossip carries
+    the join."""
+    # Same sorted-order trick as the sharded base_hook (parallel/mesh.py):
+    # hooks receive sorted hits, so sort the per-hit policy lane the same
+    # way (XLA dedups the repeated stable argsort).
+    order = K.jnp.argsort(slots, stable=True)
+    s_bucket = bucket[order]
+
     def base_hook(v_local, s_slot):
         r = remote_vals[s_slot]
         live = now_ms < remote_exp[s_slot]
-        return v_local + K.jnp.where(live, r, 0)
+        # bucket lanes carry their remote share via tat_floor_hook
+        return v_local + K.jnp.where(
+            K.jnp.logical_or(s_bucket, ~live), 0, r
+        )
+
+    def tat_floor_hook(s_slot):
+        # remote_exp holds the max-merged remote TAT for bucket slots
+        # (epoch-relative ms, refreshed at gossip/flush time)
+        return K.jnp.where(s_bucket, remote_exp[s_slot], 0)
 
     nv, ne, admitted, ok, remaining, ttl = K.check_and_update_core(
         state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
         req_ids, fresh, bucket, now_ms, num_req=slots.shape[0],
-        base_hook=base_hook,
+        base_hook=base_hook, tat_floor_hook=tat_floor_hook,
     )
     return K.CounterTableState(nv, ne), K.BatchResult(admitted, ok, remaining, ttl)
 
@@ -78,9 +98,19 @@ def _apply_remote(remote_vals, remote_exp, slots, sums, expiries):
 
 
 class TpuReplicatedStorage(TpuStorage):
-    # Big-cell gossip floods carry fixed-window (value, expiry) state; a
-    # GCRA TAT would be merged wrong by peers. Rejected up front instead.
-    supports_token_bucket = False
+    # Token buckets replicate as a SHARED TAT max-merged per actor (r5):
+    # the TAT is monotone under both admission (max(TAT, now) + d*I) and
+    # merge (join-semilattice max), exactly like the expiry merge of
+    # cr_counter_value.rs:77-113, so gossip is idempotent/commutative/
+    # associative. The wire reuses the (count, expires_at) pair: count
+    # carries the TAT in the limit's ticks, expires_at the TAT in abs ms
+    # (the liveness lane — a TAT in the past = full bucket = no state).
+    # Local admission checks against max(local TAT, gossiped remote TAT)
+    # and persists the join; cross-node over-admission is bounded by what
+    # peers admit within one gossip period (concurrent spends collapse to
+    # their max at merge), the same bounded-inaccuracy contract as the
+    # fixed-window read-as-sum.
+    supports_token_bucket = True
 
     def __init__(
         self,
@@ -148,18 +178,54 @@ class TpuReplicatedStorage(TpuStorage):
         if fresh and slot is not None:
             # Remote updates that arrived before this counter's limit was
             # configured locally parked in _remote_actors; adopt them now.
-            key = key_for_counter(counter)
-            if key in self._remote_actors:
-                self._queue_remote_sum(key, slot)
+            # ALWAYS queued (also when this key has no remote state): a
+            # recycled slot's remote lane may still carry the previous
+            # occupant's live remote entry, which would otherwise fold
+            # into the new counter's admission base.
+            self._queue_remote_sum(
+                key_for_counter(counter), slot, counter=counter
+            )
         return slot, fresh
 
-    def _queue_remote_sum(self, key: bytes, slot: int) -> None:
-        """Recompute the live remote sum for a key and queue the device
-        scatter. Caller holds the lock."""
+    def _clear_adopted_slot(self, slot: int) -> None:
+        """Zero a freshly allocated slot's LOCAL device cell. Adoption
+        paths (gossip/re-sync arriving for a counter this node never
+        served) allocate without a following kernel batch, so the
+        kernel's fresh-flag override never cleans a recycled slot —
+        without this, every later read/batch sees the previous
+        occupant's cell (r5 review finding). Caller holds the lock."""
+        self._state = K.clear_slots(
+            self._state, np.asarray([slot], np.int32)
+        )
+
+    def _queue_remote_sum(
+        self, key: bytes, slot: int, counter: Optional[Counter] = None
+    ) -> None:
+        """Recompute the live remote share for a key and queue the device
+        scatter. Fixed windows: (sum of live counts, max expiry). Token
+        buckets: (0, max live remote TAT) — the TAT rides the expiry lane
+        and folds in as the kernel's tat floor. Caller holds the lock."""
         actors = self._remote_actors.get(key, {})
         now_ms = self._now_ms()
         epoch_ms = self._epoch * 1000
+        if counter is None:
+            info = self._table.info.get(slot)
+            counter = info[1] if info is not None else None
+        is_bucket = (
+            counter is not None
+            and counter.limit.policy == "token_bucket"
+        )
+        # liveness: expires_at (windows) / TAT (buckets) still in the
+        # future — an expired entry carries no state either way
         live = [(c, e) for c, e in actors.values() if e - epoch_ms > now_ms]
+        if is_bucket:
+            # device-eligible buckets tick in ms, so the gossiped tick
+            # count and the abs-ms lane agree; merge is max
+            tat_rel = max((int(e - epoch_ms) for _c, e in live), default=0)
+            self._dirty_remote[slot] = (
+                0, max(0, min(tat_rel, (1 << 31) - 1))
+            )
+            return
         total = sum(c for c, _e in live)
         exp_rel = max((int(e - epoch_ms) for _c, e in live), default=0)
         self._dirty_remote[slot] = (
@@ -190,8 +256,29 @@ class TpuReplicatedStorage(TpuStorage):
         self._wire_for(key, counter)
         return cell
 
+    def _lift_big_bucket(self, key: tuple, cell: GcraValue) -> None:
+        """Max-merge live remote TATs into the local host bucket cell —
+        the shared-TAT join for beyond-device buckets. Peers gossip the
+        TAT in the limit's own ticks (count lane) with the abs-ms TAT as
+        the liveness lane; the join is idempotent so repeated lifts are
+        free. Caller holds the lock."""
+        wire = self._big_wire.get(key)
+        actors = self._remote_actors.get(wire) if wire is not None else None
+        if not actors:
+            return
+        now_abs_ms = self._clock() * 1000
+        for tat_ticks, exp_ms in actors.values():
+            if exp_ms > now_abs_ms and tat_ticks > cell.tat:
+                cell.tat = int(tat_ticks)
+
     def _big_remote(self, key: tuple, now: float):
-        """(live remote sum, max live expiry abs-ms), one actors pass."""
+        """(live remote sum, max live expiry abs-ms), one actors pass.
+        Bucket cells take the max-merge path instead: the remote share is
+        folded INTO the cell (shared TAT), so their remote sum is 0."""
+        entry = self._big.get(key)
+        if entry is not None and isinstance(entry[0], GcraValue):
+            self._lift_big_bucket(key, entry[0])
+            return 0, 0
         wire = self._big_wire.get(key)
         actors = self._remote_actors.get(wire) if wire is not None else None
         if not actors:
@@ -225,7 +312,9 @@ class TpuReplicatedStorage(TpuStorage):
                 self._big_wire[key_t] = wire
                 self._big_cell(counter, key_t)
             else:
-                slot, _fresh = self._slot_for(counter, create=True)
+                slot, fresh = self._slot_for(counter, create=True)
+                if fresh:
+                    self._clear_adopted_slot(slot)
                 self._queue_remote_sum(wire, slot)
 
     def _emit_big_counters(self, limits, namespaces, now, out) -> None:
@@ -339,13 +428,33 @@ class TpuReplicatedStorage(TpuStorage):
         with self._lock:
             now_ms = self._now_ms()
             create = key_for_counter(counter) in self._remote_actors
-            slot, _ = self._slot_for(counter, create=create)
+            slot, fresh = self._slot_for(counter, create=create)
             if slot is None:
                 return delta <= counter.max_value
-            v, _ttl = K.read_slots(
+            if fresh:
+                self._clear_adopted_slot(slot)
+            v, ttl = K.read_slots(
                 self._state, np.asarray([slot], np.int32), np.int32(now_ms)
             )
-            value = int(np.asarray(v)[0]) + self._remote_value(slot, now_ms)
+            # A freshly allocated/recycled slot's device cell is the
+            # PREVIOUS occupant's stale state — local reads are 0 until
+            # the first write (the kernel's segment-freshness rule; the
+            # remote lane was re-queued by _slot_for and flushes below).
+            if counter.limit.policy == "token_bucket":
+                # merged spent derives from the max of local and remote
+                # TAT (read_slots' ttl lane is the local base_rel)
+                self._flush_dirty_remote()
+                r_rel = max(
+                    int(np.asarray(self._remote_exp[slot])) - now_ms, 0
+                )
+                local_rel = 0 if fresh else int(np.asarray(ttl)[0])
+                value = spent_tokens(
+                    counter.max_value, counter.window_seconds,
+                    max(local_rel, r_rel),
+                )
+            else:
+                local_v = 0 if fresh else int(np.asarray(v)[0])
+                value = local_v + self._remote_value(slot, now_ms)
         return value + delta <= counter.max_value
 
     def get_counters(self, limits):
@@ -371,7 +480,22 @@ class TpuReplicatedStorage(TpuStorage):
                 rvals = np.asarray(self._remote_vals[slot_arr])
                 rexps = np.asarray(self._remote_exp[slot_arr])
                 for i, (_slot, c) in enumerate(merged):
-                    if int(rexps[i]) > now_ms:
+                    if int(rexps[i]) <= now_ms:
+                        continue
+                    if c.limit.policy == "token_bucket":
+                        # shared TAT: merged spent is the max, not a sum
+                        r_spent = spent_tokens(
+                            c.max_value, c.window_seconds,
+                            int(rexps[i]) - now_ms,
+                        )
+                        c.remaining = min(
+                            c.remaining, c.max_value - r_spent
+                        )
+                        c.expires_in = max(
+                            c.expires_in,
+                            (int(rexps[i]) - now_ms) / 1000.0,
+                        )
+                    else:
                         c.remaining -= int(rvals[i])
             # Remote-only counters: gossiped from peers, never locally hit —
             # the local cell is expired so the base pass skipped them, but
@@ -396,7 +520,16 @@ class TpuReplicatedStorage(TpuStorage):
                 rexps = np.asarray(self._remote_exp[slot_arr])
                 for i, (_slot, probe) in enumerate(candidates):
                     r, e = int(rvals[i]), int(rexps[i])
-                    if e <= now_ms or r <= 0:
+                    if e <= now_ms:
+                        continue
+                    if probe.limit.policy == "token_bucket":
+                        # remote-only bucket: spent derives from the
+                        # gossiped TAT (the count lane is unused)
+                        r = spent_tokens(
+                            probe.max_value, probe.window_seconds,
+                            e - now_ms,
+                        )
+                    if r <= 0:
                         continue
                     probe.remaining = probe.max_value - r
                     probe.expires_in = (e - now_ms) / 1000.0
@@ -439,12 +572,17 @@ class TpuReplicatedStorage(TpuStorage):
             self._parked_wires.discard(key)
             if self._is_big(counter):
                 # Host-side cell: ensure it exists so reads/emission see
-                # the remote share; admission folds it via _big_remote_sum.
+                # the remote share; admission folds it via _big_remote_sum
+                # (windows) or the TAT lift (buckets).
                 key_t = self._key_of(counter)
-                self._big_cell(counter, key_t)
+                cell = self._big_cell(counter, key_t)
                 self._big_wire[key_t] = key
+                if isinstance(cell, GcraValue):
+                    self._lift_big_bucket(key_t, cell)
                 return
-            slot, _fresh = self._slot_for(counter, create=True)
+            slot, fresh = self._slot_for(counter, create=True)
+            if fresh:
+                self._clear_adopted_slot(slot)
             self._queue_remote_sum(key, slot)
 
     def _decode_counter(self, key: bytes) -> Optional[Counter]:
@@ -486,26 +624,35 @@ class TpuReplicatedStorage(TpuStorage):
             expiry = np.asarray(self._state.expiry_ms)
             for slot, (_key, counter) in self._table.info.items():
                 if expiry[slot] > now_ms:
+                    # windows: expiry lane; buckets: the TAT — in both
+                    # cases "still in the future" means live state
                     expires_at = int(
                         self._epoch * 1000 + int(expiry[slot])
                     )
+                    if counter.limit.policy == "token_bucket":
+                        payload = {self.node_id: expires_at}
+                    else:
+                        payload = {self.node_id: int(values[slot])}
                     out.append(
-                        (
-                            key_for_counter(counter),
-                            {self.node_id: int(values[slot])},
-                            expires_at,
-                        )
+                        (key_for_counter(counter), payload, expires_at)
                     )
             now = self._clock()
             for key, (cell, counter) in self._big.items():
                 if cell.is_expired(now):
                     continue
                 wire = self._wire_for(key, counter)
+                if isinstance(cell, GcraValue):
+                    # host (beyond-device) buckets gossip TAT in their
+                    # own ticks — scale derives deterministically from
+                    # the limit, so peers agree on the unit
+                    payload = {self.node_id: int(cell.tat)}
+                else:
+                    payload = {self.node_id: min(int(cell.value_at(now)),
+                                                 (1 << 63) - 1)}
                 out.append(
                     (
                         wire,
-                        {self.node_id: min(int(cell.value_at(now)),
-                                           (1 << 63) - 1)},
+                        payload,
                         int(now * 1000 + cell.ttl(now) * 1000),
                     )
                 )
@@ -553,11 +700,15 @@ class TpuReplicatedStorage(TpuStorage):
             now_ms = self._now_ms()
             slots = []
             wire_keys = []
+            buckets = []
             for slot in touched:
                 info = self._table.info.get(slot)
                 if info is not None:
                     slots.append(slot)
                     wire_keys.append(key_for_counter(info[1]))
+                    buckets.append(
+                        info[1].limit.policy == "token_bucket"
+                    )
             if not slots:
                 return
             v, ttl = K.read_slots(
@@ -568,11 +719,17 @@ class TpuReplicatedStorage(TpuStorage):
             epoch_ms = self._epoch * 1000
         for i, key in enumerate(wire_keys):
             if ttl[i] <= 0:
+                # expired window / full bucket: nothing live to gossip
                 continue
             expires_at = int(epoch_ms + now_ms + int(ttl[i]))
-            self.broker.publish(
-                key, {self.node_id: int(v[i])}, expires_at
-            )
+            if buckets[i]:
+                # bucket state IS the TAT: for device-eligible buckets the
+                # ttl lane is base_rel = TAT - now, ticks are ms, so the
+                # count lane carries the same abs-ms TAT as expires_at
+                payload = {self.node_id: expires_at}
+            else:
+                payload = {self.node_id: int(v[i])}
+            self.broker.publish(key, payload, expires_at)
 
     def _publish_touched_big(self) -> None:
         """Gossip locally-written big cells: exact Python-int counts on
@@ -591,7 +748,11 @@ class TpuReplicatedStorage(TpuStorage):
                     continue
                 wire = self._wire_for(key, counter)
                 expires_at = int(now * 1000 + cell.ttl(now) * 1000)
-                count = min(int(cell.value_at(now)), (1 << 63) - 1)
+                if isinstance(cell, GcraValue):
+                    # bucket state IS the TAT (limit-derived ticks)
+                    count = int(cell.tat)
+                else:
+                    count = min(int(cell.value_at(now)), (1 << 63) - 1)
                 to_send.append((wire, count, expires_at))
         for wire, count, expires_at in to_send:
             self.broker.publish(wire, {self.node_id: count}, expires_at)

@@ -52,7 +52,6 @@ from ..parallel.mesh import (
 from .storage import (
     _BigLimitMixin,
     _bucket,
-    _clamp_window_ms,
     _hit_lane,
     _migrate_key,
     _Request,
@@ -450,7 +449,18 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 if self._is_big(counter):
                     self._big_cell(counter, self._key_of(counter))
                 else:
-                    self._slot_for(counter, create=True)
+                    shard, slot, fresh, is_g = self._slot_for(
+                        counter, create=True
+                    )
+                    if fresh and not is_g:
+                        # No kernel batch follows: clear a recycled local
+                        # cell (global slots are zeroed at release —
+                        # _zero_global_slots — so only locals can carry a
+                        # stale occupant here).
+                        self._state = ShardedCounterState(
+                            self._state.values.at[shard, slot].set(0),
+                            self._state.expiry_ms.at[shard, slot].set(0),
+                        )
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         self.apply_deltas([(counter, delta)])

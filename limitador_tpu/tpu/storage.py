@@ -656,7 +656,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 if self._is_big(counter):
                     self._big_cell(counter, self._key_of(counter))
                 else:
-                    self._slot_for(counter, create=True)
+                    slot, fresh = self._slot_for(counter, create=True)
+                    if fresh:
+                        # No kernel batch follows this allocation, so the
+                        # kernel's fresh-flag override can't clean a
+                        # recycled slot — clear the cell now or the next
+                        # (non-fresh) read/batch sees the old occupant.
+                        self._state = K.clear_slots(
+                            self._state, np.asarray([slot], np.int32)
+                        )
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         require_nonnegative_delta(delta)

@@ -62,6 +62,12 @@ def server_env(repo_root, **extra):
     return env
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running chaos/soak tests"
+    )
+
+
 @pytest.fixture
 def fake_clock():
     """Controllable clock so window-expiry tests don't sleep."""

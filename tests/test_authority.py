@@ -5,14 +5,12 @@ authority over gRPC — the out-of-process Redis topology
 import asyncio
 import socket
 
-import pytest
 
 from limitador_tpu import AsyncRateLimiter, Context, Limit
 from limitador_tpu.storage.authority import (
     RemoteAuthority,
     serve_authority,
 )
-from limitador_tpu.storage.base import StorageError
 from limitador_tpu.storage.cached import CachedCounterStorage
 from limitador_tpu.storage.in_memory import InMemoryStorage
 

@@ -2,7 +2,6 @@
 
 import asyncio
 
-import pytest
 
 from limitador_tpu import AsyncRateLimiter, Context, Limit
 from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage

@@ -7,7 +7,6 @@ import pytest
 
 from limitador_tpu import Context, Limit, RateLimiter
 from limitador_tpu.core.counter import Counter
-from limitador_tpu.ops import kernel as K
 from limitador_tpu.tpu.storage import TpuStorage
 
 BIG = 1 << 40

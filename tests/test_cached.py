@@ -4,7 +4,6 @@ partition revert — mirroring the reference's cached-Redis tests
 
 import asyncio
 
-import pytest
 
 from limitador_tpu import AsyncRateLimiter, Context, Limit, RateLimiter
 from limitador_tpu.storage.base import StorageError

@@ -36,7 +36,6 @@ from limitador_tpu.core.cel import (
     Context,
     EvaluationError,
     Expression,
-    NoSuchKey,
     ParseError,
     Predicate,
 )

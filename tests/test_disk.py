@@ -107,7 +107,6 @@ class TestDiskPersistence:
 def test_scan_tolerates_undecodable_keys(tmp_path):
     """Rows whose key bytes this codec can't read (foreign codec, corrupt
     row) are skipped by scans, not fatal — they age out via the sweep."""
-    import sqlite3
 
     from limitador_tpu.storage.disk import DiskStorage
 

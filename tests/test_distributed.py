@@ -9,7 +9,6 @@ eventually limited on both).
 import socket
 import time
 
-import pytest
 
 from limitador_tpu import Context, Limit, RateLimiter
 from limitador_tpu.storage.distributed import CrCounterValue, CrInMemoryStorage

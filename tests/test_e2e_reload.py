@@ -9,7 +9,6 @@ including the ConfigMap symlink-swap layout the watcher special-cases.
 """
 
 import json
-import os
 import socket
 import subprocess
 import sys

@@ -15,8 +15,6 @@ Two layers below the grpcio conformance in test_native_ingress.py:
 
 import json
 import socket
-import struct
-import time
 from pathlib import Path
 
 import pytest

@@ -1,0 +1,43 @@
+"""The lint gate rides the suite: `make check` and plain pytest both
+refuse a tree with findings (the clippy -D warnings analogue)."""
+
+from pathlib import Path
+
+from limitador_tpu.tools.lint import DEFAULT_TARGETS, lint_file, lint_paths
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_repo_is_lint_clean():
+    findings = lint_paths([REPO_ROOT / t for t in DEFAULT_TARGETS])
+    assert not findings, "\n".join(findings)
+
+
+def test_linter_catches_the_classes_it_claims(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os\n"
+        "import json, sys\n"
+        "import json\n"
+        "def f(x={}):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    if x == None:\n"
+        "        return {'a': 1, 'a': 2}\n"
+        "    return json.dumps(sys.path)\n"
+    )
+    messages = [msg for _ln, msg in lint_file(bad)]
+    assert any("unused import 'os'" in m for m in messages)
+    assert any("redefines" in m for m in messages)
+    assert any("mutable default" in m for m in messages)
+    assert any("bare 'except:'" in m for m in messages)
+    assert any("comparison to None" in m for m in messages)
+    assert any("duplicate dict keys" in m for m in messages)
+
+
+def test_noqa_suppresses(tmp_path):
+    ok = tmp_path / "ok.py"
+    ok.write_text("import os  # noqa: side-effect\n")
+    assert lint_file(ok) == []

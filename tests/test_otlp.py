@@ -9,7 +9,6 @@ partial from rounds 1-2.
 """
 
 import json
-import os
 import socket
 import subprocess
 import sys

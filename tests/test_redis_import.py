@@ -1,0 +1,212 @@
+"""Redis-keyspace migration tool (limitador_tpu/tools/redis_import.py).
+
+The decision of record: no RESP client — migration happens by decoding
+the reference's Redis keys (byte-identical postcard codec,
+tests/test_keys_postcard.py) and replaying counts through the live
+HTTP API. These tests build dump files with the same key bytes the
+reference writes and drive the tool end-to-end against a real server.
+"""
+
+import base64
+import json
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu import Limit
+from limitador_tpu.core.counter import Counter
+from limitador_tpu.storage.keys import key_for_counter
+from limitador_tpu.tools.redis_import import (
+    decode_entries,
+    main,
+    parse_dump,
+)
+from tests.conftest import server_env
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+LIMIT = Limit("api", 1000, 60, [], ["descriptors[0].u"])
+NAMED = Limit("api", 500, 3600, [], ["descriptors[0].t"], id="plan-a")
+
+
+def dump_line(counter, value, pttl=30_000):
+    key = base64.b64encode(key_for_counter(counter)).decode()
+    return f"{key} {value} {pttl}"
+
+
+def test_parse_and_decode_reference_keys():
+    lines = [
+        "# comment",
+        "",
+        dump_line(Counter(LIMIT, {"descriptors[0].u": "alice"}), 7),
+        dump_line(Counter(NAMED, {"descriptors[0].t": "gold"}), 12),
+        dump_line(Counter(LIMIT, {"descriptors[0].u": "bob"}), 3, pttl=0),
+    ]
+    entries, nil_skipped = parse_dump(lines)
+    assert nil_skipped == 0
+    assert len(entries) == 3
+    pairs, expired, unknown = decode_entries(entries, [LIMIT, NAMED])
+    assert expired == 1  # bob's window already over
+    assert unknown == 0
+    got = {
+        (str(c.namespace), tuple(sorted(c.set_variables.items()))): v
+        for c, v in pairs
+    }
+    assert got[("api", (("descriptors[0].u", "alice"),))] == 7
+    # v2 (id-prefixed) keys decode too
+    assert got[("api", (("descriptors[0].t", "gold"),))] == 12
+
+
+def test_unknown_keys_counted_not_fatal():
+    other = Limit("gone", 10, 60, [], ["descriptors[0].u"])
+    entries, _ = parse_dump(
+        [dump_line(Counter(other, {"descriptors[0].u": "x"}), 5)]
+    )
+    pairs, expired, unknown = decode_entries(entries, [LIMIT])
+    assert (pairs, expired, unknown) == ([], 0, 1)
+
+
+def test_nil_values_skipped_not_fatal():
+    """A key expiring between SCAN and GET yields a nil/missing value
+    field; that entry is counted and skipped, not a whole-import
+    abort."""
+    good = dump_line(Counter(LIMIT, {"descriptors[0].u": "a"}), 5)
+    entries, nil_skipped = parse_dump([
+        good,
+        "QQ== nil 1000",   # explicit nil value
+        "QQ== 1000",       # value field missing entirely
+    ])
+    assert nil_skipped == 2
+    assert len(entries) == 1
+
+
+def test_malformed_lines_raise_with_line_number():
+    with pytest.raises(ValueError, match="line 1"):
+        parse_dump(["not-base64!!! 5 1000"])
+    with pytest.raises(ValueError, match="line 2"):
+        parse_dump(["", "QQ== five 1000"])
+
+
+def test_send_failure_stops_and_returns_resumable_remainder():
+    """/report is a delta-add: on the first transport failure replay
+    stops and hands back the unsent tail (incl. the failed entry) so a
+    re-run cannot double-count what already landed."""
+    from limitador_tpu.tools.redis_import import replay
+
+    pairs = [
+        (Counter(LIMIT, {"descriptors[0].u": f"u{i}"}), i + 1)
+        for i in range(5)
+    ]
+    calls = []
+
+    def opener(req, timeout):
+        calls.append(req)
+        if len(calls) == 3:
+            raise OSError("connection reset")
+        return _null_cm()
+
+    sent, unreplayable, remaining, error = replay(
+        pairs, "http://unused", opener=opener
+    )
+    assert (sent, unreplayable) == (2, 0)
+    assert [v for _c, v in remaining] == [3, 4, 5]  # failed one included
+    assert "connection reset" in error
+
+
+def test_unreplayable_variable_forms_reported_not_sent():
+    from limitador_tpu.tools.redis_import import replay, values_for_replay
+
+    # canonical descriptor forms invert
+    c = Counter(LIMIT, {"descriptors[0].u": "a"})
+    assert values_for_replay(c) == {"u": "a"}
+    dotted = Limit("api", 10, 60, [], ["descriptors[0]['k.with.dots']"])
+    assert values_for_replay(
+        Counter(dotted, {"descriptors[0]['k.with.dots']": "v"})
+    ) == {"k.with.dots": "v"}
+    # a non-descriptor CEL variable has no HTTP form: counted, not sent
+    weird = Limit("api", 10, 60, [], ["size(descriptors)"])
+    calls = []
+    sent, unreplayable, remaining, error = replay(
+        [(Counter(weird, {"size(descriptors)": "1"}), 5)],
+        "http://unused",
+        opener=lambda req, timeout: calls.append(req) or _null_cm(),
+    )
+    assert (sent, unreplayable, remaining, error) == (0, 1, [], None)
+    assert not calls
+
+
+class _null_cm:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def test_end_to_end_replay_into_live_server(tmp_path):
+    limits = tmp_path / "limits.yaml"
+    limits.write_text(
+        "- namespace: api\n  max_value: 1000\n  seconds: 60\n"
+        "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
+    )
+    dump = tmp_path / "counters.dump"
+    dump.write_text("\n".join([
+        dump_line(Counter(LIMIT, {"descriptors[0].u": "alice"}), 40),
+        dump_line(Counter(LIMIT, {"descriptors[0].u": "bob"}), 9),
+    ]) + "\n")
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        http_port = s.getsockname()[1]
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        rls_port = s.getsockname()[1]
+    log = open(tmp_path / "server.log", "wb")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "limitador_tpu.server", str(limits),
+         "memory", "--rls-port", str(rls_port),
+         "--http-port", str(http_port)],
+        cwd=REPO_ROOT, env=server_env(REPO_ROOT),
+        stdout=log, stderr=subprocess.STDOUT,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{http_port}/status", timeout=1
+                ):
+                    break
+            except Exception:
+                if proc.poll() is not None or time.monotonic() > deadline:
+                    raise RuntimeError(
+                        (tmp_path / "server.log").read_text()
+                    )
+                time.sleep(0.1)
+        rc = main([
+            str(limits), str(dump),
+            "--target", f"http://127.0.0.1:{http_port}",
+        ])
+        assert rc == 0
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/counters/api", timeout=10
+        ) as resp:
+            counters = json.loads(resp.read())
+        got = {
+            c["set_variables"]["descriptors[0].u"]: c["remaining"]
+            for c in counters
+        }
+        assert got == {"alice": 960, "bob": 991}
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        log.close()

@@ -9,8 +9,6 @@ Python grpc.aio port and the C++ native ingress (whose bidi-stream
 surface, native/h2ingress.cc, exists for exactly this method).
 """
 
-import json
-import os
 import socket
 import subprocess
 import sys

@@ -14,7 +14,6 @@ import time
 
 import grpc
 import pytest
-from aiohttp import web
 
 from limitador_tpu import Limit, RateLimiter
 from limitador_tpu.observability import PrometheusMetrics

@@ -49,7 +49,6 @@ def server(tmp_path):
         "  conditions: []\n  variables: [\"descriptors[0].u\"]\n"
     )
     http_port, rls_port = free_port(), free_port()
-    import os
 
     # Logs go to a file, never a PIPE nobody drains: the access log fills
     # a 64KB pipe buffer mid-soak and freezes the server's event loop on

@@ -353,15 +353,68 @@ def test_unsupported_backends_reject_up_front(tmp_path):
         rl.add_limit(Limit("ns", 5, 1, **TB))
 
 
-def test_replicated_rejects_token_bucket():
+def test_documented_policy_topology_matrix():
+    """docs/configuration.md's policy x storage table must equal the
+    code's support flags (VERDICT r3 #7 / r4 #6: the doc drifted from
+    the implementation twice; now it is asserted against it)."""
+    import re
+    from pathlib import Path
+
+    from limitador_tpu.storage.cached import CachedCounterStorage
+    from limitador_tpu.storage.disk import DiskStorage
+    from limitador_tpu.storage.distributed import CrInMemoryStorage
+    from limitador_tpu.tpu.replicated import TpuReplicatedStorage
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    classes = {
+        "memory": InMemoryStorage,
+        "tpu": TpuStorage,
+        "sharded": TpuShardedStorage,
+        "replicated": TpuReplicatedStorage,
+        "disk": DiskStorage,
+        "distributed": CrInMemoryStorage,
+        "cached": CachedCounterStorage,
+    }
+    doc = (
+        Path(__file__).resolve().parent.parent
+        / "docs" / "configuration.md"
+    ).read_text()
+    documented = {}
+    for row in re.findall(r"^\| *`?([\w-]+)`? *(?:\(`--node-id`\) *)?\|"
+                          r" *yes *\| *(yes|no)[^|]*\|", doc, re.M):
+        name, bucket = row
+        if name in classes:
+            documented[name] = bucket == "yes"
+    assert set(documented) == set(classes), (
+        f"doc table rows {sorted(documented)} != storages "
+        f"{sorted(classes)} — keep docs/configuration.md in sync"
+    )
+    for name, supported in documented.items():
+        actual = bool(
+            getattr(classes[name], "supports_token_bucket", False)
+        )
+        assert actual == supported, (
+            f"{name}: doc says token_bucket={'yes' if supported else 'no'}"
+            f", code says {actual}"
+        )
+
+
+def test_replicated_supports_token_bucket():
+    """r5: the replicated topology carries token buckets (shared TAT
+    max-merge CRDT — see tests/test_tpu_replicated.py for gossip laws);
+    every topology now accepts the policy."""
     from limitador_tpu.tpu.replicated import TpuReplicatedStorage
 
     storage = TpuReplicatedStorage(node_id="n1", listen_address=None,
                                    capacity=1 << 10)
     rl = RateLimiter(storage)
     try:
-        with pytest.raises(ValueError, match="token_bucket"):
-            rl.add_limit(Limit("ns", 5, 1, **TB))
+        # 60s window (I=12s): no refill mid-test even across a slow
+        # first XLA compile of the replicated kernel
+        rl.add_limit(Limit("ns", 5, 60, **TB))
+        got = [rl.check_rate_limited_and_update("ns", ctx_for(), 1).limited
+               for _ in range(7)]
+        assert got == [False] * 5 + [True] * 2
     finally:
         storage.close()
 
@@ -493,7 +546,6 @@ def test_server_e2e_token_bucket(tmp_path):
     gRPC with the native pipeline (which must route the namespace to the
     exact path), DTO exposes the policy."""
     import json
-    import os
     import socket
     import subprocess
     import sys

@@ -266,3 +266,33 @@ def test_snapshot_restore_roundtrip(tmp_path):
     assert not r.limited
     assert limiter2.check_rate_limited_and_update(
         "ns", Context({"u": "a"}), 1).limited
+
+
+def test_add_counter_on_recycled_slot_starts_clean():
+    """r5 review follow-up: add_counter allocates WITHOUT a following
+    kernel batch, so a slot recycled from an evicted/deleted counter
+    must be cleared at allocation — otherwise the first (non-fresh)
+    check reads the previous occupant's live cell."""
+    clock = FakeClock()
+    storage = TpuStorage(capacity=1 << 6, clock=clock)
+    limiter = RateLimiter(storage)
+    old = Limit("old", 10, 3600, [], [])
+    limiter.add_limit(old)
+    # occupy the simple slot with a near-full live window
+    limiter.check_rate_limited_and_update("old", Context({}), 9)
+    storage.delete_counters({old})
+    # the freed slot is recycled for a NEW simple counter via
+    # add_counter... (delete_counters clears; force the dirtier path by
+    # evicting a qualified occupant instead)
+    q = Limit("q", 10, 3600, [], ["u"])
+    limiter.add_limit(q)
+    for u in range(1 << 6):  # roll through the whole table, evicting
+        limiter.check_rate_limited_and_update("q", Context({"u": str(u)}), 9)
+    fresh = Limit("fresh", 10, 3600, [], [])
+    limiter.add_limit(fresh)  # add_counter allocates a recycled slot
+    # all 10 units are available on the brand-new counter
+    got = [
+        limiter.check_rate_limited_and_update("fresh", Context({}), 1).limited
+        for _ in range(11)
+    ]
+    assert got == [False] * 10 + [True]

@@ -3,7 +3,6 @@
 import socket
 import time
 
-import pytest
 
 from limitador_tpu import Context, Limit, RateLimiter
 from limitador_tpu.tpu.replicated import TpuReplicatedStorage
@@ -300,6 +299,281 @@ def test_remote_only_counters_visible_in_get_counters():
             return {c.set_variables["u"]: c.remaining for c in counters}
 
         assert eventually(lambda: b_view().get("ghost") == 7), b_view()
+    finally:
+        a.close()
+        b.close()
+
+
+# -- token buckets: shared TAT max-merge CRDT (r5) ---------------------------
+#
+# A GCRA bucket's whole state is its TAT; admission advances it
+# (max(TAT, now) + d*I) and gossip merges it by per-actor max — monotone,
+# commutative, associative, idempotent, the same join-semilattice shape as
+# the expiry merge in the reference's CRDT counters
+# (cr_counter_value.rs:77-113). Over-admission is bounded by what peers
+# admit within one gossip period (concurrent spends collapse to their max).
+
+TB = dict(conditions=[], variables=["u"], policy="token_bucket")
+
+
+class FakeClock:
+    def __init__(self, now=1_700_000_000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _bucket_wire(limit, u="x"):
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.storage.keys import key_for_counter
+
+    return key_for_counter(Counter(limit, {"u": u}))
+
+
+def test_bucket_tat_merge_laws():
+    """Idempotent + commutative + monotone: re-delivered and re-ordered
+    gossip must land on the same merged TAT; an older TAT never regresses
+    a newer one."""
+    clock = FakeClock()
+    now_ms = int(clock.now * 1000)
+    limit = Limit("tb", 5, 60, **TB)  # I = 12s
+
+    def merged_spent(updates):
+        storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+        try:
+            limiter = RateLimiter(storage)
+            limiter.add_limit(limit)
+            wire = _bucket_wire(limit)
+            for actor, tat_abs in updates:
+                storage._on_remote_update(wire, {actor: tat_abs}, tat_abs)
+            counters = limiter.get_counters("tb")
+            return {c.remaining for c in counters}
+        finally:
+            storage.close()
+
+    t3 = now_ms + 3 * 12_000  # a TAT 3 tokens ahead
+    t2 = now_ms + 2 * 12_000
+    once = merged_spent([("A", t3)])
+    assert once == {2}  # 3 of 5 spent
+    # idempotent: the same update re-delivered changes nothing
+    assert merged_spent([("A", t3), ("A", t3)]) == once
+    # monotone: an older (smaller) TAT from the same actor is absorbed
+    assert merged_spent([("A", t3), ("A", t2)]) == once
+    # commutative across actors: merge order is irrelevant; the shared
+    # TAT is the max, not the sum
+    assert merged_spent([("A", t3), ("B", t2)]) == {2}
+    assert merged_spent([("B", t2), ("A", t3)]) == {2}
+
+
+def test_bucket_remote_tat_bounds_local_admission():
+    """A peer's gossiped TAT raises the local admission base: only the
+    unspent remainder admits locally, and local spending persists the
+    JOIN (so this node's gossip carries the merged TAT onward)."""
+    clock = FakeClock()
+    now_ms = int(clock.now * 1000)
+    limit = Limit("tb", 5, 60, **TB)
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limiter.add_limit(limit)
+        # peer A spent 3 of 5: TAT = now + 3*I
+        tat = now_ms + 3 * 12_000
+        storage._on_remote_update(_bucket_wire(limit), {"A": tat}, tat)
+        ctx = Context({"u": "x"})
+        outs = [
+            limiter.check_rate_limited_and_update("tb", ctx, 1).limited
+            for _ in range(3)
+        ]
+        assert outs == [False, False, True]  # exactly 2 remained
+        # the local cell now holds the join: remaining 0 in the view
+        counters = limiter.get_counters("tb")
+        assert {c.remaining for c in counters} == {0}
+    finally:
+        storage.close()
+
+
+def test_bucket_remote_tat_refills_with_real_time():
+    """The gossiped TAT is state, not a count: once wall-clock passes it,
+    the bucket is full again with NO further gossip (continuous refill —
+    the property a count-sum replication could not express)."""
+    clock = FakeClock()
+    now_ms = int(clock.now * 1000)
+    limit = Limit("tb", 5, 60, **TB)
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limiter.add_limit(limit)
+        tat = now_ms + 5 * 12_000  # peer emptied the bucket
+        storage._on_remote_update(_bucket_wire(limit), {"A": tat}, tat)
+        ctx = Context({"u": "x"})
+        assert limiter.check_rate_limited_and_update("tb", ctx, 1).limited
+        clock.now += 2 * 12.0 + 0.5  # two tokens refill
+        outs = [
+            limiter.check_rate_limited_and_update("tb", ctx, 1).limited
+            for _ in range(3)
+        ]
+        assert outs == [False, False, True]
+    finally:
+        storage.close()
+
+
+def test_recycled_slot_read_ignores_stale_occupant():
+    """r5 review: is_within_limits on a counter whose slot was just
+    recycled from an evicted occupant must not read the old cell — an
+    idle bucket was falsely denied (old window expiry read as a huge
+    TAT), and the window branch read the old value."""
+    clock = FakeClock()
+    storage = TpuReplicatedStorage(
+        "me", capacity=64, cache_size=2, clock=clock
+    )
+    try:
+        limiter = RateLimiter(storage)
+        window = Limit("w", 10, 3600, [], ["u"])
+        bucket = Limit("tb", 10, 60, **TB)
+        limiter.add_limit(window)
+        limiter.add_limit(bucket)
+        # fill the qualified cache with far-future fixed windows
+        for u in ("a", "b"):
+            limiter.check_rate_limited_and_update(
+                "w", Context({"u": u}), 9
+            )
+        # gossip arrives for a NEW bucket counter: adopting it recycles
+        # an evicted window slot whose cell still holds expiry ~3600s
+        now_ms = int(clock.now * 1000)
+        wire = _bucket_wire(bucket, "fresh")
+        storage._on_remote_update(wire, {"peer": now_ms}, now_ms)
+        ctx = Context({"u": "fresh"})
+        # all 10 tokens are available (remote TAT is in the past)
+        assert not limiter.is_rate_limited("tb", ctx, 10).limited
+        # window branch analogue: a new window counter on a recycled
+        # slot reads 0, not the old occupant's 9
+        wwire = _bucket_wire(window, "c")
+        storage._on_remote_update(wwire, {"peer": 1}, now_ms + 3_600_000)
+        assert not limiter.is_rate_limited(
+            "w", Context({"u": "c"}), 9
+        ).limited
+    finally:
+        storage.close()
+
+
+def test_two_nodes_converge_on_shared_bucket():
+    """End-to-end over real brokers: A spends, B sees the spend, B's own
+    spend flows back to A; both converge on an empty bucket."""
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [f"127.0.0.1:{p1}"],
+        capacity=256, gossip_period=0.02,
+    )
+    b = TpuReplicatedStorage(
+        "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+        capacity=256, gossip_period=0.02,
+    )
+    try:
+        limit = Limit("tb", 5, 600, **TB)  # I = 120s: no refill in-test
+        la, lb = RateLimiter(a), RateLimiter(b)
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"u": "shared"})
+        for _ in range(3):
+            assert not la.check_rate_limited_and_update(
+                "tb", ctx, 1
+            ).limited
+        # B absorbs A's 3 spent tokens
+        assert eventually(
+            lambda: lb.is_rate_limited("tb", ctx, 3).limited
+        ), "B never saw A's bucket spend"
+        assert not lb.is_rate_limited("tb", ctx, 2).limited
+        # B spends the remainder; A converges on empty
+        assert not lb.check_rate_limited_and_update("tb", ctx, 2).limited
+        assert lb.check_rate_limited_and_update("tb", ctx, 1).limited
+        assert eventually(
+            lambda: la.is_rate_limited("tb", ctx, 1).limited
+        ), "A never saw B's bucket spend"
+        # merged admin view agrees on both nodes
+        assert eventually(lambda: {
+            c.remaining for c in la.get_counters("tb")
+        } == {0} and {
+            c.remaining for c in lb.get_counters("tb")
+        } == {0})
+    finally:
+        a.close()
+        b.close()
+
+
+def test_bucket_late_joiner_resync():
+    """Re-sync snapshots carry bucket TATs: a late joiner absorbs the
+    spend it never witnessed."""
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [], capacity=256, gossip_period=0.03
+    )
+    try:
+        limit = Limit("tb", 5, 600, **TB)
+        la = RateLimiter(a)
+        la.add_limit(limit)
+        ctx = Context({"u": "x"})
+        for _ in range(4):
+            la.check_rate_limited_and_update("tb", ctx, 1)
+        b = TpuReplicatedStorage(
+            "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+            capacity=256, gossip_period=0.03,
+        )
+        try:
+            lb = RateLimiter(b)
+            lb.add_limit(limit)
+            assert eventually(
+                lambda: not lb.is_rate_limited("tb", ctx, 1).limited
+                and lb.is_rate_limited("tb", ctx, 2).limited
+            ), "late joiner never absorbed A's bucket TAT"
+        finally:
+            b.close()
+    finally:
+        a.close()
+
+
+def test_big_bucket_gossips_tat_in_native_ticks():
+    """Beyond-device buckets (µs ticks) replicate too: the wire carries
+    the TAT in the limit's own ticks, and both nodes derive the same
+    scale from the limit, so the merge is exact."""
+    p0, p1 = free_port(), free_port()
+    a = TpuReplicatedStorage(
+        "A", f"127.0.0.1:{p0}", [f"127.0.0.1:{p1}"],
+        capacity=256, gossip_period=0.02,
+    )
+    b = TpuReplicatedStorage(
+        "B", f"127.0.0.1:{p1}", [f"127.0.0.1:{p0}"],
+        capacity=256, gossip_period=0.02,
+    )
+    try:
+        # 600k tokens / 60s = 10k/s -> µs ticks, not device-eligible
+        limit = Limit("tb", 600_000, 60, **TB)
+        assert a._is_big(__import__(
+            "limitador_tpu.core.counter", fromlist=["Counter"]
+        ).Counter(limit, {"u": "x"}))
+        la, lb = RateLimiter(a), RateLimiter(b)
+        la.add_limit(limit)
+        lb.add_limit(limit)
+        ctx = Context({"u": "x"})
+        # A drains most of the burst in one bite
+        assert not la.check_rate_limited_and_update(
+            "tb", ctx, 599_000
+        ).limited
+
+        def b_sees():
+            counters = lb.get_counters("tb")
+            if not counters:
+                return False
+            # refill runs at 10k/s while gossip flows; accept the window
+            rem = next(iter(counters)).remaining
+            return 1000 <= rem < 40_000
+
+        assert eventually(b_sees), (
+            f"B never absorbed A's big-bucket TAT: "
+            f"{[c.remaining for c in lb.get_counters('tb')]}"
+        )
+        # B's admission is bounded by the merged TAT, not a fresh bucket
+        assert lb.is_rate_limited("tb", ctx, 590_000).limited
     finally:
         a.close()
         b.close()
