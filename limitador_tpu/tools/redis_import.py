@@ -99,10 +99,15 @@ def parse_dump(
         if not line or line.startswith("#"):
             continue
         parts = line.split()
-        if len(parts) == 2 or (len(parts) == 3 and parts[1] == "nil"):
+        if len(parts) == 3 and parts[1] == "nil":
             nil_skipped += 1
             continue
         if len(parts) != 3:
+            # A 2-field line is ambiguous: "key pttl" (nil value from a
+            # hand-rolled export) is indistinguishable from a TRUNCATED
+            # "key value" whose counter would silently vanish — refuse
+            # and make the operator look (only an explicit 'nil' value
+            # field takes the skip path).
             raise ValueError(f"line {n}: expected 'key value pttl'")
         try:
             key = base64.b64decode(parts[0], validate=True)

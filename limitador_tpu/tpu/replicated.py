@@ -109,7 +109,12 @@ class TpuReplicatedStorage(TpuStorage):
     # and persists the join; cross-node over-admission is bounded by what
     # peers admit within one gossip period (concurrent spends collapse to
     # their max at merge), the same bounded-inaccuracy contract as the
-    # fixed-window read-as-sum.
+    # fixed-window read-as-sum. One documented divergence: the
+    # UNCONDITIONAL update path (update_counter / apply_deltas — the
+    # Report role) advances the local TAT without folding the remote
+    # floor (update_core takes no hook); the remote cap still applies at
+    # every CHECK, and the join repairs at the next admitted check or
+    # gossip merge — same bounded window as above.
     supports_token_bucket = True
 
     def __init__(

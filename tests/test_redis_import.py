@@ -72,17 +72,16 @@ def test_unknown_keys_counted_not_fatal():
 
 
 def test_nil_values_skipped_not_fatal():
-    """A key expiring between SCAN and GET yields a nil/missing value
-    field; that entry is counted and skipped, not a whole-import
-    abort."""
+    """A key expiring between SCAN and GET yields an explicit nil value
+    field; that entry is counted and skipped, not a whole-import abort.
+    A TWO-field line stays fatal: it is indistinguishable from a
+    truncated 'key value' whose counter would silently vanish."""
     good = dump_line(Counter(LIMIT, {"descriptors[0].u": "a"}), 5)
-    entries, nil_skipped = parse_dump([
-        good,
-        "QQ== nil 1000",   # explicit nil value
-        "QQ== 1000",       # value field missing entirely
-    ])
-    assert nil_skipped == 2
+    entries, nil_skipped = parse_dump([good, "QQ== nil 1000"])
+    assert nil_skipped == 1
     assert len(entries) == 1
+    with pytest.raises(ValueError, match="line 1"):
+        parse_dump(["QQ== 42"])  # truncated mid-write: refuse
 
 
 def test_malformed_lines_raise_with_line_number():

@@ -3,6 +3,7 @@
 import socket
 import time
 
+import pytest
 
 from limitador_tpu import Context, Limit, RateLimiter
 from limitador_tpu.tpu.replicated import TpuReplicatedStorage
@@ -499,6 +500,86 @@ def test_two_nodes_converge_on_shared_bucket():
     finally:
         a.close()
         b.close()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_randomized_gossip_convergence(seed):
+    """Property test, no sockets: three nodes take random local traffic
+    (both policies) while snapshots are delivered between random pairs
+    with random duplication and reordering — the CRDT laws must make
+    every delivery schedule converge to identical merged views once a
+    full exchange happens, with no budget re-minting."""
+    import random
+
+    rng = random.Random(seed)
+    clock = FakeClock()
+    nodes = [
+        TpuReplicatedStorage(n, capacity=256, clock=clock) for n in "ABC"
+    ]
+    limiters = [RateLimiter(s) for s in nodes]
+    window = Limit("w", 40, 600, [], ["u"])
+    bucket = Limit("tb", 30, 600, **TB)
+    for lim in limiters:
+        lim.add_limit(window)
+        lim.add_limit(bucket)
+    users = ["u1", "u2"]
+
+    def deliver(src, dst):
+        """Gossip/re-sync delivery src -> dst (the broker's payload path
+        without the wire)."""
+        for key, values, expires_at in src._snapshot_for_peer():
+            dst._on_remote_update(key, dict(values), expires_at)
+
+    try:
+        admitted = 0
+        for _step in range(120):
+            op = rng.random()
+            node = rng.randrange(3)
+            if op < 0.7:
+                ns = "w" if rng.random() < 0.5 else "tb"
+                ctx = Context({"u": rng.choice(users)})
+                if not limiters[node].check_rate_limited_and_update(
+                    ns, ctx, 1
+                ).limited:
+                    admitted += 1
+            else:
+                dst = rng.randrange(3)
+                if dst != node:
+                    deliver(nodes[node], nodes[dst])
+                    if rng.random() < 0.3:  # duplicated delivery
+                        deliver(nodes[node], nodes[dst])
+            if rng.random() < 0.1:
+                clock.now += rng.random()
+
+        # full exchange, twice (idempotence), in a random order
+        pairs = [(i, j) for i in range(3) for j in range(3) if i != j]
+        for _ in range(2):
+            rng.shuffle(pairs)
+            for i, j in pairs:
+                deliver(nodes[i], nodes[j])
+
+        def view(lim, ns):
+            return {
+                (tuple(sorted(c.set_variables.items()))): c.remaining
+                for c in lim.get_counters(ns)
+            }
+
+        for ns in ("w", "tb"):
+            views = [view(lim, ns) for lim in limiters]
+            assert views[0] == views[1] == views[2], (
+                f"seed {seed} ns {ns}: diverged {views}"
+            )
+        # no re-minting: each user's merged window spend never exceeds
+        # what was actually admitted in total
+        total_spent = sum(
+            window.max_value - r for r in view(limiters[0], "w").values()
+        ) + sum(
+            bucket.max_value - r for r in view(limiters[0], "tb").values()
+        )
+        assert total_spent <= admitted, (total_spent, admitted)
+    finally:
+        for s in nodes:
+            s.close()
 
 
 def test_bucket_late_joiner_resync():
