@@ -211,18 +211,19 @@ class _LimitsRegistry:
 
 def _check_policy_supported(counters, limit: Limit) -> None:
     """Backends opt into non-fixed-window policies with a
-    ``supports_token_bucket = True`` class attribute (in-memory oracle
-    and the TPU storages). Persistence/replication backends whose cell
-    formats are fixed-window-shaped (disk rows, CRDT per-actor counts,
-    write-behind deltas) reject the limit up front rather than
-    mis-counting it."""
+    ``supports_token_bucket = True`` class attribute — as of r5 that is
+    every backend except the write-behind cache, whose batched deltas
+    are inherently additive (a TAT is state, not a sum); it rejects the
+    limit up front rather than mis-counting it. The doc matrix in
+    docs/configuration.md is pinned to these flags by
+    tests/test_token_bucket.py."""
     if limit.policy == "token_bucket" and not getattr(
         counters, "supports_token_bucket", False
     ):
         raise ValueError(
             f"limit policy 'token_bucket' is not supported by "
-            f"{type(counters).__name__}; supported on the in-memory and "
-            "tpu storages"
+            f"{type(counters).__name__} (no supports_token_bucket flag; "
+            "see docs/configuration.md's policy matrix)"
         )
 
 

@@ -13,6 +13,15 @@ rocksdb_storage.rs:160-169).
 Keys use the binary versioned codec from keys.py (the reference's binary
 v2, keys.rs:236-298); counters are re-attached to live limits on read via
 ``partial_counter_from_key``.
+
+Token buckets (r5): a GCRA cell's whole state is its TAT, so a bucket
+row persists the TAT twice — EXACT integer ticks in the ``value``
+column (the state of record; ticks follow the limit's ``unit_scale``)
+and float seconds in the ``expiry`` column, which is purely the
+liveness/sweep lane: a TAT in the past IS a full bucket, so the
+fixed-window expiry filter and the opportunistic sweep cover both
+policies unchanged. Reads hydrate a ``GcraValue`` from the ticks; the
+float column's ~µs rounding never touches token arithmetic.
 """
 
 from __future__ import annotations
@@ -25,6 +34,7 @@ from typing import List, Optional, Set
 from ..core.counter import Counter
 from ..core.limit import Limit
 from .base import Authorization, CounterStorage, StorageError
+from .gcra import cell_for_limit
 from .keys import LimitKeyIndex, key_for_counter, partial_counter_from_key
 
 __all__ = ["DiskStorage"]
@@ -33,6 +43,8 @@ _SWEEP_EVERY = 1000  # ops between expired-row sweeps
 
 
 class DiskStorage(CounterStorage):
+    supports_token_bucket = True  # TAT rows, module docstring
+
     def __init__(self, path: str, clock=time.time):
         self._clock = clock
         self._lock = threading.RLock()
@@ -73,12 +85,21 @@ class DiskStorage(CounterStorage):
         return int(row[0]), float(row[1])
 
     def _merge(self, counter: Counter, key: bytes, delta: int, now: float) -> None:
-        """ExpiringValue.update semantics: reset on expiry, else add."""
-        value, expiry = self._read(key, now)
-        if expiry is None:
-            value, expiry = delta, now + counter.window_seconds
+        """ExpiringValue.update semantics: reset on expiry, else add.
+        Bucket rows advance the TAT instead (GcraValue.update)."""
+        if counter.limit.policy == "token_bucket":
+            cell = cell_for_limit(counter.limit)
+            tat, _expiry = self._read(key, now)
+            cell.tat = tat  # 0 when missing/expired = full bucket
+            cell.update(int(delta), counter.window_seconds, now)
+            value = int(cell.tat)
+            expiry = cell.tat / (1000.0 * cell.scale)
         else:
-            value += delta
+            value, expiry = self._read(key, now)
+            if expiry is None:
+                value, expiry = delta, now + counter.window_seconds
+            else:
+                value += delta
         self._db.execute(
             "INSERT INTO counters (key, namespace, value, expiry)"
             " VALUES (?, ?, ?, ?)"
@@ -87,12 +108,35 @@ class DiskStorage(CounterStorage):
             (key, str(counter.namespace), value, expiry),
         )
 
+    @staticmethod
+    def _hydrate(counter: Counter, value: int, expiry, now: float):
+        """THE row -> (admission value, expires_in) rule, one definition
+        for the point reads and the namespace scan: spent tokens +
+        time-to-full for buckets (value column = TAT ticks); accumulated
+        count + window remainder (full window when no live row) for
+        windows."""
+        if counter.limit.policy == "token_bucket":
+            cell = cell_for_limit(counter.limit)
+            cell.tat = int(value)
+            return cell.value_at(now), cell.ttl(now)
+        return int(value), (
+            (float(expiry) - now)
+            if expiry is not None
+            else float(counter.window_seconds)
+        )
+
+    def _value_and_ttl(self, counter: Counter, key: bytes, now: float):
+        value, expiry = self._read(key, now)
+        return self._hydrate(counter, value, expiry, now)
+
     # -- CounterStorage ----------------------------------------------------
 
     def is_within_limits(self, counter: Counter, delta: int) -> bool:
         now = self._clock()
         with self._lock:
-            value, _ = self._read(key_for_counter(counter), now)
+            value, _ttl = self._value_and_ttl(
+                counter, key_for_counter(counter), now
+            )
         return value + delta <= counter.max_value
 
     def add_counter(self, limit: Limit) -> None:
@@ -127,18 +171,14 @@ class DiskStorage(CounterStorage):
                 keys = [key_for_counter(c) for c in counters]
                 to_update = []
                 for counter, key in zip(counters, keys):
-                    value, expiry = self._read(key, now)
+                    value, ttl = self._value_and_ttl(counter, key, now)
                     if load_counters:
                         remaining = counter.max_value - (value + delta)
                         counter.remaining = max(remaining, 0)
-                        # Missing/expired row reports the full window (the
-                        # write below opens one), matching the reference
-                        # RocksDB backend and the in-memory oracle.
-                        counter.expires_in = (
-                            (expiry - now)
-                            if expiry is not None
-                            else float(counter.window_seconds)
-                        )
+                        # Windows: missing/expired row reports the full
+                        # window (the write below opens one) — reference
+                        # RocksDB / oracle parity. Buckets: time-to-full.
+                        counter.expires_in = ttl
                         if first_limited is None and remaining < 0:
                             first_limited = Authorization.limited_by(
                                 counter.limit.name
@@ -184,8 +224,9 @@ class DiskStorage(CounterStorage):
             counter = self._decode(bytes(key), index)
             if counter is None:
                 continue
-            counter.remaining = counter.max_value - int(value)
-            counter.expires_in = float(expiry) - now
+            spent, ttl = self._hydrate(counter, value, expiry, now)
+            counter.remaining = counter.max_value - spent
+            counter.expires_in = ttl
             out.add(counter)
         return out
 
@@ -226,10 +267,7 @@ class DiskStorage(CounterStorage):
                 for counter, delta in items:
                     key = key_for_counter(counter)
                     self._merge(counter, key, delta, now)
-                    value, expiry = self._read(key, now)
-                    out.append(
-                        (value, (expiry - now) if expiry else 0.0)
-                    )
+                    out.append(self._value_and_ttl(counter, key, now))
                 self._db.commit()
             except sqlite3.Error as exc:
                 self._fail(exc)

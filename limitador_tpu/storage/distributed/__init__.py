@@ -20,9 +20,9 @@ from ...core.counter import Counter
 from ...core.limit import Limit
 from ..base import Authorization, CounterStorage
 from ..keys import LimitKeyIndex, key_for_counter, partial_counter_from_key
-from .cr_counter_value import CrCounterValue
+from .cr_counter_value import CrCounterValue, CrTatValue
 
-__all__ = ["CrInMemoryStorage", "CrCounterValue"]
+__all__ = ["CrInMemoryStorage", "CrCounterValue", "CrTatValue"]
 
 
 class _Entry:
@@ -34,6 +34,10 @@ class _Entry:
 
 
 class CrInMemoryStorage(CounterStorage):
+    # Token buckets replicate as a shared TAT max-merged over gossip
+    # (CrTatValue — r5; same contract as tpu/replicated.py).
+    supports_token_bucket = True
+
     def __init__(
         self,
         node_id: str,
@@ -97,14 +101,35 @@ class CrInMemoryStorage(CounterStorage):
 
     # -- internals -------------------------------------------------------------
 
+    def _coerce_policy(self, entry: _Entry, counter: Counter) -> None:
+        """Remote updates can land before the limit is configured here:
+        the shell is a window CRDT holding what the wire carried. For a
+        bucket counter that payload was TAT ticks — adopt the join
+        (per-actor max) into the TAT cell. Caller holds the lock."""
+        if (
+            counter.limit.policy == "token_bucket"
+            and isinstance(entry.value, CrCounterValue)
+        ):
+            values, _expiry = entry.value.snapshot()
+            entry.value = CrTatValue(
+                self.node_id, counter.limit,
+                max(values.values(), default=0),
+            )
+
     def _entry_for(self, counter: Counter, now: float) -> _Entry:
         key = key_for_counter(counter)
         entry = self._counters.get(key)
         if entry is None:
-            entry = _Entry(
-                key, CrCounterValue(self.node_id, counter.window_seconds, now)
-            )
+            if counter.limit.policy == "token_bucket":
+                value = CrTatValue(self.node_id, counter.limit)
+            else:
+                value = CrCounterValue(
+                    self.node_id, counter.window_seconds, now
+                )
+            entry = _Entry(key, value)
             self._counters[key] = entry
+        else:
+            self._coerce_policy(entry, counter)
         return entry
 
     # -- CounterStorage ----------------------------------------------------------
@@ -113,6 +138,8 @@ class CrInMemoryStorage(CounterStorage):
         now = self._clock()
         with self._lock:
             entry = self._counters.get(key_for_counter(counter))
+            if entry is not None:
+                self._coerce_policy(entry, counter)
             value = entry.value.read_at(now) if entry else 0
         return value + delta <= counter.max_value
 
@@ -139,11 +166,16 @@ class CrInMemoryStorage(CounterStorage):
                 if load_counters:
                     remaining = counter.max_value - (value + delta)
                     counter.remaining = max(remaining, 0)
-                    counter.expires_in = (
-                        entry.value.ttl(now)
-                        if not entry.value.expired_at(now)
-                        else counter.window_seconds
-                    )
+                    if counter.limit.policy == "token_bucket":
+                        # bucket expires_in is time-to-full (0 = full);
+                        # there is no fresh-window display case
+                        counter.expires_in = entry.value.ttl(now)
+                    else:
+                        counter.expires_in = (
+                            entry.value.ttl(now)
+                            if not entry.value.expired_at(now)
+                            else counter.window_seconds
+                        )
                     if first_limited is None and remaining < 0:
                         first_limited = Authorization.limited_by(
                             counter.limit.name
@@ -171,22 +203,30 @@ class CrInMemoryStorage(CounterStorage):
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
         now = self._clock()
         out: Set[Counter] = set()
-        # Values are read under the lock: the broker thread's merge_at
-        # mutates the same per-actor dicts.
-        with self._lock:
-            live = [
-                (entry.key, entry.value.read_at(now), entry.value.ttl(now))
-                for entry in self._counters.values()
-                if not entry.value.expired_at(now)
-            ]
         index = LimitKeyIndex(limits)
-        for key, value, ttl in live:
-            counter = self._decode(key, index)
-            if counter is None:
-                continue
-            counter.remaining = counter.max_value - value
-            counter.expires_in = ttl
-            out.add(counter)
+        # Keys decode OUTSIDE the lock (the scan cost must not stall the
+        # broker's merges or the check path); the second, short locked
+        # pass coerces policy shells — a bucket key whose entry is still
+        # a window shell from pre-configuration gossip must not have its
+        # ticks read as counts — and reads the values the broker thread
+        # mutates.
+        with self._lock:
+            snapshot = list(self._counters.values())
+        decoded = [
+            (entry, counter)
+            for entry in snapshot
+            if (counter := self._decode(entry.key, index)) is not None
+        ]
+        with self._lock:
+            for entry, counter in decoded:
+                self._coerce_policy(entry, counter)
+                if entry.value.expired_at(now):
+                    continue
+                counter.remaining = (
+                    counter.max_value - entry.value.read_at(now)
+                )
+                counter.expires_in = entry.value.ttl(now)
+                out.add(counter)
         return out
 
     def delete_counters(self, limits: Set[Limit]) -> None:

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 from typing import Dict, Tuple
 
-__all__ = ["CrCounterValue"]
+__all__ = ["CrCounterValue", "CrTatValue"]
 
 
 class CrCounterValue:
@@ -92,4 +92,60 @@ class CrCounterValue:
         return (
             f"CrCounterValue(actor={self.ourselves!r}, own={self.own}, "
             f"others={self.others!r}, expiry={self.expiry})"
+        )
+
+
+class CrTatValue:
+    """Shared-TAT token-bucket CRDT (r5 extension; the reference is
+    fixed-window only). The whole state is ONE integer — the GCRA TAT in
+    the limit's ticks: local admission advances it
+    (``max(TAT, now) + d*I``) and merge takes the max over every actor's
+    TAT — monotone, idempotent, commutative, the same join-semilattice
+    shape as the window merge above (and as tpu/replicated.py's device
+    lane). Speaks the CrCounterValue surface so the storage stays
+    cell-agnostic; on the wire the count lane carries ``tat_ticks`` and
+    expires_at carries the TAT in abs ms (the liveness lane — a TAT in
+    the past is a full bucket, i.e. no live state)."""
+
+    __slots__ = ("ourselves", "cell")
+
+    def __init__(self, actor: str, limit, tat_ticks: int = 0):
+        from ..gcra import GcraValue
+
+        self.ourselves = actor
+        self.cell = GcraValue(limit.max_value, limit.seconds)
+        self.cell.tat = int(tat_ticks)
+
+    def expired_at(self, now: float) -> bool:
+        return self.cell.is_expired(now)
+
+    def read_at(self, now: float) -> int:
+        return self.cell.value_at(now)
+
+    def ttl(self, now: float) -> float:
+        return self.cell.ttl(now)
+
+    def inc_at(self, increment: int, window_seconds: float, now: float) -> None:
+        self.cell.update(increment, window_seconds, now)
+
+    def merge_at(
+        self, values: Dict[str, int], expiry: float, now: float
+    ) -> None:
+        """Join: the shared TAT is the max over actors (the per-actor
+        attribution of the window CRDT is unnecessary — max of per-actor
+        maxes == global max, and it is what admission consults)."""
+        tat = max(values.values(), default=0)
+        if tat > self.cell.tat:
+            self.cell.tat = int(tat)
+
+    def snapshot(self) -> Tuple[Dict[str, int], float]:
+        return (
+            {self.ourselves: int(self.cell.tat)},
+            self.cell.tat / (1000.0 * self.cell.scale),
+        )
+
+    def __repr__(self) -> str:
+        return (
+            f"CrTatValue(actor={self.ourselves!r}, tat={self.cell.tat}, "
+            f"scale={self.cell.scale})"
         )

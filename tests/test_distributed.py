@@ -232,3 +232,114 @@ class TestBrokerHealth:
         packet = broker._membership_packet()
         peers = {p.peer_id: p.latency for p in packet.membership_update.peers}
         assert peers["peer1"] == 7
+
+
+class TestCrTatValue:
+    """Shared-TAT bucket CRDT laws (r5) — the token-bucket analogue of
+    the window merge laws above."""
+
+    def _limit(self):
+        return Limit("tb", 5, 60, [], ["u"], policy="token_bucket")
+
+    def test_local_spend_and_refill(self):
+        from limitador_tpu.storage.distributed import CrTatValue
+
+        v = CrTatValue("a", self._limit())
+        now = 1000.0
+        v.inc_at(3, 60, now)          # 3 of 5 spent, I = 12s
+        assert v.read_at(now) == 3
+        assert v.ttl(now) == 36.0     # time-to-full
+        assert v.read_at(now + 12.5) == 2  # continuous refill
+
+    def test_merge_is_max_idempotent_commutative(self):
+        from limitador_tpu.storage.distributed import CrTatValue
+
+        limit = self._limit()
+        now = 1000.0
+        now_ticks = int(now * 1000)
+        t3, t2 = now_ticks + 3 * 12_000, now_ticks + 2 * 12_000
+
+        def merged(deliveries):
+            v = CrTatValue("me", limit)
+            for payload in deliveries:
+                v.merge_at(payload, 0.0, now)
+            return v.read_at(now)
+
+        assert merged([{"a": t3}]) == 3
+        assert merged([{"a": t3}, {"a": t3}]) == 3        # idempotent
+        assert merged([{"a": t3}, {"b": t2}]) == 3        # max, not sum
+        assert merged([{"b": t2}, {"a": t3}]) == 3        # commutative
+        assert merged([{"a": t2}]) == 2                   # monotone
+
+    def test_snapshot_round_trips(self):
+        from limitador_tpu.storage.distributed import CrTatValue
+
+        limit = self._limit()
+        a = CrTatValue("a", limit)
+        a.inc_at(4, 60, 1000.0)
+        values, expiry_s = a.snapshot()
+        b = CrTatValue("b", limit)
+        b.merge_at(values, expiry_s, 1000.0)
+        assert b.read_at(1000.0) == a.read_at(1000.0)
+
+
+class TestReplicatedBuckets(TestReplication):
+    def test_distributed_bucket_converges(self):
+        """Bucket spends on one node bound admission on the other — the
+        host-CRDT counterpart of the tpu/replicated gossip tests."""
+        nodes = self.make_cluster(2)
+        try:
+            limit = Limit("tb", 5, 600, [], ["u"],
+                          policy="token_bucket")  # I = 120s: no refill
+            limiters = [RateLimiter(s) for s in nodes]
+            for lim in limiters:
+                lim.add_limit(limit)
+            ctx = Context({"u": "shared"})
+            for _ in range(3):
+                assert not limiters[0].check_rate_limited_and_update(
+                    "tb", ctx, 1
+                ).limited
+            assert self.eventually(
+                lambda: limiters[1].is_rate_limited("tb", ctx, 3).limited
+            ), "node1 never absorbed node0's bucket spend"
+            assert not limiters[1].is_rate_limited("tb", ctx, 2).limited
+            # node1 spends the remainder; node0 converges on empty
+            assert not limiters[1].check_rate_limited_and_update(
+                "tb", ctx, 2
+            ).limited
+            assert self.eventually(
+                lambda: limiters[0].is_rate_limited("tb", ctx, 1).limited
+            ), "node0 never absorbed node1's bucket spend"
+            # merged admin views agree
+            assert self.eventually(lambda: all(
+                {c.remaining for c in lim.get_counters("tb")} == {0}
+                for lim in limiters
+            ))
+        finally:
+            for s in nodes:
+                s.close()
+
+    def test_bucket_gossip_before_limit_configured_coerces(self):
+        """Gossip for a bucket key landing before the limit is known
+        parks as a window shell; the first local touch must coerce it to
+        the TAT cell (ticks were never counts)."""
+        from limitador_tpu.storage.keys import key_for_counter
+        from limitador_tpu.core.counter import Counter as C
+
+        limit = Limit("tb", 5, 600, [], ["u"], policy="token_bucket")
+        storage = CrInMemoryStorage("me")
+        try:
+            now_ms = int(time.time() * 1000)
+            tat = now_ms + 3 * 120_000  # 3 of 5 spent at I=120s
+            storage._on_remote_update(
+                key_for_counter(C(limit, {"u": "x"})), {"peer": tat}, tat
+            )
+            lim = RateLimiter(storage)
+            lim.add_limit(limit)
+            ctx = Context({"u": "x"})
+            assert not lim.is_rate_limited("tb", ctx, 2).limited
+            assert lim.is_rate_limited("tb", ctx, 3).limited
+            counters = lim.get_counters("tb")
+            assert {c.remaining for c in counters} == {2}
+        finally:
+            storage.close()
