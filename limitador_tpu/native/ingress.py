@@ -286,6 +286,18 @@ class NativeIngress:
 
     # -- stats --------------------------------------------------------------
 
+    def library_stats(self) -> dict:
+        """Metrics poll surface (observability/metrics.py
+        attach_library_source): the C++ counters under their exported
+        ingress_* names."""
+        s = self.stats()
+        return {
+            "ingress_connections": s["connections"],
+            "ingress_requests": s["requests"],
+            "ingress_responses": s["responses"],
+            "ingress_protocol_errors": s["protocol_errors"],
+        }
+
     def stats(self) -> dict:
         with self._ctx_lock:
             if self._ctx is None:

@@ -145,15 +145,41 @@ class PrometheusMetrics:
             "interpreter",
             registry=self.registry,
         )
+        # Native C++ HTTP/2 ingress health (cumulative in the C++ layer,
+        # converted to increments via the baseline mechanism below).
+        self.ingress_connections = Counter(
+            "ingress_connections",
+            "Connections accepted by the native C++ HTTP/2 ingress",
+            registry=self.registry,
+        )
+        self.ingress_requests = Counter(
+            "ingress_requests",
+            "Requests taken off the native ingress",
+            registry=self.registry,
+        )
+        self.ingress_responses = Counter(
+            "ingress_responses",
+            "Responses written by the native ingress",
+            registry=self.registry,
+        )
+        self.ingress_protocol_errors = Counter(
+            "ingress_protocol_errors",
+            "HTTP/2 / gRPC framing errors on the native ingress",
+            registry=self.registry,
+        )
         self._library_sources: list = []
         self._counter_baselines: dict = {}
 
     def attach_library_source(self, source) -> None:
         """Attach an object exposing ``library_stats() -> dict``; polled on
         every render. Recognized keys: ``batcher_size`` / ``cache_size``
-        (levels, summed over sources), ``counter_overshoot`` /
-        ``evicted_pending_writes`` (cumulative counts, converted to
-        increments), ``flush_sizes`` (list drained into the histogram)."""
+        (levels, summed over sources); ``counter_overshoot``,
+        ``evicted_pending_writes``, ``cel_vectorized_evals``,
+        ``cel_fallback_evals``, ``ingress_connections``,
+        ``ingress_requests``, ``ingress_responses``,
+        ``ingress_protocol_errors`` (cumulative counts, converted to
+        increments per source); ``flush_sizes`` (list drained into the
+        histogram)."""
         self._library_sources.append(source)
 
     def _poll_library_sources(self) -> None:
@@ -171,6 +197,10 @@ class PrometheusMetrics:
                 "evicted_pending_writes",
                 "cel_vectorized_evals",
                 "cel_fallback_evals",
+                "ingress_connections",
+                "ingress_requests",
+                "ingress_responses",
+                "ingress_protocol_errors",
             ):
                 if key in stats:
                     seen = int(stats[key])

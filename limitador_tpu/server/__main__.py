@@ -716,6 +716,7 @@ async def _amain(args) -> int:
                 stream_path=REFLECTION_METHOD,
             )
             rls_grpc_port = args.rls_port + 1
+            metrics.attach_library_source(native_ingress)
 
     rls_server = await serve_rls(
         limiter,

@@ -166,7 +166,7 @@ def reflection_server(tmp_path_factory):
                 "native ingress did not come up on the expected port:\n"
                 + logged
             )
-        yield {"native_port": rp, "grpc_port": rp + 1}
+        yield {"native_port": rp, "grpc_port": rp + 1, "http_port": hp}
     finally:
         proc.terminate()
         try:
@@ -211,6 +211,43 @@ def test_e2e_list_and_describe(reflection_server, plane):
     assert responses[1].original_request.file_containing_symbol == (
         ENVOY_SERVICE
     )
+
+
+def test_ingress_stats_reach_prometheus(reflection_server):
+    """The C++ ingress's connection/request/response counters surface on
+    /metrics (ingress_* series) once traffic has flowed."""
+    import grpc
+
+    from limitador_tpu.server.proto import rls_pb2
+
+    with grpc.insecure_channel(
+        f"127.0.0.1:{reflection_server['native_port']}"
+    ) as ch:
+        call = ch.unary_unary(
+            "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit",
+            request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+            response_deserializer=rls_pb2.RateLimitResponse.FromString,
+        )
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "u", "stats"
+        for _ in range(3):
+            call(req, timeout=10)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{reflection_server['http_port']}/metrics",
+        timeout=10,
+    ) as resp:
+        body = resp.read().decode()
+    series = {
+        line.split()[0]: float(line.split()[1])
+        for line in body.splitlines()
+        if line and not line.startswith("#") and " " in line
+    }
+    assert series.get("ingress_connections_total", 0) >= 1, body[:500]
+    assert series.get("ingress_requests_total", 0) >= 3
+    assert series.get("ingress_responses_total", 0) >= 3
+    assert "ingress_protocol_errors_total" in series
 
 
 # -- direct NativeIngress stream-path coverage --------------------------------
