@@ -137,11 +137,34 @@ def _disk_storage(clock, tmp_path):
     return DiskStorage(str(tmp_path / "tb.db"), clock=clock)
 
 
+def _sharded_storage(clock, tmp_path):
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    return TpuShardedStorage(
+        local_capacity=1024, global_region=32, clock=clock
+    )
+
+
+def _replicated_storage(clock, tmp_path):
+    from limitador_tpu.tpu.replicated import TpuReplicatedStorage
+
+    return TpuReplicatedStorage("n1", capacity=1 << 10, clock=clock)
+
+
+def _distributed_storage(clock, tmp_path):
+    from limitador_tpu.storage.distributed import CrInMemoryStorage
+
+    return CrInMemoryStorage("n1", clock=clock)
+
+
 @pytest.mark.parametrize("make", [
     lambda c, p: InMemoryStorage(clock=c),
     lambda c, p: TpuStorage(capacity=1 << 12, clock=c),
     _disk_storage,
-], ids=["oracle", "tpu", "disk"])
+    _sharded_storage,
+    _replicated_storage,
+    _distributed_storage,
+], ids=["oracle", "tpu", "disk", "sharded", "replicated", "distributed"])
 def test_burst_refill_and_headers(make, tmp_path):
     clk = Clock()
     rl = RateLimiter(make(clk, tmp_path))
