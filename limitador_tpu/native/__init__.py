@@ -195,7 +195,9 @@ class HostPath:
         Returns (domain_tokens, hits, columns{key->tokens}, ndesc_entries,
         extra_descriptors); -1 marks absent/failed."""
         n = len(blobs)
-        sizes = np.asarray([len(b) for b in blobs], np.int32)
+        # fromiter(map(len,...)) skips the intermediate list — this line
+        # runs per batch on the serving hot path
+        sizes = np.fromiter(map(len, blobs), np.int32, count=n)
         buf = b"".join(blobs)
         domains = np.empty(n, np.int32)
         hits = np.empty(n, np.int32)
