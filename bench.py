@@ -617,6 +617,75 @@ def _native_rls_server(native_ingress=False, batch_delay_us=None,
     return ctx()
 
 
+def _scrape_device_metrics(http_port: int) -> dict:
+    """Read the device-plane batching telemetry off a serving process's
+    /metrics exposition after a measured pass (observability/metrics.py
+    batcher_* families): queue-wait p99 by histogram-bucket interpolation,
+    mean batch fill ratio, and the share of flushes released by the
+    linger deadline rather than a full batch — so BENCH rounds can
+    correlate throughput with batching behavior."""
+    import re
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/metrics", timeout=10
+        ) as resp:
+            text = resp.read().decode()
+    except Exception as exc:
+        print(f"device metrics scrape failed: {exc}", file=sys.stderr)
+        return {}
+
+    buckets = []  # (le_seconds, cumulative_count) in exposition order
+    fill_sum = fill_count = 0.0
+    flushes = {}
+    # Only the decision path: batcher="update" is the write-behind
+    # queue, which lingers to its deadline by design and would skew
+    # every derived figure.
+    check = 'batcher="check"'
+    for line in text.splitlines():
+        if line.startswith("batcher_queue_wait_bucket") and check in line:
+            m = re.search(r'le="([^"]+)"\}\s+([0-9.eE+-]+)', line)
+            if m:
+                le = float("inf") if m.group(1) == "+Inf" else float(m.group(1))
+                buckets.append((le, float(m.group(2))))
+        elif line.startswith("batcher_batch_fill_ratio_sum") and check in line:
+            fill_sum = float(line.split()[-1])
+        elif (line.startswith("batcher_batch_fill_ratio_count")
+              and check in line):
+            fill_count = float(line.split()[-1])
+        elif line.startswith("batcher_flushes_total") and check in line:
+            m = re.search(r'reason="([^"]+)"\}\s+([0-9.eE+-]+)', line)
+            if m:
+                flushes[m.group(1)] = float(m.group(2))
+
+    out = {}
+    total = buckets[-1][1] if buckets else 0.0
+    if total > 0:
+        target = 0.99 * total
+        prev_le = prev_cum = 0.0
+        for le, cum in buckets:
+            if cum >= target:
+                if le == float("inf"):
+                    p99 = prev_le  # tail beyond the last finite bucket
+                else:
+                    span = cum - prev_cum
+                    frac = (target - prev_cum) / span if span else 1.0
+                    p99 = prev_le + (le - prev_le) * frac
+                out["queue_wait_p99_ms"] = round(p99 * 1e3, 3)
+                break
+            prev_le, prev_cum = le, cum
+    if fill_count > 0:
+        out["batch_fill_ratio"] = round(fill_sum / fill_count, 4)
+    # Shutdown-drain flushes are teardown, not steady-state behavior.
+    decided = flushes.get("size", 0.0) + flushes.get("deadline", 0.0)
+    if decided > 0:
+        out["deadline_flush_share"] = round(
+            flushes.get("deadline", 0.0) / decided, 4
+        )
+    return out
+
+
 def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
                      batch_delay_us: int = 200, native_ingress: bool = False):
     """End-to-end gRPC latency evidence: a real server process, a real
@@ -691,6 +760,9 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
         lat, wall, floor = asyncio.new_event_loop().run_until_complete(
             drive()
         )
+        # Scrape the batching telemetry BEFORE teardown: the server's
+        # shutdown drain would otherwise skew the flush-reason mix.
+        device_metrics = _scrape_device_metrics(_http_port)
         ok[0] = True
         lat_ms = np.asarray(lat) * 1e3
         floor_ms = np.asarray(floor) * 1e3
@@ -700,6 +772,7 @@ def grpc_closed_loop(concurrency: int = 64, per_worker: int = 250,
             float(np.percentile(lat_ms, 50)),
             float(np.percentile(lat_ms, 99)),
             float(np.percentile(floor_ms, 50)),
+            device_metrics,
         )
 
 
@@ -973,7 +1046,7 @@ def bench_grpc():
     """Closed-loop gRPC ShouldRateLimit over a real socket: p99 vs the 2ms
     BASELINE target (value = p99_ms, vs_baseline = 2.0 / p99 so >= 1.0
     beats the target)."""
-    rps, p50, p99, floor_p50 = grpc_closed_loop()
+    rps, p50, p99, floor_p50, device_metrics = grpc_closed_loop()
     print(
         f"grpc closed-loop: {rps/1e3:.1f}k req/s, p50 {p50:.2f}ms "
         f"p99 {p99:.2f}ms | no-storage floor p50 {floor_p50:.2f}ms "
@@ -981,6 +1054,15 @@ def bench_grpc():
         "remote-chip tunnel RTT)",
         file=sys.stderr,
     )
+    if device_metrics:
+        print(
+            "batching: queue-wait p99 "
+            f"{device_metrics.get('queue_wait_p99_ms', float('nan'))}ms, "
+            f"mean fill ratio {device_metrics.get('batch_fill_ratio', 0)}, "
+            "deadline-flush share "
+            f"{device_metrics.get('deadline_flush_share', 0)}",
+            file=sys.stderr,
+        )
     payload = {
         "metric": "grpc_should_rate_limit_p99_ms",
         "value": round(p99, 3),
@@ -989,9 +1071,12 @@ def bench_grpc():
         "rps": round(rps, 1),
         "p50_ms": round(p50, 3),
         "floor_p50_ms": round(floor_p50, 3),
+        **device_metrics,
     }
     try:
-        irps, ip50, ip99, ifloor = grpc_closed_loop(native_ingress=True)
+        irps, ip50, ip99, ifloor, _idev = grpc_closed_loop(
+            native_ingress=True
+        )
         print(
             f"native ingress closed-loop: {irps/1e3:.1f}k req/s, "
             f"p50 {ip50:.2f}ms p99 {ip99:.2f}ms | no-storage floor "
@@ -1099,7 +1184,7 @@ def main():
             )
     if args.config == "device" and device_ok:
         try:
-            rps, p50, p99, floor_p50 = grpc_closed_loop(
+            rps, p50, p99, floor_p50, device_metrics = grpc_closed_loop(
                 concurrency=64, per_worker=120
             )
             print(
@@ -1114,6 +1199,7 @@ def main():
                 "grpc_p50_ms": round(p50, 3),
                 "grpc_p99_ms": round(p99, 3),
                 "grpc_floor_p50_ms": round(floor_p50, 3),
+                **device_metrics,
             }
         except Exception as exc:
             print(f"grpc closed-loop skipped: {exc}", file=sys.stderr)
@@ -1124,7 +1210,7 @@ def main():
             # ingress_* fields to one bad boot wastes the whole capture.
             for attempt in (1, 2):
                 try:
-                    rps, p50, p99, floor_p50 = grpc_closed_loop(
+                    rps, p50, p99, floor_p50, _idev = grpc_closed_loop(
                         concurrency=64, per_worker=120, native_ingress=True
                     )
                     break

@@ -296,6 +296,9 @@ class NativeIngress:
             "ingress_requests": s["requests"],
             "ingress_responses": s["responses"],
             "ingress_protocol_errors": s["protocol_errors"],
+            # Asyncio-side pipeline queue (exact-path rows the ingress
+            # routed through submit); the C++ loop itself never queues.
+            "queue_depth": len(getattr(self.pipeline, "_pending", ())),
         }
 
     def stats(self) -> dict:

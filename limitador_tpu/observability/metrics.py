@@ -167,6 +167,97 @@ class PrometheusMetrics:
             "HTTP/2 / gRPC framing errors on the native ingress",
             registry=self.registry,
         )
+        # -- device-plane telemetry (observability/device_plane.py):
+        # where a batched decision's time goes before and inside the
+        # device round trip, and how full the device tables are. Written
+        # by the DeviceStatsRecorder the batchers/pipelines get from
+        # set_metrics; the shard gauges are polled from device_stats()
+        # sources at render time.
+        self.batcher_queue_depth = Gauge(
+            "batcher_queue_depth",
+            "Requests currently waiting in the micro-batcher queues",
+            registry=self.registry,
+        )
+        self.batcher_queue_wait = Histogram(
+            "batcher_queue_wait",
+            "Seconds a request waited in the batcher queue before its "
+            "batch flushed (linger included, device time excluded); "
+            "batcher=check is the decision path, batcher=update the "
+            "write-behind path",
+            ["batcher"],
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.batcher_batch_fill_ratio = Histogram(
+            "batcher_batch_fill_ratio",
+            "Flush occupancy as a fraction of the configured max batch "
+            "(1.0 = size-triggered full batch), per batcher "
+            "(check = decision path, update = write-behind path)",
+            ["batcher"],
+            registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 0.75, 0.9, 1.0),
+        )
+        self.batcher_flushes = Counter(
+            "batcher_flushes",
+            "Batcher flushes by trigger: size (batch full), deadline "
+            "(linger expired), shutdown (close drain); per batcher "
+            "(check = decision path, update = write-behind path)",
+            ["batcher", "reason"],
+            registry=self.registry,
+        )
+        self.device_phase_latency = Histogram(
+            "device_phase_latency",
+            "Per-phase device batch breakdown: dispatch (executor "
+            "handoff), host_stage (array build + kernel launch), "
+            "device_sync (device round trip), unpack (decode + resolve)",
+            ["phase"],
+            registry=self.registry,
+            buckets=(
+                0.0001, 0.00025, 0.0005, 0.001, 0.002, 0.005, 0.01,
+                0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+            ),
+        )
+        self.counter_slots_used = Gauge(
+            "counter_slots_used",
+            "Occupied device counter-table slots, per shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.counter_slots_capacity = Gauge(
+            "counter_slots_capacity",
+            "Device counter-table slot capacity, per shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.counter_slot_evictions = Counter(
+            "counter_slot_evictions",
+            "Counters evicted from a full device table to make room, "
+            "per shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        self.counter_slot_collisions = Counter(
+            "counter_slot_collisions",
+            "Fresh allocations that recycled a previously-occupied "
+            "device slot (stale cell overridden by the kernel's fresh "
+            "flag), per shard",
+            ["shard"],
+            registry=self.registry,
+        )
+        # Pre-seed the bounded label sets so the families render (and
+        # dashboards/benches see zeros) before the first flush.
+        from .device_plane import BATCHERS, FLUSH_REASONS, PHASES
+
+        for batcher in BATCHERS:
+            self.batcher_queue_wait.labels(batcher)
+            self.batcher_batch_fill_ratio.labels(batcher)
+            for reason in FLUSH_REASONS:
+                self.batcher_flushes.labels(batcher, reason)
+        for phase in PHASES:
+            self.device_phase_latency.labels(phase)
         self._library_sources: list = []
         self._counter_baselines: dict = {}
 
@@ -185,13 +276,16 @@ class PrometheusMetrics:
     def _poll_library_sources(self) -> None:
         batcher_size = 0
         cache_size = 0
+        queue_depth = 0
         for i, source in enumerate(self._library_sources):
+            self._poll_device_stats(i, source)
             try:
                 stats = source.library_stats()
             except Exception:
                 continue
             batcher_size += int(stats.get("batcher_size", 0))
             cache_size += int(stats.get("cache_size", 0))
+            queue_depth += int(stats.get("queue_depth", 0))
             for key in (
                 "counter_overshoot",
                 "evicted_pending_writes",
@@ -212,6 +306,38 @@ class PrometheusMetrics:
                 self.batcher_flush_size.observe(size)
         self.batcher_size.set(batcher_size)
         self.cache_size.set(cache_size)
+        self.batcher_queue_depth.set(queue_depth)
+
+    def _poll_device_stats(self, i: int, source) -> None:
+        """Per-shard device-table stats from a ``device_stats()`` source:
+        occupancy/capacity as levels, evictions/collisions as cumulative
+        counts converted to increments (same baseline mechanism as the
+        library counters above)."""
+        device_stats = getattr(source, "device_stats", None)
+        if not callable(device_stats):
+            return
+        try:
+            shards = device_stats().get("shards", ())
+        except Exception:
+            return
+        for shard in shards:
+            label = str(shard.get("shard"))
+            self.counter_slots_used.labels(label).set(
+                int(shard.get("occupied", 0))
+            )
+            self.counter_slots_capacity.labels(label).set(
+                int(shard.get("capacity", 0))
+            )
+            for key, metric in (
+                ("evictions", self.counter_slot_evictions),
+                ("collisions", self.counter_slot_collisions),
+            ):
+                seen = int(shard.get(key, 0))
+                baseline_key = (i, label, key)
+                baseline = self._counter_baselines.get(baseline_key, 0)
+                if seen > baseline:
+                    metric.labels(label).inc(seen - baseline)
+                    self._counter_baselines[baseline_key] = seen
 
     @staticmethod
     def _parse_labels(metric_labels: str):

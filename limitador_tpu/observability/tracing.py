@@ -33,6 +33,7 @@ __all__ = [
     "configure_tracing",
     "should_rate_limit_span",
     "datastore_span",
+    "device_batch_span",
     "tracing_enabled",
 ]
 
@@ -88,7 +89,9 @@ def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
     )
 
 
-def _noop_record(limited, name):
+def _noop_record(*_args, **_kwargs):
+    # Shared no-exporter stand-in for every span's yielded recorder
+    # (should_rate_limit's (limited, name), device_batch's (phases)).
     pass
 
 
@@ -121,6 +124,36 @@ def _datastore_span(op: str):
 @contextmanager
 def _noop_record_span():
     yield _noop_record
+
+
+def device_batch_span(batch_id: int, n_requests: int):
+    """Span around one device batch round trip, carrying the batch id
+    and (via the yielded setter) the per-phase timing breakdown as
+    ``batch.phase.*_ms`` attributes — so a trace view localizes where a
+    slow batch spent its time without scraping /metrics. Emitted from
+    the batcher flush loop, NOT under a MetricsLayer aggregate: the
+    per-request datastore spans already account this wall clock, and a
+    second accounting here would double-count it. No exporter -> shared
+    no-op, zero per-batch cost."""
+    if not _enabled or _tracer is None:
+        return _noop_record_span()
+    return _device_batch_span(batch_id, n_requests)
+
+
+@contextmanager
+def _device_batch_span(batch_id: int, n_requests: int):
+    with _tracer.start_as_current_span("datastore") as span:
+        span.set_attribute("datastore.operation", "device_batch")
+        span.set_attribute("batch.id", batch_id)
+        span.set_attribute("batch.requests", n_requests)
+
+        def record(phases: dict) -> None:
+            for name, seconds in phases.items():
+                span.set_attribute(
+                    f"batch.phase.{name}_ms", round(seconds * 1e3, 3)
+                )
+
+        yield record
 
 
 def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):

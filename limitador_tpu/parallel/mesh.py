@@ -49,6 +49,25 @@ __all__ = [
 _NEVER = jnp.iinfo(jnp.int32).max
 
 
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across JAX versions: the public API (>= 0.6,
+    ``check_vma``) vs ``jax.experimental.shard_map`` (0.4.x,
+    ``check_rep``). Replication checking is disabled either way — the
+    cross-device pmin/psum coupling below is deliberate."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm(
+            fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
+
+
 class ShardedCounterState(NamedTuple):
     values: jax.Array     # int32[n_shards, L+1] sharded over "shard"
     expiry_ms: jax.Array  # int32[n_shards, L+1] sharded over "shard"
@@ -153,12 +172,11 @@ def sharded_check_and_update(
 
     spec = P(axis, None)
     rep = P()
-    nv, ne, admitted, ok, remaining, ttl = jax.shard_map(
+    nv, ne, admitted, ok, remaining, ttl = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec,) * 10,
         out_specs=(spec, spec, rep, spec, spec, spec),
-        check_vma=False,
     )(state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
       req_ids, fresh, bucket, is_global)
     return (
@@ -194,12 +212,11 @@ def sharded_update(
         return nv[None], ne[None]
 
     spec = P(axis, None)
-    nv, ne = jax.shard_map(
+    nv, ne = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec,) * 7,
         out_specs=(spec, spec),
-        check_vma=False,
     )(state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
       bucket)
     return ShardedCounterState(nv, ne)

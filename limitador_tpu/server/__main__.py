@@ -281,6 +281,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--listen-address", default=_env("LISTEN_ADDRESS"),
         help="distributed: replication listen address",
     )
+    p.add_argument(
+        "--profile-dir",
+        default=_env("TPU_PROFILE_DIR", "/tmp/limitador-tpu-profile"),
+        help="default directory for on-demand jax.profiler captures "
+        "(POST /debug/profile can override per capture)",
+    )
     return p
 
 
@@ -725,8 +731,15 @@ async def _amain(args) -> int:
         args.rate_limit_headers,
         native_pipeline=native_pipeline,
     )
+    from ..observability.device_plane import JaxProfiler
+
+    debug_sources = [counters_storage]
+    if native_pipeline is not None:
+        debug_sources.append(native_pipeline)
     http_runner = await run_http_server(
-        limiter, args.http_host, args.http_port, metrics, status
+        limiter, args.http_host, args.http_port, metrics, status,
+        debug_sources=debug_sources,
+        profiler=JaxProfiler(args.profile_dir),
     )
     log.info(
         f"limitador-tpu: RLS gRPC on {args.rls_host}:{rls_grpc_port}"

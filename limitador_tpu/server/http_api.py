@@ -11,6 +11,16 @@ Mirrors /root/reference/limitador-server/src/http_api/server.rs over aiohttp:
     POST /check_and_report  200/429 + optional draft-03 headers
                             (server.rs:185-260)
 
+Beyond the reference surface, the device-plane debug endpoints
+(observability/device_plane.py):
+
+    GET  /debug/stats       batcher queue depths, per-shard counter-table
+                            occupancy, flush-reason tallies, the slowest-N
+                            decision flight recorder
+    GET  /debug/profile     jax.profiler capture status
+    POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
+                            toggles an on-demand jax.profiler trace
+
 POST bodies are CheckAndReportInfo: {"namespace", "values": {str: str},
 "delta", "response_headers": optional "DRAFT_VERSION_03"}
 (request_types.rs:10-16).
@@ -26,6 +36,11 @@ from aiohttp import web
 
 from ..core.cel import Context
 from ..core.limit import Limit
+from ..observability.device_plane import (
+    JaxProfiler,
+    ProfilerStateError,
+    collect_debug_stats,
+)
 from ..observability.metrics import PrometheusMetrics
 from ..observability.metrics_layer import installed as _metrics_layer_installed
 from ..storage.base import StorageError
@@ -152,6 +167,40 @@ def _openapi_spec() -> dict:
                     },
                 }
             },
+            "/debug/stats": {
+                "get": {
+                    "summary": "Device-plane debug state (queues, shard "
+                               "occupancy, flight recorder)",
+                    "responses": {
+                        "200": {"description": "debug stats"}
+                    },
+                }
+            },
+            "/debug/profile": {
+                "get": {
+                    "summary": "jax.profiler capture status",
+                    "responses": {"200": {"description": "profiler status"}},
+                },
+                "post": {
+                    "summary": "Start/stop an on-demand jax.profiler trace",
+                    "requestBody": {
+                        "required": True,
+                        "content": {
+                            "application/json": {
+                                "schema": {
+                                    "$ref": "#/components/schemas"
+                                            "/ProfileAction"
+                                }
+                            }
+                        },
+                    },
+                    "responses": {
+                        "200": {"description": "profiler toggled"},
+                        "409": {"description": "capture already active / "
+                                               "not active"},
+                    },
+                },
+            },
             "/limits/{namespace}": {
                 "get": {
                     "summary": "Limits configured for a namespace",
@@ -224,16 +273,38 @@ def _openapi_spec() -> dict:
                 "Limit": limit_schema,
                 "Counter": counter_schema,
                 "CheckAndReportInfo": info_schema,
+                "ProfileAction": {
+                    "type": "object",
+                    "required": ["action"],
+                    "properties": {
+                        "action": {
+                            "type": "string",
+                            "enum": ["start", "stop"],
+                        },
+                        "trace_dir": {"type": "string", "nullable": True},
+                    },
+                },
             }
         },
     }
 
 
 class _Api:
-    def __init__(self, limiter, metrics: Optional[PrometheusMetrics], status):
+    def __init__(
+        self,
+        limiter,
+        metrics: Optional[PrometheusMetrics],
+        status,
+        debug_sources=None,
+        profiler: Optional[JaxProfiler] = None,
+    ):
         self.limiter = limiter
         self.metrics = metrics
         self.status = status or {}
+        # Objects walked for /debug/stats device-plane state; the limiter
+        # is always included (it reaches the batchers + device tables).
+        self.debug_sources = [limiter] + list(debug_sources or ())
+        self.profiler = profiler or JaxProfiler()
         from ..observability.metrics import storage_self_timed
 
         self._self_timed = storage_self_timed(limiter)
@@ -278,6 +349,45 @@ class _Api:
     async def get_metrics(self, request: web.Request) -> web.Response:
         body = self.metrics.render() if self.metrics else b""
         return web.Response(body=body, content_type="text/plain")
+
+    async def get_debug_stats(self, request: web.Request) -> web.Response:
+        """Device-plane state without a debugger: queue depths, per-shard
+        table occupancy, flush reasons, the slow-decision flight recorder
+        and the profiler state."""
+        stats = collect_debug_stats(*self.debug_sources)
+        stats["profiler"] = self.profiler.status()
+        return web.json_response(stats)
+
+    async def get_debug_profile(self, request: web.Request) -> web.Response:
+        return web.json_response(self.profiler.status())
+
+    async def post_debug_profile(self, request: web.Request) -> web.Response:
+        try:
+            data = await request.json()
+            action = data["action"]
+            trace_dir = data.get("trace_dir")
+            if action not in ("start", "stop"):
+                raise ValueError(f"unknown action {action!r}")
+            if trace_dir is not None and not isinstance(trace_dir, str):
+                raise ValueError("trace_dir must be a string")
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response(
+                {"error": f"bad request: {exc}"}, status=400
+            )
+        try:
+            if action == "start":
+                target = self.profiler.start(trace_dir)
+                return web.json_response(
+                    {"status": "started", "trace_dir": target}
+                )
+            target = self.profiler.stop()
+            return web.json_response(
+                {"status": "stopped", "trace_dir": target}
+            )
+        except ProfilerStateError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except Exception as exc:  # jax.profiler failures must not crash
+            return web.json_response({"error": str(exc)}, status=500)
 
     async def get_limits(self, request: web.Request) -> web.Response:
         ns = request.match_info["namespace"]
@@ -376,14 +486,19 @@ def make_http_app(
     limiter,
     metrics: Optional[PrometheusMetrics] = None,
     status: Optional[dict] = None,
+    debug_sources=None,
+    profiler: Optional[JaxProfiler] = None,
 ) -> web.Application:
     from .middleware import http_request_id_middleware
 
-    api = _Api(limiter, metrics, status)
+    api = _Api(limiter, metrics, status, debug_sources, profiler)
     app = web.Application(middlewares=[http_request_id_middleware])
     app.router.add_get("/status", api.get_status)
     app.router.add_get("/api/spec", api.get_spec)
     app.router.add_get("/metrics", api.get_metrics)
+    app.router.add_get("/debug/stats", api.get_debug_stats)
+    app.router.add_get("/debug/profile", api.get_debug_profile)
+    app.router.add_post("/debug/profile", api.post_debug_profile)
     app.router.add_get("/limits/{namespace}", api.get_limits)
     app.router.add_get("/counters/{namespace}", api.get_counters)
     app.router.add_post("/check", api.post_check)
@@ -398,9 +513,11 @@ async def run_http_server(
     port: int = 8080,
     metrics: Optional[PrometheusMetrics] = None,
     status: Optional[dict] = None,
+    debug_sources=None,
+    profiler: Optional[JaxProfiler] = None,
 ) -> web.AppRunner:
     """Start the HTTP server (returns the runner; caller owns shutdown)."""
-    app = make_http_app(limiter, metrics, status)
+    app = make_http_app(limiter, metrics, status, debug_sources, profiler)
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)

@@ -3,7 +3,11 @@
 Mirrors the reference's RequestIdMiddleware (envoy_rls/server.rs:274-300,
 http_api/server.rs:297-314): every request carries an ``x-request-id`` —
 the client's if present, else a fresh uuid — echoed on HTTP responses and
-gRPC initial metadata so logs and traces correlate across hops.
+gRPC initial metadata so logs and traces correlate across hops. The id is
+also published to the device-plane contextvar
+(observability/device_plane.py) so flight-recorder entries for slow
+decisions correlate with access logs without threading an argument
+through every storage layer.
 """
 
 from __future__ import annotations
@@ -12,6 +16,8 @@ import uuid
 
 import grpc
 from aiohttp import web
+
+from ..observability.device_plane import set_request_id
 
 __all__ = ["http_request_id_middleware", "GrpcRequestIdInterceptor"]
 
@@ -22,6 +28,7 @@ HEADER = "x-request-id"
 async def http_request_id_middleware(request: web.Request, handler):
     request_id = request.headers.get(HEADER) or uuid.uuid4().hex
     request["request_id"] = request_id
+    set_request_id(request_id)
     try:
         response = await handler(request)
     except web.HTTPException as exc:
@@ -33,21 +40,51 @@ async def http_request_id_middleware(request: web.Request, handler):
 
 
 class GrpcRequestIdInterceptor(grpc.aio.ServerInterceptor):
+    """Echo (or mint) ``x-request-id`` on every RPC's initial metadata.
+
+    All four handler kinds are wrapped — unary-unary (the RLS hot path)
+    AND the streaming shapes (server reflection is stream-stream), which
+    previously passed through silently with no id echo."""
+
     async def intercept_service(self, continuation, handler_call_details):
         metadata = dict(handler_call_details.invocation_metadata or ())
         request_id = metadata.get(HEADER) or uuid.uuid4().hex
         handler = await continuation(handler_call_details)
-        if handler is None or handler.unary_unary is None:
+        if handler is None:
             return handler
 
-        inner = handler.unary_unary
+        def _prelude(context):
+            # Also publish to the device-plane contextvar: the wrapped
+            # coroutine runs in the request's context, so the batcher's
+            # flight recorder sees this id for decisions it coalesces.
+            set_request_id(request_id)
+            return context.send_initial_metadata(((HEADER, request_id),))
 
-        async def wrapped(request, context):
-            await context.send_initial_metadata(((HEADER, request_id),))
-            return await inner(request, context)
+        for attr, factory, streams_out in (
+            ("unary_unary", grpc.unary_unary_rpc_method_handler, False),
+            ("unary_stream", grpc.unary_stream_rpc_method_handler, True),
+            ("stream_unary", grpc.stream_unary_rpc_method_handler, False),
+            ("stream_stream", grpc.stream_stream_rpc_method_handler, True),
+        ):
+            inner = getattr(handler, attr)
+            if inner is None:
+                continue
+            if streams_out:
 
-        return grpc.unary_unary_rpc_method_handler(
-            wrapped,
-            request_deserializer=handler.request_deserializer,
-            response_serializer=handler.response_serializer,
-        )
+                async def wrapped(request, context, _inner=inner):
+                    await _prelude(context)
+                    async for response in _inner(request, context):
+                        yield response
+
+            else:
+
+                async def wrapped(request, context, _inner=inner):
+                    await _prelude(context)
+                    return await _inner(request, context)
+
+            return factory(
+                wrapped,
+                request_deserializer=handler.request_deserializer,
+                response_serializer=handler.response_serializer,
+            )
+        return handler

@@ -41,7 +41,12 @@ from typing import Dict, List, Optional, Tuple
 
 from ..core.counter import Counter
 from ..core.limit import Limit
+from ..observability.device_plane import (
+    DeviceStatsRecorder,
+    current_request_id,
+)
 from ..observability.metrics_layer import installed as _metrics_layer_installed
+from ..observability.tracing import device_batch_span
 from ..storage.base import (
     AsyncCounterStorage,
     Authorization,
@@ -66,6 +71,15 @@ def _latency_hists(metrics) -> list:
     if _metrics_layer_installed() is None:
         hists.append(metrics.datastore_latency)
     return hists
+
+
+def _timed_call(fn, arg):
+    """(fn(arg), t_start, t_end) — phase timing across an executor hop:
+    t_start - caller's submit time is the executor handoff ("dispatch"),
+    t_end - t_start is the call itself."""
+    t_start = time.perf_counter()
+    out = fn(arg)
+    return out, t_start, time.perf_counter()
 
 
 class MicroBatcher:
@@ -100,6 +114,12 @@ class MicroBatcher:
         # here — the busy-time semantics of the reference's MetricsLayer
         # (metrics.rs:100-211) instead of handler wall clock.
         self.metrics = None
+        # Device-plane telemetry sink (queue waits, fill ratios, flush
+        # reasons, phase timings, flight recorder). None until
+        # set_metrics attaches one: every instrumentation site below is
+        # gated on this single check, so a detached batcher pays nothing
+        # per decision (the tracing.py _enabled discipline).
+        self.recorder = None
 
     def _observe_batch(self, n_requests: int, dt: float) -> None:
         if self.metrics is not None:
@@ -121,30 +141,52 @@ class MicroBatcher:
         self._ensure_started()
         future = asyncio.get_running_loop().create_future()
         request = _Request(counters, delta, load)
-        self._pending.append((request, future))
+        rid = current_request_id() if self.recorder is not None else None
+        self._pending.append((request, future, time.perf_counter(), rid))
         self._pending_hits += len(request.ordered)
         self._wakeup.set()
         return await future
 
     @staticmethod
     def _fail(batch, exc) -> None:
-        for _r, future in batch:
+        for _r, future, _t, _rid in batch:
             if not future.done():
                 future.set_exception(exc)
 
     @staticmethod
     def _resolve(batch, auths) -> None:
-        for (_r, future), auth in zip(batch, auths):
+        for (_r, future, _t, _rid), auth in zip(batch, auths):
             if not future.done():
                 future.set_result(auth)
 
-    async def _finish_inflight(self, batch, handle, finish, sem, loop, t0):
+    @staticmethod
+    def _record_batch(rec, batch, batch_id, t_flush, phases) -> None:
+        rec.record_batch(
+            (
+                (t_enq, rid,
+                 request.ordered[0].namespace if request.ordered else None)
+                for request, _future, t_enq, rid in batch
+            ),
+            batch_id, t_flush, phases,
+        )
+
+    async def _finish_inflight(
+        self, batch, handle, finish, sem, loop, t0, t_flush, batch_id,
+        phases,
+    ):
         try:
-            auths = await loop.run_in_executor(
-                self._collect_pool, finish, handle
-            )
-            self._observe_batch(len(batch), time.perf_counter() - t0)
-            self._resolve(batch, auths)
+            with device_batch_span(batch_id, len(batch)) as span_phases:
+                auths, t_fin, t_done = await loop.run_in_executor(
+                    self._collect_pool, _timed_call, finish, handle
+                )
+                phases["device_sync"] = t_done - t_fin
+                self._observe_batch(len(batch), time.perf_counter() - t0)
+                self._resolve(batch, auths)
+                phases["unpack"] = time.perf_counter() - t_done
+                span_phases(phases)
+                rec = self.recorder
+                if rec is not None:
+                    self._record_batch(rec, batch, batch_id, t_flush, phases)
         except Exception as exc:
             self._fail(batch, exc)
         finally:
@@ -169,29 +211,49 @@ class MicroBatcher:
             if self._pending_hits < self.max_batch_hits:
                 # Linger briefly to let concurrent requests coalesce.
                 await asyncio.sleep(self.max_delay)
+            # The linger may have filled the batch past the size trigger:
+            # classify by what actually releases the flush.
+            reason = (
+                "size" if self._pending_hits >= self.max_batch_hits
+                else "deadline"
+            )
             batch = self._pending
             flush_hits = self._pending_hits
             self._pending = []
             self._pending_hits = 0
-            requests = [r for r, _f in batch]
+            requests = [r for r, _f, _t, _rid in batch]
             # Recorded in COUNTERS (hits), matching the shared
             # batcher_flush_size histogram's unit.
             self.flush_sizes.append(flush_hits)
             del self.flush_sizes[:-1000]
+            rec = self.recorder
+            t_flush = time.perf_counter()
+            batch_id = 0
+            if rec is not None:
+                batch_id = rec.next_batch_id()
+                rec.record_flush(
+                    reason, flush_hits / self.max_batch_hits,
+                    [t_flush - t for _r, _f, t, _rid in batch],
+                )
             if pipelined:
                 await sem.acquire()
                 t0 = time.perf_counter()
                 try:
-                    handle = await loop.run_in_executor(
-                        self._dispatch_pool, begin, requests
+                    handle, t_begin, t_launch = await loop.run_in_executor(
+                        self._dispatch_pool, _timed_call, begin, requests
                     )
                 except Exception as exc:
                     sem.release()
                     self._fail(batch, exc)
                     continue
+                phases = {
+                    "dispatch": t_begin - t0,
+                    "host_stage": t_launch - t_begin,
+                }
                 t = loop.create_task(
                     self._finish_inflight(
-                        batch, handle, finish, sem, loop, t0
+                        batch, handle, finish, sem, loop, t0, t_flush,
+                        batch_id, phases,
                     )
                 )
                 self._finishers.add(t)
@@ -199,11 +261,30 @@ class MicroBatcher:
             else:
                 t0 = time.perf_counter()
                 try:
-                    auths = await loop.run_in_executor(
-                        self._dispatch_pool, self.storage.check_many, requests
-                    )
-                    self._observe_batch(len(batch), time.perf_counter() - t0)
-                    self._resolve(batch, auths)
+                    with device_batch_span(
+                        batch_id, len(batch)
+                    ) as span_phases:
+                        auths, t_begin, t_done = await loop.run_in_executor(
+                            self._dispatch_pool, _timed_call,
+                            self.storage.check_many, requests,
+                        )
+                        self._observe_batch(
+                            len(batch), time.perf_counter() - t0
+                        )
+                        self._resolve(batch, auths)
+                        # check_many fuses staging, launch and the device
+                        # wait in one call: no host_stage/device_sync split
+                        # to report on this path.
+                        phases = {
+                            "dispatch": t_begin - t0,
+                            "device_sync": t_done - t_begin,
+                            "unpack": time.perf_counter() - t_done,
+                        }
+                        span_phases(phases)
+                        if rec is not None:
+                            self._record_batch(
+                                rec, batch, batch_id, t_flush, phases
+                            )
                 except Exception as exc:
                     self._fail(batch, exc)
 
@@ -222,10 +303,21 @@ class MicroBatcher:
         # otherwise await forever: decide them in one final batch.
         if self._pending:
             batch, self._pending = self._pending, []
+            flush_hits = self._pending_hits
             self._pending_hits = 0
+            rec = self.recorder
+            if rec is not None:
+                t_now = time.perf_counter()
+                rec.record_flush(
+                    "shutdown", flush_hits / self.max_batch_hits,
+                    [t_now - t for _r, _f, t, _rid in batch],
+                )
             try:
                 self._resolve(
-                    batch, self.storage.check_many([r for r, _f in batch])
+                    batch,
+                    self.storage.check_many(
+                        [r for r, _f, _t, _rid in batch]
+                    ),
                 )
             except Exception as exc:
                 self._fail(batch, exc)
@@ -255,6 +347,9 @@ class UpdateBatcher:
         self._closed = False
         self._pool = ThreadPoolExecutor(1, thread_name_prefix="tpu-update")
         self.metrics = None
+        # Device-plane telemetry sink; None = detached, zero hot-path cost
+        # (the MicroBatcher discipline).
+        self.recorder = None
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -268,7 +363,7 @@ class UpdateBatcher:
         self._ensure_started()
         future = asyncio.get_running_loop().create_future()
         self._pending[counter] = self._pending.get(counter, 0) + int(delta)
-        self._waiters.append(future)
+        self._waiters.append((future, time.perf_counter()))
         self._wakeup.set()
         await future
 
@@ -282,13 +377,23 @@ class UpdateBatcher:
 
     @staticmethod
     def _settle(waiters, exc) -> None:
-        for future in waiters:
+        for future, _t in waiters:
             if future.done():
                 continue
             if exc is not None:
                 future.set_exception(exc)
             else:
                 future.set_result(None)
+
+    def _record_flush(self, reason: str, n_counters: int, waiters) -> None:
+        rec = self.recorder
+        if rec is not None:
+            t_now = time.perf_counter()
+            rec.record_flush(
+                reason, n_counters / self.max_batch,
+                [t_now - t for _f, t in waiters],
+                batcher="update",
+            )
 
     def _swap(self):
         items = list(self._pending.items())
@@ -311,7 +416,12 @@ class UpdateBatcher:
                         return
             if len(self._pending) < self.max_batch:
                 await asyncio.sleep(self.max_delay)
+            reason = (
+                "size" if len(self._pending) >= self.max_batch
+                else "deadline"
+            )
             items, waiters = self._swap()
+            self._record_flush(reason, len(items), waiters)
             t0 = time.perf_counter()
             try:
                 await loop.run_in_executor(self._pool, self._apply, items)
@@ -336,6 +446,7 @@ class UpdateBatcher:
                 pass
         if self._pending:
             items, waiters = self._swap()
+            self._record_flush("shutdown", len(items), waiters)
             try:
                 self._apply(items)
             except Exception as exc:
@@ -369,13 +480,19 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.inner = storage or TpuStorage(**kwargs)
         self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
         self.update_batcher = UpdateBatcher(self.inner, max_delay=max_delay)
+        self.recorder: Optional[DeviceStatsRecorder] = None
 
     def set_metrics(self, metrics) -> None:
         """Have the batchers observe per-request datastore latency (device
         batch round trips, queue wait excluded) instead of the serving
-        plane's handler wall clock."""
+        plane's handler wall clock, and attach the device-plane telemetry
+        recorder (queue waits, fill ratios, flush reasons, phase timings,
+        slow-decision flight recorder)."""
         self.batcher.metrics = metrics
         self.update_batcher.metrics = metrics
+        self.recorder = DeviceStatsRecorder(metrics)
+        self.batcher.recorder = self.recorder
+        self.update_batcher.recorder = self.recorder
         self.reports_datastore_latency = True
 
     async def check_and_update(
@@ -419,7 +536,16 @@ class AsyncTpuStorage(AsyncCounterStorage):
             ),
             "cache_size": cache_size,
             "flush_sizes": flush_sizes,
+            "queue_depth": (
+                len(self.batcher._pending) + len(self.update_batcher._pending)
+            ),
         }
+
+    def device_stats(self) -> dict:
+        """Per-shard device table stats, delegated to the wrapped storage
+        (single-chip, sharded and replicated all expose the same shape)."""
+        inner_stats = getattr(self.inner, "device_stats", None)
+        return inner_stats() if callable(inner_stats) else {"shards": []}
 
     async def get_counters(self, limits) -> set:
         return self.inner.get_counters(limits)

@@ -25,7 +25,9 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import sys
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -33,7 +35,9 @@ import numpy as np
 
 from ..core.counter import Counter
 from ..core.limit import Namespace
+from ..observability.device_plane import current_request_id
 from ..observability.metrics import PrometheusMetrics
+from ..observability.tracing import device_batch_span
 from ..storage.base import StorageError
 from .. import native
 from ..ops import kernel as K
@@ -131,7 +135,9 @@ class NativeRlsPipeline:
         self._interner = self.hp.as_interner()
         self._tracked: Dict[str, int] = {}
         self._plans: Dict[int, Optional[_NsPlan]] = {}  # domain token -> plan
-        self._pending: List[Tuple[bytes, asyncio.Future]] = []
+        # (blob, future, enqueue time, request id) per pending request.
+        self._pending: List[Tuple[bytes, asyncio.Future, float, object]] = []
+        self._recorder = None  # memoized from the limiter on first sight
         self._flush_task: Optional[asyncio.Task] = None
         # Dispatch serializes host phases (the C++ context and the slot
         # path are single-threaded by design); collects may overlap.
@@ -153,6 +159,20 @@ class NativeRlsPipeline:
         self.max_interned = 4 << 20
         # eviction coherence: python slot release -> native map removal
         self.storage._table.on_native_release = self.hp.slots_remove
+
+    @property
+    def recorder(self):
+        """Device-plane telemetry sink, shared with the compiled limiter
+        (set_metrics on the limiter wires it — possibly after this
+        pipeline is constructed; one flight recorder and one batch-id
+        sequence per process). Memoized on first sight so the per-request
+        gate in submit() costs an attribute read, not a getattr chain."""
+        rec = self._recorder
+        if rec is None:
+            rec = getattr(self.limiter, "recorder", None)
+            if rec is not None:
+                self._recorder = rec
+        return rec
 
     # -- plan management ----------------------------------------------------
 
@@ -206,7 +226,8 @@ class NativeRlsPipeline:
 
     async def submit(self, blob: bytes) -> bytes:
         future = asyncio.get_running_loop().create_future()
-        self._pending.append((blob, future))
+        rid = current_request_id() if self.recorder is not None else None
+        self._pending.append((blob, future, time.perf_counter(), rid))
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = _spawn_detached(self._flush_soon())
         if len(self._pending) >= self.max_batch:
@@ -219,13 +240,25 @@ class NativeRlsPipeline:
         if self._pending:
             self._flush_task = _spawn_detached(self._flush_soon())
 
-    async def _flush(self) -> None:
+    async def _flush(self, reason: Optional[str] = None) -> None:
         batch, self._pending = self._pending, []
         if not batch:
             return
         loop = asyncio.get_running_loop()
         if self._inflight_sem is None:
             self._inflight_sem = asyncio.Semaphore(self.max_inflight)
+        rec = self.recorder
+        t_flush = time.perf_counter()
+        batch_id = 0
+        if rec is not None:
+            batch_id = rec.next_batch_id()
+            rec.record_flush(
+                reason or (
+                    "size" if len(batch) >= self.max_batch else "deadline"
+                ),
+                len(batch) / self.max_batch,
+                [t_flush - t for _b, _f, t, _rid in batch],
+            )
         # Two-phase pipelining (the MicroBatcher pattern): the host phase
         # (parse -> masks -> slots -> kernel LAUNCH) runs on the dispatch
         # thread and returns without waiting on the device; the collect
@@ -234,23 +267,31 @@ class NativeRlsPipeline:
         # on TPU the round trip is the dominant term, so this is where
         # the serving-path ceiling moves from 8192/RTT to 8192/host-time.
         await self._inflight_sem.acquire()
+        t_submit = time.perf_counter()
         try:
-            results, slow_rows, pendings = await loop.run_in_executor(
-                self._dispatch_pool, self._begin_batch,
-                [b for b, _f in batch],
+            (results, slow_rows, pendings), t_begin, t_staged = (
+                await loop.run_in_executor(
+                    self._dispatch_pool, self._timed_begin_batch,
+                    [b for b, _f, _t, _rid in batch],
+                )
             )
         except Exception as exc:
             self._inflight_sem.release()
-            for _blob, future in batch:
+            for _blob, future, _t, _rid in batch:
                 if not future.done():
                     future.set_exception(exc)
             return
         # Requests the columnar path couldn't take: exact per-request path.
         for r in slow_rows:
-            blob, future = batch[r]
+            blob, future, _t, _rid = batch[r]
             _spawn_detached(self._decide_exact(blob, future))
+        phases = {
+            "dispatch": t_begin - t_submit,
+            "host_stage": t_staged - t_begin,
+        }
         task = loop.run_in_executor(
-            self._collect_pool, self._finish_batch, batch, results, pendings
+            self._collect_pool, self._finish_batch, batch, results, pendings,
+            batch_id, t_flush, phases,
         )
         self._inflight.add(task)
 
@@ -259,7 +300,7 @@ class NativeRlsPipeline:
             self._inflight_sem.release()
             exc = t.exception()
             if exc is not None:
-                for _blob, future in batch:
+                for _blob, future, _t, _rid in batch:
                     if not future.done():
                         future.set_exception(exc)
 
@@ -323,6 +364,13 @@ class NativeRlsPipeline:
         with self._native_lock:
             return self._begin_batch_locked(blobs)
 
+    def _timed_begin_batch(self, blobs: List[bytes]):
+        """(begin result, t_start, t_end) — the dispatch-thread host phase
+        with its executor-handoff and staging times exposed."""
+        t_start = time.perf_counter()
+        out = self._begin_batch(blobs)
+        return out, t_start, time.perf_counter()
+
     def _begin_batch_locked(self, blobs: List[bytes]):
         """Host phase: parse, group by namespace, evaluate masks, resolve
         slots, LAUNCH kernels. Returns (results, slow_rows, pendings)
@@ -381,22 +429,46 @@ class NativeRlsPipeline:
                 pendings.append(pending)
         return results, slow_rows, pendings
 
-    def _finish_batch(self, batch, results, pendings) -> None:
+    def _finish_batch(
+        self, batch, results, pendings, batch_id: int = 0,
+        t_flush: float = 0.0, phases: Optional[dict] = None,
+    ) -> None:
         """Collect phase: block on the device results, fill the kernel-
         decided rows, resolve every settled future in ONE loop callback
         (a call_soon_threadsafe per future is a self-pipe write + wakeup
         per request — it profiled as ~45% of the serving path)."""
-        for pending in pendings:
-            self._finish_namespace(pending, results)
-        by_loop: Dict[object, list] = {}
-        for (blob, future), out in zip(batch, results):
-            # None marks slow-path rows (resolved later); note UNKNOWN
-            # serializes to b"" (all-default proto3), which is a valid
-            # response — only None is the sentinel.
-            if out is not None:
-                by_loop.setdefault(future.get_loop(), []).append((future, out))
-        for loop, pairs in by_loop.items():
-            loop.call_soon_threadsafe(_resolve_many, pairs)
+        with device_batch_span(batch_id, len(batch)) as span_phases:
+            t_fin = time.perf_counter()
+            for pending in pendings:
+                self._finish_namespace(pending, results)
+            t_done = time.perf_counter()
+            by_loop: Dict[object, list] = {}
+            for (blob, future, _t, _rid), out in zip(batch, results):
+                # None marks slow-path rows (resolved later); note UNKNOWN
+                # serializes to b"" (all-default proto3), which is a valid
+                # response — only None is the sentinel.
+                if out is not None:
+                    by_loop.setdefault(
+                        future.get_loop(), []).append((future, out))
+            for loop, pairs in by_loop.items():
+                loop.call_soon_threadsafe(_resolve_many, pairs)
+            rec = self.recorder
+            if phases is None:
+                return
+            phases["device_sync"] = t_done - t_fin
+            phases["unpack"] = time.perf_counter() - t_done
+            span_phases(phases)
+            if rec is None:
+                return
+            rec.record_batch(
+                (
+                    (t_enq, rid, None)
+                    for (_blob, _future, t_enq, rid), out
+                    in zip(batch, results)
+                    if out is not None  # slow-path rows decided elsewhere
+                ),
+                batch_id, t_flush, phases,
+            )
 
     def _begin_namespace(
         self, plan, token, rows, hits, cols, results, blobs
@@ -646,7 +718,7 @@ class NativeRlsPipeline:
 
     async def close(self) -> None:
         if self._flush_task is not None:
-            await self._flush()
+            await self._flush("shutdown")
         if self._inflight:
             await asyncio.gather(*self._inflight, return_exceptions=True)
         self._dispatch_pool.shutdown(wait=False)
@@ -661,9 +733,12 @@ def _spawn_detached(coro) -> asyncio.Task:
     one arbitrary request's span, folding other requests' storage time
     into its aggregate. Slow-path requests are measured by their own
     handler spans around the awaited future instead."""
-    return asyncio.get_running_loop().create_task(
-        coro, context=contextvars.Context()
-    )
+    loop = asyncio.get_running_loop()
+    if sys.version_info >= (3, 11):
+        return loop.create_task(coro, context=contextvars.Context())
+    # Python 3.10: create_task has no context kwarg, but Task captures
+    # copy_context() at construction — run it inside the fresh context.
+    return contextvars.Context().run(loop.create_task, coro)
 
 
 def _resolve(future: asyncio.Future, value: bytes) -> None:

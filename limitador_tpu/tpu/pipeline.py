@@ -24,22 +24,28 @@ from ..core.cel import Context
 from ..core.counter import Counter
 from ..core.limiter import AsyncRateLimiter, CheckResult
 from ..core.limit import Limit, Namespace
-from ..observability.tracing import datastore_span
-from .batcher import AsyncTpuStorage, _latency_hists
+from ..observability.device_plane import current_request_id
+from ..observability.tracing import datastore_span, device_batch_span
+from .batcher import AsyncTpuStorage, _latency_hists, _timed_call
 from .compiler import NamespaceCompiler
 
 __all__ = ["CompiledTpuLimiter"]
 
 
 class _RawPending:
-    __slots__ = ("namespace", "values", "delta", "load", "future")
+    __slots__ = (
+        "namespace", "values", "delta", "load", "future", "t_enq", "rid",
+    )
 
-    def __init__(self, namespace, values, delta, load, future):
+    def __init__(self, namespace, values, delta, load, future,
+                 t_enq=0.0, rid=None):
         self.namespace = namespace
         self.values = values
         self.delta = delta
         self.load = load
         self.future = future
+        self.t_enq = t_enq
+        self.rid = rid
 
 
 def _values_of(
@@ -76,6 +82,10 @@ class CompiledTpuLimiter(AsyncRateLimiter):
     def __init__(self, storage: Optional[AsyncTpuStorage] = None, **kwargs):
         super().__init__(storage or AsyncTpuStorage(**kwargs))
         self._metrics = None
+        # Device-plane telemetry sink, shared with the wrapped storage's
+        # micro-batcher (one batch-id sequence, one flight recorder per
+        # process). None until set_metrics — detached costs nothing.
+        self.recorder = None
         self._retired_vec_evals = 0
         self._retired_fb_evals = 0
         self._tpu: AsyncTpuStorage = self.storage.counters
@@ -134,6 +144,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             # Requests with exotic context shapes fall back to the standard
             # micro-batcher, which then reports its own device time.
             self._tpu.set_metrics(metrics)
+        self.recorder = getattr(self._tpu, "recorder", None)
 
     def _retire_compiler(self, compiler) -> None:
         if compiler is not None:
@@ -152,7 +163,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             fb += compiler.fallback_evals
         stats["cel_vectorized_evals"] = vec
         stats["cel_fallback_evals"] = fb
+        stats["queue_depth"] = stats.get("queue_depth", 0) + len(self._pending)
         return stats
+
+    def device_stats(self) -> dict:
+        inner_stats = getattr(self._tpu, "device_stats", None)
+        return inner_stats() if callable(inner_stats) else {"shards": []}
 
     def _compiler_for(self, namespace: Namespace) -> NamespaceCompiler:
         compiler = self._compilers.get(namespace)
@@ -178,8 +194,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 namespace, ctx, delta, load_counters
             )
         future = asyncio.get_running_loop().create_future()
+        rid = current_request_id() if self.recorder is not None else None
         self._pending.append(
-            _RawPending(namespace, values, delta, load_counters, future)
+            _RawPending(
+                namespace, values, delta, load_counters, future,
+                time.perf_counter(), rid,
+            )
         )
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_running_loop().create_task(
@@ -206,13 +226,25 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 self._flush_soon()
             )
 
-    async def _flush(self) -> None:
+    async def _flush(self, reason: Optional[str] = None) -> None:
         batch, self._pending = self._pending, []
         if not batch:
             return
         loop = asyncio.get_running_loop()
         if self._inflight_sem is None:
             self._inflight_sem = asyncio.Semaphore(self.max_inflight)
+        rec = self.recorder
+        t_flush = time.perf_counter()
+        batch_id = 0
+        if rec is not None:
+            batch_id = rec.next_batch_id()
+            rec.record_flush(
+                reason or (
+                    "size" if len(batch) >= self.max_batch else "deadline"
+                ),
+                len(batch) / self.max_batch,
+                [t_flush - p.t_enq for p in batch],
+            )
         live: List[Tuple[_RawPending, List[Counter]]] = []
         try:
             # Columnar evaluation stays ON the loop thread: the compiler
@@ -234,6 +266,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             if not live:
                 return
             reqs = [_Request(c, p.delta, p.load) for p, c in live]
+            t_eval = time.perf_counter()
             await self._inflight_sem.acquire()
         except BaseException as exc:
             # Nothing may escape silently: an exception (INCLUDING a
@@ -241,9 +274,11 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             # would strand every other submitter of this batch.
             _fail_futures(batch, exc)
             raise
+        t_submit = time.perf_counter()
         try:
-            handle = await loop.run_in_executor(
-                self._dispatch_pool, self._tpu.inner.begin_check_many, reqs
+            handle, t_begin, t_launch = await loop.run_in_executor(
+                self._dispatch_pool, _timed_call,
+                self._tpu.inner.begin_check_many, reqs,
             )
         except BaseException as exc:
             self._inflight_sem.release()
@@ -251,9 +286,19 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             if not isinstance(exc, Exception):
                 raise
             return
+        # host_stage folds the on-loop columnar evaluation in with the
+        # kernel launch: both are host work this batch paid before the
+        # device round trip. The inflight-semaphore wait (t_eval ->
+        # t_submit) is backpressure queueing, not host work — excluded,
+        # matching the native pipeline's post-acquire t_submit.
+        phases = {
+            "dispatch": t_begin - t_submit,
+            "host_stage": (t_eval - t_flush) + (t_launch - t_begin),
+        }
         t0 = time.perf_counter()
         task = loop.run_in_executor(
-            self._collect_pool, self._collect_batch, handle, live, t0
+            self._collect_pool, self._collect_batch, handle, live, t0,
+            batch_id, t_flush, phases,
         )
         self._inflight.add(task)
 
@@ -266,24 +311,42 @@ class CompiledTpuLimiter(AsyncRateLimiter):
 
         task.add_done_callback(_collected)
 
-    def _collect_batch(self, handle, live, t0: float) -> None:
+    def _collect_batch(
+        self, handle, live, t0: float, batch_id: int = 0,
+        t_flush: float = 0.0, phases: Optional[dict] = None,
+    ) -> None:
         """Collect-thread phase: device transfer, decode, resolve every
         future in one loop callback per loop."""
-        auths = self._tpu.inner.finish_check_many(handle)
-        if self._metrics is not None:
-            dt = time.perf_counter() - t0
-            for hist in _latency_hists(self._metrics):
-                for _ in live:
-                    hist.observe(dt)
-        by_loop: Dict[object, list] = {}
-        for (p, counters), auth in zip(live, auths):
-            loaded = counters if p.load else []
-            result = CheckResult(auth.limited, loaded, auth.limit_name)
-            by_loop.setdefault(p.future.get_loop(), []).append(
-                (p.future, result)
+        with device_batch_span(batch_id, len(live)) as span_phases:
+            auths, t_fin, t_done = _timed_call(
+                self._tpu.inner.finish_check_many, handle
             )
-        for floop, pairs in by_loop.items():
-            floop.call_soon_threadsafe(_settle_results, pairs)
+            if self._metrics is not None:
+                dt = time.perf_counter() - t0
+                for hist in _latency_hists(self._metrics):
+                    for _ in live:
+                        hist.observe(dt)
+            by_loop: Dict[object, list] = {}
+            for (p, counters), auth in zip(live, auths):
+                loaded = counters if p.load else []
+                result = CheckResult(auth.limited, loaded, auth.limit_name)
+                by_loop.setdefault(p.future.get_loop(), []).append(
+                    (p.future, result)
+                )
+            for floop, pairs in by_loop.items():
+                floop.call_soon_threadsafe(_settle_results, pairs)
+            rec = self.recorder
+            if phases is None:
+                return
+            phases["device_sync"] = t_done - t_fin
+            phases["unpack"] = time.perf_counter() - t_done
+            span_phases(phases)
+            if rec is None:
+                return
+            rec.record_batch(
+                ((p.t_enq, p.rid, p.namespace) for p, _counters in live),
+                batch_id, t_flush, phases,
+            )
 
     def _evaluate_batch(
         self, batch: List[_RawPending]
@@ -317,7 +380,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
 
     async def close(self) -> None:
         """Drain in-flight collects and release the worker pools."""
-        await self._flush()
+        await self._flush("shutdown")
         if self._inflight:
             await asyncio.gather(*self._inflight, return_exceptions=True)
         self._dispatch_pool.shutdown(wait=False)

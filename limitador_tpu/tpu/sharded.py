@@ -166,12 +166,14 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             raise StorageError("TPU shard table full (no evictable slots)")
         key, slot = next(iter(table.qualified.items()))
         table.release(slot, key, qualified=True)
+        table.evictions += 1
 
     def _evict_global(self) -> None:
         if not self._gtable.qualified:
             raise StorageError("TPU global region full (no evictable slots)")
         key, slot = next(iter(self._gtable.qualified.items()))
         self._gtable.release(slot, key, qualified=True)
+        self._gtable.evictions += 1
         self._zero_global_slots([slot])
 
     def _slot_for(
@@ -191,7 +193,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 self._evict_global()
             if not self._gtable.free:
                 self._evict_global()
-            slot = self._gtable.free.pop()
+            slot = self._gtable.alloc()
             if qualified:
                 self._gtable.qualified[key] = slot
             else:
@@ -210,7 +212,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 self._evict_local(table)
         if not table.free:
             self._evict_local(table)
-        slot = table.free.pop()
+        slot = table.alloc()
         if qualified:
             table.qualified[key] = slot
         else:
@@ -224,6 +226,28 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         s = self._rr
         self._rr = (self._rr + 1) % self._n
         return s
+
+    def device_stats(self) -> dict:
+        """Per-shard table stats for /debug/stats and the Prometheus
+        shard gauges: one entry per shard-local table (capacity = the
+        shard-local slot range) plus the replicated psum global region."""
+        with self._lock:
+            shards = [{
+                "shard": str(i),
+                "occupied": len(t.info),
+                "capacity": self._local_capacity - self._global_region,
+                "evictions": t.evictions,
+                "collisions": t.collisions,
+            } for i, t in enumerate(self._tables)]
+            if self._global_region:
+                shards.append({
+                    "shard": "global",
+                    "occupied": len(self._gtable.info),
+                    "capacity": self._global_region,
+                    "evictions": self._gtable.evictions,
+                    "collisions": self._gtable.collisions,
+                })
+            return {"shards": shards}
 
     # -- the shared batched check path --------------------------------------
 

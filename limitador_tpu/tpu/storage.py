@@ -105,6 +105,13 @@ class _SlotTable:
         # slot -> native composite key + removal hook (native fast path)
         self.native_keys: Dict[int, object] = {}
         self.on_native_release = None
+        # Device-plane telemetry (device_stats()): cumulative counts of
+        # LRU evictions and of fresh allocations that recycled a
+        # previously-occupied slot (the kernel's fresh flag overrides the
+        # stale cell). Host bookkeeping only — never reset by dump/load.
+        self.evictions = 0
+        self.collisions = 0
+        self._recycled: set = set()
 
     def lookup(self, key: tuple, qualified: bool) -> Optional[int]:
         if qualified:
@@ -144,6 +151,16 @@ class _SlotTable:
                 s for s in range(hi - 1, lo - 1, -1) if s not in occupied
             ]
 
+    def alloc(self) -> int:
+        """Pop a free slot; counts the recycled-slot collision when the
+        slot held a (now released) counter before. Callers guarantee
+        ``free`` is non-empty."""
+        slot = self.free.pop()
+        if slot in self._recycled:
+            self._recycled.discard(slot)
+            self.collisions += 1
+        return slot
+
     def release(self, slot: int, key: tuple, qualified: bool) -> None:
         self.info.pop(slot, None)
         if qualified:
@@ -151,6 +168,7 @@ class _SlotTable:
         else:
             self.simple.pop(key, None)
         self.free.append(slot)
+        self._recycled.add(slot)
         # Eviction coherence with the native slot map: a recycled slot must
         # not remain reachable under its old native key.
         native_key = self.native_keys.pop(slot, None)
@@ -398,6 +416,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             raise StorageError("TPU counter table full (no evictable slots)")
         key, slot = next(iter(self._table.qualified.items()))
         self._table.release(slot, key, qualified=True)
+        self._table.evictions += 1
 
     def _slot_for(self, counter: Counter, create: bool) -> Tuple[Optional[int], bool]:
         """Return (slot, fresh). fresh=True when allocated/recycled now."""
@@ -413,13 +432,29 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 self._evict_one()
         if not self._table.free:
             self._evict_one()
-        slot = self._table.free.pop()
+        slot = self._table.alloc()
         if qualified:
             self._table.qualified[key] = slot
         else:
             self._table.simple[key] = slot
         self._table.info[slot] = (key, counter.key())
         return slot, True
+
+    def device_stats(self) -> dict:
+        """Device-plane table stats for /debug/stats and the per-shard
+        Prometheus gauges (observability/device_plane.py): occupancy as a
+        level, evictions/collisions as cumulative counts."""
+        with self._lock:
+            t = self._table
+            return {
+                "shards": [{
+                    "shard": "0",
+                    "occupied": len(t.info),
+                    "capacity": t.capacity,
+                    "evictions": t.evictions,
+                    "collisions": t.collisions,
+                }],
+            }
 
     # -- the shared batched check path -------------------------------------
 

@@ -60,10 +60,15 @@ def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()
     for expr in dashboard_exprs():
+        # label VALUES ({batcher="check"}) are quoted — drop them so only
+        # metric and label identifiers remain
+        expr = re.sub(r'"[^"]*"', '""', expr)
         for ident in re.findall(r"[a-zA-Z_][a-zA-Z0-9_]*", expr):
             if ident in PROMQL_BUILTINS or ident.startswith("$"):
                 continue
-            if ident in ("limitador_namespace",):  # label, not a metric
+            # labels, not metrics
+            if ident in ("limitador_namespace", "shard", "phase", "reason",
+                         "batcher"):
                 continue
             # identifiers followed by ( are function calls; filter by
             # checking against the metric-shaped remainder

@@ -1,0 +1,618 @@
+"""Device-plane observability (observability/device_plane.py).
+
+Covers the tentpole surface end to end: the new metric families and
+their exposition names, the slow-decision flight recorder's admission/
+eviction order, /debug/stats and /debug/profile round trips over the
+HTTP API, registry hygiene (every metric has HELP text and a consistent
+name), and the no-op guard — a batcher without a recorder attached must
+touch zero observability objects per decision.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from limitador_tpu.observability.device_plane import (
+    DeviceStatsRecorder,
+    FLUSH_REASONS,
+    FlightRecorder,
+    JaxProfiler,
+    PHASES,
+    ProfilerStateError,
+    collect_debug_stats,
+    current_request_id,
+    set_request_id,
+)
+from limitador_tpu.observability.metrics import PrometheusMetrics
+
+
+# -- flight recorder ---------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_keeps_slowest_n_in_slowest_first_order(self):
+        fr = FlightRecorder(capacity=3)
+        for ms in (5, 1, 9, 3, 7):
+            fr.offer(ms / 1e3, {"tag": ms})
+        snap = fr.snapshot()
+        assert [e["tag"] for e in snap] == [9, 7, 5]
+        assert [e["duration_ms"] for e in snap] == [9.0, 7.0, 5.0]
+
+    def test_eviction_order_is_fastest_resident_first(self):
+        fr = FlightRecorder(capacity=2)
+        fr.offer(0.010, {"tag": "a"})
+        fr.offer(0.020, {"tag": "b"})
+        # 5ms cannot enter a {10, 20} buffer...
+        assert not fr.would_admit(0.005)
+        fr.offer(0.005, {"tag": "c"})
+        assert {e["tag"] for e in fr.snapshot()} == {"a", "b"}
+        # ...15ms evicts the fastest resident (10ms), not the slowest.
+        assert fr.would_admit(0.015)
+        fr.offer(0.015, {"tag": "d"})
+        assert [e["tag"] for e in fr.snapshot()] == ["b", "d"]
+
+    def test_ties_keep_insertion_order(self):
+        fr = FlightRecorder(capacity=4)
+        for tag in ("x", "y", "z"):
+            fr.offer(0.004, {"tag": tag})
+        assert [e["tag"] for e in fr.snapshot()] == ["x", "y", "z"]
+
+    def test_clear(self):
+        fr = FlightRecorder(capacity=2)
+        fr.offer(0.001, {})
+        fr.clear()
+        assert fr.snapshot() == []
+
+
+class TestDeviceStatsRecorder:
+    def test_flush_reasons_tally_without_metrics(self):
+        rec = DeviceStatsRecorder(metrics=None)
+        rec.record_flush("deadline", 0.5, [0.001])
+        rec.record_flush("deadline", 0.25, [])
+        rec.record_flush("size", 1.0, [0.002, 0.003])
+        assert rec.flush_reasons == {
+            "size": 1, "deadline": 2, "shutdown": 0,
+        }
+        rec.record_phases({"dispatch": 0.1})  # no metrics: must not raise
+
+    def test_observes_into_metric_families(self):
+        m = PrometheusMetrics()
+        rec = DeviceStatsRecorder(m)
+        rec.record_flush("size", 2.0, [0.001, 0.002])  # ratio clamps to 1
+        rec.record_flush("deadline", 0.5, [0.001], batcher="update")
+        rec.record_phases({p: 0.001 for p in PHASES})
+        text = m.render().decode()
+        assert (
+            'batcher_flushes_total{batcher="check",reason="size"} 1.0'
+            in text
+        )
+        assert (
+            'batcher_flushes_total{batcher="update",reason="deadline"} 1.0'
+            in text
+        )
+        assert 'batcher_queue_wait_count{batcher="check"} 2.0' in text
+        assert 'batcher_queue_wait_count{batcher="update"} 1.0' in text
+        assert 'batcher_batch_fill_ratio_sum{batcher="check"} 1.0' in text
+        for phase in PHASES:
+            assert (
+                f'device_phase_latency_count{{phase="{phase}"}} 1.0' in text
+            )
+
+    def test_batch_ids_are_monotonic(self):
+        rec = DeviceStatsRecorder()
+        assert [rec.next_batch_id() for _ in range(3)] == [1, 2, 3]
+
+    def test_request_id_contextvar_roundtrip(self):
+        assert current_request_id() is None
+        set_request_id("rid-1")
+        assert current_request_id() == "rid-1"
+        set_request_id(None)
+        assert current_request_id() is None
+
+
+# -- exposition names + registry hygiene -------------------------------------
+
+
+EXPECTED_DEVICE_FAMILIES = (
+    "batcher_queue_depth",
+    "batcher_queue_wait",
+    "batcher_batch_fill_ratio",
+    "batcher_flushes",
+    "device_phase_latency",
+    "counter_slots_used",
+    "counter_slots_capacity",
+    "counter_slot_evictions",
+    "counter_slot_collisions",
+)
+
+
+def test_device_families_exported_and_preseeded():
+    """The families render (with zeroed label sets for the bounded
+    reason/phase labels) before any traffic, so dashboards and benches
+    never see absent series."""
+    from limitador_tpu.observability.device_plane import BATCHERS
+
+    text = PrometheusMetrics().render().decode()
+    for family in EXPECTED_DEVICE_FAMILIES:
+        assert family in text, family
+    for batcher in BATCHERS:
+        assert f'batcher_queue_wait_count{{batcher="{batcher}"}} 0.0' in text
+        for reason in FLUSH_REASONS:
+            assert (
+                f'batcher_flushes_total{{batcher="{batcher}"'
+                f',reason="{reason}"}} 0.0' in text
+            )
+    for phase in PHASES:
+        assert f'device_phase_latency_count{{phase="{phase}"}} 0.0' in text
+
+
+def test_every_metric_has_help_and_consistent_name():
+    """Lint over the whole registry: non-empty HELP text and
+    snake_case names on every family PrometheusMetrics registers."""
+    import re
+
+    for fam in PrometheusMetrics().registry.collect():
+        assert fam.documentation and fam.documentation.strip(), (
+            f"metric {fam.name} has empty HELP text"
+        )
+        assert re.fullmatch(r"[a-z][a-z0-9_]*", fam.name), (
+            f"metric {fam.name} breaks the snake_case naming scheme"
+        )
+
+
+def test_poll_converts_device_stats_and_queue_depth():
+    """attach_library_source sources feed the shard gauges (levels) and
+    eviction/collision counters (cumulative -> increments) plus the
+    queue-depth gauge on every render."""
+
+    class Source:
+        def __init__(self):
+            self.evictions = 5
+
+        def library_stats(self):
+            return {"queue_depth": 7}
+
+        def device_stats(self):
+            return {"shards": [{
+                "shard": "0", "occupied": 3, "capacity": 8,
+                "evictions": self.evictions, "collisions": 2,
+            }]}
+
+    m = PrometheusMetrics()
+    source = Source()
+    m.attach_library_source(source)
+    text = m.render().decode()
+    assert "batcher_queue_depth 7.0" in text
+    assert 'counter_slots_used{shard="0"} 3.0' in text
+    assert 'counter_slots_capacity{shard="0"} 8.0' in text
+    assert 'counter_slot_evictions_total{shard="0"} 5.0' in text
+    assert 'counter_slot_collisions_total{shard="0"} 2.0' in text
+    source.evictions = 9  # cumulative 9 -> +4 over the baseline
+    text = m.render().decode()
+    assert 'counter_slot_evictions_total{shard="0"} 9.0' in text
+    assert 'counter_slot_collisions_total{shard="0"} 2.0' in text
+
+
+# -- collect_debug_stats walking ---------------------------------------------
+
+
+def test_collect_debug_stats_walks_queues_shards_and_recorders():
+    rec = DeviceStatsRecorder()
+    rec.record_flush("deadline", 0.1, [])
+    rec.record_decision(0.005, "rid-9", "ns", 4, 0.001, {"unpack": 1.0})
+
+    class Batcher:
+        recorder = rec
+        _pending = [1, 2, 3]
+        _pending_hits = 6
+
+    class Inner:
+        @staticmethod
+        def device_stats():
+            return {"shards": [
+                {"shard": "0", "occupied": 1, "capacity": 4,
+                 "evictions": 0, "collisions": 0},
+            ]}
+
+    class Storage:
+        batcher = Batcher()
+        inner = Inner()
+
+        # The facade delegates: the walker must key shards by label and
+        # not report the same table twice.
+        @staticmethod
+        def device_stats():
+            return Inner.device_stats()
+
+    class Limiter:
+        storage = Storage()
+
+    stats = collect_debug_stats(Limiter())
+    assert stats["queues"] == [
+        {"queue": "Batcher", "depth": 3, "pending_hits": 6}
+    ]
+    assert stats["shards"] == [
+        {"shard": "0", "occupied": 1, "capacity": 4,
+         "evictions": 0, "collisions": 0},
+    ]
+    assert stats["flush_reasons"]["deadline"] == 1
+    [entry] = stats["flight_recorder"]
+    assert entry["request_id"] == "rid-9"
+    assert entry["batch_id"] == 4
+    assert entry["duration_ms"] == 5.0
+    assert entry["phases_ms"] == {"unpack": 1.0}
+
+
+def test_collect_debug_stats_handles_cycles_and_bare_objects():
+    class A:
+        pass
+
+    a = A()
+    a.inner = a  # cycle must terminate
+    stats = collect_debug_stats(a, None, object())
+    assert stats == {
+        "queues": [], "shards": [], "flush_reasons": {},
+        "flight_recorder": [],
+    }
+
+
+# -- storage device_stats ----------------------------------------------------
+
+
+def test_tpu_storage_device_stats_occupancy_and_evictions():
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit
+    from limitador_tpu.tpu.storage import TpuStorage
+
+    storage = TpuStorage(capacity=8, cache_size=2)
+    limit = Limit("ns", 100, 60, [], ["u"])
+    for i in range(4):  # cache_size=2 -> 2 LRU evictions
+        storage.update_counter(Counter(limit, {"u": str(i)}), 1)
+    [shard] = storage.device_stats()["shards"]
+    assert shard["shard"] == "0"
+    assert shard["capacity"] == 8
+    assert shard["occupied"] == 2
+    assert shard["evictions"] == 2
+    # the free list is LIFO: a recycled slot is reused -> collision
+    assert shard["collisions"] >= 1
+
+
+def test_sharded_storage_device_stats_lists_every_shard():
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    storage = TpuShardedStorage(local_capacity=16, global_region=4)
+    shards = storage.device_stats()["shards"]
+    labels = [s["shard"] for s in shards]
+    assert labels[-1] == "global"
+    assert len(labels) == len(set(labels)) >= 2
+    for s in shards:
+        cap = 4 if s["shard"] == "global" else 12
+        assert s["capacity"] == cap
+        assert s["occupied"] == 0
+    storage.close()
+
+
+# -- the hot-path no-op guard ------------------------------------------------
+
+
+def test_detached_batcher_touches_no_observability_objects(monkeypatch):
+    """With no recorder attached the per-decision path must short-circuit
+    before ANY observability work: no request-id contextvar read, no
+    recorder attribute access, no span machinery beyond the cheap
+    _enabled check. The monkeypatched trips prove the gate."""
+    from limitador_tpu.storage.base import Authorization
+    from limitador_tpu.tpu import batcher as batcher_mod
+
+    def trip(*_a, **_k):
+        raise AssertionError("observability object touched while detached")
+
+    monkeypatch.setattr(batcher_mod, "current_request_id", trip)
+    monkeypatch.setattr(
+        DeviceStatsRecorder, "record_flush", trip, raising=True
+    )
+    monkeypatch.setattr(
+        DeviceStatsRecorder, "record_phases", trip, raising=True
+    )
+    monkeypatch.setattr(FlightRecorder, "offer", trip, raising=True)
+
+    class FakeStorage:
+        @staticmethod
+        def check_many(requests):
+            return [Authorization.OK] * len(requests)
+
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit
+
+    limit = Limit("ns", 100, 60, [], [])
+
+    async def main():
+        b = batcher_mod.MicroBatcher(FakeStorage(), max_delay=0.0001)
+        assert b.recorder is None and b.metrics is None
+        auths = await asyncio.gather(*[
+            b.submit([Counter(limit, {})], 1, False) for _ in range(16)
+        ])
+        await b.close()
+        return auths
+
+    auths = asyncio.new_event_loop().run_until_complete(main())
+    assert all(a is Authorization.OK for a in auths)
+
+
+def test_attached_batcher_records(monkeypatch):
+    """Control for the guard test: the same traffic WITH a recorder
+    attached does read the request id and record the flush."""
+    from limitador_tpu.storage.base import Authorization
+    from limitador_tpu.tpu import batcher as batcher_mod
+
+    calls = {"rid": 0}
+
+    def count_rid():
+        calls["rid"] += 1
+        return "rid-x"
+
+    monkeypatch.setattr(batcher_mod, "current_request_id", count_rid)
+
+    class FakeStorage:
+        @staticmethod
+        def check_many(requests):
+            return [Authorization.OK] * len(requests)
+
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.core.limit import Limit
+
+    limit = Limit("ns", 100, 60, [], ["u"])
+    rec = DeviceStatsRecorder()
+
+    async def main():
+        b = batcher_mod.MicroBatcher(FakeStorage(), max_delay=0.0001)
+        b.recorder = rec
+        await asyncio.gather(*[
+            b.submit([Counter(limit, {"u": str(i)})], 1, False)
+            for i in range(4)
+        ])
+        await b.close()
+
+    asyncio.new_event_loop().run_until_complete(main())
+    assert calls["rid"] == 4
+    assert sum(rec.flush_reasons.values()) >= 1
+    snap = rec.flight.snapshot()
+    assert snap and snap[0]["request_id"] == "rid-x"
+    assert set(snap[0]["phases_ms"]) <= set(PHASES)
+
+
+# -- /debug endpoints over the HTTP API --------------------------------------
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def test_debug_stats_endpoint_roundtrip():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import Limit
+    from limitador_tpu.server.http_api import make_http_app
+    from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+    from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+    async def main():
+        storage = AsyncTpuStorage(
+            TpuStorage(capacity=1 << 10), max_delay=0.0005
+        )
+        limiter = CompiledTpuLimiter(storage)
+        metrics = PrometheusMetrics()
+        limiter.set_metrics(metrics)
+        limiter.add_limit(Limit("api", 1000, 60, [], ["descriptors[0].u"]))
+        app = make_http_app(limiter, metrics)
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            for i in range(8):
+                resp = await client.post("/check_and_report", json={
+                    "namespace": "api", "values": {"u": str(i)},
+                })
+                assert resp.status == 200
+            await asyncio.sleep(0.1)  # let the collect thread record
+            resp = await client.get("/debug/stats")
+            assert resp.status == 200
+            data = await resp.json()
+        finally:
+            await client.close()
+            await limiter.close()
+            await storage.close()
+        return data
+
+    data = _run(main())
+    assert {"queues", "shards", "flush_reasons", "flight_recorder",
+            "profiler"} <= set(data)
+    queue_names = {q["queue"] for q in data["queues"]}
+    assert "compiled_pipeline" in queue_names
+    assert "check_batcher" in queue_names
+    [shard] = data["shards"]
+    assert shard["occupied"] == 8 and shard["capacity"] == 1024
+    assert sum(data["flush_reasons"].values()) >= 1
+    assert data["flight_recorder"], "slow decisions must be recorded"
+    entry = data["flight_recorder"][0]
+    assert entry["namespace"] == "api"
+    assert entry["batch_id"] >= 1
+    assert entry["duration_ms"] >= entry["queue_wait_ms"]
+    # the HTTP middleware published the generated x-request-id
+    assert entry["request_id"] and len(entry["request_id"]) == 32
+
+
+def test_debug_profile_endpoint_roundtrip(tmp_path):
+    """Start/stop a real jax.profiler capture through the endpoint (CPU
+    backend: the trace machinery is backend-independent)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    trace_dir = str(tmp_path / "trace")
+
+    async def main():
+        app = make_http_app(RateLimiter(), None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/profile")
+            assert (await resp.json()) == {
+                "active": False, "trace_dir": None, "started_at": None,
+            }
+            resp = await client.post("/debug/profile", json={
+                "action": "start", "trace_dir": trace_dir,
+            })
+            assert resp.status == 200
+            assert (await resp.json())["trace_dir"] == trace_dir
+            resp = await client.post(
+                "/debug/profile", json={"action": "start"}
+            )
+            assert resp.status == 409  # already capturing
+            status = await (await client.get("/debug/profile")).json()
+            assert status["active"] and status["trace_dir"] == trace_dir
+            resp = await client.post(
+                "/debug/profile", json={"action": "stop"}
+            )
+            assert resp.status == 200
+            resp = await client.post(
+                "/debug/profile", json={"action": "stop"}
+            )
+            assert resp.status == 409  # nothing active
+            resp = await client.post(
+                "/debug/profile", json={"action": "rewind"}
+            )
+            assert resp.status == 400
+        finally:
+            await client.close()
+
+    _run(main())
+    import os
+
+    assert os.path.isdir(trace_dir), "profiler wrote no trace"
+
+
+def test_jax_profiler_state_machine(tmp_path):
+    profiler = JaxProfiler(default_dir=str(tmp_path / "default"))
+    with pytest.raises(ProfilerStateError):
+        profiler.stop()
+    target = profiler.start()
+    assert target == str(tmp_path / "default")
+    with pytest.raises(ProfilerStateError):
+        profiler.start()
+    assert profiler.status()["active"]
+    assert profiler.stop() == target
+    assert not profiler.status()["active"]
+
+
+# -- gRPC request-id propagation (streaming fix) -----------------------------
+
+
+def test_grpc_stream_handlers_echo_request_id():
+    """The interceptor previously wrapped only unary-unary handlers;
+    streaming RPCs (server reflection is stream-stream) got no
+    x-request-id echo. All four handler kinds now carry it."""
+    import grpc
+
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.proto import reflection_pb2 as rpb
+    from limitador_tpu.server.reflection import REFLECTION_METHOD
+    from limitador_tpu.server.rls import serve_rls
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    def free_port():
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    limiter = RateLimiter(InMemoryStorage())
+    limiter.add_limit(Limit("ns", 3, 60, [], ["u"]))
+    port = free_port()
+    loop = asyncio.new_event_loop()
+    server = loop.run_until_complete(
+        serve_rls(limiter, f"127.0.0.1:{port}", None, "NONE")
+    )
+    thread = threading.Thread(target=loop.run_forever, daemon=True)
+    thread.start()
+    try:
+        with grpc.insecure_channel(f"127.0.0.1:{port}") as channel:
+            call = channel.stream_stream(
+                REFLECTION_METHOD,
+                request_serializer=(
+                    rpb.ServerReflectionRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    rpb.ServerReflectionResponse.FromString
+                ),
+            )
+            responses = call(
+                iter([rpb.ServerReflectionRequest(list_services="")]),
+                metadata=(("x-request-id", "stream-rid-7"),),
+                timeout=10,
+            )
+            assert list(responses)  # stream completed
+            initial = dict(responses.initial_metadata())
+            assert initial.get("x-request-id") == "stream-rid-7"
+            # without a client id the server mints one
+            responses = call(
+                iter([rpb.ServerReflectionRequest(list_services="")]),
+                timeout=10,
+            )
+            list(responses)
+            minted = dict(responses.initial_metadata()).get("x-request-id")
+            assert minted and len(minted) == 32
+    finally:
+        loop.call_soon_threadsafe(
+            lambda: asyncio.ensure_future(server.stop(grace=None))
+        )
+        loop.call_soon_threadsafe(loop.stop)
+        thread.join(timeout=5)
+
+
+# -- bench scraper -----------------------------------------------------------
+
+
+def test_bench_scraper_parses_exposition(monkeypatch):
+    """The bench's post-pass scrape turns a live exposition into
+    queue_wait_p99_ms / batch_fill_ratio / deadline_flush_share."""
+    import io
+    import sys
+    import urllib.request
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent.parent))
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+
+    m = PrometheusMetrics()
+    rec = DeviceStatsRecorder(m)
+    rec.record_flush("deadline", 0.25, [0.004] * 99)
+    rec.record_flush("deadline", 0.25, [0.080])
+    rec.record_flush("size", 1.0, [])
+    rec.record_flush("shutdown", 0.1, [])  # excluded from the share
+    # write-behind flushes must not pollute the decision-path figures
+    rec.record_flush("deadline", 0.01, [2.0] * 500, batcher="update")
+    body = m.render()
+
+    def fake_urlopen(url, timeout=None):
+        assert url.endswith("/metrics")
+        resp = io.BytesIO(body)
+        resp.__enter__ = lambda *a: resp
+        resp.__exit__ = lambda *a: False
+        return resp
+
+    monkeypatch.setattr(urllib.request, "urlopen", fake_urlopen)
+    out = bench._scrape_device_metrics(12345)
+    # 100 samples: 99 land in the le=5ms bucket, one at 80ms -> the
+    # 99th-percentile target sits exactly on the 5ms bucket bound.
+    assert 4.0 <= out["queue_wait_p99_ms"] <= 100.0
+    assert out["batch_fill_ratio"] == round(1.6 / 4, 4)
+    assert out["deadline_flush_share"] == round(2 / 3, 4)

@@ -461,10 +461,10 @@ def test_api_spec_served():
     assert spec["openapi"].startswith("3.")
     for path in ("/status", "/metrics", "/limits/{namespace}",
                  "/counters/{namespace}", "/check", "/report",
-                 "/check_and_report"):
+                 "/check_and_report", "/debug/stats", "/debug/profile"):
         assert path in spec["paths"], path
     assert set(spec["components"]["schemas"]) == {
-        "Limit", "Counter", "CheckAndReportInfo"
+        "Limit", "Counter", "CheckAndReportInfo", "ProfileAction"
     }
 
 
