@@ -639,6 +639,12 @@ def _scrape_device_metrics(http_port: int) -> dict:
     buckets = []  # (le_seconds, cumulative_count) in exposition order
     fill_sum = fill_count = 0.0
     flushes = {}
+    # Admission-plane signals (observability/metrics.py admission_*
+    # families): sheds, breaker state, cumulative failed-over seconds.
+    sheds = 0.0
+    decided_calls = 0.0  # authorized + limited (the shed-rate base)
+    breaker_state = None
+    failover_seconds = None
     # Only the decision path: batcher="update" is the write-behind
     # queue, which lingers to its deadline by design and would skew
     # every derived figure.
@@ -658,8 +664,25 @@ def _scrape_device_metrics(http_port: int) -> dict:
             m = re.search(r'reason="([^"]+)"\}\s+([0-9.eE+-]+)', line)
             if m:
                 flushes[m.group(1)] = float(m.group(2))
+        elif line.startswith("admission_sheds_total"):
+            sheds += float(line.split()[-1])
+        elif line.startswith("admission_breaker_state "):
+            breaker_state = float(line.split()[-1])
+        elif line.startswith("admission_failover_seconds_total"):
+            failover_seconds = float(line.split()[-1])
+        elif (line.startswith("authorized_calls_total")
+              or line.startswith("limited_calls_total")):
+            decided_calls += float(line.split()[-1])
 
     out = {}
+    if breaker_state is not None:
+        # Only meaningful when the admission plane is on; a server
+        # without it exposes no admission_* families at all.
+        out["breaker_state"] = int(breaker_state)
+        out["failover_seconds"] = round(failover_seconds or 0.0, 3)
+        out["shed_total"] = int(sheds)
+        if sheds + decided_calls > 0:
+            out["shed_rate"] = round(sheds / (sheds + decided_calls), 4)
     total = buckets[-1][1] if buckets else 0.0
     if total > 0:
         target = 0.99 * total

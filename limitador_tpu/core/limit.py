@@ -45,10 +45,18 @@ def _as_expression(e: Union[str, Expression]) -> Expression:
 
 POLICIES = ("fixed_window", "token_bucket")
 
+# Admission-plane priority annotation values (admission/priority.py
+# resolves them; duplicated here because core must not import the
+# admission package — numeric strings are the 0-3 levels).
+PRIORITY_ANNOTATIONS = (
+    "low", "normal", "high", "critical", "0", "1", "2", "3",
+)
+
 
 class Limit:
     __slots__ = ("id", "namespace", "max_value", "seconds", "name",
-                 "conditions", "variables", "policy", "_identity", "_hash")
+                 "conditions", "variables", "policy", "priority",
+                 "_identity", "_hash")
 
     def __init__(
         self,
@@ -60,6 +68,7 @@ class Limit:
         name: Optional[str] = None,
         id: Optional[str] = None,
         policy: str = "fixed_window",
+        priority: Optional[str] = None,
     ):
         """``policy`` extends the reference's fixed-window-only model
         (limit.rs has no such field): ``token_bucket`` counts with a
@@ -71,6 +80,16 @@ class Limit:
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown limit policy {policy!r}; expected one of {POLICIES}"
+            )
+        if priority is not None and (
+            str(priority).strip().lower() not in PRIORITY_ANNOTATIONS
+        ):
+            # An admission-plane annotation (limits-file `priority:`);
+            # like name/max_value it is EXCLUDED from identity — it
+            # shapes shedding, not counting.
+            raise ValueError(
+                f"unknown limit priority {priority!r}; expected one of "
+                f"{PRIORITY_ANNOTATIONS[:4]}"
             )
         if policy == "token_bucket" and int(max_value) > int(seconds) * 10**9:
             # GCRA ticks bottom out at 1ns/token (storage/gcra.py
@@ -89,6 +108,9 @@ class Limit:
         self.seconds = int(seconds)
         self.name = name
         self.policy = policy
+        self.priority = (
+            str(priority).strip().lower() if priority is not None else None
+        )
         # BTreeSet semantics: sorted, deduplicated, ordered by source text.
         self.conditions: Tuple[Predicate, ...] = tuple(
             sorted(set(_as_predicate(c) for c in conditions), key=lambda p: p.source)
@@ -119,6 +141,8 @@ class Limit:
             self.policy = "fixed_window"
             if len(self._identity) == 4:
                 self._identity = self._identity + ("fixed_window",)
+        if "priority" not in (slots or {}):
+            self.priority = None  # pre-admission-plane pickles
         # The pickled _hash was computed under the saving process's
         # PYTHONHASHSEED; str hashes are per-process, so always recompute —
         # otherwise restored Limits compare == to fresh ones but hash apart
@@ -209,10 +233,13 @@ class Limit:
             d["id"] = self.id
         if self.policy != "fixed_window":
             d["policy"] = self.policy
+        if self.priority is not None:
+            d["priority"] = self.priority
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "Limit":
+        priority = d.get("priority")
         return cls(
             namespace=d["namespace"],
             max_value=int(d.get("max_value", 0)),
@@ -222,4 +249,5 @@ class Limit:
             name=d.get("name"),
             id=d.get("id"),
             policy=d.get("policy", "fixed_window"),
+            priority=str(priority) if priority is not None else None,
         )

@@ -138,6 +138,8 @@ def _load():
         ]
         lib.h2i_stat.restype = ctypes.c_uint64
         lib.h2i_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.h2i_stream_key.restype = ctypes.c_uint64
+        lib.h2i_stream_key.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.h2i_close.argtypes = [ctypes.c_void_p]
         lib.h2i_hpack_decoder_new.restype = ctypes.c_void_p
         lib.h2i_hpack_decoder_free.argtypes = [ctypes.c_void_p]
@@ -252,17 +254,17 @@ class NativeIngress:
         # with status -1 closes the stream cleanly.
         self.stream_path = stream_path
         # Serializes stream-path answer COMPLETION (not just coroutine
-        # starts): a message handler that awaits mid-body must answer
-        # before a later message's answer or the eos close — once the
-        # close answers, write_stream_msg drops the stream and any late
-        # response silently. One lock for all streams is fine: the
-        # stream surface is cold-path (reflection), and global completion
-        # order implies per-stream order.
-        self._stream_serial = None
-        if stream_path is not None and loop is not None:
-            import asyncio
-
-            self._stream_serial = asyncio.Lock()
+        # starts) PER STREAM: a message handler that awaits mid-body
+        # must answer before a later message's answer or the eos close
+        # of ITS stream — once the close answers, write_stream_msg
+        # drops the stream and any late response silently. Keyed by the
+        # C++ layer's (conn, stream) key so a slow handler on one
+        # stream cannot stall concurrent streams' answers (ADVICE r5:
+        # the old single global lock serialized all of them). Entries
+        # are created on the pump thread and removed when the eos close
+        # answers; abrupt teardowns (RST / connection drop — no eos
+        # event) are pruned past a size threshold in _stream_lock.
+        self._stream_locks: dict = {}
         self.max_batch = max_batch
         self.poll_ms = poll_ms
         self._ctx = ctypes.c_void_p(
@@ -478,21 +480,53 @@ class NativeIngress:
             return
         cfut.add_done_callback(done)
 
+    def _stream_lock(self, rid: int):
+        """(key, per-stream answer lock) for a taken stream item. Runs
+        on the pump thread only (dispatch happens there; close() joins
+        the pump before freeing the context, so _ctx is live).
+
+        key 0 means the stream is already gone (answered / peer reset):
+        hand back a throwaway lock instead of sharing the 0 slot across
+        unrelated dead streams. Streams torn down WITHOUT a half-close
+        (connection drop, RST — no '#eos' event) would leak their
+        entry, so past a size threshold unlocked entries are pruned: an
+        unlocked lock has no handler in flight, so dropping and lazily
+        recreating it cannot reorder that stream's answers."""
+        import asyncio
+
+        key = self._lib.h2i_stream_key(self._ctx, rid)
+        if key == 0:
+            return 0, asyncio.Lock()
+        lock = self._stream_locks.get(key)
+        if lock is None:
+            if len(self._stream_locks) >= 4096:
+                for k in [
+                    k for k, l in self._stream_locks.items()
+                    if not l.locked()
+                ]:
+                    del self._stream_locks[k]
+            lock = asyncio.Lock()
+            self._stream_locks[key] = lock
+        return key, lock
+
     def _dispatch_method(self, rid: int, path: str, blob: bytes) -> bool:
         """Cold-path method routing: a registered handler coroutine runs
         on the server loop. Returns False when no handler is registered
         (the caller batches the UNIMPLEMENTED answers)."""
         if self.stream_path is not None and path == self.stream_path + "#eos":
             # Client half-closed the bidi stream: close it cleanly — via
-            # the loop when one exists, taking the stream-serial lock so
-            # the close ANSWERS behind every still-pending message
-            # handler of the stream (coroutine start order alone does not
-            # bound completion order once a handler awaits).
+            # the loop when one exists, taking the stream's serial lock
+            # so the close ANSWERS behind every still-pending message
+            # handler of that stream (coroutine start order alone does
+            # not bound completion order once a handler awaits).
             if self.loop is not None:
-                serial = self._stream_serial
+                key, serial = self._stream_lock(rid)
 
                 async def _close() -> bytes:
                     async with serial:
+                        # The stream is done: drop its lock entry so the
+                        # map stays bounded by live streams.
+                        self._stream_locks.pop(key, None)
                         return b""
 
                 self._answer_from_loop(rid, _close(), ok_status=-1)
@@ -503,7 +537,7 @@ class NativeIngress:
         if handler is None or self.loop is None:
             return False
         if self.stream_path is not None and path == self.stream_path:
-            serial = self._stream_serial
+            _key, serial = self._stream_lock(rid)
 
             async def _serialized(blob=blob) -> bytes:
                 async with serial:

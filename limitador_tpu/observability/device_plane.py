@@ -142,6 +142,10 @@ class DeviceStatsRecorder:
         self.flush_reasons: Dict[str, int] = dict.fromkeys(FLUSH_REASONS, 0)
         self._lock = threading.Lock()
         self._batch_ids = itertools.count(1)
+        # Admission-plane congestion feed: called with the check
+        # batcher's per-flush queue-wait list (admission/overload.py
+        # AIMD signal). None = detached, zero cost.
+        self.on_queue_waits = None
 
     def next_batch_id(self) -> int:
         return next(self._batch_ids)
@@ -155,6 +159,11 @@ class DeviceStatsRecorder:
     ) -> None:
         with self._lock:
             self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
+        if batcher == "check" and self.on_queue_waits is not None:
+            try:
+                self.on_queue_waits(queue_waits)
+            except Exception:
+                pass  # congestion feedback must never fail a flush
         m = self.metrics
         if m is None:
             return
@@ -281,10 +290,11 @@ _QUEUE_NAMES = {
 }
 
 #: attributes worth descending into when walking a limiter for
-#: device-plane state (facade -> storage -> batchers -> device table).
+#: device-plane state (facade -> storage -> batchers -> device table;
+#: "admission" reaches the admission controller hung off the storage).
 _CHILD_ATTRS = (
     "storage", "counters", "batcher", "update_batcher", "inner", "_tpu",
-    "limiter",
+    "limiter", "admission",
 )
 
 
@@ -299,8 +309,9 @@ def collect_debug_stats(*sources) -> dict:
     queues: List[dict] = []
     shards: Dict[str, dict] = {}
     recorders: Dict[int, DeviceStatsRecorder] = {}
+    admission: Dict[int, dict] = {}
     for source in sources:
-        _walk(source, seen, queues, shards, recorders)
+        _walk(source, seen, queues, shards, recorders, admission)
     flush_reasons: Dict[str, int] = {}
     flights: List[dict] = []
     for recorder in recorders.values():
@@ -308,18 +319,28 @@ def collect_debug_stats(*sources) -> dict:
             flush_reasons[reason] = flush_reasons.get(reason, 0) + count
         flights.extend(recorder.flight.snapshot())
     flights.sort(key=lambda e: -e.get("duration_ms", 0.0))
-    return {
+    out = {
         "queues": queues,
         "shards": list(shards.values()),
         "flush_reasons": flush_reasons,
         "flight_recorder": flights,
     }
+    if admission:
+        # One controller per process in practice; surface the first.
+        out["admission"] = next(iter(admission.values()))
+    return out
 
 
-def _walk(source, seen, queues, shards, recorders) -> None:
+def _walk(source, seen, queues, shards, recorders, admission=None) -> None:
     if source is None or id(source) in seen:
         return
     seen.add(id(source))
+    debug = getattr(source, "admission_debug", None)
+    if callable(debug) and admission is not None:
+        try:
+            admission[id(source)] = debug()
+        except Exception:
+            pass
     for attr in ("recorder", "_recorder"):
         recorder = getattr(source, attr, None)
         if isinstance(recorder, DeviceStatsRecorder):
@@ -349,4 +370,4 @@ def _walk(source, seen, queues, shards, recorders) -> None:
         if child is not None and not isinstance(
             child, (int, float, str, bytes, bool, dict, list, tuple, set)
         ):
-            _walk(child, seen, queues, shards, recorders)
+            _walk(child, seen, queues, shards, recorders, admission)

@@ -247,9 +247,72 @@ class PrometheusMetrics:
             ["shard"],
             registry=self.registry,
         )
+        # -- admission plane (admission/): shed/breaker/failover
+        # visibility. Family names are registered in
+        # admission.METRIC_FAMILIES; tools/lint.py's registry lint
+        # cross-checks that tuple against these declarations.
+        self.admission_inflight = Gauge(
+            "admission_inflight",
+            "Decisions currently holding an admission-plane slot",
+            registry=self.registry,
+        )
+        self.admission_limit = Gauge(
+            "admission_limit",
+            "Current adaptive (AIMD) concurrency limit of the "
+            "admission plane",
+            registry=self.registry,
+        )
+        self.admission_sheds = Counter(
+            "admission_sheds",
+            "Requests shed before batch admission, by reason (deadline "
+            "= request cannot survive the queue-wait estimate, overload "
+            "= adaptive concurrency limit reached) and priority class",
+            ["reason", "priority"],
+            registry=self.registry,
+        )
+        self.admission_breaker_state = Gauge(
+            "admission_breaker_state",
+            "Device-plane circuit breaker state: 0 closed, 1 half-open, "
+            "2 open (failed over to the host oracle)",
+            registry=self.registry,
+        )
+        self.admission_breaker_transitions = Counter(
+            "admission_breaker_transitions",
+            "Device-plane breaker transitions, labeled by the state "
+            "entered",
+            ["state"],
+            registry=self.registry,
+        )
+        self.admission_failover_decisions = Counter(
+            "admission_failover_decisions",
+            "Check-path decisions served by the host failover oracle "
+            "while the device-plane breaker was open",
+            registry=self.registry,
+        )
+        self.admission_failover_seconds = Counter(
+            "admission_failover_seconds",
+            "Cumulative seconds the device-plane breaker has spent "
+            "away from closed (open + half-open)",
+            registry=self.registry,
+        )
+        self.admission_reconciled_deltas = Counter(
+            "admission_reconciled_deltas",
+            "Host-journaled counter deltas replayed into the device "
+            "table on breaker recovery (apply_deltas reconcile)",
+            registry=self.registry,
+        )
         # Pre-seed the bounded label sets so the families render (and
         # dashboards/benches see zeros) before the first flush.
+        from ..admission import SHED_REASONS
+        from ..admission.breaker import BreakerState
+        from ..admission.priority import PRIORITIES
         from .device_plane import BATCHERS, FLUSH_REASONS, PHASES
+
+        for reason in SHED_REASONS:
+            for priority in PRIORITIES:
+                self.admission_sheds.labels(reason, priority)
+        for state in BreakerState.GAUGE:
+            self.admission_breaker_transitions.labels(state)
 
         for batcher in BATCHERS:
             self.batcher_queue_wait.labels(batcher)

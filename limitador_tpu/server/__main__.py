@@ -282,6 +282,69 @@ def build_parser() -> argparse.ArgumentParser:
         help="distributed: replication listen address",
     )
     p.add_argument(
+        "--advertise-address", default=_env("ADVERTISE_ADDRESS"),
+        help="distributed/tpu: address advertised to peers in gossip "
+        "Hello/Membership packets (defaults to --listen-address; set it "
+        "when binding 0.0.0.0 — e.g. the pod's stable DNS name — so "
+        "peers learn a dialable URL)",
+    )
+    # admission plane (admission/controller.py)
+    p.add_argument(
+        "--admission-mode",
+        choices=["off", "monitor", "enforce"],
+        default=_env("ADMISSION_MODE", "off"),
+        help="admission plane: off (default), monitor (breaker/failover "
+        "active, sheds counted but not enforced), enforce (deadline/"
+        "overload sheds enforced); requires a batched tpu storage",
+    )
+    p.add_argument(
+        "--breaker-failures", type=int,
+        default=int(_env("BREAKER_FAILURES", "3")),
+        help="consecutive device-batch failures that open the "
+        "device-plane circuit breaker",
+    )
+    p.add_argument(
+        "--breaker-stall-ms", type=float,
+        default=float(_env("BREAKER_STALL_MS", "2000")),
+        help="an in-flight device batch older than this trips the "
+        "breaker (the hung-device_sync failure mode)",
+    )
+    p.add_argument(
+        "--breaker-reset-ms", type=float,
+        default=float(_env("BREAKER_RESET_MS", "5000")),
+        help="open-state dwell before a half-open device probe",
+    )
+    p.add_argument(
+        "--max-inflight", type=int,
+        default=int(_env("ADMISSION_MAX_INFLIGHT", "4096")),
+        help="hard ceiling of the adaptive (AIMD) concurrency limit",
+    )
+    p.add_argument(
+        "--admission-target-queue-ms", type=float,
+        default=float(_env("ADMISSION_TARGET_QUEUE_MS", "20")),
+        help="queue-wait target the AIMD limit steers toward; also the "
+        "basis of deadline-aware shedding",
+    )
+    p.add_argument(
+        "--shed-response",
+        choices=["unavailable", "overlimit"],
+        default=_env("SHED_RESPONSE", "unavailable"),
+        help="RLS semantics of a shed: unavailable (gRPC UNAVAILABLE / "
+        "HTTP 503, Envoy failure-mode decides) or overlimit "
+        "(OVER_LIMIT / 429)",
+    )
+    p.add_argument(
+        "--priority-key", default=_env("PRIORITY_KEY", "priority"),
+        help="descriptor entry key carrying a request's priority class "
+        "(low|normal|high|critical)",
+    )
+    p.add_argument(
+        "--priority", action="append", default=None,
+        help="namespace priority mapping NS=CLASS (repeatable); limits-"
+        "file `priority:` annotations and the descriptor entry override "
+        "per request",
+    )
+    p.add_argument(
         "--profile-dir",
         default=_env("TPU_PROFILE_DIR", "/tmp/limitador-tpu-profile"),
         help="default directory for on-demand jax.profiler captures "
@@ -359,6 +422,7 @@ def build_limiter(args, on_partitioned=None):
             storage = TpuReplicatedStorage(
                 node_id=args.node_id or "node",
                 listen_address=args.listen_address or "0.0.0.0:5001",
+                advertise_address=args.advertise_address,
                 peers=args.peer or [],
                 capacity=args.tpu_capacity,
                 cache_size=args.cache_size,
@@ -490,6 +554,7 @@ def build_limiter(args, on_partitioned=None):
             CrInMemoryStorage(
                 node_id=args.node_id or "node",
                 listen_address=args.listen_address or "0.0.0.0:5001",
+                advertise_address=args.advertise_address,
                 peers=args.peer or [],
             )
         )
@@ -579,6 +644,57 @@ async def _amain(args) -> int:
         if hasattr(target, "set_metrics"):
             target.set_metrics(metrics)
             break
+    # Admission plane: overload control, priority shedding, device-plane
+    # breaker + host failover (admission/). Only the batched TPU
+    # storages expose set_admission — the host backends have no device
+    # plane to fail over from.
+    admission = None
+    if args.admission_mode != "off":
+        if not hasattr(counters_storage, "set_admission"):
+            log.warning(
+                f"--admission-mode {args.admission_mode} requires a "
+                f"batched tpu storage (got {args.storage!r}); admission "
+                "plane disabled")
+        else:
+            from ..admission import (
+                AdaptiveLimiter,
+                AdmissionController,
+                CircuitBreaker,
+                PriorityResolver,
+            )
+
+            admission = AdmissionController(
+                mode=args.admission_mode,
+                metrics=metrics,
+                breaker=CircuitBreaker(
+                    failure_threshold=args.breaker_failures,
+                    stall_timeout=args.breaker_stall_ms / 1000.0,
+                    reset_timeout=args.breaker_reset_ms / 1000.0,
+                ),
+                overload=AdaptiveLimiter(
+                    max_inflight=args.max_inflight,
+                    target_queue_wait=(
+                        args.admission_target_queue_ms / 1000.0
+                    ),
+                ),
+                priorities=PriorityResolver(
+                    descriptor_key=args.priority_key,
+                    namespace_map=PriorityResolver.parse_namespace_map(
+                        args.priority or ()
+                    ),
+                ),
+                shed_response=args.shed_response,
+            )
+            counters_storage.set_admission(admission)
+            if hasattr(limiter, "fail_over_queued"):
+                admission.add_drainable(limiter)
+            admission.start(asyncio.get_running_loop())
+            log.info(
+                f"admission plane: mode={args.admission_mode}, "
+                f"max-inflight={args.max_inflight}, breaker "
+                f"stall={args.breaker_stall_ms:.0f}ms/"
+                f"reset={args.breaker_reset_ms:.0f}ms, "
+                f"shed-response={args.shed_response}")
     # gRPC server reflection is always on, from the vendored SDK-free
     # implementation (server/reflection.py) — the reference serves it
     # unconditionally too (envoy_rls/server.rs:232-263). The historical
@@ -596,6 +712,9 @@ async def _amain(args) -> int:
             limiter.configure_with(limits)
         for pipeline in pipelines_to_invalidate:
             pipeline.invalidate()
+        if admission is not None:
+            # Re-derive namespace priorities from `priority:` annotations.
+            admission.priorities.refresh(limits)
 
     watcher = None
     if args.limits_file:
@@ -647,6 +766,8 @@ async def _amain(args) -> int:
                 limiter, metrics, max_delay=args.batch_delay_us / 1e6
             )
             pipelines_to_invalidate.append(native_pipeline)
+            if admission is not None:
+                admission.add_drainable(native_pipeline)
         else:
             log.warning(
                 f"native hostpath unavailable "
@@ -730,6 +851,7 @@ async def _amain(args) -> int:
         metrics,
         args.rate_limit_headers,
         native_pipeline=native_pipeline,
+        admission=admission,
     )
     from ..observability.device_plane import JaxProfiler
 
@@ -740,6 +862,7 @@ async def _amain(args) -> int:
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,
         profiler=JaxProfiler(args.profile_dir),
+        admission=admission,
     )
     log.info(
         f"limitador-tpu: RLS gRPC on {args.rls_host}:{rls_grpc_port}"
@@ -816,6 +939,8 @@ async def _amain(args) -> int:
         native_ingress.close()
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
+    if admission is not None:
+        await admission.close()
     if native_pipeline is not None:
         await native_pipeline.close()
     if hasattr(limiter, "close"):

@@ -297,9 +297,13 @@ class _Api:
         status,
         debug_sources=None,
         profiler: Optional[JaxProfiler] = None,
+        admission=None,
     ):
         self.limiter = limiter
         self.metrics = metrics
+        # Admission controller: overload/priority shedding on the HTTP
+        # decision path (None = pre-admission-plane behavior).
+        self.admission = admission
         self.status = status or {}
         # Objects walked for /debug/stats device-plane state; the limiter
         # is always included (it reaches the batchers + device tables).
@@ -458,6 +462,25 @@ class _Api:
         except (KeyError, ValueError, TypeError) as exc:
             return web.json_response({"error": f"bad request: {exc}"}, status=400)
         want_headers = response_headers == RATE_LIMIT_HEADERS_DRAFT03
+        ticket = None
+        if self.admission is not None:
+            from ..admission.controller import AdmissionShed
+
+            try:
+                # The HTTP surface carries no deadline; overload and
+                # priority shedding still apply (429 for the over-limit
+                # semantics, 503 for unavailable — the reference's
+                # storage-error status on this path is 500, but a shed
+                # is an explicit backpressure signal, not a failure).
+                ticket = self.admission.admit(
+                    namespace, data.get("values") or {}
+                )
+            except AdmissionShed as shed:
+                if shed.overlimit:
+                    return web.Response(status=429)
+                return web.json_response(
+                    {"error": str(shed)}, status=503
+                )
         try:
             result = await self._call(
                 lambda: self.limiter.check_rate_limited_and_update(
@@ -467,6 +490,9 @@ class _Api:
             )
         except StorageError as exc:
             return web.json_response({"error": str(exc)}, status=500)
+        finally:
+            if ticket is not None:
+                ticket.release()
         headers = result.response_header() if want_headers else {}
         if self.metrics:
             extra = self.metrics.custom_labels(ctx)
@@ -488,10 +514,11 @@ def make_http_app(
     status: Optional[dict] = None,
     debug_sources=None,
     profiler: Optional[JaxProfiler] = None,
+    admission=None,
 ) -> web.Application:
     from .middleware import http_request_id_middleware
 
-    api = _Api(limiter, metrics, status, debug_sources, profiler)
+    api = _Api(limiter, metrics, status, debug_sources, profiler, admission)
     app = web.Application(middlewares=[http_request_id_middleware])
     app.router.add_get("/status", api.get_status)
     app.router.add_get("/api/spec", api.get_spec)
@@ -515,9 +542,12 @@ async def run_http_server(
     status: Optional[dict] = None,
     debug_sources=None,
     profiler: Optional[JaxProfiler] = None,
+    admission=None,
 ) -> web.AppRunner:
     """Start the HTTP server (returns the runner; caller owns shutdown)."""
-    app = make_http_app(limiter, metrics, status, debug_sources, profiler)
+    app = make_http_app(
+        limiter, metrics, status, debug_sources, profiler, admission
+    )
     runner = web.AppRunner(app)
     await runner.setup()
     site = web.TCPSite(runner, host, port)

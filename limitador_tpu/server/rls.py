@@ -81,9 +81,14 @@ class RlsService:
         limiter,
         metrics: Optional[PrometheusMetrics] = None,
         rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
+        admission=None,
     ):
         self.limiter = limiter
         self.metrics = metrics
+        # Admission controller (admission/controller.py): deadline/
+        # overload shedding before a request occupies a batch slot.
+        # None = pre-admission-plane behavior.
+        self.admission = admission
         self.rate_limit_headers = rate_limit_headers
         self._is_async = isinstance(limiter, AsyncRateLimiter)
         # Batched storages time their own device round trips (the busy-time
@@ -135,6 +140,33 @@ class RlsService:
             else:
                 self.limiter.update_counters(namespace, ctx, delta)
 
+    async def _admit(self, request, context, namespace):
+        """Admission-plane gate before the storage decision. Returns a
+        ticket (or None) to release when the decision resolves, or a
+        ready RateLimitResponse when the request was shed with
+        OVER_LIMIT semantics; UNAVAILABLE sheds abort the RPC (Envoy's
+        failure_mode_deny then decides fail-open/closed, exactly like a
+        storage error)."""
+        from ..admission.controller import AdmissionShed
+
+        values = None
+        if request.descriptors:
+            values = {
+                e.key: e.value for e in request.descriptors[0].entries
+            }
+        time_remaining = getattr(context, "time_remaining", None)
+        deadline = time_remaining() if callable(time_remaining) else None
+        try:
+            return self.admission.admit(namespace, values, deadline)
+        except AdmissionShed as shed:
+            if shed.overlimit:
+                return rls_pb2.RateLimitResponse(
+                    overall_code=rls_pb2.RateLimitResponse.OVER_LIMIT
+                )
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {shed}"
+            )
+
     # -- Envoy ShouldRateLimit (THE hot path) -----------------------------
 
     async def should_rate_limit(self, request, context):
@@ -146,22 +178,35 @@ class RlsService:
         ctx = _context_from_request(request)
         hits_addend = _hits_addend(request)
         with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
+        ticket = None
+        if self.admission is not None:
+            shed = await self._admit(request, context, namespace)
+            if isinstance(shed, rls_pb2.RateLimitResponse):
+                return shed
+            ticket = shed
         # W3C trace-context from gRPC metadata parents the span
         # (envoy_rls/server.rs:100-104); only materialized when an
         # exporter is actually installed.
-        carrier = None
-        if tracing_enabled():
-            carrier = dict(context.invocation_metadata() or ())
-        with should_rate_limit_span(namespace, hits_addend, carrier) as record:
-            try:
-                result = await self._check_and_update(
-                    namespace, ctx, hits_addend, with_headers
-                )
-            except StorageError as exc:
-                await context.abort(
-                    grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
-                )
-            record(result.limited, result.limit_name)
+        try:
+            carrier = None
+            if tracing_enabled():
+                carrier = dict(context.invocation_metadata() or ())
+            with should_rate_limit_span(
+                namespace, hits_addend, carrier
+            ) as record:
+                try:
+                    result = await self._check_and_update(
+                        namespace, ctx, hits_addend, with_headers
+                    )
+                except StorageError as exc:
+                    await context.abort(
+                        grpc.StatusCode.UNAVAILABLE,
+                        f"Service unavailable: {exc}",
+                    )
+                record(result.limited, result.limit_name)
+        finally:
+            if ticket is not None:
+                ticket.release()
         if self.metrics:
             # evaluate the custom label map once per request
             extra = self.metrics.custom_labels(ctx)
@@ -266,12 +311,33 @@ def make_rls_handlers(service: RlsService):
     return [envoy, kuadrant]
 
 
-def make_native_should_rate_limit_handler(native_pipeline):
+def make_native_should_rate_limit_handler(native_pipeline, admission=None):
     """ShouldRateLimit over RAW request bytes: identity (de)serializers keep
     Python protobuf off the hot path entirely — the native pipeline parses
-    the wire bytes in C++ and answers with prebuilt response blobs."""
+    the wire bytes in C++ and answers with prebuilt response blobs.
+
+    With an admission controller, deadline/overload shedding happens
+    before the blob enters the pipeline — priority resolves without
+    parsing (the default class), since descriptor entries only
+    materialize in C++ past this point."""
+    from ..admission.controller import AdmissionShed
 
     async def handler(blob: bytes, context) -> bytes:
+        ticket = None
+        if admission is not None:
+            time_remaining = getattr(context, "time_remaining", None)
+            deadline = (
+                time_remaining() if callable(time_remaining) else None
+            )
+            try:
+                ticket = admission.admit(None, None, deadline)
+            except AdmissionShed as shed:
+                if shed.overlimit:
+                    return native_pipeline.OVER_BLOB
+                await context.abort(
+                    grpc.StatusCode.UNAVAILABLE,
+                    f"Service unavailable: {shed}",
+                )
         try:
             # MetricsLayer aggregate for the native path: the one storage
             # wait (parse -> device -> response blob) is the request's
@@ -285,6 +351,9 @@ def make_native_should_rate_limit_handler(native_pipeline):
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {exc}"
             )
+        finally:
+            if ticket is not None:
+                ticket.release()
 
     return grpc.method_handlers_generic_handler(
         _ENVOY_SERVICE,
@@ -344,6 +413,7 @@ async def serve_rls(
     metrics: Optional[PrometheusMetrics] = None,
     rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
     native_pipeline=None,
+    admission=None,
 ) -> grpc.aio.Server:
     """Start the gRPC server (returns it started; caller owns shutdown).
 
@@ -359,10 +429,12 @@ async def serve_rls(
     from .reflection import make_reflection_handler
 
     server = grpc.aio.server(interceptors=(GrpcRequestIdInterceptor(),))
-    service = RlsService(limiter, metrics, rate_limit_headers)
+    service = RlsService(limiter, metrics, rate_limit_headers, admission)
     envoy_handler, kuadrant_handler = make_rls_handlers(service)
     if native_pipeline is not None and rate_limit_headers == RATE_LIMIT_HEADERS_NONE:
-        envoy_handler = make_native_should_rate_limit_handler(native_pipeline)
+        envoy_handler = make_native_should_rate_limit_handler(
+            native_pipeline, admission
+        )
     server.add_generic_rpc_handlers((envoy_handler,))
     server.add_generic_rpc_handlers((kuadrant_handler,))
     server.add_generic_rpc_handlers(

@@ -44,6 +44,7 @@ class CrInMemoryStorage(CounterStorage):
         listen_address: Optional[str] = None,
         peers: Optional[List[str]] = None,
         clock=time.time,
+        advertise_address: Optional[str] = None,
     ):
         self._lock = threading.RLock()
         self._clock = clock
@@ -59,6 +60,7 @@ class CrInMemoryStorage(CounterStorage):
                 peer_urls=peers or [],
                 on_update=self._on_remote_update,
                 snapshot_provider=self._snapshot,
+                advertise_address=advertise_address,
             )
             self.broker.start()
 

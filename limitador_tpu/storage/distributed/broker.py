@@ -109,9 +109,17 @@ class Broker:
         peer_urls: Iterable[str],
         on_update: OnUpdate,
         snapshot_provider: SnapshotProvider,
+        advertise_address: Optional[str] = None,
     ):
         self.peer_id = peer_id
         self.listen_address = listen_address
+        # What Hello packets advertise as this node's dialable URL.
+        # Defaults to the bind address, but a node bound to 0.0.0.0
+        # must advertise something peers can actually dial (the pod's
+        # stable DNS name in kubernetes) — otherwise every peer learns
+        # a self-connecting 0.0.0.0 URL from gossip and the mesh only
+        # heals through the static --peer redial loop.
+        self.advertise_address = advertise_address or listen_address
         self.peer_urls: List[str] = list(peer_urls)
         self.on_update = on_update
         self.snapshot_provider = snapshot_provider
@@ -171,8 +179,13 @@ class Broker:
 
     def _spawn_dialer(self, url: str) -> None:
         """One tracked dial loop per url (gossip-learned ones included, so
-        shutdown cancels them and a peer's multiple urls don't race)."""
-        if url not in self._dialers and url != self.listen_address:
+        shutdown cancels them and a peer's multiple urls don't race).
+        Never dial ourselves — under either the bind or advertised name."""
+        if (
+            url not in self._dialers
+            and url != self.listen_address
+            and url != self.advertise_address
+        ):
             self._dialers[url] = asyncio.ensure_future(self._dial_loop(url))
 
     def stop(self) -> None:
@@ -413,7 +426,7 @@ class Broker:
                     pb.Packet(
                         hello=pb.Hello(
                             sender_peer_id=self.peer_id,
-                            sender_urls=[self.listen_address],
+                            sender_urls=[self.advertise_address],
                             receiver_url=url,
                         )
                     )

@@ -1,0 +1,106 @@
+"""Host-plane failover store for the admission plane's circuit breaker.
+
+While the device-plane breaker is OPEN, the check path decides against
+this store instead of the TPU table: an exact ``InMemoryStorage``
+oracle (the parity reference every backend is tested against) plus a
+delta journal. On recovery, ``reconcile_into`` replays the journaled
+deltas into the device table through the ``apply_deltas`` contract the
+write-behind topology already uses — zero deltas are lost across a
+failover window.
+
+Documented accuracy contract (mirrors the reference's partitioned
+write-behind behavior, counters_cache.rs): the oracle starts EMPTY at
+trip time — the device's live counts are unreadable precisely because
+the plane is dead — so each window's budget is enforced against
+failover-local counts only. Across one trip boundary a window may
+admit up to one extra budget; it never under-admits, and the journal
+keeps the device table's totals exact once reconciled.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from ..core.counter import Counter
+from .base import Authorization
+from .in_memory import InMemoryStorage
+
+__all__ = ["FailoverStore"]
+
+
+class FailoverStore:
+    def __init__(self, cache_size: int = 100_000, clock=time.time):
+        self._oracle = InMemoryStorage(cache_size, clock=clock)
+        self._lock = threading.Lock()
+        # counter identity -> accumulated delta while failed over
+        self._journal: Dict[Counter, int] = {}
+        self.decisions = 0          # checks served host-side (cumulative)
+        self.reconciled_deltas = 0  # deltas replayed to device (cumulative)
+
+    # -- the failed-over check path ------------------------------------------
+
+    def check_and_update(
+        self, counters: List[Counter], delta: int, load_counters: bool
+    ) -> Authorization:
+        auth = self._oracle.check_and_update(counters, delta, load_counters)
+        with self._lock:
+            self.decisions += 1
+            if not auth.limited and delta:
+                for counter in counters:
+                    key = counter.key()
+                    self._journal[key] = self._journal.get(key, 0) + int(delta)
+        return auth
+
+    def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        with self._lock:
+            self.decisions += 1
+        return self._oracle.is_within_limits(counter, delta)
+
+    def update_counter(self, counter: Counter, delta: int) -> None:
+        self._oracle.update_counter(counter, delta)
+        if delta:
+            with self._lock:
+                key = counter.key()
+                self._journal[key] = self._journal.get(key, 0) + int(delta)
+
+    # -- recovery ------------------------------------------------------------
+
+    def journal_size(self) -> int:
+        with self._lock:
+            return len(self._journal)
+
+    def drain(self) -> List[Tuple[Counter, int]]:
+        """Take (and clear) the journaled deltas. Decisions taken after
+        the drain land in a fresh journal (the breaker may re-open)."""
+        with self._lock:
+            items = list(self._journal.items())
+            self._journal.clear()
+        return items
+
+    def reconcile_into(self, storage) -> int:
+        """Replay the journal into ``storage`` (the device table) via its
+        ``apply_deltas`` contract; returns the number of counter deltas
+        applied. On failure the journal is RESTORED — a half-applied
+        reconcile must not lose the unapplied tail (apply_deltas is
+        all-or-nothing under the storage lock)."""
+        items = self.drain()
+        if not items:
+            self._oracle.clear()
+            return 0
+        try:
+            storage.apply_deltas(items)
+        except BaseException:
+            with self._lock:
+                for counter, delta in items:
+                    self._journal[counter] = (
+                        self._journal.get(counter, 0) + delta
+                    )
+            raise
+        with self._lock:
+            self.reconciled_deltas += len(items)
+        # The oracle's window state is now folded into the device table;
+        # keeping it would double-count on the next failover window.
+        self._oracle.clear()
+        return len(items)

@@ -14,7 +14,13 @@ the gate is implemented from scratch on ``ast``:
 * mutable default arguments (list/dict/set literals),
 * comparisons to ``True``/``False``/``None`` with ``==``/``!=``,
 * duplicate literal keys in dict displays,
-* tabs in indentation and trailing whitespace.
+* tabs in indentation and trailing whitespace,
+* the metric-registry cross-check: every family a subsystem registers
+  in a module-level ``METRIC_FAMILIES`` tuple (e.g.
+  ``limitador_tpu/admission/__init__.py``) must be declared in
+  ``observability/metrics.py``, and every declared ``admission_*``
+  family must appear in the admission registry — a typo'd or orphaned
+  family fails the gate instead of silently never rendering.
 
 ``# noqa`` anywhere on the offending line suppresses that finding.
 Run: ``python -m limitador_tpu.tools.lint [paths...]`` (defaults to the
@@ -29,10 +35,94 @@ import sys
 from pathlib import Path
 from typing import List, Tuple
 
-__all__ = ["lint_file", "lint_paths", "main"]
+__all__ = ["lint_file", "lint_paths", "lint_metric_registry", "main"]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
                    "__graft_entry__.py")
+
+#: metric prefixes whose declarations must be covered by a subsystem
+#: METRIC_FAMILIES registry (prefix -> registry module, repo-relative)
+REGISTRY_OWNED_PREFIXES = {
+    "admission_": "limitador_tpu/admission/__init__.py",
+}
+
+
+def declared_metric_families(metrics_path: Path):
+    """Family names declared in observability/metrics.py: the first
+    string-literal argument of every Counter/Gauge/Histogram call."""
+    tree = ast.parse(metrics_path.read_text(), filename=str(metrics_path))
+    names = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = (
+            fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if fname in ("Counter", "Gauge", "Histogram") and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                names.add(first.value)
+    return names
+
+
+def registered_metric_families(package_root: Path):
+    """(path, lineno, name) for every entry of a module-level
+    ``METRIC_FAMILIES`` tuple/list under the package."""
+    out = []
+    for path in sorted(package_root.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError:
+            continue  # reported by lint_file
+        for node in tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                continue
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.append((path, elt.lineno, elt.value))
+    return out
+
+
+def lint_metric_registry(repo_root: Path) -> List[str]:
+    """Cross-check subsystem METRIC_FAMILIES registries against the
+    PrometheusMetrics declarations (both directions for the prefixes in
+    REGISTRY_OWNED_PREFIXES)."""
+    metrics_path = repo_root / "limitador_tpu" / "observability" / "metrics.py"
+    package_root = repo_root / "limitador_tpu"
+    if not metrics_path.exists():
+        return []
+    declared = declared_metric_families(metrics_path)
+    registered = registered_metric_families(package_root)
+    findings = []
+    for path, lineno, name in registered:
+        if name not in declared:
+            findings.append(
+                f"{path}:{lineno}: metric family '{name}' is registered "
+                "but not declared in observability/metrics.py"
+            )
+    registered_names = {name for _p, _l, name in registered}
+    for prefix, registry in sorted(REGISTRY_OWNED_PREFIXES.items()):
+        for name in sorted(declared):
+            if name.startswith(prefix) and name not in registered_names:
+                findings.append(
+                    f"{metrics_path}:0: metric family '{name}' is "
+                    f"declared but missing from {registry}'s "
+                    "METRIC_FAMILIES registry"
+                )
+    return findings
 
 
 def _imported_bindings(tree: ast.AST):
@@ -219,6 +309,8 @@ def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     targets = argv or list(DEFAULT_TARGETS)
     findings = lint_paths(targets)
+    repo_root = Path(__file__).resolve().parent.parent.parent
+    findings.extend(lint_metric_registry(repo_root))
     for finding in findings:
         print(finding)
     if findings:

@@ -167,6 +167,50 @@ def values_for_replay(counter: Counter) -> Optional[Dict[str, str]]:
     return values
 
 
+def unreplayable_reason(
+    counter: Counter, namespace_limits
+) -> Tuple[Optional[str], int]:
+    """Classify one (counter, limits) pair for replay through /report.
+
+    The server re-selects limits by evaluating conditions against a
+    context built ONLY from the counter's variable bindings — so a
+    limit whose conditions reference descriptor fields absent from
+    those bindings (e.g. ``descriptors[0].method == 'GET'`` on a
+    counter keyed only by user) never matches during replay: its count
+    would be silently dropped while OTHER limits in the namespace that
+    happen to match the synthesized values got spuriously credited
+    (ADVICE r5 medium finding). Simulate the server's selection here
+    and refuse to send entries it would mis-credit.
+
+    Returns ``(reason, extra_limits)``: reason is None (replayable),
+    ``"shape"`` (a variable expression has no HTTP form) or
+    ``"conditions"`` (the owning limit would not re-select, or would
+    bind different variables); extra_limits counts OTHER limits the
+    replayed report would also credit (a multi-credit warning, not a
+    refusal — those limits would see this traffic in production too).
+    """
+    from ..core.cel import Context
+
+    values = values_for_replay(counter)
+    if values is None:
+        return "shape", 0
+    ctx = Context()
+    ctx.list_binding("descriptors", [dict(values)])
+    limit = counter.limit
+    if not limit.applies(ctx):
+        return "conditions", 0
+    resolved = limit.resolve_variables(ctx)
+    if resolved != dict(counter.set_variables):
+        return "conditions", 0
+    extra = 0
+    for other in namespace_limits:
+        if other == limit:
+            continue
+        if other.applies(ctx) and other.resolve_variables(ctx) is not None:
+            extra += 1
+    return None, extra
+
+
 def dump_line(counter: Counter, value: int, pttl_ms: int = 1) -> str:
     """One dump-format line for (counter, value) — used to write the
     resumable remainder file."""
@@ -180,20 +224,63 @@ def replay(
     pairs: List[Tuple[Counter, int]],
     target: str,
     opener=None,
+    limits=None,
+    stats: Optional[Dict[str, int]] = None,
 ) -> Tuple[int, int, List[Tuple[Counter, int]], Optional[str]]:
     """POST each (counter, value) as a /report to the live server —
     counts land through the normal write path on any storage/topology.
+
+    With ``limits`` (the fleet's configured limits), each entry is
+    pre-flighted through :func:`unreplayable_reason`: entries whose
+    owning limit would not be re-selected from the synthesized values
+    (conditions over non-variable descriptor fields) are classified
+    unreplayable — counted, warned about, NOT sent — instead of being
+    silently dropped server-side while crediting the wrong limits.
+    ``stats`` (optional dict) receives the breakdown: ``shape``,
+    ``conditions``, ``multi_credit``.
 
     /report is a delta-add (NOT idempotent), so on the first send
     failure this STOPS and returns the unsent remainder instead of
     risking double-counts on a blind retry. Returns
     (sent, unreplayable, remaining_pairs, error)."""
     opener = opener or urllib.request.urlopen
+    if stats is None:
+        stats = {}
+    stats.setdefault("shape", 0)
+    stats.setdefault("conditions", 0)
+    stats.setdefault("multi_credit", 0)
+    by_ns: Dict[str, list] = {}
+    for limit in limits or ():
+        by_ns.setdefault(str(limit.namespace), []).append(limit)
     sent = unreplayable = 0
     for i, (counter, value) in enumerate(pairs):
+        if limits is not None:
+            reason, extra = unreplayable_reason(
+                counter, by_ns.get(str(counter.namespace), ())
+            )
+            if reason is not None:
+                unreplayable += 1
+                stats[reason] += 1
+                print(
+                    f"unreplayable ({reason}): {counter.namespace} "
+                    f"{dict(counter.set_variables)} +{value} — a /report "
+                    "from these variable bindings would not re-select "
+                    "this counter's limit",
+                    file=sys.stderr,
+                )
+                continue
+            if extra:
+                stats["multi_credit"] += 1
+                print(
+                    f"warning: replaying {counter.namespace} "
+                    f"{dict(counter.set_variables)} also credits "
+                    f"{extra} other limit(s) in the namespace",
+                    file=sys.stderr,
+                )
         values = values_for_replay(counter)
         if values is None:
             unreplayable += 1
+            stats["shape"] += 1
             continue
         body = json.dumps({
             "namespace": str(counter.namespace),
@@ -249,13 +336,21 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{counter.namespace} {dict(counter.set_variables)} "
                   f"+{value}")
         return 0
-    sent, unreplayable, remaining, error = replay(pairs, args.target)
+    stats: Dict[str, int] = {}
+    sent, unreplayable, remaining, error = replay(
+        pairs, args.target, limits=limits, stats=stats
+    )
     print(
         f"replayed {sent} counters into {args.target}"
         + (
-            f" ({unreplayable} counters use variable forms with no "
-            "HTTP representation and were NOT sent)"
-            if unreplayable
+            f" ({unreplayable} unreplayable NOT sent: "
+            f"{stats.get('shape', 0)} with no HTTP variable form, "
+            f"{stats.get('conditions', 0)} whose limit conditions "
+            "reference descriptor fields absent from the counter's "
+            "bindings; "
+            f"{stats.get('multi_credit', 0)} sent with a multi-credit "
+            "warning)"
+            if unreplayable or stats.get("multi_credit")
             else ""
         ),
         file=sys.stderr,

@@ -50,6 +50,7 @@ from ..observability.tracing import device_batch_span
 from ..storage.base import (
     AsyncCounterStorage,
     Authorization,
+    StorageError,
     require_nonnegative_delta,
 )
 from .storage import TpuStorage, _Request
@@ -120,6 +121,15 @@ class MicroBatcher:
         # gated on this single check, so a detached batcher pays nothing
         # per decision (the tracing.py _enabled discipline).
         self.recorder = None
+        # Admission controller (admission/controller.py). None = no
+        # breaker feed, no failover drain — same zero-cost-when-detached
+        # discipline as the recorder.
+        self.admission = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Dispatched-but-unfinished batches, so a breaker trip can fail
+        # their futures instead of leaving them parked on a dead plane.
+        self._inflight_batches: Dict[int, list] = {}
+        self._batch_seq = 0
 
     def _observe_batch(self, n_requests: int, dt: float) -> None:
         if self.metrics is not None:
@@ -131,7 +141,36 @@ class MicroBatcher:
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
             self._wakeup = asyncio.Event()
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._loop = asyncio.get_running_loop()
+            self._task = self._loop.create_task(self._run())
+
+    def fail_over_queued(self, decider, exc) -> None:
+        """Admission-plane breaker trip: every QUEUED request gets an
+        immediate host-side decision through ``decider(counters, delta,
+        load) -> Authorization``; dispatched-but-unfinished batches fail
+        with ``exc`` (transient — their kernel may already have run, so
+        re-deciding them host-side would double-count). Thread-safe:
+        the trip listener can fire from a collect thread."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def _drain():
+            pending, self._pending = self._pending, []
+            self._pending_hits = 0
+            for request, future, _t, _rid in pending:
+                if future.done():
+                    continue
+                try:
+                    future.set_result(
+                        decider(request.ordered, request.delta, request.load)
+                    )
+                except Exception as dexc:
+                    future.set_exception(dexc)
+            for batch in list(self._inflight_batches.values()):
+                self._fail(batch, exc)
+
+        loop.call_soon_threadsafe(_drain)
 
     async def submit(
         self, counters: List[Counter], delta: int, load: bool
@@ -172,8 +211,9 @@ class MicroBatcher:
 
     async def _finish_inflight(
         self, batch, handle, finish, sem, loop, t0, t_flush, batch_id,
-        phases,
+        phases, seq, token,
     ):
+        adm = self.admission
         try:
             with device_batch_span(batch_id, len(batch)) as span_phases:
                 auths, t_fin, t_done = await loop.run_in_executor(
@@ -187,9 +227,14 @@ class MicroBatcher:
                 rec = self.recorder
                 if rec is not None:
                     self._record_batch(rec, batch, batch_id, t_flush, phases)
+            if adm is not None:
+                adm.breaker.batch_finished(token)
         except Exception as exc:
             self._fail(batch, exc)
+            if adm is not None:
+                adm.breaker.batch_finished(token, exc)
         finally:
+            self._inflight_batches.pop(seq, None)
             sem.release()
 
     async def _run(self) -> None:
@@ -211,6 +256,19 @@ class MicroBatcher:
             if self._pending_hits < self.max_batch_hits:
                 # Linger briefly to let concurrent requests coalesce.
                 await asyncio.sleep(self.max_delay)
+            if pipelined:
+                # Acquire the inflight slot BEFORE taking the batch:
+                # under device backpressure requests keep coalescing in
+                # _pending — where an admission-plane failover can still
+                # drain them — instead of riding in a local batch
+                # nothing can reach while this coroutine waits.
+                await sem.acquire()
+            # A failover drain may have emptied the queue during the
+            # linger / slot wait: nothing to flush.
+            if not self._pending:
+                if pipelined:
+                    sem.release()
+                continue
             # The linger may have filled the batch past the size trigger:
             # classify by what actually releases the flush.
             reason = (
@@ -235,8 +293,12 @@ class MicroBatcher:
                     reason, flush_hits / self.max_batch_hits,
                     [t_flush - t for _r, _f, t, _rid in batch],
                 )
+            adm = self.admission
+            self._batch_seq += 1
+            seq = self._batch_seq
+            self._inflight_batches[seq] = batch
+            token = adm.breaker.batch_started() if adm is not None else 0
             if pipelined:
-                await sem.acquire()
                 t0 = time.perf_counter()
                 try:
                     handle, t_begin, t_launch = await loop.run_in_executor(
@@ -244,7 +306,10 @@ class MicroBatcher:
                     )
                 except Exception as exc:
                     sem.release()
+                    self._inflight_batches.pop(seq, None)
                     self._fail(batch, exc)
+                    if adm is not None:
+                        adm.breaker.batch_finished(token, exc)
                     continue
                 phases = {
                     "dispatch": t_begin - t0,
@@ -253,7 +318,7 @@ class MicroBatcher:
                 t = loop.create_task(
                     self._finish_inflight(
                         batch, handle, finish, sem, loop, t0, t_flush,
-                        batch_id, phases,
+                        batch_id, phases, seq, token,
                     )
                 )
                 self._finishers.add(t)
@@ -285,8 +350,14 @@ class MicroBatcher:
                             self._record_batch(
                                 rec, batch, batch_id, t_flush, phases
                             )
+                    if adm is not None:
+                        adm.breaker.batch_finished(token)
                 except Exception as exc:
                     self._fail(batch, exc)
+                    if adm is not None:
+                        adm.breaker.batch_finished(token, exc)
+                finally:
+                    self._inflight_batches.pop(seq, None)
 
     async def close(self) -> None:
         self._closed = True
@@ -350,11 +421,49 @@ class UpdateBatcher:
         # Device-plane telemetry sink; None = detached, zero hot-path cost
         # (the MicroBatcher discipline).
         self.recorder = None
+        # Admission controller; feeds the device-plane breaker and lets
+        # a trip drain queued updates into the failover journal.
+        self.admission = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Waiters of the flush currently inside _apply on the executor,
+        # so a breaker trip can settle them off a dead plane (the
+        # MicroBatcher._inflight_batches pattern for the update path).
+        self._inflight_waiters: Dict[int, list] = {}
+        self._flush_seq = 0
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
             self._wakeup = asyncio.Event()
-            self._task = asyncio.get_running_loop().create_task(self._run())
+            self._loop = asyncio.get_running_loop()
+            self._task = self._loop.create_task(self._run())
+
+    def fail_over_queued(self, apply_fn, exc=None) -> None:
+        """Breaker trip: journal every queued (counter, delta) through
+        ``apply_fn`` (the failover store) and settle the waiters; the
+        flush already inside ``_apply`` on the dead plane settles with
+        ``exc`` (its deltas may land when the device unwedges —
+        journaling them too would double-count). No Report-path caller
+        waits on the dead plane. Thread-safe."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+        stuck_exc = exc or StorageError(
+            "device plane failed over", transient=True
+        )
+
+        def _drain():
+            items, waiters = self._swap()
+            try:
+                for counter, delta in items:
+                    apply_fn(counter, delta)
+            except Exception as dexc:
+                self._settle(waiters, dexc)
+            else:
+                self._settle(waiters, None)
+            for stuck in list(self._inflight_waiters.values()):
+                self._settle(stuck, stuck_exc)
+
+        loop.call_soon_threadsafe(_drain)
 
     async def submit(self, counter: Counter, delta: int) -> None:
         # Reject before coalescing: a negative delta inside the batch
@@ -416,24 +525,37 @@ class UpdateBatcher:
                         return
             if len(self._pending) < self.max_batch:
                 await asyncio.sleep(self.max_delay)
+            if not self._pending:
+                continue  # a failover drain emptied it during the linger
             reason = (
                 "size" if len(self._pending) >= self.max_batch
                 else "deadline"
             )
             items, waiters = self._swap()
             self._record_flush(reason, len(items), waiters)
+            adm = self.admission
+            token = adm.breaker.batch_started() if adm is not None else 0
+            self._flush_seq += 1
+            seq = self._flush_seq
+            self._inflight_waiters[seq] = waiters
             t0 = time.perf_counter()
             try:
                 await loop.run_in_executor(self._pool, self._apply, items)
             except Exception as exc:
+                if adm is not None:
+                    adm.breaker.batch_finished(token, exc)
                 self._settle(waiters, exc)
             else:
+                if adm is not None:
+                    adm.breaker.batch_finished(token)
                 if self.metrics is not None:
                     dt = time.perf_counter() - t0
                     for hist in _latency_hists(self.metrics):
                         for _ in waiters:
                             hist.observe(dt)
                 self._settle(waiters, None)
+            finally:
+                self._inflight_waiters.pop(seq, None)
 
     async def close(self) -> None:
         self._closed = True
@@ -481,6 +603,28 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
         self.update_batcher = UpdateBatcher(self.inner, max_delay=max_delay)
         self.recorder: Optional[DeviceStatsRecorder] = None
+        # Admission controller (admission/controller.py); None = the
+        # pre-admission-plane behavior, zero hot-path cost.
+        self.admission = None
+
+    def set_admission(self, controller) -> None:
+        """Put this storage under an admission controller: the check
+        path consults its breaker (failing over to the host oracle when
+        open), and the batchers feed it batch outcomes."""
+        self.admission = controller
+        self.batcher.admission = controller
+        self.update_batcher.admission = controller
+        controller.bind_storage(self)
+
+    def fail_over_queued(self, decider, exc) -> None:
+        """Breaker trip fan-out (called by the controller's transition
+        listener): drain both batcher queues off the dead plane."""
+        self.batcher.fail_over_queued(decider, exc)
+        adm = self.admission
+        if adm is not None:
+            self.update_batcher.fail_over_queued(
+                adm.failover_update_counter, exc
+            )
 
     def set_metrics(self, metrics) -> None:
         """Have the batchers observe per-request datastore latency (device
@@ -500,6 +644,14 @@ class AsyncTpuStorage(AsyncCounterStorage):
     ) -> Authorization:
         if not counters:
             return Authorization.OK
+        adm = self.admission
+        if adm is not None and adm.use_failover():
+            # Breaker open/half-open: exact host-oracle decision, no
+            # batch slot, no device touch (deltas journal for the
+            # recovery reconcile).
+            return adm.failover_check_and_update(
+                counters, delta, load_counters
+            )
         return await self.batcher.submit(counters, delta, load_counters)
 
     def set_limits_provider(self, provider) -> None:
@@ -509,12 +661,20 @@ class AsyncTpuStorage(AsyncCounterStorage):
             self.inner.set_limits_provider(provider)
 
     async def is_within_limits(self, counter: Counter, delta: int) -> bool:
+        adm = self.admission
+        if adm is not None and adm.use_failover():
+            return adm.failover_is_within_limits(counter, delta)
         return self.inner.is_within_limits(counter, delta)
 
     async def add_counter(self, limit: Limit) -> None:
         self.inner.add_counter(limit)
 
     async def update_counter(self, counter: Counter, delta: int) -> None:
+        adm = self.admission
+        if adm is not None and adm.use_failover():
+            require_nonnegative_delta(delta)
+            adm.failover_update_counter(counter, delta)
+            return
         await self.update_batcher.submit(counter, delta)
 
     def library_stats(self) -> dict:

@@ -137,6 +137,7 @@ class NativeRlsPipeline:
         self._plans: Dict[int, Optional[_NsPlan]] = {}  # domain token -> plan
         # (blob, future, enqueue time, request id) per pending request.
         self._pending: List[Tuple[bytes, asyncio.Future, float, object]] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._recorder = None  # memoized from the limiter on first sight
         self._flush_task: Optional[asyncio.Task] = None
         # Dispatch serializes host phases (the C++ context and the slot
@@ -149,6 +150,10 @@ class NativeRlsPipeline:
         )
         self._inflight: set = set()
         self._inflight_sem: Optional[asyncio.Semaphore] = None
+        # seq -> dispatched-but-uncollected batch (for breaker-trip
+        # draining, the MicroBatcher._inflight_batches pattern).
+        self._inflight_batches: Dict[int, list] = {}
+        self._batch_seq = 0
         # The C++ context is single-threaded by design; overlapping flushes
         # (timer + max_batch trigger) serialize here.
         self._native_lock = threading.Lock()
@@ -225,7 +230,14 @@ class NativeRlsPipeline:
     # -- submission ----------------------------------------------------------
 
     async def submit(self, blob: bytes) -> bytes:
-        future = asyncio.get_running_loop().create_future()
+        self._loop = asyncio.get_running_loop()
+        future = self._loop.create_future()
+        adm = getattr(self.limiter._tpu, "admission", None)
+        if adm is not None and adm.use_failover():
+            # Device-plane breaker open: exact per-request path, whose
+            # storage call lands on the host failover oracle.
+            _spawn_detached(self._decide_exact(blob, future))
+            return await future
         rid = current_request_id() if self.recorder is not None else None
         self._pending.append((blob, future, time.perf_counter(), rid))
         if self._flush_task is None or self._flush_task.done():
@@ -268,6 +280,11 @@ class NativeRlsPipeline:
         # the serving-path ceiling moves from 8192/RTT to 8192/host-time.
         await self._inflight_sem.acquire()
         t_submit = time.perf_counter()
+        adm = getattr(self.limiter._tpu, "admission", None)
+        token = adm.breaker.batch_started() if adm is not None else 0
+        self._batch_seq += 1
+        seq = self._batch_seq
+        self._inflight_batches[seq] = batch
         try:
             (results, slow_rows, pendings), t_begin, t_staged = (
                 await loop.run_in_executor(
@@ -277,6 +294,9 @@ class NativeRlsPipeline:
             )
         except Exception as exc:
             self._inflight_sem.release()
+            self._inflight_batches.pop(seq, None)
+            if adm is not None:
+                adm.breaker.batch_finished(token, exc)
             for _blob, future, _t, _rid in batch:
                 if not future.done():
                     future.set_exception(exc)
@@ -297,8 +317,11 @@ class NativeRlsPipeline:
 
         def _collected(t):
             self._inflight.discard(t)
+            self._inflight_batches.pop(seq, None)
             self._inflight_sem.release()
             exc = t.exception()
+            if adm is not None:
+                adm.breaker.batch_finished(token, exc)
             if exc is not None:
                 for _blob, future, _t, _rid in batch:
                     if not future.done():
@@ -378,6 +401,12 @@ class NativeRlsPipeline:
         kernel, slow_rows lists exact-path rows (left None), and each
         pending carries an in-flight device result for
         ``_finish_namespace``."""
+        adm = getattr(self.limiter._tpu, "admission", None)
+        if adm is not None and adm.use_failover():
+            # Breaker open: every row takes the exact path (whose
+            # storage call fails over to the host oracle) — the
+            # columnar path would launch kernels on the dead plane.
+            return [None] * len(blobs), list(range(len(blobs))), []
         self._recycle_context_if_needed()
         n = len(blobs)
         domains, hits, cols, _ndesc, extra = self.hp.parse_batch(blobs)
@@ -715,6 +744,28 @@ class NativeRlsPipeline:
         except Exception as exc:
             if not future.done():
                 future.set_exception(exc)
+
+    def fail_over_queued(self, decider, exc) -> None:
+        """Admission-plane breaker trip: queued raw requests re-route
+        through the exact per-request path (which lands on the host
+        failover oracle); dispatched-but-uncollected batches fail with
+        ``exc``. ``decider`` is unused — the exact path already decides
+        through the storage's failover branch. Thread-safe."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def _drain():
+            batch, self._pending = self._pending, []
+            for blob, future, _t, _rid in batch:
+                if not future.done():
+                    _spawn_detached(self._decide_exact(blob, future))
+            for stuck in list(self._inflight_batches.values()):
+                for _blob, future, _t, _rid in stuck:
+                    if not future.done():
+                        future.set_exception(exc)
+
+        loop.call_soon_threadsafe(_drain)
 
     async def close(self) -> None:
         if self._flush_task is not None:

@@ -92,6 +92,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         self._compilers: Dict[Namespace, NamespaceCompiler] = {}
         self._rev: Dict[Namespace, List[str]] = {}
         self._pending: List[_RawPending] = []
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # seq -> the _RawPendings of a dispatched-but-uncollected batch,
+        # so an admission-plane breaker trip can fail them off the dead
+        # plane (mirrors MicroBatcher._inflight_batches).
+        self._inflight_pendings: Dict[int, list] = {}
+        self._batch_seq = 0
         self._flush_task: Optional[asyncio.Task] = None
         self.max_delay = self._tpu.batcher.max_delay
         self.max_batch = 4096
@@ -187,12 +193,26 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         load_counters: bool = False,
     ) -> CheckResult:
         namespace = Namespace.of(namespace)
+        adm = getattr(self._tpu, "admission", None)
+        if adm is not None and adm.use_failover():
+            # Device-plane breaker open: the inherited exact path routes
+            # through the storage, whose failover branch decides against
+            # the host oracle — no batch slot, no device touch. The
+            # compiled surface also accepts bare descriptor maps; the
+            # exact path needs a real Context.
+            if isinstance(ctx, dict):
+                values, ctx = ctx, Context()
+                ctx.list_binding("descriptors", [values])
+            return await super().check_rate_limited_and_update(
+                namespace, ctx, delta, load_counters
+            )
         values = _values_of(ctx)
         if values is None:
             # Context shape the compiler doesn't cover: exact inherited path.
             return await super().check_rate_limited_and_update(
                 namespace, ctx, delta, load_counters
             )
+        self._loop = asyncio.get_running_loop()
         future = asyncio.get_running_loop().create_future()
         rid = current_request_id() if self.recorder is not None else None
         self._pending.append(
@@ -275,6 +295,11 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             _fail_futures(batch, exc)
             raise
         t_submit = time.perf_counter()
+        adm = getattr(self._tpu, "admission", None)
+        token = adm.breaker.batch_started() if adm is not None else 0
+        self._batch_seq += 1
+        seq = self._batch_seq
+        self._inflight_pendings[seq] = [p for p, _c in live]
         try:
             handle, t_begin, t_launch = await loop.run_in_executor(
                 self._dispatch_pool, _timed_call,
@@ -282,6 +307,9 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             )
         except BaseException as exc:
             self._inflight_sem.release()
+            self._inflight_pendings.pop(seq, None)
+            if adm is not None:
+                adm.breaker.batch_finished(token, exc)
             _fail_futures([p for p, _c in live], exc)
             if not isinstance(exc, Exception):
                 raise
@@ -304,8 +332,11 @@ class CompiledTpuLimiter(AsyncRateLimiter):
 
         def _collected(t):
             self._inflight.discard(t)
+            self._inflight_pendings.pop(seq, None)
             self._inflight_sem.release()
             exc = t.exception()
+            if adm is not None:
+                adm.breaker.batch_finished(token, exc)
             if exc is not None:
                 _fail_futures([p for p, _c in live], exc)
 
@@ -377,6 +408,46 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                     counters.append(Counter(limit, set_vars))
                 requests.append((batch[i], counters))
         return requests
+
+    def fail_over_queued(self, decider, exc) -> None:
+        """Admission-plane breaker trip: decide every queued raw request
+        host-side through ``decider(counters, delta, load) ->
+        Authorization`` and fail dispatched-but-uncollected batches with
+        ``exc`` (their kernel may already have run). Thread-safe — the
+        trip listener can fire from a collect thread; the drain runs on
+        the serving loop, where the compiler cache and limits registry
+        are safe to touch (the ``_flush`` discipline)."""
+        loop = self._loop
+        if loop is None or loop.is_closed():
+            return
+
+        def _drain():
+            batch, self._pending = self._pending, []
+            if batch:
+                try:
+                    evaluated = self._evaluate_batch(batch)
+                except Exception as eexc:
+                    _fail_futures(batch, eexc)
+                    evaluated = []
+                for p, counters in evaluated:
+                    if p.future.done():
+                        continue
+                    try:
+                        if not counters:
+                            p.future.set_result(CheckResult(False, [], None))
+                        else:
+                            auth = decider(counters, p.delta, p.load)
+                            p.future.set_result(CheckResult(
+                                auth.limited,
+                                counters if p.load else [],
+                                auth.limit_name,
+                            ))
+                    except Exception as dexc:
+                        p.future.set_exception(dexc)
+            for pendings in list(self._inflight_pendings.values()):
+                _fail_futures(pendings, exc)
+
+        loop.call_soon_threadsafe(_drain)
 
     async def close(self) -> None:
         """Drain in-flight collects and release the worker pools."""

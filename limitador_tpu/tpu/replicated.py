@@ -126,6 +126,7 @@ class TpuReplicatedStorage(TpuStorage):
         cache_size: Optional[int] = None,
         gossip_period: float = DEFAULT_GOSSIP_PERIOD,
         clock=time.time,
+        advertise_address: Optional[str] = None,
     ):
         super().__init__(capacity=capacity, cache_size=cache_size, clock=clock)
         self.node_id = node_id
@@ -155,6 +156,7 @@ class TpuReplicatedStorage(TpuStorage):
                 peer_urls=peers or [],
                 on_update=self._on_remote_update,
                 snapshot_provider=self._snapshot_for_peer,
+                advertise_address=advertise_address,
             )
             self.broker.start()
             self._gossip_thread = threading.Thread(

@@ -1045,6 +1045,19 @@ void h2i_respond(void* vc, int n, const uint64_t* ids, const int* statuses,
   (void)ignored;
 }
 
+// Opaque per-stream key for a taken item: (conn id << 32) | stream id,
+// 0 when the rid is unknown (already answered / peer gone). Lets the
+// app key per-stream state (answer-serialization locks) without the
+// take path copying ids per item; valid between h2i_take and
+// h2i_respond for that rid.
+uint64_t h2i_stream_key(void* vc, uint64_t rid) {
+  Ctx* c = (Ctx*)vc;
+  std::lock_guard<std::mutex> lk(c->mu);
+  auto it = c->inflight.find(rid);
+  if (it == c->inflight.end()) return 0;
+  return (it->second.conn_id << 32) | (uint64_t)it->second.stream;
+}
+
 uint64_t h2i_stat(void* vc, int what) {
   Ctx* c = (Ctx*)vc;
   switch (what) {

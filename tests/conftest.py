@@ -47,7 +47,10 @@ SERVER_ENV_VARS = frozenset({
     "REDIS_LOCAL_CACHE_BATCH_SIZE", "REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS",
     "MAX_CACHED", "RESPONSE_TIMEOUT", "DISK_PATH", "TPU_SNAPSHOT_PATH",
     "TPU_SNAPSHOT_PERIOD", "NODE_ID", "LISTEN_ADDRESS",
-    "LIMITADOR_TPU_PLATFORM",
+    "ADVERTISE_ADDRESS", "LIMITADOR_TPU_PLATFORM",
+    "ADMISSION_MODE", "BREAKER_FAILURES", "BREAKER_STALL_MS",
+    "BREAKER_RESET_MS", "ADMISSION_MAX_INFLIGHT",
+    "ADMISSION_TARGET_QUEUE_MS", "SHED_RESPONSE", "PRIORITY_KEY",
 })
 
 

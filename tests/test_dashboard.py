@@ -68,7 +68,7 @@ def test_dashboard_metrics_all_exported():
                 continue
             # labels, not metrics
             if ident in ("limitador_namespace", "shard", "phase", "reason",
-                         "batcher"):
+                         "batcher", "priority", "state"):
                 continue
             # identifiers followed by ( are function calls; filter by
             # checking against the metric-shaped remainder

@@ -41,3 +41,33 @@ def test_noqa_suppresses(tmp_path):
     ok = tmp_path / "ok.py"
     ok.write_text("import os  # noqa: side-effect\n")
     assert lint_file(ok) == []
+
+
+def test_metric_registry_lint_is_clean_and_catches_drift(tmp_path):
+    """The admission METRIC_FAMILIES registry and the PrometheusMetrics
+    declarations must agree — and the lint must actually catch both
+    drift directions on a synthetic tree."""
+    from limitador_tpu.tools.lint import lint_metric_registry
+
+    assert lint_metric_registry(REPO_ROOT) == []
+
+    # synthetic repo: a registry naming an undeclared family, and a
+    # declared admission_* family missing from the registry
+    pkg = tmp_path / "limitador_tpu"
+    (pkg / "observability").mkdir(parents=True)
+    (pkg / "admission").mkdir()
+    (pkg / "observability" / "metrics.py").write_text(
+        "from prometheus_client import Counter, Gauge\n"
+        "class M:\n"
+        "    def __init__(self, registry):\n"
+        "        self.a = Gauge('admission_declared_only', 'x',\n"
+        "                       registry=registry)\n"
+    )
+    (pkg / "admission" / "__init__.py").write_text(
+        "METRIC_FAMILIES = ('admission_registered_only',)\n"
+    )
+    findings = lint_metric_registry(tmp_path)
+    assert any("admission_registered_only" in f and "not declared" in f
+               for f in findings)
+    assert any("admission_declared_only" in f and "missing from" in f
+               for f in findings)

@@ -202,6 +202,70 @@ def test_serial_latency_floor(ingress):
     assert p50 < 5.0, f"native ingress serial p50 {p50:.3f}ms"
 
 
+def test_concurrent_streams_not_serialized_by_slow_handler():
+    """ADVICE r5: answer completion used one GLOBAL lock for every
+    stream on stream_path, so a slow handler on one stream stalled all
+    concurrent streams' answers and eos closes. Locks are now per
+    (conn, stream): a fast stream must complete while a slow stream's
+    handler is still sleeping."""
+    path = "/test.Chat/Say"
+
+    async def chat(blob: bytes) -> bytes:
+        if blob == b"slow":
+            await asyncio.sleep(1.5)
+        return b"pong"
+
+    class NoPipeline:
+        STORAGE_ERROR = object()
+
+        def decide_many(self, blobs, chunk=None):
+            return [b"" for _ in blobs]
+
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    ing = NativeIngress(
+        NoPipeline(), host="127.0.0.1", port=0, loop=loop, poll_ms=2,
+        handlers={path: chat}, stream_path=path,
+    )
+    ch = grpc.insecure_channel(f"127.0.0.1:{ing.port}")
+    stream = ch.stream_stream(
+        path,
+        request_serializer=lambda b: b,
+        response_deserializer=lambda b: b,
+    )
+    t0 = time.perf_counter()
+    slow_out = {}
+
+    def run_slow():
+        slow_out["resp"] = list(stream(iter([b"slow"])))
+        slow_out["t"] = time.perf_counter() - t0
+
+    th = threading.Thread(target=run_slow)
+    th.start()
+    time.sleep(0.3)  # the slow stream's handler is now sleeping
+    fast_resp = list(stream(iter([b"fast"])))
+    fast_t = time.perf_counter() - t0
+    th.join(timeout=10)
+    assert fast_resp == [b"pong"]
+    assert slow_out["resp"] == [b"pong"]
+    assert fast_t < 1.2, (
+        f"fast stream took {fast_t:.2f}s — serialized behind the slow "
+        "stream's handler"
+    )
+    assert slow_out["t"] >= 1.4  # the slow one really was slow
+    # per-stream lock entries are cleaned up as streams close
+    deadline = time.time() + 5.0
+    while time.time() < deadline and ing._stream_locks:
+        time.sleep(0.05)
+    assert not ing._stream_locks
+    ch.close()
+    ing.close()
+    loop.call_soon_threadsafe(loop.stop)
+    t.join(timeout=5)
+    loop.close()
+
+
 def test_stats_and_clean_close():
     from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
 

@@ -139,6 +139,63 @@ def test_unreplayable_variable_forms_reported_not_sent():
     assert not calls
 
 
+def test_condition_unreplayable_entries_detected_not_miscredited():
+    """ADVICE r5 medium finding: a limit whose conditions reference
+    descriptor fields ABSENT from the counter's variable bindings never
+    re-selects during replay — the count would be dropped server-side
+    while limits that happen to match the synthesized values got
+    spuriously credited. Such entries classify unreplayable (warned +
+    counted, NOT sent)."""
+    from limitador_tpu.tools.redis_import import replay, unreplayable_reason
+
+    gated = Limit(
+        "api", 1000, 60, ["descriptors[0].m == 'GET'"],
+        ["descriptors[0].u"],
+    )
+    c = Counter(gated, {"descriptors[0].u": "alice"})
+    reason, extra = unreplayable_reason(c, [gated, LIMIT])
+    assert reason == "conditions"
+    calls = []
+    stats = {}
+    sent, unreplayable, remaining, error = replay(
+        [(c, 9)], "http://unused",
+        opener=lambda req, timeout: calls.append(req) or _null_cm(),
+        limits=[gated, LIMIT], stats=stats,
+    )
+    assert (sent, unreplayable, remaining, error) == (0, 1, [], None)
+    assert stats["conditions"] == 1
+    assert not calls, "a condition-unreplayable entry must not be sent"
+
+
+def test_multi_credit_replays_are_warned_but_sent():
+    """Two condition-free limits over the same variable both apply to
+    the synthesized values: replay credits both (as live traffic would)
+    but counts the multi-credit so the operator can verify."""
+    from limitador_tpu.tools.redis_import import replay, unreplayable_reason
+
+    twin = Limit("api", 99, 7, [], ["descriptors[0].u"])
+    c = Counter(LIMIT, {"descriptors[0].u": "alice"})
+    reason, extra = unreplayable_reason(c, [LIMIT, twin])
+    assert reason is None and extra == 1
+    calls = []
+    stats = {}
+    sent, unreplayable, _remaining, _error = replay(
+        [(c, 4)], "http://unused",
+        opener=lambda req, timeout: calls.append(req) or _null_cm(),
+        limits=[LIMIT, twin], stats=stats,
+    )
+    assert (sent, unreplayable) == (1, 0)
+    assert stats["multi_credit"] == 1
+    assert len(calls) == 1
+
+
+def test_replayable_entry_passes_condition_preflight():
+    from limitador_tpu.tools.redis_import import unreplayable_reason
+
+    c = Counter(LIMIT, {"descriptors[0].u": "alice"})
+    assert unreplayable_reason(c, [LIMIT, NAMED]) == (None, 0)
+
+
 class _null_cm:
     def __enter__(self):
         return self
