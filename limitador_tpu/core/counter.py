@@ -17,7 +17,8 @@ __all__ = ["Counter"]
 
 
 class Counter:
-    __slots__ = ("limit", "set_variables", "remaining", "expires_in")
+    __slots__ = ("limit", "set_variables", "remaining", "expires_in",
+                 "_ckey", "_chash")
 
     def __init__(self, limit: Limit, set_variables: Dict[str, str]):
         self.limit = limit
@@ -25,6 +26,11 @@ class Counter:
         self.set_variables: Dict[str, str] = dict(sorted(set_variables.items()))
         self.remaining: Optional[int] = None
         self.expires_in: Optional[float] = None  # seconds
+        # identity tuple + hash memos (_key/__hash__ are the hottest
+        # calls on the batched storage paths; identity never changes
+        # except through update_to_limit, which invalidates them)
+        self._ckey: Optional[Tuple] = None
+        self._chash: Optional[int] = None
 
     @classmethod
     def new(cls, limit: Limit, ctx: Context) -> Optional["Counter"]:
@@ -72,25 +78,59 @@ class Counter:
     def update_to_limit(self, limit: Limit) -> bool:
         if limit == self.limit:
             self.limit = limit
+            self._ckey = None
+            self._chash = None
             return True
         return False
 
     # -- identity (limit + set_variables only) -----------------------------
 
     def _key(self) -> Tuple:
-        return (self.limit._key(), tuple(self.set_variables.items()))
+        key = self._ckey
+        if key is None:
+            key = (self.limit._key(), tuple(self.set_variables.items()))
+            self._ckey = key
+        return key
 
     def __eq__(self, other: Any) -> bool:
         return isinstance(other, Counter) and self._key() == other._key()
 
     def __hash__(self) -> int:
-        return hash(self._key())
+        h = self._chash
+        if h is None:
+            h = hash(self._key())
+            self._chash = h
+        return h
 
     def __repr__(self) -> str:
         return (
             f"Counter(limit={self.limit!r}, set_variables={self.set_variables!r}, "
             f"remaining={self.remaining}, expires_in={self.expires_in})"
         )
+
+    # -- pickling (checkpoints store Counter objects) ----------------------
+
+    def __getstate__(self):
+        # The identity memos never persist: they re-derive on first use,
+        # and excluding them keeps checkpoints format-stable.
+        return (self.limit, self.set_variables, self.remaining,
+                self.expires_in)
+
+    def __setstate__(self, state):
+        if isinstance(state, tuple) and len(state) == 2 and isinstance(
+            state[1], dict
+        ):
+            # pre-memo checkpoints: default __reduce_ex__ slot-dict form
+            _dict_state, slots = state
+            self.limit = slots.get("limit")
+            self.set_variables = slots.get("set_variables", {})
+            self.remaining = slots.get("remaining")
+            self.expires_in = slots.get("expires_in")
+        else:
+            (self.limit, self.set_variables, self.remaining,
+             self.expires_in) = state
+        self._ckey = None
+        self._chash = None
 
     # -- DTO ---------------------------------------------------------------
 

@@ -556,7 +556,11 @@ class NativeIngress:
                 [(rid, GRPC_UNIMPLEMENTED, b"method variant not supported")]
             )
             return
-        self._answer_from_loop(rid, self.pipeline.submit(blob))
+        # submit_async when present: the sync sharded submit() must run
+        # on the serving loop (it touches that loop's shard queue), and
+        # run_coroutine_threadsafe needs a coroutine besides.
+        submit = getattr(self.pipeline, "submit_async", self.pipeline.submit)
+        self._answer_from_loop(rid, submit(blob))
 
     def _respond(self, items: List[tuple]) -> None:
         if not items:

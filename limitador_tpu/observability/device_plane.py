@@ -25,8 +25,10 @@ Per-batch phase names (``PHASES``):
 
 * ``dispatch`` — flush decision to the dispatch thread picking the
   batch up (executor queueing + loop scheduling),
+* ``host_cache`` — decision-plan cache lookup + cached-lane staging
+  (native pipeline; zero on pipelines without the cache),
 * ``host_stage`` — hit-array construction + kernel launch on the
-  dispatch thread,
+  dispatch thread for the rows the cache missed,
 * ``device_sync`` — device round trip: blocking on the launched kernel
   and the device->host transfer,
 * ``unpack`` — decoding results and resolving futures.
@@ -54,7 +56,7 @@ __all__ = [
     "collect_debug_stats",
 ]
 
-PHASES = ("dispatch", "host_stage", "device_sync", "unpack")
+PHASES = ("dispatch", "host_cache", "host_stage", "device_sync", "unpack")
 FLUSH_REASONS = ("size", "deadline", "shutdown")
 # The two queues feeding the batcher_* families: the decision path's
 # MicroBatcher vs the write path's UpdateBatcher. Labeled apart because
@@ -137,6 +139,16 @@ class DeviceStatsRecorder:
     batchers never touch one."""
 
     def __init__(self, metrics=None, flight_capacity: int = 32):
+        # Duck-typed metrics sinks (bench.py's latency collector, test
+        # fakes) may carry only a subset of the families; a recorder
+        # raising mid-flush would strand every future of that batch, so
+        # partial sinks degrade to flight-recorder-only instead.
+        if metrics is not None and not all(
+            hasattr(metrics, attr)
+            for attr in ("batcher_flushes", "batcher_batch_fill_ratio",
+                         "batcher_queue_wait", "device_phase_latency")
+        ):
+            metrics = None
         self.metrics = metrics
         self.flight = FlightRecorder(flight_capacity)
         self.flush_reasons: Dict[str, int] = dict.fromkeys(FLUSH_REASONS, 0)
@@ -310,8 +322,10 @@ def collect_debug_stats(*sources) -> dict:
     shards: Dict[str, dict] = {}
     recorders: Dict[int, DeviceStatsRecorder] = {}
     admission: Dict[int, dict] = {}
+    plan_caches: Dict[int, dict] = {}
     for source in sources:
-        _walk(source, seen, queues, shards, recorders, admission)
+        _walk(source, seen, queues, shards, recorders, admission,
+              plan_caches)
     flush_reasons: Dict[str, int] = {}
     flights: List[dict] = []
     for recorder in recorders.values():
@@ -328,10 +342,18 @@ def collect_debug_stats(*sources) -> dict:
     if admission:
         # One controller per process in practice; surface the first.
         out["admission"] = next(iter(admission.values()))
+    if plan_caches:
+        # Per-pipeline hot-descriptor decision-plan cache state (native
+        # blob cache and/or compiled counter cache), keyed by type name.
+        out["plan_cache"] = {
+            name: stats for stats in plan_caches.values()
+            for name in (stats.pop("_source"),)
+        }
     return out
 
 
-def _walk(source, seen, queues, shards, recorders, admission=None) -> None:
+def _walk(source, seen, queues, shards, recorders, admission=None,
+          plan_caches=None) -> None:
     if source is None or id(source) in seen:
         return
     seen.add(id(source))
@@ -341,6 +363,16 @@ def _walk(source, seen, queues, shards, recorders, admission=None) -> None:
             admission[id(source)] = debug()
         except Exception:
             pass
+    cache_stats = getattr(source, "plan_cache_stats", None)
+    if callable(cache_stats) and plan_caches is not None:
+        try:
+            stats = cache_stats()
+        except Exception:
+            stats = None
+        if stats:
+            stats = dict(stats)
+            stats["_source"] = type(source).__name__
+            plan_caches[id(source)] = stats
     for attr in ("recorder", "_recorder"):
         recorder = getattr(source, attr, None)
         if isinstance(recorder, DeviceStatsRecorder):
@@ -370,4 +402,5 @@ def _walk(source, seen, queues, shards, recorders, admission=None) -> None:
         if child is not None and not isinstance(
             child, (int, float, str, bytes, bool, dict, list, tuple, set)
         ):
-            _walk(child, seen, queues, shards, recorders, admission)
+            _walk(child, seen, queues, shards, recorders, admission,
+                  plan_caches)

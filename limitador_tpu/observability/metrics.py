@@ -247,6 +247,38 @@ class PrometheusMetrics:
             ["shard"],
             registry=self.registry,
         )
+        # -- hot-descriptor decision-plan cache (tpu/plan_cache.py):
+        # hit/miss/evict/invalidation counts polled from the pipelines'
+        # library_stats (cumulative, baseline-converted); size is a
+        # level. Family names are registered in
+        # plan_cache.METRIC_FAMILIES (lint cross-checked).
+        self.plan_cache_hits = Counter(
+            "plan_cache_hits",
+            "Requests served from a memoized decision plan (parse/CEL/"
+            "slot hashing skipped)",
+            registry=self.registry,
+        )
+        self.plan_cache_misses = Counter(
+            "plan_cache_misses",
+            "Requests that derived (and memoized) a fresh decision plan",
+            registry=self.registry,
+        )
+        self.plan_cache_evictions = Counter(
+            "plan_cache_evictions",
+            "Decision plans evicted by the cache's LRU size cap",
+            registry=self.registry,
+        )
+        self.plan_cache_invalidations = Counter(
+            "plan_cache_invalidations",
+            "Decision plans dropped for coherence: limits-epoch bumps "
+            "(reload/add/update/delete) and device-slot recycling",
+            registry=self.registry,
+        )
+        self.plan_cache_size = Gauge(
+            "plan_cache_size",
+            "Decision plans currently cached",
+            registry=self.registry,
+        )
         # -- admission plane (admission/): shed/breaker/failover
         # visibility. Family names are registered in
         # admission.METRIC_FAMILIES; tools/lint.py's registry lint
@@ -340,6 +372,7 @@ class PrometheusMetrics:
         batcher_size = 0
         cache_size = 0
         queue_depth = 0
+        plan_cache_size = 0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -349,6 +382,7 @@ class PrometheusMetrics:
             batcher_size += int(stats.get("batcher_size", 0))
             cache_size += int(stats.get("cache_size", 0))
             queue_depth += int(stats.get("queue_depth", 0))
+            plan_cache_size += int(stats.get("plan_cache_size", 0))
             for key in (
                 "counter_overshoot",
                 "evicted_pending_writes",
@@ -358,6 +392,10 @@ class PrometheusMetrics:
                 "ingress_requests",
                 "ingress_responses",
                 "ingress_protocol_errors",
+                "plan_cache_hits",
+                "plan_cache_misses",
+                "plan_cache_evictions",
+                "plan_cache_invalidations",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -370,6 +408,7 @@ class PrometheusMetrics:
         self.batcher_size.set(batcher_size)
         self.cache_size.set(cache_size)
         self.batcher_queue_depth.set(queue_depth)
+        self.plan_cache_size.set(plan_cache_size)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:
@@ -458,13 +497,15 @@ class PrometheusMetrics:
 
     def incr_limited_calls(
         self, namespace: str, limit_name: Optional[str] = None, ctx=None,
-        labels=None,
+        labels=None, n: int = 1,
     ) -> None:
         extra = labels if labels is not None else self.custom_labels(ctx)
         if self.use_limit_name_label:
-            self.limited_calls.labels(namespace, limit_name or "", *extra).inc()
+            self.limited_calls.labels(
+                namespace, limit_name or "", *extra
+            ).inc(n)
         else:
-            self.limited_calls.labels(namespace, *extra).inc()
+            self.limited_calls.labels(namespace, *extra).inc(n)
 
     def record_datastore_latency(self, timings) -> None:
         """MetricsLayer consumer (prometheus_metrics.rs:131-133): the

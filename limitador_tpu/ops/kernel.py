@@ -398,6 +398,7 @@ def update_core(
     fresh: jax.Array,
     bucket: jax.Array,
     now_ms: jax.Array,
+    tat_floor_hook=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Unconditional increments (the reference's ``update_counter`` path):
     apply every delta, resetting expired windows, no admission check.
@@ -410,6 +411,14 @@ def update_core(
     clamps at the int32 horizon: tokens beyond it are dropped — the
     bucket analogue of the fixed-window MAX_VALUE_CAP saturation (a
     saturated TAT rejects everything and decays with real time).
+
+    ``tat_floor_hook(s_slot)`` returns a per-sorted-hit int32 floor
+    max-merged into the bucket lanes' starting TAT — the same join the
+    check core applies (replicated topology: the gossiped remote TAT).
+    Folding it here makes the UNCONDITIONAL path (Report role /
+    redis_import replay) persist the shared-bucket join too, instead of
+    advancing from a stale local TAT and briefly under-counting across
+    nodes. Window lanes ignore it; identity when None.
 
     O(H log H): hits are sorted by slot and every per-cell aggregate is a
     segment reduction, written back with one scatter-set at each
@@ -470,7 +479,10 @@ def update_core(
 
     # Bucket TAT advance, clamped so max(TAT, now) + adv*I fits int32.
     s_ival = jnp.maximum(s_win, 1)
-    tat_base = jnp.maximum(jnp.where(h_fresh, 0, e_raw), now_ms)
+    local_tat = jnp.where(h_fresh, 0, e_raw)
+    if tat_floor_hook is not None:
+        local_tat = jnp.maximum(local_tat, tat_floor_hook(s_slot))
+    tat_base = jnp.maximum(local_tat, now_ms)
     max_adv = (_NEVER - tat_base) // s_ival
     adv = jnp.minimum(seg_add[seg_id], max_adv)
     exp_new = jnp.where(

@@ -206,6 +206,22 @@ def build_parser() -> argparse.ArgumentParser:
         "library is unavailable)",
     )
     p.add_argument(
+        "--serving-shards", type=int,
+        default=int(_env("SERVING_SHARDS", "1")),
+        help="number of RLS gRPC serving loops: each extra shard is a "
+        "thread with its own event loop and its own server on the SAME "
+        "port (SO_REUSEPORT), all feeding the shared device lane — "
+        "accept/parse/future-resolution parallelize across cores "
+        "(requires a batched tpu storage to pay off; 1 = single loop)",
+    )
+    p.add_argument(
+        "--plan-cache-size", type=int,
+        default=int(_env("PLAN_CACHE_SIZE", str(1 << 16))),
+        help="hot-descriptor decision-plan cache entries per pipeline "
+        "(byte-identical repeat requests skip parse/CEL/slot hashing; "
+        "epoch-invalidated on every limits change; 0 disables)",
+    )
+    p.add_argument(
         "--native-ingress",
         action="store_true",
         default=_env("TPU_NATIVE_INGRESS", "") == "1",
@@ -459,7 +475,10 @@ def build_limiter(args, on_partitioned=None):
         if args.pipeline in ("compiled", "native"):
             from ..tpu.pipeline import CompiledTpuLimiter
 
-            return CompiledTpuLimiter(async_storage)
+            return CompiledTpuLimiter(
+                async_storage,
+                plan_cache_size=getattr(args, "plan_cache_size", 1 << 16),
+            )
         return AsyncRateLimiter(async_storage)
     if args.storage == "sharded":
         from ..tpu.batcher import AsyncTpuStorage  # noqa: lazy per-branch
@@ -510,7 +529,10 @@ def build_limiter(args, on_partitioned=None):
                     "compiled pipeline with sharded storage")
             from ..tpu.pipeline import CompiledTpuLimiter  # noqa: lazy per-branch
 
-            return CompiledTpuLimiter(async_storage)
+            return CompiledTpuLimiter(
+                async_storage,
+                plan_cache_size=getattr(args, "plan_cache_size", 1 << 16),
+            )
         return AsyncRateLimiter(async_storage)
     if args.storage == "disk":
         try:
@@ -763,9 +785,11 @@ async def _amain(args) -> int:
             from ..tpu.native_pipeline import NativeRlsPipeline
 
             native_pipeline = NativeRlsPipeline(
-                limiter, metrics, max_delay=args.batch_delay_us / 1e6
+                limiter, metrics, max_delay=args.batch_delay_us / 1e6,
+                plan_cache_size=args.plan_cache_size,
             )
             pipelines_to_invalidate.append(native_pipeline)
+            metrics.attach_library_source(native_pipeline)
             if admission is not None:
                 admission.add_drainable(native_pipeline)
         else:
@@ -853,6 +877,29 @@ async def _amain(args) -> int:
         native_pipeline=native_pipeline,
         admission=admission,
     )
+    # Extra serving shards: thread-per-event-loop gRPC servers on the
+    # same port (SO_REUSEPORT). The limiter's per-loop batchers / submit
+    # shards fan the accepted traffic into the one shared device lane.
+    serving_shards = []
+    if args.serving_shards > 1:
+        from .rls import RlsServingShard
+
+        for i in range(1, args.serving_shards):
+            try:
+                serving_shards.append(RlsServingShard(
+                    i, limiter, f"{args.rls_host}:{rls_grpc_port}",
+                    metrics, args.rate_limit_headers,
+                    native_pipeline=native_pipeline, admission=admission,
+                ))
+            except RuntimeError as exc:
+                log.warning(
+                    f"serving shard {i} unavailable ({exc}); continuing "
+                    f"with {1 + len(serving_shards)} shard(s)")
+                break
+        if serving_shards:
+            log.info(
+                f"serving shards: {1 + len(serving_shards)} event loops "
+                f"on port {rls_grpc_port}")
     from ..observability.device_plane import JaxProfiler
 
     debug_sources = [counters_storage]
@@ -937,6 +984,13 @@ async def _amain(args) -> int:
         authority_server.stop()
     if native_ingress is not None:
         native_ingress.close()
+    for shard in serving_shards:
+        # Off-loop: shard.stop blocks on the sync server's drain and a
+        # thread join; inline it would freeze the aio server's own
+        # graceful stop behind a wedged shard.
+        await asyncio.get_running_loop().run_in_executor(
+            None, shard.stop, 1.0
+        )
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
     if admission is not None:

@@ -170,7 +170,8 @@ def _openapi_spec() -> dict:
             "/debug/stats": {
                 "get": {
                     "summary": "Device-plane debug state (queues, shard "
-                               "occupancy, flight recorder)",
+                               "occupancy, plan-cache stats, flight "
+                               "recorder)",
                     "responses": {
                         "200": {"description": "debug stats"}
                     },
@@ -356,8 +357,8 @@ class _Api:
 
     async def get_debug_stats(self, request: web.Request) -> web.Response:
         """Device-plane state without a debugger: queue depths, per-shard
-        table occupancy, flush reasons, the slow-decision flight recorder
-        and the profiler state."""
+        table occupancy, flush reasons, decision-plan cache stats, the
+        slow-decision flight recorder and the profiler state."""
         stats = collect_debug_stats(*self.debug_sources)
         stats["profiler"] = self.profiler.status()
         return web.json_response(stats)

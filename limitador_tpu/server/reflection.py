@@ -31,6 +31,7 @@ from .proto import reflection_pb2
 __all__ = [
     "REFLECTION_SERVICE",
     "REFLECTION_METHOD",
+    "make_sync_reflection_handler",
     "ReflectionResponder",
     "make_reflection_handler",
     "native_reflection_handler",
@@ -156,6 +157,32 @@ def make_reflection_handler(service_names: Iterable[str]):
 
     async def server_reflection_info(request_iterator, context):
         async for request in request_iterator:
+            yield responder.answer(request)
+
+    return grpc.method_handlers_generic_handler(
+        REFLECTION_SERVICE,
+        {
+            "ServerReflectionInfo": grpc.stream_stream_rpc_method_handler(
+                server_reflection_info,
+                request_deserializer=(
+                    reflection_pb2.ServerReflectionRequest.FromString
+                ),
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+        },
+    )
+
+
+def make_sync_reflection_handler(service_names: Iterable[str]):
+    """Sync-server variant (the serving shards run sync gRPC servers:
+    grpc.aio's completion-queue poller is process-global and unsafe
+    across event loops)."""
+    import grpc
+
+    responder = ReflectionResponder(service_names)
+
+    def server_reflection_info(request_iterator, context):
+        for request in request_iterator:
             yield responder.answer(request)
 
     return grpc.method_handlers_generic_handler(

@@ -41,6 +41,7 @@ from .proto import rls_pb2
 __all__ = [
     "RATE_LIMIT_HEADERS_NONE",
     "RATE_LIMIT_HEADERS_DRAFT03",
+    "RlsServingShard",
     "make_rls_handlers",
     "serve_rls",
 ]
@@ -65,11 +66,26 @@ def _hits_addend(req) -> int:
     return req.hits_addend if req.hits_addend != 0 else 1
 
 
+# Headerless responses carry only the overall code: pre-built singletons
+# (never mutated; SerializeToString on a settled message is safe from any
+# thread) replace a per-request protobuf construction on the hot path.
+_PLAIN_RESPONSES = {
+    code: rls_pb2.RateLimitResponse(overall_code=code)
+    for code in (
+        rls_pb2.RateLimitResponse.OK,
+        rls_pb2.RateLimitResponse.OVER_LIMIT,
+        rls_pb2.RateLimitResponse.UNKNOWN,
+    )
+}
+_UNKNOWN_RESPONSE = _PLAIN_RESPONSES[rls_pb2.RateLimitResponse.UNKNOWN]
+
+
 def _response(code, result: Optional[CheckResult], with_headers: bool):
+    if not with_headers or result is None:
+        return _PLAIN_RESPONSES[code]
     resp = rls_pb2.RateLimitResponse(overall_code=code)
-    if with_headers and result is not None:
-        for key, value in result.response_header().items():
-            resp.response_headers_to_add.add(key=key, value=value)
+    for key, value in result.response_header().items():
+        resp.response_headers_to_add.add(key=key, value=value)
     return resp
 
 
@@ -160,9 +176,9 @@ class RlsService:
             return self.admission.admit(namespace, values, deadline)
         except AdmissionShed as shed:
             if shed.overlimit:
-                return rls_pb2.RateLimitResponse(
-                    overall_code=rls_pb2.RateLimitResponse.OVER_LIMIT
-                )
+                return _PLAIN_RESPONSES[
+                    rls_pb2.RateLimitResponse.OVER_LIMIT
+                ]
             await context.abort(
                 grpc.StatusCode.UNAVAILABLE, f"Service unavailable: {shed}"
             )
@@ -172,9 +188,7 @@ class RlsService:
     async def should_rate_limit(self, request, context):
         namespace = request.domain
         if not namespace:
-            return rls_pb2.RateLimitResponse(
-                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
-            )
+            return _UNKNOWN_RESPONSE
         ctx = _context_from_request(request)
         hits_addend = _hits_addend(request)
         with_headers = self.rate_limit_headers != RATE_LIMIT_HEADERS_NONE
@@ -230,9 +244,7 @@ class RlsService:
     async def check_rate_limit(self, request, context):
         namespace = request.domain
         if not namespace:
-            return rls_pb2.RateLimitResponse(
-                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
-            )
+            return _UNKNOWN_RESPONSE
         ctx = _context_from_request(request)
         try:
             # The reference checks with delta 1 regardless of hits_addend
@@ -258,9 +270,7 @@ class RlsService:
     async def report(self, request, context):
         namespace = request.domain
         if not namespace:
-            return rls_pb2.RateLimitResponse(
-                overall_code=rls_pb2.RateLimitResponse.UNKNOWN
-            )
+            return _UNKNOWN_RESPONSE
         ctx = _context_from_request(request)
         hits_addend = _hits_addend(request)
         try:
@@ -273,9 +283,7 @@ class RlsService:
             # Report counts hits only (kuadrant_service.rs report path);
             # authorized_calls is counted by CheckRateLimit.
             self.metrics.incr_authorized_hits(namespace, hits_addend, ctx=ctx)
-        return rls_pb2.RateLimitResponse(
-            overall_code=rls_pb2.RateLimitResponse.OK
-        )
+        return _PLAIN_RESPONSES[rls_pb2.RateLimitResponse.OK]
 
 
 def make_rls_handlers(service: RlsService):
@@ -311,15 +319,10 @@ def make_rls_handlers(service: RlsService):
     return [envoy, kuadrant]
 
 
-def make_native_should_rate_limit_handler(native_pipeline, admission=None):
-    """ShouldRateLimit over RAW request bytes: identity (de)serializers keep
-    Python protobuf off the hot path entirely — the native pipeline parses
-    the wire bytes in C++ and answers with prebuilt response blobs.
-
-    With an admission controller, deadline/overload shedding happens
-    before the blob enters the pipeline — priority resolves without
-    parsing (the default class), since descriptor entries only
-    materialize in C++ past this point."""
+def _native_should_rate_limit(native_pipeline, admission=None):
+    """The raw-bytes ShouldRateLimit coroutine shared by the aio server
+    handler and the sync serving shards' bridge: admission gate, then
+    ``submit`` on the calling loop's pipeline shard."""
     from ..admission.controller import AdmissionShed
 
     async def handler(blob: bytes, context) -> bytes:
@@ -355,11 +358,23 @@ def make_native_should_rate_limit_handler(native_pipeline, admission=None):
             if ticket is not None:
                 ticket.release()
 
+    return handler
+
+
+def make_native_should_rate_limit_handler(native_pipeline, admission=None):
+    """ShouldRateLimit over RAW request bytes: identity (de)serializers keep
+    Python protobuf off the hot path entirely — the native pipeline parses
+    the wire bytes in C++ and answers with prebuilt response blobs.
+
+    With an admission controller, deadline/overload shedding happens
+    before the blob enters the pipeline — priority resolves without
+    parsing (the default class), since descriptor entries only
+    materialize in C++ past this point."""
     return grpc.method_handlers_generic_handler(
         _ENVOY_SERVICE,
         {
             "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
-                handler,
+                _native_should_rate_limit(native_pipeline, admission),
                 request_deserializer=None,   # raw bytes in
                 response_serializer=None,    # raw bytes out
             )
@@ -440,6 +455,215 @@ async def serve_rls(
     server.add_generic_rpc_handlers(
         (make_reflection_handler((_ENVOY_SERVICE, _KUADRANT_SERVICE)),)
     )
-    server.add_insecure_port(address)
+    if server.add_insecure_port(address) == 0:
+        raise RuntimeError(
+            f"could not bind RLS gRPC server to {address} (port in use "
+            "without SO_REUSEPORT?)"
+        )
     await server.start()
     return server
+
+
+class _ShardAbort(Exception):
+    """Raised inside a bridged coroutine to carry ``context.abort``
+    semantics back to the sync handler thread."""
+
+    def __init__(self, code, details):
+        super().__init__(code, details)
+        self.code = code
+        self.details = details
+
+
+class _ShardContextShim:
+    """The slice of the async ServicerContext surface the RlsService
+    handlers use, backed by a sync context on another thread. ``abort``
+    raises (the coroutine ends); the handler thread re-issues it on the
+    real context, which is only legal there."""
+
+    __slots__ = ("_context",)
+
+    def __init__(self, context):
+        self._context = context
+
+    async def abort(self, code, details=""):
+        raise _ShardAbort(code, details)
+
+    def invocation_metadata(self):
+        return self._context.invocation_metadata()
+
+    def time_remaining(self):
+        return self._context.time_remaining()
+
+
+class RlsServingShard:
+    """One extra serving shard: a SYNC gRPC server (its own C-core
+    listener on the SAME address — the kernel spreads accepted
+    connections across listeners via SO_REUSEPORT, grpc's default on
+    Linux) whose handlers bridge onto the shard's own asyncio loop,
+    where the shared limiter's per-loop batchers / submit shards feed
+    the one device lane — the Ray serve pattern of per-worker event
+    loops over a shared execution lane.
+
+    Sync, not ``grpc.aio``: the aio completion-queue poller is a
+    process-global singleton, and a second event loop racing its wakeup
+    socket intermittently drops events (observed as stuck RPCs +
+    ``BlockingIOError`` in ``PollerCompletionQueue._handle_events``).
+    The sync C core gives each shard HTTP/2 framing and proto handling
+    on its own threads; only the thin decision bridge crosses into the
+    shard loop.
+
+    Construction blocks until the shard's server is listening (raises
+    if the bind fails, e.g. on a platform without SO_REUSEPORT)."""
+
+    def __init__(
+        self,
+        index: int,
+        limiter,
+        address: str,
+        metrics=None,
+        rate_limit_headers: str = RATE_LIMIT_HEADERS_NONE,
+        native_pipeline=None,
+        admission=None,
+        workers: int = 16,
+    ):
+        import asyncio
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        from .reflection import make_sync_reflection_handler
+
+        self.index = index
+        self.address = address
+        self._loop = asyncio.new_event_loop()
+        self._loop_thread = threading.Thread(
+            target=self._run_loop, name=f"rls-shard-loop-{index}",
+            daemon=True,
+        )
+        self._loop_thread.start()
+
+        service = RlsService(
+            limiter, metrics, rate_limit_headers, admission
+        )
+        self._server = grpc.server(
+            ThreadPoolExecutor(
+                workers, thread_name_prefix=f"rls-shard-{index}"
+            ),
+            options=(("grpc.so_reuseport", 1),),
+        )
+        for handler in self._make_handlers(
+            service, rate_limit_headers, native_pipeline, admission
+        ):
+            self._server.add_generic_rpc_handlers((handler,))
+        self._server.add_generic_rpc_handlers(
+            (make_sync_reflection_handler(
+                (_ENVOY_SERVICE, _KUADRANT_SERVICE)
+            ),)
+        )
+        if self._server.add_insecure_port(address) == 0:
+            self.stop(grace=0.0)
+            raise RuntimeError(
+                f"serving shard {index} could not bind {address} "
+                "(SO_REUSEPORT unavailable?)"
+            )
+        self._server.start()
+
+    def _run_loop(self) -> None:
+        import asyncio
+
+        asyncio.set_event_loop(self._loop)
+        self._loop.run_forever()
+        self._loop.close()
+
+    def _bridge(self, async_fn):
+        """Sync handler running ``async_fn(request, shim)`` on the shard
+        loop; abort round-trips through _ShardAbort. The client's
+        ``x-request-id`` is re-published to the device-plane contextvar
+        INSIDE the bridged coroutine (the handler thread's context does
+        not cross ``run_coroutine_threadsafe``), so the flight recorder
+        correlates shard traffic exactly like the aio interceptor's."""
+        import asyncio
+        import uuid
+
+        from ..observability.device_plane import set_request_id
+        from .middleware import HEADER
+
+        loop = self._loop
+
+        def handler(request, context):
+            metadata = dict(context.invocation_metadata() or ())
+            request_id = metadata.get(HEADER) or uuid.uuid4().hex
+
+            async def bridged():
+                set_request_id(request_id)
+                return await async_fn(request, _ShardContextShim(context))
+
+            future = asyncio.run_coroutine_threadsafe(bridged(), loop)
+            try:
+                response = future.result()
+            except _ShardAbort as abort:
+                context.set_trailing_metadata(((HEADER, request_id),))
+                context.abort(abort.code, abort.details)
+                return
+            context.send_initial_metadata(((HEADER, request_id),))
+            return response
+
+        return handler
+
+    def _make_handlers(
+        self, service, rate_limit_headers, native_pipeline, admission
+    ):
+        req_des = rls_pb2.RateLimitRequest.FromString
+        resp_ser = lambda m: m.SerializeToString()
+        if (
+            native_pipeline is not None
+            and rate_limit_headers == RATE_LIMIT_HEADERS_NONE
+        ):
+            # Raw-bytes hot path: identity (de)serializers, prebuilt
+            # response blobs — the same lane the aio server mounts.
+            hot = _native_should_rate_limit(native_pipeline, admission)
+            envoy = grpc.method_handlers_generic_handler(
+                _ENVOY_SERVICE,
+                {
+                    "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                        self._bridge(hot),
+                        request_deserializer=None,
+                        response_serializer=None,
+                    )
+                },
+            )
+        else:
+            envoy = grpc.method_handlers_generic_handler(
+                _ENVOY_SERVICE,
+                {
+                    "ShouldRateLimit": grpc.unary_unary_rpc_method_handler(
+                        self._bridge(service.should_rate_limit),
+                        request_deserializer=req_des,
+                        response_serializer=resp_ser,
+                    )
+                },
+            )
+        kuadrant = grpc.method_handlers_generic_handler(
+            _KUADRANT_SERVICE,
+            {
+                "CheckRateLimit": grpc.unary_unary_rpc_method_handler(
+                    self._bridge(service.check_rate_limit),
+                    request_deserializer=req_des,
+                    response_serializer=resp_ser,
+                ),
+                "Report": grpc.unary_unary_rpc_method_handler(
+                    self._bridge(service.report),
+                    request_deserializer=req_des,
+                    response_serializer=resp_ser,
+                ),
+            },
+        )
+        return [envoy, kuadrant]
+
+    def stop(self, grace: float = 1.0) -> None:
+        try:
+            self._server.stop(grace).wait(timeout=10)
+        except Exception:
+            pass
+        if not self._loop.is_closed():
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        self._loop_thread.join(timeout=10)

@@ -44,6 +44,7 @@ DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
 #: METRIC_FAMILIES registry (prefix -> registry module, repo-relative)
 REGISTRY_OWNED_PREFIXES = {
     "admission_": "limitador_tpu/admission/__init__.py",
+    "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
 }
 
 

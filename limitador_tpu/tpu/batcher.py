@@ -35,6 +35,7 @@ only owns the coalescing.
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
@@ -581,7 +582,16 @@ class UpdateBatcher:
 class AsyncTpuStorage(AsyncCounterStorage):
     """AsyncCounterStorage over TpuStorage + MicroBatcher: the hot
     check_and_update path batches, the Report/update path batches through
-    ``UpdateBatcher``; admin operations delegate inline."""
+    ``UpdateBatcher``; admin operations delegate inline.
+
+    Serving shards: the batchers are PER EVENT LOOP — a MicroBatcher's
+    queue, wakeup event and flush task are loop-affine, so each serving
+    loop (thread) gets its own pair, all feeding the one thread-safe
+    device storage behind them (kernel launches serialize under the
+    storage lock in call order). The first loop to submit binds the
+    eagerly-created default pair (``self.batcher`` /
+    ``self.update_batcher``), keeping the single-loop embedding
+    unchanged."""
 
     reports_datastore_latency = False
 
@@ -602,29 +612,87 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.inner = storage or TpuStorage(**kwargs)
         self.batcher = MicroBatcher(self.inner, max_batch_hits, max_delay)
         self.update_batcher = UpdateBatcher(self.inner, max_delay=max_delay)
+        self._batcher_args = (max_batch_hits, max_delay)
+        self._metrics = None
+        # loop -> (MicroBatcher, UpdateBatcher); the first loop gets the
+        # default pair above. The default pair binds AT MOST once — its
+        # wakeup event / run task are loop-affine, so after its loop
+        # dies later loops get fresh pairs instead of a rebind.
+        self._loop_batchers: dict = {}
+        self._default_bound = False
+        self._shards_lock = threading.Lock()
         self.recorder: Optional[DeviceStatsRecorder] = None
         # Admission controller (admission/controller.py); None = the
         # pre-admission-plane behavior, zero hot-path cost.
         self.admission = None
+
+    def _batcher_pairs(self) -> list:
+        """Every live (check, update) batcher pair, the default pair
+        included even before a loop binds it."""
+        pairs = list(self._loop_batchers.values())
+        if not any(b is self.batcher for b, _u in pairs):
+            pairs.append((self.batcher, self.update_batcher))
+        return pairs
+
+    def _batchers_for_loop(self):
+        loop = asyncio.get_running_loop()
+        pair = self._loop_batchers.get(loop)
+        if pair is not None:
+            return pair
+        with self._shards_lock:
+            pair = self._loop_batchers.get(loop)
+            if pair is None:
+                # Prune pairs whose loop died (new-loop-per-call
+                # embeddings would otherwise leak a batcher pair per
+                # dead loop for the storage's lifetime). The default
+                # pair is kept: close() owns it.
+                for dead in [
+                    l for l in self._loop_batchers if l.is_closed()
+                ]:
+                    mb, ub = self._loop_batchers.pop(dead)
+                    if mb is not self.batcher:
+                        mb._dispatch_pool.shutdown(wait=False)
+                        mb._collect_pool.shutdown(wait=False)
+                        ub._pool.shutdown(wait=False)
+                if not self._default_bound:
+                    # first loop ever binds the default pair
+                    self._default_bound = True
+                    pair = (self.batcher, self.update_batcher)
+                else:
+                    max_batch_hits, max_delay = self._batcher_args
+                    mb = MicroBatcher(
+                        self.inner, max_batch_hits, max_delay
+                    )
+                    ub = UpdateBatcher(self.inner, max_delay=max_delay)
+                    mb.metrics = self._metrics
+                    ub.metrics = self._metrics
+                    mb.recorder = self.recorder
+                    ub.recorder = self.recorder
+                    mb.admission = self.admission
+                    ub.admission = self.admission
+                    pair = (mb, ub)
+                self._loop_batchers[loop] = pair
+            return pair
 
     def set_admission(self, controller) -> None:
         """Put this storage under an admission controller: the check
         path consults its breaker (failing over to the host oracle when
         open), and the batchers feed it batch outcomes."""
         self.admission = controller
-        self.batcher.admission = controller
-        self.update_batcher.admission = controller
+        for mb, ub in self._batcher_pairs():
+            mb.admission = controller
+            ub.admission = controller
         controller.bind_storage(self)
 
     def fail_over_queued(self, decider, exc) -> None:
         """Breaker trip fan-out (called by the controller's transition
-        listener): drain both batcher queues off the dead plane."""
-        self.batcher.fail_over_queued(decider, exc)
+        listener): drain every shard's batcher queues off the dead
+        plane."""
         adm = self.admission
-        if adm is not None:
-            self.update_batcher.fail_over_queued(
-                adm.failover_update_counter, exc
-            )
+        for mb, ub in self._batcher_pairs():
+            mb.fail_over_queued(decider, exc)
+            if adm is not None:
+                ub.fail_over_queued(adm.failover_update_counter, exc)
 
     def set_metrics(self, metrics) -> None:
         """Have the batchers observe per-request datastore latency (device
@@ -632,11 +700,13 @@ class AsyncTpuStorage(AsyncCounterStorage):
         plane's handler wall clock, and attach the device-plane telemetry
         recorder (queue waits, fill ratios, flush reasons, phase timings,
         slow-decision flight recorder)."""
-        self.batcher.metrics = metrics
-        self.update_batcher.metrics = metrics
+        self._metrics = metrics
         self.recorder = DeviceStatsRecorder(metrics)
-        self.batcher.recorder = self.recorder
-        self.update_batcher.recorder = self.recorder
+        for mb, ub in self._batcher_pairs():
+            mb.metrics = metrics
+            ub.metrics = metrics
+            mb.recorder = self.recorder
+            ub.recorder = self.recorder
         self.reports_datastore_latency = True
 
     async def check_and_update(
@@ -652,7 +722,8 @@ class AsyncTpuStorage(AsyncCounterStorage):
             return adm.failover_check_and_update(
                 counters, delta, load_counters
             )
-        return await self.batcher.submit(counters, delta, load_counters)
+        batcher, _ub = self._batchers_for_loop()
+        return await batcher.submit(counters, delta, load_counters)
 
     def set_limits_provider(self, provider) -> None:
         """Forwarded so the facade's registry reaches replicated inner
@@ -675,11 +746,20 @@ class AsyncTpuStorage(AsyncCounterStorage):
             require_nonnegative_delta(delta)
             adm.failover_update_counter(counter, delta)
             return
-        await self.update_batcher.submit(counter, delta)
+        _mb, update_batcher = self._batchers_for_loop()
+        await update_batcher.submit(counter, delta)
 
     def library_stats(self) -> dict:
-        """Operational metrics for the /metrics library gauges."""
-        flush_sizes, self.batcher.flush_sizes = self.batcher.flush_sizes, []
+        """Operational metrics for the /metrics library gauges,
+        aggregated across serving shards."""
+        flush_sizes: List[int] = []
+        batcher_size = 0
+        queue_depth = 0
+        for mb, ub in self._batcher_pairs():
+            shard_sizes, mb.flush_sizes = mb.flush_sizes, []
+            flush_sizes.extend(shard_sizes)
+            batcher_size += mb._pending_hits + len(ub._pending)
+            queue_depth += len(mb._pending) + len(ub._pending)
         cache_size = 0
         table = getattr(self.inner, "_table", None)
         if table is not None:
@@ -691,14 +771,10 @@ class AsyncTpuStorage(AsyncCounterStorage):
             if gtable is not None:
                 cache_size += len(gtable.qualified) + len(gtable.simple)
         return {
-            "batcher_size": (
-                self.batcher._pending_hits + len(self.update_batcher._pending)
-            ),
+            "batcher_size": batcher_size,
             "cache_size": cache_size,
             "flush_sizes": flush_sizes,
-            "queue_depth": (
-                len(self.batcher._pending) + len(self.update_batcher._pending)
-            ),
+            "queue_depth": queue_depth,
         }
 
     def device_stats(self) -> dict:
@@ -717,5 +793,30 @@ class AsyncTpuStorage(AsyncCounterStorage):
         self.inner.clear()
 
     async def close(self) -> None:
-        await self.batcher.close()
-        await self.update_batcher.close()
+        cur = asyncio.get_running_loop()
+        closed: set = set()
+        for loop, (mb, ub) in list(self._loop_batchers.items()):
+            if id(mb) in closed or loop is cur:
+                continue  # current-loop / default pair closed below
+            if not loop.is_closed() and loop.is_running():
+                closed.add(id(mb))
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        mb.close(), loop
+                    ).result(timeout=10)
+                    asyncio.run_coroutine_threadsafe(
+                        ub.close(), loop
+                    ).result(timeout=10)
+                except Exception:
+                    pass  # shard loop died mid-shutdown
+        for mb, ub in self._batcher_pairs():
+            if id(mb) in closed:
+                continue
+            # Current-loop shards, the default pair, and pairs whose loop
+            # already died: close here (awaiting a dead loop's task is
+            # guarded inside MicroBatcher.close by the task's own state).
+            try:
+                await mb.close()
+                await ub.close()
+            except Exception:
+                pass

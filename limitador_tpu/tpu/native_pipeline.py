@@ -5,7 +5,9 @@ this pipeline RAW serialized RateLimitRequest bytes (identity deserializer
 — Python protobuf never runs on the hot path); a micro-batch of blobs then
 flows
 
-    C++ parse + intern -> token columns          (native/hostpath.cc)
+    hot-descriptor plan cache (byte-identical repeats skip everything
+    below except the kernel)                     (tpu/plan_cache.py)
+    -> C++ parse + intern -> token columns       (native/hostpath.cc)
     -> compiled predicate masks (numpy)          (tpu/compiler.py)
     -> composite-key slot lookup (C++ hash map)  (native slot map)
     -> ONE fused device kernel                   (ops/kernel.py)
@@ -16,6 +18,13 @@ Python objects only materialize off the fast path: slot-map misses
 LRU eviction invalidates both sides), requests with multiple descriptors,
 namespaces with non-vectorizable limits, and header-loading modes — all of
 which route to the exact per-request pipeline.
+
+Serving model: ``submit`` is a plain function returning an awaitable
+future — no per-request coroutine/task — and the pending queue is
+sharded PER EVENT LOOP, so N serving loops (threads) feed the one
+device lane concurrently behind the storage lock's swap discipline.
+Cross-loop future resolution stays batched (one ``call_soon_threadsafe``
+per loop per batch).
 
 Semantics are the same exact check-all-then-update-all as everywhere else;
 this module only changes how fast the batch is assembled.
@@ -44,6 +53,13 @@ from ..ops import kernel as K
 from ..storage.gcra import device_eligible, emission_interval_ms
 from .compiler import NamespaceCompiler
 from .pipeline import CompiledTpuLimiter
+from .plan_cache import (
+    PLAN_KERNEL,
+    PLAN_OK,
+    PLAN_UNKNOWN,
+    DecisionPlan,
+    DecisionPlanCache,
+)
 from .storage import TpuStorage
 
 __all__ = ["NativeRlsPipeline"]
@@ -74,10 +90,36 @@ class _NsPlan:
         ]
 
 
+class _SubmitShard:
+    """Per-event-loop serving state: the pending queue one loop's
+    handlers append to, plus that loop's flush task and in-flight
+    bookkeeping. Each serving loop (thread) owns exactly one shard; the
+    device lane behind them is shared and ordered by the storage lock."""
+
+    __slots__ = (
+        "loop", "pending", "flush_task", "sem", "inflight",
+        "inflight_batches", "batch_seq",
+    )
+
+    def __init__(self, loop, max_inflight: int):
+        self.loop = loop
+        self.pending: List[Tuple[bytes, asyncio.Future, float, object]] = []
+        self.flush_task: Optional[asyncio.Task] = None
+        self.sem = asyncio.Semaphore(max_inflight)
+        self.inflight: set = set()
+        # seq -> dispatched-but-uncollected batch (for breaker-trip
+        # draining, the MicroBatcher._inflight_batches pattern).
+        self.inflight_batches: Dict[int, list] = {}
+        self.batch_seq = 0
+
+
 class NativeRlsPipeline:
     """Owns the native context and decides batches of raw RLS blobs.
 
-    ``submit(blob)`` resolves to the serialized RateLimitResponse bytes.
+    ``submit(blob)`` returns a future resolving to the serialized
+    RateLimitResponse bytes (plain function — await it from any serving
+    shard's loop). ``submit_async`` is the coroutine form for callers
+    that must schedule cross-thread (the native ingress slow path).
     """
 
     OK_BLOB: bytes
@@ -94,6 +136,7 @@ class NativeRlsPipeline:
         max_delay: float = 0.0005,
         max_batch: int = 8192,
         max_inflight: int = 2,
+        plan_cache_size: int = 1 << 16,
     ):
         if not native.available():
             raise RuntimeError(
@@ -113,6 +156,7 @@ class NativeRlsPipeline:
         ).SerializeToString()
 
         self.limiter = limiter
+        self._tpu = limiter._tpu
         self.storage: TpuStorage = limiter._tpu.inner
         self.metrics = metrics
         if metrics is not None and metrics.custom_label_names:
@@ -127,43 +171,54 @@ class NativeRlsPipeline:
             )
         self.max_delay = max_delay
         self.max_batch = max_batch
-        #: concurrent dispatched-but-uncollected batches; 2 is enough to
-        #: keep the device busy while the host parses the next batch.
+        #: concurrent dispatched-but-uncollected batches PER SHARD; 2 is
+        #: enough to keep the device busy while the host parses the next
+        #: batch.
         self.max_inflight = max_inflight
 
         self.hp = native.HostPath()
         self._interner = self.hp.as_interner()
         self._tracked: Dict[str, int] = {}
         self._plans: Dict[int, Optional[_NsPlan]] = {}  # domain token -> plan
-        # (blob, future, enqueue time, request id) per pending request.
-        self._pending: List[Tuple[bytes, asyncio.Future, float, object]] = []
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        # Hot-descriptor decision-plan cache: raw blob -> DecisionPlan.
+        # Epoch-guarded (invalidate() bumps) and slot-coherent (the slot
+        # table's release hook drops plans pinning a recycled slot).
+        self.plan_cache: Optional[DecisionPlanCache] = (
+            DecisionPlanCache(plan_cache_size) if plan_cache_size > 0
+            else None
+        )
+        # Per-event-loop serving shards (created lazily as loops submit).
+        self._shards: Dict[object, _SubmitShard] = {}
+        self._shards_lock = threading.Lock()
         self._recorder = None  # memoized from the limiter on first sight
-        self._flush_task: Optional[asyncio.Task] = None
         # Dispatch serializes host phases (the C++ context and the slot
         # path are single-threaded by design); collects may overlap.
         self._dispatch_pool = ThreadPoolExecutor(
             1, thread_name_prefix="native-dispatch"
         )
         self._collect_pool = ThreadPoolExecutor(
-            max_inflight, thread_name_prefix="native-collect"
+            max(max_inflight, 2), thread_name_prefix="native-collect"
         )
-        self._inflight: set = set()
-        self._inflight_sem: Optional[asyncio.Semaphore] = None
-        # seq -> dispatched-but-uncollected batch (for breaker-trip
-        # draining, the MicroBatcher._inflight_batches pattern).
-        self._inflight_batches: Dict[int, list] = {}
-        self._batch_seq = 0
         # The C++ context is single-threaded by design; overlapping flushes
         # (timer + max_batch trigger) serialize here.
         self._native_lock = threading.Lock()
+        # host_cache phase split of the most recent begin (telemetry only;
+        # written under _native_lock, read right after on the same thread).
+        self._last_host_cache = 0.0
         #: rebuild the native context when the interner exceeds this many
         #: distinct strings (high-cardinality values must not grow RSS
         #: without bound; device counters are keyed by the Python table, so
         #: a rebuild only costs re-warming the caches).
         self.max_interned = 4 << 20
-        # eviction coherence: python slot release -> native map removal
+        # eviction coherence: python slot release -> native map removal,
+        # and -> plan-cache invalidation (a cached plan must never pin a
+        # recycled slot).
         self.storage._table.on_native_release = self.hp.slots_remove
+        if self.plan_cache is not None:
+            self.storage._table.on_slot_release = (
+                self.plan_cache.invalidate_slot
+            )
+            self.storage._table.on_clear = self.plan_cache.bump_epoch
 
     @property
     def recorder(self):
@@ -179,11 +234,31 @@ class NativeRlsPipeline:
                 self._recorder = rec
         return rec
 
+    @property
+    def _pending(self):
+        """Aggregate pending queue across serving shards (stats/debug
+        surface only — the hot path never builds this list)."""
+        out: list = []
+        for shard in list(self._shards.values()):
+            out.extend(shard.pending)
+        return out
+
     # -- plan management ----------------------------------------------------
 
     def invalidate(self) -> None:
-        """Limits changed: drop all plans (rebuilt lazily)."""
+        """Limits changed: drop all namespace plans (rebuilt lazily) and
+        orphan every cached decision plan (epoch bump) — a limits change
+        can never serve a stale template."""
         self._plans.clear()
+        if self.plan_cache is not None:
+            self.plan_cache.bump_epoch()
+
+    def plan_cache_stats(self) -> dict:
+        return self.plan_cache.stats() if self.plan_cache is not None else {}
+
+    def library_stats(self) -> dict:
+        """Metrics poll surface for the plan_cache_* families."""
+        return dict(self.plan_cache_stats())
 
     def _plan_for(self, domain_token: int) -> Optional[_NsPlan]:
         plan = self._plans.get(domain_token, _MISSING_PLAN)
@@ -229,72 +304,115 @@ class NativeRlsPipeline:
 
     # -- submission ----------------------------------------------------------
 
-    async def submit(self, blob: bytes) -> bytes:
-        self._loop = asyncio.get_running_loop()
-        future = self._loop.create_future()
-        adm = getattr(self.limiter._tpu, "admission", None)
+    def _shard_for(self, loop) -> _SubmitShard:
+        shard = self._shards.get(loop)
+        if shard is not None:
+            return shard
+        with self._shards_lock:
+            shard = self._shards.get(loop)
+            if shard is None:
+                # Prune shards whose loop died so loop churn (tests,
+                # new-loop-per-call embeddings) cannot leak shard
+                # structs for the pipeline's lifetime.
+                for dead in [l for l in self._shards if l.is_closed()]:
+                    del self._shards[dead]
+                shard = _SubmitShard(loop, self.max_inflight)
+                self._shards[loop] = shard
+            return shard
+
+    def submit(self, blob: bytes) -> "asyncio.Future":
+        """Enqueue one raw request on the calling loop's serving shard;
+        returns the future of its response bytes. Plain function — no
+        per-request coroutine, no task: the award of the sharded serving
+        model is that a request costs one future and one list append
+        before the batch machinery takes over."""
+        loop = asyncio.get_running_loop()
+        shard = self._shards.get(loop)
+        if shard is None:
+            shard = self._shard_for(loop)
+        future = loop.create_future()
+        adm = self._tpu.admission
         if adm is not None and adm.use_failover():
             # Device-plane breaker open: exact per-request path, whose
             # storage call lands on the host failover oracle.
             _spawn_detached(self._decide_exact(blob, future))
-            return await future
-        rid = current_request_id() if self.recorder is not None else None
-        self._pending.append((blob, future, time.perf_counter(), rid))
-        if self._flush_task is None or self._flush_task.done():
-            self._flush_task = _spawn_detached(self._flush_soon())
-        if len(self._pending) >= self.max_batch:
-            await self._flush()
-        return await future
+            return future
+        # Timestamp unconditionally (a recorder attached between enqueue
+        # and flush would otherwise read t=0.0 as a process-uptime-sized
+        # queue wait); only the request-id capture is recorder-gated.
+        shard.pending.append((
+            blob, future, time.perf_counter(),
+            current_request_id() if self.recorder is not None else None,
+        ))
+        task = shard.flush_task
+        if task is None or task.done():
+            shard.flush_task = _spawn_detached(self._flush_soon(shard))
+        if len(shard.pending) == self.max_batch:
+            # == not >=: the caller may enqueue a whole burst before the
+            # loop runs any task — one size-flush per threshold crossing,
+            # not one per submit past it.
+            _spawn_detached(self._flush(shard, "size"))
+        return future
 
-    async def _flush_soon(self) -> None:
+    async def submit_async(self, blob: bytes) -> bytes:
+        """Coroutine form of ``submit`` for callers that schedule
+        cross-thread (``run_coroutine_threadsafe`` needs a coroutine)."""
+        return await self.submit(blob)
+
+    async def _flush_soon(self, shard: _SubmitShard) -> None:
         await asyncio.sleep(self.max_delay)
-        await self._flush()
-        if self._pending:
-            self._flush_task = _spawn_detached(self._flush_soon())
+        await self._flush(shard)
+        if shard.pending:
+            shard.flush_task = _spawn_detached(self._flush_soon(shard))
 
-    async def _flush(self, reason: Optional[str] = None) -> None:
-        batch, self._pending = self._pending, []
+    async def _flush(
+        self, shard: _SubmitShard, reason: Optional[str] = None
+    ) -> None:
+        batch, shard.pending = shard.pending, []
         if not batch:
             return
         loop = asyncio.get_running_loop()
-        if self._inflight_sem is None:
-            self._inflight_sem = asyncio.Semaphore(self.max_inflight)
         rec = self.recorder
         t_flush = time.perf_counter()
         batch_id = 0
         if rec is not None:
             batch_id = rec.next_batch_id()
-            rec.record_flush(
-                reason or (
-                    "size" if len(batch) >= self.max_batch else "deadline"
-                ),
-                len(batch) / self.max_batch,
-                [t_flush - t for _b, _f, t, _rid in batch],
-            )
+            try:
+                rec.record_flush(
+                    reason or (
+                        "size" if len(batch) >= self.max_batch
+                        else "deadline"
+                    ),
+                    len(batch) / self.max_batch,
+                    [t_flush - t for _b, _f, t, _rid in batch],
+                )
+            except Exception:
+                pass  # telemetry must never strand a batch's futures
         # Two-phase pipelining (the MicroBatcher pattern): the host phase
-        # (parse -> masks -> slots -> kernel LAUNCH) runs on the dispatch
-        # thread and returns without waiting on the device; the collect
-        # phase (device_get -> resolve futures) runs on collect threads.
-        # Batch N+1's host phase overlaps batch N's device round trip —
-        # on TPU the round trip is the dominant term, so this is where
-        # the serving-path ceiling moves from 8192/RTT to 8192/host-time.
-        await self._inflight_sem.acquire()
+        # (plan cache -> parse -> masks -> slots -> kernel LAUNCH) runs on
+        # the dispatch thread and returns without waiting on the device;
+        # the collect phase (device_get -> resolve futures) runs on collect
+        # threads. Batch N+1's host phase overlaps batch N's device round
+        # trip — on TPU the round trip is the dominant term, so this is
+        # where the serving-path ceiling moves from 8192/RTT to
+        # 8192/host-time.
+        await shard.sem.acquire()
         t_submit = time.perf_counter()
-        adm = getattr(self.limiter._tpu, "admission", None)
+        adm = self._tpu.admission
         token = adm.breaker.batch_started() if adm is not None else 0
-        self._batch_seq += 1
-        seq = self._batch_seq
-        self._inflight_batches[seq] = batch
+        shard.batch_seq += 1
+        seq = shard.batch_seq
+        shard.inflight_batches[seq] = batch
         try:
-            (results, slow_rows, pendings), t_begin, t_staged = (
+            (results, slow_rows, pendings), t_begin, t_staged, t_cache = (
                 await loop.run_in_executor(
                     self._dispatch_pool, self._timed_begin_batch,
                     [b for b, _f, _t, _rid in batch],
                 )
             )
         except Exception as exc:
-            self._inflight_sem.release()
-            self._inflight_batches.pop(seq, None)
+            shard.sem.release()
+            shard.inflight_batches.pop(seq, None)
             if adm is not None:
                 adm.breaker.batch_finished(token, exc)
             for _blob, future, _t, _rid in batch:
@@ -307,18 +425,19 @@ class NativeRlsPipeline:
             _spawn_detached(self._decide_exact(blob, future))
         phases = {
             "dispatch": t_begin - t_submit,
-            "host_stage": t_staged - t_begin,
+            "host_cache": t_cache,
+            "host_stage": (t_staged - t_begin) - t_cache,
         }
         task = loop.run_in_executor(
             self._collect_pool, self._finish_batch, batch, results, pendings,
             batch_id, t_flush, phases,
         )
-        self._inflight.add(task)
+        shard.inflight.add(task)
 
         def _collected(t):
-            self._inflight.discard(t)
-            self._inflight_batches.pop(seq, None)
-            self._inflight_sem.release()
+            shard.inflight.discard(t)
+            shard.inflight_batches.pop(seq, None)
+            shard.sem.release()
             exc = t.exception()
             if adm is not None:
                 adm.breaker.batch_finished(token, exc)
@@ -333,7 +452,9 @@ class NativeRlsPipeline:
 
     def _recycle_context_if_needed(self) -> None:
         """Interner past the cap: swap in a fresh native context. Slot-map
-        entries repopulate lazily through the Python key space."""
+        entries repopulate lazily through the Python key space. Decision
+        plans survive: they pin Python-table slot indices and response
+        templates, neither of which the interner owns."""
         if self.hp.interned_count() <= self.max_interned:
             return
         old = self.hp
@@ -375,7 +496,15 @@ class NativeRlsPipeline:
         for ofs in range(0, len(blobs), chunk):
             part = blobs[ofs:ofs + chunk]
             with self._native_lock:
-                results, _slow, pendings = self._begin_batch_locked(part)
+                # The bulk engine path skips the plan cache: its C++
+                # parse -> mask -> slot lane is already fully vectorized
+                # and beats the cache's per-row Python lookups at these
+                # chunk sizes. The cache pays on the SERVED paths, where
+                # a smaller host phase frees the GIL for the serving
+                # loops (and on slow-host/fast-device boxes generally).
+                results, _slow, pendings = self._begin_batch_locked(
+                    part, use_cache=False
+                )
             window.append((results, pendings))
             if len(window) > max(inflight, 1):
                 collect_oldest()
@@ -388,39 +517,106 @@ class NativeRlsPipeline:
             return self._begin_batch_locked(blobs)
 
     def _timed_begin_batch(self, blobs: List[bytes]):
-        """(begin result, t_start, t_end) — the dispatch-thread host phase
-        with its executor-handoff and staging times exposed."""
+        """(begin result, t_start, t_end, host_cache_seconds) — the
+        dispatch-thread host phase with its executor-handoff, staging and
+        plan-cache times exposed. The host_cache split is read directly
+        after the begin on the same thread; concurrent decide_many
+        callers can at worst skew this telemetry split, never the
+        results."""
         t_start = time.perf_counter()
         out = self._begin_batch(blobs)
-        return out, t_start, time.perf_counter()
+        return out, t_start, time.perf_counter(), self._last_host_cache
 
-    def _begin_batch_locked(self, blobs: List[bytes]):
-        """Host phase: parse, group by namespace, evaluate masks, resolve
-        slots, LAUNCH kernels. Returns (results, slow_rows, pendings)
-        where results rows are filled for everything decided without a
-        kernel, slow_rows lists exact-path rows (left None), and each
-        pending carries an in-flight device result for
-        ``_finish_namespace``."""
-        adm = getattr(self.limiter._tpu, "admission", None)
+    def _begin_batch_locked(self, blobs: List[bytes], use_cache: bool = True):
+        """Host phase: plan-cache lookup, then parse/group/evaluate/slots
+        for the misses, LAUNCH kernels for both lanes. Returns (results,
+        slow_rows, pendings) where results rows are filled for everything
+        decided without a kernel, slow_rows lists exact-path rows (left
+        None), and each pending carries an in-flight device result for
+        ``_finish_namespace``. ``use_cache=False`` (the bulk engine
+        path) skips both lookup and insertion."""
+        adm = self._tpu.admission
         if adm is not None and adm.use_failover():
             # Breaker open: every row takes the exact path (whose
             # storage call fails over to the host oracle) — the
             # columnar path would launch kernels on the dead plane.
+            self._last_host_cache = 0.0
             return [None] * len(blobs), list(range(len(blobs))), []
         self._recycle_context_if_needed()
         n = len(blobs)
-        domains, hits, cols, _ndesc, extra = self.hp.parse_batch(blobs)
-
         results: List[Optional[bytes]] = [None] * n
+        pendings: list = []
+        slow_rows: List[int] = []
+
+        # ---- lane 1: the hot-descriptor plan cache ----------------------
+        cache = self.plan_cache if use_cache else None
+        # Epoch snapshot BEFORE any plan derivation: inserts check it,
+        # so a limits bump racing this batch on another thread discards
+        # the then-stale plans instead of filing them under the new
+        # epoch.
+        cache_epoch = cache.epoch if cache is not None else 0
+        miss_idx: List[int] = []
+        t_cache0 = time.perf_counter()
+        if cache is not None:
+            cached_rows: List[Tuple[int, DecisionPlan]] = []
+            ok_blob = self.OK_BLOB
+            unknown_blob = self.UNKNOWN_BLOB
+            ok_calls: Dict[str, int] = {}
+            ok_hits: Dict[str, int] = {}
+            miss_append = miss_idx.append
+            hit_append = cached_rows.append
+            metrics = self.metrics
+            # The storage lock spans lookup -> launch so a concurrent LRU
+            # eviction cannot recycle a plan-pinned slot in between
+            # (invalidate_slot fires under this same lock).
+            with self.storage._lock:
+                # Raw-dict lookups + one stats call for the whole batch:
+                # a bound-method call and two counter increments per row
+                # taxed the cached lane ~0.7µs/request.
+                get = cache.entries.get
+                for i, blob in enumerate(blobs):
+                    plan = get(blob)
+                    if plan is None:
+                        miss_append(i)
+                    elif plan.kind == PLAN_KERNEL:
+                        hit_append((i, plan))
+                    elif plan.kind == PLAN_OK:
+                        results[i] = ok_blob
+                        ns = plan.namespace
+                        if ns is not None and metrics is not None:
+                            ok_calls[ns] = ok_calls.get(ns, 0) + 1
+                            ok_hits[ns] = ok_hits.get(ns, 0) + plan.delta
+                    else:
+                        results[i] = unknown_blob
+                cache.count(n - len(miss_idx), len(miss_idx))
+                if cached_rows:
+                    pendings.append(self._begin_cached(cached_rows))
+            if metrics is not None:
+                for ns, calls in ok_calls.items():
+                    metrics.incr_authorized_calls(ns, n=calls)
+                    metrics.incr_authorized_hits(ns, ok_hits[ns])
+        else:
+            miss_idx = list(range(n))
+        self._last_host_cache = time.perf_counter() - t_cache0
+        if not miss_idx:
+            return results, slow_rows, pendings
+
+        # ---- lane 2: the miss path (parse -> masks -> slots) ------------
+        full = len(miss_idx) == n
+        sub = blobs if full else [blobs[i] for i in miss_idx]
+        row_map = np.asarray(miss_idx, np.int32)
+        domains, hits, cols, _ndesc, extra = self.hp.parse_batch(sub)
 
         # Group rows by domain token — vectorized: the per-row Python
         # dict/append loop profiled as the single largest host cost of
         # decide_many (131k dict ops per 4x32k rows).
         unknown = domains < 0
         for r in np.nonzero(unknown)[0].tolist():
-            results[r] = self.UNKNOWN_BLOB
+            results[miss_idx[r]] = self.UNKNOWN_BLOB
+            if cache is not None:
+                cache.put(sub[r], _UNKNOWN_PLAN_SINGLETON, cache_epoch)
         slow_mask = np.logical_and(~unknown, extra > 0)
-        slow_rows: List[int] = np.nonzero(slow_mask)[0].tolist()
+        slow_rows.extend(row_map[np.nonzero(slow_mask)[0]].tolist())
         norm_idx = np.nonzero(
             np.logical_and(~unknown, ~slow_mask)
         )[0].astype(np.int32)
@@ -441,22 +637,127 @@ class NativeRlsPipeline:
                     (int(st[a]), si[a:b]) for a, b in zip(starts, ends)
                 ]
 
-        pendings = []
         for token, rows in groups:
             plan = self._plan_for(token)
             if plan is None:
-                slow_rows.extend(rows.tolist())  # results stay None (slow)
+                # results stay None (slow)
+                slow_rows.extend(row_map[rows].tolist())
                 continue
             if not plan.limits_meta:
                 for r in rows.tolist():
-                    results[r] = self.OK_BLOB
+                    results[miss_idx[r]] = self.OK_BLOB
+                    if cache is not None:
+                        # Metrics-free OK (the uncached empty-namespace
+                        # branch counts nothing either): namespace None.
+                        cache.put(
+                            sub[r], _FREE_OK_PLAN_SINGLETON, cache_epoch
+                        )
                 continue
             pending = self._begin_namespace(
-                plan, token, rows, hits, cols, results, blobs
+                plan, token, rows, hits, cols, results, sub, row_map,
+                cache, cache_epoch,
             )
             if pending is not None:
                 pendings.append(pending)
         return results, slow_rows, pendings
+
+    def _begin_cached(self, cached_rows) -> "_CachedPending":
+        """Stage and launch the plan-cache lane: rows grouped by hit
+        arity so a whole group's kernel columns come from ONE
+        ``np.array`` over the plans' flat int records — no per-row numpy
+        work. Caller holds the storage lock."""
+        by_n: Dict[int, list] = {}
+        for pair in cached_rows:
+            by_n.setdefault(pair[1].nhits, []).append(pair)
+        entries: List[Tuple[int, DecisionPlan]] = []
+        slots_p: List[np.ndarray] = []
+        deltas_p: List[np.ndarray] = []
+        maxes_p: List[np.ndarray] = []
+        windows_p: List[np.ndarray] = []
+        bucket_p: List[np.ndarray] = []
+        req_p: List[np.ndarray] = []
+        rid_base = 0
+        for nh in sorted(by_n):
+            group = by_n[nh]
+            k = len(group)
+            # Every record field fits int32 by construction (slots index
+            # the table, maxes/windows are device-capped): convert the
+            # whole group's flat tuples in ONE int32 pass.
+            rec = np.array(
+                [p.record for _r, p in group], np.int32
+            ).reshape(k, nh, 4)
+            slots_p.append(rec[:, :, 0].ravel())
+            maxes_p.append(rec[:, :, 1].ravel())
+            windows_p.append(rec[:, :, 2].ravel())
+            bucket_p.append(rec[:, :, 3].ravel().astype(bool))
+            deltas_p.append(np.repeat(
+                np.array([p.delta_capped for _r, p in group], np.int32), nh
+            ))
+            req_p.append(np.repeat(
+                np.arange(rid_base, rid_base + k, dtype=np.int32), nh
+            ))
+            entries.extend(group)
+            rid_base += k
+        if len(slots_p) == 1:  # common case: uniform hit arity
+            slots, deltas, maxes = slots_p[0], deltas_p[0], maxes_p[0]
+            windows, req, bucket = windows_p[0], req_p[0], bucket_p[0]
+        else:
+            slots = np.concatenate(slots_p)
+            deltas = np.concatenate(deltas_p)
+            maxes = np.concatenate(maxes_p)
+            windows = np.concatenate(windows_p)
+            req = np.concatenate(req_p)
+            bucket = np.concatenate(bucket_p)
+        nhits = slots.shape[0]
+        arrays = self.storage.pad_hits(
+            (slots, deltas, maxes, windows, req,
+             np.zeros(nhits, bool),  # cached slots are live, never fresh
+             bucket),
+            nhits,
+        )
+        inflight = self.storage.begin_check_columnar(*arrays)
+        return _CachedPending(entries, inflight)
+
+    def _finish_cached(self, pending: "_CachedPending", results) -> None:
+        """Collect the plan-cache lane: fill response templates and
+        replicate the uncached lane's metrics exactly (authorized
+        calls/hits per namespace; first failing hit names the limit)."""
+        admitted, hit_ok, _rem, _ttl = self.storage.finish_check_columnar(
+            pending.inflight, with_remaining=False
+        )
+        ok_blob, over_blob = self.OK_BLOB, self.OVER_BLOB
+        metrics = self.metrics
+        entries = pending.entries
+        admitted_l = admitted[:len(entries)].tolist()
+        if metrics is None:
+            for (row, _plan), ok in zip(entries, admitted_l):
+                results[row] = ok_blob if ok else over_blob
+            return
+        ok_calls: Dict[str, int] = {}
+        ok_hits: Dict[str, int] = {}
+        limited: Dict[Tuple[str, Optional[str]], int] = {}
+        base = 0
+        for (row, plan), ok in zip(entries, admitted_l):
+            if ok:
+                results[row] = ok_blob
+                ns = plan.namespace
+                ok_calls[ns] = ok_calls.get(ns, 0) + 1
+                ok_hits[ns] = ok_hits.get(ns, 0) + plan.delta
+            else:
+                results[row] = over_blob
+                name = None
+                for j in range(plan.nhits):
+                    if not hit_ok[base + j]:
+                        name = plan.limit_names[j]
+                        break
+                key = (plan.namespace, name)
+                limited[key] = limited.get(key, 0) + 1
+            base += plan.nhits
+        for ns, calls in ok_calls.items():
+            metrics.incr_authorized_calls(ns, n=calls)
+            metrics.incr_authorized_hits(ns, ok_hits[ns])
+        for (ns, name), count in limited.items():
+            metrics.incr_limited_calls(ns, name, n=count)
 
     def _finish_batch(
         self, batch, results, pendings, batch_id: int = 0,
@@ -471,16 +772,21 @@ class NativeRlsPipeline:
             for pending in pendings:
                 self._finish_namespace(pending, results)
             t_done = time.perf_counter()
-            by_loop: Dict[object, list] = {}
-            for (blob, future, _t, _rid), out in zip(batch, results):
-                # None marks slow-path rows (resolved later); note UNKNOWN
-                # serializes to b"" (all-default proto3), which is a valid
-                # response — only None is the sentinel.
-                if out is not None:
-                    by_loop.setdefault(
-                        future.get_loop(), []).append((future, out))
-            for loop, pairs in by_loop.items():
-                loop.call_soon_threadsafe(_resolve_many, pairs)
+            # None marks slow-path rows (resolved later); note UNKNOWN
+            # serializes to b"" (all-default proto3), which is a valid
+            # response — only None is the sentinel. All futures of a
+            # shard's batch were created on that shard's loop (submit is
+            # loop-affine), so the whole batch resolves with ONE
+            # call_soon_threadsafe.
+            pairs = [
+                (future, out)
+                for (_blob, future, _t, _rid), out in zip(batch, results)
+                if out is not None
+            ]
+            if pairs:
+                pairs[0][0].get_loop().call_soon_threadsafe(
+                    _resolve_many, pairs
+                )
             rec = self.recorder
             if phases is None:
                 return
@@ -500,10 +806,17 @@ class NativeRlsPipeline:
             )
 
     def _begin_namespace(
-        self, plan, token, rows, hits, cols, results, blobs
+        self, plan, token, rows, hits, cols, results, blobs, row_map,
+        cache=None, cache_epoch=0,
     ) -> Optional["_NsPending"]:
+        """rows index into the parse arrays (the miss subset); row_map
+        maps them to positions in the submitted batch, which is what
+        ``results`` rows and pendings speak. ``cache`` is the decision-
+        plan cache to memoize this group's rows into — None on the bulk
+        engine path, which must not pay the per-row insertion loop."""
         rows_arr = np.asarray(rows, np.int32)
         m = rows_arr.shape[0]
+        grows = row_map[rows_arr]  # global (batch) row per group row
         needed = set()
         for cl in plan.compiler.limits:
             needed.update(cl.var_keys)
@@ -530,6 +843,10 @@ class NativeRlsPipeline:
         hit_bucket: List[np.ndarray] = []
         hit_name: List[Tuple[object, np.ndarray]] = []  # (limit, local req idx)
         failed_reqs: set = set()  # local idx whose allocation errored
+        # per-local-row flat plan records (slot, max, win, bucket) in
+        # limit compile order, grown only on the miss path
+        row_recs: Dict[int, list] = {}
+        row_names: Dict[int, list] = {}
 
         # Lookup -> (alloc misses) -> kernel happens under the storage lock
         # so a concurrent LRU eviction cannot recycle a looked-up slot
@@ -564,10 +881,11 @@ class NativeRlsPipeline:
                     bad = slots < 0
                     slots[bad] = self.storage._scratch
                     fresh[bad] = False
-                staged.append((limit, idx, slots, fresh, max_value, window_s))
+                staged.append((limit, idx, slots, fresh, max_value, window_s,
+                               name))
 
             # Phase 2: build hit arrays with failed requests fully voided.
-            for limit, idx, slots, fresh, max_value, window_s in staged:
+            for limit, idx, slots, fresh, max_value, window_s, name in staged:
                 hit_slots.append(slots.astype(np.int32))
                 deltas_l = np.minimum(
                     deltas_req[idx], K.MAX_DELTA_CAP
@@ -589,10 +907,24 @@ class NativeRlsPipeline:
                 hit_fresh.append(fresh)
                 hit_bucket.append(np.full(idx.size, is_bucket, bool))
                 hit_name.append((limit, idx))
+                if cache is not None:
+                    ib = int(is_bucket)
+                    mv = int(max_value)
+                    slots_l = slots.tolist()
+                    for pos, local in enumerate(idx.tolist()):
+                        row_recs.setdefault(local, []).extend(
+                            (slots_l[pos], mv, win, ib)
+                        )
+                        row_names.setdefault(local, []).append(name)
 
             namespace = str(plan.namespace)
+            if cache is not None:
+                self._insert_plans(
+                    cache, cache_epoch, blobs, rows_arr, deltas_req,
+                    failed_reqs, row_recs, row_names, namespace, m,
+                )
             if not hit_slots:
-                for local, r in enumerate(rows):
+                for r in grows.tolist():
                     results[r] = self.OK_BLOB
                 if self.metrics:
                     self.metrics.incr_authorized_calls(namespace, n=m)
@@ -621,12 +953,48 @@ class NativeRlsPipeline:
             )
             inflight = self.storage.begin_check_columnar(*arrays)
         return _NsPending(
-            namespace, rows, deltas_req, failed_reqs, participating,
+            namespace, grows, deltas_req, failed_reqs, participating,
             order, req, hit_name, inflight,
         )
 
-    def _finish_namespace(self, pending: "_NsPending", results) -> None:
-        """Collect one namespace's device result and fill its rows."""
+    def _insert_plans(
+        self, cache, cache_epoch, blobs, rows_arr, deltas_req,
+        failed_reqs, row_recs, row_names, namespace, m,
+    ) -> None:
+        """Memoize this group's miss rows: kernel plans for rows with
+        resolved hits, OK plans for rows no limit applied to. Caller
+        holds the storage lock (slot liveness)."""
+        rows_l = rows_arr.tolist()
+        deltas_l = deltas_req.tolist() if hasattr(
+            deltas_req, "tolist") else list(deltas_req)
+        for local in range(m):
+            if local in failed_reqs:
+                continue
+            delta = int(deltas_l[local])
+            recs = row_recs.get(local)
+            blob = blobs[rows_l[local]]
+            if recs is None:
+                cache.put(blob, DecisionPlan(
+                    PLAN_OK, namespace=namespace, delta=delta,
+                ), cache_epoch)
+            else:
+                record = tuple(recs)
+                cache.put(blob, DecisionPlan(
+                    PLAN_KERNEL,
+                    namespace=namespace,
+                    delta=delta,
+                    delta_capped=min(delta, K.MAX_DELTA_CAP),
+                    record=record,
+                    limit_names=tuple(row_names[local]),
+                    slots=record[0::4],
+                ), cache_epoch)
+
+    def _finish_namespace(self, pending, results) -> None:
+        """Collect one pending's device result and fill its rows (both
+        the miss-lane namespace pendings and the plan-cache lane)."""
+        if type(pending) is _CachedPending:
+            self._finish_cached(pending, results)
+            return
         namespace = pending.namespace
         rows = pending.rows
         deltas_req = pending.deltas_req
@@ -750,28 +1118,45 @@ class NativeRlsPipeline:
         through the exact per-request path (which lands on the host
         failover oracle); dispatched-but-uncollected batches fail with
         ``exc``. ``decider`` is unused — the exact path already decides
-        through the storage's failover branch. Thread-safe."""
-        loop = self._loop
-        if loop is None or loop.is_closed():
-            return
+        through the storage's failover branch. Thread-safe; fans out to
+        every serving shard's loop."""
+        for shard in list(self._shards.values()):
+            loop = shard.loop
+            if loop is None or loop.is_closed():
+                continue
 
-        def _drain():
-            batch, self._pending = self._pending, []
-            for blob, future, _t, _rid in batch:
-                if not future.done():
-                    _spawn_detached(self._decide_exact(blob, future))
-            for stuck in list(self._inflight_batches.values()):
-                for _blob, future, _t, _rid in stuck:
+            def _drain(shard=shard):
+                batch, shard.pending = shard.pending, []
+                for blob, future, _t, _rid in batch:
                     if not future.done():
-                        future.set_exception(exc)
+                        _spawn_detached(self._decide_exact(blob, future))
+                for stuck in list(shard.inflight_batches.values()):
+                    for _blob, future, _t, _rid in stuck:
+                        if not future.done():
+                            future.set_exception(exc)
 
-        loop.call_soon_threadsafe(_drain)
+            try:
+                loop.call_soon_threadsafe(_drain)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
+
+    async def _close_shard(self, shard: _SubmitShard) -> None:
+        await self._flush(shard, "shutdown")
+        if shard.inflight:
+            await asyncio.gather(*shard.inflight, return_exceptions=True)
 
     async def close(self) -> None:
-        if self._flush_task is not None:
-            await self._flush("shutdown")
-        if self._inflight:
-            await asyncio.gather(*self._inflight, return_exceptions=True)
+        cur = asyncio.get_running_loop()
+        for shard in list(self._shards.values()):
+            if shard.loop is cur:
+                await self._close_shard(shard)
+            elif not shard.loop.is_closed() and shard.loop.is_running():
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._close_shard(shard), shard.loop
+                    ).result(timeout=10)
+                except Exception:
+                    pass  # shard loop died mid-shutdown: futures are gone
         self._dispatch_pool.shutdown(wait=False)
         self._collect_pool.shutdown(wait=False)
 
@@ -817,7 +1202,7 @@ def _resolve_many(pairs) -> None:
 class _NsPending:
     """One namespace's launched-but-uncollected kernel: everything
     ``_finish_namespace`` needs to turn the device result into response
-    blobs and metrics."""
+    blobs and metrics. ``rows`` are batch-global row indices."""
 
     __slots__ = (
         "namespace", "rows", "deltas_req", "failed_reqs", "participating",
@@ -839,6 +1224,17 @@ class _NsPending:
         self.inflight = inflight
 
 
+class _CachedPending:
+    """The plan-cache lane's launched-but-uncollected kernel: entries in
+    kernel request-id order, each (batch row, DecisionPlan)."""
+
+    __slots__ = ("entries", "inflight")
+
+    def __init__(self, entries, inflight):
+        self.entries = entries
+        self.inflight = inflight
+
+
 class _Missing:
     pass
 
@@ -846,3 +1242,6 @@ class _Missing:
 _MISSING_PLAN = _Missing()
 _STORAGE_ERROR = _Missing()
 NativeRlsPipeline.STORAGE_ERROR = _STORAGE_ERROR
+#: shared trivial plans (stateless: no slots, no metrics mutation)
+_UNKNOWN_PLAN_SINGLETON = DecisionPlan(PLAN_UNKNOWN)
+_FREE_OK_PLAN_SINGLETON = DecisionPlan(PLAN_OK, namespace=None)

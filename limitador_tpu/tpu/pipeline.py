@@ -11,11 +11,25 @@ counters go through the same exact device kernel as the per-request path.
 same semantics (the compiler is equivalence-tested against the CEL
 interpreter), same storage. Namespace compilers rebuild lazily whenever
 that namespace's limits change.
+
+Two serving-path additions close the served/engine gap (ISSUE 3):
+
+- **Counter-plan cache**: repeat (namespace, descriptor-values)
+  identities skip CEL evaluation and Counter construction entirely —
+  the resolved Counter list is memoized under a limits epoch that every
+  add/update/delete/reload bumps (qualified-counter identity caching on
+  the gRPC path).
+- **Per-loop serving shards**: the pending queue, flush task and
+  in-flight window are sharded per event loop, so N serving loops
+  (threads) feed the one device lane concurrently; ``submit_check`` is
+  the plain-function fast lane returning the decision future without a
+  per-request coroutine.
 """
 
 from __future__ import annotations
 
 import asyncio
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple, Union
@@ -28,6 +42,7 @@ from ..observability.device_plane import current_request_id
 from ..observability.tracing import datastore_span, device_batch_span
 from .batcher import AsyncTpuStorage, _latency_hists, _timed_call
 from .compiler import NamespaceCompiler
+from .plan_cache import CounterPlanCache
 
 __all__ = ["CompiledTpuLimiter"]
 
@@ -46,6 +61,29 @@ class _RawPending:
         self.future = future
         self.t_enq = t_enq
         self.rid = rid
+
+
+class _LoopShard:
+    """Per-event-loop serving state (pending queue + flush machinery).
+    Each serving loop owns one; the compiler cache, limits registry and
+    device lane behind them are shared."""
+
+    __slots__ = (
+        "loop", "pending", "flush_task", "sem", "inflight",
+        "inflight_pendings", "batch_seq",
+    )
+
+    def __init__(self, loop, max_inflight: int):
+        self.loop = loop
+        self.pending: List[_RawPending] = []
+        self.flush_task: Optional[asyncio.Task] = None
+        self.sem = asyncio.Semaphore(max_inflight)
+        self.inflight: set = set()
+        # seq -> the _RawPendings of a dispatched-but-uncollected batch,
+        # so an admission-plane breaker trip can fail them off the dead
+        # plane (mirrors MicroBatcher._inflight_batches).
+        self.inflight_pendings: Dict[int, list] = {}
+        self.batch_seq = 0
 
 
 def _values_of(
@@ -79,7 +117,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
 
     reports_datastore_latency = False
 
-    def __init__(self, storage: Optional[AsyncTpuStorage] = None, **kwargs):
+    def __init__(
+        self,
+        storage: Optional[AsyncTpuStorage] = None,
+        plan_cache_size: int = 1 << 16,
+        **kwargs,
+    ):
         super().__init__(storage or AsyncTpuStorage(**kwargs))
         self._metrics = None
         # Device-plane telemetry sink, shared with the wrapped storage's
@@ -91,55 +134,86 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         self._tpu: AsyncTpuStorage = self.storage.counters
         self._compilers: Dict[Namespace, NamespaceCompiler] = {}
         self._rev: Dict[Namespace, List[str]] = {}
-        self._pending: List[_RawPending] = []
-        self._loop: Optional[asyncio.AbstractEventLoop] = None
-        # seq -> the _RawPendings of a dispatched-but-uncollected batch,
-        # so an admission-plane breaker trip can fail them off the dead
-        # plane (mirrors MicroBatcher._inflight_batches).
-        self._inflight_pendings: Dict[int, list] = {}
-        self._batch_seq = 0
-        self._flush_task: Optional[asyncio.Task] = None
+        # Epoch-guarded (namespace, values) -> Counter-list memo; every
+        # limits change bumps the epoch, orphaning all entries.
+        self.counters_cache: Optional[CounterPlanCache] = (
+            CounterPlanCache(plan_cache_size) if plan_cache_size > 0
+            else None
+        )
+        self._shards: Dict[object, _LoopShard] = {}
+        self._shards_lock = threading.Lock()
+        # Serializes compiler-cache access + columnar evaluation across
+        # serving shards: NamespaceCompiler's interner is a
+        # check-then-act (token = len(strings)) that two shard loops
+        # evaluating concurrently could double-assign, aliasing two
+        # descriptor values onto one token — i.e. one user's traffic
+        # debiting another's counter. Only cache MISSES pay this lock.
+        self._eval_lock = threading.Lock()
         self.max_delay = self._tpu.batcher.max_delay
         self.max_batch = 4096
-        #: dispatched-but-uncollected batches (the MicroBatcher pattern):
-        #: batch N+1's evaluate + kernel launch overlaps batch N's
-        #: device round trip.
+        #: dispatched-but-uncollected batches PER SHARD (the MicroBatcher
+        #: pattern): batch N+1's evaluate + kernel launch overlaps batch
+        #: N's device round trip.
         self.max_inflight = 2
         self._dispatch_pool = ThreadPoolExecutor(
             1, thread_name_prefix="compiled-dispatch"
         )
         self._collect_pool = ThreadPoolExecutor(
-            self.max_inflight, thread_name_prefix="compiled-collect"
+            max(self.max_inflight, 2), thread_name_prefix="compiled-collect"
         )
-        self._inflight: set = set()
-        self._inflight_sem: Optional[asyncio.Semaphore] = None
+
+    @property
+    def _pending(self):
+        """Aggregate pending across serving shards (stats/debug only)."""
+        out: list = []
+        for shard in list(self._shards.values()):
+            out.extend(shard.pending)
+        return out
 
     # -- compiler cache invalidation ----------------------------------------
+    #
+    # Ordering + locking contract (serving shards): invalidation runs
+    # AFTER the registry mutation and takes _eval_lock. A shard's miss
+    # evaluation holds _eval_lock from reading get_limits to installing
+    # the built compiler, so by the time the invalidate acquires the
+    # lock, any compiler built from the pre-mutation registry is already
+    # installed — and gets popped here; any compiler built after the pop
+    # reads the post-mutation registry. (Invalidate-before-mutation
+    # would leave a window where a shard installs a stale compiler
+    # after the pop, serving retired limits indefinitely.)
 
     def _invalidate(self, namespace: Namespace) -> None:
-        self._retire_compiler(self._compilers.pop(namespace, None))
+        with self._eval_lock:
+            self._retire_compiler(self._compilers.pop(namespace, None))
+            if self.counters_cache is not None:
+                self.counters_cache.bump_epoch()
 
     def add_limit(self, limit: Limit) -> bool:
+        added = super().add_limit(limit)
         self._invalidate(limit.namespace)
-        return super().add_limit(limit)
+        return added
 
     def update_limit(self, limit: Limit) -> bool:
+        updated = super().update_limit(limit)
         self._invalidate(limit.namespace)
-        return super().update_limit(limit)
+        return updated
 
     async def delete_limit(self, limit: Limit) -> None:
-        self._invalidate(limit.namespace)
         await super().delete_limit(limit)
+        self._invalidate(limit.namespace)
 
     async def delete_limits(self, namespace) -> None:
-        self._invalidate(Namespace.of(namespace))
         await super().delete_limits(namespace)
+        self._invalidate(Namespace.of(namespace))
 
     async def configure_with(self, limits) -> None:
-        for compiler in self._compilers.values():
-            self._retire_compiler(compiler)
-        self._compilers.clear()
         await super().configure_with(limits)
+        with self._eval_lock:
+            for compiler in self._compilers.values():
+                self._retire_compiler(compiler)
+            self._compilers.clear()
+            if self.counters_cache is not None:
+                self.counters_cache.bump_epoch()
 
     def set_metrics(self, metrics) -> None:
         """Report device-batch datastore latency + compiler eval counters
@@ -157,6 +231,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             self._retired_vec_evals += compiler.vectorized_evals
             self._retired_fb_evals += compiler.fallback_evals
 
+    def plan_cache_stats(self) -> dict:
+        return (
+            self.counters_cache.stats()
+            if self.counters_cache is not None else {}
+        )
+
     def library_stats(self) -> dict:
         stats = (
             self._tpu.library_stats()
@@ -170,6 +250,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         stats["cel_vectorized_evals"] = vec
         stats["cel_fallback_evals"] = fb
         stats["queue_depth"] = stats.get("queue_depth", 0) + len(self._pending)
+        stats.update(self.plan_cache_stats())
         return stats
 
     def device_stats(self) -> dict:
@@ -184,6 +265,53 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         return compiler
 
     # -- the batched hot path -------------------------------------------------
+
+    def _shard_for(self, loop) -> _LoopShard:
+        shard = self._shards.get(loop)
+        if shard is not None:
+            return shard
+        with self._shards_lock:
+            shard = self._shards.get(loop)
+            if shard is None:
+                # Prune shards whose loop died so loop churn cannot
+                # leak shard structs for the limiter's lifetime.
+                for dead in [l for l in self._shards if l.is_closed()]:
+                    del self._shards[dead]
+                shard = _LoopShard(loop, self.max_inflight)
+                self._shards[loop] = shard
+            return shard
+
+    def submit_check(
+        self,
+        namespace: Namespace,
+        values: Dict[str, str],
+        delta: int,
+        load_counters: bool = False,
+    ) -> "asyncio.Future":
+        """Sync fast lane: enqueue one compiled-shape check on the
+        calling loop's shard; returns the CheckResult future. One future
+        + one append per request — no per-request coroutine."""
+        loop = asyncio.get_running_loop()
+        shard = self._shards.get(loop)
+        if shard is None:
+            shard = self._shard_for(loop)
+        future = loop.create_future()
+        # Timestamp unconditionally (a recorder attached between enqueue
+        # and flush would otherwise read t_enq=0.0 as a huge queue
+        # wait); only the request-id capture is recorder-gated.
+        shard.pending.append(_RawPending(
+            namespace, values, delta, load_counters, future,
+            time.perf_counter(),
+            current_request_id() if self.recorder is not None else None,
+        ))
+        task = shard.flush_task
+        if task is None or task.done():
+            shard.flush_task = loop.create_task(self._flush_soon(shard))
+        if len(shard.pending) == self.max_batch:
+            # == not >=: one size-flush per threshold crossing, not one
+            # per submit past it (bursts enqueue before the loop runs).
+            loop.create_task(self._flush(shard, "size"))
+        return future
 
     async def check_rate_limited_and_update(
         self,
@@ -212,66 +340,60 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             return await super().check_rate_limited_and_update(
                 namespace, ctx, delta, load_counters
             )
-        self._loop = asyncio.get_running_loop()
-        future = asyncio.get_running_loop().create_future()
-        rid = current_request_id() if self.recorder is not None else None
-        self._pending.append(
-            _RawPending(
-                namespace, values, delta, load_counters, future,
-                time.perf_counter(), rid,
-            )
-        )
-        if self._flush_task is None or self._flush_task.done():
-            self._flush_task = asyncio.get_running_loop().create_task(
-                self._flush_soon()
-            )
         # The wait for the batched device decision IS this request's
         # datastore time: a record span here rolls it up under the
         # should_rate_limit aggregate (queue/linger counts as idle, the
         # reference's semantics for awaited storage futures).
         with datastore_span("check_and_update"):
-            if len(self._pending) >= self.max_batch:
-                await self._flush()
-            return await future
-
-    async def _flush_soon(self) -> None:
-        await asyncio.sleep(self.max_delay)
-        await self._flush()
-        # Requests that arrived while the flush was busy on the device must
-        # not wait for the NEXT submission to schedule a timer — re-arm
-        # unconditionally (this coroutine IS the current _flush_task, so a
-        # done() check here would always see itself as running).
-        if self._pending:
-            self._flush_task = asyncio.get_running_loop().create_task(
-                self._flush_soon()
+            return await self.submit_check(
+                namespace, values, delta, load_counters
             )
 
-    async def _flush(self, reason: Optional[str] = None) -> None:
-        batch, self._pending = self._pending, []
+    async def _flush_soon(self, shard: _LoopShard) -> None:
+        await asyncio.sleep(self.max_delay)
+        await self._flush(shard)
+        # Requests that arrived while the flush was busy on the device must
+        # not wait for the NEXT submission to schedule a timer — re-arm
+        # unconditionally (this coroutine IS the current flush_task, so a
+        # done() check here would always see itself as running).
+        if shard.pending:
+            shard.flush_task = asyncio.get_running_loop().create_task(
+                self._flush_soon(shard)
+            )
+
+    async def _flush(
+        self, shard: _LoopShard, reason: Optional[str] = None
+    ) -> None:
+        batch, shard.pending = shard.pending, []
         if not batch:
             return
         loop = asyncio.get_running_loop()
-        if self._inflight_sem is None:
-            self._inflight_sem = asyncio.Semaphore(self.max_inflight)
         rec = self.recorder
         t_flush = time.perf_counter()
         batch_id = 0
         if rec is not None:
             batch_id = rec.next_batch_id()
-            rec.record_flush(
-                reason or (
-                    "size" if len(batch) >= self.max_batch else "deadline"
-                ),
-                len(batch) / self.max_batch,
-                [t_flush - p.t_enq for p in batch],
-            )
+            try:
+                rec.record_flush(
+                    reason or (
+                        "size" if len(batch) >= self.max_batch
+                        else "deadline"
+                    ),
+                    len(batch) / self.max_batch,
+                    [t_flush - p.t_enq for p in batch],
+                )
+            except Exception:
+                pass  # telemetry must never strand a batch's futures
         live: List[Tuple[_RawPending, List[Counter]]] = []
         try:
-            # Columnar evaluation stays ON the loop thread: the compiler
-            # cache and the limits registry are only ever touched here,
-            # so a concurrent limits reload cannot hand a batch a
-            # half-rebuilt plan. Only the kernel launch (dispatch thread,
-            # launch order = device program order) and the device
+            # Columnar evaluation stays ON the serving loop thread: the
+            # counters cache absorbs repeat identities; misses touch the
+            # compiler cache and limits registry, whose mutation sites
+            # (limits reload) run on the main loop — a concurrent reload
+            # races a shard's batch only into deciding with the
+            # just-retired limits, the same window a batch flushed
+            # moments earlier had. Only the kernel launch (dispatch
+            # thread, launch order = device program order) and the device
             # transfer (collect threads) go off-loop — that's where the
             # round-trip time lives.
             from .storage import _Request
@@ -287,7 +409,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 return
             reqs = [_Request(c, p.delta, p.load) for p, c in live]
             t_eval = time.perf_counter()
-            await self._inflight_sem.acquire()
+            await shard.sem.acquire()
         except BaseException as exc:
             # Nothing may escape silently: an exception (INCLUDING a
             # cancellation of the submitter awaiting this flush) lost here
@@ -297,17 +419,17 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         t_submit = time.perf_counter()
         adm = getattr(self._tpu, "admission", None)
         token = adm.breaker.batch_started() if adm is not None else 0
-        self._batch_seq += 1
-        seq = self._batch_seq
-        self._inflight_pendings[seq] = [p for p, _c in live]
+        shard.batch_seq += 1
+        seq = shard.batch_seq
+        shard.inflight_pendings[seq] = [p for p, _c in live]
         try:
             handle, t_begin, t_launch = await loop.run_in_executor(
                 self._dispatch_pool, _timed_call,
                 self._tpu.inner.begin_check_many, reqs,
             )
         except BaseException as exc:
-            self._inflight_sem.release()
-            self._inflight_pendings.pop(seq, None)
+            shard.sem.release()
+            shard.inflight_pendings.pop(seq, None)
             if adm is not None:
                 adm.breaker.batch_finished(token, exc)
             _fail_futures([p for p, _c in live], exc)
@@ -328,12 +450,12 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             self._collect_pool, self._collect_batch, handle, live, t0,
             batch_id, t_flush, phases,
         )
-        self._inflight.add(task)
+        shard.inflight.add(task)
 
         def _collected(t):
-            self._inflight.discard(t)
-            self._inflight_pendings.pop(seq, None)
-            self._inflight_sem.release()
+            shard.inflight.discard(t)
+            shard.inflight_pendings.pop(seq, None)
+            shard.sem.release()
             exc = t.exception()
             if adm is not None:
                 adm.breaker.batch_finished(token, exc)
@@ -382,31 +504,67 @@ class CompiledTpuLimiter(AsyncRateLimiter):
     def _evaluate_batch(
         self, batch: List[_RawPending]
     ) -> List[Tuple[_RawPending, List[Counter]]]:
-        # Group by namespace; one columnar evaluation each.
+        # Counter-plan cache first: repeat (namespace, values) identities
+        # reuse their resolved Counter list and skip CEL entirely. Only
+        # load_counters=False traffic is cacheable (loads mutate
+        # per-counter observability fields on what would be shared
+        # objects).
+        cache = self.counters_cache
+        requests: List[Tuple[_RawPending, List[Counter]]] = []
+        misses: List[Tuple[_RawPending, Optional[tuple]]] = []
+        if cache is None:
+            misses = [(p, None) for p in batch]
+        else:
+            get = cache.get
+            for p in batch:
+                if p.load:
+                    misses.append((p, None))
+                    continue
+                key = (p.namespace, tuple(p.values.items()))
+                counters = get(key)
+                if counters is None:
+                    misses.append((p, key))
+                else:
+                    requests.append((p, counters))
+        if not misses:
+            return requests
+
+        # Group misses by namespace; one columnar evaluation each.
         by_ns: Dict[Namespace, List[int]] = {}
-        for i, p in enumerate(batch):
+        for i, (p, _key) in enumerate(misses):
             by_ns.setdefault(p.namespace, []).append(i)
 
-        requests: List[Tuple[_RawPending, List[Counter]]] = []
+        # Epoch snapshot BEFORE evaluation: put discards on mismatch, so
+        # a limits bump racing this batch on another thread can never
+        # file a stale counter plan under the new epoch.
+        epoch = cache.epoch if cache is not None else 0
         src_cache: Dict[Limit, List[str]] = {}
-        for namespace, idxs in by_ns.items():
-            compiler = self._compiler_for(namespace)
-            evaluated = compiler.evaluate([batch[i].values for i in idxs])
-            strings = compiler.interner.strings
-            for i, hits in zip(idxs, evaluated):
-                counters = []
-                for limit, tokens in hits:
-                    var_sources = src_cache.get(limit)
-                    if var_sources is None:
-                        # limit.variables is already source-sorted
-                        var_sources = [v.source for v in limit.variables]
-                        src_cache[limit] = var_sources
-                    set_vars = {
-                        src: strings[tok]
-                        for src, tok in zip(var_sources, tokens)
-                    }
-                    counters.append(Counter(limit, set_vars))
-                requests.append((batch[i], counters))
+        with self._eval_lock:
+            for namespace, idxs in by_ns.items():
+                compiler = self._compiler_for(namespace)
+                evaluated = compiler.evaluate(
+                    [misses[i][0].values for i in idxs]
+                )
+                strings = compiler.interner.strings
+                for i, hits in zip(idxs, evaluated):
+                    counters = []
+                    for limit, tokens in hits:
+                        var_sources = src_cache.get(limit)
+                        if var_sources is None:
+                            # limit.variables is already source-sorted
+                            var_sources = [
+                                v.source for v in limit.variables
+                            ]
+                            src_cache[limit] = var_sources
+                        set_vars = {
+                            src: strings[tok]
+                            for src, tok in zip(var_sources, tokens)
+                        }
+                        counters.append(Counter(limit, set_vars))
+                    p, key = misses[i]
+                    if key is not None and cache is not None:
+                        cache.put(key, counters, epoch)
+                    requests.append((p, counters))
         return requests
 
     def fail_over_queued(self, decider, exc) -> None:
@@ -414,46 +572,66 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         host-side through ``decider(counters, delta, load) ->
         Authorization`` and fail dispatched-but-uncollected batches with
         ``exc`` (their kernel may already have run). Thread-safe — the
-        trip listener can fire from a collect thread; the drain runs on
-        the serving loop, where the compiler cache and limits registry
-        are safe to touch (the ``_flush`` discipline)."""
-        loop = self._loop
-        if loop is None or loop.is_closed():
-            return
+        trip listener can fire from a collect thread; each shard's drain
+        runs on its own serving loop, where that shard's queue is safe
+        to touch (the ``_flush`` discipline)."""
+        for shard in list(self._shards.values()):
+            loop = shard.loop
+            if loop is None or loop.is_closed():
+                continue
 
-        def _drain():
-            batch, self._pending = self._pending, []
-            if batch:
-                try:
-                    evaluated = self._evaluate_batch(batch)
-                except Exception as eexc:
-                    _fail_futures(batch, eexc)
-                    evaluated = []
-                for p, counters in evaluated:
-                    if p.future.done():
-                        continue
+            def _drain(shard=shard):
+                batch, shard.pending = shard.pending, []
+                if batch:
                     try:
-                        if not counters:
-                            p.future.set_result(CheckResult(False, [], None))
-                        else:
-                            auth = decider(counters, p.delta, p.load)
-                            p.future.set_result(CheckResult(
-                                auth.limited,
-                                counters if p.load else [],
-                                auth.limit_name,
-                            ))
-                    except Exception as dexc:
-                        p.future.set_exception(dexc)
-            for pendings in list(self._inflight_pendings.values()):
-                _fail_futures(pendings, exc)
+                        evaluated = self._evaluate_batch(batch)
+                    except Exception as eexc:
+                        _fail_futures(batch, eexc)
+                        evaluated = []
+                    for p, counters in evaluated:
+                        if p.future.done():
+                            continue
+                        try:
+                            if not counters:
+                                p.future.set_result(
+                                    CheckResult(False, [], None)
+                                )
+                            else:
+                                auth = decider(counters, p.delta, p.load)
+                                p.future.set_result(CheckResult(
+                                    auth.limited,
+                                    counters if p.load else [],
+                                    auth.limit_name,
+                                ))
+                        except Exception as dexc:
+                            p.future.set_exception(dexc)
+                for pendings in list(shard.inflight_pendings.values()):
+                    _fail_futures(pendings, exc)
 
-        loop.call_soon_threadsafe(_drain)
+            try:
+                loop.call_soon_threadsafe(_drain)
+            except RuntimeError:
+                pass  # loop closed between the check and the call
+
+    async def _close_shard(self, shard: _LoopShard) -> None:
+        await self._flush(shard, "shutdown")
+        if shard.inflight:
+            await asyncio.gather(*shard.inflight, return_exceptions=True)
 
     async def close(self) -> None:
-        """Drain in-flight collects and release the worker pools."""
-        await self._flush("shutdown")
-        if self._inflight:
-            await asyncio.gather(*self._inflight, return_exceptions=True)
+        """Drain in-flight collects on every shard and release the
+        worker pools."""
+        cur = asyncio.get_running_loop()
+        for shard in list(self._shards.values()):
+            if shard.loop is cur:
+                await self._close_shard(shard)
+            elif not shard.loop.is_closed() and shard.loop.is_running():
+                try:
+                    asyncio.run_coroutine_threadsafe(
+                        self._close_shard(shard), shard.loop
+                    ).result(timeout=10)
+                except Exception:
+                    pass  # shard loop died mid-shutdown
         self._dispatch_pool.shutdown(wait=False)
         self._collect_pool.shutdown(wait=False)
 

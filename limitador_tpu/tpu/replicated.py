@@ -89,6 +89,30 @@ def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
     return K.CounterTableState(nv, ne), K.BatchResult(admitted, ok, remaining, ttl)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _replicated_update(state, remote_exp, slots, deltas, windows_ms, fresh,
+                       bucket, now_ms):
+    """Unconditional updates over the merged bucket state: the gossiped
+    remote TAT folds in as a floor on the local TAT before the advance,
+    so the Report role persists the shared-bucket join exactly like the
+    check path does (no briefly-under-counted window between a replayed
+    update and the next admitted check). Fixed windows are untouched —
+    remote window counts are additive, not a joinable lane."""
+    order = K.jnp.argsort(slots, stable=True)
+    s_bucket = bucket[order]
+
+    def tat_floor_hook(s_slot):
+        # remote_exp holds the max-merged remote TAT for bucket slots
+        # (epoch-relative ms, refreshed at gossip/flush time)
+        return K.jnp.where(s_bucket, remote_exp[s_slot], 0)
+
+    nv, ne = K.update_core(
+        state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
+        bucket, now_ms, tat_floor_hook=tat_floor_hook,
+    )
+    return K.CounterTableState(nv, ne)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _apply_remote(remote_vals, remote_exp, slots, sums, expiries):
     return (
@@ -109,12 +133,12 @@ class TpuReplicatedStorage(TpuStorage):
     # and persists the join; cross-node over-admission is bounded by what
     # peers admit within one gossip period (concurrent spends collapse to
     # their max at merge), the same bounded-inaccuracy contract as the
-    # fixed-window read-as-sum. One documented divergence: the
-    # UNCONDITIONAL update path (update_counter / apply_deltas — the
-    # Report role) advances the local TAT without folding the remote
-    # floor (update_core takes no hook); the remote cap still applies at
-    # every CHECK, and the join repairs at the next admitted check or
-    # gossip merge — same bounded window as above.
+    # fixed-window read-as-sum. The UNCONDITIONAL update path
+    # (update_counter / apply_deltas — the Report role and redis_import
+    # replay) folds the same remote floor via _kernel_update /
+    # _replicated_update, so replayed traffic persists the shared-bucket
+    # join instead of briefly under-counting until the next admitted
+    # check or gossip merge (the divergence ADVICE r5 called out).
     supports_token_bucket = True
 
     def __init__(
@@ -179,6 +203,16 @@ class TpuReplicatedStorage(TpuStorage):
             slots, deltas, maxes, windows, req, fresh, bucket, now_ms,
         )
         return state, result
+
+    def _kernel_update(self, slots, deltas, windows, fresh, bucket, now_ms):
+        # The unconditional path folds the gossiped remote TAT floor the
+        # same way the check path does (shared-bucket join persists on
+        # Report-role / replay traffic too).
+        self._flush_dirty_remote()
+        return _replicated_update(
+            self._state, self._remote_exp, slots, deltas, windows, fresh,
+            bucket, now_ms,
+        )
 
     def _slot_for(self, counter: Counter, create: bool):
         slot, fresh = super()._slot_for(counter, create)

@@ -80,7 +80,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             and counter.namespace in self._global_ns
         ):
             return True
-        return _BigLimitMixin._is_big(counter)
+        return _BigLimitMixin._is_big(self, counter)
 
     def __init__(
         self,

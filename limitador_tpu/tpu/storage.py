@@ -68,6 +68,20 @@ def _clamp_window_ms(seconds: int) -> int:
     return min(seconds * 1000, K.WINDOW_MS_CAP)
 
 
+def _staged(values, H: int, fill, dtype) -> np.ndarray:
+    """Right-sized staging array: prefix from a Python list (one C-level
+    conversion), padding filled with the inert default — replaces the
+    ``np.asarray(list + [pad] * k)`` pattern that built a second
+    H-element Python list per column per batch."""
+    arr = np.empty(H, dtype)
+    n = len(values)
+    if n:
+        arr[:n] = values
+    if n < H:
+        arr[n:] = fill
+    return arr
+
+
 def _hit_lane(counter: Counter) -> Tuple[int, bool]:
     """Per-hit (windows_ms lane, bucket flag) for a device-eligible
     counter: the window for fixed windows, the GCRA emission interval
@@ -105,6 +119,12 @@ class _SlotTable:
         # slot -> native composite key + removal hook (native fast path)
         self.native_keys: Dict[int, object] = {}
         self.on_native_release = None
+        # Decision-plan cache coherence (tpu/plan_cache.py): every slot
+        # release fires on_slot_release(slot) so cached plans pinning the
+        # slot are dropped before it can be recycled; wholesale table
+        # swaps (clear/snapshot-restore) fire on_clear instead.
+        self.on_slot_release = None
+        self.on_clear = None
         # Device-plane telemetry (device_stats()): cumulative counts of
         # LRU evictions and of fresh allocations that recycled a
         # previously-occupied slot (the kernel's fresh flag overrides the
@@ -174,6 +194,8 @@ class _SlotTable:
         native_key = self.native_keys.pop(slot, None)
         if native_key is not None and self.on_native_release is not None:
             self.on_native_release(native_key)
+        if self.on_slot_release is not None:
+            self.on_slot_release(slot)
 
 
 class _BigLimitMixin:
@@ -194,19 +216,50 @@ class _BigLimitMixin:
         )
         self._big_inflight: Dict[tuple, int] = {}
         self._big_cap = max(int(cap), 1)
+        # Per-limit routing memos: is-big and the (window_ms, bucket)
+        # hit lane are pure functions of (limit identity, max_value),
+        # re-derived on every hit before — the two getattr/compare
+        # chains profiled in the host_stage phase. max_value is NOT part
+        # of Limit identity (an update_limit may change only it), so it
+        # rides in the key explicitly. Bounded: pruned wholesale past a
+        # cap (limits registries are small; churn only comes from
+        # reload loops).
+        self._big_flags: Dict[tuple, bool] = {}
+        self._lanes: Dict[tuple, Tuple[int, bool]] = {}
 
-    @staticmethod
-    def _is_big(counter: Counter) -> bool:
+    def _is_big(self, counter: Counter) -> bool:
         # Token buckets run ON DEVICE (a TAT cell in the expiry lane,
         # ops/kernel.py bucket lane) whenever the int32-ms representation
         # fits; only finer-tick / beyond-cap buckets ride the exact host
         # path, same as beyond-cap fixed windows.
-        if counter.limit.policy == "token_bucket":
-            return not device_eligible(
-                counter.max_value, counter.window_seconds,
-                K.MAX_VALUE_CAP, K.WINDOW_MS_CAP,
-            )
-        return counter.max_value > K.MAX_VALUE_CAP
+        limit = counter.limit
+        key = (limit, limit.max_value)
+        flag = self._big_flags.get(key)
+        if flag is None:
+            if limit.policy == "token_bucket":
+                flag = not device_eligible(
+                    counter.max_value, counter.window_seconds,
+                    K.MAX_VALUE_CAP, K.WINDOW_MS_CAP,
+                )
+            else:
+                flag = counter.max_value > K.MAX_VALUE_CAP
+            if len(self._big_flags) >= 4096:
+                self._big_flags.clear()
+            self._big_flags[key] = flag
+        return flag
+
+    def _lane_of(self, counter: Counter) -> Tuple[int, bool]:
+        """Memoized ``_hit_lane`` — per-(limit, max_value), not
+        per-hit."""
+        limit = counter.limit
+        key = (limit, limit.max_value)
+        lane = self._lanes.get(key)
+        if lane is None:
+            lane = _hit_lane(counter)
+            if len(self._lanes) >= 4096:
+                self._lanes.clear()
+            self._lanes[key] = lane
+        return lane
 
     def _big_cell(self, counter: Counter, key: tuple) -> ExpiringValue:
         entry = self._big.get(key)
@@ -405,7 +458,10 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
 
     @staticmethod
     def _key_of(counter: Counter) -> tuple:
-        return (counter.limit._identity, tuple(counter.set_variables.items()))
+        # Counter._key() memoizes the identity tuple on the counter, so
+        # reused counter objects (the compiled path's plan cache) stop
+        # paying per-hit tuple construction + re-hash.
+        return counter._key()
 
     def _evict_one(self) -> None:
         """Free the least-recently-used qualified slot (the moka cap
@@ -467,6 +523,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             now_ms,
         )
 
+    def _kernel_update(self, slots, deltas, windows, fresh, bucket, now_ms):
+        """Unconditional-update dispatch point (update_counter /
+        apply_deltas); the replicated subclass swaps in a kernel that
+        folds the gossiped remote TAT floor into bucket advances, so
+        Report-role traffic cannot briefly under-count shared buckets."""
+        return K.update_batch(
+            self._state, slots, deltas, windows, fresh, bucket, now_ms,
+        )
+
     def begin_check_many(self, requests: List[_Request]) -> _CheckHandle:
         """Build hit arrays and launch the kernel WITHOUT waiting for the
         device->host transfer. Table mutations are serialized under the
@@ -519,7 +584,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     if self._is_big(c):
                         continue
                     slot, is_fresh = slot_for(c, create=True)
-                    win, is_bucket = _hit_lane(c)
+                    win, is_bucket = self._lane_of(c)
                     slots_l.append(slot)
                     deltas_l.append(dev_delta)
                     maxes_l.append(min(c.max_value, K.MAX_VALUE_CAP))
@@ -544,15 +609,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
 
             nhits = len(slots_l)
             H = _bucket(max(nhits, len(requests), 1))
-            pad = H - nhits
-            slots = np.asarray(
-                slots_l + [self._scratch] * pad, np.int32)
-            deltas = np.asarray(deltas_l + [0] * pad, np.int32)
-            maxes = np.asarray(maxes_l + [int(_INT32_MAX)] * pad, np.int32)
-            windows = np.asarray(windows_l + [0] * pad, np.int32)
-            req = np.asarray(req_l + [H - 1] * pad, np.int32)
-            fresh = np.asarray(fresh_l + [False] * pad, bool)
-            bucket = np.asarray(bucket_l + [False] * pad, bool)
+            # One C-level conversion per column into a right-sized array
+            # (no Python-level pad-list concatenation per batch).
+            slots = _staged(slots_l, H, self._scratch, np.int32)
+            deltas = _staged(deltas_l, H, 0, np.int32)
+            maxes = _staged(maxes_l, H, int(_INT32_MAX), np.int32)
+            windows = _staged(windows_l, H, 0, np.int32)
+            req = _staged(req_l, H, H - 1, np.int32)
+            fresh = _staged(fresh_l, H, False, bool)
+            bucket = _staged(bucket_l, H, False, bool)
 
             self._state, result = self._kernel_check(
                 slots, deltas, maxes, windows, req, fresh, bucket,
@@ -718,15 +783,14 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             windows = np.zeros(H, np.int32)
             fresh = np.zeros(H, bool)
             bucket = np.zeros(H, bool)
-            win, is_bucket = _hit_lane(counter)
+            win, is_bucket = self._lane_of(counter)
             slots[0] = slot
             deltas[0] = min(int(delta), K.MAX_DELTA_CAP)
             windows[0] = win
             fresh[0] = is_fresh
             bucket[0] = is_bucket
-            self._state = K.update_batch(
-                self._state, slots, deltas, windows, fresh, bucket,
-                np.int32(now_ms),
+            self._state = self._kernel_update(
+                slots, deltas, windows, fresh, bucket, np.int32(now_ms)
             )
 
     def check_and_update(
@@ -810,18 +874,17 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
         """Pad (slots, deltas, maxes, windows, req_ids, fresh[, bucket])
         to the next bucket with inert scratch hits."""
         H = _bucket(max(nhits, 1))
-        pad = H - nhits
         slots, deltas, maxes, windows, req, fresh = arrays[:6]
         padded = (
-            np.concatenate([slots, np.full(pad, self._scratch, np.int32)]),
-            np.concatenate([deltas, np.zeros(pad, np.int32)]),
-            np.concatenate([maxes, np.full(pad, _INT32_MAX, np.int32)]),
-            np.concatenate([windows, np.zeros(pad, np.int32)]),
-            np.concatenate([req, np.full(pad, H - 1, np.int32)]),
-            np.concatenate([fresh, np.zeros(pad, bool)]),
+            _staged(slots, H, self._scratch, np.int32),
+            _staged(deltas, H, 0, np.int32),
+            _staged(maxes, H, int(_INT32_MAX), np.int32),
+            _staged(windows, H, 0, np.int32),
+            _staged(req, H, H - 1, np.int32),
+            _staged(fresh, H, False, bool),
         )
         if len(arrays) > 6:
-            padded += (np.concatenate([arrays[6], np.zeros(pad, bool)]),)
+            padded += (_staged(arrays[6], H, False, bool),)
         return padded
 
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
@@ -875,9 +938,22 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 )
             self._delete_big(limits)
 
+    def _replace_table(self) -> "_SlotTable":
+        """Swap in a fresh slot table, carrying the coherence hooks over
+        and firing the wholesale invalidation (every previously-issued
+        slot index is dead). Caller holds the lock."""
+        old = self._table
+        self._table = _SlotTable(self._capacity)
+        self._table.on_native_release = old.on_native_release
+        self._table.on_slot_release = old.on_slot_release
+        self._table.on_clear = old.on_clear
+        if old.on_clear is not None:
+            old.on_clear()
+        return self._table
+
     def clear(self) -> None:
         with self._lock:
-            self._table = _SlotTable(self._capacity)
+            self._replace_table()
             self._state = K.make_table(self._capacity)
             self._watched_slots.clear()
             self._clear_big()
@@ -914,15 +990,14 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                 bucket = np.zeros(H, bool)
                 for k, (_i, counter, delta) in enumerate(dev_items):
                     slot, is_fresh = self._slot_for(counter, create=True)
-                    win, is_bucket = _hit_lane(counter)
+                    win, is_bucket = self._lane_of(counter)
                     slots[k] = slot
                     deltas[k] = min(int(delta), K.MAX_DELTA_CAP)
                     windows[k] = win
                     fresh[k] = is_fresh
                     bucket[k] = is_bucket
-                self._state = K.update_batch(
-                    self._state, slots, deltas, windows, fresh, bucket,
-                    np.int32(now_ms),
+                self._state = self._kernel_update(
+                    slots, deltas, windows, fresh, bucket, np.int32(now_ms)
                 )
                 values, ttls = K.read_slots(
                     self._state, slots[:n], np.int32(now_ms)
@@ -1011,7 +1086,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     values=K.jnp.asarray(data["values"]),
                     expiry_ms=K.jnp.asarray(data["expiry"]),
                 )
-            self._table = _SlotTable(self._capacity)
+            self._replace_table()
             self._table.load(table, 0, self._capacity)
             seed_slots: List[int] = []
             seed_tats: List[int] = []

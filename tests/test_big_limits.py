@@ -190,3 +190,31 @@ def test_negative_delta_rejected_sharded_and_cached():
         assert not micro._pending
 
     asyncio.run(drive_async())
+
+
+def test_update_limit_across_the_device_cap_refreshes_routing():
+    """max_value is NOT part of Limit identity, so an update_limit that
+    only raises max across the int32 device cap produces an
+    identity-equal Limit — the storage's per-limit routing memos
+    (_is_big / _lane_of) must key on (limit, max_value), not the limit
+    alone, or the updated limit would keep the stale device routing
+    (and clamp the new max to 2^30)."""
+    storage = TpuStorage(capacity=64)
+    small = Limit("ns", 100, 60, [], ["u"])
+    limiter = RateLimiter(storage)
+    limiter.add_limit(small)
+    ctx = Context({"u": "x"})
+    assert not limiter.check_rate_limited_and_update("ns", ctx, 1).limited
+    big = Limit("ns", 1 << 40, 60, [], ["u"])
+    limiter.update_limit(big)
+    # Seed just below the REAL boundary: the stale device routing would
+    # clamp max to 2^30 and reject, the stale memo would also route the
+    # counter to the (empty) device slot instead of the big host cell.
+    storage.update_counter(Counter(big, {"u": "y"}), (1 << 40) - 1)
+    assert not limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "y"}), 1
+    ).limited
+    assert limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "y"}), 1
+    ).limited
+    storage.close()

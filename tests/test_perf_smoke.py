@@ -1,0 +1,113 @@
+"""Perf smoke: per-request host-path overhead budgets on the serving
+fast paths. Budgets are LOOSE (an order of magnitude over the measured
+steady state on a throttled 1-core CI box) — they exist to catch
+regression CLASSES (a per-request task spawn, a per-submit flush storm,
+an accidental O(n²) in batch staging), not to pin a number. Not marked
+slow: one short measured pass each."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from limitador_tpu import Limit, native
+from limitador_tpu.server.proto import rls_pb2
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+D = "descriptors[0]"
+
+#: per-request budget for the native asyncio submit lane (µs). Steady
+#: state measures ~25 µs on the throttled CI container; the pre-fix
+#: flush-storm regression measured ~150 µs.
+NATIVE_SUBMIT_BUDGET_US = 120.0
+#: per-request budget for the bulk engine lane (µs). Steady state is
+#: ~2-3 µs here; 25 µs catches a per-row Python regression.
+ENGINE_BUDGET_US = 25.0
+
+
+def _blobs(n, users=512):
+    rng = np.random.default_rng(3)
+    out = []
+    for _ in range(n):
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "m", "GET"
+        e = d.entries.add()
+        e.key, e.value = "u", f"user-{int(rng.integers(0, users))}"
+        out.append(req.SerializeToString())
+    return out
+
+
+@pytest.fixture(scope="module")
+def pipeline():
+    if not native.available():
+        pytest.skip(f"native hostpath unavailable: {native.build_error()}")
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(TpuStorage(capacity=1 << 14), max_delay=0.0005)
+    )
+    limiter.add_limit(
+        Limit("api", 10**6, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])
+    )
+    return NativeRlsPipeline(limiter, None, max_delay=0.0005,
+                             max_batch=4096), limiter
+
+
+def test_engine_per_request_host_cost_within_budget(pipeline):
+    p, _limiter = pipeline
+    blobs = _blobs(4096)
+    p.decide_many(blobs, chunk=len(blobs))  # warm: compile + slots
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        results = p.decide_many(blobs, chunk=len(blobs))
+        best = min(best, time.perf_counter() - t0)
+    assert all(r is not None for r in results)
+    per_req_us = best / len(blobs) * 1e6
+    assert per_req_us <= ENGINE_BUDGET_US, (
+        f"engine host path costs {per_req_us:.1f} µs/decision "
+        f"(budget {ENGINE_BUDGET_US} µs)"
+    )
+
+
+def test_native_submit_per_request_overhead_within_budget(pipeline):
+    p, _limiter = pipeline
+    blobs = _blobs(4096)
+
+    async def measure():
+        # warm: shard creation, plan cache, kernel buckets
+        await asyncio.gather(*[p.submit(b) for b in blobs])
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            await asyncio.gather(*[p.submit(b) for b in blobs])
+            best = min(best, time.perf_counter() - t0)
+        return best / len(blobs) * 1e6
+
+    loop = asyncio.new_event_loop()
+    per_req_us = loop.run_until_complete(measure())
+    loop.close()
+    assert per_req_us <= NATIVE_SUBMIT_BUDGET_US, (
+        f"native submit lane costs {per_req_us:.1f} µs/request "
+        f"(budget {NATIVE_SUBMIT_BUDGET_US} µs)"
+    )
+
+
+def test_submit_returns_a_future_not_a_coroutine(pipeline):
+    """The serving fast lane's contract: submit() is a plain function
+    returning a future — a per-request coroutine/task would reintroduce
+    the asyncio tax the sharded serving model removed."""
+    p, _limiter = pipeline
+
+    async def check():
+        out = p.submit(_blobs(1)[0])
+        assert asyncio.isfuture(out)
+        await out
+
+    loop = asyncio.new_event_loop()
+    loop.run_until_complete(check())
+    loop.close()

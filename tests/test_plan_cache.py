@@ -1,0 +1,456 @@
+"""Hot-descriptor decision-plan cache: correctness over the behavioral
+surface — epoch invalidation on limits changes, byte/state parity of
+cached vs uncached decisions, slot-eviction coherence, and the
+mid-flight-reload race (a limits change never serves a stale template).
+"""
+
+import asyncio
+import threading
+
+import numpy as np
+import pytest
+
+from limitador_tpu import Limit, native
+from limitador_tpu.server.proto import rls_pb2
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+from limitador_tpu.tpu.plan_cache import (
+    PLAN_KERNEL,
+    DecisionPlan,
+    DecisionPlanCache,
+)
+
+D = "descriptors[0]"
+OK = rls_pb2.RateLimitResponse.OK
+OVER = rls_pb2.RateLimitResponse.OVER_LIMIT
+UNKNOWN = rls_pb2.RateLimitResponse.UNKNOWN
+
+native_only = pytest.mark.skipif(
+    not native.available(), reason="native hostpath unavailable"
+)
+
+
+def blob(domain="api", **entries):
+    req = rls_pb2.RateLimitRequest(domain=domain)
+    d = req.descriptors.add()
+    for k, v in entries.items():
+        e = d.entries.add()
+        e.key = k
+        e.value = v
+    return req.SerializeToString()
+
+
+def code(raw: bytes) -> int:
+    return rls_pb2.RateLimitResponse.FromString(raw).overall_code
+
+
+def make_pipeline(plan_cache_size=1 << 16, capacity=1 << 10, cache_size=None,
+                  limits=None):
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=capacity, cache_size=cache_size),
+            max_delay=0.001,
+        ),
+        plan_cache_size=plan_cache_size,
+    )
+    for limit in limits or [
+        Limit("api", 3, 60, [f"{D}.m == 'GET'"], [f"{D}.u"], name="q")
+    ]:
+        limiter.add_limit(limit)
+    return NativeRlsPipeline(
+        limiter, None, max_delay=0.001, plan_cache_size=plan_cache_size
+    ), limiter
+
+
+class TestCacheUnit:
+    def test_size_cap_evicts_and_keeps_reverse_index_coherent(self):
+        cache = DecisionPlanCache(max_size=2)
+        plans = [
+            DecisionPlan(PLAN_KERNEL, namespace="ns", record=(s, 10, 1000, 0),
+                         slots=(s,))
+            for s in (1, 2, 3)
+        ]
+        for i, p in enumerate(plans):
+            cache.put(b"k%d" % i, p)
+        assert len(cache) == 2
+        assert cache.evictions == 1
+        assert cache.get(b"k0") is None  # oldest evicted
+        # evicted entry's slot must be gone from the reverse index
+        cache.invalidate_slot(1)  # no-op, must not resurrect anything
+        assert cache.get(b"k1") is plans[1]
+        cache.invalidate_slot(2)
+        assert cache.get(b"k1") is None
+        assert cache.invalidations == 1
+
+    def test_epoch_bump_orphans_everything(self):
+        cache = DecisionPlanCache(max_size=8)
+        cache.put(b"a", DecisionPlan(PLAN_KERNEL, record=(5, 1, 1, 0),
+                                     slots=(5,)))
+        cache.bump_epoch()
+        assert len(cache) == 0
+        assert cache.get(b"a") is None
+        # reverse index cleared too: stale slot invalidation is a no-op
+        cache.invalidate_slot(5)
+
+    def test_put_with_stale_epoch_snapshot_is_discarded(self):
+        """A plan derived before a limits bump but inserted after it was
+        derived from dead limits: put must discard it (the cross-thread
+        reload race the cooperative mid-flight test cannot exercise)."""
+        from limitador_tpu.tpu.plan_cache import CounterPlanCache
+
+        cache = DecisionPlanCache(max_size=8)
+        snapshot = cache.epoch
+        cache.bump_epoch()  # the reload wins the race
+        cache.put(b"a", DecisionPlan(PLAN_KERNEL, record=(1, 1, 1, 0),
+                                     slots=(1,)), snapshot)
+        assert cache.get(b"a") is None
+        cache.put(b"a", DecisionPlan(PLAN_KERNEL, record=(1, 1, 1, 0),
+                                     slots=(1,)), cache.epoch)
+        assert cache.get(b"a") is not None
+
+        cc = CounterPlanCache(max_size=8)
+        snapshot = cc.epoch
+        cc.bump_epoch()
+        cc.put(("ns", ()), ["stale"], snapshot)
+        assert cc.get(("ns", ())) is None
+        cc.put(("ns", ()), ["fresh"], cc.epoch)
+        assert cc.get(("ns", ())) == ["fresh"]
+
+    def test_multi_slot_plan_unindexed_on_either_slot(self):
+        cache = DecisionPlanCache(max_size=8)
+        cache.put(b"a", DecisionPlan(
+            PLAN_KERNEL, record=(5, 1, 1, 0, 6, 1, 1, 0), slots=(5, 6)
+        ))
+        cache.invalidate_slot(6)
+        assert cache.get(b"a") is None
+        cache.invalidate_slot(5)  # the other half must not KeyError
+
+
+@native_only
+class TestCachedUncachedParity:
+    """The same traffic through a cached and a cache-disabled pipeline
+    must produce byte-identical responses and state-identical counters,
+    including across a limits-epoch bump mid-stream."""
+
+    def _traffic(self):
+        rng = np.random.default_rng(11)
+        users = [f"u{int(rng.integers(0, 6))}" for _ in range(160)]
+        blobs = []
+        for i, u in enumerate(users):
+            if i % 17 == 0:
+                blobs.append(blob(domain="", u=u))           # UNKNOWN
+            elif i % 11 == 0:
+                blobs.append(blob(domain="nolimits", x=u))   # free OK
+            elif i % 7 == 0:
+                blobs.append(blob(m="POST", u=u))            # no limit hit
+            else:
+                blobs.append(blob(m="GET", u=u))             # counted
+        return blobs
+
+    def _run(self, cache_size):
+        limits = [
+            Limit("api", 4, 60, [f"{D}.m == 'GET'"], [f"{D}.u"], name="q"),
+            Limit("api", 1000, 3600, [], [f"{D}.u"], name="daily"),
+        ]
+        p, limiter = make_pipeline(
+            plan_cache_size=cache_size, limits=limits
+        )
+        blobs = self._traffic()
+
+        async def run():
+            outs = []
+            for b in blobs:  # serial: deterministic admission order
+                outs.append(await p.submit(b))
+            # mid-stream limits change: the second half decides under
+            # the new config on both pipelines
+            await limiter.configure_with([
+                Limit("api", 2, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+                      name="q2"),
+            ])
+            p.invalidate()
+            limiter.storage.counters.inner.clear()
+            for b in blobs:
+                outs.append(await p.submit(b))
+            counters = limiter.storage.counters.inner.get_counters(
+                limiter.get_limits("api")
+            )
+            await p.close()
+            await limiter.storage.counters.close()
+            return outs, counters
+
+        loop = asyncio.new_event_loop()
+        outs, counters = loop.run_until_complete(run())
+        loop.close()
+        state = sorted(
+            (str(c.limit.name), tuple(c.set_variables.items()),
+             c.max_value - c.remaining)
+            for c in counters
+        )
+        return outs, state, p
+
+    def test_responses_byte_identical_and_state_identical(self):
+        cached_outs, cached_state, p = self._run(1 << 16)
+        uncached_outs, uncached_state, _ = self._run(0)
+        assert cached_outs == uncached_outs  # byte-identical responses
+        assert cached_state == uncached_state
+        stats = p.plan_cache_stats()
+        assert stats["plan_cache_hits"] > 0  # the cache actually served
+
+    def test_cache_disabled_pipeline_reports_empty_stats(self):
+        p, limiter = make_pipeline(plan_cache_size=0)
+        assert p.plan_cache is None
+        assert p.plan_cache_stats() == {}
+
+        async def run():
+            out = await p.submit(blob(m="GET", u="x"))
+            await p.close()
+            await limiter.storage.counters.close()
+            return out
+
+        loop = asyncio.new_event_loop()
+        assert code(loop.run_until_complete(run())) == OK
+        loop.close()
+
+
+@native_only
+class TestEpochInvalidation:
+    def test_add_update_delete_limit_invalidate_cached_plans(self):
+        p, limiter = make_pipeline()
+        lim2 = Limit("api", 100, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+                     name="wide")
+
+        async def run():
+            outs = [code(await p.submit(blob(m="GET", u="a")))
+                    for _ in range(4)]
+            assert outs == [OK, OK, OK, OVER]
+            assert p.plan_cache.hits > 0
+            # update: raise the limit; cached OVER plan must not survive
+            await limiter.configure_with([lim2])
+            p.invalidate()
+            assert code(await p.submit(blob(m="GET", u="a"))) == OK
+            # delete: namespace loses all limits -> free OK
+            await limiter.delete_limits("api")
+            p.invalidate()
+            assert code(await p.submit(blob(m="GET", u="a"))) == OK
+            await p.close()
+            await limiter.storage.counters.close()
+
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(run())
+        loop.close()
+
+    def test_invalidate_bumps_epoch_and_empties(self):
+        p, limiter = make_pipeline()
+
+        async def run():
+            await p.submit(blob(m="GET", u="a"))
+            assert len(p.plan_cache) > 0
+            epoch = p.plan_cache.epoch
+            p.invalidate()
+            assert p.plan_cache.epoch == epoch + 1
+            assert len(p.plan_cache) == 0
+            await p.close()
+            await limiter.storage.counters.close()
+
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(run())
+        loop.close()
+
+
+@native_only
+class TestSlotCoherence:
+    def test_lru_eviction_drops_plans_pinning_the_slot(self):
+        # cache_size=4 qualified slots: the 5th user evicts the 1st
+        p, limiter = make_pipeline(
+            capacity=64, cache_size=4,
+            limits=[Limit("api", 10, 60, [], [f"{D}.u"])],
+        )
+
+        async def run():
+            for _ in range(7):
+                await p.submit(blob(u="user-0"))
+            assert any(
+                pl.kind == PLAN_KERNEL
+                for pl in p.plan_cache.entries.values()
+            )
+            for i in range(1, 8):
+                await p.submit(blob(u=f"user-{i}"))
+            # user-0's slot was recycled: its plan must be gone, and a
+            # revival must start from 0 (stale plan would reuse the slot
+            # of some OTHER user's counter)
+            outs = [
+                code(await p.submit(blob(u="user-0"))) for _ in range(11)
+            ]
+            assert outs == [OK] * 10 + [OVER]
+            await p.close()
+            await limiter.storage.counters.close()
+
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(run())
+        loop.close()
+
+    def test_storage_clear_invalidates_all_plans(self):
+        p, limiter = make_pipeline(
+            limits=[Limit("api", 3, 60, [], [f"{D}.u"])]
+        )
+
+        async def run():
+            outs = [code(await p.submit(blob(u="x"))) for _ in range(4)]
+            assert outs == [OK, OK, OK, OVER]
+            limiter.storage.counters.inner.clear()
+            # table swapped: every plan-pinned slot index is dead
+            assert len(p.plan_cache) == 0
+            outs = [code(await p.submit(blob(u="x"))) for _ in range(3)]
+            assert outs == [OK, OK, OK]
+            await p.close()
+            await limiter.storage.counters.close()
+
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(run())
+        loop.close()
+
+
+@native_only
+class TestMidFlightReloadRace:
+    def test_limits_change_mid_flight_never_serves_a_stale_plan(self):
+        """Flood decide_many from worker threads while the main thread
+        flips the namespace's limit between max=1 and max=1000 many
+        times. Invariants: (a) after each invalidate() returns, a fresh
+        probe decides under some non-stale config — with max=1000 a
+        brand-new user must be admitted (a stale max=1 plan template
+        would reject it); (b) the flood only ever sees OK/OVER blobs
+        (no crashes, no storage errors)."""
+        p, limiter = make_pipeline(
+            capacity=1 << 12,
+            limits=[Limit("api", 1, 60, [], [f"{D}.u"], name="tight")],
+        )
+        stop = threading.Event()
+        errors: list = []
+
+        def flood(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                blobs = [
+                    blob(u=f"w{seed}-{int(rng.integers(0, 64))}")
+                    for _ in range(256)
+                ]
+                try:
+                    outs = p.decide_many(blobs, chunk=128)
+                    for o in outs:
+                        assert o is not None and code(o) in (OK, OVER)
+                except Exception as exc:  # surfaced in the main thread
+                    errors.append(exc)
+                    return
+
+        threads = [
+            threading.Thread(target=flood, args=(s,)) for s in (1, 2)
+        ]
+        for t in threads:
+            t.start()
+        loop = asyncio.new_event_loop()
+        try:
+            for round_no in range(10):
+                wide = Limit("api", 1000, 60, [], [f"{D}.u"], name="wide")
+                loop.run_until_complete(limiter.configure_with([wide]))
+                p.invalidate()
+                # a NEVER-seen user: admitted iff the active plan is the
+                # wide config (a stale tight plan has max=1 but the
+                # counter is fresh, so the first hit is OK either way —
+                # the second hit is the discriminator)
+                probe = f"probe-{round_no}"
+                outs = [
+                    code(o) for o in p.decide_many(
+                        [blob(u=probe)] * 3, chunk=4
+                    )
+                ]
+                assert outs == [OK, OK, OK], (
+                    f"round {round_no}: stale tight-limit plan served "
+                    f"after invalidate ({outs})"
+                )
+                tight = Limit("api", 1, 60, [], [f"{D}.u"], name="tight")
+                loop.run_until_complete(limiter.configure_with([tight]))
+                p.invalidate()
+                probe2 = f"probe2-{round_no}"
+                outs = [
+                    code(o) for o in p.decide_many(
+                        [blob(u=probe2)] * 3, chunk=4
+                    )
+                ]
+                assert outs == [OK, OVER, OVER], (
+                    f"round {round_no}: stale wide-limit plan served "
+                    f"after invalidate ({outs})"
+                )
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            loop.close()
+        assert not errors, errors
+
+
+class TestCompiledCountersCache:
+    """The compiled/gRPC-path counter-plan cache: epoch invalidation on
+    limits changes and decision parity with the cache disabled."""
+
+    def _limiter(self, plan_cache_size):
+        return CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001),
+            plan_cache_size=plan_cache_size,
+        )
+
+    def test_parity_and_epoch_invalidation(self):
+        async def drive(limiter):
+            outs = []
+            for i in range(6):
+                r = await limiter.check_rate_limited_and_update(
+                    "api", {"m": "GET", "u": "alice"}, 1
+                )
+                outs.append(r.limited)
+            # update_limit path must orphan the cached counters
+            limiter.update_limit(
+                Limit("api", 100, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])
+            )
+            r = await limiter.check_rate_limited_and_update(
+                "api", {"m": "GET", "u": "alice"}, 1
+            )
+            outs.append(r.limited)
+            await limiter.storage.counters.close()
+            return outs
+
+        results = {}
+        for size in (1 << 16, 0):
+            limiter = self._limiter(size)
+            limiter.add_limit(
+                Limit("api", 3, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])
+            )
+            loop = asyncio.new_event_loop()
+            results[size] = loop.run_until_complete(drive(limiter))
+            loop.close()
+            if size:
+                assert limiter.counters_cache.hits > 0
+        assert results[1 << 16] == results[0]
+        assert results[0] == [False, False, False, True, True, True, False]
+
+    def test_load_counters_requests_bypass_the_cache(self):
+        limiter = self._limiter(1 << 16)
+        limiter.add_limit(Limit("api", 5, 60, [], [f"{D}.u"]))
+
+        async def run():
+            r1 = await limiter.check_rate_limited_and_update(
+                "api", {"u": "x"}, 1, load_counters=True
+            )
+            r2 = await limiter.check_rate_limited_and_update(
+                "api", {"u": "x"}, 1, load_counters=True
+            )
+            await limiter.storage.counters.close()
+            return r1, r2
+
+        loop = asyncio.new_event_loop()
+        r1, r2 = loop.run_until_complete(run())
+        loop.close()
+        # distinct Counter objects per request (loads mutate them)
+        assert r1.counters[0] is not r2.counters[0]
+        assert r1.counters[0].remaining == 4
+        assert r2.counters[0].remaining == 3

@@ -63,9 +63,13 @@ def test_measured_engine_cost_backs_the_documented_coefficient():
     # warmup at the SAME chunk size (a different size would compile a
     # new XLA program inside the timed region)
     pipeline.decide_many(blobs, chunk=len(blobs))
-    t0 = time.perf_counter()
-    results = pipeline.decide_many(blobs, chunk=len(blobs))
-    dt = time.perf_counter() - t0
+    # best-of-3: a single pass on the 1-core CI box regularly eats a
+    # scheduler hiccup that has nothing to do with the coefficient
+    dt = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        results = pipeline.decide_many(blobs, chunk=len(blobs))
+        dt = min(dt, time.perf_counter() - t0)
     assert all(r is not None for r in results)
     measured_us = dt / len(blobs) * 1e6
     doc_us = _doc_coefficient_us()

@@ -807,3 +807,64 @@ def test_big_delete_keeps_remote_state_for_readoption():
         assert not lb.is_rate_limited("ns", Context({"u": "x"}), 5).limited
     finally:
         b.close()
+
+
+def test_report_path_update_folds_remote_tat_floor():
+    """The UNCONDITIONAL update path (update_counter / apply_deltas —
+    the Report role and redis_import replay) must advance the local
+    bucket TAT from the gossiped remote floor, not from the stale local
+    TAT: a replayed spend on top of a peer's spend may not briefly
+    under-count the shared bucket (the r5-acknowledged divergence this
+    kernel hook closes)."""
+    from limitador_tpu.core.counter import Counter
+
+    clock = FakeClock()
+    now_ms = int(clock.now * 1000)
+    limit = Limit("tb", 5, 60, **TB)  # I = 12s
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limiter.add_limit(limit)
+        # peer A spent 3 of 5: gossiped TAT = now + 3*I
+        tat = now_ms + 3 * 12_000
+        storage._on_remote_update(_bucket_wire(limit), {"A": tat}, tat)
+        # Report role: one unconditional token on the same bucket. With
+        # the floor folded, the local TAT becomes now + 4*I; without it,
+        # the local cell would read now + 1*I and admission would lean
+        # on the remote lane alone.
+        storage.update_counter(Counter(limit, {"u": "x"}), 1)
+        ctx = Context({"u": "x"})
+        outs = [
+            limiter.check_rate_limited_and_update("tb", ctx, 1).limited
+            for _ in range(2)
+        ]
+        assert outs == [False, True]  # exactly 1 of 5 remained
+        counters = limiter.get_counters("tb")
+        assert {c.remaining for c in counters} == {0}
+    finally:
+        storage.close()
+
+
+def test_report_path_apply_deltas_folds_remote_tat_floor():
+    """Same floor fold through the batched apply_deltas lane (the
+    UpdateBatcher / authority path)."""
+    from limitador_tpu.core.counter import Counter
+
+    clock = FakeClock()
+    now_ms = int(clock.now * 1000)
+    limit = Limit("tb", 5, 60, **TB)
+    storage = TpuReplicatedStorage("me", capacity=64, clock=clock)
+    try:
+        limiter = RateLimiter(storage)
+        limiter.add_limit(limit)
+        tat = now_ms + 2 * 12_000  # peer spent 2
+        storage._on_remote_update(_bucket_wire(limit), {"A": tat}, tat)
+        storage.apply_deltas([(Counter(limit, {"u": "x"}), 2)])
+        ctx = Context({"u": "x"})
+        outs = [
+            limiter.check_rate_limited_and_update("tb", ctx, 1).limited
+            for _ in range(2)
+        ]
+        assert outs == [False, True]  # 2 remote + 2 replayed: 1 left
+    finally:
+        storage.close()
