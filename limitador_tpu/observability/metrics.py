@@ -279,6 +279,34 @@ class PrometheusMetrics:
             "Decision plans currently cached",
             registry=self.registry,
         )
+        # -- multi-chip dispatch (tpu/sharded.py): launch counts per
+        # collective variant, polled baseline-converted off
+        # launch_stats()/library_stats. Registered in
+        # sharded.METRIC_FAMILIES (lint cross-checked).
+        self.sharded_launches = Counter(
+            "sharded_launches",
+            "Multi-chip kernel launches by collective variant: lean (no "
+            "collective), coupled (cross-shard pmin request coupling), "
+            "global (psum global-counter region present)",
+            ["variant"],
+            registry=self.registry,
+        )
+        # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
+        # split into pipelined sub-batches. Registered in
+        # batcher.METRIC_FAMILIES (lint cross-checked).
+        self.dispatch_chunk_hits = Histogram(
+            "dispatch_chunk_hits",
+            "Hits per dispatched sub-batch chunk (one kernel launch); "
+            "monolithic flushes observe their full size once",
+            registry=self.registry,
+            buckets=(256, 512, 1024, 2048, 4096, 8192, 16384, 32768),
+        )
+        self.dispatch_chunk_splits = Histogram(
+            "dispatch_chunk_splits",
+            "Chunks a flush was split into (1 = monolithic dispatch)",
+            registry=self.registry,
+            buckets=(1, 2, 3, 4, 6, 8, 12, 16),
+        )
         # -- admission plane (admission/): shed/breaker/failover
         # visibility. Family names are registered in
         # admission.METRIC_FAMILIES; tools/lint.py's registry lint
@@ -353,6 +381,11 @@ class PrometheusMetrics:
                 self.batcher_flushes.labels(batcher, reason)
         for phase in PHASES:
             self.device_phase_latency.labels(phase)
+        # tpu.sharded.LAUNCH_VARIANTS, inlined: importing the sharded
+        # module here would pull jax into every (memory/disk-only)
+        # server; tests/test_device_plane.py pins the two in sync.
+        for variant in ("lean", "coupled", "global"):
+            self.sharded_launches.labels(variant)
         self._library_sources: list = []
         self._counter_baselines: dict = {}
 
@@ -365,7 +398,8 @@ class PrometheusMetrics:
         ``ingress_requests``, ``ingress_responses``,
         ``ingress_protocol_errors`` (cumulative counts, converted to
         increments per source); ``flush_sizes`` (list drained into the
-        histogram)."""
+        histogram); ``sharded_launches`` (variant -> cumulative count
+        map, converted to labeled increments)."""
         self._library_sources.append(source)
 
     def _poll_library_sources(self) -> None:
@@ -405,6 +439,15 @@ class PrometheusMetrics:
                         self._counter_baselines[(i, key)] = seen
             for size in stats.get("flush_sizes", ()):
                 self.batcher_flush_size.observe(size)
+            for variant, seen in stats.get("sharded_launches", {}).items():
+                seen = int(seen)
+                baseline_key = (i, "sharded_launches", variant)
+                baseline = self._counter_baselines.get(baseline_key, 0)
+                if seen > baseline:
+                    self.sharded_launches.labels(variant).inc(
+                        seen - baseline
+                    )
+                    self._counter_baselines[baseline_key] = seen
         self.batcher_size.set(batcher_size)
         self.cache_size.set(cache_size)
         self.batcher_queue_depth.set(queue_depth)

@@ -1,15 +1,19 @@
 from .mesh import (
     ShardedBatchResult,
     ShardedCounterState,
+    batch_sharding,
     make_mesh,
     make_sharded_table,
     sharded_check_and_update,
+    sharded_clear_cells,
 )
 
 __all__ = [
     "ShardedBatchResult",
     "ShardedCounterState",
+    "batch_sharding",
     "make_mesh",
     "make_sharded_table",
     "sharded_check_and_update",
+    "sharded_clear_cells",
 ]

@@ -23,6 +23,34 @@ TPU-native analogue of the reference's counter-distribution topologies
 Layout: values/expiry are [n_shards, local_capacity+1] with
 PartitionSpec("shard", None); hit arrays are [n_shards, H_local] sharded the
 same way; request vectors are replicated.
+
+Collective-lean variants
+------------------------
+Collectives only pay for themselves when a batch actually needs them, and
+BENCH_r05 showed the always-coupled launch scaling NEGATIVELY (1.91M/s on
+8 shards vs 2.60M/s on one): every batch paid a psum over the global
+region plus a pmin over the full replicated request vector, whether or
+not any hit was global or any request spanned shards. The host stages
+per-shard hits and KNOWS both facts, so ``sharded_check_and_update``
+takes two static flags:
+
+- ``coupled=False`` — no request spans shards: request ids are
+  SHARD-LOCAL (``req_ids`` in [0, H_local), ``num_req = H_local``), the
+  cross-device ``pmin`` disappears, and ``admitted`` comes back
+  ``[n_shards, H_local]`` sharded like the hit arrays (the caller indexes
+  it by the request's owner shard). The per-sweep ``segment_min`` also
+  shrinks n_shards-fold.
+- ``has_global=False`` — no psum-region hit in the batch: the global
+  partial sum (and its all-reduce) is skipped entirely.
+
+The default (``coupled=True, has_global=True``) is the fully coupled
+program; the four (coupled, has_global) combinations are four compiled
+programs, selected per batch by the storage's staging pass. Batch inputs
+should be ``jax.device_put`` with :func:`batch_sharding` so each shard
+receives only its own rows — handing the jit replicated host arrays makes
+XLA materialize every shard's hits on every device and slice them back
+out, which is exactly the replication this path exists to avoid (the
+HLO regression test in tests/test_sharded.py pins this).
 """
 
 from __future__ import annotations
@@ -42,8 +70,10 @@ __all__ = [
     "ShardedBatchResult",
     "make_sharded_table",
     "make_mesh",
+    "batch_sharding",
     "sharded_check_and_update",
     "sharded_update",
+    "sharded_clear_cells",
 ]
 
 _NEVER = jnp.iinfo(jnp.int32).max
@@ -85,6 +115,14 @@ def make_mesh(devices=None, axis: str = "shard") -> Mesh:
     return Mesh(devices, (axis,))
 
 
+def batch_sharding(mesh: Mesh, axis: str = "shard") -> NamedSharding:
+    """Sharding for [n_shards, H] batch arrays: device_put hit columns
+    with this BEFORE the launch so each shard uploads only its own rows
+    (a replicated upload costs n_shards x the bytes and leaves XLA to
+    slice the local rows back out on device)."""
+    return NamedSharding(mesh, P(axis, None))
+
+
 def make_sharded_table(
     mesh: Mesh, local_capacity: int, axis: str = "shard"
 ) -> ShardedCounterState:
@@ -98,33 +136,38 @@ def make_sharded_table(
 
 def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
                 fresh, bucket, is_global, now_ms, num_req, axis,
-                global_region):
+                global_region, coupled, has_global):
     """Per-device admission over the local shard; runs inside shard_map.
 
     Delegates to ops/kernel.py's shared ``check_and_update_core`` with two
-    cross-device hooks:
+    cross-device hooks, each compiled in ONLY when the batch needs it
+    (module docstring, "Collective-lean variants"):
 
-    - ``vote_combine``: requests may span devices; admission is all-or-
-      nothing, so per-device verdicts AND across the mesh via ``pmin``
-      (devices without hits for a request vote True).
-    - ``base_hook``: global counters occupy the same slot (< global_region)
-      on every shard, each holding a per-device partial; the effective base
-      is the psum of live partials over that compact region (the CRDT
-      read-as-sum riding ICI). In-batch remote contributions are not
-      visible until the next batch — bounded over-admission, as in the
-      reference's distributed mode.
+    - ``vote_combine`` (``coupled`` batches): requests may span devices;
+      admission is all-or-nothing, so per-device verdicts AND across the
+      mesh via ``pmin`` (devices without hits for a request vote True).
+    - ``base_hook`` (``has_global`` batches): global counters occupy the
+      same slot (< global_region) on every shard, each holding a
+      per-device partial; the effective base is the psum of live partials
+      over that compact region (the CRDT read-as-sum riding ICI).
+      In-batch remote contributions are not visible until the next batch
+      — bounded over-admission, as in the reference's distributed mode.
     """
-    live_partial = jnp.where(now_ms < expiry[:global_region],
-                             values[:global_region], 0)
-    global_vals = lax.psum(live_partial, axis)
-    s_glob = is_global[jnp.argsort(slots, stable=True)]
+    base_hook = None
+    if has_global:
+        live_partial = jnp.where(now_ms < expiry[:global_region],
+                                 values[:global_region], 0)
+        global_vals = lax.psum(live_partial, axis)
+        s_glob = is_global[jnp.argsort(slots, stable=True)]
 
-    def base_hook(v_local, s_slot):
-        safe_idx = jnp.minimum(s_slot, global_region - 1)
-        return jnp.where(s_glob, global_vals[safe_idx], v_local)
+        def base_hook(v_local, s_slot):
+            safe_idx = jnp.minimum(s_slot, global_region - 1)
+            return jnp.where(s_glob, global_vals[safe_idx], v_local)
 
-    def vote_combine(local_vote):
-        return lax.pmin(local_vote.astype(jnp.int32), axis).astype(bool)
+    vote_combine = None
+    if coupled:
+        def vote_combine(local_vote):
+            return lax.pmin(local_vote.astype(jnp.int32), axis).astype(bool)
 
     return check_and_update_core(
         values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
@@ -134,7 +177,9 @@ def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "global_region"),
+    jax.jit,
+    static_argnames=("mesh", "axis", "global_region", "coupled",
+                     "has_global"),
     donate_argnums=(1,),
 )
 def sharded_check_and_update(
@@ -144,45 +189,86 @@ def sharded_check_and_update(
     deltas: jax.Array,      # int32[n, H_local]
     maxes: jax.Array,       # int32[n, H_local]
     windows_ms: jax.Array,  # int32[n, H_local]
-    req_ids: jax.Array,     # int32[n, H_local] global request ids
+    req_ids: jax.Array,     # int32[n, H_local] request ids (see below)
     fresh: jax.Array,       # bool[n, H_local]
     bucket: jax.Array,      # bool[n, H_local] GCRA token-bucket hits
     is_global: jax.Array,   # bool[n, H_local] psum-replicated counter hits
     now_ms: jax.Array,      # int32 scalar
     axis: str = "shard",
     global_region: int = 1024,
+    coupled: bool = True,
+    has_global: bool = True,
 ) -> Tuple[ShardedCounterState, ShardedBatchResult]:
     """One fused multi-chip check-and-update step over the sharded table.
+
+    ``coupled`` batches use GLOBAL request ids (< n*H, one id space mesh-
+    wide) and return a replicated ``admitted[n*H]``; ``coupled=False``
+    batches use SHARD-LOCAL ids (< H, every request's hits on one shard)
+    and return ``admitted[n, H]`` sharded like the hit arrays — no
+    cross-device collective at all when ``has_global`` is also False.
 
     Bucket hits are owner-sharded only (the host routes them like any
     exact counter; a TAT cell cannot be a psum global partial, so bucket
     counters in global namespaces stay on the host's exact path)."""
-    num_req = slots.shape[0] * slots.shape[1]
+    n, H = slots.shape
+    num_req = n * H if coupled else H
 
     def fn(values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
            bucket, is_global):
         (nv, ne, admitted, ok, remaining, ttl) = _local_step(
             values[0], expiry[0], slots[0], deltas[0], maxes[0], windows[0],
             req_ids[0], fresh[0], bucket[0], is_global[0], now_ms, num_req,
-            axis, global_region,
+            axis, global_region, coupled, has_global,
         )
+        if not coupled:
+            admitted = admitted[None]  # [1, H]: this shard's verdicts
         return (
             nv[None], ne[None], admitted, ok[None], remaining[None], ttl[None]
         )
 
     spec = P(axis, None)
-    rep = P()
+    admitted_spec = P() if coupled else spec
     nv, ne, admitted, ok, remaining, ttl = _shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec,) * 10,
-        out_specs=(spec, spec, rep, spec, spec, spec),
+        out_specs=(spec, spec, admitted_spec, spec, spec, spec),
     )(state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
       req_ids, fresh, bucket, is_global)
     return (
         ShardedCounterState(nv, ne),
         ShardedBatchResult(admitted, ok, remaining, ttl),
     )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis"), donate_argnums=(1,),
+)
+def sharded_clear_cells(
+    mesh: Mesh,
+    state: ShardedCounterState,
+    slots: jax.Array,  # int32[n, K] per-shard slots to zero (pad: row L)
+    axis: str = "shard",
+) -> ShardedCounterState:
+    """Zero (value, expiry) of per-shard cell lists IN PLACE (donated):
+    the slot-release/eviction/delete path. Each shard scatters into its
+    own rows — no collective, no full-table host round trip, and no
+    un-donated ``.at[].set`` copy of the whole [n, L+1] table (which is
+    what this replaces). Padding entries point at the scratch row L,
+    which the kernel keeps zero anyway. Zeroing a GLOBAL slot everywhere
+    = broadcast the slot list to every row of ``slots``."""
+
+    def fn(values, expiry, slots):
+        return (
+            values[0].at[slots[0]].set(0)[None],
+            expiry[0].at[slots[0]].set(0)[None],
+        )
+
+    spec = P(axis, None)
+    nv, ne = _shard_map(
+        fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec, spec),
+    )(state.values, state.expiry_ms, slots)
+    return ShardedCounterState(nv, ne)
 
 
 @functools.partial(

@@ -196,6 +196,29 @@ def build_parser() -> argparse.ArgumentParser:
         default=int(_env("TPU_BATCH_DELAY_US", "500")),
         help="micro-batcher linger in microseconds (tpu)",
     )
+
+    def _dispatch_chunk(value: str):
+        if value in ("auto", ""):
+            return None  # auto-tuned from the queue-wait signal
+        if value in ("off", "0"):
+            return 0  # monolithic dispatch
+        chunk = int(value)
+        if chunk < 0:
+            raise argparse.ArgumentTypeError(
+                "dispatch chunk must be >= 0, 'off' or 'auto'"
+            )
+        return chunk
+
+    p.add_argument(
+        "--dispatch-chunk", type=_dispatch_chunk,
+        default=_dispatch_chunk(_env("TPU_DISPATCH_CHUNK", "auto")),
+        help="tpu: hits per pipelined sub-batch launch — a flush splits "
+        "into overlapping chunks so a request's device round trip is its "
+        "chunk's, not the whole batch's (docs/configuration.md). "
+        "'auto' (default) sizes chunks from the device-plane queue-wait "
+        "signal against the 2ms latency budget; 'off'/0 dispatches "
+        "monolithically; N pins the chunk size",
+    )
     p.add_argument(
         "--pipeline",
         choices=["standard", "compiled", "native"],
@@ -470,7 +493,8 @@ def build_limiter(args, on_partitioned=None):
                     capacity=args.tpu_capacity, cache_size=args.cache_size
                 )
         async_storage = AsyncTpuStorage(
-            storage, max_delay=args.batch_delay_us / 1e6
+            storage, max_delay=args.batch_delay_us / 1e6,
+            dispatch_chunk=args.dispatch_chunk,
         )
         if args.pipeline in ("compiled", "native"):
             from ..tpu.pipeline import CompiledTpuLimiter
@@ -478,6 +502,7 @@ def build_limiter(args, on_partitioned=None):
             return CompiledTpuLimiter(
                 async_storage,
                 plan_cache_size=getattr(args, "plan_cache_size", 1 << 16),
+                dispatch_chunk=args.dispatch_chunk,
             )
         return AsyncRateLimiter(async_storage)
     if args.storage == "sharded":
@@ -520,7 +545,8 @@ def build_limiter(args, on_partitioned=None):
                 global_region=args.global_region,
             )
         async_storage = AsyncTpuStorage(
-            storage, max_delay=args.batch_delay_us / 1e6
+            storage, max_delay=args.batch_delay_us / 1e6,
+            dispatch_chunk=args.dispatch_chunk,
         )
         if args.pipeline in ("compiled", "native"):
             if args.pipeline == "native":
@@ -532,6 +558,7 @@ def build_limiter(args, on_partitioned=None):
             return CompiledTpuLimiter(
                 async_storage,
                 plan_cache_size=getattr(args, "plan_cache_size", 1 << 16),
+                dispatch_chunk=args.dispatch_chunk,
             )
         return AsyncRateLimiter(async_storage)
     if args.storage == "disk":
@@ -787,6 +814,7 @@ async def _amain(args) -> int:
             native_pipeline = NativeRlsPipeline(
                 limiter, metrics, max_delay=args.batch_delay_us / 1e6,
                 plan_cache_size=args.plan_cache_size,
+                dispatch_chunk=args.dispatch_chunk,
             )
             pipelines_to_invalidate.append(native_pipeline)
             metrics.attach_library_source(native_pipeline)

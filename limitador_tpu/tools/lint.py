@@ -20,7 +20,13 @@ the gate is implemented from scratch on ``ast``:
   ``limitador_tpu/admission/__init__.py``) must be declared in
   ``observability/metrics.py``, and every declared ``admission_*``
   family must appear in the admission registry — a typo'd or orphaned
-  family fails the gate instead of silently never rendering.
+  family fails the gate instead of silently never rendering,
+* the buffer-donation check: ``jax.jit`` call sites in the kernel
+  modules (DONATION_CHECKED_MODULES) whose wrapped function carries the
+  counter table (a ``state`` or ``values``/``expiry`` parameter) must
+  pass ``donate_argnums`` — a missing donation silently turns every
+  table-mutating launch into a full-table copy (8 bytes/slot/batch of
+  HBM traffic). Read-only kernels are allowlisted in DONATION_EXEMPT.
 
 ``# noqa`` anywhere on the offending line suppresses that finding.
 Run: ``python -m limitador_tpu.tools.lint [paths...]`` (defaults to the
@@ -35,7 +41,10 @@ import sys
 from pathlib import Path
 from typing import List, Tuple
 
-__all__ = ["lint_file", "lint_paths", "lint_metric_registry", "main"]
+__all__ = [
+    "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
+    "main",
+]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
                    "__graft_entry__.py")
@@ -45,7 +54,23 @@ DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
 REGISTRY_OWNED_PREFIXES = {
     "admission_": "limitador_tpu/admission/__init__.py",
     "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
+    "sharded_": "limitador_tpu/tpu/sharded.py",
+    "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
 }
+
+#: modules whose jax.jit sites must donate table-carrying buffers
+DONATION_CHECKED_MODULES = (
+    "limitador_tpu/ops/kernel.py",
+    "limitador_tpu/parallel/mesh.py",
+    "limitador_tpu/tpu/replicated.py",
+)
+
+#: table parameter names that mark a kernel as table-carrying
+DONATION_PARAMS = frozenset({"state", "values", "expiry"})
+
+#: read-only kernels: they take the table but never produce a new one,
+#: so there is nothing to update in place
+DONATION_EXEMPT = frozenset({"read_slots"})
 
 
 def declared_metric_families(metrics_path: Path):
@@ -123,6 +148,98 @@ def lint_metric_registry(repo_root: Path) -> List[str]:
                     f"declared but missing from {registry}'s "
                     "METRIC_FAMILIES registry"
                 )
+    return findings
+
+
+def _is_jax_jit(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "jit"
+        and isinstance(node.value, ast.Name) and node.value.id == "jax"
+    )
+
+
+def lint_donation(repo_root: Path) -> List[str]:
+    """Flag ``jax.jit`` call sites in the kernel modules whose wrapped
+    function carries the counter table (DONATION_PARAMS) but passes no
+    ``donate_argnums``: without donation XLA copies the whole table on
+    every launch instead of updating it in place. Covers the three site
+    shapes the kernels use — ``@jax.jit``, ``@functools.partial(jax.jit,
+    ...)`` and ``functools.partial(jax.jit, ...)(fn)`` — and allowlists
+    the read-only kernels (DONATION_EXEMPT)."""
+    findings: List[str] = []
+    for rel in DONATION_CHECKED_MODULES:
+        path = repo_root / rel
+        if not path.exists():
+            continue
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError:
+            continue  # reported by lint_file
+        lines = src.splitlines()
+        funcs = {
+            node.name: node
+            for node in ast.walk(tree)
+            if isinstance(node, ast.FunctionDef)
+        }
+
+        def check(lineno: int, kwargs, fn_name: str) -> None:
+            fn_node = funcs.get(fn_name)
+            if fn_node is None or fn_name in DONATION_EXEMPT:
+                return
+            params = sorted(
+                {a.arg for a in fn_node.args.args} & DONATION_PARAMS
+            )
+            if not params or "donate_argnums" in kwargs:
+                return
+            if 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]:
+                return
+            findings.append(
+                f"{path}:{lineno}: jax.jit site for table-carrying "
+                f"kernel '{fn_name}' (params {params}) passes no "
+                "donate_argnums — every launch would copy the counter "
+                "table instead of updating it in place"
+            )
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if _is_jax_jit(dec):
+                        check(dec.lineno, set(), node.name)
+                    elif isinstance(dec, ast.Call):
+                        kwargs = {k.arg for k in dec.keywords}
+                        if _is_jax_jit(dec.func):
+                            check(dec.lineno, kwargs, node.name)
+                        elif (
+                            isinstance(dec.func, ast.Attribute)
+                            and dec.func.attr == "partial"
+                            and dec.args and _is_jax_jit(dec.args[0])
+                        ):
+                            check(dec.lineno, kwargs, node.name)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                wrapped = (
+                    node.args[0].id
+                    if node.args and isinstance(node.args[0], ast.Name)
+                    else None
+                )
+                if wrapped is None:
+                    continue
+                if (
+                    isinstance(func, ast.Call)
+                    and isinstance(func.func, ast.Attribute)
+                    and func.func.attr == "partial"
+                    and func.args and _is_jax_jit(func.args[0])
+                ):
+                    # functools.partial(jax.jit, ...)(fn)
+                    check(
+                        node.lineno, {k.arg for k in func.keywords}, wrapped
+                    )
+                elif _is_jax_jit(func):
+                    # jax.jit(fn, ...)
+                    check(
+                        node.lineno, {k.arg for k in node.keywords}, wrapped
+                    )
     return findings
 
 
@@ -312,6 +429,7 @@ def main(argv=None) -> int:
     findings = lint_paths(targets)
     repo_root = Path(__file__).resolve().parent.parent.parent
     findings.extend(lint_metric_registry(repo_root))
+    findings.extend(lint_donation(repo_root))
     for finding in findings:
         print(finding)
     if findings:

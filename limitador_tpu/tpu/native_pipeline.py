@@ -51,6 +51,7 @@ from ..storage.base import StorageError
 from .. import native
 from ..ops import kernel as K
 from ..storage.gcra import device_eligible, emission_interval_ms
+from .batcher import ChunkPlanner, chunk_queue_wait
 from .compiler import NamespaceCompiler
 from .pipeline import CompiledTpuLimiter
 from .plan_cache import (
@@ -137,6 +138,7 @@ class NativeRlsPipeline:
         max_batch: int = 8192,
         max_inflight: int = 2,
         plan_cache_size: int = 1 << 16,
+        dispatch_chunk: Optional[int] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -175,6 +177,9 @@ class NativeRlsPipeline:
         #: enough to keep the device busy while the host parses the next
         #: batch.
         self.max_inflight = max_inflight
+        # Pipelined sub-batch dispatch (batcher.py module docstring):
+        # None = auto-tuned from the queue-wait signal, 0 = monolithic.
+        self.chunk_planner = ChunkPlanner(dispatch_chunk)
 
         self.hp = native.HostPath()
         self._interner = self.hp.as_interner()
@@ -396,57 +401,101 @@ class NativeRlsPipeline:
         # trip — on TPU the round trip is the dominant term, so this is
         # where the serving-path ceiling moves from 8192/RTT to
         # 8192/host-time.
-        await shard.sem.acquire()
-        t_submit = time.perf_counter()
         adm = self._tpu.admission
-        token = adm.breaker.batch_started() if adm is not None else 0
-        shard.batch_seq += 1
-        seq = shard.batch_seq
-        shard.inflight_batches[seq] = batch
-        try:
-            (results, slow_rows, pendings), t_begin, t_staged, t_cache = (
-                await loop.run_in_executor(
-                    self._dispatch_pool, self._timed_begin_batch,
-                    [b for b, _f, _t, _rid in batch],
-                )
-            )
-        except Exception as exc:
-            shard.sem.release()
-            shard.inflight_batches.pop(seq, None)
-            if adm is not None:
-                adm.breaker.batch_finished(token, exc)
-            for _blob, future, _t, _rid in batch:
-                if not future.done():
-                    future.set_exception(exc)
-            return
-        # Requests the columnar path couldn't take: exact per-request path.
-        for r in slow_rows:
-            blob, future, _t, _rid = batch[r]
-            _spawn_detached(self._decide_exact(blob, future))
-        phases = {
-            "dispatch": t_begin - t_submit,
-            "host_cache": t_cache,
-            "host_stage": (t_staged - t_begin) - t_cache,
-        }
-        task = loop.run_in_executor(
-            self._collect_pool, self._finish_batch, batch, results, pendings,
-            batch_id, t_flush, phases,
+        # Chunked pipelined dispatch (batcher.py ChunkPlanner): split the
+        # flush into sub-batches riding the shard's inflight window —
+        # chunk i+1's parse/stage/upload overlaps chunk i's device round
+        # trip, so a request waits for its chunk, not the whole flush.
+        ranges = self.chunk_planner.split(
+            [1] * len(batch), chunk_queue_wait(adm, batch[0][2], t_flush)
         )
-        shard.inflight.add(task)
+        if rec is not None:
+            rec.record_chunks([hi - lo for lo, hi in ranges])
+        # Every chunk registers as in-flight BEFORE any await, so a
+        # breaker trip can fail chunks still waiting on the window (they
+        # left shard.pending at the top of this flush).
+        chunk_seqs = []
+        for lo, hi in ranges:
+            shard.batch_seq += 1
+            shard.inflight_batches[shard.batch_seq] = batch[lo:hi]
+            chunk_seqs.append(shard.batch_seq)
 
-        def _collected(t):
-            shard.inflight.discard(t)
-            shard.inflight_batches.pop(seq, None)
-            shard.sem.release()
-            exc = t.exception()
-            if adm is not None:
-                adm.breaker.batch_finished(token, exc)
-            if exc is not None:
-                for _blob, future, _t, _rid in batch:
+        def _drop_rest(idx, exc):
+            """Fail (and deregister) chunk idx onward — nothing may be
+            left silently stranded when this coroutine unwinds."""
+            for (l2, h2), s2 in zip(ranges[idx:], chunk_seqs[idx:]):
+                shard.inflight_batches.pop(s2, None)
+                for _blob, future, _t, _rid in batch[l2:h2]:
                     if not future.done():
                         future.set_exception(exc)
 
-        task.add_done_callback(_collected)
+        failed = None
+        for ci, ((lo, hi), seq) in enumerate(zip(ranges, chunk_seqs)):
+            sub = batch[lo:hi]
+            if failed is not None:
+                shard.inflight_batches.pop(seq, None)
+                for _blob, future, _t, _rid in sub:
+                    if not future.done():
+                        future.set_exception(failed)
+                continue
+            try:
+                await shard.sem.acquire()
+            except BaseException as exc:
+                # Cancellation (loop teardown) mid-flush must not strand
+                # the chunks still waiting on the window.
+                _drop_rest(ci, exc)
+                raise
+            t_submit = time.perf_counter()
+            token = adm.breaker.batch_started() if adm is not None else 0
+            try:
+                (results, slow_rows, pendings), t_begin, t_staged, t_cache = (
+                    await loop.run_in_executor(
+                        self._dispatch_pool, self._timed_begin_batch,
+                        [b for b, _f, _t, _rid in sub],
+                    )
+                )
+            except BaseException as exc:
+                shard.sem.release()
+                if adm is not None:
+                    adm.breaker.batch_finished(token, exc)
+                if not isinstance(exc, Exception):
+                    _drop_rest(ci, exc)
+                    raise
+                shard.inflight_batches.pop(seq, None)
+                for _blob, future, _t, _rid in sub:
+                    if not future.done():
+                        future.set_exception(exc)
+                failed = exc
+                continue
+            # Requests the columnar path couldn't take: exact per-request
+            # path.
+            for r in slow_rows:
+                blob, future, _t, _rid = sub[r]
+                _spawn_detached(self._decide_exact(blob, future))
+            phases = {
+                "dispatch": t_begin - t_submit,
+                "host_cache": t_cache,
+                "host_stage": (t_staged - t_begin) - t_cache,
+            }
+            task = loop.run_in_executor(
+                self._collect_pool, self._finish_batch, sub, results,
+                pendings, batch_id, t_flush, phases,
+            )
+            shard.inflight.add(task)
+
+            def _collected(t, seq=seq, token=token, sub=sub):
+                shard.inflight.discard(t)
+                shard.inflight_batches.pop(seq, None)
+                shard.sem.release()
+                exc = t.exception()
+                if adm is not None:
+                    adm.breaker.batch_finished(token, exc)
+                if exc is not None:
+                    for _blob, future, _t, _rid in sub:
+                        if not future.done():
+                            future.set_exception(exc)
+
+            task.add_done_callback(_collected)
 
     # -- the columnar fast path ----------------------------------------------
 
@@ -791,6 +840,7 @@ class NativeRlsPipeline:
             if phases is None:
                 return
             phases["device_sync"] = t_done - t_fin
+            self.chunk_planner.observe(phases["device_sync"], len(batch))
             phases["unpack"] = time.perf_counter() - t_done
             span_phases(phases)
             if rec is None:

@@ -40,7 +40,13 @@ from ..core.limiter import AsyncRateLimiter, CheckResult
 from ..core.limit import Limit, Namespace
 from ..observability.device_plane import current_request_id
 from ..observability.tracing import datastore_span, device_batch_span
-from .batcher import AsyncTpuStorage, _latency_hists, _timed_call
+from .batcher import (
+    AsyncTpuStorage,
+    ChunkPlanner,
+    _latency_hists,
+    _timed_call,
+    chunk_queue_wait,
+)
 from .compiler import NamespaceCompiler
 from .plan_cache import CounterPlanCache
 
@@ -121,9 +127,13 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         self,
         storage: Optional[AsyncTpuStorage] = None,
         plan_cache_size: int = 1 << 16,
+        dispatch_chunk: Optional[int] = None,
         **kwargs,
     ):
         super().__init__(storage or AsyncTpuStorage(**kwargs))
+        # Pipelined sub-batch dispatch (batcher.py module docstring):
+        # None = auto-tuned from the queue-wait signal, 0 = monolithic.
+        self.chunk_planner = ChunkPlanner(dispatch_chunk)
         self._metrics = None
         # Device-plane telemetry sink, shared with the wrapped storage's
         # micro-batcher (one batch-id sequence, one flight recorder per
@@ -409,60 +419,105 @@ class CompiledTpuLimiter(AsyncRateLimiter):
                 return
             reqs = [_Request(c, p.delta, p.load) for p, c in live]
             t_eval = time.perf_counter()
-            await shard.sem.acquire()
         except BaseException as exc:
             # Nothing may escape silently: an exception (INCLUDING a
             # cancellation of the submitter awaiting this flush) lost here
             # would strand every other submitter of this batch.
             _fail_futures(batch, exc)
             raise
-        t_submit = time.perf_counter()
         adm = getattr(self._tpu, "admission", None)
-        token = adm.breaker.batch_started() if adm is not None else 0
-        shard.batch_seq += 1
-        seq = shard.batch_seq
-        shard.inflight_pendings[seq] = [p for p, _c in live]
-        try:
-            handle, t_begin, t_launch = await loop.run_in_executor(
-                self._dispatch_pool, _timed_call,
-                self._tpu.inner.begin_check_many, reqs,
-            )
-        except BaseException as exc:
-            shard.sem.release()
-            shard.inflight_pendings.pop(seq, None)
-            if adm is not None:
-                adm.breaker.batch_finished(token, exc)
-            _fail_futures([p for p, _c in live], exc)
-            if not isinstance(exc, Exception):
-                raise
-            return
-        # host_stage folds the on-loop columnar evaluation in with the
-        # kernel launch: both are host work this batch paid before the
-        # device round trip. The inflight-semaphore wait (t_eval ->
-        # t_submit) is backpressure queueing, not host work — excluded,
-        # matching the native pipeline's post-acquire t_submit.
-        phases = {
-            "dispatch": t_begin - t_submit,
-            "host_stage": (t_eval - t_flush) + (t_launch - t_begin),
-        }
-        t0 = time.perf_counter()
-        task = loop.run_in_executor(
-            self._collect_pool, self._collect_batch, handle, live, t0,
-            batch_id, t_flush, phases,
+        # Chunked pipelined dispatch (batcher.py ChunkPlanner): the flush
+        # splits into sub-batches riding the shard's inflight window, so
+        # a request's device round trip is its chunk's, not the flush's.
+        ranges = self.chunk_planner.split(
+            [len(c) for _p, c in live],
+            chunk_queue_wait(adm, batch[0].t_enq, t_flush),
         )
-        shard.inflight.add(task)
+        if rec is not None:
+            rec.record_chunks([
+                sum(len(c) for _p, c in live[lo:hi]) for lo, hi in ranges
+            ])
+        # Every chunk registers as in-flight BEFORE any await, so a
+        # breaker trip can fail chunks still waiting on the window (they
+        # left shard.pending at the top of this flush).
+        chunk_seqs = []
+        for lo, hi in ranges:
+            shard.batch_seq += 1
+            shard.inflight_pendings[shard.batch_seq] = [
+                p for p, _c in live[lo:hi]
+            ]
+            chunk_seqs.append(shard.batch_seq)
 
-        def _collected(t):
-            shard.inflight.discard(t)
-            shard.inflight_pendings.pop(seq, None)
-            shard.sem.release()
-            exc = t.exception()
-            if adm is not None:
-                adm.breaker.batch_finished(token, exc)
-            if exc is not None:
-                _fail_futures([p for p, _c in live], exc)
+        def _drop_rest(idx, exc):
+            """Fail (and deregister) chunk idx onward — nothing may be
+            left silently stranded when this coroutine unwinds."""
+            for (l2, h2), s2 in zip(ranges[idx:], chunk_seqs[idx:]):
+                shard.inflight_pendings.pop(s2, None)
+                _fail_futures([p for p, _c in live[l2:h2]], exc)
 
-        task.add_done_callback(_collected)
+        failed = None
+        for ci, ((lo, hi), seq) in enumerate(zip(ranges, chunk_seqs)):
+            sub_live = live[lo:hi]
+            if failed is not None:
+                # begin failures are plane-wide: the rest of the flush
+                # fails the way a monolithic dispatch would have.
+                shard.inflight_pendings.pop(seq, None)
+                _fail_futures([p for p, _c in sub_live], failed)
+                continue
+            try:
+                await shard.sem.acquire()
+            except BaseException as exc:
+                _drop_rest(ci, exc)
+                raise
+            t_submit = time.perf_counter()
+            token = adm.breaker.batch_started() if adm is not None else 0
+            try:
+                handle, t_begin, t_launch = await loop.run_in_executor(
+                    self._dispatch_pool, _timed_call,
+                    self._tpu.inner.begin_check_many, reqs[lo:hi],
+                )
+            except BaseException as exc:
+                shard.sem.release()
+                if adm is not None:
+                    adm.breaker.batch_finished(token, exc)
+                if not isinstance(exc, Exception):
+                    _drop_rest(ci, exc)
+                    raise
+                shard.inflight_pendings.pop(seq, None)
+                _fail_futures([p for p, _c in sub_live], exc)
+                failed = exc
+                continue
+            # host_stage folds the on-loop columnar evaluation in with
+            # the kernel launch: both are host work this batch paid
+            # before the device round trip (the evaluation share is
+            # attributed to the first chunk — it ran once for the whole
+            # flush). The inflight-semaphore wait (t_eval -> t_submit)
+            # is backpressure queueing, not host work — excluded,
+            # matching the native pipeline's post-acquire t_submit.
+            phases = {
+                "dispatch": t_begin - t_submit,
+                "host_stage": (t_launch - t_begin) + (
+                    (t_eval - t_flush) if ci == 0 else 0.0
+                ),
+            }
+            t0 = time.perf_counter()
+            task = loop.run_in_executor(
+                self._collect_pool, self._collect_batch, handle, sub_live,
+                t0, batch_id, t_flush, phases,
+            )
+            shard.inflight.add(task)
+
+            def _collected(t, seq=seq, token=token, sub_live=sub_live):
+                shard.inflight.discard(t)
+                shard.inflight_pendings.pop(seq, None)
+                shard.sem.release()
+                exc = t.exception()
+                if adm is not None:
+                    adm.breaker.batch_finished(token, exc)
+                if exc is not None:
+                    _fail_futures([p for p, _c in sub_live], exc)
+
+            task.add_done_callback(_collected)
 
     def _collect_batch(
         self, handle, live, t0: float, batch_id: int = 0,
@@ -492,6 +547,10 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             if phases is None:
                 return
             phases["device_sync"] = t_done - t_fin
+            self.chunk_planner.observe(
+                phases["device_sync"],
+                sum(len(counters) for _p, counters in live),
+            )
             phases["unpack"] = time.perf_counter() - t_done
             span_phases(phases)
             if rec is None:

@@ -21,6 +21,33 @@ ICI analogue of Redis-cluster hash-tag sharding,
 The existing MicroBatcher serves this class unchanged (it only needs
 ``check_many``), so the gRPC/HTTP planes can run multi-chip by swapping
 the storage (BASELINE.json config 5, doc/topologies.md:1-37).
+
+Scaling discipline (ISSUE 4)
+----------------------------
+Three rules keep throughput scaling with device count instead of against
+it (BENCH_r05 measured the old path at 0.73x one device):
+
+- **Collective-lean launches**: staging classifies each batch — psum
+  only when a global-namespace hit is present, pmin only when some
+  request actually spans shards (``coupled``); the common owner-sharded
+  batch runs with shard-local request ids and ZERO collectives
+  (parallel/mesh.py "Collective-lean variants"). Launch counts per
+  variant are exported as the ``sharded_launches`` metric family.
+- **Genuinely sharded staging**: hits are bucketed per shard on the host
+  (memoized ``_stable_hash`` routing + the vectorized partition of
+  storage.py ``_partition_positions``/``_scatter_rows``) and
+  ``device_put`` with the mesh sharding, so each shard uploads only its
+  own rows — never a replicated [n, H] batch.
+- **In-place tables**: every table-mutating kernel donates the counter
+  buffers (``sharded_check_and_update``/``sharded_update``/
+  ``sharded_clear_cells``), so XLA updates the [n_shards, L+1] table in
+  place instead of copying it per batch; host-side slot zeroing rides
+  the donated clear kernel, not a full-table ``.at[].set`` copy.
+
+``begin_check_many``/``finish_check_many`` split the launch from the
+device->host transfer exactly like TpuStorage, so the MicroBatcher
+pipelines sharded batches (and chunked dispatch overlaps sub-batches)
+the same way it does single-chip ones.
 """
 
 from __future__ import annotations
@@ -44,21 +71,33 @@ from ..storage.gcra import GcraValue, restore_cell, spent_tokens
 from ..ops import kernel as K
 from ..parallel.mesh import (
     ShardedCounterState,
+    batch_sharding,
     make_mesh,
     make_sharded_table,
     sharded_check_and_update,
+    sharded_clear_cells,
     sharded_update,
 )
 from .storage import (
     _BigLimitMixin,
     _bucket,
-    _hit_lane,
     _migrate_key,
+    _partition_positions,
     _Request,
+    _scatter_rows,
     _SlotTable,
 )
 
-__all__ = ["TpuShardedStorage"]
+__all__ = ["TpuShardedStorage", "METRIC_FAMILIES"]
+
+#: metric families this subsystem owns (cross-checked against
+#: observability/metrics.py by tools/lint.py's registry lint): per-variant
+#: multi-chip launch counts, polled off ``launch_stats()`` at render time.
+METRIC_FAMILIES = ("sharded_launches",)
+
+#: sharded_launches label values: lean = no collective at all, coupled =
+#: pmin request coupling only, global = psum global region present.
+LAUNCH_VARIANTS = ("lean", "coupled", "global")
 
 _INT32_MAX = int(np.iinfo(np.int32).max)
 
@@ -66,6 +105,44 @@ _INT32_MAX = int(np.iinfo(np.int32).max)
 def _stable_hash(key: tuple) -> int:
     """Deterministic (process-independent) hash for shard routing."""
     return zlib.crc32(repr(key).encode())
+
+
+class _ShardedHandle:
+    """In-flight sharded batch: kernel launched, device->host transfer
+    pending. Produced by ``begin_check_many``, consumed by
+    ``finish_check_many`` — the sharded analogue of storage.py's
+    ``_CheckHandle``, carrying the flat staging columns so decode is a
+    vectorized gather instead of per-hit Python."""
+
+    __slots__ = (
+        "requests", "result", "coupled", "seq", "now", "shard_ids", "pos",
+        "slot_col", "glob_col", "j_l", "starts", "adjust_by_req", "home",
+        "local_ids", "fresh_by_req", "big_by_req", "big_projected",
+        "watch_touches",
+    )
+
+    def __init__(self, requests, result, coupled, seq, now, shard_ids, pos,
+                 slot_col, glob_col, j_l, starts, adjust_by_req, home,
+                 local_ids, fresh_by_req, big_by_req, big_projected,
+                 watch_touches):
+        self.requests = requests
+        self.result = result
+        self.coupled = coupled
+        self.seq = seq
+        self.now = now
+        self.shard_ids = shard_ids
+        self.pos = pos
+        self.slot_col = slot_col
+        self.glob_col = glob_col
+        self.j_l = j_l
+        self.starts = starts
+        self.adjust_by_req = adjust_by_req
+        self.home = home            # lean mode: owner shard per request
+        self.local_ids = local_ids  # lean mode: shard-local request id
+        self.fresh_by_req = fresh_by_req
+        self.big_by_req = big_by_req
+        self.big_projected = big_projected
+        self.watch_touches = watch_touches
 
 
 class TpuShardedStorage(_BigLimitMixin, CounterStorage):
@@ -111,6 +188,20 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         self._tables: List[_SlotTable] = []
         self._gtable = _SlotTable(self._global_region)
         self._rr = 0  # round-robin shard for global-counter deltas
+        # Memoized key -> owner shard (the crc32 hash is pure; recomputing
+        # repr+crc per hit was the staging pass's hot spot). Bounded the
+        # same way as the mixin's per-limit memos.
+        self._shard_memo: Dict[tuple, int] = {}
+        # Batch input sharding: device_put hit columns with this so each
+        # shard uploads only its own rows.
+        self._sharding = batch_sharding(self._mesh)
+        # Pipelining bookkeeping (the TpuStorage discipline): batch seq +
+        # last-touch seq of watched slots, keyed (shard, slot) for locals
+        # and (-1, slot) for the psum global region.
+        self._seq = 0
+        self._watched: Dict[Tuple[int, int], int] = {}
+        # Per-variant launch tallies (the sharded_launches families).
+        self._launches: Dict[str, int] = dict.fromkeys(LAUNCH_VARIANTS, 0)
         # Host-side fallback for max_value > device cap (_BigLimitMixin).
         self._init_big(self._cache_size)
         self._reset_tables()
@@ -151,15 +242,21 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
     def _is_global(self, counter: Counter) -> bool:
         return counter.namespace in self._global_ns
 
+    def _clear_rows(self, rows: np.ndarray) -> None:
+        """Zero per-shard cell lists via the donated clear kernel
+        (``rows`` is [n, k], scratch-padded) — in-place on device, no
+        full-table copy."""
+        k = _bucket(rows.shape[1])
+        padded = np.full((self._n, k), self._scratch, np.int32)
+        padded[:, : rows.shape[1]] = rows
+        self._state = sharded_clear_cells(self._mesh, self._state, padded)
+
     def _zero_global_slots(self, slots: List[int]) -> None:
         """A recycled global slot must not inherit stale partials on any
         shard (the kernel's psum base reads the whole global region, not
         just table-reachable cells)."""
         idx = np.asarray(slots, np.int32)
-        self._state = ShardedCounterState(
-            self._state.values.at[:, idx].set(0),
-            self._state.expiry_ms.at[:, idx].set(0),
-        )
+        self._clear_rows(np.broadcast_to(idx, (self._n, idx.shape[0])))
 
     def _evict_local(self, table: _SlotTable) -> None:
         if not table.qualified:
@@ -200,7 +297,12 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 self._gtable.simple[key] = slot
             self._gtable.info[slot] = (key, counter.key())
             return None, slot, True, True
-        shard = _stable_hash(key) % self._n
+        shard = self._shard_memo.get(key)
+        if shard is None:
+            shard = _stable_hash(key) % self._n
+            if len(self._shard_memo) >= 4 * self._cache_size:
+                self._shard_memo.clear()
+            self._shard_memo[key] = shard
         table = self._tables[shard]
         slot = table.lookup(key, qualified)
         if slot is not None:
@@ -227,6 +329,15 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         self._rr = (self._rr + 1) % self._n
         return s
 
+    def launch_stats(self) -> dict:
+        """Cumulative multi-chip launch counts per collective variant
+        (the ``sharded_launches`` metric family, polled baseline-
+        converted off library_stats at render time): a hot path that
+        is mostly ``coupled``/``global`` instead of ``lean`` means the
+        limits layout is forcing collectives onto every batch."""
+        with self._lock:
+            return {"sharded_launches": dict(self._launches)}
+
     def device_stats(self) -> dict:
         """Per-shard table stats for /debug/stats and the Prometheus
         shard gauges: one entry per shard-local table (capacity = the
@@ -251,168 +362,302 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
 
     # -- the shared batched check path --------------------------------------
 
-    def check_many(self, requests: List[_Request]) -> List[Authorization]:
-        """One shard_map launch deciding a batch of requests in list order
-        (same exactness contract as TpuStorage.check_many; cross-shard
-        requests couple via pmin). Counters with max_value beyond the
-        device cap are decided host-side in exact Python ints, coupled
-        into the all-or-nothing decision exactly as in
-        TpuStorage.begin_check_many."""
+    def begin_check_many(self, requests: List[_Request]) -> "_ShardedHandle":
+        """Stage, partition per shard, and LAUNCH one batch without
+        waiting on the device (the TpuStorage begin/finish discipline, so
+        the batcher overlaps batch N+1's staging with batch N's round
+        trip). Table mutations serialize under the lock in call order,
+        which is also device program order.
+
+        Staging classifies the batch: ``coupled`` when any request's
+        device hits span shards (pmin rides along), ``has_global`` when
+        any hit lands in the psum region — otherwise the launch is the
+        collective-free lean variant with shard-local request ids.
+        Counters with max_value beyond the device cap are decided
+        host-side here, exactly as in TpuStorage.begin_check_many."""
         import jax
 
         for request in requests:
             require_nonnegative_delta(request.delta)
         n = self._n
+        # Flat per-hit columns (Python lists; one C-level conversion +
+        # one vectorized per-shard scatter after the loop).
+        shard_l: List[int] = []
+        slot_l: List[int] = []
+        delta_l: List[int] = []
+        max_l: List[int] = []
+        win_l: List[int] = []
+        req_l: List[int] = []
+        fresh_l: List[bool] = []
+        bucket_l: List[bool] = []
+        glob_l: List[bool] = []
+        j_l: List[int] = []
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()
-            # rows: (slot, delta, max, window_ms, req_id, fresh, bucket,
-            #        is_global)
-            per_shard: List[
-                List[Tuple[int, int, int, int, int, bool, bool, bool]]
-            ] = [[] for _ in range(n)]
-            # per request: hit locations [(shard, pos_in_shard)], in order
-            locs_by_req: List[List[Tuple[int, int]]] = []
+            self._seq += 1
+            seq = self._seq
+            watched = self._watched
+            watch_touches: List[Tuple[int, int]] = []
             fresh_by_req: List[List[Tuple[int, Counter, int, int, bool]]] = []
             big_by_req: List[list] = []
-            dev_j_by_req: List[List[Tuple[int, int]]] = []
             big_projected: List[Tuple[tuple, int]] = []
-            use_count: Dict[Tuple[int, int], int] = {}
+            starts: List[int] = []      # flat-hit range start per request
+            adjust_by_req: List[int] = []
+            home_l: List[int] = []      # owner shard per request (-1 none)
+            coupled = False
+            slot_for = self._slot_for
+            lane_of = self._lane_of
+            is_big = self._is_big
             for r, request in enumerate(requests):
+                starts.append(len(slot_l))
                 raw_delta = int(request.delta)
                 delta = min(raw_delta, K.MAX_DELTA_CAP)
-                locs: List[Tuple[int, int]] = []
-                fresh_hits: List[Tuple[int, Counter, int, int, bool]] = []
-                dev_j: List[Tuple[int, int]] = []
                 bigs, big_failed, projected = self._eval_big_hits(
                     request.ordered, raw_delta, now
                 )
                 big_projected.extend(projected)
                 dev_delta = 0 if big_failed else delta
-                adjust = delta if big_failed else 0
+                adjust_by_req.append(delta if big_failed else 0)
+                home = -1
+                fresh_hits: List[Tuple[int, Counter, int, int, bool]] = []
                 for j, c in enumerate(request.ordered):
-                    if self._is_big(c):
+                    if is_big(c):
                         continue
-                    shard, slot, is_fresh, is_g = self._slot_for(
-                        c, create=True
-                    )
+                    shard, slot, is_fresh, is_g = slot_for(c, create=True)
                     if is_g:
                         shard = self._app_shard()
-                    row = per_shard[shard]
-                    locs.append((shard, len(row)))
-                    dev_j.append((j, adjust))
-                    win, is_bucket = _hit_lane(c)
-                    row.append((
-                        slot,
-                        dev_delta,
-                        min(c.max_value, K.MAX_VALUE_CAP),
-                        win,
-                        r,
-                        is_fresh,
-                        is_bucket,
-                        is_g,
-                    ))
-                    use = (1 if is_g else 0, slot if is_g else shard, slot)
-                    use_count[use] = use_count.get(use, 0) + 1
+                    if home < 0:
+                        home = shard
+                    elif shard != home:
+                        coupled = True
+                    win, is_bucket = lane_of(c)
+                    shard_l.append(shard)
+                    slot_l.append(slot)
+                    delta_l.append(dev_delta)
+                    max_l.append(min(c.max_value, K.MAX_VALUE_CAP))
+                    win_l.append(win)
+                    req_l.append(r)
+                    fresh_l.append(is_fresh)
+                    bucket_l.append(is_bucket)
+                    glob_l.append(is_g)
+                    j_l.append(j)
+                    wkey = (-1, slot) if is_g else (shard, slot)
                     if is_fresh:
                         fresh_hits.append((j, c, shard, slot, is_g))
-                locs_by_req.append(locs)
+                        watched[wkey] = seq
+                        watch_touches.append(wkey)
+                    elif wkey in watched:
+                        # A later batch re-used a slot an earlier in-flight
+                        # batch may want to release: the re-use wins.
+                        watched[wkey] = seq
+                        watch_touches.append(wkey)
+                home_l.append(home)
                 fresh_by_req.append(fresh_hits)
                 big_by_req.append(bigs)
-                dev_j_by_req.append(dev_j)
+            starts.append(len(slot_l))
 
-            # n*H must cover every request id (big-only requests still
-            # consume an id even with zero device hits).
-            H = _bucket(max(
-                max(len(p) for p in per_shard),
-                (len(requests) + n - 1) // n,
-                1,
+            R = len(requests)
+            shard_ids = np.asarray(shard_l, np.int32)
+            counts, pos = _partition_positions(shard_ids, n)
+            max_count = int(counts.max(initial=0))
+            if coupled:
+                # n*H must cover every request id (big-only requests
+                # still consume an id even with zero device hits).
+                H = _bucket(max(max_count, (R + n - 1) // n, 1))
+                req_col = np.asarray(req_l, np.int32)
+                req_fill = n * H - 1
+                home = local_ids = None
+            else:
+                H = _bucket(max(max_count, 1))
+                # Shard-local request ids: dense per shard, assigned in
+                # request order (nondecreasing within each shard's rows).
+                home = np.asarray(home_l, np.int32)
+                mask = home >= 0
+                local_ids = np.full(R, H - 1, np.int32)
+                if mask.any():
+                    _lc, lpos = _partition_positions(home[mask], n)
+                    local_ids[mask] = lpos.astype(np.int32)
+                req_col = local_ids[np.asarray(req_l, np.intp)]
+                req_fill = H - 1
+            slot_col = np.asarray(slot_l, np.int32)
+            glob_col = np.asarray(glob_l, bool)
+            has_global = bool(glob_col.any())
+            cols = _scatter_rows(shard_ids, pos, n, H, (
+                (slot_col, self._scratch, np.int32),
+                (delta_l, 0, np.int32),
+                (max_l, _INT32_MAX, np.int32),
+                (win_l, 0, np.int32),
+                (req_col, req_fill, np.int32),
+                (fresh_l, False, bool),
+                (bucket_l, False, bool),
+                (glob_col, False, bool),
             ))
-            slots = np.full((n, H), self._scratch, np.int32)
-            deltas = np.zeros((n, H), np.int32)
-            maxes = np.full((n, H), _INT32_MAX, np.int32)
-            windows = np.zeros((n, H), np.int32)
-            req_ids = np.full((n, H), n * H - 1, np.int32)
-            fresh = np.zeros((n, H), bool)
-            bucket = np.zeros((n, H), bool)
-            is_global = np.zeros((n, H), bool)
-            for s in range(n):
-                rows = per_shard[s]
-                if not rows:
-                    continue
-                # One vectorized store per column (per-element numpy scalar
-                # stores dominate the host loop otherwise — same reasoning
-                # as the single-chip builder, storage.py check_many).
-                m = len(rows)
-                cols = list(zip(*rows))
-                slots[s, :m] = cols[0]
-                deltas[s, :m] = cols[1]
-                maxes[s, :m] = cols[2]
-                windows[s, :m] = cols[3]
-                req_ids[s, :m] = cols[4]
-                fresh[s, :m] = cols[5]
-                bucket[s, :m] = cols[6]
-                is_global[s, :m] = cols[7]
-
             try:
+                # Sharded upload: each shard receives only its own rows.
+                cols = jax.device_put(tuple(cols), self._sharding)
                 self._state, result = sharded_check_and_update(
-                    self._mesh, self._state, slots, deltas, maxes, windows,
-                    req_ids, fresh, bucket, is_global, np.int32(now_ms),
+                    self._mesh, self._state, *cols, np.int32(now_ms),
                     global_region=self._global_region,
+                    coupled=coupled, has_global=has_global,
                 )
-                admitted, hit_ok, remaining, ttl_ms = jax.device_get((
-                    result.admitted, result.hit_ok, result.remaining,
-                    result.ttl_ms,
-                ))
             except BaseException:
                 # Projection reservations must not leak on a failed launch.
                 self._unproject_big(big_projected)
                 raise
+            self._launches[
+                "global" if has_global
+                else ("coupled" if coupled else "lean")
+            ] += 1
+        return _ShardedHandle(
+            requests, result, coupled, seq, now, shard_ids, pos, slot_col,
+            glob_col, np.asarray(j_l, np.int32), np.asarray(starts, np.intp),
+            adjust_by_req, home, local_ids, fresh_by_req, big_by_req,
+            big_projected, watch_touches,
+        )
 
-            auths: List[Authorization] = []
-            big_applies: List[Tuple[tuple, int, int]] = []
-            for r, request in enumerate(requests):
-                locs = locs_by_req[r]
-                dev_j = dev_j_by_req[r]
-                bigs = big_by_req[r]
-                dev_ok = bool(admitted[r]) if locs else True
-                big_ok = all(ok for _j, ok, *_rest in bigs)
-                if request.load:
-                    for (s, i), (j, adjust) in zip(locs, dev_j):
-                        c = request.ordered[j]
-                        c.remaining = max(int(remaining[s, i]) - adjust, 0)
-                        c.expires_in = float(ttl_ms[s, i]) / 1000.0
-                    for j, _ok, rem, ttl, _key, _c, _d in bigs:
-                        c = request.ordered[j]
-                        c.remaining = rem
-                        c.expires_in = ttl
-                if dev_ok and big_ok:
-                    auths.append(Authorization.OK)
-                    for _j, _ok, _rem, _ttl, key, c, d in bigs:
-                        big_applies.append((key, d, c.window_seconds))
+    def finish_check_many(
+        self, handle: "_ShardedHandle"
+    ) -> List[Authorization]:
+        """Transfer and decode one in-flight batch: load_counters side
+        effects, first-limited naming, and the non-load early-return slot
+        release (guarded by the watched-slot seq so a later in-flight
+        batch's re-use of the slot wins — same contract as
+        TpuStorage.finish_check_many)."""
+        import jax
+
+        result = handle.result
+        try:
+            admitted, hit_ok, remaining, ttl_ms = jax.device_get((
+                result.admitted, result.hit_ok, result.remaining,
+                result.ttl_ms,
+            ))
+        except BaseException:
+            with self._lock:
+                self._unproject_big(handle.big_projected)
+                # The watch entries must not outlive the batch either: a
+                # stale seq would suppress every later batch's release
+                # of these slots (leaking qualified slots under repeated
+                # device faults).
+                watched = self._watched
+                for wkey in handle.watch_touches:
+                    if watched.get(wkey) == handle.seq:
+                        del watched[wkey]
+            raise
+
+        requests = handle.requests
+        shard_ids, pos = handle.shard_ids, handle.pos
+        starts = handle.starts
+        j_l = handle.j_l
+        R = len(requests)
+        # Vectorized flat views (one fancy gather per output, not a
+        # Python pair loop per hit).
+        ok_flat = hit_ok[shard_ids, pos]
+        rem_flat = ttl_flat = None
+        if any(request.load for request in requests):
+            rem_flat = remaining[shard_ids, pos]
+            ttl_flat = ttl_ms[shard_ids, pos]
+        if handle.coupled:
+            adm_by_req = admitted[:R]
+        else:
+            adm_by_req = np.ones(R, bool)
+            mask = handle.home >= 0
+            if mask.any():
+                adm_by_req[mask] = admitted[
+                    handle.home[mask], handle.local_ids[mask]
+                ]
+        use_counts = None  # computed lazily, only when a release is due
+
+        auths: List[Authorization] = []
+        big_applies: List[Tuple[tuple, int, int]] = []
+        releases: List[Tuple[Counter, int, int, bool]] = []
+        for r, request in enumerate(requests):
+            s0, s1 = int(starts[r]), int(starts[r + 1])
+            bigs = handle.big_by_req[r]
+            dev_ok = bool(adm_by_req[r]) if s1 > s0 else True
+            big_ok = all(ok for _j, ok, *_rest in bigs)
+            if request.load:
+                adjust = handle.adjust_by_req[r]
+                for i in range(s0, s1):
+                    c = request.ordered[int(j_l[i])]
+                    c.remaining = max(int(rem_flat[i]) - adjust, 0)
+                    c.expires_in = float(ttl_flat[i]) / 1000.0
+                for j, _ok, rem, ttl, _key, _c, _d in bigs:
+                    c = request.ordered[j]
+                    c.remaining = rem
+                    c.expires_in = ttl
+            if dev_ok and big_ok:
+                auths.append(Authorization.OK)
+                for _j, _ok, _rem, _ttl, key, c, d in bigs:
+                    big_applies.append((key, d, c.window_seconds))
+                continue
+            oks_by_j = {
+                int(j_l[i]): bool(ok_flat[i]) for i in range(s0, s1)
+            }
+            for j, ok, *_rest in bigs:
+                oks_by_j[j] = ok
+            limited_js = [j for j, ok in oks_by_j.items() if not ok]
+            first = min(limited_js) if limited_js else 0
+            auths.append(
+                Authorization.limited_by(request.ordered[first].limit.name)
+            )
+            if not request.load:
+                # Non-load early-return semantics (in_memory.rs:110-133):
+                # drop qualified slots allocated past the first limited
+                # hit, when no other hit in the batch shares them.
+                for j, c, shard, slot, is_g in handle.fresh_by_req[r]:
+                    if j <= first:
+                        continue
+                    if use_counts is None:
+                        use_counts = self._slot_use_counts(
+                            shard_ids, handle.slot_col, handle.glob_col
+                        )
+                    use = (-slot - 1) if is_g else (shard << 32) + slot
+                    if use_counts.get(use) == 1:
+                        releases.append((c, shard, slot, is_g))
+        with self._lock:
+            self._unproject_big(handle.big_projected)
+            self._apply_big(big_applies, handle.now)
+            watched = self._watched
+            for c, shard, slot, is_g in releases:
+                wkey = (-1, slot) if is_g else (shard, slot)
+                if watched.get(wkey) != handle.seq:
                     continue
-                oks_by_j = {
-                    j: bool(hit_ok[s, i])
-                    for (s, i), (j, _a) in zip(locs, dev_j)
-                }
-                for j, ok, *_rest in bigs:
-                    oks_by_j[j] = ok
-                limited_js = [j for j, ok in oks_by_j.items() if not ok]
-                first = min(limited_js) if limited_js else 0
-                auths.append(
-                    Authorization.limited_by(request.ordered[first].limit.name)
+                # The table must still map this key to this slot — an
+                # intervening delete/evict/clear means the slot was
+                # already freed (releasing again would double-free it).
+                key = self._key_of(c)
+                qualified = c.is_qualified()
+                table = self._gtable if is_g else self._tables[shard]
+                mapped = (
+                    table.qualified.get(key) == slot
+                    if qualified else table.simple.get(key) == slot
                 )
-                if not request.load:
-                    # Non-load early-return semantics (in_memory.rs:110-133):
-                    # drop qualified slots allocated past the first limited
-                    # hit, when no other hit in the batch shares them.
-                    for j, c, shard, slot, is_g in fresh_by_req[r]:
-                        use = (1 if is_g else 0, slot if is_g else shard, slot)
-                        if j > first and use_count.get(use) == 1:
-                            self._release(c, shard, slot, is_g)
-            self._unproject_big(big_projected)
-            self._apply_big(big_applies, now)
+                if mapped:
+                    self._release(c, shard, slot, is_g)
+            for wkey in handle.watch_touches:
+                if watched.get(wkey) == handle.seq:
+                    del watched[wkey]
         return auths
+
+    @staticmethod
+    def _slot_use_counts(shard_ids, slot_col, glob_col) -> Dict[int, int]:
+        """Batch-wide use count per device cell, as a composite-int map
+        (negative = global slot). Vectorized; built only when a non-load
+        limited request actually has fresh slots to consider releasing."""
+        comp = np.where(
+            glob_col,
+            -(slot_col.astype(np.int64) + 1),
+            shard_ids.astype(np.int64) * (1 << 32) + slot_col,
+        )
+        uniq, cnt = np.unique(comp, return_counts=True)
+        return dict(zip(uniq.tolist(), cnt.tolist()))
+
+    def check_many(self, requests: List[_Request]) -> List[Authorization]:
+        """One sharded launch deciding a batch of requests in list order
+        (same exactness contract as TpuStorage.check_many; cross-shard
+        requests couple via pmin when present)."""
+        return self.finish_check_many(self.begin_check_many(requests))
 
     def _release(self, counter: Counter, shard: int, slot: int, is_g: bool):
         key = self._key_of(counter)
@@ -481,10 +726,9 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                         # cell (global slots are zeroed at release —
                         # _zero_global_slots — so only locals can carry a
                         # stale occupant here).
-                        self._state = ShardedCounterState(
-                            self._state.values.at[shard, slot].set(0),
-                            self._state.expiry_ms.at[shard, slot].set(0),
-                        )
+                        rows = np.full((self._n, 1), self._scratch, np.int32)
+                        rows[shard, 0] = slot
+                        self._clear_rows(rows)
 
     def update_counter(self, counter: Counter, delta: int) -> None:
         self.apply_deltas([(counter, delta)])
@@ -504,13 +748,18 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         region) for the authoritative values."""
         for _counter, delta in items:
             require_nonnegative_delta(delta)
+        import jax
+
         with self._lock:
             now_ms = self._now_ms()
             now = self._clock()
-            # rows: (slot, delta, window_ms, fresh, bucket)
-            per_shard: List[List[Tuple[int, int, int, bool, bool]]] = [
-                [] for _ in range(self._n)
-            ]
+            # Flat staging columns (the begin_check_many discipline).
+            app_l: List[int] = []
+            slot_l: List[int] = []
+            delta_l: List[int] = []
+            win_l: List[int] = []
+            fresh_l: List[bool] = []
+            bucket_l: List[bool] = []
             # loc: (shard, slot, is_global, counter) or ("big", value, ttl)
             locs: List[tuple] = []
             for counter, delta in items:
@@ -524,37 +773,28 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                 shard, slot, is_fresh, is_g = self._slot_for(
                     counter, create=True
                 )
-                app = self._app_shard() if is_g else shard
-                win, is_bucket = _hit_lane(counter)
-                per_shard[app].append((
-                    slot,
-                    min(int(delta), K.MAX_DELTA_CAP),
-                    win,
-                    is_fresh,
-                    is_bucket,
-                ))
+                win, is_bucket = self._lane_of(counter)
+                app_l.append(self._app_shard() if is_g else shard)
+                slot_l.append(slot)
+                delta_l.append(min(int(delta), K.MAX_DELTA_CAP))
+                win_l.append(win)
+                fresh_l.append(is_fresh)
+                bucket_l.append(is_bucket)
                 locs.append((shard, slot, is_g, counter))
             n = self._n
-            H = _bucket(max(max(len(p) for p in per_shard), 1))
-            slots = np.full((n, H), self._scratch, np.int32)
-            deltas = np.zeros((n, H), np.int32)
-            windows = np.zeros((n, H), np.int32)
-            fresh = np.zeros((n, H), bool)
-            bucket = np.zeros((n, H), bool)
-            for s in range(n):
-                rows = per_shard[s]
-                if not rows:
-                    continue
-                m = len(rows)
-                cols = list(zip(*rows))
-                slots[s, :m] = cols[0]
-                deltas[s, :m] = cols[1]
-                windows[s, :m] = cols[2]
-                fresh[s, :m] = cols[3]
-                bucket[s, :m] = cols[4]
+            app_ids = np.asarray(app_l, np.int32)
+            counts, pos = _partition_positions(app_ids, n)
+            H = _bucket(max(int(counts.max(initial=0)), 1))
+            cols = _scatter_rows(app_ids, pos, n, H, (
+                (slot_l, self._scratch, np.int32),
+                (delta_l, 0, np.int32),
+                (win_l, 0, np.int32),
+                (fresh_l, False, bool),
+                (bucket_l, False, bool),
+            ))
+            cols = jax.device_put(tuple(cols), self._sharding)
             self._state = sharded_update(
-                self._mesh, self._state, slots, deltas, windows, fresh,
-                bucket, np.int32(now_ms),
+                self._mesh, self._state, *cols, np.int32(now_ms),
             )
             # Batched authoritative reads: one gather per slot family.
             dev_locs = [loc for loc in locs if loc[0] != "big"]
@@ -674,16 +914,19 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             if shard_idx:
                 si = np.asarray(shard_idx, np.int32)
                 li = np.asarray(slot_idx, np.int32)
-                self._state = ShardedCounterState(
-                    self._state.values.at[si, li].set(0),
-                    self._state.expiry_ms.at[si, li].set(0),
+                counts, pos = _partition_positions(si, self._n)
+                (rows,) = _scatter_rows(
+                    si, pos, self._n, max(int(counts.max(initial=0)), 1),
+                    ((li, self._scratch, np.int32),),
                 )
+                self._clear_rows(rows)
             self._delete_big(limits)
 
     def clear(self) -> None:
         with self._lock:
             self._reset_tables()
             self._clear_big()
+            self._watched.clear()
             self._state = make_sharded_table(
                 self._mesh, self._local_capacity
             )

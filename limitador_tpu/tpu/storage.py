@@ -82,6 +82,45 @@ def _staged(values, H: int, fill, dtype) -> np.ndarray:
     return arr
 
 
+def _partition_positions(
+    group_ids: np.ndarray, n_groups: int
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized grouped cumcount: for flat staged rows labeled with a
+    group (a shard id, a request's home shard), return
+    ``(counts[n_groups], pos)`` where ``pos[i]`` is row i's index WITHIN
+    its group, counted in input order. This is the host side of the
+    sharded partition step — one argsort + two cumsums, no per-row
+    Python (tests/test_perf_smoke.py budgets it)."""
+    m = group_ids.shape[0]
+    counts = np.bincount(group_ids, minlength=n_groups)
+    order = np.argsort(group_ids, kind="stable")
+    starts = np.concatenate(([0], np.cumsum(counts)[:-1]))
+    pos = np.empty(m, np.int64)
+    pos[order] = np.arange(m, dtype=np.int64) - np.repeat(starts, counts)
+    return counts, pos
+
+
+def _scatter_rows(
+    shard_ids: np.ndarray,
+    pos: np.ndarray,
+    n: int,
+    H: int,
+    columns: Sequence[Tuple[Sequence, object, type]],
+) -> List[np.ndarray]:
+    """Scatter flat hit columns into per-shard ``[n, H]`` staging arrays
+    (``(values, fill, dtype)`` per column) — one fancy-index store per
+    column, pad rows pre-filled with the inert default. Flat order is
+    request order, and ``pos`` counts per shard in flat order, so each
+    shard's rows stay in request order (the kernel's nondecreasing
+    req_ids contract)."""
+    out = []
+    for values, fill, dtype in columns:
+        arr = np.full((n, H), fill, dtype)
+        arr[shard_ids, pos] = values
+        out.append(arr)
+    return out
+
+
 def _hit_lane(counter: Counter) -> Tuple[int, bool]:
     """Per-hit (windows_ms lane, bucket flag) for a device-eligible
     counter: the window for fixed windows, the GCRA emission interval
@@ -645,9 +684,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             )
         except BaseException:
             # The projection reservations must not leak when the transfer
-            # fails, else those big counters under-admit forever.
+            # fails, else those big counters under-admit forever — and
+            # neither may the watch entries: a stale seq would suppress
+            # every later batch's release of these slots.
             with self._lock:
                 self._unproject_big(handle.big_projected)
+                watched = self._watched_slots
+                for slot in handle.watch_touches:
+                    if watched.get(slot) == handle.seq:
+                        del watched[slot]
             raise
 
         auths: List[Authorization] = []

@@ -42,6 +42,7 @@ SERVER_ENV_VARS = frozenset({
     "TRACING_ENDPOINT", "METRIC_LABELS", "METRIC_LABELS_FILE",
     "RATE_LIMIT_HEADERS", "STRUCTURED_LOGS", "LIMITADOR_LOG", "RUST_LOG",
     "LIMITS_FILE_POLL_INTERVAL", "TPU_TABLE_CAPACITY", "TPU_BATCH_DELAY_US",
+    "TPU_DISPATCH_CHUNK",
     "TPU_PIPELINE", "TPU_NATIVE_INGRESS", "GLOBAL_NAMESPACES",
     "GLOBAL_REGION", "AUTHORITY_LISTEN", "AUTHORITY_URL",
     "REDIS_LOCAL_CACHE_BATCH_SIZE", "REDIS_LOCAL_CACHE_FLUSHING_PERIOD_MS",

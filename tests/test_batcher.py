@@ -225,3 +225,96 @@ def test_threaded_begin_finish_interleaving_stays_exact():
         t.join()
     assert not errors, errors
     assert sum(admitted) == 50  # 4x25=100 attempts, exactly max admitted
+
+
+def test_chunk_planner_modes_and_splits():
+    from limitador_tpu.tpu.batcher import ChunkPlanner
+
+    # Fixed mode: pinned chunk size, split respects item boundaries; a
+    # tail smaller than the chunk folds into the last launch.
+    planner = ChunkPlanner(dispatch_chunk=4)
+    assert planner.split([2, 2, 2, 2]) == [(0, 2), (2, 4)]
+    assert planner.split([2, 2, 2, 2, 2]) == [(0, 2), (2, 5)]
+    # Monolithic mode never splits.
+    assert ChunkPlanner(dispatch_chunk=0).split([1] * 100) == [(0, 100)]
+    # Auto without a device-time signal stays monolithic.
+    auto = ChunkPlanner()
+    assert auto.split([1] * 100) == [(0, 100)]
+    # With a signal, chunks target the latency budget on the
+    # power-of-two bucket grid (no per-flush program churn)...
+    auto.observe(0.002, 1000)  # 2us/hit -> 1000 hits per 2ms target
+    assert auto.chunk_hits() == 1024
+    # ...and tighten to half-budget once queueing ate the budget.
+    assert auto.chunk_hits(queue_wait_s=0.05) == 512
+    # Small flushes stay monolithic; a sub-MIN tail folds back.
+    assert auto.split([1] * 1500) == [(0, 1500)]
+    ranges = auto.split([1] * 2300)
+    assert ranges == [(0, 1024), (1024, 2300)]  # 1276-tail kept whole
+    ranges = auto.split([1] * 2100)
+    assert ranges[-1][1] == 2100
+    sizes = [hi - lo for lo, hi in ranges]
+    assert all(s >= 512 for s in sizes[1:]) or len(ranges) == 1
+
+
+def test_chunk_planner_split_caps_launch_count():
+    from limitador_tpu.tpu.batcher import ChunkPlanner
+
+    planner = ChunkPlanner(dispatch_chunk=8)
+    ranges = planner.split([1] * 1000)
+    assert len(ranges) <= ChunkPlanner.MAX_SPLITS
+    assert ranges[0][0] == 0 and ranges[-1][1] == 1000
+    # Contiguous, non-overlapping coverage.
+    for (l1, h1), (l2, h2) in zip(ranges, ranges[1:]):
+        assert h1 == l2
+
+
+def test_chunked_dispatch_through_micro_batcher_is_exact():
+    """A fixed dispatch_chunk splits a coalesced batch into several
+    kernel launches; admission must stay exactly max_value across the
+    chunk boundaries (the state array threads through sub-batches)."""
+    async def main():
+        storage = AsyncTpuStorage(
+            TpuStorage(capacity=1 << 10), max_delay=0.002,
+            dispatch_chunk=8,
+        )
+        # Chunks need >= 2 * chunk hits in one flush to split.
+        limiter = AsyncRateLimiter(storage)
+        limiter.add_limit(Limit("ns", 10, 60, [], ["u"]))
+        ctx = Context({"u": "hot"})
+        results = await asyncio.gather(*[
+            limiter.check_rate_limited_and_update("ns", ctx, 1)
+            for _ in range(40)
+        ])
+        await storage.close()
+        return sum(1 for r in results if not r.limited)
+
+    loop = asyncio.new_event_loop()
+    try:
+        assert loop.run_until_complete(main()) == 10
+    finally:
+        loop.close()
+
+
+def test_chunk_telemetry_reaches_recorder():
+    from limitador_tpu.observability.device_plane import DeviceStatsRecorder
+
+    class _Hist:
+        def __init__(self):
+            self.observed = []
+
+        def observe(self, v):
+            self.observed.append(v)
+
+    class _Metrics:
+        def __init__(self):
+            self.dispatch_chunk_hits = _Hist()
+            self.dispatch_chunk_splits = _Hist()
+
+    metrics = _Metrics()
+    rec = DeviceStatsRecorder()  # metrics=None path must not blow up
+    rec.record_chunks([8, 8, 4])
+    rec = DeviceStatsRecorder.__new__(DeviceStatsRecorder)
+    rec.metrics = metrics
+    rec.record_chunks([8, 8, 4])
+    assert metrics.dispatch_chunk_splits.observed == [3]
+    assert metrics.dispatch_chunk_hits.observed == [8, 8, 4]

@@ -616,3 +616,40 @@ def test_bench_scraper_parses_exposition(monkeypatch):
     assert 4.0 <= out["queue_wait_p99_ms"] <= 100.0
     assert out["batch_fill_ratio"] == round(1.6 / 4, 4)
     assert out["deadline_flush_share"] == round(2 / 3, 4)
+
+
+def test_sharded_launch_variants_preseeded_and_in_sync():
+    """The sharded_launches label set renders zeroed before traffic, and
+    the inlined variant tuple in metrics.py stays in sync with
+    tpu.sharded.LAUNCH_VARIANTS (the inline avoids importing jax into
+    non-TPU servers)."""
+    from limitador_tpu.tpu.sharded import LAUNCH_VARIANTS
+
+    text = PrometheusMetrics().render().decode()
+    assert set(LAUNCH_VARIANTS) == {"lean", "coupled", "global"}
+    for variant in LAUNCH_VARIANTS:
+        assert (
+            f'sharded_launches_total{{variant="{variant}"}} 0.0' in text
+        ), variant
+
+
+def test_sharded_launches_polled_from_library_stats():
+    """The variant->count map a sharded AsyncTpuStorage exposes through
+    library_stats converts to labeled counter increments at render time
+    (cumulative, baseline-converted like the plan-cache counts)."""
+    class _Source:
+        def __init__(self):
+            self.launches = {"lean": 3, "coupled": 1, "global": 0}
+
+        def library_stats(self):
+            return {"sharded_launches": dict(self.launches)}
+
+    m = PrometheusMetrics()
+    source = _Source()
+    m.attach_library_source(source)
+    text = m.render().decode()
+    assert 'sharded_launches_total{variant="lean"} 3.0' in text
+    assert 'sharded_launches_total{variant="coupled"} 1.0' in text
+    source.launches["lean"] = 5  # +2 since the last render
+    text = m.render().decode()
+    assert 'sharded_launches_total{variant="lean"} 5.0' in text

@@ -71,3 +71,41 @@ def test_metric_registry_lint_is_clean_and_catches_drift(tmp_path):
                for f in findings)
     assert any("admission_declared_only" in f and "missing from" in f
                for f in findings)
+
+
+def test_donation_lint_is_clean_and_catches_missing_donation(tmp_path):
+    """Every table-carrying jax.jit kernel in the repo donates its
+    buffers — and the lint must actually flag a site that stops
+    donating (all three jit spellings) while leaving read-only and
+    donating kernels alone."""
+    from limitador_tpu.tools.lint import lint_donation
+
+    assert lint_donation(REPO_ROOT) == []
+
+    ops = tmp_path / "limitador_tpu" / "ops"
+    ops.mkdir(parents=True)
+    (ops / "kernel.py").write_text(
+        "import functools\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def bare_kernel(state, slots):\n"
+        "    return state\n"
+        "@functools.partial(jax.jit, static_argnames=('axis',))\n"
+        "def partial_kernel(values, expiry, axis='x'):\n"
+        "    return values, expiry\n"
+        "@functools.partial(jax.jit, donate_argnums=(0,))\n"
+        "def donating_kernel(state, slots):\n"
+        "    return state\n"
+        "@jax.jit\n"
+        "def read_slots(state, slots):\n"
+        "    return state.values\n"
+        "def _impl(state, slots):\n"
+        "    return state\n"
+        "wrapped = functools.partial(jax.jit)(_impl)\n"
+    )
+    findings = lint_donation(tmp_path)
+    assert any("bare_kernel" in f for f in findings)
+    assert any("partial_kernel" in f for f in findings)
+    assert any("_impl" in f for f in findings)
+    assert not any("donating_kernel" in f for f in findings)
+    assert not any("read_slots" in f for f in findings)  # exempt

@@ -25,6 +25,11 @@ NATIVE_SUBMIT_BUDGET_US = 120.0
 #: per-request budget for the bulk engine lane (µs). Steady state is
 #: ~2-3 µs here; 25 µs catches a per-row Python regression.
 ENGINE_BUDGET_US = 25.0
+#: per-hit budget for the host-side per-shard partition step of the
+#: sharded staging pass (µs). The vectorized path (one argsort + two
+#: cumsums + one fancy store per column) measures ~0.1 µs/hit on the
+#: throttled CI box; a per-row Python fallback measures ~1-3 µs.
+PARTITION_BUDGET_US = 0.8
 
 
 def _blobs(n, users=512):
@@ -94,6 +99,44 @@ def test_native_submit_per_request_overhead_within_budget(pipeline):
     assert per_req_us <= NATIVE_SUBMIT_BUDGET_US, (
         f"native submit lane costs {per_req_us:.1f} µs/request "
         f"(budget {NATIVE_SUBMIT_BUDGET_US} µs)"
+    )
+
+
+def test_sharded_partition_step_stays_vectorized():
+    """Budget on the host-side per-shard partition of the sharded
+    staging pass (storage.py ``_partition_positions``/``_scatter_rows``):
+    it must stay one vectorized pass — a per-row Python partition (the
+    pre-ISSUE-4 per-shard list appends) would blow this budget by an
+    order of magnitude and silently re-tax every multi-chip batch."""
+    import time as _time
+
+    from limitador_tpu.tpu.storage import (
+        _partition_positions,
+        _scatter_rows,
+    )
+
+    n_shards = 8
+    nhits = 1 << 16
+    rng = np.random.default_rng(5)
+    shard_ids = rng.integers(0, n_shards, nhits).astype(np.int32)
+    slots = rng.integers(0, 1 << 17, nhits).astype(np.int32)
+    deltas = np.ones(nhits, np.int32)
+    best = float("inf")
+    for _ in range(3):
+        t0 = _time.perf_counter()
+        counts, pos = _partition_positions(shard_ids, n_shards)
+        H = 1 << 14  # next bucket above ~8200 hits/shard
+        _cols = _scatter_rows(shard_ids, pos, n_shards, H, (
+            (slots, 0, np.int32),
+            (deltas, 0, np.int32),
+        ))
+        best = min(best, _time.perf_counter() - t0)
+    assert int(counts.sum()) == nhits
+    per_hit_us = best / nhits * 1e6
+    assert per_hit_us <= PARTITION_BUDGET_US, (
+        f"per-shard partition costs {per_hit_us:.2f} µs/hit "
+        f"(budget {PARTITION_BUDGET_US} µs — did per-row Python sneak "
+        "back into the staging pass?)"
     )
 
 

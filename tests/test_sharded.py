@@ -1,5 +1,7 @@
 """Multi-chip sharded counter table tests (8 virtual CPU devices)."""
 
+import re
+
 import jax
 import numpy as np
 import pytest
@@ -8,6 +10,7 @@ from limitador_tpu.parallel import (
     make_mesh,
     make_sharded_table,
     sharded_check_and_update,
+    sharded_clear_cells,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -120,6 +123,85 @@ def test_global_counter_psum_read():
         mesh, state, now_ms=np.int32(1000), **b2
     )
     assert not np.asarray(res2.admitted)[0]
+
+
+def _lower_hlo(local_cap=64, h=8, **variant) -> str:
+    mesh = make_mesh()
+    n = mesh.shape["shard"]
+    state = make_sharded_table(mesh, local_cap)
+    b = _empty_batch(n, h, local_cap)
+    lowered = sharded_check_and_update.lower(
+        mesh, state, b["slots"], b["deltas"], b["maxes"], b["windows_ms"],
+        b["req_ids"], b["fresh"], b["bucket"], b["is_global"],
+        np.int32(1000), global_region=8, **variant,
+    )
+    return lowered.compile().as_text()
+
+
+def _full_table_ops(hlo: str, n: int, local_cap: int):
+    """HLO ops whose result or operand materializes the FULL (unsharded)
+    counter table [n, L+1] — the signature of accidental replication.
+    Per-shard views are [1, L+1] / s32[L+1]; the full table only appears
+    when GSPMD decides to all-gather it (or slice a replicated copy)."""
+    full = rf"\[{n},{local_cap + 1}\]|\[{n * (local_cap + 1)}\]"
+    return [
+        line.strip()
+        for line in hlo.splitlines()
+        if re.search(r"(all-gather|dynamic-slice|gather)\(", line)
+        and re.search(full, line)
+    ]
+
+
+def test_hlo_lean_launch_has_no_collectives_or_replication():
+    """HLO regression lint (ISSUE 4): the collective-lean variant must
+    compile to ZERO cross-device ops — no all-gather, no all-reduce
+    (psum/pmin), no collective-permute — and must never materialize the
+    full table on any device (no full-table gather/dynamic-slice).
+    Accidental re-replication of the batch or table shows up here before
+    it shows up as negative scaling in a BENCH round."""
+    mesh = make_mesh()
+    n, local_cap = mesh.shape["shard"], 64
+    hlo = _lower_hlo(local_cap, coupled=False, has_global=False)
+    for op in ("all-gather", "all-reduce", "collective-permute",
+               "all-to-all"):
+        assert f"{op}(" not in hlo, f"lean HLO contains {op}"
+    offenders = _full_table_ops(hlo, n, local_cap)
+    assert not offenders, f"full-table access leaked into HLO: {offenders}"
+
+
+def test_hlo_coupled_launch_all_reduces_but_never_gathers_the_table():
+    """The coupled variant legitimately all-reduces (pmin vote / psum
+    base) but must still never all-gather or slice the full counter
+    table — hits stay owner-sharded even when requests couple."""
+    mesh = make_mesh()
+    n, local_cap = mesh.shape["shard"], 64
+    hlo = _lower_hlo(local_cap, coupled=True, has_global=True)
+    assert "all-reduce" in hlo  # the pmin/psum coupling really compiled
+    assert "all-gather(" not in hlo
+    offenders = _full_table_ops(hlo, n, local_cap)
+    assert not offenders, f"full-table access leaked into HLO: {offenders}"
+
+
+def test_sharded_clear_cells_zeroes_rows_in_place():
+    mesh = make_mesh()
+    n = mesh.shape["shard"]
+    local_cap = 32
+    state = make_sharded_table(mesh, local_cap)
+    b = _empty_batch(n, 4, local_cap)
+    b["slots"][:, 0] = 5
+    b["deltas"][:, 0] = 3
+    b["maxes"][:, 0] = 100
+    b["windows_ms"][:, 0] = 60_000
+    b["req_ids"][:, 0] = 0
+    state, _res = sharded_check_and_update(
+        mesh, state, now_ms=np.int32(1000), **b
+    )
+    rows = np.full((n, 8), local_cap, np.int32)  # scratch-padded
+    rows[0, 0] = 5  # clear only shard 0's cell
+    state = sharded_clear_cells(mesh, state, rows)
+    values = np.asarray(jax.device_get(state.values))
+    assert values[0, 5] == 0
+    assert (values[1:, 5] == 3).all()  # other shards untouched
 
 
 def test_window_expiry_sharded():

@@ -224,6 +224,256 @@ def test_randomized_op_stream_parity_vs_oracle():
         assert c1 == c2, f"{ns}: final counters diverged"
 
 
+def test_launch_variant_classification():
+    """Staging classifies every batch: single-counter traffic runs the
+    collective-free lean variant; multi-limit namespaces whose counters
+    hash to different shards couple; global-namespace hits run the psum
+    variant (the sharded_launches families)."""
+    storage = make_storage(global_namespaces=["g"])
+    limiter = RateLimiter(storage)
+    limiter.add_limit(Limit("ns", 10, 60, [], ["u"]))
+    limiter.add_limit(Limit("g", 10, 60, [], ["u"]))
+    base = dict(storage._launches)
+    limiter.check_rate_limited_and_update("ns", Context({"u": "a"}), 1)
+    assert storage._launches["lean"] == base["lean"] + 1
+    limiter.check_rate_limited_and_update("g", Context({"u": "a"}), 1)
+    assert storage._launches["global"] == base["global"] + 1
+    # Two limits -> one request with two counters; find a user whose two
+    # counters land on different shards, which must couple.
+    limiter2 = RateLimiter(make_storage())
+    limiter2.add_limit(Limit("ns2", 100, 3600, [], ["u"], name="a"))
+    limiter2.add_limit(Limit("ns2", 100, 60, [], ["u"], name="b"))
+    st = limiter2.storage.counters
+    for i in range(64):
+        before = dict(st._launches)
+        limiter2.check_rate_limited_and_update(
+            "ns2", Context({"u": f"u{i}"}), 1
+        )
+        if st._launches["coupled"] == before["coupled"] + 1:
+            break
+    else:
+        raise AssertionError("no user coupled across shards in 64 tries")
+    # And the tallies surface through the batcher's library_stats.
+    from limitador_tpu.tpu.batcher import AsyncTpuStorage
+
+    stats = AsyncTpuStorage(storage=storage).library_stats()
+    assert stats["sharded_launches"]["lean"] >= 1
+
+
+def test_parity_vs_oracle_under_eviction_pressure():
+    """Eviction parity: a tiny qualified cache forces constant LRU
+    eviction while keys cycle in phases separated by clock advances
+    longer than every window — an evicted-then-revived counter restarts
+    exactly like an expired one, so the oracle (which never evicts) must
+    stay bit-identical decision for decision."""
+    class FakeClock:
+        def __init__(self):
+            self.now = 1_700_000_000.0
+
+        def __call__(self):
+            return self.now
+
+        def advance(self, s):
+            self.now += s
+
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    clock = FakeClock()
+    mem = RateLimiter(InMemoryStorage(10_000, clock=clock))
+    sharded = RateLimiter(
+        # 16 qualified slots mesh-wide (2 per shard on the 8-mesh).
+        TpuShardedStorage(
+            local_capacity=1024, global_region=32, cache_size=16,
+            clock=clock,
+        )
+    )
+    limit = Limit("ns", 3, 10, [], ["u"])
+    for limiter in (mem, sharded):
+        limiter.add_limit(limit)
+    evicting = sharded.storage.counters
+    for phase in range(4):
+        for u in range(40):  # 40 keys through 16 slots: heavy eviction
+            ctx = Context({"u": f"p{phase}-u{u}"})
+            for delta in (1, 2, 1):
+                r1 = mem.check_rate_limited_and_update("ns", ctx, delta)
+                r2 = sharded.check_rate_limited_and_update("ns", ctx, delta)
+                assert r1.limited == r2.limited, (phase, u, delta)
+        clock.advance(11.0)  # all windows expired before keys revisit
+    assert sum(t.evictions for t in evicting._tables) > 0
+
+
+def test_apply_deltas_mixed_global_and_local_one_batch(fake_clock):
+    """apply_deltas replay (the Report/import path) with psum-global and
+    owner-local counters mixed in ONE batch: authoritative values match
+    the in-memory oracle's update path, and a follow-up check_many sees
+    the replayed state exactly."""
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    mem = InMemoryStorage(10_000, clock=fake_clock)
+    storage = make_storage(
+        global_namespaces=["g"], clock=fake_clock
+    )
+    lim_l = Limit("ns", 10, 60, [], ["u"])
+    lim_g = Limit("g", 20, 60, [], ["u"])
+    items = [
+        (Counter(lim_l, {"u": "a"}), 4),
+        (Counter(lim_g, {"u": "shared"}), 7),
+        (Counter(lim_l, {"u": "b"}), 2),
+        (Counter(lim_g, {"u": "shared"}), 5),
+        (Counter(lim_l, {"u": "a"}), 1),
+    ]
+    out = storage.apply_deltas(items)
+    for counter, delta in items:
+        mem.update_counter(counter, delta)
+    # Authoritative values: the LAST apply of each identity reports the
+    # running total (a=5 after its second delta, shared=12).
+    assert out[3][0] == 12  # psum of partials spread over app shards
+    assert out[4][0] == 5
+    # Decisions over the replayed state match the oracle.
+    for counter, delta, in ((Counter(lim_l, {"u": "a"}), 5),
+                            (Counter(lim_l, {"u": "a"}), 6),
+                            (Counter(lim_g, {"u": "shared"}), 8),
+                            (Counter(lim_g, {"u": "shared"}), 9)):
+        assert (
+            storage.check_and_update([counter], delta, False).limited
+            == mem.check_and_update([counter], delta, False).limited
+        ), (counter.namespace, delta)
+
+
+def test_parity_vs_oracle_across_snapshot_restore(tmp_path, fake_clock):
+    """Snapshot/restore parity: stream against the oracle, checkpoint
+    mid-stream, restore into a fresh storage, keep streaming — decisions
+    and final counter state stay identical through the restart."""
+    import random
+
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    mem = RateLimiter(InMemoryStorage(10_000, clock=fake_clock))
+    sharded = RateLimiter(
+        TpuShardedStorage(
+            local_capacity=1024, global_region=32,
+            global_namespaces=["g"], clock=fake_clock,
+        )
+    )
+    limits = [
+        Limit("ns", 5, 60, [], ["u"]),
+        Limit("g", 15, 60, [], ["u"]),
+    ]
+    for limiter in (mem, sharded):
+        for lim in limits:
+            limiter.add_limit(lim)
+    rng = random.Random(11)
+    users = [f"u{i}" for i in range(6)]
+
+    def step(sh, n):
+        for _ in range(n):
+            ns = rng.choice(["ns", "g"])
+            ctx = Context({"u": rng.choice(users)})
+            delta = rng.choice([1, 2])
+            r1 = mem.check_rate_limited_and_update(ns, ctx, delta)
+            r2 = sh.check_rate_limited_and_update(ns, ctx, delta)
+            assert r1.limited == r2.limited
+            assert r1.limit_name == r2.limit_name
+
+    step(sharded, 80)
+    path = str(tmp_path / "mid.ckpt")
+    sharded.storage.counters.snapshot(path)
+    restored = RateLimiter(
+        TpuShardedStorage.restore(path, clock=fake_clock)
+    )
+    for lim in limits:
+        restored.add_limit(lim)
+    step(restored, 80)
+    for ns in ("ns", "g"):
+        c1 = {(tuple(c.set_variables.items())): c.remaining
+              for c in mem.get_counters(ns)}
+        c2 = {(tuple(c.set_variables.items())): c.remaining
+              for c in restored.get_counters(ns)}
+        assert c1 == c2, ns
+
+
+def test_begin_finish_pipelining_is_exact():
+    """Two batches in flight at once (begin N+1 before finish N): the
+    state array threads through launches under the lock, so decisions
+    equal the serial order — and a slot freshly allocated by batch N
+    then reused by in-flight batch N+1 must survive N's non-load
+    early-release (the watched-slot seq guard)."""
+    from limitador_tpu.tpu.storage import _Request
+
+    storage = make_storage()
+    limiter = RateLimiter(storage)  # registers limits for naming
+    tight = Limit("ns", 1, 60, [], ["u"], name="tight")
+    wide = Limit("ns", 100, 3600, [], ["u"], name="wide")
+    limiter.add_limit(tight)
+    limiter.add_limit(wide)
+
+    def req(u, delta=1):
+        return _Request(
+            [Counter(tight, {"u": u}), Counter(wide, {"u": u})], delta,
+            False,
+        )
+
+    # Batch 1 exhausts "hot" (tight limit 1) plus one more that gets
+    # rejected — its wide counter slot is fresh and release-eligible.
+    h1 = storage.begin_check_many([req("hot"), req("hot")])
+    # Batch 2 (launched before finish 1) reuses the same counters: the
+    # watched-slot guard must keep batch 1's finish from releasing the
+    # slot batch 2's kernel already targets.
+    h2 = storage.begin_check_many([req("hot")])
+    a1 = storage.finish_check_many(h1)
+    a2 = storage.finish_check_many(h2)
+    assert [a.limited for a in a1] == [False, True]
+    assert a1[1].limit_name == "tight"
+    assert [a.limited for a in a2] == [True]
+    # The wide counter kept exactly the one admitted hit.
+    counters = {c.limit.name: c for c in storage.get_counters({wide})}
+    assert counters["wide"].remaining == 99
+
+
+def test_chunked_dispatch_byte_identical_to_monolithic():
+    """The same request stream through chunked sub-batch dispatch and
+    through one monolithic launch must produce byte-identical decisions
+    and final counter state (launch order = device program order; the
+    state array threads through sub-batches)."""
+    import pickle
+
+    from limitador_tpu.tpu.storage import _Request
+
+    def drive(chunk_size):
+        storage = make_storage()
+        limiter = RateLimiter(storage)
+        limit = Limit("ns", 7, 60, [], ["u"])
+        limiter.add_limit(limit)
+        requests = [
+            _Request([Counter(limit, {"u": f"u{i % 13}"})], 1 + i % 3,
+                     False)
+            for i in range(96)
+        ]
+        auths = []
+        if chunk_size:
+            handles = []
+            for lo in range(0, len(requests), chunk_size):
+                handles.append(
+                    storage.begin_check_many(requests[lo:lo + chunk_size])
+                )
+            for handle in handles:
+                auths.extend(storage.finish_check_many(handle))
+        else:
+            auths = storage.check_many(requests)
+        state = sorted(
+            (c.set_variables["u"], c.remaining)
+            for c in storage.get_counters({limit})
+        )
+        return (
+            pickle.dumps([(a.limited, a.limit_name) for a in auths]),
+            pickle.dumps(state),
+        )
+
+    mono = drive(0)
+    for chunk_size in (16, 32):
+        assert drive(chunk_size) == mono, chunk_size
+
+
 def test_epoch_rebase_survives_month_long_idle(fake_clock):
     storage = make_storage(clock=fake_clock)
     limit = Limit("ns", 10, 60, [], ["u"])
