@@ -47,11 +47,40 @@ def zipf_keys(n_keys: int, n_samples: int, s: float, rng) -> np.ndarray:
     return np.searchsorted(cdf, u).astype(np.int32)
 
 
+_BOX_CALIBRATION = None
+
+
+def box_calibration_score() -> float:
+    """Fixed single-thread spin + memcpy workload, scored in passes per
+    second (higher = faster box). Recorded on every BENCH row because
+    absolute throughput numbers are only comparable across rounds after
+    normalizing by box speed — the r4 box swung ~6x mid-round, making
+    raw absolutes uninterpretable. Performance CLAIMS (e.g. the hot-lane
+    speedup) therefore ride same-process on/off ratios; this score is
+    the cross-round normalizer for everything else."""
+    global _BOX_CALIBRATION
+    if _BOX_CALIBRATION is None:
+        src = bytes(4 << 20)
+        dst = bytearray(4 << 20)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            acc = 0
+            for i in range(200_000):  # fixed Python-interpreter spin
+                acc += i ^ (acc & 0xFF)
+            for _ in range(24):  # 96 MB of memcpy
+                dst[:] = src
+            best = min(best, time.perf_counter() - t0)
+        _BOX_CALIBRATION = round(1.0 / best, 3)
+    return _BOX_CALIBRATION
+
+
 def emit(metric: str, value: float, unit: str, baseline: float,
          ndigits: int = 1, lower_is_better: bool = False, **extra) -> None:
     """One JSON result line. ``vs_baseline`` is uniformly >1-is-better:
     value/baseline for throughput rows, baseline/value when
-    ``lower_is_better`` (latency targets)."""
+    ``lower_is_better`` (latency targets). Every row carries the box
+    calibration score (see ``box_calibration_score``)."""
     ratio = (baseline / value) if lower_is_better else (value / baseline)
     payload = {
         "metric": metric,
@@ -60,6 +89,7 @@ def emit(metric: str, value: float, unit: str, baseline: float,
         "vs_baseline": round(ratio, 4),
     }
     payload.update(extra)
+    payload.setdefault("box_calibration_score", box_calibration_score())
     print(json.dumps(payload))
 
 
@@ -269,15 +299,18 @@ def bench_pipeline():
 
 
 def bench_native():
-    """Native columnar serving path: raw RLS blobs -> C++ parse ->
-    compiled masks -> native slot map -> device kernel -> response blobs.
-    The full end-to-end host+device path, no Python per-request objects.
+    """Native columnar serving path: raw RLS blobs -> C++ hot lane (or
+    parse -> masks -> slots on misses) -> device kernel -> response
+    blobs.
 
-    The served row sweeps SERVING SHARDS (thread-per-event-loop, all
-    feeding the one pipeline through its per-loop submit shards) and
-    records the per-shard-count rates plus the decision-plan cache hit
-    ratio — the two levers ISSUE 3 added to close the served/engine
-    gap."""
+    Every headline runs TWICE in this process — zero-Python hot lane ON
+    (the default) and OFF (the pure-Python cached/parse lanes) — and the
+    recorded speedups are those same-process, same-box ratios; absolute
+    rates carry ``box_calibration_score`` for cross-round context but
+    are NOT comparable across rounds on their own (ISSUE 5 satellite).
+    The served row sweeps SERVING SHARDS (thread-per-event-loop); the
+    ingress row drives the vendored C++ HTTP/2 ingress in-process over
+    real sockets with the pump's batch-coded answer path on vs off."""
     import asyncio
     import os
     import threading
@@ -303,32 +336,35 @@ def bench_native():
         e.value = f"user-{int(rng.integers(0, 100_000))}"
         blobs.append(req.SerializeToString())
 
-    limiter = CompiledTpuLimiter(
-        AsyncTpuStorage(TpuStorage(capacity=1 << 17), max_delay=0.001)
-    )
-    limiter.add_limit(
-        Limit("api", 10**6, 60,
-              ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
-    )
-    pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001)
-    # Engine path first: raw blobs -> response blobs through
-    # decide_many, zero per-request asyncio (the surface a native
-    # ingress drives). Warm pass compiles kernel buckets + slots.
-    # Full-list chunks amortize the link round trip (under axon the
-    # tunnel RTT, not the kernel, bounds a chunk).
-    chunk = len(blobs)
-    pipeline.decide_many(blobs, chunk=chunk)
-    n = 0
-    t0 = time.perf_counter()
-    for _ in range(4):
-        n += len(pipeline.decide_many(blobs, chunk=chunk))
-    engine_rate = n / (time.perf_counter() - t0)
+    def build(hot):
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(TpuStorage(capacity=1 << 17), max_delay=0.001)
+        )
+        limiter.add_limit(
+            Limit("api", 10**6, 60,
+                  ["descriptors[0].m == 'GET'"], ["descriptors[0].u"])
+        )
+        return NativeRlsPipeline(
+            limiter, None, max_delay=0.001, hot_lane=hot
+        ), limiter
 
-    # Serving path: per-request futures through the sharded asyncio
-    # submit lane (the grpc.aio integration surface). One thread per
-    # shard, each with its own event loop; gather waves sized to the
-    # pipeline's max_batch so flushes pipeline instead of barriering.
-    def drive_shards(shards: int, reps: int = 3) -> float:
+    def engine_rate_of(pipeline) -> float:
+        # One timed engine pass: raw blobs -> response blobs through
+        # decide_many, zero per-request asyncio. Full-list chunks
+        # amortize the link round trip. Callers warm first and
+        # interleave on/off passes (this box swings 2-6x mid-run; a
+        # sequential A-then-B comparison measures the drift, not the
+        # code).
+        chunk = len(blobs)
+        n = 0
+        t0 = time.perf_counter()
+        for _ in range(4):
+            n += len(pipeline.decide_many(blobs, chunk=chunk))
+        return n / (time.perf_counter() - t0)
+
+    def drive_shards(pipeline, shards: int, reps: int = 3) -> float:
+        # Serving path: per-request futures through the sharded asyncio
+        # submit lane (the grpc.aio integration surface).
         parts = [blobs[i::shards] for i in range(shards)]
 
         async def worker(part):
@@ -358,32 +394,82 @@ def bench_native():
             t.join()
         return reps * len(blobs) / (time.perf_counter() - t0)
 
-    drive_shards(1, reps=1)  # warm: shard creation + plan cache fill
-    serving_rate = 0.0
+    def teardown(pipeline, limiter):
+        async def go():
+            await pipeline.close()
+            await limiter.storage.counters.close()
+
+        loop = asyncio.new_event_loop()
+        loop.run_until_complete(go())
+        loop.close()
+
+    # Both pipelines live side by side and every comparison interleaves
+    # on/off passes, best-of per mode: the box swings 2-6x mid-run, so a
+    # sequential off-pass-then-on-pass would record scheduler drift, not
+    # the lane. The ratios below are same-process, same-box by
+    # construction.
+    p_off, lim_off = build(False)
+    pipeline, limiter = build(None)
+    hot_active = pipeline.hot_lane_active
+    chunk = len(blobs)
+    p_off.decide_many(blobs, chunk=chunk)  # warm: buckets/slots/plans
+    pipeline.decide_many(blobs, chunk=chunk)
+    engine_off = engine_rate = 0.0
+    for _rep in range(3):
+        engine_off = max(engine_off, engine_rate_of(p_off))
+        engine_rate = max(engine_rate, engine_rate_of(pipeline))
+
+    drive_shards(p_off, 1, reps=1)  # warm shard + plan cache
+    drive_shards(pipeline, 1, reps=1)
+    serving_off = serving_on_1 = 0.0
+    for _rep in range(2):
+        serving_off = max(serving_off, drive_shards(p_off, 1))
+        serving_on_1 = max(serving_on_1, drive_shards(pipeline, 1))
+    serving_rate = serving_on_1
     serving_shards = 1
-    by_shards = {}
-    shard_counts = [1, 2, 4]
+    by_shards = {"1": round(serving_on_1, 1)}
+    shard_counts = [2, 4]
     cores = os.cpu_count() or 1
     if cores >= 8:
         shard_counts.append(8)
     for shards in shard_counts:
-        rate = drive_shards(shards)
+        rate = drive_shards(pipeline, shards)
         by_shards[str(shards)] = round(rate, 1)
         if rate > serving_rate:
             serving_rate, serving_shards = rate, shards
+
+    ingress_off = ingress_on = 0.0
+    for _rep in range(2):
+        ingress_off = max(
+            ingress_off, _drive_native_ingress(p_off, blobs)
+        )
+        ingress_on = max(
+            ingress_on, _drive_native_ingress(pipeline, blobs)
+        )
     cache = pipeline.plan_cache
     hit_ratio = round(cache.hit_ratio, 4) if cache is not None else 0.0
+    lane_stats = pipeline.lane_stats()
 
-    async def teardown():
-        await pipeline.close()
-        await limiter.storage.counters.close()
-
-    asyncio.new_event_loop().run_until_complete(teardown())
+    teardown(p_off, lim_off)
+    teardown(pipeline, limiter)
+    engine_speedup = round(engine_rate / engine_off, 2) if engine_off else 0.0
+    serving_speedup = (
+        round(serving_on_1 / serving_off, 2) if serving_off else 0.0
+    )
+    ingress_speedup = (
+        round(ingress_on / ingress_off, 2)
+        if ingress_on and ingress_off else 0.0
+    )
     print(
-        f"native pipeline: {engine_rate/1e3:.1f}k decisions/s engine "
-        f"(decide_many), {serving_rate/1e3:.1f}k decisions/s served "
-        f"(asyncio submit, best at {serving_shards} shard(s); "
-        f"sweep {by_shards}), plan-cache hit ratio {hit_ratio}",
+        f"native pipeline (hot lane {'on' if hot_active else 'OFF'}): "
+        f"{engine_rate/1e3:.1f}k decisions/s engine "
+        f"({engine_speedup}x vs lane-off {engine_off/1e3:.1f}k), "
+        f"{serving_rate/1e3:.1f}k served best at {serving_shards} "
+        f"shard(s) (sweep {by_shards}; 1-shard {serving_speedup}x vs "
+        f"lane-off {serving_off/1e3:.1f}k), ingress "
+        f"{ingress_on/1e3:.1f}k req/s ({ingress_speedup}x vs lane-off "
+        f"{ingress_off/1e3:.1f}k), plan-cache hit ratio {hit_ratio}, "
+        f"lane rows {lane_stats.get('hits', 0)}",
         file=sys.stderr,
     )
     emit(
@@ -392,7 +478,150 @@ def bench_native():
         native_serving_shards=serving_shards,
         native_serving_by_shards=by_shards,
         plan_cache_hit_ratio=hit_ratio,
+        hot_lane_active=hot_active,
+        native_engine_off_decisions_per_sec=round(engine_off, 1),
+        native_hot_lane_engine_speedup=engine_speedup,
+        native_serving_off_decisions_per_sec=round(serving_off, 1),
+        native_hot_lane_serving_speedup=serving_speedup,
+        native_ingress_rps=round(ingress_on, 1),
+        native_ingress_off_rps=round(ingress_off, 1),
+        native_hot_lane_ingress_speedup=ingress_speedup,
+        native_lane_staged_hits=lane_stats.get("staged_hits", 0),
     )
+
+
+def _h2_frame(ftype: int, flags: int, stream: int, payload: bytes) -> bytes:
+    return (
+        len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+        + stream.to_bytes(4, "big") + payload
+    )
+
+
+def _drive_native_ingress(pipeline, blobs, waves: int = 40,
+                          wave_size: int = 512) -> float:
+    """Served throughput through the vendored C++ HTTP/2 ingress over a
+    real socket, in-process, with a RAW pipelined h2 client: each wave
+    pre-serializes HEADERS+DATA for ``wave_size`` streams (static-table
+    HPACK only) and is written with one sendall, then responses are
+    drained counting END_STREAM trailers. A python-gRPC closed loop
+    measures its own per-call overhead (~1ms/req on this box) instead
+    of the server; this driver keeps the pump fed with real batches, so
+    the recorded hot-lane on/off ratio isolates the server-side answer
+    path (batch-coded respond vs per-row). Returns req/s (0.0 when the
+    ingress library is unavailable)."""
+    import asyncio
+    import socket
+    import threading as _threading
+
+    try:
+        from limitador_tpu.native.ingress import (
+            NativeIngress,
+            ingress_available,
+        )
+    except Exception as exc:
+        print(f"ingress drive skipped: {exc}", file=sys.stderr)
+        return 0.0
+    if not ingress_available():
+        return 0.0
+
+    loop = asyncio.new_event_loop()
+    lt = _threading.Thread(target=loop.run_forever, daemon=True)
+    lt.start()
+    ing = NativeIngress(pipeline, host="127.0.0.1", port=0, loop=loop,
+                        poll_ms=1, max_batch=wave_size)
+    path = b"/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
+    # :method POST (static idx 3), :scheme http (6), :path literal
+    # (name idx 4), content-type literal (name idx 31) — no dynamic
+    # table, so every stream reuses one prebuilt block.
+    ct = b"application/grpc"
+    headers = (
+        bytes([0x83, 0x86, 0x04, len(path)]) + path
+        + bytes([0x0F, 0x10, len(ct)]) + ct
+    )
+    subset = blobs[:512]  # repeated -> the plan caches serve steady state
+
+    def build_waves(n_waves, first_stream):
+        bufs, sid = [], first_stream
+        for _w in range(n_waves):
+            parts = []
+            for i in range(wave_size):
+                blob = subset[(sid // 2) % len(subset)]
+                grpc_msg = b"\x00" + len(blob).to_bytes(4, "big") + blob
+                parts.append(_h2_frame(1, 0x4, sid, headers))
+                parts.append(_h2_frame(0, 0x1, sid, grpc_msg))
+                sid += 2
+            bufs.append(b"".join(parts))
+        return bufs, sid
+
+    def drain(sock, buf: bytearray, expect: int) -> None:
+        # Count trailer frames (HEADERS with END_STREAM): one per
+        # answered stream. The server's connection send window is
+        # refilled promptly for received DATA bytes (else it parks
+        # responses after ~64KB).
+        done = 0
+        data_bytes = 0
+        while done < expect:
+            data = sock.recv(1 << 18)
+            if not data:
+                raise ConnectionError("ingress closed mid-drive")
+            buf += data
+            off = 0
+            while len(buf) - off >= 9:
+                flen = int.from_bytes(buf[off:off + 3], "big")
+                if len(buf) - off < 9 + flen:
+                    break
+                ftype = buf[off + 3]
+                if ftype == 1 and buf[off + 4] & 0x1:
+                    done += 1
+                elif ftype == 0:
+                    data_bytes += flen
+                off += 9 + flen
+            del buf[:off]
+            if data_bytes >= 8192:
+                sock.sendall(
+                    _h2_frame(8, 0, 0, data_bytes.to_bytes(4, "big"))
+                )
+                data_bytes = 0
+        if data_bytes:
+            sock.sendall(
+                _h2_frame(8, 0, 0, data_bytes.to_bytes(4, "big"))
+            )
+
+    rate = 0.0
+    try:
+        sock = socket.create_connection(("127.0.0.1", ing.port),
+                                        timeout=30)
+        sock.settimeout(60)
+        sock.sendall(
+            b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n" + _h2_frame(4, 0, 0, b"")
+        )
+        rbuf = bytearray()
+        warm_bufs, next_sid = build_waves(4, 1)
+        for buf in warm_bufs:  # warm: slots, plan caches, kernel buckets
+            sock.sendall(buf)
+            drain(sock, rbuf, wave_size)
+        # Two timed passes, best-of: wave-sized bursts (one sendall,
+        # full drain) keep the measurement stable on a contended box —
+        # full streaming thrashes the 2-core CI container's scheduler
+        # and swings 10x run to run.
+        for _pass in range(2):
+            wave_bufs, next_sid = build_waves(waves, next_sid)
+            t0 = time.perf_counter()
+            for buf in wave_bufs:
+                sock.sendall(buf)
+                drain(sock, rbuf, wave_size)
+            rate = max(
+                rate, waves * wave_size / (time.perf_counter() - t0)
+            )
+        sock.close()
+    except Exception as exc:
+        print(f"ingress drive failed: {exc}", file=sys.stderr)
+    finally:
+        ing.close()
+        loop.call_soon_threadsafe(loop.stop)
+        lt.join(timeout=5)
+        loop.close()
+    return rate
 
 
 def bench_backends():

@@ -1,0 +1,188 @@
+"""Shared builder for the vendored native libraries.
+
+``hostpath`` (native/hostpath.cc) and ``h2ingress`` (native/h2ingress.cc)
+used to carry copy-pasted digest/stamp/compile logic; this module is the
+single implementation both bind through. One :class:`NativeLib` per
+shared object:
+
+- **Content-based staleness**: the built ``.so`` is valid only while a
+  stamp file carries the sha256 of every source file plus the compile
+  flags (mtime ordering is unreliable across checkouts, and a flag
+  change must rebuild too).
+- **Compiler search**: ``$CXX`` when set, then ``g++``, then ``clang++``
+  — the first candidate that produces a binary wins; every failed
+  attempt's error is kept so the surfaced build error names what was
+  tried.
+- **Per-library error surface**: ``build_status()`` reports, for every
+  registered library, whether it loaded and the build error string when
+  it did not — served under ``GET /debug/stats`` (server/http_api.py)
+  so a silently-degraded (pure-Python fallback) deployment is visible
+  without log spelunking.
+
+Consumers keep the lazy-build contract: nothing compiles at import
+time; the first ``load()`` (via ``available()``) pays the build once
+per source change.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import threading
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["NativeLib", "build_status", "compiler_candidates"]
+
+_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_BUILD_DIR = os.path.join(_ROOT, "native", "build")
+
+#: name -> NativeLib, for the /debug/stats surface
+_REGISTRY: Dict[str, "NativeLib"] = {}
+
+
+def compiler_candidates() -> List[str]:
+    """Compilers to try, in order: $CXX (when set), g++, clang++."""
+    out = []
+    cxx = os.environ.get("CXX")
+    if cxx:
+        out.append(cxx)
+    for cc in ("g++", "clang++"):
+        if cc not in out:
+            out.append(cc)
+    return out
+
+
+class NativeLib:
+    """One vendored shared library: sources + flags -> loaded CDLL.
+
+    ``sources`` are paths relative to the repo root (the first entry is
+    the translation unit handed to the compiler; the rest are headers
+    folded into the staleness digest). ``extra_flags`` extend the common
+    ``-O2 -std=c++17 -shared -fPIC`` set.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        sources: Sequence[str],
+        extra_flags: Sequence[str] = (),
+        timeout: float = 180.0,
+    ):
+        self.name = name
+        self.sources = [os.path.join(_ROOT, s) for s in sources]
+        self.extra_flags = list(extra_flags)
+        self.timeout = timeout
+        self.so_path = os.path.join(_BUILD_DIR, f"lib{name}.so")
+        self.stamp_path = self.so_path + ".sha256"
+        self._lock = threading.Lock()
+        self._lib: Optional[ctypes.CDLL] = None
+        self._build_error: Optional[str] = None
+        _REGISTRY[name] = self
+
+    # -- staleness ----------------------------------------------------------
+
+    def _digest(self) -> Optional[str]:
+        try:
+            h = hashlib.sha256()
+            for path in self.sources:
+                with open(path, "rb") as f:
+                    h.update(f.read())
+            h.update(" ".join(self.extra_flags).encode())
+            return h.hexdigest()
+        except OSError:
+            return None
+
+    def _stale(self, digest: Optional[str]) -> bool:
+        if not os.path.exists(self.so_path):
+            return True
+        if digest is None:
+            return False  # no source available; trust the existing binary
+        try:
+            with open(self.stamp_path) as f:
+                return f.read().strip() != digest
+        except OSError:
+            return True
+
+    # -- build --------------------------------------------------------------
+
+    def _build(self, digest: Optional[str]) -> Optional[str]:
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        attempts: List[str] = []
+        for cxx in compiler_candidates():
+            if shutil.which(cxx) is None:
+                attempts.append(f"{cxx}: not found")
+                continue
+            cmd = [
+                cxx, "-O2", "-std=c++17", "-shared", "-fPIC",
+                *self.extra_flags, "-o", self.so_path, self.sources[0],
+            ]
+            try:
+                proc = subprocess.run(
+                    cmd, capture_output=True, text=True,
+                    timeout=self.timeout,
+                )
+            except (OSError, subprocess.TimeoutExpired) as exc:
+                attempts.append(f"{cxx}: invocation failed: {exc}")
+                continue
+            if proc.returncode != 0:
+                attempts.append(f"{cxx}: {proc.stderr[-1500:]}")
+                continue
+            if digest is not None:
+                with open(self.stamp_path, "w") as f:
+                    f.write(digest)
+            return None
+        return " | ".join(attempts) or "no compiler candidates"
+
+    # -- load ---------------------------------------------------------------
+
+    def load(self) -> Optional[ctypes.CDLL]:
+        """Build (when stale) and dlopen; memoized, thread-safe. Returns
+        None on failure with the error kept in ``build_error``."""
+        with self._lock:
+            if self._lib is not None or self._build_error is not None:
+                return self._lib
+            digest = self._digest()
+            if self._stale(digest):
+                self._build_error = self._build(digest)
+                if self._build_error is not None:
+                    return None
+            try:
+                self._lib = ctypes.CDLL(self.so_path)
+            except OSError as exc:
+                self._build_error = str(exc)
+                return None
+            return self._lib
+
+    @property
+    def build_error(self) -> Optional[str]:
+        return self._build_error
+
+    @property
+    def loaded(self) -> bool:
+        return self._lib is not None
+
+    def peek(self) -> Optional[ctypes.CDLL]:
+        """The loaded library WITHOUT triggering a build — for optional
+        fast paths (e.g. the sharded partition assist) that must never
+        stall a serving process on a first-use compile."""
+        return self._lib
+
+
+def build_status() -> dict:
+    """Per-library load state for ``GET /debug/stats``: attempted
+    libraries only (``load()`` not yet called -> ``attempted: false``,
+    no build is triggered by reporting)."""
+    out = {}
+    for name, lib in sorted(_REGISTRY.items()):
+        attempted = lib.loaded or lib.build_error is not None
+        out[name] = {
+            "attempted": attempted,
+            "loaded": lib.loaded,
+            "build_error": lib.build_error,
+        }
+    return out

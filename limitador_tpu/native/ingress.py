@@ -19,22 +19,14 @@ service and the HTTP API keep the Python server.
 from __future__ import annotations
 
 import ctypes
-import hashlib
-import os
-import subprocess
 import threading
 from typing import List, Optional
 
-__all__ = ["ingress_available", "ingress_build_error", "NativeIngress"]
+import numpy as np
 
-_ROOT = os.path.dirname(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
-_SRC = os.path.join(_ROOT, "native", "h2ingress.cc")
-_TABLES = os.path.join(_ROOT, "native", "h2_hpack_tables.h")
-_BUILD_DIR = os.path.join(_ROOT, "native", "build")
-_SO = os.path.join(_BUILD_DIR, "libh2ingress.so")
-_STAMP = _SO + ".sha256"
+from .build import NativeLib
+
+__all__ = ["ingress_available", "ingress_build_error", "NativeIngress"]
 
 TARGET_PATH = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
 
@@ -52,68 +44,24 @@ class GrpcHandlerError(Exception):
         self.status = status
         self.message = message
 
-_lock = threading.Lock()
-_lib = None
-_build_error: Optional[str] = None
 
-
-def _src_digest() -> Optional[str]:
-    try:
-        h = hashlib.sha256()
-        for path in (_SRC, _TABLES):
-            with open(path, "rb") as f:
-                h.update(f.read())
-        return h.hexdigest()
-    except OSError:
-        return None
-
-
-def _stale(digest: Optional[str]) -> bool:
-    if not os.path.exists(_SO):
-        return True
-    if digest is None:
-        return False
-    try:
-        with open(_STAMP) as f:
-            return f.read().strip() != digest
-    except OSError:
-        return True
-
-
-def _build(digest: Optional[str]) -> Optional[str]:
-    os.makedirs(_BUILD_DIR, exist_ok=True)
-    cmd = [
-        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
-        "-o", _SO, _SRC,
-    ]
-    try:
-        proc = subprocess.run(cmd, capture_output=True, text=True,
-                              timeout=180)
-    except (OSError, subprocess.TimeoutExpired) as exc:
-        return f"g++ invocation failed: {exc}"
-    if proc.returncode != 0:
-        return f"g++ failed: {proc.stderr[-2000:]}"
-    if digest is not None:
-        with open(_STAMP, "w") as f:
-            f.write(digest)
-    return None
+_LIB = NativeLib(
+    "h2ingress",
+    ["native/h2ingress.cc", "native/h2_hpack_tables.h"],
+    ["-pthread"],
+)
+_sigs_lock = threading.Lock()
+_sigs_done = False
 
 
 def _load():
-    global _lib, _build_error
-    with _lock:
-        if _lib is not None or _build_error is not None:
-            return _lib
-        digest = _src_digest()
-        if _stale(digest):
-            _build_error = _build(digest)
-            if _build_error is not None:
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError as exc:
-            _build_error = str(exc)
-            return None
+    global _sigs_done
+    lib = _LIB.load()
+    if lib is None or _sigs_done:
+        return lib
+    with _sigs_lock:
+        if _sigs_done:
+            return lib
         lib.h2i_create.restype = ctypes.c_void_p
         lib.h2i_create.argtypes = [
             ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
@@ -150,8 +98,15 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
             ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint32,
         ]
-        _lib = lib
-        return _lib
+        lib.h2i_set_code.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.h2i_respond_coded.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+        ]
+        _sigs_done = True
+        return lib
 
 
 def ingress_available() -> bool:
@@ -166,7 +121,9 @@ class HpackDecoder:
     def __init__(self):
         lib = _load()
         if lib is None:
-            raise RuntimeError(f"native ingress unavailable: {_build_error}")
+            raise RuntimeError(
+                f"native ingress unavailable: {_LIB.build_error}"
+            )
         self._lib = lib
         self._d = ctypes.c_void_p(lib.h2i_hpack_decoder_new())
 
@@ -214,7 +171,7 @@ class HpackDecoder:
 
 def ingress_build_error() -> Optional[str]:
     _load()
-    return _build_error
+    return _LIB.build_error
 
 
 class NativeIngress:
@@ -242,7 +199,7 @@ class NativeIngress:
         lib = _load()
         if lib is None:
             raise RuntimeError(
-                f"native ingress unavailable: {_build_error}"
+                f"native ingress unavailable: {_LIB.build_error}"
             )
         self._lib = lib
         self.pipeline = pipeline
@@ -276,6 +233,23 @@ class NativeIngress:
         if not self._ctx:
             raise OSError(f"could not bind native ingress to {host}:{port}")
         self.port = lib.h2i_port(self._ctx)
+        # Hot-lane coded answers: when the pipeline exposes its outcome
+        # templates, they are registered with the C layer once and the
+        # pump answers whole batches with ONE h2i_respond_coded call —
+        # zero Python per request between the socket and the kernel for
+        # repeat descriptors.
+        self._coded = False
+        templates = getattr(pipeline, "lane_code_templates", None)
+        if callable(templates) and hasattr(
+            pipeline, "_begin_batch_coded_ptrs"
+        ):
+            tmpl = templates()
+            if tmpl:
+                for code, (status, payload) in tmpl.items():
+                    lib.h2i_set_code(
+                        self._ctx, code, status, payload, len(payload)
+                    )
+                self._coded = True
         self._stopping = False
         # Serializes every h2i_* call against close(): slow-path done
         # callbacks fire on the server loop thread and must never reach a
@@ -345,6 +319,7 @@ class NativeIngress:
                 2, thread_name_prefix="h2-ingress-finish"
             )
             sem = threading.BoundedSemaphore(2)
+        coded = self._coded and pipelined
         try:
             while not self._stopping:
                 n = self._lib.h2i_take(
@@ -357,6 +332,20 @@ class NativeIngress:
                 )
                 if n <= 0:
                     continue
+                if coded:
+                    # The zero-Python lane: when no cold-path method rows
+                    # are present (one vectorized scan of the path
+                    # pointers), the batch stays in the take buffers end
+                    # to end — plan lookup, staging and the response
+                    # build all happen natively; only miss/slow rows
+                    # materialize Python objects.
+                    if not np.frombuffer(
+                        path_ptrs, dtype=np.uint64, count=n
+                    ).any():
+                        self._decide_coded(
+                            ids, ptrs, lens, n, finish_pool, sem
+                        )
+                        continue
                 rids, blobs, unknown = [], [], []
                 for i in range(n):
                     blob = ctypes.string_at(ptrs[i], lens[i])
@@ -435,6 +424,80 @@ class NativeIngress:
         finally:
             if not submitted:
                 sem.release()
+
+    def _decide_coded(self, ids, ptrs, lens, n, finish_pool, sem) -> None:
+        """Hot-lane batch: begin over the take buffers in place (zero
+        copies, zero per-row Python for repeat descriptors), hand the
+        collect to the finish pool. Only the id column is copied — the
+        take buffers are reused by the next poll, but begin consumed the
+        payloads synchronously and miss/slow rows materialized their
+        bytes inside it."""
+        sem.acquire()
+        submitted = False
+        slow: set = set()
+        try:
+            ids_arr = np.frombuffer(ids, dtype=np.uint64, count=n).copy()
+            codes, results, slow_rows, pendings = (
+                self.pipeline._begin_batch_coded_ptrs(ptrs, lens, n)
+            )
+            slow = set(slow_rows)
+            for r in slow_rows:
+                self._submit_slow(
+                    int(ids_arr[r]), ctypes.string_at(ptrs[r], lens[r])
+                )
+            finish_pool.submit(
+                self._finish_coded, ids_arr, codes, results, slow,
+                pendings, sem,
+            )
+            submitted = True
+        except Exception as exc:
+            self._respond(
+                [(int(rid), GRPC_INTERNAL, str(exc).encode()[:100])
+                 for i, rid in enumerate(
+                     np.frombuffer(ids, dtype=np.uint64, count=n).tolist()
+                 ) if i not in slow]
+            )
+        finally:
+            if not submitted:
+                sem.release()
+
+    def _finish_coded(self, ids_arr, codes, results, slow, pendings,
+                      sem) -> None:
+        """Collect a hot-lane batch: finish the launched lanes, then
+        answer every coded row with ONE native call; miss rows (Python-
+        decided bytes) answer through the per-row path — steady state
+        has none."""
+        try:
+            for pending in pendings:
+                self.pipeline._finish_namespace(pending, results)
+            if codes is not None:
+                with self._ctx_lock:
+                    if self._ctx is None:
+                        return
+                    self._lib.h2i_respond_coded(
+                        self._ctx, len(ids_arr), ids_arr.ctypes.data,
+                        codes.ctypes.data,
+                    )
+            items = []
+            for i, res in enumerate(results):
+                if res is None or i in slow:
+                    continue
+                if res is self.pipeline.STORAGE_ERROR:
+                    items.append(
+                        (int(ids_arr[i]), GRPC_UNAVAILABLE,
+                         b"storage unavailable")
+                    )
+                else:
+                    items.append((int(ids_arr[i]), 0, res))
+            self._respond(items)
+        except Exception as exc:
+            self._respond(
+                [(int(rid), GRPC_INTERNAL, str(exc).encode()[:100])
+                 for i, rid in enumerate(ids_arr.tolist())
+                 if i not in slow]
+            )
+        finally:
+            sem.release()
 
     def _finish_decided(self, rids, slow, results, pendings, sem) -> None:
         """Collect one launched batch (device transfer) and answer it.

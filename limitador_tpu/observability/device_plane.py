@@ -27,6 +27,9 @@ Per-batch phase names (``PHASES``):
   batch up (executor queueing + loop scheduling),
 * ``host_cache`` — decision-plan cache lookup + cached-lane staging
   (native pipeline; zero on pipelines without the cache),
+* ``native_lane`` — the zero-Python hot lane's one C call: plan-mirror
+  lookup, columnar staging into the pre-allocated upload buffers and
+  begin-time response codes (native pipeline; zero with the lane off),
 * ``host_stage`` — hit-array construction + kernel launch on the
   dispatch thread for the rows the cache missed,
 * ``device_sync`` — device round trip: blocking on the launched kernel
@@ -56,7 +59,8 @@ __all__ = [
     "collect_debug_stats",
 ]
 
-PHASES = ("dispatch", "host_cache", "host_stage", "device_sync", "unpack")
+PHASES = ("dispatch", "host_cache", "native_lane", "host_stage",
+          "device_sync", "unpack")
 FLUSH_REASONS = ("size", "deadline", "shutdown")
 # The two queues feeding the batcher_* families: the decision path's
 # MicroBatcher vs the write path's UpdateBatcher. Labeled apart because

@@ -279,6 +279,48 @@ class PrometheusMetrics:
             "Decision plans currently cached",
             registry=self.registry,
         )
+        # -- native hot lane (tpu/native_pipeline.py + native/hostpath.cc):
+        # rows and device hits handled by the zero-Python C lane vs the
+        # Python miss lane, plus C plan-mirror health. Polled cumulative
+        # from the pipeline's library_stats (baseline-converted).
+        # Registered in native_pipeline.METRIC_FAMILIES (lint
+        # cross-checked).
+        self.native_lane_rows = Counter(
+            "native_lane_rows",
+            "Requests decided by the GIL-free native hot lane (plan "
+            "lookup, staging and response build with zero per-row "
+            "Python)",
+            registry=self.registry,
+        )
+        self.native_lane_misses = Counter(
+            "native_lane_misses",
+            "Requests the hot lane missed on (decided by the Python "
+            "miss lane, then mirrored)",
+            registry=self.registry,
+        )
+        self.native_lane_staged_hits = Counter(
+            "native_lane_staged_hits",
+            "Device hits staged natively into the pre-allocated upload "
+            "buffers by the hot lane",
+            registry=self.registry,
+        )
+        self.native_lane_invalidations = Counter(
+            "native_lane_invalidations",
+            "C plan-mirror entries dropped for coherence (slot "
+            "recycling, limits-epoch bumps, size-cap clears)",
+            registry=self.registry,
+        )
+        self.native_lane_overflows = Counter(
+            "native_lane_overflows",
+            "Hot-lane rows demoted to the Python miss lane because the "
+            "staging buffers were full (undersized hot-lane cap)",
+            registry=self.registry,
+        )
+        self.native_lane_plans = Gauge(
+            "native_lane_plans",
+            "Decision plans live in the C-side plan mirror",
+            registry=self.registry,
+        )
         # -- multi-chip dispatch (tpu/sharded.py): launch counts per
         # collective variant, polled baseline-converted off
         # launch_stats()/library_stats. Registered in
@@ -407,6 +449,7 @@ class PrometheusMetrics:
         cache_size = 0
         queue_depth = 0
         plan_cache_size = 0
+        native_lane_plans = 0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -417,6 +460,7 @@ class PrometheusMetrics:
             cache_size += int(stats.get("cache_size", 0))
             queue_depth += int(stats.get("queue_depth", 0))
             plan_cache_size += int(stats.get("plan_cache_size", 0))
+            native_lane_plans += int(stats.get("native_lane_plans", 0))
             for key in (
                 "counter_overshoot",
                 "evicted_pending_writes",
@@ -430,6 +474,11 @@ class PrometheusMetrics:
                 "plan_cache_misses",
                 "plan_cache_evictions",
                 "plan_cache_invalidations",
+                "native_lane_rows",
+                "native_lane_misses",
+                "native_lane_staged_hits",
+                "native_lane_invalidations",
+                "native_lane_overflows",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -452,6 +501,7 @@ class PrometheusMetrics:
         self.cache_size.set(cache_size)
         self.batcher_queue_depth.set(queue_depth)
         self.plan_cache_size.set(plan_cache_size)
+        self.native_lane_plans.set(native_lane_plans)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

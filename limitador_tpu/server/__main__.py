@@ -245,6 +245,16 @@ def build_parser() -> argparse.ArgumentParser:
         "epoch-invalidated on every limits change; 0 disables)",
     )
     p.add_argument(
+        "--native-hot-lane",
+        choices=["on", "off"],
+        default=_env("TPU_NATIVE_HOT_LANE", "on"),
+        help="zero-Python hot lane for the native pipeline: repeat "
+        "descriptors run plan lookup, columnar staging and response "
+        "build in one GIL-free C call (C-side mirror of the decision-"
+        "plan cache; epoch/slot-coherent). 'off' pins the pure-Python "
+        "cached lane — byte-identical decisions, host-bound throughput",
+    )
+    p.add_argument(
         "--native-ingress",
         action="store_true",
         default=_env("TPU_NATIVE_INGRESS", "") == "1",
@@ -815,7 +825,16 @@ async def _amain(args) -> int:
                 limiter, metrics, max_delay=args.batch_delay_us / 1e6,
                 plan_cache_size=args.plan_cache_size,
                 dispatch_chunk=args.dispatch_chunk,
+                hot_lane=args.native_hot_lane == "on",
             )
+            if (
+                args.native_hot_lane == "on"
+                and not native_pipeline.hot_lane_active
+            ):
+                log.warning(
+                    "native hot lane requested but unavailable (library "
+                    "without lane symbols, or plan cache disabled); "
+                    "serving through the pure-Python cached lane")
             pipelines_to_invalidate.append(native_pipeline)
             metrics.attach_library_source(native_pipeline)
             if admission is not None:

@@ -358,9 +358,27 @@ class _Api:
     async def get_debug_stats(self, request: web.Request) -> web.Response:
         """Device-plane state without a debugger: queue depths, per-shard
         table occupancy, flush reasons, decision-plan cache stats, the
-        slow-decision flight recorder and the profiler state."""
+        slow-decision flight recorder, per-library native build state
+        (compiler errors surface here, not just in logs) and the
+        profiler state."""
         stats = collect_debug_stats(*self.debug_sources)
         stats["profiler"] = self.profiler.status()
+        try:
+            from ..native.build import build_status
+
+            stats["native_build"] = build_status()
+        except Exception:
+            pass  # a diagnostics surface must never 500 the endpoint
+        for source in self.debug_sources:
+            lane_stats = getattr(source, "lane_stats", None)
+            if callable(lane_stats):
+                try:
+                    lane = lane_stats()
+                except Exception:
+                    lane = None
+                if lane:
+                    stats["native_hot_lane"] = lane
+                    break
         return web.json_response(stats)
 
     async def get_debug_profile(self, request: web.Request) -> web.Response:

@@ -43,7 +43,7 @@ from typing import List, Tuple
 
 __all__ = [
     "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
-    "main",
+    "lint_ctypes_signatures", "main",
 ]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
@@ -56,7 +56,18 @@ REGISTRY_OWNED_PREFIXES = {
     "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
     "sharded_": "limitador_tpu/tpu/sharded.py",
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
+    "native_lane_": "limitador_tpu/tpu/native_pipeline.py",
 }
+
+#: native sources whose extern "C" exports must carry matching ctypes
+#: declarations in the binding modules (symbol prefix filters the
+#: internal helpers out)
+CTYPES_SOURCES = ("native/hostpath.cc", "native/h2ingress.cc")
+CTYPES_BINDINGS = (
+    "limitador_tpu/native/__init__.py",
+    "limitador_tpu/native/ingress.py",
+)
+CTYPES_SYMBOL_PREFIXES = ("hp_", "h2i_")
 
 #: modules whose jax.jit sites must donate table-carrying buffers
 DONATION_CHECKED_MODULES = (
@@ -148,6 +159,101 @@ def lint_metric_registry(repo_root: Path) -> List[str]:
                     f"declared but missing from {registry}'s "
                     "METRIC_FAMILIES registry"
                 )
+    return findings
+
+
+def exported_c_symbols(source: str):
+    """(name, return_type, has_params) for every exported C function in
+    a translation unit (prefix-filtered; extern "C" definitions in this
+    repo all sit at column 0 with the return type on the same line)."""
+    import re
+
+    out = []
+    pattern = re.compile(
+        r"^([A-Za-z_][A-Za-z0-9_]*\s*\**)\s+("
+        + "|".join(p + r"[a-z0-9_]+" for p in CTYPES_SYMBOL_PREFIXES)
+        + r")\s*\(([^)]*)",
+        re.MULTILINE,
+    )
+    for match in pattern.finditer(source):
+        ret = match.group(1).replace(" ", "")
+        name = match.group(2)
+        params = match.group(3).strip()
+        # multi-line parameter lists never close on the match line; an
+        # empty first-line capture with more lines following still means
+        # "has params" only when the very next char isn't ')'
+        has_params = params not in ("", "void")
+        out.append((name, ret, has_params))
+    return out
+
+
+def declared_ctypes_signatures(source: str):
+    """{symbol: {"restype", "argtypes"}} assignments in a binding
+    module (``lib.<symbol>.restype = ...`` / ``.argtypes = ...``)."""
+    import re
+
+    out: dict = {}
+    for match in re.finditer(
+        r"lib\.([A-Za-z_][A-Za-z0-9_]*)\.(restype|argtypes)\s*=", source
+    ):
+        out.setdefault(match.group(1), set()).add(match.group(2))
+    return out
+
+
+def lint_ctypes_signatures(repo_root: Path) -> List[str]:
+    """Signature-drift gate for the native ABI: every symbol exported
+    from the C sources must have a ctypes ``argtypes`` declaration on
+    the Python side (non-void returns also need ``restype``), and every
+    Python-side declaration must name a symbol that still exists — a
+    renamed/removed export fails the gate instead of segfaulting at
+    call time."""
+    findings: List[str] = []
+    exported: dict = {}
+    for rel in CTYPES_SOURCES:
+        path = repo_root / rel
+        if not path.exists():
+            continue
+        for name, ret, has_params in exported_c_symbols(path.read_text()):
+            exported[name] = (rel, ret, has_params)
+    declared: dict = {}
+    for rel in CTYPES_BINDINGS:
+        path = repo_root / rel
+        if not path.exists():
+            continue
+        for name, kinds in declared_ctypes_signatures(
+            path.read_text()
+        ).items():
+            declared.setdefault(name, set()).update(kinds)
+    if not exported or not declared:
+        return findings
+    for name, (rel, ret, has_params) in sorted(exported.items()):
+        kinds = declared.get(name)
+        if kinds is None:
+            findings.append(
+                f"{rel}: exported symbol '{name}' has no ctypes "
+                "declaration in the binding modules (drift: a call "
+                "through the default int-sized signature corrupts "
+                "arguments silently)"
+            )
+            continue
+        if has_params and "argtypes" not in kinds:
+            findings.append(
+                f"{rel}: exported symbol '{name}' takes parameters but "
+                "the binding declares no argtypes"
+            )
+        if ret != "void" and "restype" not in kinds:
+            findings.append(
+                f"{rel}: exported symbol '{name}' returns {ret} but the "
+                "binding declares no restype (ctypes truncates to int)"
+            )
+    for name in sorted(declared):
+        if not name.startswith(CTYPES_SYMBOL_PREFIXES):
+            continue
+        if name not in exported:
+            findings.append(
+                f"limitador_tpu/native: binding declares '{name}' but no "
+                "native source exports it (renamed or removed symbol)"
+            )
     return findings
 
 
@@ -430,6 +536,7 @@ def main(argv=None) -> int:
     repo_root = Path(__file__).resolve().parent.parent.parent
     findings.extend(lint_metric_registry(repo_root))
     findings.extend(lint_donation(repo_root))
+    findings.extend(lint_ctypes_signatures(repo_root))
     for finding in findings:
         print(finding)
     if findings:

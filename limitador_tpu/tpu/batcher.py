@@ -46,6 +46,12 @@ Within a batch, requests keep their enqueue order and the kernel decides
 admission exactly as if they were processed serially; all hit-building and
 result-decoding semantics live in ``TpuStorage.check_many`` — the batcher
 only owns the coalescing.
+
+On sharded storage, a flush's staging additionally rides the native
+per-shard partition pass when the hostpath library is loaded
+(``hp_partition_positions`` via storage.py ``_partition_positions``:
+one O(n) GIL-free C sweep replacing the argsort) — the MicroBatcher
+flush path's slice of the ISSUE-5 zero-Python hot lane.
 """
 
 from __future__ import annotations

@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import ctypes
 import sys
 import threading
 import time
@@ -63,7 +64,20 @@ from .plan_cache import (
 )
 from .storage import TpuStorage
 
-__all__ = ["NativeRlsPipeline"]
+__all__ = ["NativeRlsPipeline", "METRIC_FAMILIES"]
+
+#: metric families owned by the native hot lane (cross-checked against
+#: observability/metrics.py by tools/lint.py's registry lint): rows and
+#: hits decided by the zero-Python C lane vs the Python miss lane, and
+#: the C-side plan-mirror health counters.
+METRIC_FAMILIES = (
+    "native_lane_rows",
+    "native_lane_misses",
+    "native_lane_staged_hits",
+    "native_lane_invalidations",
+    "native_lane_overflows",
+    "native_lane_plans",
+)
 
 
 class _NsPlan:
@@ -74,11 +88,12 @@ class _NsPlan:
     def __init__(self, namespace: Namespace, compiler: NamespaceCompiler, hp):
         self.namespace = namespace
         self.compiler = compiler
-        # per vectorized limit: (limit_token, max, window_s, name, limit).
-        # The token is interned from the limit's stable identity — compile
-        # order must NOT leak into native slot keys, or a limits reload that
-        # reorders limits would alias counters (plans rebuild, the native
-        # slot map does not).
+        # per vectorized limit: (limit_token, max, window_s, name, limit,
+        # name_token). The token is interned from the limit's stable
+        # identity — compile order must NOT leak into native slot keys, or
+        # a limits reload that reorders limits would alias counters (plans
+        # rebuild, the native slot map does not). name_token feeds the hot
+        # lane's limited-call aggregation (-1 = unnamed limit).
         self.limits_meta = [
             (
                 hp.intern("limit\x00" + repr(cl.limit._identity)),
@@ -86,6 +101,7 @@ class _NsPlan:
                 cl.limit.window_seconds,
                 cl.limit.name,
                 cl.limit,
+                hp.intern(cl.limit.name) if cl.limit.name else -1,
             )
             for cl in compiler.limits
         ]
@@ -139,6 +155,7 @@ class NativeRlsPipeline:
         max_inflight: int = 2,
         plan_cache_size: int = 1 << 16,
         dispatch_chunk: Optional[int] = None,
+        hot_lane: Optional[bool] = None,
     ):
         if not native.available():
             raise RuntimeError(
@@ -207,9 +224,11 @@ class NativeRlsPipeline:
         # The C++ context is single-threaded by design; overlapping flushes
         # (timer + max_batch trigger) serialize here.
         self._native_lock = threading.Lock()
-        # host_cache phase split of the most recent begin (telemetry only;
-        # written under _native_lock, read right after on the same thread).
+        # host_cache / native_lane phase splits of the most recent begin
+        # (telemetry only; written under _native_lock, read right after
+        # on the same thread).
         self._last_host_cache = 0.0
+        self._last_native_lane = 0.0
         #: rebuild the native context when the interner exceeds this many
         #: distinct strings (high-cardinality values must not grow RSS
         #: without bound; device counters are keyed by the Python table, so
@@ -224,6 +243,26 @@ class NativeRlsPipeline:
                 self.plan_cache.invalidate_slot
             )
             self.storage._table.on_clear = self.plan_cache.bump_epoch
+        # The zero-Python hot lane (ISSUE 5): a C-side mirror of the
+        # decision-plan cache plus one-call columnar staging + response
+        # codes (native/hostpath.cc). ``hot_lane=None`` means auto (on
+        # when the library exports it); ``False`` pins the pure-Python
+        # cached lane, which stays byte-identical (the fuzz parity suite
+        # drives both).
+        self._hot_lane = None
+        #: cumulative lane stats carried across interner-recycle context
+        #: swaps (the mirror dies with its context).
+        self._lane_stats_base: Dict[str, int] = {}
+        want_lane = True if hot_lane is None else bool(hot_lane)
+        if (
+            want_lane and self.plan_cache is not None
+            and native.lane_available()
+        ):
+            self._hot_lane = self.hp.hot_lane(
+                self.storage._scratch, cap=max(4 * max_batch, 1 << 14),
+                max_rows=max(max_batch, 1 << 12),
+            )
+            self.plan_cache.add_mirror(self._hot_lane)
 
     @property
     def recorder(self):
@@ -261,9 +300,57 @@ class NativeRlsPipeline:
     def plan_cache_stats(self) -> dict:
         return self.plan_cache.stats() if self.plan_cache is not None else {}
 
+    def lane_stats(self) -> dict:
+        """Cumulative native hot-lane stats (C plan mirror + staging),
+        carried across interner-recycle context swaps. Serialized under
+        the native lock: begins mutate the C counters with the GIL
+        released, and a recycle frees the context — an unguarded read
+        from the metrics/debug thread would race both."""
+        if self._hot_lane is None:
+            return {}
+        with self._native_lock:
+            lane = self._hot_lane
+            if lane is None:
+                return {}
+            stats = lane.stats()
+            base = self._lane_stats_base
+            return {
+                key: stats[key] + base.get(key, 0)
+                for key in ("hits", "misses", "staged_hits", "insertions",
+                            "invalidations", "overflows")
+            } | {"plans": stats["plans"], "epoch": stats["epoch"]}
+
     def library_stats(self) -> dict:
-        """Metrics poll surface for the plan_cache_* families."""
-        return dict(self.plan_cache_stats())
+        """Metrics poll surface for the plan_cache_* and native_lane_*
+        families."""
+        out = dict(self.plan_cache_stats())
+        lane_stats = self.lane_stats()
+        if lane_stats:
+            out.update({
+                "native_lane_rows": lane_stats["hits"],
+                "native_lane_misses": lane_stats["misses"],
+                "native_lane_staged_hits": lane_stats["staged_hits"],
+                "native_lane_invalidations": lane_stats["invalidations"],
+                "native_lane_overflows": lane_stats["overflows"],
+                "native_lane_plans": lane_stats["plans"],
+            })
+        return out
+
+    @property
+    def hot_lane_active(self) -> bool:
+        return self._hot_lane is not None
+
+    def lane_code_templates(self) -> Optional[dict]:
+        """(grpc status, payload) per hot-lane outcome code, for the
+        native ingress's batch-coded respond path; None when the lane is
+        off (the pump then keeps the per-row answer path)."""
+        if self._hot_lane is None:
+            return None
+        return {
+            native.LANE_OK: (0, self.OK_BLOB),
+            native.LANE_UNKNOWN: (0, self.UNKNOWN_BLOB),
+            native.LANE_OVER: (0, self.OVER_BLOB),
+        }
 
     def _plan_for(self, domain_token: int) -> Optional[_NsPlan]:
         plan = self._plans.get(domain_token, _MISSING_PLAN)
@@ -448,7 +535,8 @@ class NativeRlsPipeline:
             t_submit = time.perf_counter()
             token = adm.breaker.batch_started() if adm is not None else 0
             try:
-                (results, slow_rows, pendings), t_begin, t_staged, t_cache = (
+                ((results, slow_rows, pendings), t_begin, t_staged, t_cache,
+                 t_lane) = (
                     await loop.run_in_executor(
                         self._dispatch_pool, self._timed_begin_batch,
                         [b for b, _f, _t, _rid in sub],
@@ -475,7 +563,8 @@ class NativeRlsPipeline:
             phases = {
                 "dispatch": t_begin - t_submit,
                 "host_cache": t_cache,
-                "host_stage": (t_staged - t_begin) - t_cache,
+                "native_lane": t_lane,
+                "host_stage": (t_staged - t_begin) - t_cache - t_lane,
             }
             task = loop.run_in_executor(
                 self._collect_pool, self._finish_batch, sub, results,
@@ -507,13 +596,36 @@ class NativeRlsPipeline:
         if self.hp.interned_count() <= self.max_interned:
             return
         old = self.hp
+        old_lane = self._hot_lane
         self.hp = native.HostPath()
         self._interner = self.hp.as_interner()
         self._tracked = {}
         self._plans = {}
-        self.storage._table.native_keys.clear()
-        self.storage._table.on_native_release = self.hp.slots_remove
-        old.close()
+        # The storage lock spans the swap AND the free: slot-release
+        # hooks fan out to the mirror list under this same lock, so no
+        # release can reach the old lane's context after hp_free (and
+        # lane_stats readers serialize on the native lock the caller
+        # already holds). In-flight pendings keep the OLD lane object —
+        # its finish pass is context-free (NULL ctx, per-call scratch,
+        # string memos seeded at insertion), so it survives the close.
+        with self.storage._lock:
+            if old_lane is not None:
+                # The mirror dies with its context: fold its cumulative
+                # stats into the carried base and stand up a fresh lane.
+                stats = old_lane.stats()
+                base = self._lane_stats_base
+                for key in ("hits", "misses", "staged_hits", "insertions",
+                            "invalidations", "overflows"):
+                    base[key] = base.get(key, 0) + stats[key]
+                self.plan_cache.remove_mirror(old_lane)
+                self._hot_lane = self.hp.hot_lane(
+                    self.storage._scratch, cap=old_lane.cap,
+                    max_rows=old_lane.max_rows,
+                )
+                self.plan_cache.add_mirror(self._hot_lane)
+            self.storage._table.native_keys.clear()
+            self.storage._table.on_native_release = self.hp.slots_remove
+            old.close()
 
     def decide_many(
         self, blobs: List[bytes], chunk: int = 8192, inflight: int = 8
@@ -534,27 +646,54 @@ class NativeRlsPipeline:
         from collections import deque
 
         out: List[Optional[bytes]] = []
-        window: deque = deque()  # (results, pendings), launch order
+        window: deque = deque()  # (results, pendings, codes), launch order
+        lane = self._hot_lane
+        # codes -> response template; LANE_MISS/LANE_KERNEL resolve via
+        # ``results`` (bytes, STORAGE_ERROR, or None = slow). Object-
+        # dtype fancy indexing keeps the steady-state (all-hot) batch
+        # free of per-row Python.
+        lut = np.array(
+            [None, None, self.OK_BLOB, self.UNKNOWN_BLOB, self.OVER_BLOB,
+             _STORAGE_ERROR],
+            object,
+        )
 
         def collect_oldest():
-            results, pendings = window.popleft()
+            results, pendings, codes = window.popleft()
             for p in pendings:
                 self._finish_namespace(p, results)
-            out.extend(results)
+            if codes is None:
+                out.extend(results)
+                return
+            vals = lut[codes]
+            low = np.nonzero(codes < native.LANE_OK)[0]
+            if low.size:  # miss-lane rows answer from results
+                for i in low.tolist():
+                    vals[i] = results[i]
+            out.extend(vals.tolist())
 
         for ofs in range(0, len(blobs), chunk):
             part = blobs[ofs:ofs + chunk]
             with self._native_lock:
-                # The bulk engine path skips the plan cache: its C++
-                # parse -> mask -> slot lane is already fully vectorized
-                # and beats the cache's per-row Python lookups at these
-                # chunk sizes. The cache pays on the SERVED paths, where
-                # a smaller host phase frees the GIL for the serving
-                # loops (and on slow-host/fast-device boxes generally).
-                results, _slow, pendings = self._begin_batch_locked(
-                    part, use_cache=False
-                )
-            window.append((results, pendings))
+                if lane is not None:
+                    # The hot lane moves the repeat-descriptor work —
+                    # plan lookup, staging, response build — into ONE
+                    # GIL-free C call, so the bulk engine path now DOES
+                    # ride the (mirrored) plan cache: at engine chunk
+                    # sizes the mirror's hash pass beats even the
+                    # vectorized parse -> mask -> slot lane.
+                    results, _slow, pendings, codes = (
+                        self._begin_batch_coded_locked(part, use_cache=True)
+                    )
+                else:
+                    # Pure-Python fallback: skip the plan cache — its
+                    # per-row Python lookups lose to the vectorized
+                    # parse lane at these chunk sizes.
+                    results, _slow, pendings = self._begin_batch_locked(
+                        part, use_cache=False
+                    )
+                    codes = None
+            window.append((results, pendings, codes))
             if len(window) > max(inflight, 1):
                 collect_oldest()
         while window:
@@ -565,46 +704,147 @@ class NativeRlsPipeline:
         with self._native_lock:
             return self._begin_batch_locked(blobs)
 
+    def _begin_batch_coded_ptrs(self, ptrs, lens, n: int):
+        """The ingress pump's zero-copy begin: the batch stays in the
+        take buffers (ctypes pointer/length arrays) end to end — a
+        repeat descriptor runs zero Python bytecode per row between the
+        pump and the kernel launch. Returns (codes, results, slow_rows,
+        pendings); only when the hot lane is active (the pump gates on
+        ``lane_code_templates``)."""
+        if self._hot_lane is None:
+            raise RuntimeError("native hot lane is off")
+        with self._native_lock:
+            results, slow_rows, pendings, codes = (
+                self._begin_batch_coded_locked(
+                    None, True, ptrs=ptrs, lens=lens, count=n
+                )
+            )
+        return codes, results, slow_rows, pendings
+
     def _timed_begin_batch(self, blobs: List[bytes]):
-        """(begin result, t_start, t_end, host_cache_seconds) — the
-        dispatch-thread host phase with its executor-handoff, staging and
-        plan-cache times exposed. The host_cache split is read directly
-        after the begin on the same thread; concurrent decide_many
-        callers can at worst skew this telemetry split, never the
-        results."""
+        """(begin result, t_start, t_end, host_cache_s, native_lane_s) —
+        the dispatch-thread host phase with its executor-handoff,
+        staging, plan-cache and hot-lane times exposed. The splits are
+        read directly after the begin on the same thread; concurrent
+        decide_many callers can at worst skew this telemetry split,
+        never the results."""
         t_start = time.perf_counter()
         out = self._begin_batch(blobs)
-        return out, t_start, time.perf_counter(), self._last_host_cache
+        return (out, t_start, time.perf_counter(), self._last_host_cache,
+                self._last_native_lane)
 
     def _begin_batch_locked(self, blobs: List[bytes], use_cache: bool = True):
-        """Host phase: plan-cache lookup, then parse/group/evaluate/slots
-        for the misses, LAUNCH kernels for both lanes. Returns (results,
-        slow_rows, pendings) where results rows are filled for everything
-        decided without a kernel, slow_rows lists exact-path rows (left
-        None), and each pending carries an in-flight device result for
-        ``_finish_namespace``. ``use_cache=False`` (the bulk engine
-        path) skips both lookup and insertion."""
+        """Host phase, bytes-resolving form: the coded begin below plus
+        response bytes for the rows the hot lane decided at begin time
+        (the future-resolving submit path wants ``results`` rows, not
+        codes). Hot kernel rows fill at finish (``fill_results``)."""
+        results, slow_rows, pendings, codes = self._begin_batch_coded_locked(
+            blobs, use_cache
+        )
+        if codes is not None:
+            ok_blob, unknown_blob = self.OK_BLOB, self.UNKNOWN_BLOB
+            for r in np.nonzero(codes == native.LANE_OK)[0].tolist():
+                results[r] = ok_blob
+            for r in np.nonzero(codes == native.LANE_UNKNOWN)[0].tolist():
+                results[r] = unknown_blob
+            for pending in pendings:
+                if type(pending) is _HotPending:
+                    pending.staged.fill_results = True
+        return results, slow_rows, pendings
+
+    def _begin_batch_coded_locked(
+        self, blobs: Optional[List[bytes]], use_cache: bool = True,
+        ptrs=None, lens=None, count: Optional[int] = None,
+    ):
+        """Host phase: hot-lane (or plan-cache) lookup, then
+        parse/group/evaluate/slots for the misses, LAUNCH kernels for
+        every staged lane. Returns (results, slow_rows, pendings,
+        codes):
+
+        - ``codes`` is the hot lane's per-row outcome column
+          (native.LANE_*; None when the lane is off). Rows the lane
+          decided stay None in ``results`` — the ingress pump answers
+          them with ONE ``h2i_respond_coded`` call and the submit path
+          converts codes to template bytes, so no per-row Python runs
+          for a repeat descriptor between here and the kernel launch.
+        - the miss lane fills ``results`` rows directly (bytes /
+          STORAGE_ERROR), slow_rows lists exact-path rows (left None).
+        - ``blobs`` may be None when ``ptrs``/``lens``/``count`` address
+          the batch in place (the ingress's take buffers): only
+          miss/slow rows materialize Python bytes then.
+
+        ``use_cache=False`` (the legacy bulk engine path) skips lane,
+        lookup and insertion. Callers hold ``_native_lock``."""
+        n = count if blobs is None else len(blobs)
         adm = self._tpu.admission
         if adm is not None and adm.use_failover():
             # Breaker open: every row takes the exact path (whose
             # storage call fails over to the host oracle) — the
             # columnar path would launch kernels on the dead plane.
             self._last_host_cache = 0.0
-            return [None] * len(blobs), list(range(len(blobs))), []
+            self._last_native_lane = 0.0
+            return [None] * n, list(range(n)), [], None
         self._recycle_context_if_needed()
-        n = len(blobs)
         results: List[Optional[bytes]] = [None] * n
         pendings: list = []
         slow_rows: List[int] = []
 
-        # ---- lane 1: the hot-descriptor plan cache ----------------------
         cache = self.plan_cache if use_cache else None
         # Epoch snapshot BEFORE any plan derivation: inserts check it,
         # so a limits bump racing this batch on another thread discards
         # the then-stale plans instead of filing them under the new
         # epoch.
         cache_epoch = cache.epoch if cache is not None else 0
+        lane = self._hot_lane if use_cache else None
+        codes = None
         miss_idx: List[int] = []
+        self._last_host_cache = 0.0
+        self._last_native_lane = 0.0
+        if lane is not None:
+            # ---- lane 0: the zero-Python hot lane -----------------------
+            # One GIL-free C call covers plan lookup, columnar staging
+            # into the pre-allocated upload buffers (padding included)
+            # and begin-time response codes; the storage lock spans
+            # lookup -> launch so a concurrent LRU eviction cannot
+            # recycle a plan-pinned slot in between (the mirror's
+            # invalidate_slot fires under this same lock).
+            t_lane0 = time.perf_counter()
+            with self.storage._lock:
+                if blobs is not None:
+                    staged = lane.begin(blobs, cache_epoch)
+                else:
+                    staged = lane.begin_ptrs(ptrs, lens, n, cache_epoch)
+                # Coded callers (ingress pump, decide_many) answer from
+                # the code column — only the bytes-resolving wrapper
+                # (_begin_batch_locked) flips this back on.
+                staged.fill_results = False
+                if staged.k:
+                    inflight = self.storage.begin_check_columnar(
+                        *lane.kernel_columns(staged.H)
+                    )
+                    pendings.append(_HotPending(staged, lane, inflight))
+            codes = staged.codes
+            self._last_native_lane = time.perf_counter() - t_lane0
+            if staged.ok_aggr and self.metrics is not None:
+                for ns, calls, hits in lane.ok_aggr_strings(staged.ok_aggr):
+                    self.metrics.incr_authorized_calls(ns, n=calls)
+                    self.metrics.incr_authorized_hits(ns, hits)
+            miss_mask = codes == native.LANE_MISS
+            n_miss = int(miss_mask.sum())
+            # The mirror IS the decision-plan cache's lookup half when
+            # the lane is on: account its hit/miss traffic there too, so
+            # plan_cache_hit_ratio keeps meaning "requests served from a
+            # memoized plan" regardless of which side did the lookup.
+            cache.count(n - n_miss, n_miss)
+            if n_miss == 0:
+                return results, slow_rows, pendings, codes
+            miss_idx = np.nonzero(miss_mask)[0].tolist()
+            return self._begin_miss_lane(
+                blobs, ptrs, lens, n, miss_idx, results, slow_rows,
+                pendings, codes, cache, cache_epoch, lane,
+            )
+
+        # ---- lane 1: the hot-descriptor plan cache (pure Python) --------
         t_cache0 = time.perf_counter()
         if cache is not None:
             cached_rows: List[Tuple[int, DecisionPlan]] = []
@@ -648,11 +888,32 @@ class NativeRlsPipeline:
             miss_idx = list(range(n))
         self._last_host_cache = time.perf_counter() - t_cache0
         if not miss_idx:
-            return results, slow_rows, pendings
+            return results, slow_rows, pendings, codes
+        return self._begin_miss_lane(
+            blobs, None, None, n, miss_idx, results, slow_rows, pendings,
+            codes, cache, cache_epoch, None,
+        )
 
-        # ---- lane 2: the miss path (parse -> masks -> slots) ------------
+    def _begin_miss_lane(
+        self, blobs, ptrs, lens, n, miss_idx, results, slow_rows,
+        pendings, codes, cache, cache_epoch, lane,
+    ):
+        """lane 2: the miss path (parse -> masks -> slots -> launch).
+        ``miss_idx`` rows of the batch are parsed, derived, launched and
+        memoized (Python cache + C mirror when ``lane`` is active);
+        bytes materialize here when the batch arrived as raw pointers
+        (``blobs`` None)."""
         full = len(miss_idx) == n
-        sub = blobs if full else [blobs[i] for i in miss_idx]
+        if blobs is None:
+            # Pointer-addressed batch (the ingress pump): only the miss
+            # rows become Python bytes — the hot rows never did.
+            sub = [
+                ctypes.string_at(ptrs[i], lens[i]) for i in miss_idx
+            ]
+        elif full:
+            sub = blobs
+        else:
+            sub = [blobs[i] for i in miss_idx]
         row_map = np.asarray(miss_idx, np.int32)
         domains, hits, cols, _ndesc, extra = self.hp.parse_batch(sub)
 
@@ -664,6 +925,10 @@ class NativeRlsPipeline:
             results[miss_idx[r]] = self.UNKNOWN_BLOB
             if cache is not None:
                 cache.put(sub[r], _UNKNOWN_PLAN_SINGLETON, cache_epoch)
+                if lane is not None:
+                    lane.plan_put(
+                        sub[r], cache_epoch, native.LANE_UNKNOWN, -1, 1, 1
+                    )
         slow_mask = np.logical_and(~unknown, extra > 0)
         slow_rows.extend(row_map[np.nonzero(slow_mask)[0]].tolist())
         norm_idx = np.nonzero(
@@ -701,31 +966,39 @@ class NativeRlsPipeline:
                         cache.put(
                             sub[r], _FREE_OK_PLAN_SINGLETON, cache_epoch
                         )
+                        if lane is not None:
+                            lane.plan_put(
+                                sub[r], cache_epoch, native.LANE_OK, -1,
+                                1, 1,
+                            )
                 continue
             pending = self._begin_namespace(
                 plan, token, rows, hits, cols, results, sub, row_map,
-                cache, cache_epoch,
+                cache, cache_epoch, lane,
             )
             if pending is not None:
                 pendings.append(pending)
-        return results, slow_rows, pendings
+        return results, slow_rows, pendings, codes
 
     def _begin_cached(self, cached_rows) -> "_CachedPending":
         """Stage and launch the plan-cache lane: rows grouped by hit
         arity so a whole group's kernel columns come from ONE
         ``np.array`` over the plans' flat int records — no per-row numpy
-        work. Caller holds the storage lock."""
+        work. Kernel request ids follow BATCH ROW ORDER (one stable
+        argsort restores it after the arity-grouped conversion): rows of
+        this lane contending on one counter admit in arrival order,
+        byte-identical to the C hot lane's staging. Caller holds the
+        storage lock."""
         by_n: Dict[int, list] = {}
-        for pair in cached_rows:
-            by_n.setdefault(pair[1].nhits, []).append(pair)
-        entries: List[Tuple[int, DecisionPlan]] = []
+        for pos, pair in enumerate(cached_rows):
+            by_n.setdefault(pair[1].nhits, []).append((pos, pair[1]))
+        entries: List[Tuple[int, DecisionPlan]] = cached_rows
         slots_p: List[np.ndarray] = []
         deltas_p: List[np.ndarray] = []
         maxes_p: List[np.ndarray] = []
         windows_p: List[np.ndarray] = []
         bucket_p: List[np.ndarray] = []
         req_p: List[np.ndarray] = []
-        rid_base = 0
         for nh in sorted(by_n):
             group = by_n[nh]
             k = len(group)
@@ -733,23 +1006,30 @@ class NativeRlsPipeline:
             # the table, maxes/windows are device-capped): convert the
             # whole group's flat tuples in ONE int32 pass.
             rec = np.array(
-                [p.record for _r, p in group], np.int32
+                [p.record for _pos, p in group], np.int32
             ).reshape(k, nh, 4)
             slots_p.append(rec[:, :, 0].ravel())
             maxes_p.append(rec[:, :, 1].ravel())
             windows_p.append(rec[:, :, 2].ravel())
             bucket_p.append(rec[:, :, 3].ravel().astype(bool))
             deltas_p.append(np.repeat(
-                np.array([p.delta_capped for _r, p in group], np.int32), nh
+                np.array([p.delta_capped for _pos, p in group], np.int32),
+                nh,
             ))
             req_p.append(np.repeat(
-                np.arange(rid_base, rid_base + k, dtype=np.int32), nh
+                np.array([pos for pos, _p in group], np.int32), nh
             ))
-            entries.extend(group)
-            rid_base += k
         if len(slots_p) == 1:  # common case: uniform hit arity
             slots, deltas, maxes = slots_p[0], deltas_p[0], maxes_p[0]
             windows, req, bucket = windows_p[0], req_p[0], bucket_p[0]
+            if req.size and not bool((req[:-1] <= req[1:]).all()):
+                order = np.argsort(req, kind="stable")
+                slots, deltas, maxes = (
+                    slots[order], deltas[order], maxes[order]
+                )
+                windows, req, bucket = (
+                    windows[order], req[order], bucket[order]
+                )
         else:
             slots = np.concatenate(slots_p)
             deltas = np.concatenate(deltas_p)
@@ -757,6 +1037,12 @@ class NativeRlsPipeline:
             windows = np.concatenate(windows_p)
             req = np.concatenate(req_p)
             bucket = np.concatenate(bucket_p)
+            # restore batch row order (kernel req_ids must be
+            # nondecreasing; same-request hits stay contiguous under the
+            # stable sort)
+            order = np.argsort(req, kind="stable")
+            slots, deltas, maxes = slots[order], deltas[order], maxes[order]
+            windows, req, bucket = windows[order], req[order], bucket[order]
         nhits = slots.shape[0]
         arrays = self.storage.pad_hits(
             (slots, deltas, maxes, windows, req,
@@ -857,13 +1143,14 @@ class NativeRlsPipeline:
 
     def _begin_namespace(
         self, plan, token, rows, hits, cols, results, blobs, row_map,
-        cache=None, cache_epoch=0,
+        cache=None, cache_epoch=0, lane=None,
     ) -> Optional["_NsPending"]:
         """rows index into the parse arrays (the miss subset); row_map
         maps them to positions in the submitted batch, which is what
         ``results`` rows and pendings speak. ``cache`` is the decision-
         plan cache to memoize this group's rows into — None on the bulk
-        engine path, which must not pay the per-row insertion loop."""
+        engine path, which must not pay the per-row insertion loop;
+        ``lane`` additionally mirrors the plans into the C hot lane."""
         rows_arr = np.asarray(rows, np.int32)
         m = rows_arr.shape[0]
         grows = row_map[rows_arr]  # global (batch) row per group row
@@ -897,6 +1184,7 @@ class NativeRlsPipeline:
         # limit compile order, grown only on the miss path
         row_recs: Dict[int, list] = {}
         row_names: Dict[int, list] = {}
+        row_ntoks: Dict[int, list] = {}
 
         # Lookup -> (alloc misses) -> kernel happens under the storage lock
         # so a concurrent LRU eviction cannot recycle a looked-up slot
@@ -910,7 +1198,7 @@ class NativeRlsPipeline:
                 plan.compiler.evaluate_columns(group_cols, m),
                 plan.limits_meta,
             ):
-                limit_token, max_value, window_s, name, limit = meta
+                limit_token, max_value, window_s, name, limit, ntok = meta
                 idx = np.nonzero(applies)[0].astype(np.int32)
                 if idx.size == 0:
                     continue
@@ -932,10 +1220,11 @@ class NativeRlsPipeline:
                     slots[bad] = self.storage._scratch
                     fresh[bad] = False
                 staged.append((limit, idx, slots, fresh, max_value, window_s,
-                               name))
+                               name, ntok))
 
             # Phase 2: build hit arrays with failed requests fully voided.
-            for limit, idx, slots, fresh, max_value, window_s, name in staged:
+            for (limit, idx, slots, fresh, max_value, window_s, name,
+                 ntok) in staged:
                 hit_slots.append(slots.astype(np.int32))
                 deltas_l = np.minimum(
                     deltas_req[idx], K.MAX_DELTA_CAP
@@ -966,12 +1255,14 @@ class NativeRlsPipeline:
                             (slots_l[pos], mv, win, ib)
                         )
                         row_names.setdefault(local, []).append(name)
+                        row_ntoks.setdefault(local, []).append(ntok)
 
             namespace = str(plan.namespace)
             if cache is not None:
                 self._insert_plans(
                     cache, cache_epoch, blobs, rows_arr, deltas_req,
                     failed_reqs, row_recs, row_names, namespace, m,
+                    lane, token, row_ntoks,
                 )
             if not hit_slots:
                 for r in grows.tolist():
@@ -1010,10 +1301,14 @@ class NativeRlsPipeline:
     def _insert_plans(
         self, cache, cache_epoch, blobs, rows_arr, deltas_req,
         failed_reqs, row_recs, row_names, namespace, m,
+        lane=None, ns_token=-1, row_ntoks=None,
     ) -> None:
         """Memoize this group's miss rows: kernel plans for rows with
-        resolved hits, OK plans for rows no limit applied to. Caller
-        holds the storage lock (slot liveness)."""
+        resolved hits, OK plans for rows no limit applied to — into the
+        Python cache and, when ``lane`` is active, the C plan mirror
+        (stride-5 records: the stride-4 python record plus the limit-name
+        token the hot finish aggregates limited calls by). Caller holds
+        the storage lock (slot liveness)."""
         rows_l = rows_arr.tolist()
         deltas_l = deltas_req.tolist() if hasattr(
             deltas_req, "tolist") else list(deltas_req)
@@ -1027,6 +1322,11 @@ class NativeRlsPipeline:
                 cache.put(blob, DecisionPlan(
                     PLAN_OK, namespace=namespace, delta=delta,
                 ), cache_epoch)
+                if lane is not None:
+                    lane.plan_put(
+                        blob, cache_epoch, native.LANE_OK, ns_token,
+                        delta, min(delta, K.MAX_DELTA_CAP), ns=namespace,
+                    )
             else:
                 record = tuple(recs)
                 cache.put(blob, DecisionPlan(
@@ -1038,10 +1338,51 @@ class NativeRlsPipeline:
                     limit_names=tuple(row_names[local]),
                     slots=record[0::4],
                 ), cache_epoch)
+                if lane is not None:
+                    ntoks = row_ntoks[local]
+                    rec4 = np.asarray(recs, np.int32).reshape(-1, 4)
+                    rec5 = np.empty((rec4.shape[0], 5), np.int32)
+                    rec5[:, :4] = rec4
+                    rec5[:, 4] = ntoks
+                    lane.plan_put(
+                        blob, cache_epoch, native.LANE_KERNEL, ns_token,
+                        delta, min(delta, K.MAX_DELTA_CAP), rec5,
+                        ns=namespace,
+                        names=zip(ntoks, row_names[local]),
+                    )
+
+    def _finish_hot(self, pending: "_HotPending", results) -> None:
+        """Collect the zero-Python hot lane: ONE C call turns the device
+        result columns into final response codes (in place on the
+        staged code column) and the batch's aggregated metrics. Response
+        bytes materialize only for the future-resolving submit path
+        (``fill_results``) — the ingress pump answers straight from the
+        codes."""
+        staged = pending.staged
+        admitted, hit_ok, _rem, _ttl = self.storage.finish_check_columnar(
+            pending.inflight, with_remaining=False
+        )
+        ok_aggr, limited = pending.lane.finish(staged, admitted, hit_ok)
+        if staged.fill_results:
+            ok_blob, over_blob = self.OK_BLOB, self.OVER_BLOB
+            for r, a in zip(staged.rows.tolist(),
+                            admitted[:staged.k].tolist()):
+                results[r] = ok_blob if a else over_blob
+        metrics = self.metrics
+        if metrics is not None:
+            for ns, calls, hits in ok_aggr:
+                metrics.incr_authorized_calls(ns, n=calls)
+                metrics.incr_authorized_hits(ns, hits)
+            for ns, name, count in limited:
+                metrics.incr_limited_calls(ns, name, n=count)
 
     def _finish_namespace(self, pending, results) -> None:
-        """Collect one pending's device result and fill its rows (both
-        the miss-lane namespace pendings and the plan-cache lane)."""
+        """Collect one pending's device result and fill its rows (the
+        miss-lane namespace pendings, the plan-cache lane and the native
+        hot lane)."""
+        if type(pending) is _HotPending:
+            self._finish_hot(pending, results)
+            return
         if type(pending) is _CachedPending:
             self._finish_cached(pending, results)
             return
@@ -1282,6 +1623,20 @@ class _CachedPending:
 
     def __init__(self, entries, inflight):
         self.entries = entries
+        self.inflight = inflight
+
+
+class _HotPending:
+    """The native hot lane's launched-but-uncollected kernel: the
+    staged geometry/code column plus the lane that staged it (pinned so
+    a pending survives an interner-recycle lane swap — its finish pass
+    is context-free)."""
+
+    __slots__ = ("staged", "lane", "inflight")
+
+    def __init__(self, staged, lane, inflight):
+        self.staged = staged
+        self.lane = lane
         self.inflight = inflight
 
 

@@ -191,6 +191,24 @@ class DecisionPlanCache(_BaseCache):
         super().__init__(max_size)
         # slot -> set of blob keys whose plans pin it
         self._by_slot: Dict[int, set] = {}
+        # Downstream mirrors (the C-side plan mirror of the native hot
+        # lane, native/hostpath.cc): every slot invalidation forwards so
+        # a mirrored plan can never outlive the slot it pins. Epoch
+        # bumps need no forwarding — mirrors sync the epoch lazily at
+        # their next begin, which clears them before any lookup under
+        # the new epoch.
+        self._mirrors: list = []
+
+    def add_mirror(self, mirror) -> None:
+        """Register an object with ``invalidate_slot(slot)``; called
+        under the storage lock on every slot release."""
+        self._mirrors.append(mirror)
+
+    def remove_mirror(self, mirror) -> None:
+        try:
+            self._mirrors.remove(mirror)
+        except ValueError:
+            pass
 
     def put(self, blob: bytes, plan: DecisionPlan,
             epoch: Optional[int] = None) -> None:
@@ -216,7 +234,12 @@ class DecisionPlanCache(_BaseCache):
 
     def invalidate_slot(self, slot: int) -> None:
         """A device slot was released (LRU eviction / delete / clear):
-        drop every plan that pinned it. Called under the storage lock."""
+        drop every plan that pinned it. Called under the storage lock.
+        Mirrors are notified UNCONDITIONALLY — this cache's LRU may have
+        evicted the plan while the mirror still holds it, so an empty
+        reverse-index bucket here proves nothing about the mirror."""
+        for mirror in self._mirrors:
+            mirror.invalidate_slot(slot)
         with self._lock:
             keys = self._by_slot.pop(slot, None)
             if not keys:

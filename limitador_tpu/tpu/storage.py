@@ -82,6 +82,20 @@ def _staged(values, H: int, fill, dtype) -> np.ndarray:
     return arr
 
 
+def _native_partition(group_ids: np.ndarray, n_groups: int):
+    """Native (GIL-free, O(n), no argsort) grouped cumcount when the
+    hostpath library is ALREADY loaded — never triggers a first-use
+    compile from a staging pass. Returns None to keep the numpy path."""
+    try:
+        from .. import native
+    except Exception:  # pragma: no cover - import cycles in odd embeddings
+        return None
+    try:
+        return native.partition_positions(group_ids, n_groups)
+    except Exception:
+        return None
+
+
 def _partition_positions(
     group_ids: np.ndarray, n_groups: int
 ) -> Tuple[np.ndarray, np.ndarray]:
@@ -89,9 +103,16 @@ def _partition_positions(
     group (a shard id, a request's home shard), return
     ``(counts[n_groups], pos)`` where ``pos[i]`` is row i's index WITHIN
     its group, counted in input order. This is the host side of the
-    sharded partition step — one argsort + two cumsums, no per-row
-    Python (tests/test_perf_smoke.py budgets it)."""
+    sharded partition step, riding every MicroBatcher flush on sharded
+    storage. Two implementations, identical outputs: the native one
+    (one O(n) C pass, hostpath.cc ``hp_partition_positions``) when the
+    library is already loaded, else one argsort + two cumsums — either
+    way no per-row Python (tests/test_perf_smoke.py budgets it)."""
     m = group_ids.shape[0]
+    if m >= 2048:
+        native_out = _native_partition(group_ids, n_groups)
+        if native_out is not None:
+            return native_out
     counts = np.bincount(group_ids, minlength=n_groups)
     order = np.argsort(group_ids, kind="stable")
     starts = np.concatenate(([0], np.cumsum(counts)[:-1]))

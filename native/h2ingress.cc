@@ -362,6 +362,17 @@ struct Ctx {
   std::unordered_map<uint64_t, InflightReq> inflight;
   std::vector<Resp> responses;
 
+  // Coded-response templates (h2i_set_code / h2i_respond_coded): the
+  // hot lane answers whole batches by outcome code, so the prebuilt
+  // (status, payload) pairs live here instead of crossing ctypes per
+  // request.
+  struct CodeTmpl {
+    bool set = false;
+    int status = 0;
+    std::string payload;
+  };
+  CodeTmpl code_tmpls[16];
+
   std::unordered_map<uint64_t, Conn*> conns;
   uint64_t next_conn_id = 2;  // 0 = listen socket tag, 1 = wake eventfd tag
   uint64_t next_rid = 1;
@@ -1040,6 +1051,44 @@ void h2i_respond(void* vc, int n, const uint64_t* ids, const int* statuses,
           std::string((const char*)payloads[i], lens[i])});
     }
   }
+  uint64_t one = 1;
+  ssize_t ignored = write(c->wake_fd, &one, 8);
+  (void)ignored;
+}
+
+// Register the (grpc status, payload) template answered for outcome
+// ``code`` by h2i_respond_coded. Codes are small ints (the hostpath hot
+// lane's LANE_* values); call before serving traffic.
+void h2i_set_code(void* vc, int code, int status, const uint8_t* payload,
+                  uint32_t len) {
+  Ctx* c = (Ctx*)vc;
+  if (code < 0 || code >= 16) return;
+  std::lock_guard<std::mutex> lk(c->mu);
+  c->code_tmpls[code].set = true;
+  c->code_tmpls[code].status = status;
+  c->code_tmpls[code].payload.assign((const char*)payload, len);
+}
+
+// Batch-complete answers in ONE native call: every row whose code has a
+// registered template is answered with it; negative / unregistered
+// codes are skipped (answered elsewhere — the miss/slow lanes). This is
+// the response half of the zero-Python hot lane: the pump hands the
+// take-side id buffer and the hot lane's code column straight back.
+void h2i_respond_coded(void* vc, int n, const uint64_t* ids,
+                       const int8_t* codes) {
+  Ctx* c = (Ctx*)vc;
+  int queued = 0;
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    for (int i = 0; i < n; i++) {
+      int code = codes[i];
+      if (code < 0 || code >= 16 || !c->code_tmpls[code].set) continue;
+      c->responses.push_back(Resp{
+          ids[i], c->code_tmpls[code].status, c->code_tmpls[code].payload});
+      queued++;
+    }
+  }
+  if (queued == 0) return;
   uint64_t one = 1;
   ssize_t ignored = write(c->wake_fd, &one, 8);
   (void)ignored;

@@ -24,10 +24,16 @@
 //
 // Build: g++ -O2 -shared -fPIC (see limitador_tpu/native/__init__.py).
 
+#include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <cstring>
 #include <cstdlib>
+#include <functional>
+#include <mutex>
 #include <string>
+#include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -275,11 +281,255 @@ struct Cursor {
   }
 };
 
+// ---------------------------------------------------------------------------
+// Parallel pool: a tiny persistent worker pool for the hot lane's
+// GIL-free passes (ctypes releases the GIL around every call into this
+// library, so these threads parallelize host staging for real).
+// ---------------------------------------------------------------------------
+
+struct ParallelPool {
+  std::vector<std::thread> workers;
+  std::mutex m;
+  // Serializes whole run() invocations: the pool is process-global
+  // while the Python-side native lock is per-pipeline INSTANCE, so two
+  // pipelines' hot begins may reach here concurrently.
+  std::mutex run_mu;
+  std::condition_variable cv, cv_done;
+  std::function<void(int, int)> job;  // (part index, n_parts)
+  uint64_t gen = 0;
+  int n_parts = 0;
+  int remaining = 0;
+  bool stop = false;
+
+  explicit ParallelPool(int n) {
+    for (int i = 0; i < n; i++)
+      workers.emplace_back([this, i] { worker(i); });
+  }
+
+  void worker(int idx) {
+    uint64_t seen = 0;
+    std::unique_lock<std::mutex> lk(m);
+    for (;;) {
+      cv.wait(lk, [&] { return stop || gen != seen; });
+      if (stop) return;
+      seen = gen;
+      if (idx < n_parts) {
+        auto f = job;
+        int parts = n_parts;
+        lk.unlock();
+        f(idx, parts);
+        lk.lock();
+      }
+      if (--remaining == 0) cv_done.notify_all();
+    }
+  }
+
+  // Blocks until every part ran; concurrent callers serialize on
+  // run_mu (losing parallelism, never correctness).
+  void run(int parts, std::function<void(int, int)> f) {
+    std::lock_guard<std::mutex> run_lk(run_mu);
+    std::unique_lock<std::mutex> lk(m);
+    job = std::move(f);
+    n_parts = parts;
+    remaining = (int)workers.size();
+    gen++;
+    cv.notify_all();
+    cv_done.wait(lk, [&] { return remaining == 0; });
+  }
+};
+
+// Leaked on purpose: joining at process exit would deadlock atexit
+// ordering; exit() never joins detached-by-leak workers.
+ParallelPool* g_pool = nullptr;
+std::mutex g_pool_mu;
+int g_threads = -1;  // -1 = derive from hardware on first use
+
+int lane_threads() {
+  if (g_threads >= 0) return g_threads;
+  unsigned hw = std::thread::hardware_concurrency();
+  int n = (int)(hw == 0 ? 1 : hw);
+  return n > 4 ? 4 : n;
+}
+
+ParallelPool* pool_for(int threads) {
+  std::lock_guard<std::mutex> lk(g_pool_mu);
+  if (g_pool == nullptr && threads > 1) g_pool = new ParallelPool(threads);
+  return g_pool;
+}
+
+// ---------------------------------------------------------------------------
+// Plan mirror: the C side of tpu/plan_cache.py's DecisionPlanCache.
+//
+// blob bytes -> decision plan, epoch-guarded exactly like the Python
+// cache (an epoch mismatch at sync time clears wholesale; a put carrying
+// a stale epoch is discarded). Slot invalidation is CONSERVATIVE: the
+// reverse index keys (hash, arena ref) per pinned slot and kills
+// whatever live plan those bytes currently resolve to — over-
+// invalidation only costs a re-derive, never a stale answer. Size
+// bounds (entry count, arenas) clear wholesale: the mirror is a cache
+// of the Python cache, so losing it costs one miss lane pass per hot
+// blob, nothing else.
+// ---------------------------------------------------------------------------
+
+enum LaneKind {
+  LANE_MISS = 0,
+  LANE_KERNEL = 1,
+  LANE_OK = 2,
+  LANE_UNKNOWN = 3,
+  LANE_OVER = 4,
+  LANE_ERROR = 5,
+};
+
+//: per staged hit: slot, max_value, window_ms, bucket flag, name token
+constexpr int REC_STRIDE = 5;
+
+struct PlanEntry {
+  uint64_t hash = 0;
+  uint64_t blob_off = 0;
+  uint32_t blob_len = 0;
+  int8_t state = 0;  // 0 empty, 1 live, 2 dead (tombstone)
+  int32_t kind = 0;  // LANE_KERNEL / LANE_OK / LANE_UNKNOWN
+  int32_t ns_token = -1;  // -1 = count no metrics
+  int32_t delta = 1;
+  int32_t delta_capped = 1;
+  int32_t nhits = 0;
+  uint64_t rec_off = 0;  // into recs, REC_STRIDE per hit
+};
+
+struct BlobRef {
+  uint64_t hash;
+  uint64_t off;
+  uint32_t len;
+};
+
+struct PlanMirror {
+  std::vector<PlanEntry> table;
+  std::string blob_arena;
+  std::vector<int32_t> recs;
+  uint64_t mask;
+  uint64_t live = 0;
+  uint64_t dead = 0;
+  int64_t epoch = 0;
+  std::unordered_map<int64_t, std::vector<BlobRef>> by_slot;
+  uint64_t max_plans;
+  uint64_t max_arena;
+  // cumulative stats (polled into the native_lane_* metric families)
+  uint64_t hits = 0, misses = 0, staged_hits = 0, insertions = 0,
+           invalidations = 0, overflows = 0;
+
+  explicit PlanMirror(uint64_t max_plans_ = 1 << 16)
+      : max_plans(max_plans_), max_arena(64u << 20) {
+    uint64_t cap = 1 << 12;
+    table.assign(cap, PlanEntry{});
+    mask = cap - 1;
+  }
+
+  void clear() {
+    invalidations += live;
+    for (auto& e : table) e.state = 0;
+    blob_arena.clear();
+    recs.clear();
+    by_slot.clear();
+    live = dead = 0;
+  }
+
+  void sync_epoch(int64_t e) {
+    if (e != epoch) {
+      clear();
+      epoch = e;
+    }
+  }
+
+  int64_t find(const uint8_t* blob, uint32_t len, uint64_t h) const {
+    uint64_t j = h & mask;
+    while (table[j].state != 0) {
+      const PlanEntry& e = table[j];
+      if (e.state == 1 && e.hash == h && e.blob_len == len &&
+          memcmp(blob_arena.data() + e.blob_off, blob, len) == 0)
+        return (int64_t)j;
+      j = (j + 1) & mask;
+    }
+    return -1;
+  }
+
+  void rehash(uint64_t new_cap) {
+    std::vector<PlanEntry> nt(new_cap, PlanEntry{});
+    uint64_t nmask = new_cap - 1;
+    for (auto& e : table) {
+      if (e.state != 1) continue;
+      uint64_t j = e.hash & nmask;
+      while (nt[j].state != 0) j = (j + 1) & nmask;
+      nt[j] = e;
+    }
+    table.swap(nt);
+    mask = nmask;
+    dead = 0;
+  }
+
+  void put(const uint8_t* blob, uint32_t len, int32_t kind, int32_t ns_token,
+           int32_t delta, int32_t delta_capped, const int32_t* rec,
+           int32_t nhits) {
+    if (live >= max_plans || blob_arena.size() + len > max_arena ||
+        recs.size() * sizeof(int32_t) > max_arena)
+      clear();  // coarse cap: the mirror is a cache of a cache
+    uint64_t h = Interner::fnv1a((const char*)blob, len);
+    if (find(blob, len, h) >= 0) return;  // identical derivation, keep
+    if ((live + dead) * 10 >= (mask + 1) * 7)
+      rehash(live * 10 >= (mask + 1) * 5 ? (mask + 1) << 1 : mask + 1);
+    uint64_t j = h & mask;
+    while (table[j].state == 1) j = (j + 1) & mask;
+    if (table[j].state == 2) dead--;
+    PlanEntry& e = table[j];
+    e.hash = h;
+    e.blob_off = blob_arena.size();
+    e.blob_len = len;
+    e.state = 1;
+    e.kind = kind;
+    e.ns_token = ns_token;
+    e.delta = delta;
+    e.delta_capped = delta_capped;
+    e.nhits = nhits;
+    e.rec_off = recs.size();
+    blob_arena.append((const char*)blob, len);
+    recs.insert(recs.end(), rec, rec + (size_t)nhits * REC_STRIDE);
+    live++;
+    insertions++;
+    for (int32_t i = 0; i < nhits; i++)
+      by_slot[rec[(size_t)i * REC_STRIDE]].push_back(
+          BlobRef{h, e.blob_off, len});
+  }
+
+  void invalidate_slot(int64_t slot) {
+    auto it = by_slot.find(slot);
+    if (it == by_slot.end()) return;
+    for (const BlobRef& ref : it->second) {
+      int64_t j = find((const uint8_t*)blob_arena.data() + ref.off,
+                       ref.len, ref.hash);
+      if (j >= 0) {
+        table[j].state = 2;
+        live--;
+        dead++;
+        invalidations++;
+      }
+    }
+    by_slot.erase(it);
+  }
+};
+
 struct Ctx {
   Interner interner{1 << 12};
   SlotMap slot_map{1 << 12};
   std::vector<std::string> tracked;  // column index -> descriptor key
+  PlanMirror mirror;
+  // hot-begin scratch (entry index per row), reused across calls
+  std::vector<int64_t> scratch_ent;
 };
+
+int32_t pow2_bucket(int64_t n, int64_t floor_) {
+  int64_t b = floor_;
+  while (b < n) b <<= 1;
+  return (int32_t)b;
+}
 
 }  // namespace
 
@@ -444,6 +694,334 @@ void hp_slots_remove(void* c, const int32_t* key, int32_t k) {
 
 int64_t hp_slots_count(void* c) {
   return (int64_t)((Ctx*)c)->slot_map.count;
+}
+
+// ---- hot lane -------------------------------------------------------------
+// The zero-Python serving lane: plan-mirror lookup, columnar staging into
+// the caller's pre-allocated upload buffers, and response-code build from
+// the device result columns. Calls are GIL-free (ctypes) and the begin
+// passes parallelize across the worker pool for large batches.
+
+void hp_set_threads(int32_t n) { g_threads = n < 0 ? -1 : n; }
+
+void hp_plan_epoch(void* c, int64_t epoch) {
+  ((Ctx*)c)->mirror.sync_epoch(epoch);
+}
+
+// Insert one plan; discarded when ``epoch`` no longer matches the
+// mirror's (the caller snapshotted it before deriving — same stale-put
+// contract as DecisionPlanCache.put). ``rec`` is REC_STRIDE int32 per
+// hit: slot, max_value, window_ms, bucket flag, limit-name token.
+void hp_plan_put(void* c, const uint8_t* blob, int32_t len, int64_t epoch,
+                 int32_t kind, int32_t ns_token, int32_t delta,
+                 int32_t delta_capped, const int32_t* rec, int32_t nhits) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  if (epoch != m.epoch) return;
+  m.put(blob, (uint32_t)len, kind, ns_token, delta, delta_capped, rec,
+        nhits);
+}
+
+void hp_plan_invalidate_slot(void* c, int64_t slot) {
+  ((Ctx*)c)->mirror.invalidate_slot(slot);
+}
+
+int64_t hp_plan_count(void* c) {
+  return (int64_t)((Ctx*)c)->mirror.live;
+}
+
+// out[8]: hits, misses, staged_hits, insertions, invalidations,
+// overflows, live plans, epoch
+void hp_lane_stats(void* c, int64_t* out) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  out[0] = (int64_t)m.hits;
+  out[1] = (int64_t)m.misses;
+  out[2] = (int64_t)m.staged_hits;
+  out[3] = (int64_t)m.insertions;
+  out[4] = (int64_t)m.invalidations;
+  out[5] = (int64_t)m.overflows;
+  out[6] = (int64_t)m.live;
+  out[7] = m.epoch;
+}
+
+// The hot begin: one call per batch covering plan lookup + columnar
+// staging + begin-time response codes.
+//
+//   ptrs/lens[n]: the raw request blobs (no copy — the ingress's take
+//       buffers or a ctypes view over Python bytes objects)
+//   epoch: the caller's limits epoch (mirror clears when it moved)
+//   out_kind[n]: LANE_MISS / LANE_KERNEL / LANE_OK / LANE_UNKNOWN
+//   slots..bucket[cap]: pre-allocated kernel staging columns; staged
+//       hits land at [0, nhits) and padding up to the pow2 bucket H is
+//       filled here (scratch slot, delta 0, req H-1) so Python stages
+//       NOTHING per row
+//   out_rows/out_row_nhits/out_row_delta/out_row_ns[n]: per kernel row
+//       (in kernel request-id order == batch order)
+//   out_hit_names[cap]: limit-name token per staged hit
+//   out_ok_ns/out_ok_calls/out_ok_hits[n]: begin-time OK metric
+//       aggregation (plan-OK rows), n_ok_ns distinct namespaces
+//   out_meta[8]: k, nhits, H, hit_rows, miss_rows, overflow_rows,
+//       n_ok_ns, 0
+// Returns k (kernel rows staged).
+int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
+                     const uint32_t* lens, int32_t n, int64_t epoch,
+                     int8_t* out_kind, int32_t* slots, int32_t* deltas,
+                     int32_t* maxes, int32_t* windows, int32_t* req,
+                     uint8_t* bucket, int64_t cap, int64_t scratch_slot,
+                     int32_t* out_rows, int32_t* out_row_nhits,
+                     int32_t* out_row_delta, int32_t* out_row_ns,
+                     int32_t* out_hit_names, int32_t* out_ok_ns,
+                     int64_t* out_ok_calls, int64_t* out_ok_hits,
+                     int64_t* out_meta) {
+  Ctx* ctx = (Ctx*)c;
+  PlanMirror& m = ctx->mirror;
+  m.sync_epoch(epoch);
+  std::vector<int64_t>& ent = ctx->scratch_ent;
+  if ((int64_t)ent.size() < n) ent.resize(n);
+
+  // Pass 1 (parallel): hash + mirror lookup per row; OK/UNKNOWN rows get
+  // their begin-time code here. Reads only; disjoint writes per range.
+  int threads = lane_threads();
+  ParallelPool* pool = n >= 4096 && threads > 1 ? pool_for(threads) : nullptr;
+  auto lookup_range = [&](int part, int parts) {
+    int32_t lo = (int32_t)((int64_t)n * part / parts);
+    int32_t hi = (int32_t)((int64_t)n * (part + 1) / parts);
+    for (int32_t r = lo; r < hi; r++) {
+      uint64_t h = Interner::fnv1a((const char*)ptrs[r], lens[r]);
+      int64_t j = m.find(ptrs[r], lens[r], h);
+      ent[r] = j;
+      if (j < 0) {
+        out_kind[r] = LANE_MISS;
+      } else {
+        int32_t kind = m.table[j].kind;
+        out_kind[r] = (int8_t)(kind == LANE_KERNEL ? LANE_KERNEL : kind);
+      }
+    }
+  };
+  if (pool != nullptr) {
+    pool->run((int)pool->workers.size(), lookup_range);
+  } else {
+    lookup_range(0, 1);
+  }
+
+  // Pass 2 (serial): kernel-row offsets (prefix sum), overflow handling,
+  // and the begin-time OK metric aggregation.
+  int32_t k = 0;
+  int64_t nhits = 0;
+  int64_t hit_rows = 0, miss_rows = 0, overflow_rows = 0;
+  int32_t n_ok_ns = 0;
+  // per-kernel-row hit offset, reused scratch tail of ent (append)
+  std::vector<int64_t> row_off((size_t)n);
+  for (int32_t r = 0; r < n; r++) {
+    int64_t j = ent[r];
+    if (j < 0) {
+      miss_rows++;
+      continue;
+    }
+    hit_rows++;
+    const PlanEntry& e = m.table[j];
+    if (e.kind == LANE_KERNEL) {
+      if (nhits + e.nhits > cap) {
+        // Staging buffers full: everything from here takes the Python
+        // miss lane (safe: it re-derives). Counted so a silently
+        // undersized cap shows in native_lane_overflows.
+        out_kind[r] = LANE_MISS;
+        ent[r] = -1;
+        hit_rows--;
+        overflow_rows++;
+        miss_rows++;
+        continue;
+      }
+      out_rows[k] = r;
+      out_row_nhits[k] = e.nhits;
+      out_row_delta[k] = e.delta;
+      out_row_ns[k] = e.ns_token;
+      row_off[k] = nhits;
+      nhits += e.nhits;
+      k++;
+    } else if (e.kind == LANE_OK && e.ns_token >= 0) {
+      int32_t g = 0;
+      for (; g < n_ok_ns; g++) {
+        if (out_ok_ns[g] == e.ns_token) break;
+      }
+      if (g == n_ok_ns) {
+        out_ok_ns[g] = e.ns_token;
+        out_ok_calls[g] = 0;
+        out_ok_hits[g] = 0;
+        n_ok_ns++;
+      }
+      out_ok_calls[g] += 1;
+      out_ok_hits[g] += e.delta;
+    }
+  }
+  m.hits += (uint64_t)hit_rows;
+  m.misses += (uint64_t)miss_rows;
+  m.staged_hits += (uint64_t)nhits;
+  m.overflows += (uint64_t)overflow_rows;
+
+  // Pass 3 (parallel): scatter plan records into the staging columns.
+  auto stage_range = [&](int part, int parts) {
+    int32_t lo = (int32_t)((int64_t)k * part / parts);
+    int32_t hi = (int32_t)((int64_t)k * (part + 1) / parts);
+    for (int32_t i = lo; i < hi; i++) {
+      const PlanEntry& e = m.table[ent[out_rows[i]]];
+      const int32_t* rec = m.recs.data() + e.rec_off;
+      int64_t off = row_off[i];
+      for (int32_t hnum = 0; hnum < e.nhits; hnum++) {
+        slots[off] = rec[0];
+        maxes[off] = rec[1];
+        windows[off] = rec[2];
+        bucket[off] = (uint8_t)rec[3];
+        out_hit_names[off] = rec[4];
+        deltas[off] = e.delta_capped;
+        req[off] = i;
+        rec += REC_STRIDE;
+        off++;
+      }
+    }
+  };
+  if (pool != nullptr && k >= 4096) {
+    pool->run((int)pool->workers.size(), stage_range);
+  } else {
+    stage_range(0, 1);
+  }
+
+  // Pass 4: pad to the kernel's pow2 hit bucket with inert scratch hits
+  // (delta 0, req H-1 — exactly TpuStorage.pad_hits' fill).
+  int32_t H = 0;
+  if (k > 0) {
+    H = pow2_bucket(nhits > k ? nhits : k, 8);
+    if (H > cap) H = (int32_t)cap;  // cap is pow2-sized by the caller
+    for (int64_t i = nhits; i < H; i++) {
+      slots[i] = (int32_t)scratch_slot;
+      deltas[i] = 0;
+      maxes[i] = 0x7fffffff;
+      windows[i] = 0;
+      req[i] = H - 1;
+      bucket[i] = 0;
+    }
+  }
+  out_meta[0] = k;
+  out_meta[1] = nhits;
+  out_meta[2] = H;
+  out_meta[3] = hit_rows;
+  out_meta[4] = miss_rows;
+  out_meta[5] = overflow_rows;
+  out_meta[6] = n_ok_ns;
+  out_meta[7] = 0;
+  return k;
+}
+
+// Concatenated-buffer form of hp_hot_begin: ``buf`` holds the blobs
+// back to back with ``sizes[n]`` lengths (the cheap layout a Python
+// bytes join produces — building a per-row pointer table through ctypes
+// costs ~850ns/row, 4x the entire C pass). The pointer table is derived
+// here in one O(n) sweep.
+int32_t hp_hot_begin_buf(void* c, const uint8_t* buf, const int32_t* sizes,
+                         int32_t n, int64_t epoch, int8_t* out_kind,
+                         int32_t* slots, int32_t* deltas, int32_t* maxes,
+                         int32_t* windows, int32_t* req, uint8_t* bucket,
+                         int64_t cap, int64_t scratch_slot,
+                         int32_t* out_rows, int32_t* out_row_nhits,
+                         int32_t* out_row_delta, int32_t* out_row_ns,
+                         int32_t* out_hit_names, int32_t* out_ok_ns,
+                         int64_t* out_ok_calls, int64_t* out_ok_hits,
+                         int64_t* out_meta) {
+  std::vector<const uint8_t*> ptrs((size_t)n);
+  std::vector<uint32_t> lens((size_t)n);
+  const uint8_t* p = buf;
+  for (int32_t i = 0; i < n; i++) {
+    ptrs[i] = p;
+    lens[i] = (uint32_t)sizes[i];
+    p += sizes[i];
+  }
+  return hp_hot_begin(c, ptrs.data(), lens.data(), n, epoch, out_kind,
+                      slots, deltas, maxes, windows, req, bucket, cap,
+                      scratch_slot, out_rows, out_row_nhits, out_row_delta,
+                      out_row_ns, out_hit_names, out_ok_ns, out_ok_calls,
+                      out_ok_hits, out_meta);
+}
+
+// The hot finish: turn the device result columns into response codes and
+// aggregate the batch's metrics in one pass. Stateless with respect to
+// the mirror (safe from any collect thread while the next begin runs).
+//
+//   admitted[k]: per kernel row; hit_ok[nhits]: per staged hit
+//   out_kind: rows flip LANE_KERNEL -> LANE_OK / LANE_OVER
+//   out_ok_*[k]: admitted-call aggregation per namespace token
+//   out_lim_ns/out_lim_name/out_lim_count[k]: limited aggregation per
+//       (namespace, first-failing-limit-name) token pair
+//   out_counts[2]: n_ok_ns, n_limited
+void hp_hot_finish(void* c, const uint8_t* admitted, const uint8_t* hit_ok,
+                   int32_t k, const int32_t* rows,
+                   const int32_t* row_nhits, const int32_t* row_delta,
+                   const int32_t* row_ns, const int32_t* hit_names,
+                   int8_t* out_kind, int32_t* out_ok_ns,
+                   int64_t* out_ok_calls, int64_t* out_ok_hits,
+                   int32_t* out_lim_ns, int32_t* out_lim_name,
+                   int64_t* out_lim_count, int64_t* out_counts) {
+  (void)c;
+  int32_t n_ok = 0, n_lim = 0;
+  int64_t base = 0;
+  for (int32_t i = 0; i < k; i++) {
+    int32_t r = rows[i];
+    if (admitted[i]) {
+      out_kind[r] = LANE_OK;
+      int32_t ns = row_ns[i];
+      if (ns >= 0) {
+        int32_t g = 0;
+        for (; g < n_ok; g++) {
+          if (out_ok_ns[g] == ns) break;
+        }
+        if (g == n_ok) {
+          out_ok_ns[g] = ns;
+          out_ok_calls[g] = 0;
+          out_ok_hits[g] = 0;
+          n_ok++;
+        }
+        out_ok_calls[g] += 1;
+        out_ok_hits[g] += row_delta[i];
+      }
+    } else {
+      out_kind[r] = LANE_OVER;
+      int32_t ns = row_ns[i];
+      if (ns >= 0) {
+        // first failing hit in request order names the limit
+        int32_t name = -1;
+        for (int32_t hnum = 0; hnum < row_nhits[i]; hnum++) {
+          if (!hit_ok[base + hnum]) {
+            name = hit_names[base + hnum];
+            break;
+          }
+        }
+        int32_t g = 0;
+        for (; g < n_lim; g++) {
+          if (out_lim_ns[g] == ns && out_lim_name[g] == name) break;
+        }
+        if (g == n_lim) {
+          out_lim_ns[g] = ns;
+          out_lim_name[g] = name;
+          out_lim_count[g] = 0;
+          n_lim++;
+        }
+        out_lim_count[g] += 1;
+      }
+    }
+    base += row_nhits[i];
+  }
+  out_counts[0] = n_ok;
+  out_counts[1] = n_lim;
+}
+
+// ---- per-shard partition (tpu/storage.py staging assist) -----------------
+
+// Grouped cumcount in one O(n) pass: counts[n_groups] and pos[i] = row
+// i's index within its group, counted in input order — the host side of
+// the sharded staging partition, minus numpy's argsort.
+void hp_partition_positions(const int32_t* group_ids, int64_t n,
+                            int32_t n_groups, int64_t* out_counts,
+                            int64_t* out_pos) {
+  for (int32_t g = 0; g < n_groups; g++) out_counts[g] = 0;
+  for (int64_t i = 0; i < n; i++) out_pos[i] = out_counts[group_ids[i]]++;
 }
 
 }  // extern "C"

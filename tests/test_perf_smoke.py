@@ -30,6 +30,14 @@ ENGINE_BUDGET_US = 25.0
 #: cumsums + one fancy store per column) measures ~0.1 µs/hit on the
 #: throttled CI box; a per-row Python fallback measures ~1-3 µs.
 PARTITION_BUDGET_US = 0.8
+#: per-row budget for the zero-Python hot lane's begin + finish
+#: (plan-mirror lookup, columnar staging into the pre-allocated upload
+#: buffers, response-code build from the device columns), in
+#: NANOSECONDS. The C passes measure ~200-400 ns/row on the throttled
+#: CI box; a silent fall-through to the pure-Python cached lane
+#: measures ~1500-3000 ns — the generous multiplier still catches that
+#: regression class.
+NATIVE_LANE_BUDGET_NS = 1200.0
 
 
 def _blobs(n, users=512):
@@ -137,6 +145,40 @@ def test_sharded_partition_step_stays_vectorized():
         f"per-shard partition costs {per_hit_us:.2f} µs/hit "
         f"(budget {PARTITION_BUDGET_US} µs — did per-row Python sneak "
         "back into the staging pass?)"
+    )
+
+
+def test_native_lane_staging_and_response_build_within_budget(pipeline):
+    """ns/row budget for the hot lane's host phases ALONE (no kernel):
+    begin (plan lookup + columnar staging + padding) and finish
+    (response codes + metric aggregation from the device columns). A
+    regression that silently re-routes these phases through Python
+    blows the budget by an order of magnitude."""
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None:
+        pytest.skip("native hot lane unavailable")
+    blobs = _blobs(4096)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror the plans
+    epoch = p.plan_cache.epoch
+    admitted = np.ones(len(blobs), bool)
+    hit_ok = np.ones(lane.cap, bool)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        staged = lane.begin(blobs, epoch)
+        lane.finish(staged, admitted, hit_ok)
+        best = min(best, time.perf_counter() - t0)
+    # the lane must actually have served these rows natively — a silent
+    # fallback (all misses) would make the timing meaningless
+    assert staged.k == len(blobs), (
+        f"hot lane staged only {staged.k}/{len(blobs)} rows natively"
+    )
+    per_row_ns = best / len(blobs) * 1e9
+    assert per_row_ns <= NATIVE_LANE_BUDGET_NS, (
+        f"native hot lane costs {per_row_ns:.0f} ns/row "
+        f"(budget {NATIVE_LANE_BUDGET_NS} ns — did staging or response "
+        "build fall back to Python?)"
     )
 
 
