@@ -1,0 +1,57 @@
+"""Quota-leasing edge tier (ISSUE 6).
+
+The write-behind topology of the reference answers hot keys locally and
+settles with the authoritative store asynchronously; the scalable
+rate-limiting survey names quota leasing with a bounded over-admission
+contract as the way to do that without giving up enforceability. This
+package is that tier for the native serving path: the
+:class:`~limitador_tpu.lease.broker.LeaseBroker` watches the C plan
+mirror's demand signal, pre-debits batches of quota from the device
+table through the shared columnar check lane, and attaches the tokens
+to the mirrored plan — after which a repeat descriptor with live lease
+tokens is admitted inside ``hp_hot_begin`` with zero Python and zero
+device work.
+
+The contract (enforced, and proven by tests/test_lease.py):
+
+- **Bounded over-admission**: grants are pre-debited, so the device
+  counter always runs AHEAD of true usage by exactly the outstanding
+  (granted-but-unconsumed) tokens — over-admission for any counter is
+  bounded by its outstanding leased tokens, and only across a window
+  roll (within a window the pre-debit makes leased admission exact).
+- **Headroom-checked grants**: the debit rides the same
+  check-all-then-update-all kernel as live traffic, so a grant that
+  would exceed the remaining window headroom is refused atomically —
+  a lease is never granted past the headroom that existed at grant
+  time.
+- **No stranded quota**: unused tokens come back. Expiry revokes
+  synchronously; plan invalidation (slot recycling, limits-epoch bumps
+  from reload, snapshot/restore table swaps — the same
+  ``DecisionPlanCache`` release hooks the mirror already rides) pushes
+  the balance onto a return ring the broker drains and credits back
+  through a dedicated floor-guarded credit kernel
+  (``ops/kernel.py::credit_batch``). Credits verify slot->counter
+  identity under the storage lock, so a recycled slot's dead debit is
+  dropped instead of crediting a stranger.
+- **Cold keys stay exact**: only repeat descriptors with a live
+  mirrored kernel plan are leasable; cold keys, multi-descriptor
+  requests, exact-path namespaces, big limits and capped addends all
+  keep the existing exact lanes. ``--lease-mode off`` (the default) is
+  byte-identical to the pre-lease tier.
+"""
+
+from .broker import LeaseBroker, LeaseConfig
+
+__all__ = ["LeaseBroker", "LeaseConfig", "METRIC_FAMILIES"]
+
+#: Prometheus families owned by the lease tier (lint-enforced against
+#: the declarations in observability/metrics.py).
+METRIC_FAMILIES = (
+    "lease_admissions",
+    "lease_grants",
+    "lease_grant_denials",
+    "lease_granted_tokens",
+    "lease_returned_tokens",
+    "lease_active",
+    "lease_outstanding_tokens",
+)

@@ -30,6 +30,7 @@ from .build import NativeLib, build_status
 __all__ = [
     "available",
     "lane_available",
+    "lease_available",
     "build_error",
     "build_status",
     "HostPath",
@@ -110,6 +111,34 @@ def _bind(lib) -> None:
     lib.hp_plan_count.restype = ctypes.c_int64
     lib.hp_plan_count.argtypes = [ctypes.c_void_p]
     lib.hp_lane_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # -- quota leasing (lease/broker.py drives these under the native
+    # lock; consume itself rides hp_hot_begin) -------------------------
+    lib.hp_lease_config.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.hp_lease_grant.restype = ctypes.c_int32
+    lib.hp_lease_grant.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.hp_lease_revoke.restype = ctypes.c_int64
+    lib.hp_lease_revoke.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+    ]
+    lib.hp_lease_tokens.restype = ctypes.c_int64
+    lib.hp_lease_tokens.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+    ]
+    lib.hp_lease_drain_returns.restype = ctypes.c_int32
+    lib.hp_lease_drain_returns.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
+    ]
+    lib.hp_lease_candidates.restype = ctypes.c_int32
+    lib.hp_lease_candidates.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int32,
+    ]
+    lib.hp_lease_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.hp_hot_begin.restype = ctypes.c_int32
     lib.hp_hot_begin.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
@@ -161,6 +190,13 @@ def lane_available() -> bool:
     pre-stamped binary without them degrades to the pure-Python lane)."""
     lib = _load()
     return lib is not None and hasattr(lib, "hp_hot_begin")
+
+
+def lease_available() -> bool:
+    """True when the loaded library exports the quota-lease symbols (an
+    old pre-stamped binary without them serves without the lease tier)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hp_lease_grant")
 
 
 def loaded():
@@ -422,6 +458,79 @@ class NativeHotLane:
             self._ctx, blob, len(blob), epoch, kind, ns_token,
             min(int(delta), _INT32_MAX), int(delta_capped), ptr, nhits,
         )
+
+    # -- quota leasing (lease/broker.py) -------------------------------------
+    # All lease calls run under the pipeline's native lock, the same lock
+    # serializing the begins that consume tokens.
+
+    def lease_config(self, enabled: bool, hot_threshold: int = 8) -> None:
+        if hasattr(self._lib, "hp_lease_config"):
+            self._lib.hp_lease_config(
+                self._ctx, 1 if enabled else 0, int(hot_threshold)
+            )
+
+    def lease_grant(self, blob: bytes, epoch: int, lease_id: int,
+                    tokens: int) -> bool:
+        """Attach a pre-debited grant to the mirrored plan; False means
+        the plan is gone / epoch moved / already leased — the caller
+        must credit the debit straight back."""
+        return bool(self._lib.hp_lease_grant(
+            self._ctx, blob, len(blob), epoch, lease_id, int(tokens)
+        ))
+
+    def lease_revoke(self, blob: bytes, expect_id: int = -1) -> int:
+        """Reclaim a lease synchronously; returns the remaining tokens,
+        or -1 when there is nothing live to reclaim (the tokens already
+        travelled through the return ring, the plan is gone, or the
+        plan's live lease is a newer grant than ``expect_id``)."""
+        return self._lib.hp_lease_revoke(
+            self._ctx, blob, len(blob), expect_id
+        )
+
+    def lease_tokens(self, blob: bytes, expect_id: int = -1) -> int:
+        return self._lib.hp_lease_tokens(
+            self._ctx, blob, len(blob), expect_id
+        )
+
+    def lease_drain_returns(self, cap: int = 4096):
+        """[(lease_id, stranded tokens)] pushed by invalidation/clear."""
+        ids = np.empty(cap, np.int64)
+        tokens = np.empty(cap, np.int64)
+        n = self._lib.hp_lease_drain_returns(
+            self._ctx, ids.ctypes.data, tokens.ctypes.data, cap
+        )
+        return list(zip(ids[:n].tolist(), tokens[:n].tolist()))
+
+    def lease_candidates(self, cap: int = 256, blob_cap: int = 1 << 20):
+        """[(blob bytes, observed demand)] for hot unleased kernel
+        plans; draining resets their demand counts."""
+        blobs = np.empty(blob_cap, np.uint8)
+        lens = np.empty(cap, np.int32)
+        counts = np.empty(cap, np.int64)
+        n = self._lib.hp_lease_candidates(
+            self._ctx, blobs.ctypes.data, blob_cap, lens.ctypes.data,
+            counts.ctypes.data, cap,
+        )
+        if n == 0:
+            return []
+        used = int(lens[:n].sum())
+        raw = blobs[:used].tobytes()  # copy only the written prefix
+        out = []
+        off = 0
+        for i in range(n):
+            ln = int(lens[i])
+            out.append((raw[off:off + ln], int(counts[i])))
+            off += ln
+        return out
+
+    def lease_stats(self) -> dict:
+        out = np.zeros(8, np.int64)
+        if self._ctx and hasattr(self._lib, "hp_lease_stats"):
+            self._lib.hp_lease_stats(self._ctx, out.ctypes.data)
+        keys = ("leased", "grants", "granted_tokens", "ring_tokens",
+                "active", "outstanding", "pending_candidates",
+                "pending_returns")
+        return dict(zip(keys, out.tolist()))
 
     # -- begin / finish ------------------------------------------------------
 

@@ -34,7 +34,9 @@ Per-batch phase names (``PHASES``):
   dispatch thread for the rows the cache missed,
 * ``device_sync`` — device round trip: blocking on the launched kernel
   and the device->host transfer,
-* ``unpack`` — decoding results and resolving futures.
+* ``unpack`` — decoding results and resolving futures,
+* ``lease`` — one lease-broker refresh pass (settle stranded tokens +
+  batched grant debits; lease/broker.py — zero with the tier off).
 """
 
 from __future__ import annotations
@@ -60,7 +62,7 @@ __all__ = [
 ]
 
 PHASES = ("dispatch", "host_cache", "native_lane", "host_stage",
-          "device_sync", "unpack")
+          "device_sync", "unpack", "lease")
 FLUSH_REASONS = ("size", "deadline", "shutdown")
 # The two queues feeding the batcher_* families: the decision path's
 # MicroBatcher vs the write path's UpdateBatcher. Labeled apart because

@@ -321,6 +321,52 @@ class PrometheusMetrics:
             "Decision plans live in the C-side plan mirror",
             registry=self.registry,
         )
+        # -- quota-lease tier (lease/broker.py + native/hostpath.cc):
+        # locally-admitted leased decisions, grant/settle traffic, and
+        # the outstanding-token level that IS the over-admission bound.
+        # Polled cumulative from the pipeline's library_stats
+        # (baseline-converted). Registered in lease.METRIC_FAMILIES
+        # (lint cross-checked).
+        self.lease_admissions = Counter(
+            "lease_admissions",
+            "Requests admitted from a live quota lease in the C hot "
+            "lane (zero Python, zero device work)",
+            registry=self.registry,
+        )
+        self.lease_grants = Counter(
+            "lease_grants",
+            "Quota leases granted (pre-debited through the columnar "
+            "check lane, headroom-checked atomically)",
+            registry=self.registry,
+        )
+        self.lease_grant_denials = Counter(
+            "lease_grant_denials",
+            "Lease grants refused by the device for lack of window "
+            "headroom (the broker halves and backs off)",
+            registry=self.registry,
+        )
+        self.lease_granted_tokens = Counter(
+            "lease_granted_tokens",
+            "Tokens granted across all leases",
+            registry=self.registry,
+        )
+        self.lease_returned_tokens = Counter(
+            "lease_returned_tokens",
+            "Unused lease tokens reclaimed (expiry, plan invalidation, "
+            "limits reload, context swap) and credited back",
+            registry=self.registry,
+        )
+        self.lease_active = Gauge(
+            "lease_active",
+            "Live leases (mirrored plans holding tokens)",
+            registry=self.registry,
+        )
+        self.lease_outstanding_tokens = Gauge(
+            "lease_outstanding_tokens",
+            "Outstanding (granted-but-unconsumed) lease tokens — the "
+            "enforced over-admission bound",
+            registry=self.registry,
+        )
         # -- multi-chip dispatch (tpu/sharded.py): launch counts per
         # collective variant, polled baseline-converted off
         # launch_stats()/library_stats. Registered in
@@ -450,6 +496,8 @@ class PrometheusMetrics:
         queue_depth = 0
         plan_cache_size = 0
         native_lane_plans = 0
+        lease_active = 0
+        lease_outstanding = 0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -461,6 +509,10 @@ class PrometheusMetrics:
             queue_depth += int(stats.get("queue_depth", 0))
             plan_cache_size += int(stats.get("plan_cache_size", 0))
             native_lane_plans += int(stats.get("native_lane_plans", 0))
+            lease_active += int(stats.get("lease_active", 0))
+            lease_outstanding += int(
+                stats.get("lease_outstanding_tokens", 0)
+            )
             for key in (
                 "counter_overshoot",
                 "evicted_pending_writes",
@@ -479,6 +531,11 @@ class PrometheusMetrics:
                 "native_lane_staged_hits",
                 "native_lane_invalidations",
                 "native_lane_overflows",
+                "lease_admissions",
+                "lease_grants",
+                "lease_grant_denials",
+                "lease_granted_tokens",
+                "lease_returned_tokens",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -502,6 +559,8 @@ class PrometheusMetrics:
         self.batcher_queue_depth.set(queue_depth)
         self.plan_cache_size.set(plan_cache_size)
         self.native_lane_plans.set(native_lane_plans)
+        self.lease_active.set(lease_active)
+        self.lease_outstanding_tokens.set(lease_outstanding)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

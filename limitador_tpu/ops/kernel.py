@@ -77,6 +77,7 @@ __all__ = [
     "check_and_update_core",
     "update_batch",
     "update_core",
+    "credit_batch",
     "read_slots",
     "clear_slots",
     "rebase_epoch",
@@ -518,6 +519,50 @@ def update_batch(
         bucket, now_ms,
     )
     return CounterTableState(nv, ne)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def credit_batch(
+    state: CounterTableState,
+    slots: jax.Array,       # int32[H] slot per credit (C for padding)
+    credits: jax.Array,     # int32[H] tokens*delta to return, >= 0
+    windows_ms: jax.Array,  # int32[H] emission interval I for bucket rows
+    bucket: jax.Array,      # bool[H]
+    now_ms: jax.Array,      # int32 scalar
+) -> CounterTableState:
+    """Return unused leased quota (lease/broker.py): subtract each
+    credit from its counter, floored so a credit can never create more
+    headroom than a fresh cell holds. The update lane clips deltas at 0
+    (its 8-bit lane split can't carry signs), so credits get their own
+    scatter instead of widening that kernel.
+
+    Callers aggregate per slot host-side (one row per slot — duplicate
+    slots would race the scatter) and pad with the scratch slot, credit
+    0. Fixed windows: value = max(value - credit, 0) while the window is
+    live; an expired cell is left alone (it already reads as 0 and the
+    debit evaporated with the window). Buckets: the TAT retreats by
+    credit*I, floored at now (TAT <= now is a full bucket); credit*I is
+    computed only when it cannot wrap int32 (credit < intervals-ahead),
+    else the TAT floors straight to now."""
+    v = state.values[slots]
+    e = state.expiry_ms[slots]
+    live_window = jnp.logical_and(~bucket, now_ms < e)
+    new_v = jnp.where(live_window, jnp.maximum(v - credits, 0), v)
+    ival = jnp.maximum(windows_ms, 1)
+    ahead = jnp.maximum(e - now_ms, 0)
+    covers = credits >= ahead // ival  # credit >= whole intervals ahead
+    bucket_live = jnp.logical_and(bucket, e > now_ms)
+    new_e = jnp.where(
+        bucket_live,
+        jnp.where(covers, now_ms, e - credits * ival),
+        e,
+    )
+    values = state.values.at[slots].set(new_v)
+    expiry = state.expiry_ms.at[slots].set(new_e)
+    # Scratch cell stays inert (it absorbed the padding writes).
+    values = values.at[-1].set(0)
+    expiry = expiry.at[-1].set(0)
+    return CounterTableState(values, expiry)
 
 
 @jax.jit

@@ -255,6 +255,26 @@ def build_parser() -> argparse.ArgumentParser:
         "cached lane — byte-identical decisions, host-bound throughput",
     )
     p.add_argument(
+        "--lease-mode",
+        choices=["on", "off"],
+        default=_env("TPU_LEASE_MODE", "off"),
+        help="quota-leasing edge tier (requires --pipeline native with "
+        "the hot lane): hot descriptors get pre-debited token batches "
+        "attached to their mirrored plans, so repeat decisions complete "
+        "with zero device work; over-admission per counter is bounded "
+        "by its outstanding leased tokens, grants never exceed the "
+        "remaining window headroom, and cold/exact-path keys stay "
+        "exact. 'off' (default) is byte-identical to the pre-lease "
+        "serving path",
+    )
+    p.add_argument(
+        "--lease-max-tokens", type=int,
+        default=int(_env("TPU_LEASE_MAX_TOKENS", "1024")),
+        help="per-lease token cap (the broker sizes each grant from "
+        "observed demand up to this, doubling on renewal and halving "
+        "on a headroom denial)",
+    )
+    p.add_argument(
         "--native-ingress",
         action="store_true",
         default=_env("TPU_NATIVE_INGRESS", "") == "1",
@@ -839,10 +859,36 @@ async def _amain(args) -> int:
             metrics.attach_library_source(native_pipeline)
             if admission is not None:
                 admission.add_drainable(native_pipeline)
+            if args.lease_mode == "on":
+                if native_pipeline.hot_lane_active:
+                    from ..lease import LeaseConfig
+
+                    try:
+                        native_pipeline.attach_lease(LeaseConfig(
+                            max_tokens=args.lease_max_tokens,
+                        ))
+                        log.info(
+                            "limitador-tpu: quota-lease tier on "
+                            f"(max {args.lease_max_tokens} tokens/lease)")
+                    except RuntimeError as exc:
+                        # e.g. a storage without the credit lane
+                        # (sharded/global counters stay exact by design)
+                        log.warning(
+                            f"--lease-mode on unavailable: {exc}; "
+                            "serving without the lease tier")
+                else:
+                    log.warning(
+                        "--lease-mode on requires the native hot lane "
+                        "(plan mirror); serving without the lease tier")
         else:
             log.warning(
                 f"native hostpath unavailable "
                 f"({native_mod.build_error()}); using compiled pipeline")
+
+    if args.lease_mode == "on" and native_pipeline is None:
+        log.warning(
+            "--lease-mode on requires tpu storage with --pipeline native; "
+            "serving without the lease tier")
 
     authority_server = None
     if args.authority_listen:

@@ -379,6 +379,16 @@ class _Api:
                 if lane:
                     stats["native_hot_lane"] = lane
                     break
+        for source in self.debug_sources:
+            lease_stats = getattr(source, "lease_stats", None)
+            if callable(lease_stats):
+                try:
+                    lease = lease_stats()
+                except Exception:
+                    lease = None
+                if lease:
+                    stats["lease"] = lease
+                    break
         return web.json_response(stats)
 
     async def get_debug_profile(self, request: web.Request) -> web.Response:

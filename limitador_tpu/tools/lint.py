@@ -57,6 +57,7 @@ REGISTRY_OWNED_PREFIXES = {
     "sharded_": "limitador_tpu/tpu/sharded.py",
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
     "native_lane_": "limitador_tpu/tpu/native_pipeline.py",
+    "lease_": "limitador_tpu/lease/__init__.py",
 }
 
 #: native sources whose extern "C" exports must carry matching ctypes

@@ -250,6 +250,10 @@ class NativeRlsPipeline:
         # cached lane, which stays byte-identical (the fuzz parity suite
         # drives both).
         self._hot_lane = None
+        #: quota-lease broker (lease/broker.py), attached by
+        #: ``attach_lease`` when --lease-mode is on; None = lease tier
+        #: off, byte-identical to the pre-lease lane.
+        self.lease_broker = None
         #: cumulative lane stats carried across interner-recycle context
         #: swaps (the mirror dies with its context).
         self._lane_stats_base: Dict[str, int] = {}
@@ -321,8 +325,8 @@ class NativeRlsPipeline:
             } | {"plans": stats["plans"], "epoch": stats["epoch"]}
 
     def library_stats(self) -> dict:
-        """Metrics poll surface for the plan_cache_* and native_lane_*
-        families."""
+        """Metrics poll surface for the plan_cache_*, native_lane_* and
+        lease_* families."""
         out = dict(self.plan_cache_stats())
         lane_stats = self.lane_stats()
         if lane_stats:
@@ -334,11 +338,59 @@ class NativeRlsPipeline:
                 "native_lane_overflows": lane_stats["overflows"],
                 "native_lane_plans": lane_stats["plans"],
             })
+        if self.lease_broker is not None:
+            out.update(self.lease_broker.stats())
         return out
 
     @property
     def hot_lane_active(self) -> bool:
         return self._hot_lane is not None
+
+    # -- quota leasing (lease/broker.py) -------------------------------------
+
+    def attach_lease(self, config=None, autostart: bool = True):
+        """Stand up the quota-lease tier on this pipeline: a LeaseBroker
+        that grants pre-debited token batches to hot mirrored plans, so
+        repeat descriptors with live tokens are admitted in the C hot
+        lane with zero device work. Requires the hot lane (the C mirror
+        holds the balances). Epoch bumps wake the broker through the
+        plan cache's release hooks so reload-stranded tokens settle
+        promptly."""
+        from ..lease import LeaseBroker
+
+        if self._hot_lane is None:
+            raise RuntimeError(
+                "lease tier requires the native hot lane (plan mirror)"
+            )
+        if not native.lease_available():
+            # A pre-lease binary exports the hot lane but none of the
+            # hp_lease_* symbols: without this gate the tier would log
+            # "on" while every broker call dies silently.
+            raise RuntimeError(
+                "native library lacks the lease exports (stale binary; "
+                "rebuild native/hostpath.cc)"
+            )
+        if self.lease_broker is not None:
+            return self.lease_broker
+        broker = LeaseBroker(self, config)
+        self.lease_broker = broker
+        with self._native_lock:
+            broker.attach_lane(self._hot_lane)
+        if self.plan_cache is not None:
+            self.plan_cache.on_epoch_bump = broker.poke
+        if autostart:
+            broker.start()
+        return broker
+
+    def lease_stats(self) -> dict:
+        """Lease-tier debug surface (/debug/stats ``lease`` section);
+        empty when the tier is off."""
+        broker = self.lease_broker
+        if broker is None:
+            return {}
+        out = broker.stats()
+        out["leases"] = len(broker._leases)
+        return out
 
     def lane_code_templates(self) -> Optional[dict]:
         """(grpc status, payload) per hot-lane outcome code, for the
@@ -623,6 +675,12 @@ class NativeRlsPipeline:
                     max_rows=old_lane.max_rows,
                 )
                 self.plan_cache.add_mirror(self._hot_lane)
+                if self.lease_broker is not None:
+                    # Leases die with the old mirror: reclaim + credit
+                    # them before the context is freed, then re-arm the
+                    # fresh lane's consume path.
+                    self.lease_broker.on_context_swap(old_lane)
+                    self.lease_broker.attach_lane(self._hot_lane)
             self.storage._table.native_keys.clear()
             self.storage._table.on_native_release = self.hp.slots_remove
             old.close()
@@ -1537,6 +1595,8 @@ class NativeRlsPipeline:
             await asyncio.gather(*shard.inflight, return_exceptions=True)
 
     async def close(self) -> None:
+        if self.lease_broker is not None:
+            self.lease_broker.close()
         cur = asyncio.get_running_loop()
         for shard in list(self._shards.values()):
             if shard.loop is cur:

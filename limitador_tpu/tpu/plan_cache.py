@@ -113,6 +113,10 @@ class _BaseCache:
         self.misses = 0
         self.evictions = 0
         self.invalidations = 0
+        # post-bump notification (outside the lock): the lease broker's
+        # wake-up so limits reloads settle stranded lease tokens without
+        # waiting out a refresh interval.
+        self.on_epoch_bump = None
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -144,11 +148,18 @@ class _BaseCache:
         self.misses += misses
 
     def bump_epoch(self) -> None:
-        """Limits changed: orphan every cached plan atomically."""
+        """Limits changed: orphan every cached plan atomically. The
+        optional ``on_epoch_bump`` hook fires AFTER the bump, outside
+        the lock (the lease broker rides it to settle reload-stranded
+        tokens promptly — the C mirror clears lazily at its next begin,
+        pushing any leased balances onto the return ring)."""
         with self._lock:
             self.epoch += 1
             self.invalidations += len(self._entries)
             self._clear_locked()
+        hook = self.on_epoch_bump
+        if hook is not None:
+            hook()
 
     def _clear_locked(self) -> None:
         self._entries.clear()

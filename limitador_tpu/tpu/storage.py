@@ -936,6 +936,36 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
              result.ttl_ms)
         )
 
+    def credit_columnar(
+        self,
+        slots: np.ndarray,
+        credits: np.ndarray,
+        windows_ms: np.ndarray,
+        bucket: np.ndarray,
+    ) -> None:
+        """Return unused leased quota to the device table (the lease
+        broker's credit lane, lease/broker.py): one scatter kernel,
+        floored so a credit can never create more headroom than a fresh
+        cell. ``slots`` must be unique (callers aggregate per slot) and
+        LIVE — the caller verifies slot->counter identity under this
+        same lock, because a recycled slot's credit would land on a
+        different counter. Rows are padded to the kernel's pow2 buckets
+        with inert scratch writes (no per-length XLA program churn)."""
+        n = int(slots.shape[0])
+        if n == 0:
+            return
+        H = _bucket(n)
+        with self._lock:
+            now_ms = self._now_ms()
+            self._state = K.credit_batch(
+                self._state,
+                _staged(slots, H, self._scratch, np.int32),
+                _staged(credits, H, 0, np.int32),
+                _staged(windows_ms, H, 0, np.int32),
+                _staged(bucket, H, False, bool),
+                np.int32(now_ms),
+            )
+
     def pad_hits(self, arrays: Tuple[np.ndarray, ...], nhits: int):
         """Pad (slots, deltas, maxes, windows, req_ids, fresh[, bucket])
         to the next bucket with inert scratch hits."""

@@ -394,12 +394,26 @@ struct PlanEntry {
   int32_t delta_capped = 1;
   int32_t nhits = 0;
   uint64_t rec_off = 0;  // into recs, REC_STRIDE per hit
+  // Quota lease (ISSUE 6): admissions this plan may answer locally with
+  // zero device work. The broker pre-debited the device counters for
+  // the whole grant, so local consumption never outruns the table; the
+  // id keys the Python-side ledger when unused tokens travel back
+  // through the return ring (invalidation/clear) for credit.
+  int64_t lease_tokens = 0;
+  int64_t lease_id = -1;
+  int32_t lease_size = 0;   // tokens of the current/last grant
+  uint32_t hot_count = 0;   // kernel-lane rows since last candidate drain
 };
 
 struct BlobRef {
   uint64_t hash;
   uint64_t off;
   uint32_t len;
+};
+
+struct LeaseReturn {
+  int64_t id;
+  int64_t tokens;
 };
 
 struct PlanMirror {
@@ -416,6 +430,26 @@ struct PlanMirror {
   // cumulative stats (polled into the native_lane_* metric families)
   uint64_t hits = 0, misses = 0, staged_hits = 0, insertions = 0,
            invalidations = 0, overflows = 0;
+  // ---- quota leasing (ISSUE 6) ----------------------------------------
+  // Disabled by default: with lease_enabled == 0 the begin pass is
+  // byte-identical to the pre-lease lane (no consume, no candidates).
+  int32_t lease_enabled = 0;
+  int32_t lease_hot_threshold = 8;
+  // Tokens stranded by invalidation/clear travel here; Python drains
+  // and credits the device counters back (ids key the broker ledger).
+  std::vector<LeaseReturn> lease_returns;
+  // Hot plans whose demand crossed the threshold (or whose lease just
+  // exhausted): the broker drains these and decides grants.
+  std::vector<BlobRef> lease_candidates;
+  std::vector<int64_t> lease_cand_counts;
+  static constexpr size_t kMaxCandidates = 1024;
+  // cumulative lease stats (hp_lease_stats)
+  uint64_t leased = 0;             // admissions answered from a lease
+  uint64_t lease_grants = 0;
+  uint64_t lease_granted_tokens = 0;
+  uint64_t lease_ring_tokens = 0;  // tokens pushed to the return ring
+  uint64_t lease_active = 0;       // live entries with tokens > 0
+  int64_t lease_outstanding = 0;   // sum of live tokens (the bound)
 
   explicit PlanMirror(uint64_t max_plans_ = 1 << 16)
       : max_plans(max_plans_), max_arena(64u << 20) {
@@ -424,12 +458,43 @@ struct PlanMirror {
     mask = cap - 1;
   }
 
+  void push_return(PlanEntry& e) {
+    if (e.lease_tokens > 0) {
+      lease_returns.push_back(LeaseReturn{e.lease_id, e.lease_tokens});
+      lease_ring_tokens += (uint64_t)e.lease_tokens;
+      lease_outstanding -= e.lease_tokens;
+      lease_active--;
+      e.lease_tokens = 0;
+    }
+    e.lease_id = -1;
+  }
+
+  void push_candidate(PlanEntry& e, int64_t count) {
+    if (lease_candidates.size() < kMaxCandidates) {
+      lease_candidates.push_back(BlobRef{e.hash, e.blob_off, e.blob_len});
+      lease_cand_counts.push_back(count);
+    } else {
+      // Queue full: drop, but restart the demand count so the plan
+      // re-queues after another threshold's worth of traffic — a
+      // hot_count left past the threshold would never fire == again.
+      e.hot_count = 0;
+    }
+  }
+
   void clear() {
     invalidations += live;
-    for (auto& e : table) e.state = 0;
+    // Leases die with their plans, but their tokens must not: the
+    // return ring survives the wipe so the broker can credit them back
+    // (reload/snapshot-restore never strands phantom quota).
+    for (auto& e : table) {
+      if (e.state == 1) push_return(e);
+      e.state = 0;
+    }
     blob_arena.clear();
     recs.clear();
     by_slot.clear();
+    lease_candidates.clear();  // blob refs die with the arena
+    lease_cand_counts.clear();
     live = dead = 0;
   }
 
@@ -506,6 +571,7 @@ struct PlanMirror {
       int64_t j = find((const uint8_t*)blob_arena.data() + ref.off,
                        ref.len, ref.hash);
       if (j >= 0) {
+        push_return(table[j]);  // stranded lease tokens -> return ring
         table[j].state = 2;
         live--;
         dead++;
@@ -743,6 +809,153 @@ void hp_lane_stats(void* c, int64_t* out) {
   out[7] = m.epoch;
 }
 
+// ---- quota leasing (ISSUE 6) ----------------------------------------------
+// The C half of the lease tier: per-plan token balances consumed GIL-free
+// inside hp_hot_begin (a leased row answers LANE_OK with zero staging and
+// zero device work), a candidate queue feeding the Python LeaseBroker's
+// grant pass, and a return ring carrying tokens stranded by plan
+// invalidation back to the broker for device credit. All calls run under
+// the pipeline's native lock, like the begins that mutate the same state.
+
+void hp_lease_config(void* c, int32_t enabled, int32_t hot_threshold) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  m.lease_enabled = enabled;
+  if (hot_threshold > 0) m.lease_hot_threshold = hot_threshold;
+}
+
+// Attach a pre-debited grant to a live kernel plan. Refused (0) when the
+// plan is gone, the epoch moved (the broker derived the grant from dead
+// limits), the plan already holds tokens, or leasing is off — the caller
+// must then credit the debit straight back.
+int32_t hp_lease_grant(void* c, const uint8_t* blob, int32_t len,
+                       int64_t epoch, int64_t lease_id, int64_t tokens) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  if (!m.lease_enabled || tokens <= 0 || epoch != m.epoch) return 0;
+  uint64_t h = Interner::fnv1a((const char*)blob, len);
+  int64_t j = m.find(blob, (uint32_t)len, h);
+  if (j < 0) return 0;
+  PlanEntry& e = m.table[j];
+  if (e.kind != LANE_KERNEL || e.lease_tokens > 0) return 0;
+  e.lease_tokens = tokens;
+  e.lease_id = lease_id;
+  e.lease_size = (int32_t)(tokens > 0x7fffffff ? 0x7fffffff : tokens);
+  e.hot_count = 0;
+  m.lease_active++;
+  m.lease_outstanding += tokens;
+  m.lease_grants++;
+  m.lease_granted_tokens += (uint64_t)tokens;
+  return 1;
+}
+
+// Reclaim a lease synchronously (expiry sweep): returns the remaining
+// tokens cleared from the plan, or -1 when there is nothing to reclaim
+// (plan gone, tokens already travelled through the return ring, or —
+// when expect_id >= 0 — the plan's live lease is a NEWER grant than the
+// one being reclaimed: an expired ledger entry must never revoke its
+// blob's renewal).
+int64_t hp_lease_revoke(void* c, const uint8_t* blob, int32_t len,
+                        int64_t expect_id) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  uint64_t h = Interner::fnv1a((const char*)blob, len);
+  int64_t j = m.find(blob, (uint32_t)len, h);
+  if (j < 0) return -1;
+  PlanEntry& e = m.table[j];
+  if (e.lease_tokens <= 0) return -1;
+  if (expect_id >= 0 && e.lease_id != expect_id) return -1;
+  int64_t remaining = e.lease_tokens;
+  m.lease_outstanding -= remaining;
+  m.lease_active--;
+  e.lease_tokens = 0;
+  e.lease_id = -1;
+  return remaining;
+}
+
+// Live token balance of one plan (tests/debug + the oracle bound);
+// -1 when no live lease (or, with expect_id >= 0, a different grant).
+int64_t hp_lease_tokens(void* c, const uint8_t* blob, int32_t len,
+                        int64_t expect_id) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  uint64_t h = Interner::fnv1a((const char*)blob, len);
+  int64_t j = m.find(blob, (uint32_t)len, h);
+  if (j < 0) return -1;
+  const PlanEntry& e = m.table[j];
+  if (expect_id >= 0 && e.lease_id != expect_id) return -1;
+  return e.lease_tokens;
+}
+
+// Drain the return ring: (lease_id, stranded tokens) pairs pushed by
+// invalidation/clear. Returns the number drained (ring keeps the rest
+// when cap is short).
+int32_t hp_lease_drain_returns(void* c, int64_t* out_ids,
+                               int64_t* out_tokens, int32_t cap) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  int32_t n = (int32_t)m.lease_returns.size();
+  if (n > cap) n = cap;
+  for (int32_t i = 0; i < n; i++) {
+    out_ids[i] = m.lease_returns[i].id;
+    out_tokens[i] = m.lease_returns[i].tokens;
+  }
+  m.lease_returns.erase(m.lease_returns.begin(),
+                        m.lease_returns.begin() + n);
+  return n;
+}
+
+// Drain the candidate queue: hot kernel plans whose demand crossed the
+// threshold (or whose lease just exhausted). Blob bytes land
+// concatenated in out_blobs with per-candidate lengths/demand counts;
+// dead or since-granted plans are skipped; drained plans restart their
+// demand count. The queue clears wholesale — a dropped candidate
+// re-queues within one threshold's worth of traffic.
+int32_t hp_lease_candidates(void* c, uint8_t* out_blobs, int64_t blob_cap,
+                            int32_t* out_lens, int64_t* out_counts,
+                            int32_t cap) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  int32_t n = 0;
+  int64_t used = 0;
+  for (size_t i = 0; i < m.lease_candidates.size(); i++) {
+    const BlobRef& ref = m.lease_candidates[i];
+    int64_t j = m.find((const uint8_t*)m.blob_arena.data() + ref.off,
+                       ref.len, ref.hash);
+    if (j < 0) continue;
+    PlanEntry& e = m.table[j];
+    // Demand kept accruing between the threshold crossing and this
+    // drain: report the larger figure so grants track real traffic.
+    int64_t demand = m.lease_cand_counts[i] > (int64_t)e.hot_count
+                         ? m.lease_cand_counts[i]
+                         : (int64_t)e.hot_count;
+    // Every candidate leaving the queue restarts its demand count,
+    // DRAINED OR DROPPED — a hot_count parked past the threshold would
+    // never fire the == crossing again, permanently starving exactly
+    // the high-fanout hot plans the tier targets.
+    e.hot_count = 0;
+    if (e.kind != LANE_KERNEL || e.lease_tokens > 0) continue;
+    if (n >= cap || used + ref.len > blob_cap) continue;  // drop + reset
+    memcpy(out_blobs + used, m.blob_arena.data() + e.blob_off, ref.len);
+    out_lens[n] = (int32_t)ref.len;
+    out_counts[n] = demand;
+    used += ref.len;
+    n++;
+  }
+  m.lease_candidates.clear();
+  m.lease_cand_counts.clear();
+  return n;
+}
+
+// out[8]: leased admissions, grants, granted tokens, ring tokens,
+// active leases, outstanding tokens (the over-admission bound),
+// pending candidates, pending returns
+void hp_lease_stats(void* c, int64_t* out) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  out[0] = (int64_t)m.leased;
+  out[1] = (int64_t)m.lease_grants;
+  out[2] = (int64_t)m.lease_granted_tokens;
+  out[3] = (int64_t)m.lease_ring_tokens;
+  out[4] = (int64_t)m.lease_active;
+  out[5] = m.lease_outstanding;
+  out[6] = (int64_t)m.lease_candidates.size();
+  out[7] = (int64_t)m.lease_returns.size();
+}
+
 // The hot begin: one call per batch covering plan lookup + columnar
 // staging + begin-time response codes.
 //
@@ -804,11 +1017,25 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   }
 
   // Pass 2 (serial): kernel-row offsets (prefix sum), overflow handling,
-  // and the begin-time OK metric aggregation.
+  // lease consumption, and the begin-time OK metric aggregation.
   int32_t k = 0;
   int64_t nhits = 0;
   int64_t hit_rows = 0, miss_rows = 0, overflow_rows = 0;
   int32_t n_ok_ns = 0;
+  auto aggregate_ok = [&](int32_t ns_token, int32_t delta) {
+    int32_t g = 0;
+    for (; g < n_ok_ns; g++) {
+      if (out_ok_ns[g] == ns_token) break;
+    }
+    if (g == n_ok_ns) {
+      out_ok_ns[g] = ns_token;
+      out_ok_calls[g] = 0;
+      out_ok_hits[g] = 0;
+      n_ok_ns++;
+    }
+    out_ok_calls[g] += 1;
+    out_ok_hits[g] += delta;
+  };
   // per-kernel-row hit offset, reused scratch tail of ent (append)
   std::vector<int64_t> row_off((size_t)n);
   for (int32_t r = 0; r < n; r++) {
@@ -818,8 +1045,32 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
       continue;
     }
     hit_rows++;
-    const PlanEntry& e = m.table[j];
+    PlanEntry& e = m.table[j];
     if (e.kind == LANE_KERNEL) {
+      if (m.lease_enabled && e.lease_tokens > 0) {
+        // Leased admission: the device counters were pre-debited at
+        // grant time, so this row completes with zero staging and zero
+        // device work — consume one token and answer OK in place.
+        e.lease_tokens--;
+        m.lease_outstanding--;
+        m.leased++;
+        if (e.lease_tokens == 0) {
+          m.lease_active--;
+          // exhausted under live demand: renewal signal sized by the
+          // grant just consumed
+          m.push_candidate(e, (int64_t)e.lease_size);
+          e.hot_count = 0;
+        }
+        out_kind[r] = LANE_OK;
+        ent[r] = -1;  // not a kernel row: stage/finish must skip it
+        if (e.ns_token >= 0) aggregate_ok(e.ns_token, e.delta);
+        continue;
+      }
+      if (m.lease_enabled) {
+        e.hot_count++;
+        if (e.hot_count == (uint32_t)m.lease_hot_threshold)
+          m.push_candidate(e, (int64_t)e.hot_count);
+      }
       if (nhits + e.nhits > cap) {
         // Staging buffers full: everything from here takes the Python
         // miss lane (safe: it re-derives). Counted so a silently
@@ -839,18 +1090,7 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
       nhits += e.nhits;
       k++;
     } else if (e.kind == LANE_OK && e.ns_token >= 0) {
-      int32_t g = 0;
-      for (; g < n_ok_ns; g++) {
-        if (out_ok_ns[g] == e.ns_token) break;
-      }
-      if (g == n_ok_ns) {
-        out_ok_ns[g] = e.ns_token;
-        out_ok_calls[g] = 0;
-        out_ok_hits[g] = 0;
-        n_ok_ns++;
-      }
-      out_ok_calls[g] += 1;
-      out_ok_hits[g] += e.delta;
+      aggregate_ok(e.ns_token, e.delta);
     }
   }
   m.hits += (uint64_t)hit_rows;

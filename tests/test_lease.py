@@ -1,0 +1,420 @@
+"""Quota-lease tier: the bounded over-admission contract (ISSUE 6).
+
+Every test here pins one clause of the lease contract against the
+device table itself:
+
+- leased hits complete with ZERO device work (no staged hits, no
+  kernel rows) and count as ordinary authorized traffic;
+- the device counter always equals exact usage + outstanding leased
+  tokens (pre-debit), so final counter state vs the in-memory oracle
+  differs by at most the outstanding tokens — and collapses to exact
+  once leases settle;
+- grants are headroom-checked atomically (a lease is never granted
+  past the remaining window headroom) and tiny limits are never
+  leased at all;
+- unused tokens come back on expiry, limits reload, slot eviction and
+  snapshot/restore — never stranded, never credited to a recycled
+  slot's new tenant;
+- across a window roll, over-admission is bounded by the tokens
+  outstanding at the roll (the only place leasing trades exactness).
+
+The lane-parity suite (test_native_lane_fuzz.py) separately proves the
+tier is byte-identical when off.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from limitador_tpu import Limit, native
+from limitador_tpu.server.proto import rls_pb2
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+pytestmark = pytest.mark.skipif(
+    not native.available() or not native.lease_available(),
+    reason="native lease lane unavailable",
+)
+
+D = "descriptors[0]"
+FROZEN_NOW = 1_800_000_000.0
+
+
+class _Clock:
+    """Mutable frozen clock shared by storage and broker."""
+
+    def __init__(self, now=FROZEN_NOW):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+def _blob(domain="api", u="hot", m="GET"):
+    req = rls_pb2.RateLimitRequest(domain=domain)
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "m", m
+    e = d.entries.add()
+    e.key, e.value = "u", u
+    return req.SerializeToString()
+
+
+def _build(limits, clock=None, **lease_kwargs):
+    from limitador_tpu.lease import LeaseConfig
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    clock = clock or _Clock()
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=1 << 12, clock=clock), max_delay=0.001
+        )
+    )
+    for limit in limits:
+        limiter.add_limit(limit)
+    pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001,
+                                 hot_lane=True)
+    assert pipeline.hot_lane_active
+    kwargs = dict(max_tokens=64, hot_threshold=2, ttl_s=30.0)
+    kwargs.update(lease_kwargs)
+    broker = pipeline.attach_lease(
+        LeaseConfig(**kwargs), autostart=False
+    )
+    broker._clock = clock
+    return pipeline, limiter, broker, clock
+
+
+def _remaining(limiter, namespace="api"):
+    """(limit name, sorted variable values) -> remaining."""
+    async def go():
+        return {
+            (c.limit.name, tuple(sorted((c.set_variables or {}).values()))):
+            c.remaining
+            for c in await limiter.get_counters(namespace)
+        }
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(go())
+    finally:
+        loop.close()
+
+
+def _drive(pipeline, blobs):
+    out = pipeline.decide_many(list(blobs), chunk=len(blobs))
+    assert all(r is not None for r in out)
+    return sum(1 for r in out if r == pipeline.OK_BLOB)
+
+
+def test_leased_hits_skip_the_device_and_count_as_authorized():
+    pipeline, _limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    # first batch derives + mirrors the plan; the second counts demand
+    _drive(pipeline, [b] * 2)
+    _drive(pipeline, [b] * 2)
+    assert broker.refresh()["grants"] == 1
+    staged_before = pipeline.lane_stats()["staged_hits"]
+    tokens = broker.stats()["lease_outstanding_tokens"]
+    assert tokens > 0
+    ok = _drive(pipeline, [b] * tokens)
+    assert ok == tokens
+    # zero device work for the leased phase: nothing staged
+    assert pipeline.lane_stats()["staged_hits"] == staged_before
+    stats = broker.stats()
+    assert stats["lease_admissions"] == tokens
+    assert stats["lease_outstanding_tokens"] == 0
+
+
+def test_device_state_is_exact_usage_plus_outstanding():
+    """The pre-debit invariant: at every point, device usage ==
+    admitted debits + outstanding leased tokens — which is exactly the
+    'differs from the oracle by at most outstanding tokens' clause."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")],
+        max_tokens=16,
+    )
+    rng = np.random.default_rng(7)
+    users = [f"u{i}" for i in range(8)]
+    blobs = {u: _blob(u=u) for u in users}
+    ok_by_user = dict.fromkeys(users, 0)
+    for _round in range(20):
+        picks = rng.choice(len(users), size=32).tolist()
+        batch = [blobs[users[i]] for i in picks]
+        out = pipeline.decide_many(batch, chunk=len(batch))
+        for i, r in zip(picks, out):
+            if r == pipeline.OK_BLOB:
+                ok_by_user[users[i]] += 1
+        broker.refresh()
+    assert broker.stats()["lease_admissions"] > 0, "leases never engaged"
+    info = pipeline.storage._table.info
+    outstanding = {}
+    for slot, tokens in broker.outstanding_by_slot().items():
+        values = tuple(sorted(
+            (info[slot][1].set_variables or {}).values()
+        ))
+        outstanding[values] = outstanding.get(values, 0) + tokens
+    remaining = _remaining(limiter)
+    for u in users:
+        used = 1000 - remaining[("per-user", (u,))]
+        assert used == ok_by_user[u] + outstanding.get((u,), 0), (
+            u, used, ok_by_user[u], outstanding
+        )
+
+
+def test_settle_collapses_to_exact_oracle_state():
+    """After leases settle (expiry revoke + credit), the device state
+    equals the exact count of admitted requests — what the in-memory
+    oracle would hold for the same admitted set."""
+    pipeline, limiter, broker, clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")],
+        ttl_s=5.0,
+    )
+    b = _blob()
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    broker.refresh()
+    ok += _drive(pipeline, [b] * 1)  # consume one leased token
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    clock.now += 6.0  # past the ttl: the sweep revokes + credits
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    assert stats["lease_returned_tokens"] > 0
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == ok
+    # conservation: every granted token is consumed, returned or live
+    assert stats["lease_granted_tokens"] == (
+        stats["lease_admissions"] + stats["lease_returned_tokens"]
+    )
+
+
+def test_grants_never_exceed_remaining_headroom():
+    """The debit rides the admission kernel, so a grant past the
+    window headroom is refused atomically and the broker backs off."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 10, 60, [], [f"{D}.u"], name="small")],
+        max_tokens=64, hot_threshold=2,
+    )
+    b = _blob(u="greedy")
+    ok = _drive(pipeline, [b] * 4)
+    ok += _drive(pipeline, [b] * 4)  # 8 of 10 used, demand recorded
+    assert ok == 8
+    broker.refresh()
+    stats = broker.stats()
+    # sizing caps at max_value//2 = 5 > headroom 2 -> denied
+    assert stats["lease_grants"] == 0
+    assert stats["lease_grant_denials"] >= 1
+    used = 10 - _remaining(limiter)[("small", ("greedy",))]
+    assert used == 8  # the refused debit left no trace
+
+
+def test_tiny_limits_are_never_leased():
+    pipeline, _limiter, broker, _clock = _build(
+        [Limit("api", 1, 60, [], [f"{D}.u"], name="one")],
+        hot_threshold=1,
+    )
+    b = _blob(u="x")
+    _drive(pipeline, [b] * 2)
+    _drive(pipeline, [b] * 2)
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_grants"] == 0
+    assert stats["lease_grant_denials"] == 0  # filtered before the debit
+
+
+def test_limits_reload_settles_stranded_tokens():
+    """A mid-flight limits reload orphans every plan; the leased
+    balances ride the return ring and credit back — no phantom usage
+    left behind."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    broker.refresh()
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    pipeline.invalidate()  # the reload path's epoch bump
+    # next begin syncs the mirror epoch -> clear -> returns pushed
+    ok += _drive(pipeline, [b] * 1)
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == ok
+
+
+def test_slot_eviction_never_credits_the_slot_s_next_tenant():
+    """Evicting the leased counter's slot pushes the balance to the
+    return ring, but the credit must be DROPPED: the cell was reset
+    (debit died with it) and may already belong to another counter."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    _drive(pipeline, [b] * 2)
+    _drive(pipeline, [b] * 2)
+    broker.refresh()
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    storage = pipeline.storage
+    with storage._lock:
+        for slot, (key, counter) in list(storage._table.info.items()):
+            storage._table.release(slot, key, counter.is_qualified())
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    assert stats["lease_returned_tokens"] > 0
+    # fresh allocation after the release: the counter restarts exact
+    # (no leftover debit, no phantom credit)
+    ok = _drive(pipeline, [b] * 2)
+    assert ok == 2
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == 2
+
+
+def test_snapshot_restore_settles_without_stranding(tmp_path):
+    """A table swap (snapshot restore) bumps the epoch through the
+    same release hooks; the restored counters carry the pre-debit, and
+    settling credits exactly that back — no stranded, no duplicated
+    quota."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    broker.refresh()
+    ok += _drive(pipeline, [b] * 1)  # one leased admission
+    storage = pipeline.storage
+    path = str(tmp_path / "lease-snap.npz")
+    storage.snapshot(path)
+    storage.load_snapshot(path)  # table swap -> on_clear -> epoch bump
+    ok_after = _drive(pipeline, [b] * 1)  # re-derives; mirror cleared
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == ok + ok_after
+
+
+def test_window_roll_over_admission_is_bounded_by_outstanding():
+    """The one place leasing trades exactness: tokens outstanding when
+    the window rolls admit without a debit in the new window. The
+    over-admission is bounded by exactly that balance."""
+    pipeline, limiter, broker, clock = _build(
+        [Limit("api", 10, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")],
+        max_tokens=4, hot_threshold=2, ttl_s=300.0,
+    )
+    b = _blob(u="roller")
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    assert ok == 4
+    broker.refresh()
+    outstanding_at_roll = broker.stats()["lease_outstanding_tokens"]
+    assert 0 < outstanding_at_roll <= 4
+    clock.now += 61.0  # window rolls; the device debit evaporates
+    # leased admissions in the NEW window: free of any debit — this is
+    # the over-admission, and it cannot exceed the rolled balance
+    ok_new = _drive(pipeline, [b] * (outstanding_at_roll + 6))
+    over = ok_new - min(ok_new, 10)
+    assert over <= outstanding_at_roll
+    used = 10 - _remaining(limiter).get(
+        ("per-user", ("roller",)), 10
+    )
+    # device window-2 usage only counts kernel admissions; adding the
+    # locally-consumed balance can exceed the limit by AT MOST the
+    # tokens outstanding at the roll
+    assert used + outstanding_at_roll >= ok_new - 10 or ok_new <= 10
+
+
+def test_token_bucket_leases_settle_exactly():
+    pipeline, limiter, broker, clock = _build(
+        [Limit("bucket", 100, 60, [], [f"{D}.u"], name="tb",
+               policy="token_bucket")],
+        max_tokens=8, hot_threshold=2, ttl_s=5.0,
+    )
+    b = _blob(domain="bucket", u="tb-user")
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    broker.refresh()
+    assert broker.stats()["lease_grants"] == 1
+    # consume PART of the lease: a drained lease would queue a renewal
+    # candidate and the post-expiry refresh would (correctly) re-grant
+    ok += _drive(pipeline, [b] * 1)
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    clock.now += 6.0
+    broker.refresh()  # expiry: unused bucket tokens credit back
+    assert broker.stats()["lease_outstanding_tokens"] == 0
+    rem = _remaining(limiter, "bucket").get(("tb", ("tb-user",)))
+    if rem is not None:  # None = bucket fully idle-refilled
+        assert rem >= 100 - ok
+
+
+def test_idle_broker_is_byte_identical_to_no_broker():
+    """--lease-mode on with no grants (threshold never crossed) must
+    not perturb a single byte of the serving path."""
+    limits = [
+        Limit("api", 5, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+              name="per-user"),
+    ]
+    p_lease, lim_a, _broker, _clock = _build(
+        limits, hot_threshold=1 << 30
+    )
+    clock_b = _Clock()
+    lim_b = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=1 << 12, clock=clock_b), max_delay=0.001
+        )
+    )
+    for limit in limits:
+        lim_b.add_limit(limit)
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    p_plain = NativeRlsPipeline(lim_b, None, max_delay=0.001,
+                                hot_lane=True)
+    rng = np.random.default_rng(3)
+    for _round in range(6):
+        batch = [
+            _blob(u=f"u{int(rng.integers(0, 4))}",
+                  m="GET" if rng.integers(0, 2) else "POST")
+            for _ in range(32)
+        ]
+        out_a = p_lease.decide_many(batch, chunk=32)
+        out_b = p_plain.decide_many(batch, chunk=32)
+        assert out_a == out_b
+    assert _remaining(lim_a) == _remaining(lim_b)
+
+
+def test_context_swap_reclaims_every_lease():
+    """The interner-recycle context swap kills the mirror: every lease
+    must settle through the swap hook, with the consume counter carried
+    into the broker's base."""
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    ok = _drive(pipeline, [b] * 2)
+    ok += _drive(pipeline, [b] * 2)
+    broker.refresh()
+    ok += _drive(pipeline, [b] * 1)
+    consumed_before = broker.stats()["lease_admissions"]
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    pipeline.max_interned = 0  # force the swap on the next begin
+    ok += _drive(pipeline, [b] * 1)  # swap happens inside this begin
+    pipeline.max_interned = 4 << 20
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    assert stats["lease_admissions"] >= consumed_before
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == ok

@@ -323,6 +323,68 @@ def test_hot_lane_off_pipeline_has_no_mirror():
     assert p.lane_stats() == {}
 
 
+@pytest.mark.parametrize("seed", [6, 7])
+def test_lease_corpus_conservation_and_settle(seed):
+    """The lease tier over the full fuzz corpus (every wire shape:
+    multi-descriptor, unknown fields, CEL gating, token buckets, empty
+    domains, hits_addend variation): every granted token must be
+    consumed, returned or outstanding at all times — and a forced
+    settle (reload epoch bump + expiry sweep) drives outstanding to
+    zero with nothing stranded. Token conservation is the corpus-wide
+    face of the over-admission bound (the per-counter form is pinned in
+    test_lease.py)."""
+    if not native.lease_available():
+        pytest.skip("native lease lane unavailable")
+    from limitador_tpu.lease import LeaseConfig
+
+    clock = {"now": FROZEN_NOW}
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=1 << 12, clock=lambda: clock["now"]),
+            max_delay=0.001,
+        )
+    )
+    for limit in _limits():
+        limiter.add_limit(limit)
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    pipeline = NativeRlsPipeline(limiter, None, max_delay=0.001,
+                                 hot_lane=True)
+    broker = pipeline.attach_lease(
+        LeaseConfig(max_tokens=8, hot_threshold=2, ttl_s=30.0),
+        autostart=False,
+    )
+    broker._clock = lambda: clock["now"]
+
+    blobs = _corpus(seed)
+    for _pass in range(3):
+        for ofs in range(0, len(blobs), 64):
+            _decide_cached(pipeline, blobs[ofs:ofs + 64])
+            broker.refresh()
+            stats = broker.stats()
+            assert stats["lease_granted_tokens"] == (
+                stats["lease_admissions"]
+                + stats["lease_returned_tokens"]
+                + stats["lease_outstanding_tokens"]
+            ), stats
+        # roll every window: the corpus limits are tiny, so headroom
+        # (and with it grantability) refreshes between passes — this
+        # also drives leases ACROSS window rolls under the full corpus
+        clock["now"] += 121.0
+    assert broker.stats()["lease_admissions"] > 0, "leases never engaged"
+    # forced settle: reload bump strands every live balance onto the
+    # ring; one begin syncs the epoch, the expiry sweep catches the rest
+    pipeline.invalidate()
+    _decide_cached(pipeline, blobs[:8])
+    clock["now"] += 10_000.0
+    broker.refresh()
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    assert stats["lease_granted_tokens"] == (
+        stats["lease_admissions"] + stats["lease_returned_tokens"]
+    ), stats
+
+
 def test_native_partition_matches_numpy():
     """The C partition pass (hp_partition_positions) must produce the
     exact (counts, pos) the numpy argsort path does — it rides every

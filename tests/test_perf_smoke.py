@@ -38,6 +38,21 @@ PARTITION_BUDGET_US = 0.8
 #: measures ~1500-3000 ns — the generous multiplier still catches that
 #: regression class.
 NATIVE_LANE_BUDGET_NS = 1200.0
+#: per-row budget for a LEASED hot-descriptor decision's host phase
+#: (plan-mirror lookup + token consume + begin-time OK code; no
+#: staging, no kernel), in NANOSECONDS. Leased rows measure ~100-300
+#: ns/row on the throttled CI box; a silent fallback to the kernel
+#: lane (staging + device round trip per batch) or to Python measures
+#: an order of magnitude worse — which is exactly the regression this
+#: gate exists to catch (ISSUE 6 acceptance: sub-µs engine-side p50).
+LEASE_HIT_BUDGET_NS = 1000.0
+#: per-candidate budget for one lease-broker refresh pass that grants
+#: a batch of leases (drain + ONE batched debit launch + attach), in
+#: MICROSECONDS. The batched pass measures ~100-400 µs/candidate on
+#: the throttled CI box (dominated by the one shared kernel launch); a
+#: regression to one device launch PER candidate measures ~2-3 ms
+#: each.
+LEASE_REFRESH_BUDGET_US = 1500.0
 
 
 def _blobs(n, users=512):
@@ -179,6 +194,103 @@ def test_native_lane_staging_and_response_build_within_budget(pipeline):
         f"native hot lane costs {per_row_ns:.0f} ns/row "
         f"(budget {NATIVE_LANE_BUDGET_NS} ns — did staging or response "
         "build fall back to Python?)"
+    )
+
+
+def test_leased_hit_lane_within_budget(pipeline):
+    """ns/row budget for leased hot-descriptor decisions: with live
+    tokens on every plan, a begin must answer the whole batch from the
+    mirror (k == 0 kernel rows, all codes OK) — a silent fallback to
+    the kernel lane or to Python blows this budget and the staged-rows
+    assertion."""
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None or not native.lease_available():
+        pytest.skip("native lease lane unavailable")
+    from collections import Counter
+
+    blobs = _blobs(4096)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror the plans
+    epoch = p.plan_cache.epoch
+    counts = Counter(blobs)
+    passes = 6
+    lane.lease_config(True, 1 << 30)  # no candidate churn in the loop
+    try:
+        with p._native_lock:
+            for i, (blob, count) in enumerate(counts.items()):
+                assert lane.lease_grant(
+                    blob, epoch, i + 1, passes * count + 1
+                ), "plan not mirrored; lease grant refused"
+        best = float("inf")
+        for _ in range(passes):
+            t0 = time.perf_counter()
+            with p._native_lock:
+                staged = lane.begin(blobs, epoch)
+            best = min(best, time.perf_counter() - t0)
+            assert staged.k == 0, (
+                f"{staged.k} rows fell through to the kernel lane"
+            )
+            assert int((staged.codes == native.LANE_OK).sum()) == len(blobs)
+        per_row_ns = best / len(blobs) * 1e9
+        assert per_row_ns <= LEASE_HIT_BUDGET_NS, (
+            f"leased hit lane costs {per_row_ns:.0f} ns/row "
+            f"(budget {LEASE_HIT_BUDGET_NS} ns — did leased rows fall "
+            "back to staging or Python?)"
+        )
+    finally:
+        # this module-scoped pipeline is shared: strip the manual
+        # leases + disable the tier again
+        with p._native_lock:
+            for blob in counts:
+                lane.lease_revoke(blob)
+            lane.lease_config(False)
+
+
+def test_lease_refresh_grant_pass_within_budget():
+    """µs/candidate budget for the broker's batched grant pass: the
+    debit for N candidates must ride ONE device launch — a regression
+    to a launch per candidate costs ~2-3 ms each and blows this by an
+    order of magnitude."""
+    if not native.available() or not native.lease_available():
+        pytest.skip("native lease lane unavailable")
+    from limitador_tpu.lease import LeaseConfig
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(TpuStorage(capacity=1 << 14), max_delay=0.0005)
+    )
+    limiter.add_limit(
+        Limit("api", 10**6, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])
+    )
+    p = NativeRlsPipeline(limiter, None, max_delay=0.0005,
+                          max_batch=4096)
+    broker = p.attach_lease(
+        LeaseConfig(max_tokens=64, hot_threshold=2, ttl_s=0.05),
+        autostart=False,
+    )
+    n_cands = 64
+    blobs = _blobs(4096, users=n_cands)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror
+    p.decide_many(blobs, chunk=len(blobs))  # cross the demand threshold
+    broker.refresh()  # warm: compiles the debit launch's kernel bucket
+    best = float("inf")
+    granted = 0
+    for _ in range(3):
+        time.sleep(0.06)  # expire the previous round's leases
+        broker.refresh()  # settle pass (revoke + credit)
+        p.decide_many(blobs, chunk=len(blobs))  # re-queue candidates
+        t0 = time.perf_counter()
+        summary = broker.refresh()
+        best = min(best, time.perf_counter() - t0)
+        granted = max(granted, summary.get("grants", 0))
+    assert granted >= n_cands // 2, (
+        f"grant pass only granted {granted}/{n_cands} candidates"
+    )
+    per_cand_us = best / max(granted, 1) * 1e6
+    assert per_cand_us <= LEASE_REFRESH_BUDGET_US, (
+        f"lease refresh costs {per_cand_us:.0f} µs/candidate "
+        f"(budget {LEASE_REFRESH_BUDGET_US} µs — is the debit still "
+        "ONE batched launch?)"
     )
 
 
