@@ -362,6 +362,14 @@ def bench_native():
         emit("native_pipeline_decisions_per_sec", 0.0, "decisions/s", 1e7)
         return
 
+    # Arm the native telemetry plane so this row carries the drained
+    # per-phase percentiles (ISSUE 7 acceptance: native_phase_* in
+    # bench JSON rows; the serving/grpc rows scrape the same families
+    # off /metrics instead).
+    from limitador_tpu.observability.native_plane import NativePlane
+
+    tel_plane = NativePlane()
+
     rng = np.random.default_rng(0)
     blobs = []
     for i in range(1 << 15):
@@ -523,6 +531,11 @@ def bench_native():
         native_ingress_off_rps=round(ingress_off, 1),
         native_hot_lane_ingress_speedup=ingress_speedup,
         native_lane_staged_hits=lane_stats.get("staged_hits", 0),
+        native_phase_us={
+            phase: stats
+            for phase, stats in tel_plane.native_telemetry().items()
+            if stats.get("count")
+        },
     )
 
 
@@ -1306,6 +1319,26 @@ def _native_rls_server(native_ingress=False, batch_delay_us=None,
     return ctx()
 
 
+def _hist_p99(buckets) -> float:
+    """p99 by bucket interpolation over Prometheus-exposition
+    (le, cumulative_count) pairs; None with no observations. The +Inf
+    tail clamps to the last finite edge."""
+    total = buckets[-1][1] if buckets else 0.0
+    if total <= 0:
+        return None
+    target = 0.99 * total
+    prev_le = prev_cum = 0.0
+    for le, cum in buckets:
+        if cum >= target:
+            if le == float("inf"):
+                return prev_le
+            span = cum - prev_cum
+            frac = (target - prev_cum) / span if span else 1.0
+            return prev_le + (le - prev_le) * frac
+        prev_le, prev_cum = le, cum
+    return None
+
+
 def _scrape_device_metrics(http_port: int) -> dict:
     """Read the device-plane batching telemetry off a serving process's
     /metrics exposition after a measured pass (observability/metrics.py
@@ -1328,6 +1361,12 @@ def _scrape_device_metrics(http_port: int) -> dict:
     buckets = []  # (le_seconds, cumulative_count) in exposition order
     fill_sum = fill_count = 0.0
     flushes = {}
+    # Native telemetry plane + SLO watchdog (observability/
+    # native_plane.py): slo_* gauges verbatim, native_phase_* histogram
+    # p99s by bucket interpolation — every serving bench row carries
+    # the native-plane evidence (ISSUE 7 acceptance).
+    slo = {}
+    native_phase = {}  # family -> [(le_seconds, cumulative_count)]
     # Admission-plane signals (observability/metrics.py admission_*
     # families): sheds, breaker state, cumulative failed-over seconds.
     sheds = 0.0
@@ -1362,8 +1401,35 @@ def _scrape_device_metrics(http_port: int) -> dict:
         elif (line.startswith("authorized_calls_total")
               or line.startswith("limited_calls_total")):
             decided_calls += float(line.split()[-1])
+        elif line.startswith("slo_"):
+            parts = line.split()
+            if len(parts) == 2:
+                try:
+                    slo[parts[0]] = float(parts[1])
+                except ValueError:
+                    pass
+        elif line.startswith("native_phase_") and "_bucket{" in line:
+            fam = line.split("_bucket{", 1)[0]
+            m = re.search(r'le="([^"]+)"\}\s+([0-9.eE+-]+)', line)
+            if m:
+                le = (
+                    float("inf") if m.group(1) == "+Inf"
+                    else float(m.group(1))
+                )
+                native_phase.setdefault(fam, []).append(
+                    (le, float(m.group(2)))
+                )
 
     out = {}
+    if slo:
+        out["slo"] = {k: round(v, 4) for k, v in sorted(slo.items())}
+    phase_p99 = {}
+    for fam, fam_buckets in sorted(native_phase.items()):
+        p99_s = _hist_p99(fam_buckets)
+        if p99_s is not None:
+            phase_p99[fam[len("native_phase_"):]] = round(p99_s * 1e6, 2)
+    if phase_p99:
+        out["native_phase_p99_us"] = phase_p99
     if breaker_state is not None:
         # Only meaningful when the admission plane is on; a server
         # without it exposes no admission_* families at all.

@@ -31,8 +31,13 @@ __all__ = [
     "available",
     "lane_available",
     "lease_available",
+    "tel_available",
+    "tel_config",
+    "tel_drain",
+    "tel_exemplars",
     "build_error",
     "build_status",
+    "staged_trace_attrs",
     "HostPath",
     "NativeHotLane",
     "LANE_MISS",
@@ -41,6 +46,8 @@ __all__ = [
     "LANE_UNKNOWN",
     "LANE_OVER",
     "LANE_ERROR",
+    "TEL_PHASES",
+    "TEL_BUCKETS",
 ]
 
 #: hot-lane outcome codes (mirror native/hostpath.cc LaneKind)
@@ -52,6 +59,15 @@ LANE_OVER = 4
 LANE_ERROR = 5
 
 _INT32_MAX = (1 << 31) - 1
+
+#: hostpath-local telemetry phases, in the C TelPhase enum order (the
+#: h2ingress library's ``h2i_respond`` phase rides its own drain —
+#: observability/native_plane.py merges both under one PHASES tuple)
+TEL_PHASES = ("hot_lookup", "hot_stage", "lease_hit", "hot_finish")
+#: log2-ns histogram buckets per phase: bucket b holds [2^b, 2^{b+1}) ns
+TEL_BUCKETS = 40
+#: int64 fields per drained slow-row exemplar (hp_tel_exemplars)
+TEL_EX_STRIDE = 12
 
 _LIB = NativeLib("hostpath", ["native/hostpath.cc"], ["-pthread"])
 _sigs_lock = threading.Lock()
@@ -139,6 +155,15 @@ def _bind(lib) -> None:
         ctypes.c_void_p, ctypes.c_int32,
     ]
     lib.hp_lease_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # -- native telemetry plane (process-global; observability/
+    # native_plane.py drains it) ---------------------------------------
+    lib.hp_tel_config.argtypes = [
+        ctypes.c_int32, ctypes.c_int64, ctypes.c_int64,
+    ]
+    lib.hp_tel_drain.restype = ctypes.c_int32
+    lib.hp_tel_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.hp_tel_exemplars.restype = ctypes.c_int32
+    lib.hp_tel_exemplars.argtypes = [ctypes.c_void_p, ctypes.c_int32]
     lib.hp_hot_begin.restype = ctypes.c_int32
     lib.hp_hot_begin.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int32,
@@ -178,6 +203,12 @@ def _load():
             if not _sigs_done:
                 _bind(lib)
                 _sigs_done = True
+                # Re-arm the telemetry state requested before the
+                # library was built (tel_config only peeks).
+                if _tel_desired is not None and hasattr(
+                    lib, "hp_tel_config"
+                ):
+                    lib.hp_tel_config(*_tel_desired)
     return lib
 
 
@@ -228,6 +259,90 @@ def partition_positions(group_ids: np.ndarray, n_groups: int):
         pos.ctypes.data,
     )
     return counts, pos
+
+
+# -- native telemetry plane (ISSUE 7) ----------------------------------------
+# Process-global in the C library (NULL-ctx finishes and interner-recycle
+# context swaps both demand it), so these are module functions, not
+# HostPath methods. All calls are GIL-free and wait-free on the C side.
+# Like the ingress bindings, these PEEK at the library: arming telemetry
+# for a server that never uses the native lane must not stall startup on
+# a first-use compile — ``_load`` re-arms the desired state the moment
+# something else builds/loads the library for real.
+
+_tel_desired = None  # (enabled, slow_row_ns, trace_sample) or None
+
+
+def _peek_lib():
+    lib = _LIB.peek()
+    if lib is not None and not _sigs_done:
+        return _load()  # already dlopened: binding signatures is cheap
+    return lib
+
+
+def tel_available() -> bool:
+    """True when the library is LOADED and exports the telemetry plane
+    (an old pre-stamped binary without it serves untelemetered; an
+    unloaded library reports False rather than compiling)."""
+    lib = _peek_lib()
+    return lib is not None and hasattr(lib, "hp_tel_drain")
+
+
+def tel_config(enabled: bool, slow_row_ns: int = 0,
+               trace_sample: int = 0) -> bool:
+    """Arm (or disarm) the native telemetry plane: histogram observes,
+    the slow-row exemplar threshold (per-row average ns; 0 = exemplars
+    off) and 1-in-N begin trace sampling (0 = off). The desired state
+    is remembered and applied on library load when the library isn't
+    live yet; returns False in that case."""
+    global _tel_desired
+    _tel_desired = (1 if enabled else 0, int(slow_row_ns),
+                    int(trace_sample))
+    if not tel_available():
+        return False
+    _peek_lib().hp_tel_config(*_tel_desired)
+    return True
+
+
+def tel_drain() -> Dict[str, dict]:
+    """Cumulative native phase histograms:
+    ``{phase: {"count", "sum_ns", "buckets": [TEL_BUCKETS]}}``. One
+    GIL-free C call; {} when the library is not loaded or lacks the
+    telemetry plane. The
+    layout size is echoed by the C side — a constants mismatch (stale
+    binding vs rebuilt library) raises instead of misparsing."""
+    if not tel_available():
+        return {}
+    stride = 2 + TEL_BUCKETS
+    out = np.zeros(len(TEL_PHASES) * stride, np.int64)
+    need = _peek_lib().hp_tel_drain(out.ctypes.data, out.shape[0])
+    if need != out.shape[0]:
+        raise RuntimeError(
+            f"hp_tel_drain layout mismatch: library says {need} int64s, "
+            f"binding allocated {out.shape[0]}"
+        )
+    snap: Dict[str, dict] = {}
+    for i, phase in enumerate(TEL_PHASES):
+        rec = out[i * stride:(i + 1) * stride]
+        snap[phase] = {
+            "count": int(rec[0]),
+            "sum_ns": int(rec[1]),
+            "buckets": rec[2:].tolist(),
+        }
+    return snap
+
+
+def tel_exemplars(cap: int = 64) -> List[dict]:
+    """Drain (and clear) the slow-row exemplar ring: one dict per slow
+    begin, oldest first."""
+    if not tel_available():
+        return []
+    out = np.zeros((max(int(cap), 1), TEL_EX_STRIDE), np.int64)
+    n = _peek_lib().hp_tel_exemplars(out.ctypes.data, out.shape[0])
+    keys = ("total_ns", "lookup_ns", "stage_ns", "rows", "kernel_rows",
+            "staged_hits", "miss_rows", "leased_rows", "blob_digest",
+            "blob_len", "plan_kind", "lease_tokens")
+    return [dict(zip(keys, row)) for row in out[:n].tolist()]
 
 
 class HostPath:
@@ -359,11 +474,13 @@ class HotStaged:
 
     __slots__ = (
         "codes", "k", "nhits", "H", "rows", "row_nhits", "row_delta",
-        "row_ns", "hit_names", "ok_aggr", "fill_results",
+        "row_ns", "hit_names", "ok_aggr", "fill_results", "leased_rows",
+        "lookup_ns", "stage_ns", "trace_id",
     )
 
     def __init__(self, codes, k, nhits, H, rows, row_nhits, row_delta,
-                 row_ns, hit_names, ok_aggr):
+                 row_ns, hit_names, ok_aggr, leased_rows=0, lookup_ns=0,
+                 stage_ns=0, trace_id=0):
         self.codes = codes
         self.k = k
         self.nhits = nhits
@@ -375,6 +492,26 @@ class HotStaged:
         self.hit_names = hit_names
         self.ok_aggr = ok_aggr  # [(ns_token, calls, hits)] at begin time
         self.fill_results = True
+        # telemetry tail (zeros with the native plane off): the begin's
+        # native phase splits, leased-row count, and the 1-in-N sampled
+        # trace id (0 = unsampled) for OTLP span attachment
+        self.leased_rows = leased_rows
+        self.lookup_ns = lookup_ns
+        self.stage_ns = stage_ns
+        self.trace_id = trace_id
+
+
+def staged_trace_attrs(staged: "HotStaged") -> dict:
+    """OTLP span attributes for a 1-in-N sampled hot-lane begin: the
+    trace id the C side stamped plus the native phase splits. ONE
+    schema shared by the submit-flush and ingress span legs — callers
+    gate on ``staged.trace_id`` first."""
+    return {
+        "native.trace_id": int(staged.trace_id),
+        "native.hot_lookup_ms": round(staged.lookup_ns / 1e6, 4),
+        "native.hot_stage_ms": round(staged.stage_ns / 1e6, 4),
+        "native.leased_rows": int(staged.leased_rows),
+    }
 
 
 class NativeHotLane:
@@ -407,7 +544,9 @@ class NativeHotLane:
         self.fresh = np.zeros(c, bool)
         self._hit_names = np.empty(c, np.int32)
         self._resize_rows(max_rows)
-        self._meta = np.zeros(8, np.int64)
+        # 8 geometry slots + the 4-slot telemetry tail (hp_hot_begin
+        # writes all 12 every call; zeros with the plane off)
+        self._meta = np.zeros(12, np.int64)
         # token -> namespace / limit-name string memos (metrics apply)
         self._ns_strings: Dict[int, str] = {}
         self._name_strings: Dict[int, Optional[str]] = {}
@@ -596,6 +735,8 @@ class NativeHotLane:
             self._rows[:k].copy(), self._row_nhits[:k].copy(),
             self._row_delta[:k].copy(), self._row_ns[:k].copy(),
             self._hit_names[:nhits].copy(), ok_aggr,
+            leased_rows=int(meta[10]), lookup_ns=int(meta[8]),
+            stage_ns=int(meta[9]), trace_id=int(meta[11]),
         )
 
     def kernel_columns(self, H: int):

@@ -26,7 +26,10 @@ import numpy as np
 
 from .build import NativeLib
 
-__all__ = ["ingress_available", "ingress_build_error", "NativeIngress"]
+__all__ = [
+    "ingress_available", "ingress_build_error", "NativeIngress",
+    "ingress_tel_available", "ingress_tel_config", "ingress_tel_drain",
+]
 
 TARGET_PATH = "/envoy.service.ratelimit.v3.RateLimitService/ShouldRateLimit"
 
@@ -105,12 +108,92 @@ def _load():
         lib.h2i_respond_coded.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
         ]
+        lib.h2i_tel_config.argtypes = [ctypes.c_int32]
+        lib.h2i_tel_drain.restype = ctypes.c_int32
+        lib.h2i_tel_drain.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         _sigs_done = True
         return lib
 
 
 def ingress_available() -> bool:
     return _load() is not None
+
+
+# -- respond-path telemetry (native telemetry plane, ISSUE 7) ----------------
+# Process-global in the C library, like hostpath's hp_tel_* — module
+# functions, merged into the PHASES surface by observability/
+# native_plane.py under the ``h2i_respond`` phase. These PEEK at the
+# library instead of loading it: the telemetry poll must never stall a
+# serving process on a first-use ingress compile (a server without
+# --native-ingress never builds this library). NativeIngress
+# construction re-arms the desired state once the library is live.
+
+#: log2-ns buckets of the respond histogram (mirrors hostpath's layout)
+TEL_BUCKETS = 40
+
+_tel_desired = False
+
+
+def _peek():
+    lib = _LIB.peek()
+    if lib is not None and not _sigs_done:
+        return _load()  # already dlopened: binding signatures is cheap
+    return lib
+
+
+def ingress_tel_available() -> bool:
+    lib = _peek()
+    return lib is not None and hasattr(lib, "h2i_tel_drain")
+
+
+def ingress_tel_config(enabled: bool) -> bool:
+    global _tel_desired
+    _tel_desired = bool(enabled)
+    if not ingress_tel_available():
+        return False
+    _peek().h2i_tel_config(1 if enabled else 0)
+    return True
+
+
+def ingress_tel_drain():
+    """Cumulative ``h2i_respond_coded`` histogram in the shared drain
+    shape ``{"count", "sum_ns", "buckets": [TEL_BUCKETS]}``; None when
+    the library is not loaded or lacks the telemetry exports."""
+    if not ingress_tel_available():
+        return None
+    out = np.zeros(2 + TEL_BUCKETS, np.int64)
+    need = _peek().h2i_tel_drain(out.ctypes.data, out.shape[0])
+    if need != out.shape[0]:
+        raise RuntimeError(
+            f"h2i_tel_drain layout mismatch: library says {need} int64s, "
+            f"binding allocated {out.shape[0]}"
+        )
+    return {
+        "count": int(out[0]),
+        "sum_ns": int(out[1]),
+        "buckets": out[2:].tolist(),
+    }
+
+
+def _sampled_batch_span(pendings, n: int):
+    """OTLP device_batch span for a 1-in-N sampled hot-lane batch on
+    the ingress path; a no-op context unless an exporter is installed
+    AND the C side stamped this begin with a trace id."""
+    from contextlib import nullcontext
+
+    from ..observability.tracing import device_batch_span, tracing_enabled
+
+    if not tracing_enabled():
+        return nullcontext()
+    for pending in pendings:
+        staged = getattr(pending, "staged", None)
+        if staged is not None and getattr(staged, "trace_id", 0):
+            from . import staged_trace_attrs
+
+            attrs = staged_trace_attrs(staged)
+            attrs["native.ingress"] = True
+            return device_batch_span(0, n, attrs)
+    return nullcontext()
 
 
 class HpackDecoder:
@@ -233,6 +316,10 @@ class NativeIngress:
         if not self._ctx:
             raise OSError(f"could not bind native ingress to {host}:{port}")
         self.port = lib.h2i_port(self._ctx)
+        # Re-arm the respond-path telemetry the plane asked for before
+        # this library was built (ingress_tel_config only peeks).
+        if _tel_desired and hasattr(lib, "h2i_tel_config"):
+            lib.h2i_tel_config(1)
         # Hot-lane coded answers: when the pipeline exposes its outcome
         # templates, they are registered with the C layer once and the
         # pump answers whole batches with ONE h2i_respond_coded call —
@@ -466,10 +553,15 @@ class NativeIngress:
         """Collect a hot-lane batch: finish the launched lanes, then
         answer every coded row with ONE native call; miss rows (Python-
         decided bytes) answer through the per-row path — steady state
-        has none."""
+        has none. 1-in-N sampled batches (``--native-trace-sample``)
+        get an OTLP device_batch span carrying the native begin splits
+        the C side stamped — the h2i leg of sampled end-to-end
+        tracing."""
         try:
-            for pending in pendings:
-                self.pipeline._finish_namespace(pending, results)
+            span = _sampled_batch_span(pendings, len(ids_arr))
+            with span:
+                for pending in pendings:
+                    self.pipeline._finish_namespace(pending, results)
             if codes is not None:
                 with self._ctx_lock:
                     if self._ctx is None:

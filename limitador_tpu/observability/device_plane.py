@@ -164,6 +164,10 @@ class DeviceStatsRecorder:
         # batcher's per-flush queue-wait list (admission/overload.py
         # AIMD signal). None = detached, zero cost.
         self.on_queue_waits = None
+        # SLO watchdog (observability/native_plane.SloWatchdog): fed the
+        # per-decision end-to-end latencies record_batch already has in
+        # hand, one lock per batch. None = detached, zero cost.
+        self.slo = None
 
     def next_batch_id(self) -> int:
         return next(self._batch_ids)
@@ -248,14 +252,23 @@ class DeviceStatsRecorder:
         self.record_phases(phases)
         phases_ms = self.phases_ms(phases)
         flight = self.flight
+        slo = self.slo
+        totals: Optional[list] = [] if slo is not None else None
         t_now = time.perf_counter()
         for t_enq, rid, namespace in entries:
             total = t_now - t_enq
+            if totals is not None:
+                totals.append(total)
             if flight.would_admit(total):
                 self.record_decision(
                     total, rid, namespace, batch_id,
                     max(t_flush - t_enq, 0.0), phases_ms,
                 )
+        if totals:
+            try:
+                slo.observe_many(totals)
+            except Exception:
+                pass  # the watchdog must never fail a collect
 
     @staticmethod
     def phases_ms(phases: Dict[str, float]) -> dict:

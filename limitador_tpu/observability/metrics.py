@@ -367,6 +367,96 @@ class PrometheusMetrics:
             "enforced over-admission bound",
             registry=self.registry,
         )
+        # -- native telemetry plane (observability/native_plane.py +
+        # native/hostpath.cc hp_tel_* / native/h2ingress.cc h2i_tel_*):
+        # per-phase latency of the zero-Python hot lane, measured INSIDE
+        # the C libraries and merged bucket-for-bucket at render time
+        # (the pow2 edges match the C log2-ns buckets exactly). One
+        # family per native_plane.PHASES entry — lint cross-checked.
+        from .native_plane import NATIVE_PHASE_BUCKETS
+
+        self.native_phase_hot_lookup = Histogram(
+            "native_phase_hot_lookup",
+            "Hot-begin plan-mirror lookup pass latency (per begin call, "
+            "measured natively)",
+            registry=self.registry,
+            buckets=NATIVE_PHASE_BUCKETS,
+        )
+        self.native_phase_hot_stage = Histogram(
+            "native_phase_hot_stage",
+            "Hot-begin columnar staging latency: scatter into the "
+            "pre-allocated upload buffers, pow2 padding and lease "
+            "consume (per begin call, measured natively)",
+            registry=self.registry,
+            buckets=NATIVE_PHASE_BUCKETS,
+        )
+        self.native_phase_lease_hit = Histogram(
+            "native_phase_lease_hit",
+            "Full begin latency of calls that admitted at least one row "
+            "from a live quota lease (measured natively)",
+            registry=self.registry,
+            buckets=NATIVE_PHASE_BUCKETS,
+        )
+        self.native_phase_hot_finish = Histogram(
+            "native_phase_hot_finish",
+            "Hot-finish latency: device result columns to response "
+            "codes + metric aggregation (per finish call, measured "
+            "natively)",
+            registry=self.registry,
+            buckets=NATIVE_PHASE_BUCKETS,
+        )
+        self.native_phase_h2i_respond = Histogram(
+            "native_phase_h2i_respond",
+            "Native ingress batch-coded respond latency "
+            "(h2i_respond_coded, per respond call, measured natively)",
+            registry=self.registry,
+            buckets=NATIVE_PHASE_BUCKETS,
+        )
+        # -- SLO burn-rate watchdog (native_plane.SloWatchdog): the
+        # p99<=2ms north-star budget tracked over 5m/1h windows of
+        # merged host+device decision latency.
+        self.slo_p99_ms_5m = Gauge(
+            "slo_p99_ms_5m",
+            "Observed p99 decision latency (ms) over the trailing 5m "
+            "window (bucket upper edge)",
+            registry=self.registry,
+        )
+        self.slo_p99_ms_1h = Gauge(
+            "slo_p99_ms_1h",
+            "Observed p99 decision latency (ms) over the trailing 1h "
+            "window (bucket upper edge)",
+            registry=self.registry,
+        )
+        self.slo_burn_rate_5m = Gauge(
+            "slo_burn_rate_5m",
+            "SLO error-budget burn rate over 5m: share of decisions "
+            "over budget / (1 - target quantile); >1 = p99 breach pace",
+            registry=self.registry,
+        )
+        self.slo_burn_rate_1h = Gauge(
+            "slo_burn_rate_1h",
+            "SLO error-budget burn rate over 1h",
+            registry=self.registry,
+        )
+        self.slo_budget_ms = Gauge(
+            "slo_budget_ms",
+            "Configured decision-latency SLO budget (ms) the watchdog "
+            "tracks at its target quantile",
+            registry=self.registry,
+        )
+        self.slo_breached = Gauge(
+            "slo_breached",
+            "1 while BOTH burn-rate windows exceed 1.0 (sustained p99 "
+            "budget breach), else 0",
+            registry=self.registry,
+        )
+        self.device_backed = Gauge(
+            "device_backed",
+            "1 when a non-CPU jax backend serves this process, 0 on "
+            "CPU fallback, -1 before the backend is known",
+            registry=self.registry,
+        )
+        self.device_backed.set(-1)
         # -- multi-chip dispatch (tpu/sharded.py): launch counts per
         # collective variant, polled baseline-converted off
         # launch_stats()/library_stats. Registered in
@@ -476,6 +566,13 @@ class PrometheusMetrics:
             self.sharded_launches.labels(variant)
         self._library_sources: list = []
         self._counter_baselines: dict = {}
+        self._native_planes: list = []
+
+    def attach_native_plane(self, plane) -> None:
+        """Attach a ``native_plane.NativePlane``; its ``poll(self)``
+        runs on every render (native phase histogram merge, slow-row
+        exemplar drain, slo_* / device_backed gauge refresh)."""
+        self._native_planes.append(plane)
 
     def attach_library_source(self, source) -> None:
         """Attach an object exposing ``library_stats() -> dict``; polled on
@@ -491,6 +588,11 @@ class PrometheusMetrics:
         self._library_sources.append(source)
 
     def _poll_library_sources(self) -> None:
+        for plane in self._native_planes:
+            try:
+                plane.poll(self)
+            except Exception:
+                pass  # telemetry must never fail a render
         batcher_size = 0
         cache_size = 0
         queue_depth = 0

@@ -126,26 +126,32 @@ def _noop_record_span():
     yield _noop_record
 
 
-def device_batch_span(batch_id: int, n_requests: int):
+def device_batch_span(batch_id: int, n_requests: int, attrs=None):
     """Span around one device batch round trip, carrying the batch id
     and (via the yielded setter) the per-phase timing breakdown as
     ``batch.phase.*_ms`` attributes — so a trace view localizes where a
-    slow batch spent its time without scraping /metrics. Emitted from
+    slow batch spent its time without scraping /metrics. ``attrs`` adds
+    extra span attributes (the native telemetry plane attaches
+    ``native.trace_id`` + native phase splits for 1-in-N sampled
+    zero-Python batches). Emitted from
     the batcher flush loop, NOT under a MetricsLayer aggregate: the
     per-request datastore spans already account this wall clock, and a
     second accounting here would double-count it. No exporter -> shared
     no-op, zero per-batch cost."""
     if not _enabled or _tracer is None:
         return _noop_record_span()
-    return _device_batch_span(batch_id, n_requests)
+    return _device_batch_span(batch_id, n_requests, attrs)
 
 
 @contextmanager
-def _device_batch_span(batch_id: int, n_requests: int):
+def _device_batch_span(batch_id: int, n_requests: int, attrs=None):
     with _tracer.start_as_current_span("datastore") as span:
         span.set_attribute("datastore.operation", "device_batch")
         span.set_attribute("batch.id", batch_id)
         span.set_attribute("batch.requests", n_requests)
+        if attrs:
+            for key, value in attrs.items():
+                span.set_attribute(key, value)
 
         def record(phases: dict) -> None:
             for name, seconds in phases.items():

@@ -419,6 +419,29 @@ def build_parser() -> argparse.ArgumentParser:
         help="default directory for on-demand jax.profiler captures "
         "(POST /debug/profile can override per capture)",
     )
+    p.add_argument(
+        "--native-trace-sample", type=int,
+        default=int(_env("TPU_NATIVE_TRACE_SAMPLE", "0")),
+        help="sample 1 in N hot-lane batches with a native trace id so "
+        "OTLP device_batch spans carry the C-side phase splits for "
+        "zero-Python rows (0 = off, the default)",
+    )
+    p.add_argument(
+        "--native-slow-row-us", type=float,
+        default=float(_env("TPU_NATIVE_SLOW_ROW_US", "50")),
+        help="slow-row exemplar threshold of the native telemetry "
+        "plane: a hot-lane begin averaging more than this many "
+        "microseconds per row records a native phase breakdown + "
+        "descriptor digest into the flight recorder (0 disables "
+        "exemplars; histograms stay on)",
+    )
+    p.add_argument(
+        "--slo-budget-ms", type=float,
+        default=float(_env("TPU_SLO_BUDGET_MS", "2.0")),
+        help="decision-latency SLO budget the burn-rate watchdog "
+        "tracks at p99 over 5m/1h windows (slo_* gauges, /debug/stats "
+        "slo section)",
+    )
     return p
 
 
@@ -723,6 +746,32 @@ async def _amain(args) -> int:
         if hasattr(target, "set_metrics"):
             target.set_metrics(metrics)
             break
+    # Native telemetry plane + SLO burn-rate watchdog (observability/
+    # native_plane.py): arms the C-side histograms/exemplars, merges
+    # them into /metrics on every render, feeds the watchdog from the
+    # device-plane recorder and serves the /debug/stats sections.
+    # Device storages only — host-only backends have no native lane to
+    # measure (and should not pay a native build for a watchdog).
+    native_plane = None
+    if args.storage == "tpu":
+        from ..observability.native_plane import NativePlane
+
+        native_plane = NativePlane(
+            budget_ms=args.slo_budget_ms,
+            slow_row_us=args.native_slow_row_us,
+            trace_sample=args.native_trace_sample,
+        )
+        # The recorder lives on whichever target set_metrics landed on:
+        # the compiled limiter carries its own; the standard pipeline's
+        # AsyncRateLimiter does not, so the storage's recorder is the
+        # process flight recorder + SLO feed there.
+        recorder = (
+            getattr(limiter, "recorder", None)
+            or getattr(counters_storage, "recorder", None)
+        )
+        if recorder is not None:
+            native_plane.attach_recorder(recorder)
+        metrics.attach_native_plane(native_plane)
     # Admission plane: overload control, priority shedding, device-plane
     # breaker + host failover (admission/). Only the batched TPU
     # storages expose set_admission — the host backends have no device
@@ -998,6 +1047,8 @@ async def _amain(args) -> int:
     debug_sources = [counters_storage]
     if native_pipeline is not None:
         debug_sources.append(native_pipeline)
+    if native_plane is not None:
+        debug_sources.append(native_plane)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,

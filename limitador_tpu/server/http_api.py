@@ -389,6 +389,23 @@ class _Api:
                 if lease:
                     stats["lease"] = lease
                     break
+        # Native telemetry plane + SLO watchdog + runtime device_backed
+        # probe (observability/native_plane.NativePlane in
+        # debug_sources; each section independent so a partial plane
+        # still reports what it has).
+        for key, attr in (
+            ("native_telemetry", "native_telemetry"),
+            ("slo", "slo_status"),
+            ("device_backed", "device_backed"),
+        ):
+            for source in self.debug_sources:
+                fn = getattr(source, attr, None)
+                if callable(fn):
+                    try:
+                        stats[key] = fn()
+                    except Exception:
+                        pass  # diagnostics must never 500 the endpoint
+                    break
         return web.json_response(stats)
 
     async def get_debug_profile(self, request: web.Request) -> web.Response:

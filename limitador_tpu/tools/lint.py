@@ -21,6 +21,10 @@ the gate is implemented from scratch on ``ast``:
   ``observability/metrics.py``, and every declared ``admission_*``
   family must appear in the admission registry — a typo'd or orphaned
   family fails the gate instead of silently never rendering,
+* the native-phase cross-check: every entry of the telemetry plane's
+  ``PHASES`` tuple (observability/native_plane.py) must have a matching
+  ``native_phase_<entry>`` histogram family declared in metrics.py and
+  registered in the plane's ``METRIC_FAMILIES``,
 * the buffer-donation check: ``jax.jit`` call sites in the kernel
   modules (DONATION_CHECKED_MODULES) whose wrapped function carries the
   counter table (a ``state`` or ``values``/``expiry`` parameter) must
@@ -43,7 +47,7 @@ from typing import List, Tuple
 
 __all__ = [
     "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
-    "lint_ctypes_signatures", "main",
+    "lint_ctypes_signatures", "lint_native_phases", "main",
 ]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
@@ -58,7 +62,16 @@ REGISTRY_OWNED_PREFIXES = {
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
     "native_lane_": "limitador_tpu/tpu/native_pipeline.py",
     "lease_": "limitador_tpu/lease/__init__.py",
+    "native_phase_": "limitador_tpu/observability/native_plane.py",
+    "slo_": "limitador_tpu/observability/native_plane.py",
 }
+
+#: the native telemetry plane's phase registry: every entry of this
+#: module-level PHASES tuple must have a ``native_phase_<entry>``
+#: histogram family declared in metrics.py AND registered in the same
+#: module's METRIC_FAMILIES — a phase added to the C enum without its
+#: Prometheus family would silently drop that phase's drain.
+NATIVE_PLANE_MODULE = "limitador_tpu/observability/native_plane.py"
 
 #: native sources whose extern "C" exports must carry matching ctypes
 #: declarations in the binding modules (symbol prefix filters the
@@ -160,6 +173,62 @@ def lint_metric_registry(repo_root: Path) -> List[str]:
                     f"declared but missing from {registry}'s "
                     "METRIC_FAMILIES registry"
                 )
+    return findings
+
+
+def _module_string_tuple(path: Path, name: str) -> List[str]:
+    """Entries of a module-level ``NAME = ("a", "b", ...)`` tuple/list
+    assignment (string constants only)."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return []
+    out: List[str] = []
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            continue
+        for elt in node.value.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+    return out
+
+
+def lint_native_phases(repo_root: Path) -> List[str]:
+    """Cross-check the native telemetry plane's PHASES tuple: every
+    phase needs a ``native_phase_<phase>`` histogram family declared in
+    observability/metrics.py and registered in native_plane's
+    METRIC_FAMILIES — otherwise that phase's drain silently never
+    renders."""
+    plane_path = repo_root / NATIVE_PLANE_MODULE
+    metrics_path = (
+        repo_root / "limitador_tpu" / "observability" / "metrics.py"
+    )
+    if not plane_path.exists() or not metrics_path.exists():
+        return []
+    phases = _module_string_tuple(plane_path, "PHASES")
+    registered = set(_module_string_tuple(plane_path, "METRIC_FAMILIES"))
+    declared = declared_metric_families(metrics_path)
+    findings = []
+    for phase in phases:
+        family = f"native_phase_{phase}"
+        if family not in declared:
+            findings.append(
+                f"{plane_path}:0: PHASES entry '{phase}' has no "
+                f"'{family}' histogram family declared in "
+                "observability/metrics.py"
+            )
+        if family not in registered:
+            findings.append(
+                f"{plane_path}:0: PHASES entry '{phase}' has no "
+                f"'{family}' entry in METRIC_FAMILIES"
+            )
     return findings
 
 
@@ -538,6 +607,7 @@ def main(argv=None) -> int:
     findings.extend(lint_metric_registry(repo_root))
     findings.extend(lint_donation(repo_root))
     findings.extend(lint_ctypes_signatures(repo_root))
+    findings.extend(lint_native_phases(repo_root))
     for finding in findings:
         print(finding)
     if findings:

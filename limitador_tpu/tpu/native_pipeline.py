@@ -47,7 +47,7 @@ from ..core.counter import Counter
 from ..core.limit import Namespace
 from ..observability.device_plane import current_request_id
 from ..observability.metrics import PrometheusMetrics
-from ..observability.tracing import device_batch_span
+from ..observability.tracing import device_batch_span, tracing_enabled
 from ..storage.base import StorageError
 from .. import native
 from ..ops import kernel as K
@@ -1160,7 +1160,9 @@ class NativeRlsPipeline:
         decided rows, resolve every settled future in ONE loop callback
         (a call_soon_threadsafe per future is a self-pipe write + wakeup
         per request — it profiled as ~45% of the serving path)."""
-        with device_batch_span(batch_id, len(batch)) as span_phases:
+        with device_batch_span(
+            batch_id, len(batch), _native_trace_attrs(pendings)
+        ) as span_phases:
             t_fin = time.perf_counter()
             for pending in pendings:
                 self._finish_namespace(pending, results)
@@ -1610,6 +1612,20 @@ class NativeRlsPipeline:
                     pass  # shard loop died mid-shutdown: futures are gone
         self._dispatch_pool.shutdown(wait=False)
         self._collect_pool.shutdown(wait=False)
+
+
+def _native_trace_attrs(pendings) -> Optional[dict]:
+    """Span attributes for a 1-in-N sampled hot-lane batch (native
+    telemetry plane): the trace id hp_hot_begin stamped plus the native
+    begin splits, so an OTLP trace of a sampled zero-Python batch shows
+    where native time went. None (zero cost) unless an exporter is
+    installed AND this batch was sampled."""
+    if not tracing_enabled():
+        return None
+    for pending in pendings:
+        if type(pending) is _HotPending and pending.staged.trace_id:
+            return native.staged_trace_attrs(pending.staged)
+    return None
 
 
 def _spawn_detached(coro) -> asyncio.Task:

@@ -31,6 +31,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -43,6 +44,37 @@
 #include "h2_hpack_tables.h"
 
 namespace {
+
+// ------------------------------------------------------- respond telemetry
+// One log2-ns histogram over h2i_respond_coded — the native half of the
+// zero-Python response path (native telemetry plane, ISSUE 7). Process-
+// global and wait-free like hostpath.cc's Tel: relaxed atomics, two
+// steady_clock reads per respond batch, nothing per row. Drained
+// cumulative by h2i_tel_drain; Python converts to increments.
+
+constexpr int H2I_TEL_BUCKETS = 40;
+
+std::atomic<int32_t> g_tel_enabled{0};
+std::atomic<uint64_t> g_tel_count{0};
+std::atomic<uint64_t> g_tel_sum{0};
+std::atomic<uint64_t> g_tel_buckets[H2I_TEL_BUCKETS];
+
+inline int64_t tel_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline void tel_observe(int64_t ns) {
+  if (ns < 0) ns = 0;
+  int b = 0;
+  uint64_t v = (uint64_t)ns;
+  while (v >>= 1) b++;
+  if (b >= H2I_TEL_BUCKETS) b = H2I_TEL_BUCKETS - 1;
+  g_tel_count.fetch_add(1, std::memory_order_relaxed);
+  g_tel_sum.fetch_add((uint64_t)ns, std::memory_order_relaxed);
+  g_tel_buckets[b].fetch_add(1, std::memory_order_relaxed);
+}
 
 // ---------------------------------------------------------------- huffman
 
@@ -1077,6 +1109,8 @@ void h2i_set_code(void* vc, int code, int status, const uint8_t* payload,
 void h2i_respond_coded(void* vc, int n, const uint64_t* ids,
                        const int8_t* codes) {
   Ctx* c = (Ctx*)vc;
+  const int32_t tel = g_tel_enabled.load(std::memory_order_relaxed);
+  const int64_t tel_t0 = tel ? tel_now_ns() : 0;
   int queued = 0;
   {
     std::lock_guard<std::mutex> lk(c->mu);
@@ -1088,10 +1122,33 @@ void h2i_respond_coded(void* vc, int n, const uint64_t* ids,
       queued++;
     }
   }
+  if (tel) tel_observe(tel_now_ns() - tel_t0);
   if (queued == 0) return;
   uint64_t one = 1;
   ssize_t ignored = write(c->wake_fd, &one, 8);
   (void)ignored;
+}
+
+// ---- respond-path telemetry (native telemetry plane, ISSUE 7) -------------
+
+void h2i_tel_config(int32_t enabled) {
+  g_tel_enabled.store(enabled, std::memory_order_relaxed);
+}
+
+// Snapshot the cumulative respond histogram: [count, sum_ns,
+// bucket_0 .. bucket_{H2I_TEL_BUCKETS-1}] (same log2-ns layout as
+// hostpath.cc's hp_tel_drain, one phase). Writes min(cap, needed)
+// int64s and returns the full layout size.
+int32_t h2i_tel_drain(int64_t* out, int64_t cap) {
+  const int64_t need = 2 + H2I_TEL_BUCKETS;
+  int64_t idx = 0;
+  if (idx < cap)
+    out[idx++] = (int64_t)g_tel_count.load(std::memory_order_relaxed);
+  if (idx < cap)
+    out[idx++] = (int64_t)g_tel_sum.load(std::memory_order_relaxed);
+  for (int b = 0; b < H2I_TEL_BUCKETS && idx < cap; b++)
+    out[idx++] = (int64_t)g_tel_buckets[b].load(std::memory_order_relaxed);
+  return (int32_t)need;
 }
 
 // Opaque per-stream key for a taken item: (conn id << 32) | stream id,

@@ -25,6 +25,7 @@
 // Build: g++ -O2 -shared -fPIC (see limitador_tpu/native/__init__.py).
 
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -355,6 +356,96 @@ ParallelPool* pool_for(int threads) {
   std::lock_guard<std::mutex> lk(g_pool_mu);
   if (g_pool == nullptr && threads > 1) g_pool = new ParallelPool(threads);
   return g_pool;
+}
+
+// ---------------------------------------------------------------------------
+// Native telemetry plane (ISSUE 7): per-thread lock-free phase
+// histograms + a slow-row exemplar ring for the zero-Python hot lane.
+//
+// The zero-Python lane runs no Python bytecode per repeat row, so the
+// PR 1 flight recorder and phase histograms never see the dominant
+// traffic. This plane measures the native phases from INSIDE the
+// library: each observation is two steady_clock reads per batch pass
+// plus relaxed fetch_adds into a thread-indexed bank of log2-bucketed
+// counters — wait-free on the hot path, no locks, no Python. State is
+// process-global (not per-Ctx) on purpose: hp_hot_finish runs with a
+// NULL ctx (it must survive interner-recycle context swaps), and a
+// global plane is recycle-proof by construction. hp_tel_drain snapshots
+// the cumulative totals into a caller-provided buffer in one GIL-free
+// call; the Python side (observability/native_plane.py) converts them
+// to increments.
+//
+// Exemplars: a begin call whose per-row average exceeds the configured
+// threshold records a phase breakdown + the lead row's blob digest and
+// lease/plan state into a small ring (mutex-guarded — slow events are
+// off the hot path by definition). Python drains the ring into the
+// flight recorder so GET /debug/stats shows real slow hot-lane rows.
+// ---------------------------------------------------------------------------
+
+constexpr int TEL_PHASES = 4;    // hostpath-local phases (h2i has its own)
+constexpr int TEL_BUCKETS = 40;  // log2 ns: bucket b holds [2^b, 2^{b+1})
+constexpr int TEL_BANKS = 8;     // thread-striped to keep fetch_adds local
+constexpr int TEL_EX_STRIDE = 12;
+constexpr int TEL_EX_CAP = 64;
+
+enum TelPhase {
+  TEL_HOT_LOOKUP = 0,  // hot-begin plan-mirror lookup pass
+  TEL_HOT_STAGE = 1,   // columnar staging passes (incl. pad + lease consume)
+  TEL_LEASE_HIT = 2,   // begins that answered >=1 row from a live lease
+  TEL_HOT_FINISH = 3,  // device columns -> response codes + metrics
+};
+
+struct alignas(64) TelBank {
+  std::atomic<uint64_t> count[TEL_PHASES];
+  std::atomic<uint64_t> sum[TEL_PHASES];
+  std::atomic<uint64_t> buckets[TEL_PHASES][TEL_BUCKETS];
+};
+
+struct Tel {
+  std::atomic<int32_t> enabled{0};
+  std::atomic<int64_t> slow_ns{0};       // per-row avg threshold; 0 = off
+  std::atomic<int64_t> trace_sample{0};  // 1-in-N begin sampling; 0 = off
+  std::atomic<uint64_t> batch_seq{0};
+  TelBank banks[TEL_BANKS];
+  std::mutex ex_mu;
+  int64_t ring[TEL_EX_CAP][TEL_EX_STRIDE];
+  int ex_n = 0;     // live exemplars
+  int ex_head = 0;  // next write (oldest overwritten when full)
+};
+
+Tel g_tel;
+
+int tel_bank_id() {
+  static std::atomic<int> next{0};
+  thread_local int id =
+      next.fetch_add(1, std::memory_order_relaxed) & (TEL_BANKS - 1);
+  return id;
+}
+
+inline int64_t tel_now_ns() {
+  return std::chrono::duration_cast<std::chrono::nanoseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+inline void tel_observe(int phase, int64_t ns) {
+  if (ns < 0) ns = 0;
+  int b = 0;
+  uint64_t v = (uint64_t)ns;
+  while (v >>= 1) b++;  // floor(log2); 0/1 land in bucket 0
+  if (b >= TEL_BUCKETS) b = TEL_BUCKETS - 1;
+  TelBank& bank = g_tel.banks[tel_bank_id()];
+  bank.count[phase].fetch_add(1, std::memory_order_relaxed);
+  bank.sum[phase].fetch_add((uint64_t)ns, std::memory_order_relaxed);
+  bank.buckets[phase][b].fetch_add(1, std::memory_order_relaxed);
+}
+
+void tel_push_exemplar(const int64_t* fields) {
+  std::lock_guard<std::mutex> lk(g_tel.ex_mu);
+  memcpy(g_tel.ring[g_tel.ex_head], fields,
+         TEL_EX_STRIDE * sizeof(int64_t));
+  g_tel.ex_head = (g_tel.ex_head + 1) % TEL_EX_CAP;
+  if (g_tel.ex_n < TEL_EX_CAP) g_tel.ex_n++;
 }
 
 // ---------------------------------------------------------------------------
@@ -956,6 +1047,71 @@ void hp_lease_stats(void* c, int64_t* out) {
   out[7] = (int64_t)m.lease_returns.size();
 }
 
+// ---- native telemetry plane (ISSUE 7) -------------------------------------
+// Process-global (see the Tel comment above): every context's begins and
+// every finish — including the NULL-ctx finishes that outlive an
+// interner recycle — land in one recycle-proof set of counters.
+
+// enabled gates the histogram observes; slow_row_ns > 0 additionally
+// records exemplars for begins whose per-row average exceeds it;
+// trace_sample N stamps every Nth begin's out_meta with a trace id
+// (0 = off) for sampled end-to-end tracing.
+void hp_tel_config(int32_t enabled, int64_t slow_row_ns,
+                   int64_t trace_sample) {
+  g_tel.enabled.store(enabled, std::memory_order_relaxed);
+  g_tel.slow_ns.store(slow_row_ns < 0 ? 0 : slow_row_ns,
+                      std::memory_order_relaxed);
+  g_tel.trace_sample.store(trace_sample < 0 ? 0 : trace_sample,
+                           std::memory_order_relaxed);
+}
+
+// Snapshot the cumulative histograms into out: TEL_PHASES records of
+// [count, sum_ns, bucket_0 .. bucket_{TEL_BUCKETS-1}], phases in
+// TelPhase order. Writes min(cap, needed) int64s and returns the full
+// layout size, so a binding compiled against different constants fails
+// loudly instead of reading garbage. GIL-free, wait-free (relaxed reads
+// summed across banks; a torn in-flight increment skews one drain by
+// one observation, never corrupts).
+int32_t hp_tel_drain(int64_t* out, int64_t cap) {
+  const int64_t need = (int64_t)TEL_PHASES * (2 + TEL_BUCKETS);
+  int64_t idx = 0;
+  for (int p = 0; p < TEL_PHASES && idx < cap; p++) {
+    uint64_t count = 0, sum = 0;
+    for (int k = 0; k < TEL_BANKS; k++) {
+      count += g_tel.banks[k].count[p].load(std::memory_order_relaxed);
+      sum += g_tel.banks[k].sum[p].load(std::memory_order_relaxed);
+    }
+    if (idx < cap) out[idx++] = (int64_t)count;
+    if (idx < cap) out[idx++] = (int64_t)sum;
+    for (int b = 0; b < TEL_BUCKETS && idx < cap; b++) {
+      uint64_t c = 0;
+      for (int k = 0; k < TEL_BANKS; k++)
+        c += g_tel.banks[k].buckets[p][b].load(std::memory_order_relaxed);
+      out[idx++] = (int64_t)c;
+    }
+  }
+  return (int32_t)need;
+}
+
+// Drain (and clear) the slow-row exemplar ring: up to cap records of
+// TEL_EX_STRIDE int64 fields each — [total_ns, lookup_ns, stage_ns,
+// rows, kernel_rows, staged_hits, miss_rows, leased_rows, blob_digest,
+// blob_len, plan_kind, lease_tokens]. Returns records written.
+int32_t hp_tel_exemplars(int64_t* out, int32_t cap) {
+  std::lock_guard<std::mutex> lk(g_tel.ex_mu);
+  int n = g_tel.ex_n < cap ? g_tel.ex_n : cap;
+  // oldest-first: start at head - ex_n (mod cap)
+  int start = (g_tel.ex_head - g_tel.ex_n + 2 * TEL_EX_CAP) % TEL_EX_CAP;
+  for (int i = 0; i < n; i++) {
+    memcpy(out + (int64_t)i * TEL_EX_STRIDE,
+           g_tel.ring[(start + i) % TEL_EX_CAP],
+           TEL_EX_STRIDE * sizeof(int64_t));
+  }
+  g_tel.ex_n = 0;
+  g_tel.ex_head = 0;
+  return n;
+}
+
 // The hot begin: one call per batch covering plan lookup + columnar
 // staging + begin-time response codes.
 //
@@ -972,8 +1128,10 @@ void hp_lease_stats(void* c, int64_t* out) {
 //   out_hit_names[cap]: limit-name token per staged hit
 //   out_ok_ns/out_ok_calls/out_ok_hits[n]: begin-time OK metric
 //       aggregation (plan-OK rows), n_ok_ns distinct namespaces
-//   out_meta[8]: k, nhits, H, hit_rows, miss_rows, overflow_rows,
-//       n_ok_ns, 0
+//   out_meta[12]: k, nhits, H, hit_rows, miss_rows, overflow_rows,
+//       n_ok_ns, 0, then the telemetry tail (zeros with telemetry off):
+//       lookup_ns, stage_ns, leased_rows, trace_id (nonzero only for
+//       1-in-N sampled begins when hp_tel_config set trace_sample)
 // Returns k (kernel rows staged).
 int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
                      const uint32_t* lens, int32_t n, int64_t epoch,
@@ -990,6 +1148,8 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   m.sync_epoch(epoch);
   std::vector<int64_t>& ent = ctx->scratch_ent;
   if ((int64_t)ent.size() < n) ent.resize(n);
+  const int32_t tel = g_tel.enabled.load(std::memory_order_relaxed);
+  const int64_t tel_t0 = tel ? tel_now_ns() : 0;
 
   // Pass 1 (parallel): hash + mirror lookup per row; OK/UNKNOWN rows get
   // their begin-time code here. Reads only; disjoint writes per range.
@@ -1015,11 +1175,13 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   } else {
     lookup_range(0, 1);
   }
+  const int64_t tel_t1 = tel ? tel_now_ns() : 0;
 
   // Pass 2 (serial): kernel-row offsets (prefix sum), overflow handling,
   // lease consumption, and the begin-time OK metric aggregation.
   int32_t k = 0;
   int64_t nhits = 0;
+  int64_t leased_rows = 0;
   int64_t hit_rows = 0, miss_rows = 0, overflow_rows = 0;
   int32_t n_ok_ns = 0;
   auto aggregate_ok = [&](int32_t ns_token, int32_t delta) {
@@ -1054,6 +1216,7 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
         e.lease_tokens--;
         m.lease_outstanding--;
         m.leased++;
+        leased_rows++;
         if (e.lease_tokens == 0) {
           m.lease_active--;
           // exhausted under live demand: renewal signal sized by the
@@ -1148,6 +1311,56 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   out_meta[5] = overflow_rows;
   out_meta[6] = n_ok_ns;
   out_meta[7] = 0;
+  out_meta[8] = 0;
+  out_meta[9] = 0;
+  out_meta[10] = 0;
+  out_meta[11] = 0;
+  if (tel) {
+    const int64_t tel_t2 = tel_now_ns();
+    const int64_t lookup_ns = tel_t1 - tel_t0;
+    const int64_t stage_ns = tel_t2 - tel_t1;
+    tel_observe(TEL_HOT_LOOKUP, lookup_ns);
+    tel_observe(TEL_HOT_STAGE, stage_ns);
+    if (leased_rows > 0) tel_observe(TEL_LEASE_HIT, tel_t2 - tel_t0);
+    const int64_t slow = g_tel.slow_ns.load(std::memory_order_relaxed);
+    if (slow > 0 && n > 0 && (tel_t2 - tel_t0) > slow * (int64_t)n) {
+      // Slow begin: record the lead row's identity + lease/plan state
+      // so the flight recorder shows a concrete culprit, not just a
+      // number. Lead row = first kernel row when one staged (its plan
+      // entry is still addressable through ent), else row 0.
+      int64_t fields[TEL_EX_STRIDE];
+      fields[0] = tel_t2 - tel_t0;
+      fields[1] = lookup_ns;
+      fields[2] = stage_ns;
+      fields[3] = n;
+      fields[4] = k;
+      fields[5] = nhits;
+      fields[6] = miss_rows;
+      fields[7] = leased_rows;
+      if (k > 0) {
+        const PlanEntry& e = m.table[ent[out_rows[0]]];
+        fields[8] = (int64_t)e.hash;
+        fields[9] = (int64_t)e.blob_len;
+        fields[10] = e.kind;
+        fields[11] = e.lease_tokens;
+      } else {
+        fields[8] = (int64_t)Interner::fnv1a((const char*)ptrs[0], lens[0]);
+        fields[9] = (int64_t)lens[0];
+        fields[10] = -1;
+        fields[11] = -1;
+      }
+      tel_push_exemplar(fields);
+    }
+    out_meta[8] = lookup_ns;
+    out_meta[9] = stage_ns;
+    out_meta[10] = leased_rows;
+    const int64_t samp = g_tel.trace_sample.load(std::memory_order_relaxed);
+    if (samp > 0) {
+      uint64_t seq = g_tel.batch_seq.fetch_add(1, std::memory_order_relaxed)
+                     + 1;
+      if (seq % (uint64_t)samp == 0) out_meta[11] = (int64_t)seq;
+    }
+  }
   return k;
 }
 
@@ -1200,6 +1413,8 @@ void hp_hot_finish(void* c, const uint8_t* admitted, const uint8_t* hit_ok,
                    int32_t* out_lim_ns, int32_t* out_lim_name,
                    int64_t* out_lim_count, int64_t* out_counts) {
   (void)c;
+  const int32_t tel = g_tel.enabled.load(std::memory_order_relaxed);
+  const int64_t tel_t0 = tel ? tel_now_ns() : 0;
   int32_t n_ok = 0, n_lim = 0;
   int64_t base = 0;
   for (int32_t i = 0; i < k; i++) {
@@ -1250,6 +1465,7 @@ void hp_hot_finish(void* c, const uint8_t* admitted, const uint8_t* hit_ok,
   }
   out_counts[0] = n_ok;
   out_counts[1] = n_lim;
+  if (tel) tel_observe(TEL_HOT_FINISH, tel_now_ns() - tel_t0);
 }
 
 // ---- per-shard partition (tpu/storage.py staging assist) -----------------

@@ -56,6 +56,43 @@ def test_dashboard_is_valid_json_with_panels():
     assert len(doc["panels"]) >= 10
 
 
+def test_dashboard_covers_lease_and_native_lane_families():
+    """PR 5/6 shipped the native_lane_* and lease_* families without
+    panels; PR 7 added the rows — every one of these families must be
+    referenced by at least one panel expression, and the native
+    telemetry / SLO row must query the new plane."""
+    exprs = "\n".join(dashboard_exprs())
+    for family in (
+        "native_lane_rows",
+        "native_lane_misses",
+        "native_lane_staged_hits",
+        "native_lane_invalidations",
+        "native_lane_plans",
+        "lease_admissions",
+        "lease_grants",
+        "lease_grant_denials",
+        "lease_granted_tokens",
+        "lease_returned_tokens",
+        "lease_active",
+        "lease_outstanding_tokens",
+        "native_phase_hot_lookup",
+        "native_phase_h2i_respond",
+        "slo_burn_rate_5m",
+        "slo_p99_ms_1h",
+        "slo_breached",
+        "device_backed",
+    ):
+        assert family in exprs, f"no panel queries {family}"
+
+
+def test_dashboard_has_rows_for_the_new_planes():
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("hot lane" in r.lower() for r in rows)
+    assert any("lease" in r.lower() for r in rows)
+    assert any("slo" in r.lower() for r in rows)
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

@@ -1,0 +1,381 @@
+"""Native telemetry plane + SLO watchdog (ISSUE 7).
+
+Covers the acceptance criteria: the native histograms validated against
+a Python-side timing oracle within bucket resolution, the slow-row
+exemplar ring landing in the flight recorder, 1-in-N trace-id
+sampling, the SLO watchdog demonstrably firing under injected p99
+budget burn (and recovering), and the ``/debug/stats`` schema — every
+section present and JSON-serializable under live mixed traffic,
+including after an interner-recycle context swap.
+"""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from limitador_tpu import Limit, native
+from limitador_tpu.observability.device_plane import DeviceStatsRecorder
+from limitador_tpu.observability.metrics import PrometheusMetrics
+from limitador_tpu.observability.native_plane import (
+    PHASES,
+    NativePlane,
+    SloWatchdog,
+    device_backed_runtime,
+)
+from limitador_tpu.server.proto import rls_pb2
+from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+D = "descriptors[0]"
+
+
+def _blobs(n, users=256, domain="api"):
+    rng = np.random.default_rng(11)
+    out = []
+    for _ in range(n):
+        req = rls_pb2.RateLimitRequest(domain=domain)
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "m", "GET"
+        e = d.entries.add()
+        e.key, e.value = "u", f"user-{int(rng.integers(0, users))}"
+        out.append(req.SerializeToString())
+    return out
+
+
+def _multi_descriptor_blob():
+    req = rls_pb2.RateLimitRequest(domain="api")
+    for val in ("a", "b"):
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "u", val
+    return req.SerializeToString()
+
+
+def _build_pipeline(metrics=None, capacity=1 << 14):
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(TpuStorage(capacity=capacity), max_delay=0.0005)
+    )
+    limiter.add_limit(
+        Limit("api", 10**6, 60, [f"{D}.m == 'GET'"], [f"{D}.u"])
+    )
+    if metrics is not None:
+        limiter.set_metrics(metrics)
+    return NativeRlsPipeline(limiter, metrics, max_delay=0.0005,
+                             max_batch=4096), limiter
+
+
+@pytest.fixture
+def pipeline():
+    if not native.available():
+        pytest.skip(f"native hostpath unavailable: {native.build_error()}")
+    if not native.tel_available():
+        pytest.skip("native telemetry exports unavailable")
+    p, limiter = _build_pipeline()
+    yield p, limiter
+    native.tel_config(False)
+
+
+# -- histograms vs a Python-side timing oracle -------------------------------
+
+
+def test_histograms_match_python_timing_oracle(pipeline):
+    """The C-measured lookup+stage time of N begins must (a) count
+    exactly N observations per phase, (b) never exceed the Python-side
+    wall clock around the same calls, (c) cover a meaningful share of
+    it, and (d) have bucket contents that bracket the exact C sums
+    within log2 bucket resolution."""
+    import time
+
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None:
+        pytest.skip("native hot lane unavailable")
+    blobs = _blobs(2048)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror the plans
+    epoch = p.plan_cache.epoch
+    native.tel_config(True)
+    base = native.tel_drain()
+    passes = 20
+    t0 = time.perf_counter()
+    for _ in range(passes):
+        with p._native_lock:
+            staged = lane.begin(blobs, epoch)
+    py_ns = (time.perf_counter() - t0) * 1e9
+    assert staged.k == len(blobs), "plans must serve from the mirror"
+    snap = native.tel_drain()
+    c_total = 0
+    for phase in ("hot_lookup", "hot_stage"):
+        delta_count = snap[phase]["count"] - base[phase]["count"]
+        assert delta_count == passes, (
+            f"{phase}: {delta_count} observations for {passes} begins"
+        )
+        delta_sum = snap[phase]["sum_ns"] - base[phase]["sum_ns"]
+        assert delta_sum > 0
+        c_total += delta_sum
+        # bucket resolution: sum reconstructed from log2 buckets must
+        # bracket the exact sum (bucket b holds [2^b, 2^{b+1}))
+        buckets = np.asarray(snap[phase]["buckets"]) - np.asarray(
+            base[phase]["buckets"]
+        )
+        assert int(buckets.sum()) == passes
+        lo = sum(c * 2.0**b for b, c in enumerate(buckets.tolist()))
+        hi = sum(c * 2.0 ** (b + 1) for b, c in enumerate(buckets.tolist()))
+        assert lo <= delta_sum <= hi, (
+            f"{phase}: bucket contents {lo}..{hi} do not bracket the "
+            f"exact sum {delta_sum}"
+        )
+    # the python oracle: C-inner time can never exceed the outer wall
+    # clock, and the lookup+stage passes dominate a begin
+    assert c_total <= py_ns, (
+        f"C-measured {c_total}ns exceeds the Python wall clock {py_ns}ns"
+    )
+    assert c_total >= py_ns * 0.2, (
+        f"C-measured {c_total}ns is implausibly small vs {py_ns}ns — "
+        "is the clock broken?"
+    )
+
+
+def test_finish_phase_and_meta_tail_observed(pipeline):
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None:
+        pytest.skip("native hot lane unavailable")
+    blobs = _blobs(512)
+    p.decide_many(blobs, chunk=len(blobs))
+    epoch = p.plan_cache.epoch
+    native.tel_config(True)
+    base = native.tel_drain()
+    with p._native_lock:
+        staged = lane.begin(blobs, epoch)
+    assert staged.lookup_ns > 0 and staged.stage_ns > 0
+    admitted = np.ones(len(blobs), bool)
+    hit_ok = np.ones(lane.cap, bool)
+    lane.finish(staged, admitted, hit_ok)
+    snap = native.tel_drain()
+    assert snap["hot_finish"]["count"] - base["hot_finish"]["count"] == 1
+
+
+def test_trace_sampling_stamps_every_nth_begin(pipeline):
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None:
+        pytest.skip("native hot lane unavailable")
+    blobs = _blobs(128)
+    p.decide_many(blobs, chunk=len(blobs))
+    epoch = p.plan_cache.epoch
+    native.tel_config(True, 0, 2)
+    ids = []
+    for _ in range(6):
+        with p._native_lock:
+            ids.append(lane.begin(blobs, epoch).trace_id)
+    sampled = [t for t in ids if t]
+    assert len(sampled) == 3, f"expected 3 of 6 sampled, got {ids}"
+    assert sampled == sorted(sampled) and len(set(sampled)) == 3
+
+
+# -- slow-row exemplars ------------------------------------------------------
+
+
+def test_exemplars_drain_into_the_flight_recorder(pipeline):
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None:
+        pytest.skip("native hot lane unavailable")
+    metrics = PrometheusMetrics()
+    recorder = DeviceStatsRecorder(metrics)
+    plane = NativePlane(slow_row_us=0.001, recorder=recorder)  # ~1ns/row
+    native.tel_exemplars()  # clear anything a prior test recorded
+    blobs = _blobs(512)
+    p.decide_many(blobs, chunk=len(blobs))
+    epoch = p.plan_cache.epoch
+    with p._native_lock:
+        lane.begin(blobs, epoch)
+    plane.poll(metrics)
+    entries = [
+        e for e in recorder.flight.snapshot() if "native" in e
+    ]
+    assert entries, "no exemplar reached the flight recorder"
+    entry = entries[0]
+    assert entry["phases_ms"]["native_lane"] > 0
+    nat = entry["native"]
+    assert nat["rows"] == 512
+    assert len(nat["blob_digest"]) == 16  # hex fnv64 of the lead blob
+    assert entry["duration_ms"] > 0
+    # the same poll also merged the histograms into prometheus
+    text = metrics.render().decode()
+    assert "native_phase_hot_lookup_count" in text
+
+
+# -- the SLO burn-rate watchdog ----------------------------------------------
+
+
+def test_slo_watchdog_fires_on_injected_burn_and_recovers():
+    clock = [0.0]
+    wd = SloWatchdog(budget_ms=2.0, clock=lambda: clock[0])
+    # healthy traffic: p99 well under budget, nothing burns
+    for _ in range(30):
+        wd.observe_many([0.0001] * 200)
+        clock[0] += 10.0
+    s = wd.status()
+    assert not s["breached"]
+    assert s["burn_rate_5m"] == 0.0
+    assert s["p99_ms_5m"] <= 2.0
+    # inject sustained p99 budget burn: 5% of decisions at 5ms (error
+    # budget for p99 is 1%, so burn rate ~5x) across both windows
+    for _ in range(31):
+        wd.observe_many([0.0001] * 190 + [0.005] * 10)
+        clock[0] += 10.0
+    s = wd.status()
+    assert s["burn_rate_5m"] > 1.0
+    assert s["burn_rate_1h"] > 1.0
+    assert s["breached"], f"watchdog must fire under sustained burn: {s}"
+    # recovery: healthy traffic again — the short window clears first,
+    # un-firing the watchdog long before the 1h window forgets
+    for _ in range(31):
+        wd.observe_many([0.0001] * 200)
+        clock[0] += 10.0
+    s = wd.status()
+    assert s["burn_rate_5m"] == 0.0
+    assert not s["breached"]
+    assert s["burn_rate_1h"] > 0.0  # the long window still remembers
+
+
+def test_slo_watchdog_p99_within_bucket_resolution():
+    clock = [0.0]
+    wd = SloWatchdog(budget_ms=2.0, clock=lambda: clock[0])
+    # 1000 observations at exactly 1ms: p99 must land in the bucket
+    # containing 1000µs — upper edge within one log2 step
+    wd.observe_many([0.001] * 1000)
+    s = wd.status()
+    assert 1.0 <= s["p99_ms_5m"] <= 2.048
+    assert s["samples_5m"] == 1000
+
+
+def test_recorder_feeds_the_watchdog_per_batch():
+    metrics = PrometheusMetrics()
+    recorder = DeviceStatsRecorder(metrics)
+    wd = SloWatchdog(budget_ms=2.0)
+    recorder.slo = wd
+    import time
+
+    t = time.perf_counter()
+    recorder.record_batch(
+        [(t - 0.005, None, None), (t - 0.0001, None, None)],
+        batch_id=1, t_flush=t, phases={"device_sync": 0.001},
+    )
+    s = wd.status()
+    assert s["samples_5m"] == 2
+    assert s["burn_rate_5m"] > 0  # the 5ms decision burned budget
+
+
+def test_device_backed_runtime_matches_jax(pipeline):
+    import jax
+
+    backed = device_backed_runtime()
+    assert backed is not None  # jax is imported in this process
+    assert backed == (jax.devices()[0].platform not in ("", "cpu"))
+
+
+# -- /debug/stats schema under live mixed traffic ----------------------------
+
+
+def test_debug_stats_schema_under_mixed_traffic_and_recycle():
+    """Every section — admission, plan_cache, native_build,
+    native_hot_lane, lease, native_telemetry, slo (+ device_backed,
+    flight_recorder) — present and JSON-serializable under live mixed
+    traffic, including after an interner-recycle context swap."""
+    if not native.available():
+        pytest.skip(f"native hostpath unavailable: {native.build_error()}")
+    if not native.tel_available():
+        pytest.skip("native telemetry exports unavailable")
+    if not native.lease_available():
+        pytest.skip("native lease exports unavailable")
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu.admission import (
+        AdaptiveLimiter,
+        AdmissionController,
+    )
+    from limitador_tpu.lease import LeaseConfig
+    from limitador_tpu.server.http_api import make_http_app
+
+    metrics = PrometheusMetrics()
+    p, limiter = _build_pipeline(metrics)
+    storage = limiter.storage.counters
+    adm = AdmissionController(
+        mode="enforce", overload=AdaptiveLimiter(max_inflight=64)
+    )
+    storage.set_admission(adm)
+    plane = NativePlane(slow_row_us=0.001, trace_sample=4)
+    plane.attach_recorder(limiter.recorder)
+    metrics.attach_native_plane(plane)
+    broker = p.attach_lease(
+        LeaseConfig(max_tokens=32, hot_threshold=2), autostart=False
+    )
+
+    def drive_mixed():
+        hot = _blobs(512, users=32)
+        cold = _blobs(64, users=10_000, domain="api")
+        unknown = _blobs(8, domain="elsewhere")
+        mixed = hot + cold + unknown + [_multi_descriptor_blob()]
+        for _ in range(3):
+            p.decide_many(mixed, chunk=len(mixed))
+        broker.refresh()  # grant leases to the hot plans
+        p.decide_many(hot, chunk=len(hot))  # leased admissions
+
+    drive_mixed()
+
+    async def fetch():
+        app = make_http_app(
+            limiter, metrics, {},
+            debug_sources=[storage, p, plane],
+        )
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        resp = await client.get("/debug/stats")
+        body = await resp.text()
+        await client.close()
+        return resp.status, body
+
+    required = (
+        "queues", "shards", "flush_reasons", "flight_recorder",
+        "admission", "plan_cache", "native_build", "native_hot_lane",
+        "lease", "native_telemetry", "slo",
+    )
+
+    def check():
+        loop = asyncio.new_event_loop()
+        try:
+            status, body = loop.run_until_complete(fetch())
+        finally:
+            loop.close()
+        assert status == 200
+        stats = json.loads(body)  # round-trips = JSON-serializable
+        for section in required:
+            assert section in stats, f"missing section {section!r}"
+        assert "device_backed" in stats
+        assert json.dumps(stats)
+        tel = stats["native_telemetry"]
+        for phase in PHASES:
+            assert phase in tel
+        assert tel["hot_lookup"]["count"] > 0
+        assert stats["slo"]["budget_ms"] == 2.0
+        assert stats["native_hot_lane"]["hits"] > 0
+        assert stats["lease"]["lease_grants"] >= 0
+        return stats
+
+    check()
+    # interner-recycle context swap: the next begin swaps in a fresh
+    # native context (mirror + leases settle through on_context_swap);
+    # every section must survive it
+    p.max_interned = 0
+    drive_mixed()
+    stats = check()
+    assert stats["native_telemetry"]["hot_lookup"]["count"] > 0
+    broker.close()
+    native.tel_config(False)

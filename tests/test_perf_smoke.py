@@ -53,6 +53,17 @@ LEASE_HIT_BUDGET_NS = 1000.0
 #: regression to one device launch PER candidate measures ~2-3 ms
 #: each.
 LEASE_REFRESH_BUDGET_US = 1500.0
+#: telemetry-on vs telemetry-off hot-lane overhead cap (ISSUE 7
+#: acceptance): interleaved same-process begin+finish passes, best-of
+#: per mode. The plane adds ~6 steady_clock reads + a handful of
+#: relaxed atomic adds per BATCH (~0.01% at 4096 rows); 5% catches a
+#: regression to per-ROW timing or locking.
+TEL_OVERHEAD_RATIO = 1.05
+#: per-call budget for the GIL-free hp_tel_drain snapshot (µs): a
+#: fixed-size sum over the telemetry banks (~13 KB of relaxed loads).
+#: Measures ~5-30 µs on the throttled CI box; a regression to
+#: per-observation draining would blow this by orders of magnitude.
+TEL_DRAIN_BUDGET_US = 500.0
 
 
 def _blobs(n, users=512):
@@ -291,6 +302,90 @@ def test_lease_refresh_grant_pass_within_budget():
         f"lease refresh costs {per_cand_us:.0f} µs/candidate "
         f"(budget {LEASE_REFRESH_BUDGET_US} µs — is the debit still "
         "ONE batched launch?)"
+    )
+
+
+def test_native_telemetry_overhead_within_budget(pipeline):
+    """ISSUE 7 acceptance: the native telemetry plane must be near-free
+    on the hot lane. Interleaved same-process passes (tel on, tel off,
+    repeat), best-of per mode — the same discipline every bench ratio
+    uses, because a sequential A-then-B run on a throttled box measures
+    scheduler drift, not the plane."""
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None or not native.tel_available():
+        pytest.skip("native telemetry unavailable")
+    blobs = _blobs(4096)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror the plans
+    epoch = p.plan_cache.epoch
+    admitted = np.ones(len(blobs), bool)
+    hit_ok = np.ones(lane.cap, bool)
+
+    def one_sample():
+        # 3 aggregated passes per sample: a single pass is ~1ms on a
+        # calm box and the scheduler jitter on a loaded CI box is the
+        # same order — aggregation + best-of keeps the comparison about
+        # the plane, not the box.
+        t0 = time.perf_counter()
+        for _ in range(3):
+            staged = lane.begin(blobs, epoch)
+            lane.finish(staged, admitted, hit_ok)
+        return time.perf_counter() - t0, staged
+
+    staged = None
+    try:
+        for mode in (True, False):  # warm both modes (bank first-touch)
+            native.tel_config(mode)
+            _took, staged = one_sample()
+        # Preemption on a loaded suite run swings a sample 2x either
+        # way, so a single best-of comparison can land anywhere within
+        # ±10% by pure scheduler luck. Rounds bound the false-failure
+        # rate instead: the true overhead is ~0.02%/batch, so a calm
+        # round compliant with the 5% cap shows up almost immediately —
+        # while a real regression (per-row timing, a lock: +50% and up)
+        # can never produce one, in any number of rounds.
+        ratios = []
+        for _round in range(4):
+            best = {True: float("inf"), False: float("inf")}
+            for rep in range(6):
+                # alternate which mode goes first so slow drift on a
+                # throttled box cannot systematically favor either
+                order = (True, False) if rep % 2 == 0 else (False, True)
+                for mode in order:
+                    native.tel_config(mode)
+                    took, staged = one_sample()
+                    best[mode] = min(best[mode], took)
+            ratios.append(best[True] / best[False])
+            if ratios[-1] <= TEL_OVERHEAD_RATIO:
+                break
+        assert staged.k == len(blobs), "hot lane must serve all rows"
+        assert min(ratios) <= TEL_OVERHEAD_RATIO, (
+            f"telemetry-on hot lane measured {ratios} x telemetry-off "
+            f"across {len(ratios)} interleaved rounds "
+            f"(cap {TEL_OVERHEAD_RATIO}) — did per-row timing or a "
+            "lock sneak onto the hot path?"
+        )
+    finally:
+        native.tel_config(False)
+
+
+def test_tel_drain_within_budget():
+    """Per-call budget for the GIL-free telemetry snapshot: /metrics
+    renders pay one drain each, so a drain must stay a fixed-size
+    memory sweep."""
+    if not native.available() or not native.tel_available():
+        pytest.skip("native telemetry unavailable")
+    native.tel_drain()  # warm (binds + first-touch)
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        snap = native.tel_drain()
+        best = min(best, time.perf_counter() - t0)
+    assert set(snap) == set(native.TEL_PHASES)
+    per_call_us = best * 1e6
+    assert per_call_us <= TEL_DRAIN_BUDGET_US, (
+        f"hp_tel_drain costs {per_call_us:.0f} µs/call "
+        f"(budget {TEL_DRAIN_BUDGET_US} µs)"
     )
 
 
