@@ -1212,6 +1212,46 @@ def _wait_http(port, proc, stderr_path=None, tries=240):
     raise RuntimeError(f"bench server on :{port} never came up")
 
 
+_LAST_PROBE = {"attempts": 0, "platform": "", "ok": False,
+               "window_s": 0.0}
+
+
+def _record_device_probe(note: str = "") -> None:
+    """Append the headline device-probe outcome to the round's
+    DEVICE_PROBES log (ROADMAP direction 5 evidence hygiene: the probe
+    record used to be written by hand per round — now every
+    device-intended bench run emits it). Path: $BENCH_PROBE_LOG, else
+    DEVICE_PROBES_auto.log next to this file."""
+    import datetime
+    import os
+
+    path = os.environ.get("BENCH_PROBE_LOG") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "DEVICE_PROBES_auto.log",
+    )
+    ts = datetime.datetime.now(datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%SZ"
+    )
+    p = _LAST_PROBE
+    result = (
+        f"OK (platform={p['platform'] or '?'})" if p["ok"]
+        else f"FAIL (last platform={p['platform'] or 'none'!r}; tunnel "
+             "down, backend init hung, or cpu-only fallback)"
+    )
+    line = (
+        f"{ts} probe=auto method='import jax; jax.devices()' "
+        f"attempts={p['attempts']} window={p['window_s']:.0f}s "
+        f"result={result}"
+    )
+    if note:
+        line += f" note={note}"
+    try:
+        with open(path, "a") as f:
+            f.write(line + "\n")
+    except OSError as exc:
+        print(f"probe log append failed: {exc}", file=sys.stderr)
+
+
 def _device_available(window_s: float = None) -> bool:
     """Probe device/backend init in a SUBPROCESS: a dead remote-chip
     tunnel makes jax.devices() hang indefinitely, which would leave the
@@ -1229,6 +1269,7 @@ def _device_available(window_s: float = None) -> bool:
     deadline = time.monotonic() + window_s
     attempt = 0
     backoff = 10.0
+    _LAST_PROBE["window_s"] = window_s
     while True:
         attempt += 1
         try:
@@ -1240,8 +1281,11 @@ def _device_available(window_s: float = None) -> bool:
         except subprocess.TimeoutExpired:
             probe = None
         platform = probe.stdout.strip() if probe is not None else ""
+        _LAST_PROBE.update(attempts=attempt, platform=platform)
         if probe is not None and probe.returncode == 0 and platform != "cpu":
+            _LAST_PROBE["ok"] = True
             return True
+        _LAST_PROBE["ok"] = False
         # rc==0 with platform "cpu" means jax silently fell back to the
         # host backend — that must NOT pass as "device available" or CPU
         # numbers would masquerade as the device headline.
@@ -1421,6 +1465,30 @@ def _scrape_device_metrics(http_port: int) -> dict:
                 )
 
     out = {}
+    # The unified ControlSignals snapshot (observability/signals.py):
+    # GET /debug/signals serves the joined, timestamped vector — embed
+    # it verbatim so every serving bench row carries the observation
+    # plane (ISSUE 8 acceptance), plus the observatory's top tenants.
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/debug/signals", timeout=10
+        ) as resp:
+            payload = json.loads(resp.read().decode())
+        out["signals"] = payload.get("current", {})
+    except Exception:
+        pass  # pre-observatory server / host-only storage: no bus
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{http_port}/debug/top?k=5", timeout=10
+        ) as resp:
+            payload = json.loads(resp.read().decode())
+        out["tenant_top"] = [
+            {k: r.get(k) for k in ("namespace", "limit_name", "key",
+                                   "hits", "utilization")}
+            for r in payload.get("top", [])
+        ]
+    except Exception:
+        pass
     if slo:
         out["slo"] = {k: round(v, 4) for k, v in sorted(slo.items())}
     phase_p99 = {}
@@ -1919,6 +1987,12 @@ def main():
                  "tenants", "sharded", "backends", "grpc", "fleet",
                  "onbox"],
     )
+    parser.add_argument(
+        "--require-device", action="store_true",
+        help="fail loudly (exit 3) when the device probe falls back to "
+        "the CPU backend instead of silently recording CPU numbers as "
+        "the round's headline (ROADMAP direction 5 evidence hygiene)",
+    )
     args = parser.parse_args()
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
@@ -1956,6 +2030,22 @@ def main():
     device_ok = True
     if args.config == "device":
         device_ok = _device_available()
+        # Evidence hygiene: every device-intended run records its probe
+        # outcome in the DEVICE_PROBES log (no more hand-written probe
+        # records per round).
+        _record_device_probe(
+            "" if device_ok else "CPU fallback"
+            + (" refused by --require-device" if args.require_device
+               else " accepted; headline runs on CPU")
+        )
+        if not device_ok and args.require_device:
+            print(
+                "ERROR: --require-device: device backend unavailable "
+                "(probe fell back to CPU) — refusing to record CPU "
+                "numbers as a device round. See the DEVICE_PROBES log.",
+                file=sys.stderr,
+            )
+            sys.exit(3)
         if not device_ok:
             print(
                 "WARNING: device backend unavailable; headline will run on "

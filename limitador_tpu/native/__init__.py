@@ -155,6 +155,14 @@ def _bind(lib) -> None:
         ctypes.c_void_p, ctypes.c_int32,
     ]
     lib.hp_lease_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    # -- tenant usage observatory (drains per-plan leased-admission
+    # counts; observability/usage.py merges them into the heavy-hitter
+    # table) ------------------------------------------------------------
+    lib.hp_usage_drain.restype = ctypes.c_int32
+    lib.hp_usage_drain.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_int32,
+    ]
     # -- native telemetry plane (process-global; observability/
     # native_plane.py drains it) ---------------------------------------
     lib.hp_tel_config.argtypes = [
@@ -670,6 +678,34 @@ class NativeHotLane:
                 "active", "outstanding", "pending_candidates",
                 "pending_returns")
         return dict(zip(keys, out.tolist()))
+
+    def usage_drain(self, cap: int = 1024, blob_cap: int = 1 << 20):
+        """[(blob bytes, leased admissions since last drain)] — the
+        native half of the tenant usage observatory. Leased rows never
+        reach the device's per-slot hit accumulator; the observatory
+        resolves each blob to its plan's slots and merges these counts
+        in. Draining resets the per-plan counts; plans that don't fit
+        the buffers keep theirs for the next drain."""
+        if not self._ctx or not hasattr(self._lib, "hp_usage_drain"):
+            return []
+        blobs = np.empty(blob_cap, np.uint8)
+        lens = np.empty(cap, np.int32)
+        counts = np.empty(cap, np.int64)
+        n = self._lib.hp_usage_drain(
+            self._ctx, blobs.ctypes.data, blob_cap, lens.ctypes.data,
+            counts.ctypes.data, cap,
+        )
+        if n == 0:
+            return []
+        used = int(lens[:n].sum())
+        raw = blobs[:used].tobytes()
+        out = []
+        off = 0
+        for i in range(n):
+            ln = int(lens[i])
+            out.append((raw[off:off + ln], int(counts[i])))
+            off += ln
+        return out
 
     # -- begin / finish ------------------------------------------------------
 

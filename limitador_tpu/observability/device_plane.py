@@ -168,6 +168,12 @@ class DeviceStatsRecorder:
         # per-decision end-to-end latencies record_batch already has in
         # hand, one lock per batch. None = detached, zero cost.
         self.slo = None
+        # Control-signal taps (observability/signals.SignalBus): EWMAs
+        # of the check path's per-flush worst queue wait and fill
+        # ratio, updated in record_flush — two float ops per flush, so
+        # the bus never has to read histograms back out of Prometheus.
+        self.signal_queue_wait_s = 0.0
+        self.signal_batch_fill = 0.0
 
     def next_batch_id(self) -> int:
         return next(self._batch_ids)
@@ -179,8 +185,18 @@ class DeviceStatsRecorder:
         queue_waits: Iterable[float],
         batcher: str = "check",
     ) -> None:
+        queue_waits = list(queue_waits)
         with self._lock:
             self.flush_reasons[reason] = self.flush_reasons.get(reason, 0) + 1
+        if batcher == "check":
+            # Signal taps (racy float EWMAs by design: a torn read
+            # costs one sample of smoothing, never correctness).
+            self.signal_queue_wait_s += 0.2 * (
+                max(queue_waits, default=0.0) - self.signal_queue_wait_s
+            )
+            self.signal_batch_fill += 0.2 * (
+                min(fill_ratio, 1.0) - self.signal_batch_fill
+            )
         if batcher == "check" and self.on_queue_waits is not None:
             try:
                 self.on_queue_waits(queue_waits)

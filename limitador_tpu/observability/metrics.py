@@ -457,6 +457,111 @@ class PrometheusMetrics:
             registry=self.registry,
         )
         self.device_backed.set(-1)
+        # -- tenant usage observatory (observability/usage.py): device-
+        # fed heavy-hitter attribution + quota-pressure telemetry,
+        # polled via the render hook. Registered in usage.METRIC_FAMILIES
+        # (lint cross-checked).
+        self.tenant_hits = Counter(
+            "tenant_hits",
+            "Counter hits attributed per namespace by the usage "
+            "observatory (device accumulator drains + native leased "
+            "admissions)",
+            [NAMESPACE_LABEL],
+            registry=self.registry,
+        )
+        self.tenant_utilization = Histogram(
+            "tenant_utilization",
+            "value/max_value utilization sampled per hot counter at "
+            "each heavy-hitter drain, per namespace (>1.0 = Report-role "
+            "overflow past the limit)",
+            [NAMESPACE_LABEL],
+            registry=self.registry,
+            buckets=(0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0, 1.5),
+        )
+        self.tenant_max_utilization = Gauge(
+            "tenant_max_utilization",
+            "Highest sampled counter utilization per namespace at the "
+            "last heavy-hitter drain",
+            [NAMESPACE_LABEL],
+            registry=self.registry,
+        )
+        self.tenant_near_exhaustion = Gauge(
+            "tenant_near_exhaustion",
+            "Sampled counters at or past the near-exhaustion threshold "
+            "(default 90% of max_value) per namespace at the last drain",
+            [NAMESPACE_LABEL],
+            registry=self.registry,
+        )
+        self.tenant_top_hit_count = Gauge(
+            "tenant_top_hit_count",
+            "Cumulative hit count of the single hottest tracked counter",
+            registry=self.registry,
+        )
+        self.tenant_tracked_counters = Gauge(
+            "tenant_tracked_counters",
+            "Counter identities tracked in the host-side heavy-hitter "
+            "table",
+            registry=self.registry,
+        )
+        # -- unified control-signal bus (observability/signals.py): the
+        # joined observation vector served at /debug/signals, mirrored
+        # as gauges so the adaptive controller's inputs are scrapeable.
+        # Registered in signals.METRIC_FAMILIES (lint cross-checked).
+        self.signal_queue_wait_ms = Gauge(
+            "signal_queue_wait_ms",
+            "Control signal: EWMA of per-flush worst batcher queue "
+            "wait (ms, check path)",
+            registry=self.registry,
+        )
+        self.signal_batch_fill = Gauge(
+            "signal_batch_fill",
+            "Control signal: EWMA of check-batcher flush fill ratio",
+            registry=self.registry,
+        )
+        self.signal_breaker_state = Gauge(
+            "signal_breaker_state",
+            "Control signal: device-plane breaker state (0 closed, 1 "
+            "half-open, 2 open)",
+            registry=self.registry,
+        )
+        self.signal_shed_rate = Gauge(
+            "signal_shed_rate",
+            "Control signal: admission sheds per second between signal "
+            "snapshots, per priority class",
+            ["priority"],
+            registry=self.registry,
+        )
+        self.signal_lease_outstanding_tokens = Gauge(
+            "signal_lease_outstanding_tokens",
+            "Control signal: outstanding quota-lease tokens (the live "
+            "over-admission bound)",
+            registry=self.registry,
+        )
+        self.signal_native_p99_us = Gauge(
+            "signal_native_p99_us",
+            "Control signal: native-plane per-phase p99 (µs), per phase",
+            ["phase"],
+            registry=self.registry,
+        )
+        self.signal_slo_burn_5m = Gauge(
+            "signal_slo_burn_5m",
+            "Control signal: SLO error-budget burn rate over the 5m "
+            "window",
+            registry=self.registry,
+        )
+        self.signal_box_calibration = Gauge(
+            "signal_box_calibration",
+            "Control signal: runtime box calibration score (the bench's "
+            "fixed spin+memcpy normalizer, computed in-process)",
+            registry=self.registry,
+        )
+        self.signal_device_backed = Gauge(
+            "signal_device_backed",
+            "Control signal: device_backed as seen by the signal bus "
+            "(1 device, 0 CPU fallback, -1 unknown)",
+            registry=self.registry,
+        )
+        self.signal_device_backed.set(-1)
         # -- multi-chip dispatch (tpu/sharded.py): launch counts per
         # collective variant, polled baseline-converted off
         # launch_stats()/library_stats. Registered in
@@ -564,15 +669,31 @@ class PrometheusMetrics:
         # server; tests/test_device_plane.py pins the two in sync.
         for variant in ("lean", "coupled", "global"):
             self.sharded_launches.labels(variant)
+        # Pre-seed the bounded signal label sets so the families render
+        # before the first snapshot (signals._PRIORITIES / _PHASES).
+        for priority in PRIORITIES:
+            self.signal_shed_rate.labels(priority)
+        for phase in (
+            "hot_lookup", "hot_stage", "lease_hit", "hot_finish",
+            "h2i_respond",
+        ):
+            self.signal_native_p99_us.labels(phase)
         self._library_sources: list = []
         self._counter_baselines: dict = {}
         self._native_planes: list = []
+        self._render_hooks: list = []
 
     def attach_native_plane(self, plane) -> None:
         """Attach a ``native_plane.NativePlane``; its ``poll(self)``
         runs on every render (native phase histogram merge, slow-row
         exemplar drain, slo_* / device_backed gauge refresh)."""
         self._native_planes.append(plane)
+
+    def attach_render_hook(self, hook) -> None:
+        """Attach any object exposing ``poll(metrics)``; called on
+        every render (the tenant usage observatory and the control-
+        signal bus ride this)."""
+        self._render_hooks.append(hook)
 
     def attach_library_source(self, source) -> None:
         """Attach an object exposing ``library_stats() -> dict``; polled on
@@ -591,6 +712,11 @@ class PrometheusMetrics:
         for plane in self._native_planes:
             try:
                 plane.poll(self)
+            except Exception:
+                pass  # telemetry must never fail a render
+        for hook in self._render_hooks:
+            try:
+                hook.poll(self)
             except Exception:
                 pass  # telemetry must never fail a render
         batcher_size = 0

@@ -1,0 +1,374 @@
+"""Unified control-signal bus (ISSUE 8, ROADMAP direction 4).
+
+The adaptive-control direction needs one OBSERVATION VECTOR: every
+signal the hand-tuned controllers steer by today — admission queue-wait,
+batch fill, breaker state, per-priority shed rates, lease outstanding,
+native-phase p99s, SLO burn — plus the calibration context
+(``box_calibration_score``, ``device_backed``) that makes absolute
+numbers comparable across boxes. Before this module those signals lived
+in five subsystems with five polling surfaces; a controller (or a bench
+row, or an operator) had to join them by hand and got no common
+timestamp.
+
+:class:`ControlSignals` is that joined, timestamped snapshot;
+:class:`SignalBus` owns the sources, computes snapshots on demand,
+keeps a ring-buffered timeline (``GET /debug/signals`` serves both),
+and exports every scalar as a ``signal_*`` Prometheus family at render
+time. ``vector()`` flattens a snapshot into a fixed-order float list —
+exactly the observation the DRL adaptive-rate-limiting controller
+(PAPERS.md) consumes, so direction 4's controller becomes a consumer of
+this plane, not a prerequisite for it.
+
+Sources attach getattr-style and every field degrades to its neutral
+default when a source is absent (a memory-only server still serves
+``/debug/signals`` — with device fields at their defaults) — the
+snapshot SCHEMA is identical across configurations, which is what lets
+the bench scrape it into every row.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+__all__ = [
+    "ControlSignals",
+    "SignalBus",
+    "METRIC_FAMILIES",
+    "box_calibration_score",
+]
+
+#: Prometheus families owned by this module (lint-enforced against the
+#: declarations in observability/metrics.py).
+METRIC_FAMILIES = (
+    "signal_queue_wait_ms",
+    "signal_batch_fill",
+    "signal_breaker_state",
+    "signal_shed_rate",
+    "signal_lease_outstanding_tokens",
+    "signal_native_p99_us",
+    "signal_slo_burn_5m",
+    "signal_box_calibration",
+    "signal_device_backed",
+)
+
+#: priority classes, in the admission plane's order (inlined so a
+#: host-only server never imports the admission package for a schema;
+#: tests pin the two in sync)
+_PRIORITIES = ("low", "normal", "high", "critical")
+
+#: native phases, in observability/native_plane.PHASES order (same
+#: inlining rationale; tests pin the sync)
+_PHASES = ("hot_lookup", "hot_stage", "lease_hit", "hot_finish",
+           "h2i_respond")
+
+
+_BOX_CALIBRATION: Optional[float] = None
+_BOX_LOCK = threading.Lock()
+
+
+def box_calibration_score(cached: bool = True) -> float:
+    """The bench's fixed spin+memcpy box score (bench.py
+    ``box_calibration_score``), computed in-process so runtime signal
+    snapshots carry the same cross-round normalizer bench rows do. Same
+    constants as the bench on purpose — the scores must be comparable.
+    ~100-400 ms once; cached for the process (SignalBus computes it on a
+    background thread at start so no snapshot ever pays it inline)."""
+    global _BOX_CALIBRATION
+    if cached and _BOX_CALIBRATION is not None:
+        return _BOX_CALIBRATION
+    with _BOX_LOCK:
+        if cached and _BOX_CALIBRATION is not None:
+            return _BOX_CALIBRATION
+        src = bytes(4 << 20)
+        dst = bytearray(4 << 20)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            acc = 0
+            for i in range(200_000):  # fixed Python-interpreter spin
+                acc += i ^ (acc & 0xFF)
+            for _ in range(24):  # 96 MB of memcpy
+                dst[:] = src
+            best = min(best, time.perf_counter() - t0)
+        _BOX_CALIBRATION = round(1.0 / best, 3)
+    return _BOX_CALIBRATION
+
+
+class ControlSignals:
+    """One timestamped observation vector. Every field is always
+    present; a field whose source is absent holds its neutral default
+    (0/0.0/empty map, ``device_backed`` -1 for unknown) so consumers
+    never branch on schema."""
+
+    FIELDS = (
+        "ts",
+        "queue_wait_ms",
+        "batch_fill",
+        "breaker_state",
+        "shed_rate_by_priority",
+        "lease_outstanding_tokens",
+        "native_phase_p99_us",
+        "slo_burn_5m",
+        "slo_burn_1h",
+        "slo_breached",
+        "box_calibration_score",
+        "device_backed",
+        "top_namespace",
+        "near_exhaustion",
+    )
+
+    __slots__ = FIELDS
+
+    def __init__(self, **kw):
+        self.ts = kw.get("ts", 0.0)
+        self.queue_wait_ms = kw.get("queue_wait_ms", 0.0)
+        self.batch_fill = kw.get("batch_fill", 0.0)
+        self.breaker_state = kw.get("breaker_state", 0)
+        self.shed_rate_by_priority = kw.get(
+            "shed_rate_by_priority"
+        ) or {p: 0.0 for p in _PRIORITIES}
+        self.lease_outstanding_tokens = kw.get(
+            "lease_outstanding_tokens", 0
+        )
+        self.native_phase_p99_us = kw.get(
+            "native_phase_p99_us"
+        ) or {p: 0.0 for p in _PHASES}
+        self.slo_burn_5m = kw.get("slo_burn_5m", 0.0)
+        self.slo_burn_1h = kw.get("slo_burn_1h", 0.0)
+        self.slo_breached = kw.get("slo_breached", 0)
+        self.box_calibration_score = kw.get("box_calibration_score", 0.0)
+        self.device_backed = kw.get("device_backed", -1)
+        self.top_namespace = kw.get("top_namespace", "")
+        self.near_exhaustion = kw.get("near_exhaustion", 0)
+
+    def to_dict(self) -> dict:
+        return {f: getattr(self, f) for f in self.FIELDS}
+
+    def vector(self) -> List[float]:
+        """Fixed-order numeric flattening — the adaptive controller's
+        observation. Maps expand in declaration order (_PRIORITIES,
+        _PHASES); strings are dropped."""
+        out = [
+            float(self.ts),
+            float(self.queue_wait_ms),
+            float(self.batch_fill),
+            float(self.breaker_state),
+        ]
+        out.extend(
+            float(self.shed_rate_by_priority.get(p, 0.0))
+            for p in _PRIORITIES
+        )
+        out.append(float(self.lease_outstanding_tokens))
+        out.extend(
+            float(self.native_phase_p99_us.get(p, 0.0)) for p in _PHASES
+        )
+        out.extend([
+            float(self.slo_burn_5m),
+            float(self.slo_burn_1h),
+            float(self.slo_breached),
+            float(self.box_calibration_score),
+            float(self.device_backed),
+            float(self.near_exhaustion),
+        ])
+        return out
+
+
+class SignalBus:
+    """Joins the attached sources into :class:`ControlSignals`
+    snapshots and keeps a bounded timeline.
+
+    Attach points (all optional; each enriches the snapshot):
+
+    * ``attach_recorder`` — a DeviceStatsRecorder: per-flush queue-wait
+      / batch-fill EWMAs (``signal_queue_wait_s`` taps fed by
+      ``record_flush``).
+    * ``attach_admission`` — the AdmissionController: breaker state and
+      the per-priority shed counters the rates derive from.
+    * ``attach_pipeline`` — a NativeRlsPipeline: lease outstanding
+      tokens via ``library_stats``.
+    * ``attach_native_plane`` — the NativePlane: per-phase p99s + SLO
+      burn + runtime ``device_backed``.
+    * ``attach_observatory`` — the TenantUsageObservatory: hottest
+      namespace + near-exhaustion count.
+
+    ``snapshot()`` computes a fresh vector and appends it to the ring;
+    the usage observatory's drain thread ticks it so the timeline has a
+    steady cadence even when nobody scrapes. Shed RATES are per-second
+    deltas between consecutive snapshots (counters are cumulative)."""
+
+    #: minimum wall-time between shed-rate baselines (seconds)
+    MIN_RATE_WINDOW_S = 0.5
+
+    def __init__(self, timeline: int = 256, clock=time.time):
+        self._clock = clock
+        self._ring: deque = deque(maxlen=max(int(timeline), 1))
+        self._lock = threading.Lock()
+        self._recorder = None
+        self._admission = None
+        self._pipeline = None
+        self._native_plane = None
+        self._observatory = None
+        # previous cumulative shed counts + timestamp, for the rates;
+        # baselines only advance once per MIN_RATE_WINDOW_S so the four
+        # independent snapshot triggers (drain tick, renders, the two
+        # debug endpoints) can't shrink the window to milliseconds and
+        # quantize the rate into 0-or-spike noise — snapshots inside
+        # the window reuse the last computed rates.
+        self._prev_sheds: Dict[str, int] = {}
+        self._prev_ts: Optional[float] = None
+        self._last_rates: Dict[str, float] = {p: 0.0 for p in _PRIORITIES}
+
+    # -- attachment ----------------------------------------------------------
+
+    def attach_recorder(self, recorder) -> None:
+        self._recorder = recorder
+
+    def attach_admission(self, admission) -> None:
+        self._admission = admission
+
+    def attach_pipeline(self, pipeline) -> None:
+        self._pipeline = pipeline
+
+    def attach_native_plane(self, plane) -> None:
+        self._native_plane = plane
+
+    def attach_observatory(self, observatory) -> None:
+        self._observatory = observatory
+
+    def warm(self) -> None:
+        """Pre-compute the box calibration score off-thread so the
+        first snapshot doesn't pay the ~100-400 ms probe inline."""
+        threading.Thread(
+            target=box_calibration_score, daemon=True,
+            name="signal-calibration",
+        ).start()
+
+    # -- snapshot ------------------------------------------------------------
+
+    def snapshot(self) -> ControlSignals:
+        """Compute one ControlSignals vector from the live sources and
+        append it to the timeline. Every source read is exception-
+        guarded: a failing subsystem costs its field, never the bus."""
+        now = self._clock()
+        kw: dict = {"ts": round(now, 3)}
+        rec = self._recorder
+        if rec is not None:
+            kw["queue_wait_ms"] = round(
+                getattr(rec, "signal_queue_wait_s", 0.0) * 1e3, 4
+            )
+            kw["batch_fill"] = round(
+                getattr(rec, "signal_batch_fill", 0.0), 4
+            )
+        adm = self._admission
+        sheds: Dict[str, int] = {}
+        if adm is not None:
+            try:
+                from ..admission.breaker import BreakerState
+
+                kw["breaker_state"] = BreakerState.GAUGE[adm.breaker.state]
+                with adm._shed_lock:
+                    for (_reason, pname), count in adm._shed_counts.items():
+                        sheds[pname] = sheds.get(pname, 0) + count
+            except Exception:
+                pass
+        pipe = self._pipeline
+        if pipe is not None:
+            try:
+                kw["lease_outstanding_tokens"] = int(
+                    pipe.library_stats().get("lease_outstanding_tokens", 0)
+                )
+            except Exception:
+                pass
+        plane = self._native_plane
+        if plane is not None:
+            try:
+                tel = plane.native_telemetry()
+                kw["native_phase_p99_us"] = {
+                    phase: float(tel.get(phase, {}).get("p99_us", 0.0))
+                    for phase in _PHASES
+                }
+                slo = plane.slo_status()
+                kw["slo_burn_5m"] = slo.get("burn_rate_5m", 0.0)
+                kw["slo_burn_1h"] = slo.get("burn_rate_1h", 0.0)
+                kw["slo_breached"] = 1 if slo.get("breached") else 0
+                backed = plane.device_backed()
+                if backed is not None:
+                    kw["device_backed"] = 1 if backed else 0
+            except Exception:
+                pass
+        obs = self._observatory
+        if obs is not None:
+            try:
+                pressure = obs.pressure()
+                kw["top_namespace"] = pressure.get("top_namespace", "")
+                kw["near_exhaustion"] = int(
+                    pressure.get("near_exhaustion", 0)
+                )
+            except Exception:
+                pass
+        if _BOX_CALIBRATION is not None:
+            kw["box_calibration_score"] = _BOX_CALIBRATION
+        with self._lock:
+            # per-priority shed rates: cumulative-count deltas over at
+            # least MIN_RATE_WINDOW_S of wall time; in-window snapshots
+            # reuse the last computed rates instead of re-baselining.
+            if self._prev_ts is None:
+                self._prev_sheds = dict(sheds)
+                self._prev_ts = now
+            elif now - self._prev_ts >= self.MIN_RATE_WINDOW_S:
+                dt = now - self._prev_ts
+                rates = {p: 0.0 for p in _PRIORITIES}
+                for pname, count in sheds.items():
+                    d = count - self._prev_sheds.get(pname, 0)
+                    if d > 0:
+                        rates[pname] = round(d / dt, 4)
+                self._last_rates = rates
+                self._prev_sheds = dict(sheds)
+                self._prev_ts = now
+            kw["shed_rate_by_priority"] = dict(self._last_rates)
+            signals = ControlSignals(**kw)
+            self._ring.append(signals)
+        return signals
+
+    def timeline(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            items = list(self._ring)
+        if n is not None:
+            items = items[-int(n):]
+        return [s.to_dict() for s in items]
+
+    # -- surfaces ------------------------------------------------------------
+
+    def signals_debug(self) -> dict:
+        """The ``GET /debug/signals`` payload (also the ``signals``
+        section of /debug/stats): a fresh snapshot, its flattened
+        vector, and the ring timeline."""
+        current = self.snapshot()
+        return {
+            "current": current.to_dict(),
+            "vector": current.vector(),
+            "fields": list(ControlSignals.FIELDS),
+            "timeline": self.timeline(),
+        }
+
+    def poll(self, metrics) -> None:
+        """Render-time hook (``PrometheusMetrics.attach_render_hook``):
+        refresh the ``signal_*`` gauge families from a fresh
+        snapshot."""
+        s = self.snapshot()
+        metrics.signal_queue_wait_ms.set(s.queue_wait_ms)
+        metrics.signal_batch_fill.set(s.batch_fill)
+        metrics.signal_breaker_state.set(s.breaker_state)
+        for pname, rate in s.shed_rate_by_priority.items():
+            metrics.signal_shed_rate.labels(pname).set(rate)
+        metrics.signal_lease_outstanding_tokens.set(
+            s.lease_outstanding_tokens
+        )
+        for phase, p99 in s.native_phase_p99_us.items():
+            metrics.signal_native_p99_us.labels(phase).set(p99)
+        metrics.signal_slo_burn_5m.set(s.slo_burn_5m)
+        metrics.signal_box_calibration.set(s.box_calibration_score)
+        metrics.signal_device_backed.set(s.device_backed)

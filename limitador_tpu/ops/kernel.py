@@ -62,7 +62,7 @@ buckets H into powers of two so XLA compiles a handful of programs total.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -80,6 +80,7 @@ __all__ = [
     "credit_batch",
     "read_slots",
     "clear_slots",
+    "drain_top_hits",
     "rebase_epoch",
     "rebase_epoch_chunked",
     "MAX_VALUE_CAP",
@@ -96,10 +97,19 @@ _NEVER = jnp.iinfo(jnp.int32).max
 
 
 class CounterTableState(NamedTuple):
-    """Device-resident counter table. Row C is the padding scratch cell."""
+    """Device-resident counter table. Row C is the padding scratch cell.
+
+    ``hits`` is the per-slot traffic accumulator (ISSUE 8 tenant usage
+    observatory): every real hit a check or update batch lands on a slot
+    — admitted or rejected — bumps it inside the SAME scatter the value
+    write rides, so heavy-hitter accounting costs zero extra kernel
+    launches. ``drain_top_hits`` reads-and-resets it periodically into a
+    host-side top-K table. None on legacy states (pre-accumulator
+    constructions); all kernels pass it through untouched then."""
 
     values: jax.Array     # int32[C+1]
     expiry_ms: jax.Array  # int32[C+1], relative to the host epoch
+    hits: Optional[jax.Array] = None  # int32[C+1] hit-count accumulator
 
 
 class BatchResult(NamedTuple):
@@ -113,6 +123,7 @@ def make_table(capacity: int) -> CounterTableState:
     return CounterTableState(
         values=jnp.zeros((capacity + 1,), dtype=jnp.int32),
         expiry_ms=jnp.zeros((capacity + 1,), dtype=jnp.int32),
+        hits=jnp.zeros((capacity + 1,), dtype=jnp.int32),
     )
 
 
@@ -154,6 +165,7 @@ def check_and_update_core(
     vote_combine=None,
     base_hook=None,
     tat_floor_hook=None,
+    hits=None,
 ):
     """Shared admission + scatter body (see module docstring).
 
@@ -181,8 +193,16 @@ def check_and_update_core(
     values lane is unspecified for bucket cells (reads derive spent from
     the TAT; the kernel writes 0).
 
-    Returns (new_values, new_expiry, admitted[num_req], ok, remaining,
-    ttl_ms) with the last three in input hit order.
+    ``hits`` is the per-slot traffic accumulator: every non-padding hit
+    (admitted or not — rejected traffic is exactly what heavy-hitter
+    attribution wants) bumps its slot by 1 via one extra segment count
+    riding the existing sorted order and one extra scatter-set — no
+    extra launch, no extra device round trip. Fresh slots restart from
+    the batch's own count (the old occupant's traffic must not
+    attribute to the new tenant). None = passthrough (legacy states).
+
+    Returns (new_values, new_expiry, new_hits, admitted[num_req], ok,
+    remaining, ttl_ms) with the last three in input hit order.
     """
     H = slots.shape[0]
 
@@ -348,9 +368,25 @@ def check_and_update_core(
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
 
+    # Per-slot traffic accumulator: one more segment count over the
+    # already-sorted hits + one more end-of-segment scatter. Padding
+    # hits aggregate on the scratch row, which is re-zeroed below.
+    if hits is None:
+        new_hits = None
+    else:
+        seg_count = jax.ops.segment_sum(
+            jnp.ones_like(s_slot), seg_id, num_segments=H,
+            indices_are_sorted=True,
+        )
+        base_hits = jnp.where(h_fresh, 0, hits[s_slot])
+        hit_count = jnp.minimum(base_hits + seg_count[seg_id], _NEVER)
+        idx_hits = jnp.where(is_end, s_slot, scratch)
+        new_hits = hits.at[idx_hits].set(hit_count).at[-1].set(0)
+
     return (
         new_values,
         new_expiry,
+        new_hits,
         admitted,
         ok_sorted[inv_order],
         remaining[inv_order],
@@ -378,11 +414,15 @@ def check_and_update_impl(
     makes the stable sort in the core preserve request order within a
     slot.
     """
-    nv, ne, admitted, ok, remaining, ttl = check_and_update_core(
+    nv, ne, nh, admitted, ok, remaining, ttl = check_and_update_core(
         state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
         req_ids, fresh, bucket, now_ms, num_req=slots.shape[0],
+        hits=state.hits,
     )
-    return CounterTableState(nv, ne), BatchResult(admitted, ok, remaining, ttl)
+    return (
+        CounterTableState(nv, ne, nh),
+        BatchResult(admitted, ok, remaining, ttl),
+    )
 
 
 check_and_update_batch = functools.partial(jax.jit, donate_argnums=(0,))(
@@ -400,7 +440,8 @@ def update_core(
     bucket: jax.Array,
     now_ms: jax.Array,
     tat_floor_hook=None,
-) -> Tuple[jax.Array, jax.Array]:
+    hits=None,
+) -> Tuple[jax.Array, jax.Array, Optional[jax.Array]]:
     """Unconditional increments (the reference's ``update_counter`` path):
     apply every delta, resetting expired windows, no admission check.
     Traceable core shared by the single-chip ``update_batch`` wrapper and
@@ -433,7 +474,13 @@ def update_core(
     saturated cell can never re-admit against a cap-sized max_value.
     Negative deltas would corrupt the lane split (shift/mask of a
     negative int32); they are rejected host-side and clamped here as a
-    backstop."""
+    backstop.
+
+    ``hits`` is the per-slot traffic accumulator (see
+    ``check_and_update_core``): the Report/update lane's hits count as
+    traffic too, so the same segment count + end-of-segment scatter
+    rides here; None = passthrough. Returns (new_values, new_expiry,
+    new_hits)."""
     H = slots.shape[0]
     scratch = values.shape[0] - 1
     order, s_slot, _is_start, is_end, seg_id = _sort_segments(slots)
@@ -501,7 +548,15 @@ def update_core(
     new_expiry = expiry.at[idx_exp].set(exp_new)
     new_values = new_values.at[-1].set(0)
     new_expiry = new_expiry.at[-1].set(0)
-    return new_values, new_expiry
+    if hits is None:
+        new_hits = None
+    else:
+        seg_count = seg_sum(jnp.ones_like(s_slot))
+        base_hits = jnp.where(h_fresh, 0, hits[s_slot])
+        hit_count = jnp.minimum(base_hits + seg_count[seg_id], _NEVER)
+        idx_hits = jnp.where(is_end, s_slot, scratch)
+        new_hits = hits.at[idx_hits].set(hit_count).at[-1].set(0)
+    return new_values, new_expiry, new_hits
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -514,11 +569,11 @@ def update_batch(
     bucket: jax.Array,
     now_ms: jax.Array,
 ) -> CounterTableState:
-    nv, ne = update_core(
+    nv, ne, nh = update_core(
         state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
-        bucket, now_ms,
+        bucket, now_ms, hits=state.hits,
     )
-    return CounterTableState(nv, ne)
+    return CounterTableState(nv, ne, nh)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -562,7 +617,9 @@ def credit_batch(
     # Scratch cell stays inert (it absorbed the padding writes).
     values = values.at[-1].set(0)
     expiry = expiry.at[-1].set(0)
-    return CounterTableState(values, expiry)
+    # Credits are settlement, not traffic: the hit accumulator rides
+    # through untouched.
+    return CounterTableState(values, expiry, state.hits)
 
 
 @jax.jit
@@ -580,7 +637,26 @@ def read_slots(
 def clear_slots(state: CounterTableState, slots: jax.Array) -> CounterTableState:
     values = state.values.at[slots].set(0)
     expiry = state.expiry_ms.at[slots].set(0)
-    return CounterTableState(values, expiry)
+    # A cleared (deleted) slot's traffic history dies with its counter —
+    # the next occupant must not inherit the attribution.
+    hits = None if state.hits is None else state.hits.at[slots].set(0)
+    return CounterTableState(values, expiry, hits)
+
+
+@functools.partial(jax.jit, static_argnums=(1,), donate_argnums=(0,))
+def drain_top_hits(
+    hits: jax.Array, k: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Read-and-reset the per-slot hit accumulator: the K hottest slots
+    since the last drain, decided ON DEVICE so only 2K ints cross the
+    host link instead of the whole column. Donated: the zeroed
+    accumulator reuses the buffer in place. Returns (zeroed_hits,
+    counts[k] descending, slots[k]); entries with count 0 are filler
+    (fewer than k slots saw traffic) — callers filter. The scratch row
+    is excluded (it only ever absorbs padding writes and is kept 0 by
+    the kernels anyway)."""
+    counts, slots = lax.top_k(hits[:-1], k)
+    return jnp.zeros_like(hits), counts, slots
 
 
 def rebase_epoch_chunked(expiry_ms: jax.Array, shift: int) -> jax.Array:
@@ -600,5 +676,6 @@ def rebase_epoch(state: CounterTableState, shift_ms: jax.Array) -> CounterTableS
     (prevents int32 overflow on long uptimes). Already-expired cells clamp
     at 0 and stay expired."""
     return CounterTableState(
-        state.values, jnp.maximum(state.expiry_ms - shift_ms, 0)
+        state.values, jnp.maximum(state.expiry_ms - shift_ms, 0),
+        state.hits,
     )

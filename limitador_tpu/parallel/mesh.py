@@ -56,7 +56,7 @@ HLO regression test in tests/test_sharded.py pins this).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +74,7 @@ __all__ = [
     "sharded_check_and_update",
     "sharded_update",
     "sharded_clear_cells",
+    "sharded_drain_top_hits",
 ]
 
 _NEVER = jnp.iinfo(jnp.int32).max
@@ -99,8 +100,15 @@ def _shard_map(fn, *, mesh, in_specs, out_specs):
 
 
 class ShardedCounterState(NamedTuple):
+    """``hits`` is the per-slot traffic accumulator (shard-local counts;
+    a global counter's traffic lands in each hitting shard's row —
+    drains sum it host-side). ``make_sharded_table`` always creates it;
+    the sharded kernels below require it present (None is tolerated
+    only as a passthrough on rebase/clear for legacy states)."""
+
     values: jax.Array     # int32[n_shards, L+1] sharded over "shard"
     expiry_ms: jax.Array  # int32[n_shards, L+1] sharded over "shard"
+    hits: Optional[jax.Array] = None  # int32[n_shards, L+1]
 
 
 class ShardedBatchResult(NamedTuple):
@@ -131,11 +139,11 @@ def make_sharded_table(
     make = lambda: jax.device_put(
         jnp.zeros((n, local_capacity + 1), jnp.int32), sharding
     )
-    return ShardedCounterState(values=make(), expiry_ms=make())
+    return ShardedCounterState(values=make(), expiry_ms=make(), hits=make())
 
 
-def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
-                fresh, bucket, is_global, now_ms, num_req, axis,
+def _local_step(values, expiry, hits, slots, deltas, maxes, windows,
+                req_ids, fresh, bucket, is_global, now_ms, num_req, axis,
                 global_region, coupled, has_global):
     """Per-device admission over the local shard; runs inside shard_map.
 
@@ -172,7 +180,7 @@ def _local_step(values, expiry, slots, deltas, maxes, windows, req_ids,
     return check_and_update_core(
         values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
         bucket, now_ms, num_req, vote_combine=vote_combine,
-        base_hook=base_hook,
+        base_hook=base_hook, hits=hits,
     )
 
 
@@ -213,30 +221,31 @@ def sharded_check_and_update(
     n, H = slots.shape
     num_req = n * H if coupled else H
 
-    def fn(values, expiry, slots, deltas, maxes, windows, req_ids, fresh,
-           bucket, is_global):
-        (nv, ne, admitted, ok, remaining, ttl) = _local_step(
-            values[0], expiry[0], slots[0], deltas[0], maxes[0], windows[0],
-            req_ids[0], fresh[0], bucket[0], is_global[0], now_ms, num_req,
-            axis, global_region, coupled, has_global,
+    def fn(values, expiry, hits, slots, deltas, maxes, windows, req_ids,
+           fresh, bucket, is_global):
+        (nv, ne, nh, admitted, ok, remaining, ttl) = _local_step(
+            values[0], expiry[0], hits[0], slots[0], deltas[0], maxes[0],
+            windows[0], req_ids[0], fresh[0], bucket[0], is_global[0],
+            now_ms, num_req, axis, global_region, coupled, has_global,
         )
         if not coupled:
             admitted = admitted[None]  # [1, H]: this shard's verdicts
         return (
-            nv[None], ne[None], admitted, ok[None], remaining[None], ttl[None]
+            nv[None], ne[None], nh[None], admitted, ok[None],
+            remaining[None], ttl[None]
         )
 
     spec = P(axis, None)
     admitted_spec = P() if coupled else spec
-    nv, ne, admitted, ok, remaining, ttl = _shard_map(
+    nv, ne, nh, admitted, ok, remaining, ttl = _shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec,) * 10,
-        out_specs=(spec, spec, admitted_spec, spec, spec, spec),
-    )(state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
-      req_ids, fresh, bucket, is_global)
+        in_specs=(spec,) * 11,
+        out_specs=(spec, spec, spec, admitted_spec, spec, spec, spec),
+    )(state.values, state.expiry_ms, state.hits, slots, deltas,
+      maxes, windows_ms, req_ids, fresh, bucket, is_global)
     return (
-        ShardedCounterState(nv, ne),
+        ShardedCounterState(nv, ne, nh),
         ShardedBatchResult(admitted, ok, remaining, ttl),
     )
 
@@ -256,19 +265,34 @@ def sharded_clear_cells(
     un-donated ``.at[].set`` copy of the whole [n, L+1] table (which is
     what this replaces). Padding entries point at the scratch row L,
     which the kernel keeps zero anyway. Zeroing a GLOBAL slot everywhere
-    = broadcast the slot list to every row of ``slots``."""
+    = broadcast the slot list to every row of ``slots``. The hit
+    accumulator clears with the cell (a recycled slot must not inherit
+    the old occupant's traffic attribution)."""
+    spec = P(axis, None)
+    if state.hits is None:  # legacy state: no accumulator to clear
 
-    def fn(values, expiry, slots):
+        def fn2(values, expiry, slots):
+            return (
+                values[0].at[slots[0]].set(0)[None],
+                expiry[0].at[slots[0]].set(0)[None],
+            )
+
+        nv, ne = _shard_map(
+            fn2, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec, spec),
+        )(state.values, state.expiry_ms, slots)
+        return ShardedCounterState(nv, ne)
+
+    def fn(values, expiry, hits, slots):
         return (
             values[0].at[slots[0]].set(0)[None],
             expiry[0].at[slots[0]].set(0)[None],
+            hits[0].at[slots[0]].set(0)[None],
         )
 
-    spec = P(axis, None)
-    nv, ne = _shard_map(
-        fn, mesh=mesh, in_specs=(spec,) * 3, out_specs=(spec, spec),
-    )(state.values, state.expiry_ms, slots)
-    return ShardedCounterState(nv, ne)
+    nv, ne, nh = _shard_map(
+        fn, mesh=mesh, in_specs=(spec,) * 4, out_specs=(spec, spec, spec),
+    )(state.values, state.expiry_ms, state.hits, slots)
+    return ShardedCounterState(nv, ne, nh)
 
 
 @functools.partial(
@@ -290,19 +314,45 @@ def sharded_update(
     scatter-adds, no admission, no cross-device coupling — a global
     counter's delta simply lands in one shard's partial."""
 
-    def fn(values, expiry, slots, deltas, windows, fresh, bucket):
-        nv, ne = update_core(
+    def fn(values, expiry, hits, slots, deltas, windows, fresh, bucket):
+        nv, ne, nh = update_core(
             values[0], expiry[0], slots[0], deltas[0], windows[0], fresh[0],
-            bucket[0], now_ms,
+            bucket[0], now_ms, hits=hits[0],
         )
-        return nv[None], ne[None]
+        return nv[None], ne[None], nh[None]
 
     spec = P(axis, None)
-    nv, ne = _shard_map(
+    nv, ne, nh = _shard_map(
         fn,
         mesh=mesh,
-        in_specs=(spec,) * 7,
-        out_specs=(spec, spec),
-    )(state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
-      bucket)
-    return ShardedCounterState(nv, ne)
+        in_specs=(spec,) * 8,
+        out_specs=(spec, spec, spec),
+    )(state.values, state.expiry_ms, state.hits, slots, deltas,
+      windows_ms, fresh, bucket)
+    return ShardedCounterState(nv, ne, nh)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "k"), donate_argnums=(1,),
+)
+def sharded_drain_top_hits(
+    mesh: Mesh,
+    hits: jax.Array,  # int32[n, L+1] the state's accumulator (donated)
+    k: int,
+    axis: str = "shard",
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-shard read-and-reset of the hit accumulator: each shard's K
+    hottest local slots, decided on its own device — no collective, and
+    only 2*K ints per shard cross the host link. Returns (zeroed_hits,
+    counts[n, k] descending per shard, slots[n, k]); count-0 entries
+    are filler. The host merges shards (and sums the psum global
+    region's per-shard counts) with full slot->counter attribution."""
+
+    def fn(hits):
+        counts, slots = lax.top_k(hits[0][:-1], k)
+        return jnp.zeros_like(hits), counts[None], slots[None]
+
+    spec = P(axis, None)
+    return _shard_map(
+        fn, mesh=mesh, in_specs=(spec,), out_specs=(spec, spec, spec),
+    )(hits)

@@ -442,6 +442,25 @@ def build_parser() -> argparse.ArgumentParser:
         "tracks at p99 over 5m/1h windows (slo_* gauges, /debug/stats "
         "slo section)",
     )
+    p.add_argument(
+        "--usage-topk", type=int,
+        default=int(_env("TPU_USAGE_TOPK", "64")),
+        help="heavy-hitter slots drained per pass by the tenant usage "
+        "observatory (GET /debug/top, tenant_* metrics; 0 disables the "
+        "observatory)",
+    )
+    p.add_argument(
+        "--usage-drain-interval", type=float,
+        default=float(_env("TPU_USAGE_DRAIN_S", "1.0")),
+        help="seconds between heavy-hitter accumulator drains (also "
+        "the control-signal timeline tick)",
+    )
+    p.add_argument(
+        "--usage-near-threshold", type=float,
+        default=float(_env("TPU_USAGE_NEAR_THRESHOLD", "0.9")),
+        help="value/max_value utilization at which a sampled counter "
+        "counts as near-exhaustion (tenant_near_exhaustion gauge)",
+    )
     return p
 
 
@@ -939,6 +958,51 @@ async def _amain(args) -> int:
             "--lease-mode on requires tpu storage with --pipeline native; "
             "serving without the lease tier")
 
+    # Tenant usage observatory + unified control-signal bus (ISSUE 8):
+    # periodic heavy-hitter drains with slot->counter attribution
+    # (GET /debug/top, tenant_* families) and the joined ControlSignals
+    # observation vector (GET /debug/signals, signal_* families) —
+    # device-backed storages only (the accumulator lives in the device
+    # table).
+    observatory = None
+    signal_bus = None
+    device_storage = getattr(counters_storage, "inner", counters_storage)
+    if args.usage_topk > 0 and hasattr(device_storage, "drain_hot_slots"):
+        from ..observability.signals import SignalBus
+        from ..observability.usage import TenantUsageObservatory
+
+        signal_bus = SignalBus()
+        signal_bus.warm()  # calibration probe off-thread
+        observatory = TenantUsageObservatory(
+            device_storage,
+            pipeline=native_pipeline,
+            top_k=args.usage_topk,
+            interval_s=args.usage_drain_interval,
+            near_threshold=args.usage_near_threshold,
+            signal_bus=signal_bus,
+        )
+        bus_recorder = (
+            getattr(limiter, "recorder", None)
+            or getattr(counters_storage, "recorder", None)
+        )
+        if bus_recorder is not None:
+            signal_bus.attach_recorder(bus_recorder)
+        if admission is not None:
+            signal_bus.attach_admission(admission)
+        if native_pipeline is not None:
+            signal_bus.attach_pipeline(native_pipeline)
+        if native_plane is not None:
+            signal_bus.attach_native_plane(native_plane)
+        signal_bus.attach_observatory(observatory)
+        metrics.attach_render_hook(observatory)
+        metrics.attach_render_hook(signal_bus)
+        observatory.start()
+        log.info(
+            f"tenant usage observatory: top-{args.usage_topk} drained "
+            f"every {args.usage_drain_interval:.1f}s"
+            + (", native leased merge on"
+               if native_pipeline is not None else ""))
+
     authority_server = None
     if args.authority_listen:
         from ..storage.authority import serve_authority
@@ -1049,6 +1113,10 @@ async def _amain(args) -> int:
         debug_sources.append(native_pipeline)
     if native_plane is not None:
         debug_sources.append(native_plane)
+    if observatory is not None:
+        debug_sources.append(observatory)
+    if signal_bus is not None:
+        debug_sources.append(signal_bus)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,
@@ -1137,6 +1205,8 @@ async def _amain(args) -> int:
         )
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
+    if observatory is not None:
+        observatory.close()
     if admission is not None:
         await admission.close()
     if native_pipeline is not None:

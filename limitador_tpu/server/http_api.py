@@ -17,6 +17,11 @@ Beyond the reference surface, the device-plane debug endpoints
     GET  /debug/stats       batcher queue depths, per-shard counter-table
                             occupancy, flush-reason tallies, the slowest-N
                             decision flight recorder
+    GET  /debug/top         tenant usage observatory: true top-K hottest
+                            counters with namespace/limit/key attribution
+                            and utilization (?k=N trims)
+    GET  /debug/signals     unified ControlSignals snapshot + flattened
+                            observation vector + ring timeline
     GET  /debug/profile     jax.profiler capture status
     POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
                             toggles an on-demand jax.profiler trace
@@ -46,7 +51,43 @@ from ..observability.metrics_layer import installed as _metrics_layer_installed
 from ..storage.base import StorageError
 from .rls import RATE_LIMIT_HEADERS_DRAFT03
 
-__all__ = ["make_http_app", "run_http_server"]
+__all__ = [
+    "make_http_app",
+    "run_http_server",
+    "DEBUG_STATS_SECTIONS",
+    "DEBUG_SOURCE_SECTIONS",
+]
+
+#: /debug/stats sections sourced from debug_sources by named callable:
+#: (section key, source attribute). Adding a pair here both serves the
+#: section and registers it — tools/lint.py's debug-section cross-check
+#: fails on a section served outside DEBUG_STATS_SECTIONS.
+DEBUG_SOURCE_SECTIONS = (
+    ("native_telemetry", "native_telemetry"),
+    ("slo", "slo_status"),
+    ("device_backed", "device_backed"),
+    ("tenant_usage", "tenant_usage"),
+    ("signals", "signals_debug"),
+)
+
+#: every /debug/stats section THIS module can add on top of
+#: collect_debug_stats' base payload. tools/lint.py cross-checks it both
+#: ways against the actual handler code (every ``stats["..."] =``
+#: literal and every DEBUG_SOURCE_SECTIONS key must be registered here,
+#: and every registered name must be served) — a renamed or orphaned
+#: section fails the gate instead of silently vanishing from the
+#: endpoint its dashboards and benches scrape.
+DEBUG_STATS_SECTIONS = (
+    "profiler",
+    "native_build",
+    "native_hot_lane",
+    "lease",
+    "native_telemetry",
+    "slo",
+    "device_backed",
+    "tenant_usage",
+    "signals",
+)
 
 
 def _limit_dto(limit: Limit) -> dict:
@@ -174,6 +215,29 @@ def _openapi_spec() -> dict:
                                "recorder)",
                     "responses": {
                         "200": {"description": "debug stats"}
+                    },
+                }
+            },
+            "/debug/top": {
+                "get": {
+                    "summary": "Tenant usage observatory: top-K hottest "
+                               "counters with namespace/limit/key "
+                               "attribution and utilization",
+                    "responses": {
+                        "200": {"description": "top counters"},
+                        "404": {"description": "observatory not running"},
+                    },
+                }
+            },
+            "/debug/signals": {
+                "get": {
+                    "summary": "Unified control-signal snapshot (queue "
+                               "wait, batch fill, breaker, sheds, lease "
+                               "outstanding, native p99s, SLO burn, "
+                               "calibration) + ring timeline",
+                    "responses": {
+                        "200": {"description": "control signals"},
+                        "404": {"description": "signal bus not running"},
                     },
                 }
             },
@@ -389,24 +453,57 @@ class _Api:
                 if lease:
                     stats["lease"] = lease
                     break
-        # Native telemetry plane + SLO watchdog + runtime device_backed
-        # probe (observability/native_plane.NativePlane in
-        # debug_sources; each section independent so a partial plane
-        # still reports what it has).
-        for key, attr in (
-            ("native_telemetry", "native_telemetry"),
-            ("slo", "slo_status"),
-            ("device_backed", "device_backed"),
-        ):
-            for source in self.debug_sources:
-                fn = getattr(source, attr, None)
-                if callable(fn):
-                    try:
-                        stats[key] = fn()
-                    except Exception:
-                        pass  # diagnostics must never 500 the endpoint
-                    break
+        # Sections sourced from debug_sources by named callable: the
+        # native telemetry plane / SLO watchdog / device_backed probe,
+        # the tenant usage observatory, and the control-signal bus —
+        # each independent so a partial deployment still reports what
+        # it has (the registry tuple is the lint-checked contract).
+        for key, attr in DEBUG_SOURCE_SECTIONS:
+            source_fn = self._debug_source_fn(attr)
+            if source_fn is not None:
+                try:
+                    stats[key] = source_fn()
+                except Exception:
+                    pass  # diagnostics must never 500 the endpoint
         return web.json_response(stats)
+
+    def _debug_source_fn(self, attr: str):
+        """First debug source exposing a callable ``attr``."""
+        for source in self.debug_sources:
+            fn = getattr(source, attr, None)
+            if callable(fn):
+                return fn
+        return None
+
+    async def get_debug_top(self, request: web.Request) -> web.Response:
+        """Tenant usage observatory: the true top-K hottest counters
+        with namespace/limit/key attribution and utilization (drains
+        the device accumulator first, so nothing is in flight)."""
+        fn = self._debug_source_fn("top_counters")
+        if fn is None:
+            return web.json_response(
+                {"error": "tenant usage observatory not running (tpu "
+                          "storage only)"},
+                status=404,
+            )
+        try:
+            k = int(request.query["k"]) if "k" in request.query else None
+        except ValueError:
+            return web.json_response(
+                {"error": "k must be an integer"}, status=400
+            )
+        return web.json_response(fn(k))
+
+    async def get_debug_signals(self, request: web.Request) -> web.Response:
+        """Unified control-signal bus: the current ControlSignals
+        snapshot, its flattened observation vector, and the ring
+        timeline."""
+        fn = self._debug_source_fn("signals_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "signal bus not running"}, status=404
+            )
+        return web.json_response(fn())
 
     async def get_debug_profile(self, request: web.Request) -> web.Response:
         return web.json_response(self.profiler.status())
@@ -570,6 +667,8 @@ def make_http_app(
     app.router.add_get("/api/spec", api.get_spec)
     app.router.add_get("/metrics", api.get_metrics)
     app.router.add_get("/debug/stats", api.get_debug_stats)
+    app.router.add_get("/debug/top", api.get_debug_top)
+    app.router.add_get("/debug/signals", api.get_debug_signals)
     app.router.add_get("/debug/profile", api.get_debug_profile)
     app.router.add_post("/debug/profile", api.post_debug_profile)
     app.router.add_get("/limits/{namespace}", api.get_limits)

@@ -47,7 +47,8 @@ from typing import List, Tuple
 
 __all__ = [
     "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
-    "lint_ctypes_signatures", "lint_native_phases", "main",
+    "lint_ctypes_signatures", "lint_native_phases",
+    "lint_debug_sections", "main",
 ]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
@@ -64,6 +65,8 @@ REGISTRY_OWNED_PREFIXES = {
     "lease_": "limitador_tpu/lease/__init__.py",
     "native_phase_": "limitador_tpu/observability/native_plane.py",
     "slo_": "limitador_tpu/observability/native_plane.py",
+    "tenant_": "limitador_tpu/observability/usage.py",
+    "signal_": "limitador_tpu/observability/signals.py",
 }
 
 #: the native telemetry plane's phase registry: every entry of this
@@ -72,6 +75,11 @@ REGISTRY_OWNED_PREFIXES = {
 #: module's METRIC_FAMILIES — a phase added to the C enum without its
 #: Prometheus family would silently drop that phase's drain.
 NATIVE_PLANE_MODULE = "limitador_tpu/observability/native_plane.py"
+
+#: the HTTP API module whose /debug/stats sections must be registered
+#: in its DEBUG_STATS_SECTIONS tuple (lint_debug_sections — the
+#: lint_native_phases pattern generalized to the debug surface)
+HTTP_API_MODULE = "limitador_tpu/server/http_api.py"
 
 #: native sources whose extern "C" exports must carry matching ctypes
 #: declarations in the binding modules (symbol prefix filters the
@@ -90,8 +98,9 @@ DONATION_CHECKED_MODULES = (
     "limitador_tpu/tpu/replicated.py",
 )
 
-#: table parameter names that mark a kernel as table-carrying
-DONATION_PARAMS = frozenset({"state", "values", "expiry"})
+#: table parameter names that mark a kernel as table-carrying ("hits"
+#: is the per-slot traffic accumulator column — same in-place contract)
+DONATION_PARAMS = frozenset({"state", "values", "expiry", "hits"})
 
 #: read-only kernels: they take the table but never produce a new one,
 #: so there is nothing to update in place
@@ -229,6 +238,85 @@ def lint_native_phases(repo_root: Path) -> List[str]:
                 f"{plane_path}:0: PHASES entry '{phase}' has no "
                 f"'{family}' entry in METRIC_FAMILIES"
             )
+    return findings
+
+
+def _debug_section_tuples(path: Path, name: str) -> List[str]:
+    """First elements of a module-level ``NAME = (("k", "attr"), ...)``
+    tuple-of-pairs assignment."""
+    try:
+        tree = ast.parse(path.read_text(), filename=str(path))
+    except (OSError, SyntaxError):
+        return []
+    out: List[str] = []
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            continue
+        for elt in node.value.elts:
+            if (
+                isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
+                and isinstance(elt.elts[0], ast.Constant)
+                and isinstance(elt.elts[0].value, str)
+            ):
+                out.append(elt.elts[0].value)
+    return out
+
+
+def lint_debug_sections(repo_root: Path) -> List[str]:
+    """Cross-check the /debug/stats section registry (the
+    lint_native_phases pattern generalized to the debug surface): every
+    section http_api.py serves — a ``stats["..."] = ...`` literal store
+    or a DEBUG_SOURCE_SECTIONS entry — must appear in its
+    DEBUG_STATS_SECTIONS tuple, and every registered name must actually
+    be served. A renamed or orphaned section fails the gate instead of
+    silently vanishing from the endpoint dashboards and benches
+    scrape."""
+    api_path = repo_root / HTTP_API_MODULE
+    if not api_path.exists():
+        return []
+    registered = set(_module_string_tuple(api_path, "DEBUG_STATS_SECTIONS"))
+    served: dict = {}  # name -> lineno
+    for name in _debug_section_tuples(api_path, "DEBUG_SOURCE_SECTIONS"):
+        served.setdefault(name, 0)
+    try:
+        tree = ast.parse(api_path.read_text(), filename=str(api_path))
+    except SyntaxError:
+        return []  # reported by lint_file
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+        ):
+            continue
+        target = node.targets[0]
+        if not (
+            isinstance(target.value, ast.Name)
+            and target.value.id == "stats"
+        ):
+            continue
+        sl = target.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            served.setdefault(sl.value, node.lineno)
+    findings = []
+    for name, lineno in sorted(served.items()):
+        if name not in registered:
+            findings.append(
+                f"{api_path}:{lineno}: /debug/stats section '{name}' is "
+                "served but missing from DEBUG_STATS_SECTIONS"
+            )
+    for name in sorted(registered - set(served)):
+        findings.append(
+            f"{api_path}:0: DEBUG_STATS_SECTIONS entry '{name}' is "
+            "registered but never served by get_debug_stats"
+        )
     return findings
 
 
@@ -608,6 +696,7 @@ def main(argv=None) -> int:
     findings.extend(lint_donation(repo_root))
     findings.extend(lint_ctypes_signatures(repo_root))
     findings.extend(lint_native_phases(repo_root))
+    findings.extend(lint_debug_sections(repo_root))
     for finding in findings:
         print(finding)
     if findings:

@@ -392,6 +392,45 @@ class NativeRlsPipeline:
         out["leases"] = len(broker._leases)
         return out
 
+    def drain_leased_usage(self) -> Dict[int, int]:
+        """Tenant usage observatory (ISSUE 8): per-SLOT counts of
+        admissions answered from live leases since the last drain.
+        Leased rows never reach the device's hit accumulator, so the
+        observatory merges these in for full attribution. The C side
+        reports per-plan (blob, count); each count lands on EVERY slot
+        of the plan — exactly the per-hit accounting a kernel row would
+        have produced. Resolution rides the Python plan cache under the
+        native lock; a plan the cache has since evicted (the mirror may
+        outlive it) drops its counts — bounded by one drain interval."""
+        lane = self._hot_lane
+        cache = self.plan_cache
+        if lane is None or cache is None:
+            return {}
+        out: Dict[int, int] = {}
+        with self._native_lock:
+            if self._hot_lane is not lane:
+                return {}
+            drained = lane.usage_drain()
+            if not drained:
+                return {}
+            entries = cache.entries
+            for blob, count in drained:
+                plan = entries.get(blob)
+                if plan is None:
+                    continue
+                for slot in plan.slots:
+                    out[slot] = out.get(slot, 0) + count
+        return out
+
+    def outstanding_lease_debit(self) -> Dict[int, int]:
+        """Per-slot outstanding leased debit from the broker ledger
+        (the observatory's over-admission context for /debug/top);
+        empty with the tier off."""
+        broker = self.lease_broker
+        if broker is None:
+            return {}
+        return broker.outstanding_by_slot()
+
     def lane_code_templates(self) -> Optional[dict]:
         """(grpc status, payload) per hot-lane outcome code, for the
         native ingress's batch-coded respond path; None when the lane is

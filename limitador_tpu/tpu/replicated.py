@@ -81,12 +81,16 @@ def _replicated_check(state, remote_vals, remote_exp, slots, deltas, maxes,
         # (epoch-relative ms, refreshed at gossip/flush time)
         return K.jnp.where(s_bucket, remote_exp[s_slot], 0)
 
-    nv, ne, admitted, ok, remaining, ttl = K.check_and_update_core(
+    nv, ne, nh, admitted, ok, remaining, ttl = K.check_and_update_core(
         state.values, state.expiry_ms, slots, deltas, maxes, windows_ms,
         req_ids, fresh, bucket, now_ms, num_req=slots.shape[0],
         base_hook=base_hook, tat_floor_hook=tat_floor_hook,
+        hits=state.hits,
     )
-    return K.CounterTableState(nv, ne), K.BatchResult(admitted, ok, remaining, ttl)
+    return (
+        K.CounterTableState(nv, ne, nh),
+        K.BatchResult(admitted, ok, remaining, ttl),
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -106,11 +110,11 @@ def _replicated_update(state, remote_exp, slots, deltas, windows_ms, fresh,
         # (epoch-relative ms, refreshed at gossip/flush time)
         return K.jnp.where(s_bucket, remote_exp[s_slot], 0)
 
-    nv, ne = K.update_core(
+    nv, ne, nh = K.update_core(
         state.values, state.expiry_ms, slots, deltas, windows_ms, fresh,
-        bucket, now_ms, tat_floor_hook=tat_floor_hook,
+        bucket, now_ms, tat_floor_hook=tat_floor_hook, hits=state.hits,
     )
-    return K.CounterTableState(nv, ne)
+    return K.CounterTableState(nv, ne, nh)
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))

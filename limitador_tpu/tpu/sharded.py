@@ -76,6 +76,7 @@ from ..parallel.mesh import (
     make_sharded_table,
     sharded_check_and_update,
     sharded_clear_cells,
+    sharded_drain_top_hits,
     sharded_update,
 )
 from .storage import (
@@ -86,6 +87,7 @@ from .storage import (
     _Request,
     _scatter_rows,
     _SlotTable,
+    hot_attribution,
 )
 
 __all__ = ["TpuShardedStorage", "METRIC_FAMILIES"]
@@ -228,6 +230,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             self._state = ShardedCounterState(
                 self._state.values,
                 K.rebase_epoch_chunked(self._state.expiry_ms, shift),
+                self._state.hits,
             )
             self._epoch += shift / 1000.0
             now -= shift
@@ -359,6 +362,86 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     "collisions": self._gtable.collisions,
                 })
             return {"shards": shards}
+
+    def drain_hot_slots(self, k: int = 64) -> List[dict]:
+        """Sharded heavy-hitter drain (ISSUE 8): one per-shard top-k
+        kernel (no collective; 2*k ints per shard cross the link), then
+        host-side attribution through the per-shard slot tables. A psum
+        global counter's traffic lands in each hitting shard's
+        accumulator row — those counts merge here by slot, attributed
+        through the global table with the read-as-sum value. Returns the
+        merged records hottest-first (at most k)."""
+        with self._lock:
+            hits = self._state.hits
+            if hits is None or k <= 0:
+                return []
+            now_ms = self._now_ms()
+            kk = min(int(k), self._local_capacity)
+            new_hits, counts, slots = sharded_drain_top_hits(
+                self._mesh, hits, kk
+            )
+            self._state = ShardedCounterState(
+                self._state.values, self._state.expiry_ms, new_hits
+            )
+            counts = np.asarray(counts)
+            slots = np.asarray(slots)
+            out: List[dict] = []
+            g_counts: Dict[int, int] = {}
+            loc_sh: List[int] = []
+            loc_sl: List[int] = []
+            loc_count: List[int] = []
+            for s in range(self._n):
+                for j in range(counts.shape[1]):
+                    c = int(counts[s, j])
+                    if c <= 0:
+                        continue
+                    slot = int(slots[s, j])
+                    if slot < self._global_region:
+                        g_counts[slot] = g_counts.get(slot, 0) + c
+                    else:
+                        loc_sh.append(s)
+                        loc_sl.append(slot)
+                        loc_count.append(c)
+            if loc_sl:
+                # Gather ONLY the drained coordinates — never the table.
+                sh = np.asarray(loc_sh, np.int32)
+                sl = np.asarray(loc_sl, np.int32)
+                vals = np.asarray(self._state.values[sh, sl])
+                exps = np.asarray(self._state.expiry_ms[sh, sl])
+                for i in range(sh.shape[0]):
+                    shard, slot = int(sh[i]), int(sl[i])
+                    record = {
+                        "slot": slot, "shard": shard,
+                        "count": loc_count[i],
+                    }
+                    entry = self._tables[shard].info.get(slot)
+                    if entry is not None:
+                        ttl = max(int(exps[i]) - now_ms, 0)
+                        value = int(vals[i]) if ttl > 0 else 0
+                        record.update(
+                            hot_attribution(entry[1], value, ttl)
+                        )
+                    out.append(record)
+            if g_counts:
+                gsl = np.asarray(sorted(g_counts), np.int32)
+                gvals = np.asarray(self._state.values[:, gsl])
+                gexps = np.asarray(self._state.expiry_ms[:, gsl])
+                live = gexps > now_ms
+                value_sum = (gvals * live).sum(axis=0)
+                ttls = np.maximum(gexps.max(axis=0) - now_ms, 0)
+                for i, slot in enumerate(gsl.tolist()):
+                    record = {
+                        "slot": int(slot), "shard": "global",
+                        "count": g_counts[int(slot)],
+                    }
+                    entry = self._gtable.info.get(int(slot))
+                    if entry is not None:
+                        record.update(hot_attribution(
+                            entry[1], int(value_sum[i]), int(ttls[i])
+                        ))
+                    out.append(record)
+            out.sort(key=lambda r: -r["count"])
+            return out[:kk]
 
     # -- the shared batched check path --------------------------------------
 
@@ -1021,7 +1104,9 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         if gslots.size:
             values = values.at[:, gslots].set(np.asarray(data["gvalues"]))
             expiry = expiry.at[:, gslots].set(np.asarray(data["gexpiry"]))
-        self._state = ShardedCounterState(values, expiry)
+        # The hit accumulator is telemetry, not state: restores count
+        # afresh from the constructor's zeros.
+        self._state = ShardedCounterState(values, expiry, self._state.hits)
         for table, dump in zip(self._tables, data["tables"]):
             table.load(dump, self._global_region, self._local_capacity)
         self._gtable.load(data["gtable"], 0, self._global_region)
@@ -1052,6 +1137,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
             self._state = ShardedCounterState(
                 self._state.values.at[sh, sl].set(0),
                 self._state.expiry_ms.at[sh, sl].set(tat),
+                self._state.hits,
             )
         return self
 

@@ -142,6 +142,35 @@ def _scatter_rows(
     return out
 
 
+def hot_attribution(counter: Counter, value: int, ttl_ms: int) -> dict:
+    """Tenant-usage attribution fields for one drained heavy-hitter slot
+    (ISSUE 8): full slot->counter identity plus the utilization sample
+    read at drain time. Shared by the single-chip and sharded drains.
+    ``value`` is the raw values-lane read; bucket counters derive spent
+    tokens from the ttl lane instead (their values lane is
+    unspecified)."""
+    limit = counter.limit
+    if limit.policy == "token_bucket":
+        value = spent_tokens(
+            counter.max_value, counter.window_seconds, ttl_ms
+        )
+    max_value = int(counter.max_value)
+    util = value / max_value if max_value > 0 else 0.0
+    return {
+        "namespace": str(counter.namespace),
+        "limit_name": limit.name,
+        "policy": limit.policy,
+        "max_value": max_value,
+        "seconds": counter.window_seconds,
+        "key": dict(counter.set_variables),
+        "value": int(value),
+        # Unclamped on purpose: >1.0 is real signal (Report-role
+        # unconditional updates can push past max_value).
+        "utilization": round(util, 4),
+        "ttl_s": round(ttl_ms / 1000.0, 3),
+    }
+
+
 def _hit_lane(counter: Counter) -> Tuple[int, bool]:
     """Per-hit (windows_ms lane, bucket flag) for a device-eligible
     counter: the window for fixed windows, the GCRA emission interval
@@ -509,6 +538,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             self._state = K.CounterTableState(
                 self._state.values,
                 K.rebase_epoch_chunked(self._state.expiry_ms, shift),
+                self._state.hits,
             )
             self._epoch += shift / 1000.0
             now -= shift
@@ -571,6 +601,91 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     "collisions": t.collisions,
                 }],
             }
+
+    def drain_hot_slots(self, k: int = 64) -> List[dict]:
+        """Heavy-hitter drain (ISSUE 8 tenant usage observatory):
+        read-and-reset the per-slot hit accumulator and attribute the K
+        hottest slots through the slot table — namespace, limit, key
+        values, hit count, plus a value/max_value utilization sample and
+        ttl read at drain time. One donated top-k kernel + one
+        ``read_slots`` gather, entirely OFF the check path (the
+        accumulator itself rides the existing check/update scatters —
+        zero extra launches there, perf-smoke enforced). Attribution is
+        resolved at drain: a slot recycled within one drain interval
+        attributes its counts to the current occupant (or drops them
+        when the slot is free) — bounded by the drain period, and only
+        under table eviction pressure."""
+        with self._lock:
+            hits = self._state.hits
+            if hits is None or k <= 0:
+                return []
+            now_ms = self._now_ms()
+            new_hits, counts, slots = K.drain_top_hits(
+                hits, min(int(k), self._capacity)
+            )
+            self._state = K.CounterTableState(
+                self._state.values, self._state.expiry_ms, new_hits
+            )
+            counts = np.asarray(counts)
+            slots = np.asarray(slots)
+            live = counts > 0
+            if not live.any():
+                return []
+            slots = slots[live].astype(np.int32)
+            counts = counts[live]
+            values, ttls = K.read_slots(
+                self._state, slots, np.int32(now_ms)
+            )
+            values = np.asarray(values)
+            ttls = np.asarray(ttls)
+            out: List[dict] = []
+            info = self._table.info
+            for i, slot in enumerate(slots.tolist()):
+                record = {"slot": int(slot), "count": int(counts[i])}
+                entry = info.get(slot)
+                if entry is not None:
+                    record.update(hot_attribution(
+                        entry[1], int(values[i]), int(ttls[i])
+                    ))
+                out.append(record)
+            return out
+
+    def attribute_slots(self, slot_counts: Dict[int, int]) -> List[dict]:
+        """Attribution records for externally-counted slot traffic —
+        the native lane's leased admissions never reach the device
+        accumulator, so the usage observatory counts them C-side and
+        resolves them here: same record shape as ``drain_hot_slots``,
+        counts supplied by the caller. Slots whose counter has been
+        released since the counts were taken are dropped (their debit
+        died with the cell)."""
+        if not slot_counts:
+            return []
+        with self._lock:
+            now_ms = self._now_ms()
+            info = self._table.info
+            items = [
+                (slot, count) for slot, count in slot_counts.items()
+                if slot in info
+            ]
+            if not items:
+                return []
+            slots = np.asarray([s for s, _ in items], np.int32)
+            values, ttls = K.read_slots(
+                self._state, slots, np.int32(now_ms)
+            )
+            values = np.asarray(values)
+            ttls = np.asarray(ttls)
+            out: List[dict] = []
+            for i, (slot, count) in enumerate(items):
+                record = {
+                    "slot": int(slot), "count": int(count),
+                    "source": "lease",
+                }
+                record.update(hot_attribution(
+                    info[slot][1], int(values[i]), int(ttls[i])
+                ))
+                out.append(record)
+            return out
 
     # -- the shared batched check path -------------------------------------
 
@@ -1176,11 +1291,15 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                         expiry_ms=self._state.expiry_ms.at[slots].set(
                             K.jnp.asarray(data["expiry"])
                         ),
+                        # telemetry, not state: checkpoints never carry
+                        # the hit accumulator — restarts count afresh
+                        hits=self._state.hits,
                     )
             else:  # round-1 dense checkpoints
                 self._state = K.CounterTableState(
                     values=K.jnp.asarray(data["values"]),
                     expiry_ms=K.jnp.asarray(data["expiry"]),
+                    hits=self._state.hits,
                 )
             self._replace_table()
             self._table.load(table, 0, self._capacity)
@@ -1217,6 +1336,7 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
                     expiry_ms=self._state.expiry_ms.at[idx].set(
                         np.asarray(seed_tats, np.int32)
                     ),
+                    hits=self._state.hits,
                 )
 
     def load_snapshot(self, path: str) -> None:

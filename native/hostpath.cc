@@ -494,6 +494,13 @@ struct PlanEntry {
   int64_t lease_id = -1;
   int32_t lease_size = 0;   // tokens of the current/last grant
   uint32_t hot_count = 0;   // kernel-lane rows since last candidate drain
+  // Tenant usage observatory (ISSUE 8): admissions this plan answered
+  // from a live lease since the last hp_usage_drain. Leased rows never
+  // touch the device, so the kernel's per-slot hit accumulator misses
+  // them — this is the native half the drain merges back in. Distinct
+  // from hot_count on purpose: hot_count resets on lease-candidacy
+  // drains with their own cadence.
+  uint32_t use_leased = 0;
 };
 
 struct BlobRef {
@@ -1047,6 +1054,35 @@ void hp_lease_stats(void* c, int64_t* out) {
   out[7] = (int64_t)m.lease_returns.size();
 }
 
+// ---- tenant usage observatory (ISSUE 8) -----------------------------------
+// Drain per-plan LEASED admission counts accumulated since the last
+// call: leased rows answer with zero device work, so the kernel's
+// per-slot hit accumulator never sees them — the Python observatory
+// resolves each blob back to its plan's device slots and merges these
+// counts into the heavy-hitter table. Blob bytes land concatenated in
+// out_blobs with per-plan lengths/counts; drained plans reset their
+// count. A plan that doesn't fit the caller's buffers KEEPS its count
+// for the next drain (conservation beats completeness here). Runs under
+// the pipeline's native lock, like every other mirror walk.
+int32_t hp_usage_drain(void* c, uint8_t* out_blobs, int64_t blob_cap,
+                       int32_t* out_lens, int64_t* out_counts,
+                       int32_t cap) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  int32_t n = 0;
+  int64_t used = 0;
+  for (auto& e : m.table) {
+    if (e.state != 1 || e.use_leased == 0) continue;
+    if (n >= cap || used + e.blob_len > blob_cap) continue;  // keep count
+    memcpy(out_blobs + used, m.blob_arena.data() + e.blob_off, e.blob_len);
+    out_lens[n] = (int32_t)e.blob_len;
+    out_counts[n] = (int64_t)e.use_leased;
+    e.use_leased = 0;
+    used += e.blob_len;
+    n++;
+  }
+  return n;
+}
+
 // ---- native telemetry plane (ISSUE 7) -------------------------------------
 // Process-global (see the Tel comment above): every context's begins and
 // every finish — including the NULL-ctx finishes that outlive an
@@ -1216,6 +1252,7 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
         e.lease_tokens--;
         m.lease_outstanding--;
         m.leased++;
+        e.use_leased++;
         leased_rows++;
         if (e.lease_tokens == 0) {
           m.lease_active--;

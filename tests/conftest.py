@@ -52,6 +52,9 @@ SERVER_ENV_VARS = frozenset({
     "ADMISSION_MODE", "BREAKER_FAILURES", "BREAKER_STALL_MS",
     "BREAKER_RESET_MS", "ADMISSION_MAX_INFLIGHT",
     "ADMISSION_TARGET_QUEUE_MS", "SHED_RESPONSE", "PRIORITY_KEY",
+    "TPU_NATIVE_TRACE_SAMPLE", "TPU_NATIVE_SLOW_ROW_US",
+    "TPU_SLO_BUDGET_MS",
+    "TPU_USAGE_TOPK", "TPU_USAGE_DRAIN_S", "TPU_USAGE_NEAR_THRESHOLD",
 })
 
 

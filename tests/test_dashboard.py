@@ -91,6 +91,32 @@ def test_dashboard_has_rows_for_the_new_planes():
     assert any("hot lane" in r.lower() for r in rows)
     assert any("lease" in r.lower() for r in rows)
     assert any("slo" in r.lower() for r in rows)
+    assert any("tenant" in r.lower() for r in rows)
+
+
+def test_dashboard_covers_tenant_and_signal_families():
+    """ISSUE 8: the tenant usage observatory and the control-signal bus
+    ship WITH their Grafana row — every tenant_*/signal_* family must be
+    referenced by at least one panel expression."""
+    exprs = "\n".join(dashboard_exprs())
+    for family in (
+        "tenant_hits",
+        "tenant_utilization",
+        "tenant_max_utilization",
+        "tenant_near_exhaustion",
+        "tenant_top_hit_count",
+        "tenant_tracked_counters",
+        "signal_queue_wait_ms",
+        "signal_batch_fill",
+        "signal_breaker_state",
+        "signal_shed_rate",
+        "signal_lease_outstanding_tokens",
+        "signal_native_p99_us",
+        "signal_slo_burn_5m",
+        "signal_box_calibration",
+        "signal_device_backed",
+    ):
+        assert family in exprs, f"no panel queries {family}"
 
 
 def test_dashboard_metrics_all_exported():
@@ -112,6 +138,12 @@ def test_dashboard_metrics_all_exported():
             if ident in names:
                 continue
             if f"{ident}_total" in names or ident.removesuffix("_total") in names:
+                continue
+            # histogram sample suffixes on a labeled family with no
+            # pre-seeded children (per-namespace histograms): the
+            # FAMILY name is the export contract
+            base = re.sub(r"_(bucket|sum|count)$", "", ident)
+            if base in names:
                 continue
             missing.add(ident)
     assert not missing, f"dashboard references unexported metrics: {missing}"

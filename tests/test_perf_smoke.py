@@ -64,6 +64,20 @@ TEL_OVERHEAD_RATIO = 1.05
 #: Measures ~5-30 µs on the throttled CI box; a regression to
 #: per-observation draining would blow this by orders of magnitude.
 TEL_DRAIN_BUDGET_US = 500.0
+#: per-call budget for one heavy-hitter drain pass (ms): ONE donated
+#: top-k kernel + ONE read_slots gather + O(k) host attribution. The
+#: drain holds the storage lock, so a slow drain stalls the flush path
+#: — that is exactly the regression class this budget exists to catch
+#: (a full-table host transfer or per-slot Python measures 10-100x).
+#: Steady state measures ~2-6 ms on the throttled CI box (CPU-jax
+#: top_k over 16k slots).
+USAGE_DRAIN_BUDGET_MS = 50.0
+#: per-call budget for a full /debug/signals render (ControlSignals
+#: snapshot + flattened vector + ring timeline), in MILLISECONDS. Pure
+#: host joins over already-collected state; a regression that puts a
+#: device round trip or a full metrics render inside the snapshot blows
+#: this by an order of magnitude.
+SIGNALS_RENDER_BUDGET_MS = 20.0
 
 
 def _blobs(n, users=512):
@@ -386,6 +400,129 @@ def test_tel_drain_within_budget():
     assert per_call_us <= TEL_DRAIN_BUDGET_US, (
         f"hp_tel_drain costs {per_call_us:.0f} µs/call "
         f"(budget {TEL_DRAIN_BUDGET_US} µs)"
+    )
+
+
+def test_hit_accumulation_adds_no_kernel_launches():
+    """ISSUE 8 acceptance: per-slot hit accumulation rides the EXISTING
+    check launch — a batch through check_many must invoke exactly one
+    check kernel and zero drain/top-k/update/clear kernels. A
+    regression that 'helpfully' drains or clears the accumulator on the
+    decision path doubles every batch's device work."""
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.ops import kernel as K
+    from limitador_tpu.tpu.storage import TpuStorage, _Request
+    from limitador_tpu import Limit
+
+    storage = TpuStorage(capacity=1 << 10)
+    limit = Limit("api", 100, 60, [], [f"{D}.u"])
+    reqs = [
+        _Request([Counter(limit, {"u": f"user-{i % 32}"})], 1, False)
+        for i in range(256)
+    ]
+    storage.check_many(reqs)  # warm: slots + compile
+    calls = {"check": 0, "other": 0}
+    real_check = K.check_and_update_batch
+
+    def counting_check(*a, **kw):
+        calls["check"] += 1
+        return real_check(*a, **kw)
+
+    def counting_other(name, real):
+        def fn(*a, **kw):
+            calls["other"] += 1
+            return real(*a, **kw)
+        return fn
+
+    patched = {"check_and_update_batch": counting_check}
+    for name in ("drain_top_hits", "update_batch", "credit_batch",
+                 "clear_slots"):
+        patched[name] = counting_other(name, getattr(K, name))
+    originals = {}
+    try:
+        for name, fn in patched.items():
+            originals[name] = getattr(K, name)
+            setattr(K, name, fn)
+        storage.check_many(reqs)
+    finally:
+        for name, fn in originals.items():
+            setattr(K, name, fn)
+    assert calls["check"] == 1, (
+        f"check_many launched {calls['check']} check kernels for one "
+        "batch"
+    )
+    assert calls["other"] == 0, (
+        f"{calls['other']} extra kernel launches rode the check path — "
+        "hit accumulation must stay inside the existing launch"
+    )
+
+
+def test_heavy_hitter_drain_within_budget():
+    """ms budget for one drain pass: it holds the storage lock, so it
+    must never stall the flush path behind a full-table transfer or
+    per-slot Python."""
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.tpu.storage import TpuStorage, _Request
+    from limitador_tpu import Limit
+
+    storage = TpuStorage(capacity=1 << 14)
+    limit = Limit("api", 10**6, 60, [], [f"{D}.u"])
+    reqs = [
+        _Request([Counter(limit, {"u": f"user-{i % 512}"})], 1, False)
+        for i in range(4096)
+    ]
+    storage.check_many(reqs)
+    storage.drain_hot_slots(64)  # warm: compiles the top-k program
+    best = float("inf")
+    for _ in range(5):
+        storage.check_many(reqs)  # re-accumulate so the drain has work
+        t0 = time.perf_counter()
+        records = storage.drain_hot_slots(64)
+        best = min(best, time.perf_counter() - t0)
+    assert records, "drain returned nothing for a traffic-bearing table"
+    per_call_ms = best * 1e3
+    assert per_call_ms <= USAGE_DRAIN_BUDGET_MS, (
+        f"heavy-hitter drain costs {per_call_ms:.1f} ms/pass "
+        f"(budget {USAGE_DRAIN_BUDGET_MS} ms — is it still one top-k "
+        "kernel + one gather?)"
+    )
+
+
+def test_signals_render_within_budget():
+    """ms budget for a full /debug/signals payload (snapshot + vector +
+    timeline): pure host joins over already-collected state."""
+    import json
+
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.observability.signals import SignalBus
+    from limitador_tpu.observability.usage import TenantUsageObservatory
+    from limitador_tpu.tpu.storage import TpuStorage, _Request
+    from limitador_tpu import Limit
+
+    storage = TpuStorage(capacity=1 << 12)
+    limit = Limit("api", 10**6, 60, [], [f"{D}.u"])
+    storage.check_many([
+        _Request([Counter(limit, {"u": f"user-{i % 64}"})], 1, False)
+        for i in range(1024)
+    ])
+    bus = SignalBus(timeline=256)
+    obs = TenantUsageObservatory(storage, top_k=32, signal_bus=bus)
+    obs.drain()
+    bus.attach_observatory(obs)
+    for _ in range(256):  # full ring: the worst-case timeline render
+        bus.snapshot()
+    best = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        payload = bus.signals_debug()
+        json.dumps(payload)  # the endpoint serializes it too
+        best = min(best, time.perf_counter() - t0)
+    assert payload["current"] and len(payload["timeline"]) == 256
+    per_call_ms = best * 1e3
+    assert per_call_ms <= SIGNALS_RENDER_BUDGET_MS, (
+        f"/debug/signals render costs {per_call_ms:.1f} ms "
+        f"(budget {SIGNALS_RENDER_BUDGET_MS} ms — did a device round "
+        "trip or metrics render sneak into the snapshot?)"
     )
 
 
