@@ -1,23 +1,33 @@
 # Mirror of the reference's CI gate (.github/workflows/rust.yml:
 # fmt --check + clippy -D warnings + test matrix), for this stack.
 #
-# `test` skips the @pytest.mark.slow chaos/soak scenarios for a fast
-# gate; `test-all` (and `check-all`) runs everything.
+# `lint` is the full static-analysis gate (ISSUE 9): the pass registry
+# in limitador_tpu/tools/analysis/ — style, registry cross-checks,
+# donation, ctypes-ABI drift, lock-order, buffer-safety,
+# tracing-safety (see docs/analysis.md). `race-hunt` builds the
+# sanitizer-instrumented native drivers (TSAN/ASAN/UBSAN) and asserts
+# a clean report — slow, not part of the tier-1 gate.
+#
+# `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
+# a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench
+.PHONY: check check-all lint test test-all bench race-hunt
 
 check: lint test
 
 check-all: lint test-all
 
 lint:
-	python -m limitador_tpu.tools.lint
+	python -m limitador_tpu.tools.analysis --all
 
 test:
 	python -m pytest tests/ -q -m "not slow"
 
 test-all:
 	python -m pytest tests/ -q
+
+race-hunt:
+	python -m pytest tests/test_race_hunt.py -q
 
 bench:
 	python bench.py

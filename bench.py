@@ -109,13 +109,43 @@ def device_backed() -> bool:
     return _DEVICE_BACKED
 
 
+_ANALYSIS_CLEAN = None
+
+
+def analysis_clean() -> bool:
+    """One in-process run of the static-analysis gate (ISSUE 9),
+    cached for the bench process. Recorded on every BENCH row so a
+    round captured from a dirty tree (parked baseline entries, local
+    hacks) is machine-distinguishable from a gate-green one."""
+    global _ANALYSIS_CLEAN
+    if _ANALYSIS_CLEAN is None:
+        try:
+            from limitador_tpu.tools.analysis import repo_root, run_passes
+
+            active, _suppressed = run_passes(repo_root())
+            _ANALYSIS_CLEAN = not active
+        except Exception:
+            _ANALYSIS_CLEAN = False
+    return _ANALYSIS_CLEAN
+
+
+def sanitizer_variant_tag() -> str:
+    """The active TPU_NATIVE_SANITIZE variant ("" = plain -O2 build).
+    A sanitizer-instrumented native plane runs 2-20x slower — rows
+    from such runs must never be read as device-round numbers."""
+    from limitador_tpu.native.build import sanitizer_variant
+
+    return sanitizer_variant() or ""
+
+
 def emit(metric: str, value: float, unit: str, baseline: float,
          ndigits: int = 1, lower_is_better: bool = False, **extra) -> None:
     """One JSON result line. ``vs_baseline`` is uniformly >1-is-better:
     value/baseline for throughput rows, baseline/value when
     ``lower_is_better`` (latency targets). Every row carries the box
-    calibration score (see ``box_calibration_score``) and the
-    ``device_backed`` probe result."""
+    calibration score (see ``box_calibration_score``), the
+    ``device_backed`` probe result, the ``analysis_clean`` gate bit and
+    the active ``sanitizer`` variant (ISSUE 9 bench hygiene)."""
     ratio = (baseline / value) if lower_is_better else (value / baseline)
     payload = {
         "metric": metric,
@@ -126,6 +156,8 @@ def emit(metric: str, value: float, unit: str, baseline: float,
     payload.update(extra)
     payload.setdefault("box_calibration_score", box_calibration_score())
     payload.setdefault("device_backed", device_backed())
+    payload.setdefault("analysis_clean", analysis_clean())
+    payload.setdefault("sanitizer", sanitizer_variant_tag())
     print(json.dumps(payload))
 
 

@@ -1,0 +1,124 @@
+"""GIL-release buffer-safety pass (ISSUE 9 analyzer b).
+
+ctypes releases the GIL around every call into the native libraries, so
+the C side reads its pointer arguments while Python is free to run — a
+buffer must stay referenced from Python for the WHOLE call. The classic
+bug: ``lib.hp_tel_drain(np.empty(n).ctypes.data, n)``. ``.ctypes.data``
+extracts a raw integer address; the temporary array's refcount hits
+zero the moment the argument expression finishes evaluating — BEFORE
+the C call runs — and the allocator is free to reuse the memory under
+the GIL-released call. The same holds for ``.ctypes.data_as(...)`` on
+temporaries and for pointer extraction from ``x.astype(...)`` /
+``x.copy()`` / ``np.ascontiguousarray(x)`` results.
+
+What is safe, and why the pass allows it:
+
+* ``buf.ctypes.data`` where ``buf`` is a local / attribute binding —
+  the binding outlives the call statement;
+* ``buf[a:b].ctypes.data`` — the slice VIEW is a temporary, but the
+  address belongs to ``buf``'s buffer, which the named base keeps
+  alive (walking a Subscript/Attribute chain to a Name is accepted);
+* a numpy array passed DIRECTLY as an argument (ndpointer/c_char_p
+  conversion) — the argument tuple keeps it referenced for the call.
+
+Flagged: any ``.ctypes.data`` / ``.ctypes.data_as(...)`` whose
+ownership chain roots in a Call/BinOp/comprehension — i.e. a value no
+name keeps alive — inside an ``hp_*`` / ``h2i_*`` call's arguments.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from . import Finding, RepoContext, register_pass
+
+__all__ = ["NATIVE_SYMBOL_PREFIXES", "buffer_findings"]
+
+NATIVE_SYMBOL_PREFIXES = ("hp_", "h2i_")
+
+
+def _is_native_call(node: ast.Call) -> Optional[str]:
+    """The native symbol name when this call targets an hp_*/h2i_*
+    export (any receiver: ``lib.hp_x``, ``self._lib.h2i_y``, bare
+    ``hp_x``)."""
+    fn = node.func
+    name = None
+    if isinstance(fn, ast.Attribute):
+        name = fn.attr
+    elif isinstance(fn, ast.Name):
+        name = fn.id
+    if name and name.startswith(NATIVE_SYMBOL_PREFIXES):
+        return name
+    return None
+
+
+def _chain_root(node: ast.AST) -> ast.AST:
+    """Walk an Attribute/Subscript ownership chain to its root: the
+    object whose lifetime owns the pointed-at buffer."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node
+
+
+def _pointer_extractions(arg: ast.AST):
+    """(node, base) for every ``X.ctypes.data`` / ``X.ctypes.data_as(..)``
+    inside an argument expression."""
+    out = []
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Attribute) and node.attr == "data":
+            inner = node.value
+            if isinstance(inner, ast.Attribute) and inner.attr == "ctypes":
+                out.append((node, inner.value))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "data_as"
+        ):
+            inner = node.func.value
+            if isinstance(inner, ast.Attribute) and inner.attr == "ctypes":
+                out.append((node, inner.value))
+    return out
+
+
+def buffer_findings(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in ctx.iter_files():
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        for node in ctx.nodes(path):
+            if not isinstance(node, ast.Call):
+                continue
+            symbol = _is_native_call(node)
+            if symbol is None:
+                continue
+            args = list(node.args) + [k.value for k in node.keywords]
+            for arg in args:
+                for ptr_node, base in _pointer_extractions(arg):
+                    root = _chain_root(base)
+                    if isinstance(root, ast.Name):
+                        continue  # named binding keeps the buffer alive
+                    if ctx.noqa(path, ptr_node.lineno):
+                        continue
+                    findings.append(Finding(
+                        "buffer-safety", rel, ptr_node.lineno,
+                        f"'{symbol}' is handed a pointer into a "
+                        "temporary buffer (.ctypes.data on an unnamed "
+                        "value): the temporary dies before the "
+                        "GIL-released native call completes",
+                        hint="bind the array to a local first "
+                             "(buf = ...; lib.call(buf.ctypes.data, "
+                             "...)) so the binding outlives the call",
+                    ))
+    return findings
+
+
+@register_pass(
+    "buffer-safety",
+    "numpy buffers handed to GIL-released hp_*/h2i_* calls must be "
+    "kept alive by a name, not a temporary",
+)
+def run(ctx: RepoContext) -> List[Finding]:
+    return buffer_findings(ctx)

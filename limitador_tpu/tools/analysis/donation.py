@@ -1,0 +1,135 @@
+"""Buffer-donation pass: table-carrying ``jax.jit`` sites must donate.
+
+Without ``donate_argnums`` XLA copies the whole counter table on every
+launch instead of updating it in place — 8 bytes/slot/batch of silent
+HBM traffic. Ported from ``tools/lint.py`` (PR 4); the ``shard_map``
+half of the check (every shard-mapped table kernel must sit inside a
+donating jit) lives in the tracing-safety pass, which generalizes this
+one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from . import Finding, RepoContext, register_pass
+
+__all__ = [
+    "DONATION_CHECKED_MODULES", "DONATION_PARAMS", "DONATION_EXEMPT",
+    "donation_findings", "is_jax_jit",
+]
+
+#: modules whose jax.jit sites must donate table-carrying buffers
+DONATION_CHECKED_MODULES = (
+    "limitador_tpu/ops/kernel.py",
+    "limitador_tpu/parallel/mesh.py",
+    "limitador_tpu/tpu/replicated.py",
+)
+
+#: table parameter names that mark a kernel as table-carrying ("hits"
+#: is the per-slot traffic accumulator column — same in-place contract)
+DONATION_PARAMS = frozenset({"state", "values", "expiry", "hits"})
+
+#: read-only kernels: they take the table but never produce a new one,
+#: so there is nothing to update in place
+DONATION_EXEMPT = frozenset({"read_slots"})
+
+
+def is_jax_jit(node) -> bool:
+    return (
+        isinstance(node, ast.Attribute) and node.attr == "jit"
+        and isinstance(node.value, ast.Name) and node.value.id == "jax"
+    )
+
+
+def donation_findings(ctx: RepoContext) -> List[Finding]:
+    """Covers the three site shapes the kernels use — ``@jax.jit``,
+    ``@functools.partial(jax.jit, ...)`` and
+    ``functools.partial(jax.jit, ...)(fn)`` — and allowlists the
+    read-only kernels (DONATION_EXEMPT)."""
+    findings: List[Finding] = []
+    for rel in DONATION_CHECKED_MODULES:
+        path = ctx.path(rel)
+        if not path.exists():
+            continue
+        tree = ctx.tree(path)
+        if tree is None:
+            continue  # reported by the style pass
+        funcs = {
+            node.name: node
+            for node in ctx.nodes(path)
+            if isinstance(node, ast.FunctionDef)
+        }
+
+        def check(lineno: int, kwargs, fn_name: str) -> None:
+            fn_node = funcs.get(fn_name)
+            if fn_node is None or fn_name in DONATION_EXEMPT:
+                return
+            params = sorted(
+                {a.arg for a in fn_node.args.args} & DONATION_PARAMS
+            )
+            if not params or "donate_argnums" in kwargs:
+                return
+            if ctx.noqa(path, lineno):
+                return
+            findings.append(Finding(
+                "donation", ctx.rel(path), lineno,
+                f"jax.jit site for table-carrying kernel '{fn_name}' "
+                f"(params {params}) passes no donate_argnums — every "
+                "launch would copy the counter table instead of "
+                "updating it in place",
+                hint="pass donate_argnums covering the table params, "
+                     "or add the kernel to DONATION_EXEMPT if it is "
+                     "read-only",
+            ))
+
+        for node in ctx.nodes(path):
+            if isinstance(node, ast.FunctionDef):
+                for dec in node.decorator_list:
+                    if is_jax_jit(dec):
+                        check(dec.lineno, set(), node.name)
+                    elif isinstance(dec, ast.Call):
+                        kwargs = {k.arg for k in dec.keywords}
+                        if is_jax_jit(dec.func):
+                            check(dec.lineno, kwargs, node.name)
+                        elif (
+                            isinstance(dec.func, ast.Attribute)
+                            and dec.func.attr == "partial"
+                            and dec.args and is_jax_jit(dec.args[0])
+                        ):
+                            check(dec.lineno, kwargs, node.name)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                wrapped = (
+                    node.args[0].id
+                    if node.args and isinstance(node.args[0], ast.Name)
+                    else None
+                )
+                if wrapped is None:
+                    continue
+                if (
+                    isinstance(func, ast.Call)
+                    and isinstance(func.func, ast.Attribute)
+                    and func.func.attr == "partial"
+                    and func.args and is_jax_jit(func.args[0])
+                ):
+                    # functools.partial(jax.jit, ...)(fn)
+                    check(
+                        node.lineno, {k.arg for k in func.keywords}, wrapped
+                    )
+                elif is_jax_jit(func):
+                    # jax.jit(fn, ...)
+                    check(
+                        node.lineno, {k.arg for k in node.keywords}, wrapped
+                    )
+    return findings
+
+
+@register_pass(
+    "donation",
+    "table-carrying jax.jit kernels must pass donate_argnums "
+    "(read-only kernels exempt)",
+)
+def run(ctx: RepoContext) -> List[Finding]:
+    return donation_findings(ctx)

@@ -1,0 +1,613 @@
+"""Lock-order / blocking-in-async analyzer (ISSUE 9 analyzer a).
+
+The hot path spans four threading-lock domains — the storage lock
+(``tpu/storage.py`` / ``tpu/sharded.py`` ``_lock``), the native-lane
+lock (``_native_lock``), the lease-broker lock (``lease/broker.py``
+``_lock``) and the observatory lock (``observability/usage.py``
+``_lock``) — plus the plan-cache lock underneath them. The reference
+Rust implementation gets ordering safety from the borrow checker; here
+the canonical order is a convention::
+
+    broker  ->  native  ->  storage  ->  plan_cache
+
+This pass extracts the actual acquisition graph from the AST (nested
+``with`` statements, plus one-level interprocedural propagation through
+same-class method calls and package-unique function names) and:
+
+* **rejects cycles** between the named domains — a cycle is a deadlock
+  waiting for the right interleaving;
+* flags **``await`` while holding a threading lock** — the event loop
+  parks the coroutine with the lock held, and every other thread on
+  that lock stalls for an unbounded suspension (``asyncio.Lock`` is
+  fine to await and is excluded by construction: only attributes
+  assigned ``threading.Lock()`` / ``threading.RLock()`` count);
+* flags **blocking calls while holding a lock** — ``time.sleep``,
+  ``.wait()`` / ``.wait_for()`` on events/conditions, ``.result()`` on
+  futures, the blocking ``h2i_take`` ctypes export — outside the
+  explicit allowlist below;
+* flags the **observatory drain thread's lock holds**: its drain runs
+  device kernels under the storage lock by design, so the finding
+  exists and is suppressed by an allowlist entry that CITES the
+  perf-smoke budget bounding the hold — an explicit contract, not a
+  silent pass.
+
+Allowlisted findings are reported with ``suppressed_by`` set (visible
+in ``--json`` / ``--show-suppressed``), never dropped.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import Finding, RepoContext, register_pass
+
+__all__ = [
+    "TRACKED_DOMAINS", "CANONICAL_ORDER", "ALLOWLIST", "LockAllow",
+    "lock_order_findings",
+]
+
+#: the named lock domains the acquisition graph is built over
+TRACKED_DOMAINS = (
+    "broker", "native", "storage", "plan_cache", "observatory",
+)
+
+#: the documented canonical acquisition order (outermost first); the
+#: graph may use any PREFIX-compatible subset, never the reverse
+CANONICAL_ORDER = ("broker", "native", "storage", "plan_cache")
+
+#: attribute name -> domain, regardless of receiver (``_native_lock``
+#: is unique to the native pipeline)
+ATTR_DOMAINS = {
+    "_native_lock": "native",
+}
+
+#: (module relpath, "self" attr) -> domain for the generically-named
+#: ``self._lock`` attributes
+MODULE_SELF_DOMAINS = {
+    ("limitador_tpu/tpu/storage.py", "_lock"): "storage",
+    ("limitador_tpu/tpu/sharded.py", "_lock"): "storage",
+    ("limitador_tpu/lease/broker.py", "_lock"): "broker",
+    ("limitador_tpu/observability/usage.py", "_lock"): "observatory",
+    ("limitador_tpu/tpu/plan_cache.py", "_lock"): "plan_cache",
+}
+
+#: receiver NAME -> domain for cross-object acquisitions
+#: (``storage._lock`` / ``self.storage._lock`` from the pipeline,
+#: broker and lease modules all mean the device-table lock)
+OWNER_NAME_DOMAINS = {
+    "storage": "storage",
+}
+
+#: blocking call detection while a lock is held: exact dotted names and
+#: method-attribute names. Kept deliberately narrow — false positives
+#: here train people to allowlist reflexively.
+BLOCKING_DOTTED = {"time.sleep"}
+BLOCKING_ATTRS = {"wait", "wait_for", "result", "h2i_take"}
+
+#: observatory drain entry points: (module relpath, class, method).
+#: Everything their call graph acquires is reported (rule
+#: "drain-thread-lock") so a drain that starts holding a NEW lock
+#: surfaces immediately.
+DRAIN_ENTRY = (
+    "limitador_tpu/observability/usage.py",
+    "TenantUsageObservatory",
+    "drain",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LockAllow:
+    """One explicit allowlist entry: rule + where + the reason the
+    pattern is sound (with the budget/test that enforces it)."""
+
+    rule: str       #: "blocking-under-lock" | "drain-thread-lock"
+    module: str     #: repo-relative module the finding lands in
+    qualname: str   #: enclosing function qualname ("" = any in module)
+    needle: str     #: substring of the finding message ("" = any)
+    reason: str
+
+
+ALLOWLIST: Tuple[LockAllow, ...] = (
+    # The PR 8 usage-drain-holds-storage-lock pattern: the device top-k
+    # drain + slot attribution MUST ride the storage lock (slot
+    # identity), and the leased-usage merge MUST ride the native lock
+    # (mirror liveness). The hold is bounded, not unbounded: perf-smoke
+    # asserts USAGE_DRAIN_BUDGET_MS = 50.0 (tests/test_perf_smoke.py)
+    # so the flush path never stalls past one drain pass.
+    LockAllow(
+        rule="drain-thread-lock",
+        module="limitador_tpu/observability/usage.py",
+        qualname="TenantUsageObservatory.drain",
+        needle="'storage'",
+        reason="by design: device top-k + attribution need slot "
+               "identity under the storage lock; hold bounded by "
+               "USAGE_DRAIN_BUDGET_MS=50.0 (tests/test_perf_smoke.py)",
+    ),
+    LockAllow(
+        rule="drain-thread-lock",
+        module="limitador_tpu/observability/usage.py",
+        qualname="TenantUsageObservatory.drain",
+        needle="'native'",
+        reason="by design: leased-usage merge resolves plans under the "
+               "native lock; same USAGE_DRAIN_BUDGET_MS=50.0 bound",
+    ),
+    LockAllow(
+        rule="drain-thread-lock",
+        module="limitador_tpu/observability/usage.py",
+        qualname="TenantUsageObservatory.drain",
+        needle="'plan_cache'",
+        reason="plan-cache stats/invalidations reached through the "
+               "storage hooks; bounded by the same drain budget",
+    ),
+)
+
+
+def _allow_reason(
+    rule: str, module: str, qualname: str, message: str
+) -> Optional[str]:
+    for entry in ALLOWLIST:
+        if entry.rule != rule or entry.module != module:
+            continue
+        if entry.qualname and entry.qualname != qualname:
+            continue
+        if entry.needle and entry.needle not in message:
+            continue
+        return entry.reason
+    return None
+
+
+# ---------------------------------------------------------------------------
+# collection
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str
+    qualname: str
+    name: str
+    cls: Optional[str]
+    node: ast.AST
+    is_async: bool
+    #: domains acquired directly in this function's body
+    acquires: Set[str] = dataclasses.field(default_factory=set)
+    #: (held domain, acquired domain, lineno) direct nesting edges
+    edges: List[Tuple[str, str, int]] = dataclasses.field(
+        default_factory=list
+    )
+    #: (held domains snapshot, callee ref, lineno) calls under a lock
+    locked_calls: List[Tuple[Tuple[str, ...], "CallRef", int]] = (
+        dataclasses.field(default_factory=list)
+    )
+    #: (held domains, kind, detail, lineno) direct blocking findings
+    blocking: List[Tuple[Tuple[str, ...], str, str, int]] = (
+        dataclasses.field(default_factory=list)
+    )
+    #: every callee referenced anywhere in the body (for closures)
+    calls: List["CallRef"] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class CallRef:
+    name: str          #: bare callee name (method or function)
+    on_self: bool      #: ``self.name(...)``
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Collector(ast.NodeVisitor):
+    """One module walk: find threading-lock attributes per class, then
+    per-function acquisition/blocking facts."""
+
+    def __init__(self, ctx: RepoContext, path, rel: str,
+                 thread_lock_attrs: Set[str]):
+        self.ctx = ctx
+        self.path = path
+        self.rel = rel
+        self.thread_lock_attrs = thread_lock_attrs
+        self.funcs: Dict[str, FuncInfo] = {}
+        self._cls_stack: List[str] = []
+        self._fn_stack: List[FuncInfo] = []
+        self._held: List[str] = []
+
+    # -- structure -----------------------------------------------------------
+
+    def visit_ClassDef(self, node):
+        self._cls_stack.append(node.name)
+        self.generic_visit(node)
+        self._cls_stack.pop()
+
+    def _visit_func(self, node, is_async: bool):
+        cls = self._cls_stack[-1] if self._cls_stack else None
+        qual = f"{cls}.{node.name}" if cls else node.name
+        info = FuncInfo(
+            module=self.rel, qualname=qual, name=node.name, cls=cls,
+            node=node, is_async=is_async,
+        )
+        # nested defs fold into their parent's qualname slot only if
+        # unique; last-in wins is fine for this analysis
+        self.funcs[qual] = info
+        self._fn_stack.append(info)
+        held, self._held = self._held, []
+        self.generic_visit(node)
+        self._held = held
+        self._fn_stack.pop()
+
+    def visit_FunctionDef(self, node):
+        self._visit_func(node, False)
+
+    def visit_AsyncFunctionDef(self, node):
+        self._visit_func(node, True)
+
+    # -- lock classification -------------------------------------------------
+
+    def _classify(self, expr: ast.AST) -> Optional[str]:
+        """Domain name for a with-item context expression, or None when
+        it is not a tracked threading lock."""
+        if not isinstance(expr, ast.Attribute):
+            return None
+        attr = expr.attr
+        if attr in ATTR_DOMAINS:
+            return ATTR_DOMAINS[attr]
+        owner = expr.value
+        if isinstance(owner, ast.Name):
+            if owner.id == "self":
+                dom = MODULE_SELF_DOMAINS.get((self.rel, attr))
+                if dom:
+                    return dom
+                if attr in self.thread_lock_attrs:
+                    return f"local:{self.rel}:{attr}"
+                return None
+            if attr == "_lock" and owner.id in OWNER_NAME_DOMAINS:
+                return OWNER_NAME_DOMAINS[owner.id]
+            return None
+        if isinstance(owner, ast.Attribute):
+            # self.storage._lock / pipeline.storage._lock
+            if attr == "_lock" and owner.attr in OWNER_NAME_DOMAINS:
+                return OWNER_NAME_DOMAINS[owner.attr]
+        return None
+
+    # -- acquisition ---------------------------------------------------------
+
+    def _enter_with(self, node):
+        acquired: List[str] = []
+        for item in node.items:
+            dom = self._classify(item.context_expr)
+            if dom is None:
+                continue
+            fn = self._fn_stack[-1] if self._fn_stack else None
+            if fn is not None:
+                fn.acquires.add(dom)
+                for held in self._held:
+                    if held != dom:
+                        fn.edges.append((held, dom, node.lineno))
+            acquired.append(dom)
+        return acquired
+
+    def visit_With(self, node):
+        acquired = self._enter_with(node)
+        self._held.extend(acquired)
+        self.generic_visit(node)
+        for _ in acquired:
+            self._held.pop()
+
+    # async-with on a threading lock is nonsensical and would fail at
+    # runtime; asyncio locks are untracked — just recurse
+    visit_AsyncWith = visit_With
+
+    # -- blocking ------------------------------------------------------------
+
+    def visit_Await(self, node):
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None and self._held:
+            fn.blocking.append(
+                (tuple(self._held), "await", "", node.lineno)
+            )
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        fn = self._fn_stack[-1] if self._fn_stack else None
+        if fn is not None:
+            ref = None
+            if isinstance(node.func, ast.Name):
+                ref = CallRef(node.func.id, False)
+            elif isinstance(node.func, ast.Attribute):
+                on_self = (
+                    isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                )
+                ref = CallRef(node.func.attr, on_self)
+            if ref is not None:
+                fn.calls.append(ref)
+                if self._held:
+                    fn.locked_calls.append(
+                        (tuple(self._held), ref, node.lineno)
+                    )
+            if self._held:
+                dotted = _dotted(node.func)
+                attr = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute) else None
+                )
+                if dotted in BLOCKING_DOTTED:
+                    fn.blocking.append(
+                        (tuple(self._held), "call", dotted, node.lineno)
+                    )
+                elif attr in BLOCKING_ATTRS:
+                    # str.join-style false positives don't apply: these
+                    # attr names are sync primitives / futures only
+                    fn.blocking.append(
+                        (tuple(self._held), "call", attr, node.lineno)
+                    )
+        self.generic_visit(node)
+
+
+def _thread_lock_attrs(nodes) -> Set[str]:
+    """self.<attr> names assigned ``threading.Lock()`` / ``RLock()``
+    anywhere in the module (asyncio.Lock is deliberately excluded: it
+    is awaited by design)."""
+    out: Set[str] = set()
+    for node in nodes:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        target = node.targets[0]
+        value = node.value
+        if not (
+            isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"
+            and isinstance(value, ast.Call)
+        ):
+            continue
+        dotted = _dotted(value.func)
+        if dotted in ("threading.Lock", "threading.RLock"):
+            out.add(target.attr)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# resolution + graph
+# ---------------------------------------------------------------------------
+
+def _closure(
+    info: FuncInfo,
+    by_class: Dict[Tuple[str, str], FuncInfo],
+    by_name: Dict[str, List[FuncInfo]],
+    memo: Dict[Tuple[str, str], Set[str]],
+    stack: Set[Tuple[str, str]],
+    union_ambiguous: bool,
+) -> Set[str]:
+    """Transitively-acquired domains of ``info``. Callee resolution is
+    conservative-by-omission for the EDGE graph (self-calls resolve in
+    the same class; other names only when package-unique) and
+    conservative-by-union for the drain rule (``union_ambiguous``)."""
+    key = (info.module, info.qualname)
+    if key in memo:
+        return memo[key]
+    if key in stack:
+        return set(info.acquires)  # recursion: direct only
+    stack.add(key)
+    out: Set[str] = set(info.acquires)
+    for ref in info.calls:
+        targets: List[FuncInfo] = []
+        if ref.on_self and info.cls is not None:
+            hit = by_class.get((info.cls, ref.name))
+            if hit is not None:
+                targets = [hit]
+        if not targets:
+            cands = by_name.get(ref.name, [])
+            if len(cands) == 1:
+                targets = cands
+            elif union_ambiguous and 1 < len(cands) <= 8:
+                targets = cands
+        for t in targets:
+            out |= _closure(
+                t, by_class, by_name, memo, stack, union_ambiguous
+            )
+    stack.discard(key)
+    memo[key] = out
+    return out
+
+
+def _find_cycles(edges: Dict[str, Set[str]]) -> List[List[str]]:
+    cycles: List[List[str]] = []
+    seen_cycles: Set[Tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: List[str], visited: Set[str]):
+        for nxt in sorted(edges.get(node, ())):
+            if nxt == start:
+                cyc = path + [start]
+                key = tuple(sorted(cyc[:-1]))
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(cyc)
+            elif nxt not in visited:
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(edges):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def lock_order_findings(
+    ctx: RepoContext, modules: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    files = (
+        [ctx.path(m) for m in modules] if modules
+        else ctx.package_files()
+    )
+    all_funcs: List[FuncInfo] = []
+    for path in files:
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        rel = ctx.rel(path)
+        if rel.startswith("limitador_tpu/tools/"):
+            continue  # the analyzers themselves
+        collector = _Collector(
+            ctx, path, rel, _thread_lock_attrs(ctx.nodes(path))
+        )
+        collector.visit(tree)
+        all_funcs.extend(collector.funcs.values())
+
+    by_class: Dict[Tuple[str, str], FuncInfo] = {}
+    by_name: Dict[str, List[FuncInfo]] = {}
+    for info in all_funcs:
+        if info.cls is not None:
+            by_class[(info.cls, info.name)] = info
+        by_name.setdefault(info.name, []).append(info)
+
+    findings: List[Finding] = []
+    edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+    edges: Dict[str, Set[str]] = {}
+
+    def add_edge(a: str, b: str, module: str, lineno: int) -> None:
+        if a == b:
+            return
+        edges.setdefault(a, set()).add(b)
+        edge_sites.setdefault((a, b), (module, lineno))
+
+    memo: Dict[Tuple[str, str], Set[str]] = {}
+    for info in all_funcs:
+        for held, acquired, lineno in info.edges:
+            add_edge(held, acquired, info.module, lineno)
+        for held_stack, ref, lineno in info.locked_calls:
+            # propagate: calling f while holding L implies L -> every
+            # domain f's closure acquires (strict resolution)
+            targets: List[FuncInfo] = []
+            if ref.on_self and info.cls is not None:
+                hit = by_class.get((info.cls, ref.name))
+                if hit is not None:
+                    targets = [hit]
+            else:
+                cands = by_name.get(ref.name, [])
+                if len(cands) == 1:
+                    targets = cands
+            for t in targets:
+                acq = _closure(t, by_class, by_name, memo, set(), False)
+                for dom in acq:
+                    for held in held_stack:
+                        add_edge(held, dom, info.module, lineno)
+
+    # R1: cycles between tracked domains
+    tracked_edges = {
+        a: {b for b in bs if b in TRACKED_DOMAINS}
+        for a, bs in edges.items() if a in TRACKED_DOMAINS
+    }
+    for cycle in _find_cycles(tracked_edges):
+        first_site = edge_sites.get(
+            (cycle[0], cycle[1]), ("limitador_tpu", 0)
+        )
+        findings.append(Finding(
+            "lock-order", first_site[0], first_site[1],
+            "lock acquisition cycle: " + " -> ".join(cycle)
+            + f" (canonical order is {' -> '.join(CANONICAL_ORDER)})",
+            hint="re-nest so every path acquires along the canonical "
+                 "order; if a new pairing is needed, re-derive the "
+                 "order and update CANONICAL_ORDER + docs/analysis.md",
+        ))
+
+    # R1b: tracked edges that invert the canonical order (a cycle
+    # waiting for its second half)
+    rank = {d: i for i, d in enumerate(CANONICAL_ORDER)}
+    for a, bs in sorted(tracked_edges.items()):
+        for b in sorted(bs):
+            if a in rank and b in rank and rank[a] > rank[b]:
+                mod, lineno = edge_sites[(a, b)]
+                msg = (
+                    f"acquisition edge '{a}' -> '{b}' inverts the "
+                    f"canonical order {' -> '.join(CANONICAL_ORDER)}"
+                )
+                findings.append(Finding(
+                    "lock-order", mod, lineno, msg,
+                    hint="take the outer lock first or split the "
+                         "critical section",
+                ))
+
+    # R2/R3: await / blocking calls while holding a threading lock
+    for info in all_funcs:
+        for held_stack, kind, detail, lineno in info.blocking:
+            if ctx.noqa(ctx.path(info.module), lineno):
+                continue
+            held_desc = ", ".join(f"'{h}'" for h in held_stack)
+            if kind == "await":
+                msg = (
+                    f"await while holding threading lock(s) "
+                    f"{held_desc} in {info.qualname}: the coroutine "
+                    "parks with the lock held and every thread on it "
+                    "stalls for the suspension"
+                )
+                hint = ("release the lock before awaiting, or make the "
+                        "guarded state loop-local")
+            else:
+                msg = (
+                    f"blocking call '{detail}' while holding "
+                    f"{held_desc} in {info.qualname}"
+                )
+                hint = ("move the blocking call outside the critical "
+                        "section, or add an explicit LockAllow entry "
+                        "citing the budget that bounds the hold")
+            reason = _allow_reason(
+                "blocking-under-lock", info.module, info.qualname, msg
+            )
+            findings.append(Finding(
+                "lock-order", info.module, lineno, msg, hint=hint,
+                suppressed_by=(
+                    f"allowlist: {reason}" if reason else None
+                ),
+            ))
+
+    # R4: the observatory drain thread's lock holds — explicit, never
+    # silent. Union-resolution: ambiguous callees (drain_hot_slots is
+    # defined per storage flavor) conservatively merge.
+    drain_mod, drain_cls, drain_name = DRAIN_ENTRY
+    entry = next(
+        (f for f in all_funcs
+         if f.module == drain_mod and f.cls == drain_cls
+         and f.name == drain_name),
+        None,
+    )
+    if entry is not None:
+        union_memo: Dict[Tuple[str, str], Set[str]] = {}
+        acq = _closure(entry, by_class, by_name, union_memo, set(), True)
+        # the observatory's own lock is the drain's to hold; the rule
+        # is about the SHARED serving-path locks it reaches out to
+        for dom in sorted(acq & set(TRACKED_DOMAINS) - {"observatory"}):
+            msg = (
+                f"observatory drain thread acquires '{dom}' (via "
+                f"{entry.qualname}): the flush path serializes behind "
+                "every drain pass"
+            )
+            reason = _allow_reason(
+                "drain-thread-lock", drain_mod, entry.qualname, msg
+            )
+            findings.append(Finding(
+                "lock-order", drain_mod, entry.node.lineno, msg,
+                hint="keep the hold inside the perf-smoke drain "
+                     "budget, or move the work off the lock",
+                suppressed_by=(
+                    f"allowlist: {reason}" if reason else None
+                ),
+            ))
+    return findings
+
+
+@register_pass(
+    "lock-order",
+    "acquisition-graph cycles, canonical-order inversions, await/"
+    "blocking calls under threading locks, drain-thread lock holds",
+)
+def run(ctx: RepoContext) -> List[Finding]:
+    return lock_order_findings(ctx)

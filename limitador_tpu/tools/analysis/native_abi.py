@@ -1,0 +1,131 @@
+"""ctypes ABI drift pass: C exports vs Python binding declarations.
+
+Every symbol exported from the native sources must have a ctypes
+``argtypes`` declaration on the Python side (non-void returns also need
+``restype``), and every Python-side declaration must name a symbol that
+still exists — a renamed/removed export fails the gate instead of
+segfaulting at call time. Ported from ``tools/lint.py`` (PR 5).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List
+
+from . import Finding, RepoContext, register_pass
+
+__all__ = [
+    "CTYPES_SOURCES", "CTYPES_BINDINGS", "CTYPES_SYMBOL_PREFIXES",
+    "exported_c_symbols", "declared_ctypes_signatures", "abi_findings",
+]
+
+#: native sources whose extern "C" exports must carry matching ctypes
+#: declarations in the binding modules (symbol prefix filters the
+#: internal helpers out)
+CTYPES_SOURCES = ("native/hostpath.cc", "native/h2ingress.cc")
+CTYPES_BINDINGS = (
+    "limitador_tpu/native/__init__.py",
+    "limitador_tpu/native/ingress.py",
+)
+CTYPES_SYMBOL_PREFIXES = ("hp_", "h2i_")
+
+
+def exported_c_symbols(source: str):
+    """(name, return_type, has_params) for every exported C function in
+    a translation unit (prefix-filtered; extern "C" definitions in this
+    repo all sit at column 0 with the return type on the same line)."""
+    out = []
+    pattern = re.compile(
+        r"^([A-Za-z_][A-Za-z0-9_]*\s*\**)\s+("
+        + "|".join(p + r"[a-z0-9_]+" for p in CTYPES_SYMBOL_PREFIXES)
+        + r")\s*\(([^)]*)",
+        re.MULTILINE,
+    )
+    for match in pattern.finditer(source):
+        ret = match.group(1).replace(" ", "")
+        name = match.group(2)
+        params = match.group(3).strip()
+        # multi-line parameter lists never close on the match line; an
+        # empty first-line capture with more lines following still means
+        # "has params" only when the very next char isn't ')'
+        has_params = params not in ("", "void")
+        out.append((name, ret, has_params))
+    return out
+
+
+def declared_ctypes_signatures(source: str):
+    """{symbol: {"restype", "argtypes"}} assignments in a binding
+    module (``lib.<symbol>.restype = ...`` / ``.argtypes = ...``)."""
+    out: dict = {}
+    for match in re.finditer(
+        r"lib\.([A-Za-z_][A-Za-z0-9_]*)\.(restype|argtypes)\s*=", source
+    ):
+        out.setdefault(match.group(1), set()).add(match.group(2))
+    return out
+
+
+def abi_findings(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    exported: dict = {}
+    for rel in CTYPES_SOURCES:
+        path = ctx.path(rel)
+        if not path.exists():
+            continue
+        for name, ret, has_params in exported_c_symbols(ctx.source(path)):
+            exported[name] = (rel, ret, has_params)
+    declared: dict = {}
+    for rel in CTYPES_BINDINGS:
+        path = ctx.path(rel)
+        if not path.exists():
+            continue
+        for name, kinds in declared_ctypes_signatures(
+            ctx.source(path)
+        ).items():
+            declared.setdefault(name, set()).update(kinds)
+    if not exported or not declared:
+        return findings
+    for name, (rel, ret, has_params) in sorted(exported.items()):
+        kinds = declared.get(name)
+        if kinds is None:
+            findings.append(Finding(
+                "ctypes-abi", rel, 0,
+                f"exported symbol '{name}' has no ctypes declaration in "
+                "the binding modules (drift: a call through the default "
+                "int-sized signature corrupts arguments silently)",
+                hint="declare lib.<symbol>.argtypes (and restype when "
+                     "non-void) in the binding module",
+            ))
+            continue
+        if has_params and "argtypes" not in kinds:
+            findings.append(Finding(
+                "ctypes-abi", rel, 0,
+                f"exported symbol '{name}' takes parameters but the "
+                "binding declares no argtypes",
+            ))
+        if ret != "void" and "restype" not in kinds:
+            findings.append(Finding(
+                "ctypes-abi", rel, 0,
+                f"exported symbol '{name}' returns {ret} but the "
+                "binding declares no restype (ctypes truncates to int)",
+            ))
+    for name in sorted(declared):
+        if not name.startswith(CTYPES_SYMBOL_PREFIXES):
+            continue
+        if name not in exported:
+            findings.append(Finding(
+                "ctypes-abi", "limitador_tpu/native", 0,
+                f"binding declares '{name}' but no native source "
+                "exports it (renamed or removed symbol)",
+                hint="rename the binding to match the export, or drop "
+                     "the dead declaration",
+            ))
+    return findings
+
+
+@register_pass(
+    "ctypes-abi",
+    "native extern-C exports vs ctypes argtypes/restype declarations, "
+    "both directions",
+)
+def run(ctx: RepoContext) -> List[Finding]:
+    return abi_findings(ctx)

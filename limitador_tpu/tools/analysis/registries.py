@@ -1,0 +1,258 @@
+"""Registry cross-check passes: metric families, native phases, debug
+sections.
+
+Three instances of the same shape — a subsystem declares a module-level
+registry tuple, another module consumes it, and drift in either
+direction (a typo'd family that never renders, an orphaned registration
+nothing serves) must fail the gate instead of silently vanishing from
+dashboards. Ported from ``tools/lint.py`` (PR 2 / PR 7 / PR 8).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List
+
+from . import Finding, RepoContext, register_pass
+
+__all__ = [
+    "REGISTRY_OWNED_PREFIXES", "NATIVE_PLANE_MODULE", "HTTP_API_MODULE",
+    "declared_metric_families", "registered_metric_families",
+    "metric_registry_findings", "native_phase_findings",
+    "debug_section_findings",
+]
+
+#: metric prefixes whose declarations must be covered by a subsystem
+#: METRIC_FAMILIES registry (prefix -> registry module, repo-relative)
+REGISTRY_OWNED_PREFIXES = {
+    "admission_": "limitador_tpu/admission/__init__.py",
+    "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
+    "sharded_": "limitador_tpu/tpu/sharded.py",
+    "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
+    "native_lane_": "limitador_tpu/tpu/native_pipeline.py",
+    "lease_": "limitador_tpu/lease/__init__.py",
+    "native_phase_": "limitador_tpu/observability/native_plane.py",
+    "slo_": "limitador_tpu/observability/native_plane.py",
+    "tenant_": "limitador_tpu/observability/usage.py",
+    "signal_": "limitador_tpu/observability/signals.py",
+}
+
+#: the native telemetry plane's phase registry module
+NATIVE_PLANE_MODULE = "limitador_tpu/observability/native_plane.py"
+
+#: the HTTP API module whose /debug/stats sections must be registered
+#: in its DEBUG_STATS_SECTIONS tuple
+HTTP_API_MODULE = "limitador_tpu/server/http_api.py"
+
+METRICS_MODULE = "limitador_tpu/observability/metrics.py"
+
+
+def declared_metric_families(ctx: RepoContext):
+    """Family names declared in observability/metrics.py: the first
+    string-literal argument of every Counter/Gauge/Histogram call."""
+    path = ctx.path(METRICS_MODULE)
+    names = set()
+    if ctx.tree(path) is None:
+        return names
+    for node in ctx.nodes(path):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = (
+            fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if fname in ("Counter", "Gauge", "Histogram") and node.args:
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ):
+                names.add(first.value)
+    return names
+
+
+def registered_metric_families(ctx: RepoContext):
+    """(path, lineno, name) for every entry of a module-level
+    ``METRIC_FAMILIES`` tuple/list under the package."""
+    out = []
+    for path in ctx.package_files():
+        tree = ctx.tree(path)
+        if tree is None:
+            continue  # reported by the style pass
+        for node in tree.body:
+            if not (
+                isinstance(node, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
+                    for t in node.targets
+                )
+                and isinstance(node.value, (ast.Tuple, ast.List))
+            ):
+                continue
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(
+                    elt.value, str
+                ):
+                    out.append((path, elt.lineno, elt.value))
+    return out
+
+
+def metric_registry_findings(ctx: RepoContext) -> List[Finding]:
+    if not ctx.path(METRICS_MODULE).exists():
+        return []
+    declared = declared_metric_families(ctx)
+    registered = registered_metric_families(ctx)
+    findings = []
+    for path, lineno, name in registered:
+        if name not in declared:
+            findings.append(Finding(
+                "metric-registry", ctx.rel(path), lineno,
+                f"metric family '{name}' is registered but not declared "
+                "in observability/metrics.py",
+                hint="declare the Counter/Gauge/Histogram in "
+                     "PrometheusMetrics, or drop the registry entry",
+            ))
+    registered_names = {name for _p, _l, name in registered}
+    for prefix, registry in sorted(REGISTRY_OWNED_PREFIXES.items()):
+        for name in sorted(declared):
+            if name.startswith(prefix) and name not in registered_names:
+                findings.append(Finding(
+                    "metric-registry", METRICS_MODULE, 0,
+                    f"metric family '{name}' is declared but missing "
+                    f"from {registry}'s METRIC_FAMILIES registry",
+                    hint=f"add '{name}' to METRIC_FAMILIES in {registry}",
+                ))
+    return findings
+
+
+def native_phase_findings(ctx: RepoContext) -> List[Finding]:
+    plane = ctx.path(NATIVE_PLANE_MODULE)
+    if not plane.exists() or not ctx.path(METRICS_MODULE).exists():
+        return []
+    phases = ctx.module_string_tuple(plane, "PHASES")
+    registered = set(ctx.module_string_tuple(plane, "METRIC_FAMILIES"))
+    declared = declared_metric_families(ctx)
+    findings = []
+    for phase in phases:
+        family = f"native_phase_{phase}"
+        if family not in declared:
+            findings.append(Finding(
+                "native-phases", NATIVE_PLANE_MODULE, 0,
+                f"PHASES entry '{phase}' has no '{family}' histogram "
+                "family declared in observability/metrics.py",
+                hint="a phase without its family silently drops that "
+                     "phase's drain — declare the histogram",
+            ))
+        if family not in registered:
+            findings.append(Finding(
+                "native-phases", NATIVE_PLANE_MODULE, 0,
+                f"PHASES entry '{phase}' has no '{family}' entry in "
+                "METRIC_FAMILIES",
+                hint=f"register '{family}' in native_plane's "
+                     "METRIC_FAMILIES",
+            ))
+    return findings
+
+
+def _debug_section_pairs(ctx: RepoContext, path: Path, name: str):
+    """First elements of a module-level ``NAME = (("k", "attr"), ...)``
+    tuple-of-pairs assignment."""
+    tree = ctx.tree(path)
+    if tree is None:
+        return []
+    out: List[str] = []
+    for node in tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == name
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            continue
+        for elt in node.value.elts:
+            if (
+                isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
+                and isinstance(elt.elts[0], ast.Constant)
+                and isinstance(elt.elts[0].value, str)
+            ):
+                out.append(elt.elts[0].value)
+    return out
+
+
+def debug_section_findings(ctx: RepoContext) -> List[Finding]:
+    api_path = ctx.path(HTTP_API_MODULE)
+    if not api_path.exists():
+        return []
+    registered = set(
+        ctx.module_string_tuple(api_path, "DEBUG_STATS_SECTIONS")
+    )
+    served: dict = {}  # name -> lineno
+    for name in _debug_section_pairs(ctx, api_path, "DEBUG_SOURCE_SECTIONS"):
+        served.setdefault(name, 0)
+    tree = ctx.tree(api_path)
+    if tree is None:
+        return []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Subscript)
+        ):
+            continue
+        target = node.targets[0]
+        if not (
+            isinstance(target.value, ast.Name)
+            and target.value.id == "stats"
+        ):
+            continue
+        sl = target.slice
+        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+            served.setdefault(sl.value, node.lineno)
+    findings = []
+    for name, lineno in sorted(served.items()):
+        if name not in registered:
+            findings.append(Finding(
+                "debug-sections", HTTP_API_MODULE, lineno,
+                f"/debug/stats section '{name}' is served but missing "
+                "from DEBUG_STATS_SECTIONS",
+                hint="register it so dashboards and benches can rely "
+                     "on the section set",
+            ))
+    for name in sorted(registered - set(served)):
+        findings.append(Finding(
+            "debug-sections", HTTP_API_MODULE, 0,
+            f"DEBUG_STATS_SECTIONS entry '{name}' is registered but "
+            "never served by get_debug_stats",
+            hint="serve the section or drop the registration",
+        ))
+    return findings
+
+
+@register_pass(
+    "metric-registry",
+    "subsystem METRIC_FAMILIES registries vs PrometheusMetrics "
+    "declarations, both directions",
+)
+def run_metric_registry(ctx: RepoContext) -> List[Finding]:
+    return metric_registry_findings(ctx)
+
+
+@register_pass(
+    "native-phases",
+    "native telemetry PHASES entries each need a declared + registered "
+    "native_phase_* family",
+)
+def run_native_phases(ctx: RepoContext) -> List[Finding]:
+    return native_phase_findings(ctx)
+
+
+@register_pass(
+    "debug-sections",
+    "/debug/stats served sections vs the DEBUG_STATS_SECTIONS registry, "
+    "both directions",
+)
+def run_debug_sections(ctx: RepoContext) -> List[Finding]:
+    return debug_section_findings(ctx)

@@ -1,0 +1,317 @@
+"""Tracing-safety pass for the hot-path modules (ISSUE 9 analyzer c).
+
+One stray host sync or recompile on the decision path blows the 2ms p99
+budget (BASELINE north star). Four rules, each an incident class this
+repo has already paid for:
+
+* **no-host-sync** — ``block_until_ready`` / ``jax.device_get`` in a
+  hot-path module: the decision path must stay async against the
+  device; syncs belong to bench/warmup code.
+* **no-implicit-asarray** — ``np.asarray(x)`` / ``np.array(x)``
+  WITHOUT a dtype inside a decision-path function: with a device array
+  argument that is a silent blocking device->host transfer per batch.
+  Host staging always knows its dtype (``np.asarray(x, np.int32)``);
+  spelling it keeps the conversion provably host-side and self-
+  documents the intent.
+* **kernel-launch-locality** — calls into ``ops/kernel.py`` functions
+  from modules OUTSIDE the quantizing owners (storage/sharded/
+  replicated/mesh): the owners pad every jit-visible shape to the pow2
+  hit buckets; a direct launch from anywhere else ships un-quantized
+  shapes and recompiles per batch size (measured 300ms+ stalls,
+  PR 4). Reading kernel CONSTANTS (``K.MAX_DELTA_CAP``) is fine — only
+  calls are flagged.
+* **shard-map-donation** — generalizing the donation pass: a
+  ``shard_map``/``_shard_map`` site whose wrapped kernel carries the
+  counter table must sit inside a function that is itself a donating
+  table kernel (the donation pass checks its jit site) — otherwise the
+  per-shard table copies come back through the side door.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from . import Finding, RepoContext, register_pass
+from .donation import DONATION_CHECKED_MODULES, DONATION_PARAMS
+
+__all__ = [
+    "HOT_MODULES", "DECISION_PREFIXES", "KERNEL_OWNER_MODULES",
+    "tracing_findings",
+]
+
+#: modules on the serving hot path (decision-path rules apply here)
+HOT_MODULES = (
+    "limitador_tpu/tpu/native_pipeline.py",
+    "limitador_tpu/tpu/storage.py",
+    "limitador_tpu/tpu/sharded.py",
+    "limitador_tpu/tpu/batcher.py",
+    "limitador_tpu/tpu/plan_cache.py",
+    "limitador_tpu/tpu/pipeline.py",
+    "limitador_tpu/native/ingress.py",
+)
+
+#: function-name prefixes that mark the decision path (begin/submit
+#: side — the finish side owns the device sync by definition)
+DECISION_PREFIXES = (
+    "decide", "submit", "begin_", "_begin", "pad_hits",
+)
+
+#: modules allowed to call ops/kernel.py functions: they own the pow2
+#: bucket quantization of every jit-visible shape
+KERNEL_OWNER_MODULES = (
+    "limitador_tpu/ops/kernel.py",
+    "limitador_tpu/tpu/storage.py",
+    "limitador_tpu/tpu/sharded.py",
+    "limitador_tpu/tpu/replicated.py",
+    "limitador_tpu/parallel/mesh.py",
+)
+
+KERNEL_MODULE = "limitador_tpu/ops/kernel.py"
+
+
+def _kernel_function_names(ctx: RepoContext) -> Set[str]:
+    path = ctx.path(KERNEL_MODULE)
+    if ctx.tree(path) is None:
+        return set()
+    return {
+        node.name for node in ctx.nodes(path)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        and not node.name.startswith("_")
+    }
+
+
+def _kernel_aliases(nodes) -> Set[str]:
+    """Names the module binds to ops.kernel (``from ..ops import kernel
+    as K`` / ``import ...ops.kernel as kernel``)."""
+    out: Set[str] = set()
+    for node in nodes:
+        if isinstance(node, ast.ImportFrom) and node.module and (
+            node.module.endswith("ops") or node.module.endswith("ops.kernel")
+        ):
+            for alias in node.names:
+                if alias.name == "kernel" or node.module.endswith(
+                    "ops.kernel"
+                ):
+                    out.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("ops.kernel"):
+                    out.add(alias.asname or alias.name.split(".")[0])
+    return out
+
+
+def _enclosing_function(
+    tree: ast.AST, target: ast.AST
+) -> Optional[ast.FunctionDef]:
+    """Innermost FunctionDef lexically containing ``target``."""
+    best: Optional[ast.FunctionDef] = None
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: List[ast.FunctionDef] = []
+
+        def generic_visit(self, node):
+            nonlocal best
+            if node is target and self.stack:
+                best = self.stack[-1]
+            is_fn = isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            )
+            if is_fn:
+                self.stack.append(node)
+            super().generic_visit(node)
+            if is_fn:
+                self.stack.pop()
+
+    V().visit(tree)
+    return best
+
+
+def tracing_findings(ctx: RepoContext) -> List[Finding]:
+    findings: List[Finding] = []
+    kernel_fns = _kernel_function_names(ctx)
+
+    # -- rules 1-2: host syncs in hot modules --------------------------------
+    for rel in HOT_MODULES:
+        path = ctx.path(rel)
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+
+        decision_spans: List[tuple] = []
+        for node in ctx.nodes(path):
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name.startswith(DECISION_PREFIXES):
+                end = getattr(node, "end_lineno", node.lineno)
+                decision_spans.append((node.lineno, end, node.name))
+
+        def decision_fn(lineno: int) -> Optional[str]:
+            for lo, hi, name in decision_spans:
+                if lo <= lineno <= hi:
+                    return name
+            return None
+
+        for node in ctx.nodes(path):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            attr = fn.attr if isinstance(fn, ast.Attribute) else None
+            if attr == "block_until_ready" and not ctx.noqa(
+                path, node.lineno
+            ):
+                findings.append(Finding(
+                    "tracing-safety", rel, node.lineno,
+                    "block_until_ready in a hot-path module: the "
+                    "decision path must stay async against the device",
+                    hint="move the sync to bench/warmup code, or # noqa "
+                         "with the reason if this is a warmup helper",
+                ))
+                continue
+            if (
+                attr == "device_get"
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "jax"
+            ):
+                where = decision_fn(node.lineno)
+                if where and not ctx.noqa(path, node.lineno):
+                    findings.append(Finding(
+                        "tracing-safety", rel, node.lineno,
+                        f"jax.device_get on the decision path "
+                        f"('{where}'): blocking device->host transfer "
+                        "per batch",
+                        hint="defer the transfer to the finish side",
+                    ))
+                continue
+            if (
+                attr in ("asarray", "array")
+                and isinstance(fn.value, ast.Name)
+                and fn.value.id == "np"
+            ):
+                where = decision_fn(node.lineno)
+                if where is None:
+                    continue
+                has_dtype = len(node.args) >= 2 or any(
+                    k.arg == "dtype" for k in node.keywords
+                )
+                if not has_dtype and not ctx.noqa(path, node.lineno):
+                    findings.append(Finding(
+                        "tracing-safety", rel, node.lineno,
+                        f"implicit np.{attr}(x) on the decision path "
+                        f"('{where}'): with a device array this is a "
+                        "silent blocking transfer",
+                        hint="spell the dtype (np.asarray(x, np.int32)) "
+                             "to keep the conversion provably host-side",
+                    ))
+
+    # -- rule 3: kernel-launch locality --------------------------------------
+    if kernel_fns:
+        for path in ctx.package_files():
+            rel = ctx.rel(path)
+            if rel in KERNEL_OWNER_MODULES or rel.startswith(
+                "limitador_tpu/tools/"
+            ):
+                continue
+            if ctx.tree(path) is None:
+                continue
+            aliases = _kernel_aliases(ctx.nodes(path))
+            if not aliases:
+                continue
+            for node in ctx.nodes(path):
+                if not (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id in aliases
+                    and node.func.attr in kernel_fns
+                ):
+                    continue
+                if ctx.noqa(path, node.lineno):
+                    continue
+                findings.append(Finding(
+                    "tracing-safety", rel, node.lineno,
+                    f"direct kernel launch '{node.func.attr}' outside "
+                    "the quantizing owner modules: jit-visible shapes "
+                    "must be padded to the pow2 hit buckets or every "
+                    "batch size compiles a new XLA program",
+                    hint="route the launch through TpuStorage/"
+                         "TpuShardedStorage (they own pad_hits and the "
+                         "bucket quantization)",
+                ))
+
+    # -- rule 4: shard_map sites donation-checked ----------------------------
+    for rel in DONATION_CHECKED_MODULES:
+        path = ctx.path(rel)
+        tree = ctx.tree(path)
+        if tree is None:
+            continue
+        funcs = {
+            node.name: node for node in ctx.nodes(path)
+            if isinstance(node, ast.FunctionDef)
+        }
+        for node in ctx.nodes(path):
+            if not (
+                isinstance(node, ast.Call)
+                and (
+                    (isinstance(node.func, ast.Name)
+                     and node.func.id in ("shard_map", "_shard_map"))
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "shard_map")
+                )
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+            ):
+                continue
+            host = _enclosing_function(tree, node)
+            if host is not None and node.args[0].id in {
+                a.arg for a in host.args.args
+            }:
+                # pass-through helper (e.g. the version-compat
+                # _shard_map wrapper): the REAL site is the caller,
+                # checked on its own visit
+                continue
+            wrapped = None
+            if host is not None:
+                # prefer the kernel nested in the calling function —
+                # the sharded launchers all use a local `def fn(...)`
+                wrapped = next(
+                    (n for n in ast.walk(host)
+                     if isinstance(n, ast.FunctionDef)
+                     and n.name == node.args[0].id and n is not host),
+                    None,
+                )
+            if wrapped is None:
+                wrapped = funcs.get(node.args[0].id)
+            if wrapped is None:
+                continue
+            w_params = {a.arg for a in wrapped.args.args} & DONATION_PARAMS
+            if not w_params:
+                continue
+            host_params = (
+                {a.arg for a in host.args.args} & DONATION_PARAMS
+                if host is not None else set()
+            )
+            if host is None or not host_params:
+                if not ctx.noqa(path, node.lineno):
+                    findings.append(Finding(
+                        "tracing-safety", rel, node.lineno,
+                        f"shard_map over table-carrying kernel "
+                        f"'{node.args[0].id}' is not enclosed in a "
+                        "table-carrying function the donation pass can "
+                        "check: per-shard table copies come back "
+                        "through the side door",
+                        hint="thread the table params through the "
+                             "enclosing function so its jit site is "
+                             "donation-checked",
+                    ))
+    return findings
+
+
+@register_pass(
+    "tracing-safety",
+    "no host syncs / implicit asarray on the decision path, kernel "
+    "launches only from pow2-quantizing owners, shard_map donation",
+)
+def run(ctx: RepoContext) -> List[Finding]:
+    return tracing_findings(ctx)

@@ -1,708 +1,95 @@
-"""Vendored AST linter — the fmt/clippy gate of this repo.
+"""Compatibility shim over ``limitador_tpu.tools.analysis`` (ISSUE 9).
 
-The reference enforces ``cargo fmt --check`` and ``clippy -D warnings``
-in CI (/root/reference/.github/workflows/rust.yml). This image ships no
-Python linter (no ruff/pyflakes/flake8, and installs are off), so — by
-the same standard as the vendored HTTP/2, OTLP and reflection layers —
-the gate is implemented from scratch on ``ast``:
+The five ad-hoc passes that lived here (style, metric-registry,
+donation, ctypes-ABI drift, native-phase/debug-section cross-checks)
+now ride the pass-registry framework in ``tools/analysis/`` alongside
+the lock-order, buffer-safety and tracing-safety analyzers. This module
+keeps the historical entry points — ``python -m
+limitador_tpu.tools.lint``, ``make lint``, and the function API
+``tests/`` import — delegating to the registry, with byte-compatible
+legacy string rendering ("path:lineno: message").
 
-* syntax errors (hard fail),
-* unused imports (pyflakes F401 class: a name imported but never
-  referenced in the module; ``__all__`` strings count as uses),
-* redefined imports (same name imported twice in one scope),
-* bare ``except:`` (clippy would call this a swallow-all),
-* mutable default arguments (list/dict/set literals),
-* comparisons to ``True``/``False``/``None`` with ``==``/``!=``,
-* duplicate literal keys in dict displays,
-* tabs in indentation and trailing whitespace,
-* the metric-registry cross-check: every family a subsystem registers
-  in a module-level ``METRIC_FAMILIES`` tuple (e.g.
-  ``limitador_tpu/admission/__init__.py``) must be declared in
-  ``observability/metrics.py``, and every declared ``admission_*``
-  family must appear in the admission registry — a typo'd or orphaned
-  family fails the gate instead of silently never rendering,
-* the native-phase cross-check: every entry of the telemetry plane's
-  ``PHASES`` tuple (observability/native_plane.py) must have a matching
-  ``native_phase_<entry>`` histogram family declared in metrics.py and
-  registered in the plane's ``METRIC_FAMILIES``,
-* the buffer-donation check: ``jax.jit`` call sites in the kernel
-  modules (DONATION_CHECKED_MODULES) whose wrapped function carries the
-  counter table (a ``state`` or ``values``/``expiry`` parameter) must
-  pass ``donate_argnums`` — a missing donation silently turns every
-  table-mutating launch into a full-table copy (8 bytes/slot/batch of
-  HBM traffic). Read-only kernels are allowlisted in DONATION_EXEMPT.
-
-``# noqa`` anywhere on the offending line suppresses that finding.
-Run: ``python -m limitador_tpu.tools.lint [paths...]`` (defaults to the
-repo's lintable set); exit 1 on any finding — ``make check`` and
-``tests/test_lint.py`` both ride this.
+New passes register in ``tools/analysis/``; see ``docs/analysis.md``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
 from pathlib import Path
-from typing import List, Tuple
+from typing import List
+
+from .analysis import RepoContext
+from .analysis.donation import (          # noqa: re-exported legacy API
+    DONATION_CHECKED_MODULES, DONATION_EXEMPT, DONATION_PARAMS,
+    donation_findings,
+)
+from .analysis.native_abi import (        # noqa: re-exported legacy API
+    CTYPES_BINDINGS, CTYPES_SOURCES, CTYPES_SYMBOL_PREFIXES,
+    abi_findings, declared_ctypes_signatures, exported_c_symbols,
+)
+from .analysis.registries import (        # noqa: re-exported legacy API
+    HTTP_API_MODULE, NATIVE_PLANE_MODULE, REGISTRY_OWNED_PREFIXES,
+    debug_section_findings, metric_registry_findings,
+    native_phase_findings,
+)
+from .analysis.style import lint_file, lint_paths  # noqa: re-exported
 
 __all__ = [
     "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
     "lint_ctypes_signatures", "lint_native_phases",
-    "lint_debug_sections", "main",
+    "lint_debug_sections", "main", "DEFAULT_TARGETS",
 ]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
                    "__graft_entry__.py")
 
-#: metric prefixes whose declarations must be covered by a subsystem
-#: METRIC_FAMILIES registry (prefix -> registry module, repo-relative)
-REGISTRY_OWNED_PREFIXES = {
-    "admission_": "limitador_tpu/admission/__init__.py",
-    "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
-    "sharded_": "limitador_tpu/tpu/sharded.py",
-    "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
-    "native_lane_": "limitador_tpu/tpu/native_pipeline.py",
-    "lease_": "limitador_tpu/lease/__init__.py",
-    "native_phase_": "limitador_tpu/observability/native_plane.py",
-    "slo_": "limitador_tpu/observability/native_plane.py",
-    "tenant_": "limitador_tpu/observability/usage.py",
-    "signal_": "limitador_tpu/observability/signals.py",
-}
 
-#: the native telemetry plane's phase registry: every entry of this
-#: module-level PHASES tuple must have a ``native_phase_<entry>``
-#: histogram family declared in metrics.py AND registered in the same
-#: module's METRIC_FAMILIES — a phase added to the C enum without its
-#: Prometheus family would silently drop that phase's drain.
-NATIVE_PLANE_MODULE = "limitador_tpu/observability/native_plane.py"
-
-#: the HTTP API module whose /debug/stats sections must be registered
-#: in its DEBUG_STATS_SECTIONS tuple (lint_debug_sections — the
-#: lint_native_phases pattern generalized to the debug surface)
-HTTP_API_MODULE = "limitador_tpu/server/http_api.py"
-
-#: native sources whose extern "C" exports must carry matching ctypes
-#: declarations in the binding modules (symbol prefix filters the
-#: internal helpers out)
-CTYPES_SOURCES = ("native/hostpath.cc", "native/h2ingress.cc")
-CTYPES_BINDINGS = (
-    "limitador_tpu/native/__init__.py",
-    "limitador_tpu/native/ingress.py",
-)
-CTYPES_SYMBOL_PREFIXES = ("hp_", "h2i_")
-
-#: modules whose jax.jit sites must donate table-carrying buffers
-DONATION_CHECKED_MODULES = (
-    "limitador_tpu/ops/kernel.py",
-    "limitador_tpu/parallel/mesh.py",
-    "limitador_tpu/tpu/replicated.py",
-)
-
-#: table parameter names that mark a kernel as table-carrying ("hits"
-#: is the per-slot traffic accumulator column — same in-place contract)
-DONATION_PARAMS = frozenset({"state", "values", "expiry", "hits"})
-
-#: read-only kernels: they take the table but never produce a new one,
-#: so there is nothing to update in place
-DONATION_EXEMPT = frozenset({"read_slots"})
-
-
-def declared_metric_families(metrics_path: Path):
-    """Family names declared in observability/metrics.py: the first
-    string-literal argument of every Counter/Gauge/Histogram call."""
-    tree = ast.parse(metrics_path.read_text(), filename=str(metrics_path))
-    names = set()
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        fname = (
-            fn.id if isinstance(fn, ast.Name)
-            else fn.attr if isinstance(fn, ast.Attribute) else None
-        )
-        if fname in ("Counter", "Gauge", "Histogram") and node.args:
-            first = node.args[0]
-            if isinstance(first, ast.Constant) and isinstance(
-                first.value, str
-            ):
-                names.add(first.value)
-    return names
-
-
-def registered_metric_families(package_root: Path):
-    """(path, lineno, name) for every entry of a module-level
-    ``METRIC_FAMILIES`` tuple/list under the package."""
+def _legacy(ctx: RepoContext, findings) -> List[str]:
+    """Render registry findings in the historical string format."""
     out = []
-    for path in sorted(package_root.rglob("*.py")):
-        try:
-            tree = ast.parse(path.read_text(), filename=str(path))
-        except SyntaxError:
-            continue  # reported by lint_file
-        for node in tree.body:
-            if not (
-                isinstance(node, ast.Assign)
-                and any(
-                    isinstance(t, ast.Name) and t.id == "METRIC_FAMILIES"
-                    for t in node.targets
-                )
-                and isinstance(node.value, (ast.Tuple, ast.List))
-            ):
-                continue
-            for elt in node.value.elts:
-                if isinstance(elt, ast.Constant) and isinstance(
-                    elt.value, str
-                ):
-                    out.append((path, elt.lineno, elt.value))
+    for f in findings:
+        path = f.path
+        if not Path(path).is_absolute():
+            path = str(ctx.root / path)
+        out.append(f"{path}:{f.line}: {f.message}")
     return out
 
 
-def lint_metric_registry(repo_root: Path) -> List[str]:
-    """Cross-check subsystem METRIC_FAMILIES registries against the
-    PrometheusMetrics declarations (both directions for the prefixes in
-    REGISTRY_OWNED_PREFIXES)."""
-    metrics_path = repo_root / "limitador_tpu" / "observability" / "metrics.py"
-    package_root = repo_root / "limitador_tpu"
-    if not metrics_path.exists():
-        return []
-    declared = declared_metric_families(metrics_path)
-    registered = registered_metric_families(package_root)
-    findings = []
-    for path, lineno, name in registered:
-        if name not in declared:
-            findings.append(
-                f"{path}:{lineno}: metric family '{name}' is registered "
-                "but not declared in observability/metrics.py"
-            )
-    registered_names = {name for _p, _l, name in registered}
-    for prefix, registry in sorted(REGISTRY_OWNED_PREFIXES.items()):
-        for name in sorted(declared):
-            if name.startswith(prefix) and name not in registered_names:
-                findings.append(
-                    f"{metrics_path}:0: metric family '{name}' is "
-                    f"declared but missing from {registry}'s "
-                    "METRIC_FAMILIES registry"
-                )
-    return findings
+def lint_metric_registry(repo_root) -> List[str]:
+    ctx = RepoContext(repo_root)
+    return _legacy(ctx, metric_registry_findings(ctx))
 
 
-def _module_string_tuple(path: Path, name: str) -> List[str]:
-    """Entries of a module-level ``NAME = ("a", "b", ...)`` tuple/list
-    assignment (string constants only)."""
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except (OSError, SyntaxError):
-        return []
-    out: List[str] = []
-    for node in tree.body:
-        if not (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == name
-                for t in node.targets
-            )
-            and isinstance(node.value, (ast.Tuple, ast.List))
-        ):
-            continue
-        for elt in node.value.elts:
-            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
-                out.append(elt.value)
-    return out
+def lint_native_phases(repo_root) -> List[str]:
+    ctx = RepoContext(repo_root)
+    return _legacy(ctx, native_phase_findings(ctx))
 
 
-def lint_native_phases(repo_root: Path) -> List[str]:
-    """Cross-check the native telemetry plane's PHASES tuple: every
-    phase needs a ``native_phase_<phase>`` histogram family declared in
-    observability/metrics.py and registered in native_plane's
-    METRIC_FAMILIES — otherwise that phase's drain silently never
-    renders."""
-    plane_path = repo_root / NATIVE_PLANE_MODULE
-    metrics_path = (
-        repo_root / "limitador_tpu" / "observability" / "metrics.py"
-    )
-    if not plane_path.exists() or not metrics_path.exists():
-        return []
-    phases = _module_string_tuple(plane_path, "PHASES")
-    registered = set(_module_string_tuple(plane_path, "METRIC_FAMILIES"))
-    declared = declared_metric_families(metrics_path)
-    findings = []
-    for phase in phases:
-        family = f"native_phase_{phase}"
-        if family not in declared:
-            findings.append(
-                f"{plane_path}:0: PHASES entry '{phase}' has no "
-                f"'{family}' histogram family declared in "
-                "observability/metrics.py"
-            )
-        if family not in registered:
-            findings.append(
-                f"{plane_path}:0: PHASES entry '{phase}' has no "
-                f"'{family}' entry in METRIC_FAMILIES"
-            )
-    return findings
+def lint_debug_sections(repo_root) -> List[str]:
+    ctx = RepoContext(repo_root)
+    return _legacy(ctx, debug_section_findings(ctx))
 
 
-def _debug_section_tuples(path: Path, name: str) -> List[str]:
-    """First elements of a module-level ``NAME = (("k", "attr"), ...)``
-    tuple-of-pairs assignment."""
-    try:
-        tree = ast.parse(path.read_text(), filename=str(path))
-    except (OSError, SyntaxError):
-        return []
-    out: List[str] = []
-    for node in tree.body:
-        if not (
-            isinstance(node, ast.Assign)
-            and any(
-                isinstance(t, ast.Name) and t.id == name
-                for t in node.targets
-            )
-            and isinstance(node.value, (ast.Tuple, ast.List))
-        ):
-            continue
-        for elt in node.value.elts:
-            if (
-                isinstance(elt, (ast.Tuple, ast.List)) and elt.elts
-                and isinstance(elt.elts[0], ast.Constant)
-                and isinstance(elt.elts[0].value, str)
-            ):
-                out.append(elt.elts[0].value)
-    return out
+def lint_ctypes_signatures(repo_root) -> List[str]:
+    # legacy format for this pass: repo-relative path, NO line prefix
+    # ("native/hostpath.cc: exported symbol ...")
+    ctx = RepoContext(repo_root)
+    return [f"{f.path}: {f.message}" for f in abi_findings(ctx)]
 
 
-def lint_debug_sections(repo_root: Path) -> List[str]:
-    """Cross-check the /debug/stats section registry (the
-    lint_native_phases pattern generalized to the debug surface): every
-    section http_api.py serves — a ``stats["..."] = ...`` literal store
-    or a DEBUG_SOURCE_SECTIONS entry — must appear in its
-    DEBUG_STATS_SECTIONS tuple, and every registered name must actually
-    be served. A renamed or orphaned section fails the gate instead of
-    silently vanishing from the endpoint dashboards and benches
-    scrape."""
-    api_path = repo_root / HTTP_API_MODULE
-    if not api_path.exists():
-        return []
-    registered = set(_module_string_tuple(api_path, "DEBUG_STATS_SECTIONS"))
-    served: dict = {}  # name -> lineno
-    for name in _debug_section_tuples(api_path, "DEBUG_SOURCE_SECTIONS"):
-        served.setdefault(name, 0)
-    try:
-        tree = ast.parse(api_path.read_text(), filename=str(api_path))
-    except SyntaxError:
-        return []  # reported by lint_file
-    for node in ast.walk(tree):
-        if not (
-            isinstance(node, ast.Assign)
-            and len(node.targets) == 1
-            and isinstance(node.targets[0], ast.Subscript)
-        ):
-            continue
-        target = node.targets[0]
-        if not (
-            isinstance(target.value, ast.Name)
-            and target.value.id == "stats"
-        ):
-            continue
-        sl = target.slice
-        if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
-            served.setdefault(sl.value, node.lineno)
-    findings = []
-    for name, lineno in sorted(served.items()):
-        if name not in registered:
-            findings.append(
-                f"{api_path}:{lineno}: /debug/stats section '{name}' is "
-                "served but missing from DEBUG_STATS_SECTIONS"
-            )
-    for name in sorted(registered - set(served)):
-        findings.append(
-            f"{api_path}:0: DEBUG_STATS_SECTIONS entry '{name}' is "
-            "registered but never served by get_debug_stats"
-        )
-    return findings
-
-
-def exported_c_symbols(source: str):
-    """(name, return_type, has_params) for every exported C function in
-    a translation unit (prefix-filtered; extern "C" definitions in this
-    repo all sit at column 0 with the return type on the same line)."""
-    import re
-
-    out = []
-    pattern = re.compile(
-        r"^([A-Za-z_][A-Za-z0-9_]*\s*\**)\s+("
-        + "|".join(p + r"[a-z0-9_]+" for p in CTYPES_SYMBOL_PREFIXES)
-        + r")\s*\(([^)]*)",
-        re.MULTILINE,
-    )
-    for match in pattern.finditer(source):
-        ret = match.group(1).replace(" ", "")
-        name = match.group(2)
-        params = match.group(3).strip()
-        # multi-line parameter lists never close on the match line; an
-        # empty first-line capture with more lines following still means
-        # "has params" only when the very next char isn't ')'
-        has_params = params not in ("", "void")
-        out.append((name, ret, has_params))
-    return out
-
-
-def declared_ctypes_signatures(source: str):
-    """{symbol: {"restype", "argtypes"}} assignments in a binding
-    module (``lib.<symbol>.restype = ...`` / ``.argtypes = ...``)."""
-    import re
-
-    out: dict = {}
-    for match in re.finditer(
-        r"lib\.([A-Za-z_][A-Za-z0-9_]*)\.(restype|argtypes)\s*=", source
-    ):
-        out.setdefault(match.group(1), set()).add(match.group(2))
-    return out
-
-
-def lint_ctypes_signatures(repo_root: Path) -> List[str]:
-    """Signature-drift gate for the native ABI: every symbol exported
-    from the C sources must have a ctypes ``argtypes`` declaration on
-    the Python side (non-void returns also need ``restype``), and every
-    Python-side declaration must name a symbol that still exists — a
-    renamed/removed export fails the gate instead of segfaulting at
-    call time."""
-    findings: List[str] = []
-    exported: dict = {}
-    for rel in CTYPES_SOURCES:
-        path = repo_root / rel
-        if not path.exists():
-            continue
-        for name, ret, has_params in exported_c_symbols(path.read_text()):
-            exported[name] = (rel, ret, has_params)
-    declared: dict = {}
-    for rel in CTYPES_BINDINGS:
-        path = repo_root / rel
-        if not path.exists():
-            continue
-        for name, kinds in declared_ctypes_signatures(
-            path.read_text()
-        ).items():
-            declared.setdefault(name, set()).update(kinds)
-    if not exported or not declared:
-        return findings
-    for name, (rel, ret, has_params) in sorted(exported.items()):
-        kinds = declared.get(name)
-        if kinds is None:
-            findings.append(
-                f"{rel}: exported symbol '{name}' has no ctypes "
-                "declaration in the binding modules (drift: a call "
-                "through the default int-sized signature corrupts "
-                "arguments silently)"
-            )
-            continue
-        if has_params and "argtypes" not in kinds:
-            findings.append(
-                f"{rel}: exported symbol '{name}' takes parameters but "
-                "the binding declares no argtypes"
-            )
-        if ret != "void" and "restype" not in kinds:
-            findings.append(
-                f"{rel}: exported symbol '{name}' returns {ret} but the "
-                "binding declares no restype (ctypes truncates to int)"
-            )
-    for name in sorted(declared):
-        if not name.startswith(CTYPES_SYMBOL_PREFIXES):
-            continue
-        if name not in exported:
-            findings.append(
-                f"limitador_tpu/native: binding declares '{name}' but no "
-                "native source exports it (renamed or removed symbol)"
-            )
-    return findings
-
-
-def _is_jax_jit(node) -> bool:
-    return (
-        isinstance(node, ast.Attribute) and node.attr == "jit"
-        and isinstance(node.value, ast.Name) and node.value.id == "jax"
-    )
-
-
-def lint_donation(repo_root: Path) -> List[str]:
-    """Flag ``jax.jit`` call sites in the kernel modules whose wrapped
-    function carries the counter table (DONATION_PARAMS) but passes no
-    ``donate_argnums``: without donation XLA copies the whole table on
-    every launch instead of updating it in place. Covers the three site
-    shapes the kernels use — ``@jax.jit``, ``@functools.partial(jax.jit,
-    ...)`` and ``functools.partial(jax.jit, ...)(fn)`` — and allowlists
-    the read-only kernels (DONATION_EXEMPT)."""
-    findings: List[str] = []
-    for rel in DONATION_CHECKED_MODULES:
-        path = repo_root / rel
-        if not path.exists():
-            continue
-        src = path.read_text()
-        try:
-            tree = ast.parse(src, filename=str(path))
-        except SyntaxError:
-            continue  # reported by lint_file
-        lines = src.splitlines()
-        funcs = {
-            node.name: node
-            for node in ast.walk(tree)
-            if isinstance(node, ast.FunctionDef)
-        }
-
-        def check(lineno: int, kwargs, fn_name: str) -> None:
-            fn_node = funcs.get(fn_name)
-            if fn_node is None or fn_name in DONATION_EXEMPT:
-                return
-            params = sorted(
-                {a.arg for a in fn_node.args.args} & DONATION_PARAMS
-            )
-            if not params or "donate_argnums" in kwargs:
-                return
-            if 0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]:
-                return
-            findings.append(
-                f"{path}:{lineno}: jax.jit site for table-carrying "
-                f"kernel '{fn_name}' (params {params}) passes no "
-                "donate_argnums — every launch would copy the counter "
-                "table instead of updating it in place"
-            )
-
-        for node in ast.walk(tree):
-            if isinstance(node, ast.FunctionDef):
-                for dec in node.decorator_list:
-                    if _is_jax_jit(dec):
-                        check(dec.lineno, set(), node.name)
-                    elif isinstance(dec, ast.Call):
-                        kwargs = {k.arg for k in dec.keywords}
-                        if _is_jax_jit(dec.func):
-                            check(dec.lineno, kwargs, node.name)
-                        elif (
-                            isinstance(dec.func, ast.Attribute)
-                            and dec.func.attr == "partial"
-                            and dec.args and _is_jax_jit(dec.args[0])
-                        ):
-                            check(dec.lineno, kwargs, node.name)
-            elif isinstance(node, ast.Call):
-                func = node.func
-                wrapped = (
-                    node.args[0].id
-                    if node.args and isinstance(node.args[0], ast.Name)
-                    else None
-                )
-                if wrapped is None:
-                    continue
-                if (
-                    isinstance(func, ast.Call)
-                    and isinstance(func.func, ast.Attribute)
-                    and func.func.attr == "partial"
-                    and func.args and _is_jax_jit(func.args[0])
-                ):
-                    # functools.partial(jax.jit, ...)(fn)
-                    check(
-                        node.lineno, {k.arg for k in func.keywords}, wrapped
-                    )
-                elif _is_jax_jit(func):
-                    # jax.jit(fn, ...)
-                    check(
-                        node.lineno, {k.arg for k in node.keywords}, wrapped
-                    )
-    return findings
-
-
-def _imported_bindings(tree: ast.AST):
-    """(lineno, bound_name, scope_id) for every import; scope_id keys
-    the nearest enclosing function/class/module, so a deliberate lazy
-    re-import inside a function never collides with the module scope
-    (pyflakes F811 is same-scope only too)."""
-    out = []
-
-    class V(ast.NodeVisitor):
-        def __init__(self):
-            self.scope = [id(tree)]
-
-        def visit_Import(self, node):
-            for alias in node.names:
-                bound = alias.asname or alias.name.split(".")[0]
-                # redef key keeps the dotted path: `import urllib.request`
-                # and `import urllib.error` both bind 'urllib' on purpose
-                out.append(
-                    (node.lineno, bound, alias.name, self.scope[-1])
-                )
-
-        def visit_ImportFrom(self, node):
-            if node.module == "__future__":
-                return  # compiler directive, not a binding
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                bound = alias.asname or alias.name
-                out.append(
-                    (node.lineno, bound, bound, self.scope[-1])
-                )
-
-        def _scoped(self, node):
-            self.scope.append(id(node))
-            self.generic_visit(node)
-            self.scope.pop()
-
-        visit_FunctionDef = _scoped
-        visit_AsyncFunctionDef = _scoped
-        visit_ClassDef = _scoped
-        visit_Lambda = _scoped
-
-    V().visit(tree)
-    return out
-
-
-def _used_names(tree: ast.AST):
-    used = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Name):
-            used.add(node.id)
-        elif isinstance(node, ast.Attribute):
-            # "a.b.c" usage roots at the Name, already collected
-            pass
-        elif isinstance(node, ast.Assign):
-            for target in node.targets:
-                if (
-                    isinstance(target, ast.Name)
-                    and target.id == "__all__"
-                    and isinstance(node.value, (ast.List, ast.Tuple))
-                ):
-                    for elt in node.value.elts:
-                        if isinstance(elt, ast.Constant) and isinstance(
-                            elt.value, str
-                        ):
-                            used.add(elt.value)
-    return used
-
-
-def lint_file(path: Path) -> List[Tuple[int, str]]:
-    src = path.read_text()
-    lines = src.splitlines()
-
-    def suppressed(lineno: int) -> bool:
-        return (
-            0 < lineno <= len(lines) and "# noqa" in lines[lineno - 1]
-        )
-
-    try:
-        tree = ast.parse(src, filename=str(path))
-    except SyntaxError as exc:
-        return [(exc.lineno or 0, f"syntax error: {exc.msg}")]
-
-    findings: List[Tuple[int, str]] = []
-
-    # unused + same-scope-redefined imports
-    bindings = _imported_bindings(tree)
-    used = _used_names(tree)
-    seen: dict = {}
-    for lineno, name, full, scope in bindings:
-        key = (full, scope)
-        if key in seen and not suppressed(lineno):
-            findings.append(
-                (lineno, f"import '{name}' redefines line {seen[key]}")
-            )
-        seen.setdefault(key, lineno)
-    for lineno, name, _full, _scope in bindings:
-        if name not in used and not suppressed(lineno):
-            findings.append((lineno, f"unused import '{name}'"))
-
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if not suppressed(node.lineno):
-                findings.append(
-                    (node.lineno, "bare 'except:' swallows everything")
-                )
-        elif isinstance(
-            node, (ast.FunctionDef, ast.AsyncFunctionDef)
-        ):
-            for default in (
-                list(node.args.defaults) + list(node.args.kw_defaults)
-            ):
-                if isinstance(
-                    default, (ast.List, ast.Dict, ast.Set)
-                ) and not suppressed(default.lineno):
-                    findings.append((
-                        default.lineno,
-                        f"mutable default argument in '{node.name}'",
-                    ))
-        elif isinstance(node, ast.Compare):
-            for op, comp in zip(node.ops, node.comparators):
-                if (
-                    isinstance(op, (ast.Eq, ast.NotEq))
-                    and isinstance(comp, ast.Constant)
-                    and (comp.value is None or comp.value is True
-                         or comp.value is False)
-                    and not suppressed(node.lineno)
-                ):
-                    findings.append((
-                        node.lineno,
-                        f"comparison to {comp.value!r} with ==/!= "
-                        "(use is/is not or truthiness)",
-                    ))
-        elif isinstance(node, ast.Dict):
-            keys = [
-                k.value
-                for k in node.keys
-                if isinstance(k, ast.Constant)
-                and isinstance(k.value, (str, int))
-            ]
-            dupes = {k for k in keys if keys.count(k) > 1}
-            if dupes and not suppressed(node.lineno):
-                findings.append((
-                    node.lineno,
-                    f"duplicate dict keys: {sorted(map(repr, dupes))}",
-                ))
-
-    for i, line in enumerate(lines, 1):
-        if "# noqa" in line:
-            continue
-        stripped = line.rstrip("\n")
-        if stripped != stripped.rstrip():
-            findings.append((i, "trailing whitespace"))
-        indent = stripped[: len(stripped) - len(stripped.lstrip())]
-        if "\t" in indent:
-            findings.append((i, "tab in indentation"))
-
-    return sorted(findings)
-
-
-def _iter_files(targets) -> List[Path]:
-    files = []
-    for target in targets:
-        p = Path(target)
-        if p.is_dir():
-            files.extend(sorted(p.rglob("*.py")))
-        elif p.suffix == ".py":
-            files.append(p)
-    # generated protobuf output is protoc's style, not ours
-    return [f for f in files if not f.name.endswith("_pb2.py")
-            and not f.name.endswith("_pb2_grpc.py")]
-
-
-def lint_paths(targets) -> List[str]:
-    out = []
-    for f in _iter_files(targets):
-        for lineno, msg in lint_file(f):
-            out.append(f"{f}:{lineno}: {msg}")
-    return out
+def lint_donation(repo_root) -> List[str]:
+    ctx = RepoContext(repo_root)
+    return _legacy(ctx, donation_findings(ctx))
 
 
 def main(argv=None) -> int:
+    """Historical CLI: now the full analysis gate (every registered
+    pass, baseline applied). ``python -m limitador_tpu.tools.analysis``
+    is the first-class interface with --list/--only/--json."""
+    from .analysis.__main__ import main as analysis_main
+
     argv = list(sys.argv[1:] if argv is None else argv)
-    targets = argv or list(DEFAULT_TARGETS)
-    findings = lint_paths(targets)
-    repo_root = Path(__file__).resolve().parent.parent.parent
-    findings.extend(lint_metric_registry(repo_root))
-    findings.extend(lint_donation(repo_root))
-    findings.extend(lint_ctypes_signatures(repo_root))
-    findings.extend(lint_native_phases(repo_root))
-    findings.extend(lint_debug_sections(repo_root))
-    for finding in findings:
-        print(finding)
-    if findings:
-        print(f"{len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    return 0
+    return analysis_main(argv)
 
 
 if __name__ == "__main__":

@@ -71,6 +71,11 @@ inline void tel_observe(int64_t ns) {
   uint64_t v = (uint64_t)ns;
   while (v >>= 1) b++;
   if (b >= H2I_TEL_BUCKETS) b = H2I_TEL_BUCKETS - 1;
+  // relaxed: independently-monotone counters; a concurrent drain may
+  // split one observation's (count, sum, bucket) triple across two
+  // drains — the Python side converts per-bucket deltas, so the
+  // observation lands whole next drain (same invariant as hostpath's
+  // tel_observe, AUDITED ISSUE 9)
   g_tel_count.fetch_add(1, std::memory_order_relaxed);
   g_tel_sum.fetch_add((uint64_t)ns, std::memory_order_relaxed);
   g_tel_buckets[b].fetch_add(1, std::memory_order_relaxed);
@@ -956,6 +961,9 @@ void drain_responses(Ctx* c) {
 
 void io_loop(Ctx* c) {
   epoll_event evs[256];
+  // relaxed: stop is a pure shutdown latch polled once per epoll tick;
+  // h2i_close joins this thread after setting it, and the join (not
+  // the flag) is the synchronization point for teardown state
   while (!c->stop.load(std::memory_order_relaxed)) {
     int n = epoll_wait(c->epoll_fd, evs, 256, 100);
     for (int i = 0; i < n; i++) {
@@ -1048,8 +1056,18 @@ int h2i_take(void* vc, int max_n, int timeout_ms, uint64_t* ids,
   Ctx* c = (Ctx*)vc;
   std::unique_lock<std::mutex> lk(c->mu);
   if (c->ready.empty()) {
-    c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
-                   [&] { return !c->ready.empty() || c->stop.load(); });
+    // wait_until(system_clock) instead of wait_for: FOUND BY THE RACE
+    // HUNT (ISSUE 9). libstdc++'s wait_for lowers to
+    // pthread_cond_clockwait (CLOCK_MONOTONIC), which this toolchain's
+    // TSAN does not intercept — the sanitizer then models the mutex as
+    // never released across the wait and every h2i critical section
+    // cross-reports as a race. wait_until(system_clock) lowers to the
+    // intercepted pthread_cond_timedwait. Cost: a wall-clock jump can
+    // stretch/shrink one 10-100ms pump poll — the pump loops anyway.
+    c->cv.wait_until(lk,
+                     std::chrono::system_clock::now()
+                         + std::chrono::milliseconds(timeout_ms),
+                     [&] { return !c->ready.empty() || c->stop.load(); });
   }
   int n = 0;
   while (n < max_n && !c->ready.empty()) {
@@ -1109,6 +1127,8 @@ void h2i_set_code(void* vc, int code, int status, const uint8_t* payload,
 void h2i_respond_coded(void* vc, int n, const uint64_t* ids,
                        const int8_t* codes) {
   Ctx* c = (Ctx*)vc;
+  // relaxed: enable flag gates clock reads only; a respond straddling
+  // a config flip measures (or skips) this one batch
   const int32_t tel = g_tel_enabled.load(std::memory_order_relaxed);
   const int64_t tel_t0 = tel ? tel_now_ns() : 0;
   int queued = 0;
@@ -1132,6 +1152,7 @@ void h2i_respond_coded(void* vc, int n, const uint64_t* ids,
 // ---- respond-path telemetry (native telemetry plane, ISSUE 7) -------------
 
 void h2i_tel_config(int32_t enabled) {
+  // relaxed: single self-contained flag, nothing published through it
   g_tel_enabled.store(enabled, std::memory_order_relaxed);
 }
 
@@ -1142,6 +1163,10 @@ void h2i_tel_config(int32_t enabled) {
 int32_t h2i_tel_drain(int64_t* out, int64_t cap) {
   const int64_t need = 2 + H2I_TEL_BUCKETS;
   int64_t idx = 0;
+  // relaxed reads of monotone counters (see tel_observe's invariant):
+  // a one-observation skew between count/sum/buckets self-corrects at
+  // the next drain; snapshot consistency would need a lock the
+  // wait-free respond path exists to avoid
   if (idx < cap)
     out[idx++] = (int64_t)g_tel_count.load(std::memory_order_relaxed);
   if (idx < cap)

@@ -343,10 +343,17 @@ struct ParallelPool {
 // ordering; exit() never joins detached-by-leak workers.
 ParallelPool* g_pool = nullptr;
 std::mutex g_pool_mu;
-int g_threads = -1;  // -1 = derive from hardware on first use
+// FOUND BY THE RACE HUNT (ISSUE 9): this was a plain int — written by
+// hp_set_threads (Python config path) while lane_threads() read it
+// inside concurrent begins, a genuine data race TSAN flagged in the
+// first drive. Atomic now; relaxed is sufficient because the value is
+// an independent sizing hint: a begin that reads the pre-update count
+// just sizes one pass with the old thread budget.
+std::atomic<int> g_threads{-1};  // -1 = derive from hardware on first use
 
 int lane_threads() {
-  if (g_threads >= 0) return g_threads;
+  int configured = g_threads.load(std::memory_order_relaxed);
+  if (configured >= 0) return configured;
   unsigned hw = std::thread::hardware_concurrency();
   int n = (int)(hw == 0 ? 1 : hw);
   return n > 4 ? 4 : n;
@@ -417,6 +424,8 @@ Tel g_tel;
 
 int tel_bank_id() {
   static std::atomic<int> next{0};
+  // relaxed: bank assignment only needs per-thread uniqueness-mod-N;
+  // no other memory is published through this counter
   thread_local int id =
       next.fetch_add(1, std::memory_order_relaxed) & (TEL_BANKS - 1);
   return id;
@@ -435,6 +444,10 @@ inline void tel_observe(int phase, int64_t ns) {
   while (v >>= 1) b++;  // floor(log2); 0/1 land in bucket 0
   if (b >= TEL_BUCKETS) b = TEL_BUCKETS - 1;
   TelBank& bank = g_tel.banks[tel_bank_id()];
+  // relaxed: each counter is independently monotone; nothing reads
+  // them for synchronization. A drain may observe count updated but
+  // sum/bucket not yet (or vice versa) — bounded one-observation skew,
+  // self-correcting at the next drain (see hp_tel_drain).
   bank.count[phase].fetch_add(1, std::memory_order_relaxed);
   bank.sum[phase].fetch_add((uint64_t)ns, std::memory_order_relaxed);
   bank.buckets[phase][b].fetch_add(1, std::memory_order_relaxed);
@@ -866,7 +879,11 @@ int64_t hp_slots_count(void* c) {
 // the device result columns. Calls are GIL-free (ctypes) and the begin
 // passes parallelize across the worker pool for large batches.
 
-void hp_set_threads(int32_t n) { g_threads = n < 0 ? -1 : n; }
+// relaxed: sizing hint only — no data is published through it (see
+// the g_threads declaration; promoted from a plain int by the hunt)
+void hp_set_threads(int32_t n) {
+  g_threads.store(n < 0 ? -1 : n, std::memory_order_relaxed);
+}
 
 void hp_plan_epoch(void* c, int64_t epoch) {
   ((Ctx*)c)->mirror.sync_epoch(epoch);
@@ -1094,6 +1111,9 @@ int32_t hp_usage_drain(void* c, uint8_t* out_blobs, int64_t blob_cap,
 // (0 = off) for sampled end-to-end tracing.
 void hp_tel_config(int32_t enabled, int64_t slow_row_ns,
                    int64_t trace_sample) {
+  // relaxed: three independent flags, each self-contained — a begin
+  // that observes a mixed old/new combination behaves like either
+  // config, never incorrectly (no invariant couples them)
   g_tel.enabled.store(enabled, std::memory_order_relaxed);
   g_tel.slow_ns.store(slow_row_ns < 0 ? 0 : slow_row_ns,
                       std::memory_order_relaxed);
@@ -1113,6 +1133,17 @@ int32_t hp_tel_drain(int64_t* out, int64_t cap) {
   int64_t idx = 0;
   for (int p = 0; p < TEL_PHASES && idx < cap; p++) {
     uint64_t count = 0, sum = 0;
+    // relaxed (AUDITED, ISSUE 9 — the prime suspect): these are the
+    // cross-thread histogram reads. Invariant the consumer relies on:
+    // every counter is individually monotone, and the Python side
+    // (native_plane.py) converts PER-BUCKET deltas against its own
+    // kept baseline — so a drain that interleaves with an in-flight
+    // tel_observe can under-read one observation's (count, sum,
+    // bucket) triple inconsistently, and that observation simply
+    // lands whole in the next drain. Acquire would not buy snapshot
+    // consistency here anyway (no single release point covers all
+    // banks); a consistent snapshot would need the banks behind a
+    // lock, which the wait-free hot path exists to avoid.
     for (int k = 0; k < TEL_BANKS; k++) {
       count += g_tel.banks[k].count[p].load(std::memory_order_relaxed);
       sum += g_tel.banks[k].sum[p].load(std::memory_order_relaxed);
@@ -1121,6 +1152,7 @@ int32_t hp_tel_drain(int64_t* out, int64_t cap) {
     if (idx < cap) out[idx++] = (int64_t)sum;
     for (int b = 0; b < TEL_BUCKETS && idx < cap; b++) {
       uint64_t c = 0;
+      // relaxed: same per-bucket monotone invariant as count/sum above
       for (int k = 0; k < TEL_BANKS; k++)
         c += g_tel.banks[k].buckets[p][b].load(std::memory_order_relaxed);
       out[idx++] = (int64_t)c;
@@ -1184,6 +1216,8 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   m.sync_epoch(epoch);
   std::vector<int64_t>& ent = ctx->scratch_ent;
   if ((int64_t)ent.size() < n) ent.resize(n);
+  // relaxed: enable flag gates clock reads only; a begin straddling a
+  // config flip just measures (or skips) this one batch
   const int32_t tel = g_tel.enabled.load(std::memory_order_relaxed);
   const int64_t tel_t0 = tel ? tel_now_ns() : 0;
 
@@ -1359,6 +1393,8 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
     tel_observe(TEL_HOT_LOOKUP, lookup_ns);
     tel_observe(TEL_HOT_STAGE, stage_ns);
     if (leased_rows > 0) tel_observe(TEL_LEASE_HIT, tel_t2 - tel_t0);
+    // relaxed: threshold is advisory per batch; exemplar ring itself
+    // is mutex-guarded (tel_push_exemplar)
     const int64_t slow = g_tel.slow_ns.load(std::memory_order_relaxed);
     if (slow > 0 && n > 0 && (tel_t2 - tel_t0) > slow * (int64_t)n) {
       // Slow begin: record the lead row's identity + lease/plan state
@@ -1391,6 +1427,8 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
     out_meta[8] = lookup_ns;
     out_meta[9] = stage_ns;
     out_meta[10] = leased_rows;
+    // relaxed: batch_seq only needs global uniqueness + roughly-1-in-N
+    // cadence for trace sampling; nothing is published through it
     const int64_t samp = g_tel.trace_sample.load(std::memory_order_relaxed);
     if (samp > 0) {
       uint64_t seq = g_tel.batch_seq.fetch_add(1, std::memory_order_relaxed)
@@ -1450,6 +1488,9 @@ void hp_hot_finish(void* c, const uint8_t* admitted, const uint8_t* hit_ok,
                    int32_t* out_lim_ns, int32_t* out_lim_name,
                    int64_t* out_lim_count, int64_t* out_counts) {
   (void)c;
+  // relaxed: same enable-flag invariant as the begin side — this call
+  // may run with a NULL ctx after an interner recycle, which is WHY
+  // the plane is process-global (see the Tel comment)
   const int32_t tel = g_tel.enabled.load(std::memory_order_relaxed);
   const int64_t tel_t0 = tel ? tel_now_ns() : 0;
   int32_t n_ok = 0, n_lim = 0;

@@ -1,0 +1,294 @@
+// Concurrency race-hunt driver for native/hostpath.cc (ISSUE 9).
+//
+// Compiled standalone with -fsanitize=thread (see
+// limitador_tpu/native/build.py build_tool + tests/test_race_hunt.py):
+// dlopen'ing a TSAN .so into a plain CPython needs the runtime
+// preloaded, so the hunt drives the library as its own process instead
+// — same TU, same code, full sanitizer coverage.
+//
+// The driver reproduces the PRODUCTION locking discipline, not a
+// free-for-all: begins, lease traffic, usage drains and context swaps
+// all serialize on one mutex (the Python side's per-pipeline native
+// lock + storage lock span), because racing those is a bug in the
+// CALLER by contract. What must be clean WITHOUT the lock — and what
+// this hunt actually hammers from unsynchronized threads — is:
+//
+//   * the wait-free telemetry plane: hp_tel_drain / hp_tel_exemplars /
+//     hp_tel_config racing tel_observe from every begin/finish;
+//   * hp_hot_finish with a NULL ctx racing begins and hp_free (the
+//     interner-recycle contract: pendings outlive their context);
+//   * hp_set_threads racing lane_threads() inside large begins (the
+//     worker-pool sizing path);
+//   * the in-library ParallelPool itself (one serving thread uses
+//     4096-row batches to engage it);
+//   * hp_partition_positions on private buffers.
+//
+// Exit 0 with a "RACE_HUNT_OK ops=<n>" line; any ThreadSanitizer
+// report fails the suite (TSAN_OPTIONS exitcode + output scan).
+
+#include "hostpath.cc"
+
+#include <cinttypes>
+#include <cstdio>
+#include <random>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kEpoch = 7;
+constexpr int kPlans = 64;
+constexpr int64_t kCap = 1 << 14;
+constexpr int64_t kScratchSlot = 100000;
+
+std::mutex pipeline_mu;  // models the Python native+storage lock span
+void* g_ctx_ptr = nullptr;  // guarded by pipeline_mu
+std::atomic<bool> g_done{false};
+std::atomic<uint64_t> g_ops{0};
+
+std::vector<std::string> make_blobs() {
+  std::vector<std::string> out;
+  for (int i = 0; i < kPlans; i++) {
+    std::string b = "blob-" + std::to_string(i) + "-";
+    for (int j = 0; j < (i % 23); j++) b.push_back((char)('a' + j));
+    out.push_back(b);
+  }
+  return out;
+}
+
+void seed_plans(void* ctx, const std::vector<std::string>& blobs) {
+  hp_plan_epoch(ctx, kEpoch);
+  for (int i = 0; i < (int)blobs.size(); i++) {
+    int32_t nhits = 1 + (i % 2);
+    int32_t rec[2 * REC_STRIDE];
+    for (int32_t h = 0; h < nhits; h++) {
+      rec[h * REC_STRIDE + 0] = (i * 2 + h) % 1000;  // slot
+      rec[h * REC_STRIDE + 1] = 1000;                // max_value
+      rec[h * REC_STRIDE + 2] = 1000;                // window_ms
+      rec[h * REC_STRIDE + 3] = i % 2;               // bucket flag
+      rec[h * REC_STRIDE + 4] = i;                   // name token
+    }
+    int32_t kind = (i % 4 == 3) ? LANE_OK : LANE_KERNEL;
+    hp_plan_put(ctx, (const uint8_t*)blobs[i].data(),
+                (int32_t)blobs[i].size(), kEpoch, kind, i % 8, 1, 1, rec,
+                kind == LANE_OK ? 0 : nhits);
+  }
+}
+
+// per-thread staging buffers, sized once
+struct Bufs {
+  int32_t n;
+  std::vector<const uint8_t*> ptrs;
+  std::vector<uint32_t> lens;
+  std::vector<int8_t> kind;
+  std::vector<int32_t> slots, deltas, maxes, windows, req;
+  std::vector<uint8_t> bucket, admitted, hit_ok;
+  std::vector<int32_t> rows, row_nhits, row_delta, row_ns, hit_names;
+  std::vector<int32_t> ok_ns, lim_ns, lim_name;
+  std::vector<int64_t> ok_calls, ok_hits, lim_count;
+  int64_t meta[12];
+  int64_t counts[2];
+
+  explicit Bufs(int32_t rows_n) : n(rows_n) {
+    ptrs.resize(n);
+    lens.resize(n);
+    kind.resize(n);
+    slots.resize(kCap);
+    deltas.resize(kCap);
+    maxes.resize(kCap);
+    windows.resize(kCap);
+    req.resize(kCap);
+    bucket.resize(kCap);
+    admitted.resize(n);
+    hit_ok.resize(kCap);
+    rows.resize(n);
+    row_nhits.resize(n);
+    row_delta.resize(n);
+    row_ns.resize(n);
+    hit_names.resize(kCap);
+    ok_ns.resize(n);
+    ok_calls.resize(n);
+    ok_hits.resize(n);
+    lim_ns.resize(n);
+    lim_name.resize(n);
+    lim_count.resize(n);
+  }
+};
+
+void serving_worker(int seed, int32_t batch_rows) {
+  Bufs b(batch_rows);
+  std::mt19937 rng(seed);
+  const std::vector<std::string> blobs = make_blobs();
+  while (!g_done.load()) {
+    int32_t k;
+    int64_t nhits;
+    {
+      std::lock_guard<std::mutex> lk(pipeline_mu);
+      void* ctx = g_ctx_ptr;
+      for (int32_t r = 0; r < b.n; r++) {
+        const std::string& blob = blobs[rng() % blobs.size()];
+        b.ptrs[r] = (const uint8_t*)blob.data();
+        b.lens[r] = (uint32_t)blob.size();
+      }
+      k = hp_hot_begin(ctx, b.ptrs.data(), b.lens.data(), b.n, kEpoch,
+                       b.kind.data(), b.slots.data(), b.deltas.data(),
+                       b.maxes.data(), b.windows.data(), b.req.data(),
+                       b.bucket.data(), kCap, kScratchSlot, b.rows.data(),
+                       b.row_nhits.data(), b.row_delta.data(),
+                       b.row_ns.data(), b.hit_names.data(), b.ok_ns.data(),
+                       b.ok_calls.data(), b.ok_hits.data(), b.meta);
+      nhits = b.meta[1];
+    }
+    // Device "result" + finish OUTSIDE the lock, NULL ctx — exactly the
+    // interner-recycle contract production relies on.
+    for (int32_t i = 0; i < k; i++) b.admitted[i] = (uint8_t)(rng() & 1);
+    for (int64_t h = 0; h < nhits; h++) b.hit_ok[h] = (uint8_t)(rng() & 1);
+    hp_hot_finish(nullptr, b.admitted.data(), b.hit_ok.data(), k,
+                  b.rows.data(), b.row_nhits.data(), b.row_delta.data(),
+                  b.row_ns.data(), b.hit_names.data(), b.kind.data(),
+                  b.ok_ns.data(), b.ok_calls.data(), b.ok_hits.data(),
+                  b.lim_ns.data(), b.lim_name.data(), b.lim_count.data(),
+                  b.counts);
+    g_ops.fetch_add(1);
+  }
+}
+
+void broker_worker() {
+  std::vector<uint8_t> cand_blobs(1 << 16);
+  std::vector<int32_t> cand_lens(256);
+  std::vector<int64_t> cand_counts(256), ret_ids(256), ret_tokens(256);
+  int64_t stats[8];
+  int64_t next_id = 1;
+  const std::vector<std::string> blobs = make_blobs();
+  std::mt19937 rng(99);
+  while (!g_done.load()) {
+    {
+      std::lock_guard<std::mutex> lk(pipeline_mu);
+      void* ctx = g_ctx_ptr;
+      hp_lease_config(ctx, 1, 4);
+      int32_t n = hp_lease_candidates(ctx, cand_blobs.data(),
+                                      (int64_t)cand_blobs.size(),
+                                      cand_lens.data(), cand_counts.data(),
+                                      256);
+      int64_t off = 0;
+      for (int32_t i = 0; i < n; i++) {
+        hp_lease_grant(ctx, cand_blobs.data() + off, cand_lens[i], kEpoch,
+                       next_id++, 64);
+        off += cand_lens[i];
+      }
+      const std::string& victim = blobs[rng() % blobs.size()];
+      hp_lease_tokens(ctx, (const uint8_t*)victim.data(),
+                      (int32_t)victim.size(), -1);
+      if ((rng() & 3) == 0)
+        hp_lease_revoke(ctx, (const uint8_t*)victim.data(),
+                        (int32_t)victim.size(), -1);
+      hp_lease_drain_returns(ctx, ret_ids.data(), ret_tokens.data(), 256);
+      hp_lease_stats(ctx, stats);
+    }
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+}
+
+void stats_worker() {
+  std::vector<uint8_t> blobs(1 << 16);
+  std::vector<int32_t> lens(256);
+  std::vector<int64_t> counts(256);
+  int64_t lane[8];
+  while (!g_done.load()) {
+    {
+      std::lock_guard<std::mutex> lk(pipeline_mu);
+      void* ctx = g_ctx_ptr;
+      hp_lane_stats(ctx, lane);
+      hp_usage_drain(ctx, blobs.data(), (int64_t)blobs.size(), lens.data(),
+                     counts.data(), 256);
+    }
+    std::this_thread::sleep_for(std::chrono::microseconds(300));
+  }
+}
+
+void telemetry_worker(int which) {
+  const int64_t need = (int64_t)TEL_PHASES * (2 + TEL_BUCKETS);
+  std::vector<int64_t> hist(need);
+  std::vector<int64_t> ex((size_t)TEL_EX_CAP * TEL_EX_STRIDE);
+  int flip = 0;
+  while (!g_done.load()) {
+    hp_tel_drain(hist.data(), need);
+    hp_tel_exemplars(ex.data(), TEL_EX_CAP);
+    if (which == 0 && (++flip & 15) == 0)
+      hp_tel_config(1, 1, 3);  // re-assert: stores race the observes
+    std::this_thread::sleep_for(std::chrono::microseconds(100));
+  }
+}
+
+void config_worker() {
+  int n = 1;
+  while (!g_done.load()) {
+    hp_set_threads(1 + (n++ % 4));  // races lane_threads() in begins
+    std::this_thread::sleep_for(std::chrono::microseconds(500));
+  }
+}
+
+void swap_worker() {
+  const std::vector<std::string> blobs = make_blobs();
+  while (!g_done.load()) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+    void* fresh = hp_new();
+    seed_plans(fresh, blobs);
+    void* old;
+    {
+      std::lock_guard<std::mutex> lk(pipeline_mu);
+      old = g_ctx_ptr;
+      g_ctx_ptr = fresh;
+    }
+    // free OUTSIDE the lock while NULL-ctx finishes may still run —
+    // the production recycle contract (finish never derefs its ctx)
+    hp_free(old);
+  }
+}
+
+void partition_worker() {
+  std::vector<int32_t> groups(4096);
+  std::vector<int64_t> counts(8), pos(4096);
+  std::mt19937 rng(7);
+  while (!g_done.load()) {
+    for (auto& g : groups) g = (int32_t)(rng() % 8);
+    hp_partition_positions(groups.data(), (int64_t)groups.size(), 8,
+                           counts.data(), pos.data());
+    std::this_thread::sleep_for(std::chrono::microseconds(200));
+  }
+}
+
+}  // namespace
+
+int main() {
+  const char* ms_env = getenv("RACE_HUNT_MS");
+  int run_ms = ms_env ? atoi(ms_env) : 2000;
+  if (run_ms <= 0) run_ms = 2000;
+
+  hp_tel_config(1, /*slow_row_ns=*/1, /*trace_sample=*/3);
+  void* ctx = hp_new();
+  seed_plans(ctx, make_blobs());
+  g_ctx_ptr = ctx;
+
+  std::vector<std::thread> threads;
+  threads.emplace_back(serving_worker, 1, 256);
+  threads.emplace_back(serving_worker, 2, 256);
+  threads.emplace_back(serving_worker, 3, 4096);  // engages the pool
+  threads.emplace_back(broker_worker);
+  threads.emplace_back(stats_worker);
+  threads.emplace_back(telemetry_worker, 0);
+  threads.emplace_back(telemetry_worker, 1);
+  threads.emplace_back(config_worker);
+  threads.emplace_back(swap_worker);
+  threads.emplace_back(partition_worker);
+
+  std::this_thread::sleep_for(std::chrono::milliseconds(run_ms));
+  g_done.store(true);
+  for (auto& t : threads) t.join();
+  {
+    std::lock_guard<std::mutex> lk(pipeline_mu);
+    hp_free(g_ctx_ptr);
+  }
+  printf("RACE_HUNT_OK ops=%" PRIu64 "\n", g_ops.load());
+  return 0;
+}

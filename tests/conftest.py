@@ -55,6 +55,9 @@ SERVER_ENV_VARS = frozenset({
     "TPU_NATIVE_TRACE_SAMPLE", "TPU_NATIVE_SLOW_ROW_US",
     "TPU_SLO_BUDGET_MS",
     "TPU_USAGE_TOPK", "TPU_USAGE_DRAIN_S", "TPU_USAGE_NEAR_THRESHOLD",
+    # an ambient sanitizer variant would silently slow every native
+    # budget test 2-20x (and a server subprocess would rebuild the .so)
+    "TPU_NATIVE_SANITIZE",
 })
 
 

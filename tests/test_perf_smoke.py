@@ -78,6 +78,12 @@ USAGE_DRAIN_BUDGET_MS = 50.0
 #: device round trip or a full metrics render inside the snapshot blows
 #: this by an order of magnitude.
 SIGNALS_RENDER_BUDGET_MS = 20.0
+#: wall-clock budget for the ENTIRE static-analysis gate (ISSUE 9):
+#: every registered pass over the full default target set, one shared
+#: parse per file. Measures ~4-5 s on the throttled CI box; the budget
+#: keeps the tier-1 gate under 10 s — a pass that re-parses per rule
+#: or goes quadratic over the call graph blows it immediately.
+ANALYSIS_GATE_BUDGET_S = 10.0
 
 
 def _blobs(n, users=512):
@@ -523,6 +529,22 @@ def test_signals_render_within_budget():
         f"/debug/signals render costs {per_call_ms:.1f} ms "
         f"(budget {SIGNALS_RENDER_BUDGET_MS} ms — did a device round "
         "trip or metrics render sneak into the snapshot?)"
+    )
+
+
+def test_analysis_gate_within_budget():
+    """The full pass-registry analysis run must stay inside the tier-1
+    time box (it rides every `make check` and the tier-1 suite)."""
+    from limitador_tpu.tools.analysis import repo_root, run_passes
+
+    t0 = time.perf_counter()
+    active, _suppressed = run_passes(repo_root())
+    elapsed = time.perf_counter() - t0
+    assert not active  # correctness asserted in test_analysis too
+    assert elapsed <= ANALYSIS_GATE_BUDGET_S, (
+        f"analysis gate took {elapsed:.1f} s "
+        f"(budget {ANALYSIS_GATE_BUDGET_S} s — did a pass start "
+        "re-parsing per rule or walking the call graph quadratically?)"
     )
 
 
