@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench race-hunt
+.PHONY: check check-all lint test test-all bench race-hunt pod-smoke
 
 check: lint test
 
@@ -28,6 +28,13 @@ test-all:
 
 race-hunt:
 	python -m pytest tests/test_race_hunt.py -q
+
+# 2-process jax.distributed CPU pod on this box (ISSUE 10): global-mesh
+# formation + the zero-cross-host-collective HLO lint + routed-ingress
+# byte-parity vs a single process. Slow; skips when the backend can't
+# form a pod.
+pod-smoke:
+	python -m pytest tests/test_pod.py -q
 
 bench:
 	python bench.py

@@ -29,6 +29,10 @@ The other BASELINE configs run with --config:
     --config fleet      N replica processes sharing one RLS port via
                         SO_REUSEPORT over one network authority (the
                         N-limitadors-one-Redis topology)
+    --config pod        1/2/4-process jax.distributed CPU pods on this
+                        box: summed owned-key device-lane throughput,
+                        pod_scaling_efficiency, and the routed-ingress
+                        local/forwarded split with the peer hop's p99
     --config backends   reference criterion scenarios per backend
     --config onbox      serving-stack closed-loop latency with the jax
                         backend pinned on-box (LIMITADOR_TPU_PLATFORM=cpu):
@@ -1167,6 +1171,253 @@ def bench_sharded():
     )
 
 
+def _bench_pod_worker(args):
+    """One process of the pod sweep (spawned by ``bench_pod``): forms
+    the pod, owns one CPU shard, and measures
+
+    - phase B (headline): decisions/s of owned-key ``check_many``
+      batches through its host-local sharded device lane — the path
+      routed ingress traffic actually rides, routing memo included;
+    - phase A (p > 1): the routed frontend over real PeerLanes with
+      round-robin arrivals — the locally-owned vs forwarded split
+      (``pod_routed_share``) and the peer hop's p99.
+    """
+    import asyncio
+    import os
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.parallel import initialize_pod, make_mesh, pod_barrier
+    from limitador_tpu.routing import PodRouter, PodTopology, counter_key
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+    from limitador_tpu.tpu.storage import _Request
+
+    p, pid = args.pod_worker_procs, args.pod_worker_id
+    if p > 1:
+        initialize_pod(args.pod_coordinator, p, pid)
+    topo = PodTopology(
+        hosts=p, host_id=pid, shards_per_host=jax.local_device_count()
+    )
+    storage = TpuShardedStorage(
+        mesh=make_mesh(jax.local_devices()),
+        local_capacity=1 << 16,
+        global_region=256,
+    )
+    limiter = RateLimiter(storage)
+    limit = Limit("bench", 10**9, 3600, [], ["k"], name="bench")
+    limiter.add_limit(limit)
+
+    n_keys = 4096
+    counters = [
+        Counter.new(limit, Context({"k": f"key-{i}"}))
+        for i in range(n_keys)
+    ]
+    owned = [
+        c for c in counters if topo.owner_host(counter_key(c)) == pid
+    ]
+
+    # -- phase B: owned-key device-lane throughput ---------------------------
+    B = 512
+    reqs = [
+        [_Request([owned[(b * B + i) % len(owned)]], 1, False)
+         for i in range(B)]
+        for b in range(8)
+    ]
+    for batch in reqs[:2]:  # warm: slots allocated, programs compiled
+        storage.check_many(batch)
+    decided = 0
+    rate = 0.0
+    for _rep in range(2):  # best-of-two: box jitter
+        t0 = time.perf_counter()
+        for batch in reqs:
+            storage.check_many(batch)
+        dt = time.perf_counter() - t0
+        decided = len(reqs) * B
+        rate = max(rate, decided / dt)
+
+    # -- phase A: routed frontend share + peer hop cost ----------------------
+    routed = {"pod_routed_local": 0, "pod_routed_forwarded": 0,
+              "pod_routed_pinned": 0}
+    peer_p99_ms = 0.0
+    if p > 1:
+        from limitador_tpu.server.peering import PeerLane, PodFrontend
+
+        ports = [int(x) for x in args.pod_peer_ports.split(",")]
+        lane = PeerLane(
+            pid,
+            f"127.0.0.1:{ports[pid]}",
+            {i: f"127.0.0.1:{port}" for i, port in enumerate(ports)
+             if i != pid},
+            None,
+        )
+        lane.start()
+        frontend = PodFrontend(limiter, PodRouter(topo), lane)
+        loop = asyncio.new_event_loop()
+        # Warm the single-request program BEFORE peers start
+        # forwarding: a forwarded decision must never pay this
+        # worker's first-launch XLA compile inside the peer deadline.
+        # _local_check (not the routed surface): the warm key must not
+        # dial a lane that may not be serving yet.
+        warm_key = owned[0].set_variables["k"]
+        loop.run_until_complete(frontend._local_check(
+            "bench", Context({"k": warm_key}), 0, False
+        ))
+        pod_barrier("bench-pod-lanes-ready")
+
+        async def drive():
+            # Round-robin arrivals over the shared key sequence: the
+            # 1/p of keys this worker ingresses but does not own pay
+            # the one forwarding hop.
+            for i in range(pid, 512, p):
+                ctx = Context({"k": f"key-{i % n_keys}"})
+                await frontend.check_rate_limited_and_update(
+                    "bench", ctx, 1, False
+                )
+
+        loop.run_until_complete(drive())
+        pod_barrier("bench-pod-drive-done")
+        routed = frontend.router.stats()
+        peer_p99_ms = lane.stats()["pod_peer_p99_ms"]
+        lane.stop()
+
+    with open(args.pod_out, "w") as f:
+        json.dump({
+            "rate": rate,
+            "decided": decided,
+            "owned_keys": len(owned),
+            "routed": routed,
+            "peer_p99_ms": peer_p99_ms,
+            "route_memo": storage.launch_stats(),
+        }, f)
+    return 0
+
+
+def bench_pod():
+    """Pod sweep (ISSUE 10): 1/2/4-process `jax.distributed` CPU pods
+    on THIS box (one shard per process), emitting
+    ``pod_decisions_per_sec`` (summed owned-key device-lane throughput),
+    ``pod_scaling_efficiency`` (rate at max processes / rate at 1 — the
+    same-run interleaved ratio, per the PR 5 box-variance caveat: the
+    1/2/4 runs share one invocation and one box) and
+    ``pod_routed_share`` (locally-owned fraction under round-robin
+    arrivals, with the peer hop's p99 alongside). Every row carries the
+    pod topology; on a device-backed round the sweep appends its probe
+    record to the DEVICE_PROBES log."""
+    import os
+    import subprocess
+    import tempfile
+
+    by_processes = {}
+    shares = {}
+    peer_p99 = {}
+    pod_note = ""
+    for p in (1, 2, 4):
+        coordinator = f"127.0.0.1:{_free_port()}"
+        peer_ports = ",".join(str(_free_port()) for _ in range(p))
+        env = {
+            k: v for k, v in os.environ.items()
+            if not k.startswith("TPU_POD_")
+        }
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_FORCE_CPU"] = "1"
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        with tempfile.TemporaryDirectory() as tmp:
+            procs = []
+            outs = []
+            for pid in range(p):
+                out = os.path.join(tmp, f"pod-{pid}.json")
+                outs.append(out)
+                procs.append(subprocess.Popen(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--config", "pod",
+                     "--pod-worker-id", str(pid),
+                     "--pod-worker-procs", str(p),
+                     "--pod-coordinator", coordinator,
+                     "--pod-peer-ports", peer_ports,
+                     "--pod-out", out],
+                    env=env, stdout=subprocess.DEVNULL,
+                    stderr=subprocess.PIPE, text=True,
+                ))
+            failed = None
+            for pid, proc in enumerate(procs):
+                try:
+                    _out, err = proc.communicate(timeout=600)
+                except subprocess.TimeoutExpired:
+                    failed = f"{p}-process pod timed out"
+                    break
+                if proc.returncode != 0:
+                    failed = (
+                        f"{p}-process pod worker {pid} rc="
+                        f"{proc.returncode}: {err.strip()[-400:]}"
+                    )
+                    break
+            if failed:
+                # One dead worker dooms the pod: kill the rest NOW so
+                # zombies can't starve (or key-collide with) the next
+                # sweep size.
+                for x in procs:
+                    if x.poll() is None:
+                        x.kill()
+                for x in procs:
+                    try:
+                        x.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pass
+            if failed:
+                print(f"bench_pod: {failed}", file=sys.stderr)
+                pod_note = failed
+                continue
+            rate = 0.0
+            local = forwarded = pinned = 0
+            p99 = 0.0
+            for out in outs:
+                with open(out) as f:
+                    r = json.load(f)
+                rate += r["rate"]
+                local += r["routed"]["pod_routed_local"]
+                forwarded += r["routed"]["pod_routed_forwarded"]
+                pinned += r["routed"]["pod_routed_pinned"]
+                p99 = max(p99, r["peer_p99_ms"])
+        by_processes[str(p)] = round(rate, 1)
+        total_routed = local + forwarded + pinned
+        if total_routed:
+            shares[str(p)] = round(local / total_routed, 4)
+        peer_p99[str(p)] = round(p99, 3)
+        print(
+            f"pod over {p} process(es): {rate/1e3:.1f}k decisions/s"
+            + (
+                f", routed share {shares[str(p)]:.2%} local, "
+                f"peer p99 {p99:.1f}ms" if p > 1 and total_routed else ""
+            ),
+            file=sys.stderr,
+        )
+    if "1" not in by_processes:
+        print("bench_pod: no successful pod run", file=sys.stderr)
+        return
+    full_p = max(int(k) for k in by_processes)
+    rate = by_processes[str(full_p)]
+    efficiency = round(rate / by_processes["1"], 3)
+    routed_share = shares.get(str(full_p), 1.0)
+    if device_backed():
+        # Evidence hygiene (ROADMAP direction 5): a device-backed pod
+        # sweep is a new probe-worthy artifact.
+        _LAST_PROBE.update(ok=True, attempts=1, window_s=0.0)
+        _record_device_probe("pod sweep")
+    emit(
+        "pod_decisions_per_sec", rate, "decisions/s", 1e6,
+        pod_by_processes=by_processes,
+        pod_processes=full_p,
+        pod_scaling_efficiency=efficiency,
+        pod_routed_share=routed_share,
+        pod_routed_share_by_processes=shares,
+        pod_peer_p99_ms_by_processes=peer_p99,
+        **({"pod_note": pod_note} if pod_note else {}),
+    )
+
+
 def _free_port() -> int:
     import socket
 
@@ -2017,8 +2268,19 @@ def main():
         default="device",
         choices=["device", "memory", "pipeline", "native", "lease",
                  "tenants", "sharded", "backends", "grpc", "fleet",
-                 "onbox"],
+                 "onbox", "pod"],
     )
+    # internal: one process of the pod sweep (spawned by bench_pod)
+    parser.add_argument("--pod-worker-id", type=int, default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--pod-worker-procs", type=int, default=1,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--pod-coordinator", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--pod-peer-ports", default=None,
+                        help=argparse.SUPPRESS)
+    parser.add_argument("--pod-out", default=None,
+                        help=argparse.SUPPRESS)
     parser.add_argument(
         "--require-device", action="store_true",
         help="fail loudly (exit 3) when the device probe falls back to "
@@ -2047,6 +2309,10 @@ def main():
         return bench_lease()
     if args.config == "sharded":
         return bench_sharded()
+    if args.config == "pod":
+        if args.pod_worker_id is not None:
+            return _bench_pod_worker(args)
+        return bench_pod()
     if args.config == "grpc":
         return bench_grpc()
     if args.config == "fleet":

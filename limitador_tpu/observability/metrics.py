@@ -574,6 +574,63 @@ class PrometheusMetrics:
             ["variant"],
             registry=self.registry,
         )
+        self.sharded_route_memo_hits = Counter(
+            "sharded_route_memo_hits",
+            "Key->owner-shard route memo hits (LRU-bounded, "
+            "tpu/sharded.py)",
+            registry=self.registry,
+        )
+        self.sharded_route_memo_misses = Counter(
+            "sharded_route_memo_misses",
+            "Route memo misses (key re-hashed; miss-heavy means the "
+            "LRU cap thrashes under the live key cardinality)",
+            registry=self.registry,
+        )
+        self.sharded_route_memo_evictions = Counter(
+            "sharded_route_memo_evictions",
+            "Route memo LRU evictions",
+            registry=self.registry,
+        )
+        self.sharded_route_memo_size = Gauge(
+            "sharded_route_memo_size",
+            "Resident route-memo entries (capped at 4x the qualified-"
+            "counter cache size)",
+            registry=self.registry,
+        )
+        # -- pod routing (routing.py + server/peering.py): the routed
+        # ingress verdict counters and the peer forwarding lane's
+        # health, polled off the pod frontend's library_stats.
+        # Registered in routing.METRIC_FAMILIES (lint cross-checked).
+        self.pod_routed_local = Counter(
+            "pod_routed_local",
+            "Decisions owned by this host (the collective-free lean "
+            "path; zero cross-host traffic)",
+            registry=self.registry,
+        )
+        self.pod_routed_forwarded = Counter(
+            "pod_routed_forwarded",
+            "Decisions forwarded once over the peer lane to their "
+            "owner host",
+            registry=self.registry,
+        )
+        self.pod_routed_pinned = Counter(
+            "pod_routed_pinned",
+            "Decisions routed by namespace pin (multi-limit or global "
+            "namespaces, whole namespace owned by one host)",
+            registry=self.registry,
+        )
+        self.pod_peer_errors = Counter(
+            "pod_peer_errors",
+            "Peer-lane forward failures (dead/slow owner host; the "
+            "request fails with the shed semantics)",
+            registry=self.registry,
+        )
+        self.pod_peer_p99_ms = Gauge(
+            "pod_peer_p99_ms",
+            "p99 peer-lane forward latency (ms) over the recent "
+            "forward window — the pod's one-hop cost",
+            registry=self.registry,
+        )
         # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
         # split into pipelined sub-batches. Registered in
         # batcher.METRIC_FAMILIES (lint cross-checked).
@@ -726,6 +783,8 @@ class PrometheusMetrics:
         native_lane_plans = 0
         lease_active = 0
         lease_outstanding = 0
+        route_memo_size = 0
+        peer_p99_ms = 0.0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -740,6 +799,10 @@ class PrometheusMetrics:
             lease_active += int(stats.get("lease_active", 0))
             lease_outstanding += int(
                 stats.get("lease_outstanding_tokens", 0)
+            )
+            route_memo_size += int(stats.get("sharded_route_memo_size", 0))
+            peer_p99_ms = max(
+                peer_p99_ms, float(stats.get("pod_peer_p99_ms", 0.0))
             )
             for key in (
                 "counter_overshoot",
@@ -764,6 +827,13 @@ class PrometheusMetrics:
                 "lease_grant_denials",
                 "lease_granted_tokens",
                 "lease_returned_tokens",
+                "sharded_route_memo_hits",
+                "sharded_route_memo_misses",
+                "sharded_route_memo_evictions",
+                "pod_routed_local",
+                "pod_routed_forwarded",
+                "pod_routed_pinned",
+                "pod_peer_errors",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -789,6 +859,8 @@ class PrometheusMetrics:
         self.native_lane_plans.set(native_lane_plans)
         self.lease_active.set(lease_active)
         self.lease_outstanding_tokens.set(lease_outstanding)
+        self.sharded_route_memo_size.set(route_memo_size)
+        self.pod_peer_p99_ms.set(peer_p99_ms)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

@@ -70,11 +70,18 @@ __all__ = [
     "ShardedBatchResult",
     "make_sharded_table",
     "make_mesh",
+    "make_global_mesh",
     "batch_sharding",
     "sharded_check_and_update",
     "sharded_update",
     "sharded_clear_cells",
     "sharded_drain_top_hits",
+    "PodInfo",
+    "initialize_pod",
+    "pod_info",
+    "host_local_to_global",
+    "pod_sync",
+    "pod_barrier",
 ]
 
 _NEVER = jnp.iinfo(jnp.int32).max
@@ -121,6 +128,151 @@ class ShardedBatchResult(NamedTuple):
 def make_mesh(devices=None, axis: str = "shard") -> Mesh:
     devices = devices if devices is not None else jax.devices()
     return Mesh(devices, (axis,))
+
+
+# -- pod-scale (multi-host) plumbing ------------------------------------------
+#
+# `jax.distributed.initialize()` + a pod-wide Mesh generalize every
+# sharded kernel above across hosts (the multihost pjit pattern,
+# SNIPPETS [3]): `jax.devices()` becomes the GLOBAL device list, the
+# "shard" axis spans processes, and the collective-lean classification
+# holds unchanged — a `coupled=False, has_global=False` launch lowers
+# with ZERO cross-host collectives on the global mesh exactly as it
+# does on ICI (tests/test_pod.py lints the HLO inside a live 2-process
+# pod). Each host feeds only its addressable shards:
+# `host_local_to_global` lifts host-local [n_local, H] staging rows
+# into the global [n_total, H] array without materializing remote rows
+# anywhere.
+
+
+class PodInfo(NamedTuple):
+    """The process's place in the pod (degenerate single-process values
+    when `jax.distributed` was never initialized)."""
+
+    process_id: int
+    num_processes: int
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_processes > 1
+
+
+def initialize_pod(
+    coordinator: str, num_processes: int, process_id: int
+) -> PodInfo:
+    """`jax.distributed.initialize()` with the CPU-pod affordance: on
+    the host backend cross-process collectives need the gloo
+    implementation (the default 'none' forms the pod but fails the
+    first collective with "Multiprocess computations aren't
+    implemented"), which is also how the 1/2/4-process bench and the
+    2-process parity harness run a pod on one box. Idempotent: a
+    second call in an already-initialized process just returns the
+    live topology."""
+    try:
+        from jax._src.distributed import global_state as _dist_state
+    except ImportError:  # pragma: no cover - newer jax layouts
+        _dist_state = getattr(jax.distributed, "global_state", None)
+    if (
+        _dist_state is not None
+        and getattr(_dist_state, "coordinator_address", None) is not None
+    ):
+        return pod_info()
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass  # older jaxlibs: TPU pods don't need the CPU collectives
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=int(num_processes),
+        process_id=int(process_id),
+    )
+    return pod_info()
+
+
+def pod_info() -> PodInfo:
+    return PodInfo(
+        process_id=jax.process_index(),
+        num_processes=jax.process_count(),
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def make_global_mesh(axis: str = "shard") -> Mesh:
+    """The pod-wide mesh: every device of every process on one shard
+    axis, ordered so each host's addressable devices form a contiguous
+    block (global shard `g` belongs to host `g // local_device_count` —
+    the contract routing.PodTopology encodes)."""
+    procs = sorted(
+        {d.process_index for d in jax.devices()}
+    )
+    ordered = [
+        d
+        for p in procs
+        for d in sorted(
+            (d for d in jax.devices() if d.process_index == p),
+            key=lambda d: d.id,
+        )
+    ]
+    return Mesh(ordered, (axis,))
+
+
+def host_local_to_global(mesh: Mesh, arrays, axis: str = "shard"):
+    """Lift host-local [n_local, ...] staging arrays into global
+    [n_total, ...] arrays on a multi-host mesh (each host contributes
+    only its addressable shards — remote rows are never materialized
+    here). On a single-process mesh this is the plain sharded
+    device_put the storage already performs."""
+    sharding = batch_sharding(mesh, axis)
+    if len(mesh.devices.flat) == len([
+        d for d in mesh.devices.flat if d.process_index == jax.process_index()
+    ]):
+        return jax.device_put(tuple(arrays), sharding)
+    from jax.experimental import multihost_utils
+
+    spec = P(axis, None)
+    return tuple(
+        multihost_utils.host_local_array_to_global_array(a, mesh, spec)
+        for a in arrays
+    )
+
+
+def pod_sync(tag: str = "pod") -> None:
+    """DEVICE barrier across the pod's processes (no-op single-
+    process): a psum over the global mesh, so it proves the device
+    collectives themselves work. Must NOT be held while another thread
+    needs the same devices — the CPU client serializes executions per
+    device, so a concurrent local launch (e.g. a peer-lane forwarded
+    decision) would deadlock against it; those phases use
+    :func:`pod_barrier` instead."""
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(tag)
+
+
+def pod_barrier(tag: str, timeout_ms: int = 120_000) -> None:
+    """CONTROL-PLANE barrier across the pod's processes (no-op single-
+    process): the coordination-service barrier of the distributed
+    runtime — pure RPC, touches no device, so other threads keep
+    launching freely while this one waits (the lockstep points of the
+    pod drive, where the waiting host's lane thread must stay able to
+    serve forwarded decisions)."""
+    if jax.process_count() <= 1:
+        return
+    try:
+        from jax._src.distributed import global_state
+    except ImportError:  # pragma: no cover - newer jax layouts
+        global_state = getattr(jax.distributed, "global_state", None)
+
+    client = getattr(global_state, "client", None)
+    if client is None:  # pragma: no cover - non-distributed fallback
+        pod_sync(tag)
+        return
+    client.wait_at_barrier(tag, timeout_ms)
 
 
 def batch_sharding(mesh: Mesh, axis: str = "shard") -> NamedSharding:

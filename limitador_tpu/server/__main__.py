@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import inspect
 import json
 import logging
 import os
@@ -283,6 +284,37 @@ def build_parser() -> argparse.ArgumentParser:
         "headers NONE); the Python gRPC server (Kuadrant + Envoy with "
         "headers) moves to --rls-port + 1",
     )
+    # pod-scale serving (docs/configuration.md "Pod-scale serving"):
+    # jax.distributed global mesh + shard-aware routed ingress
+    p.add_argument(
+        "--pod-coordinator", default=_env("TPU_POD_COORDINATOR"),
+        help="pod: jax.distributed coordinator address (host:port); "
+        "required when --pod-processes > 1. Process 0 must be reachable "
+        "there before the others start",
+    )
+    p.add_argument(
+        "--pod-processes", type=int,
+        default=int(_env("TPU_POD_PROCESSES", "1")),
+        help="pod: total number of pod processes (hosts); 1 = no pod "
+        "(the default single-host topology)",
+    )
+    p.add_argument(
+        "--pod-process-id", type=int,
+        default=int(_env("TPU_POD_PROCESS_ID", "0")),
+        help="pod: this process's id in [0, --pod-processes)",
+    )
+    p.add_argument(
+        "--pod-peer", action="append", default=None,
+        help="pod: peer-lane address of each pod process in process-id "
+        "order, repeatable (env TPU_POD_PEERS, comma separated); a "
+        "descriptor owned by another host is forwarded once over this "
+        "lane",
+    )
+    p.add_argument(
+        "--pod-peer-listen", default=_env("TPU_POD_PEER_LISTEN"),
+        help="pod: bind address of this host's peer lane "
+        "(default 0.0.0.0:<rls-port + 2>)",
+    )
     p.add_argument(
         "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
         help="sharded: comma-separated namespaces whose counters are "
@@ -494,6 +526,22 @@ def _preserve_rejected_snapshot(path: str) -> None:
         log.warning(f"could not preserve rejected snapshot: {exc}")
 
 
+def _pod_local_mesh():
+    """Pod mode: the sharded storage shards over THIS host's devices
+    only (the default mesh would span the whole pod and every launch
+    would be an SPMD program all hosts must enter together); the
+    cross-host partition of the key space lives in the routed frontend
+    (server/peering.py), not in the device mesh. None single-host —
+    the storage's default mesh is already right there."""
+    import jax
+
+    if jax.process_count() > 1:
+        from ..parallel import make_mesh
+
+        return make_mesh(jax.local_devices())
+    return None
+
+
 def build_limiter(args, on_partitioned=None):
     """Limiter::new equivalent (main.rs:93-185): pick + build the backend.
     ``on_partitioned`` reaches storages that track authority partitions
@@ -584,10 +632,11 @@ def build_limiter(args, on_partitioned=None):
         cli_global_ns = {
             ns for ns in (args.global_namespaces or "").split(",") if ns
         }
+        mesh = _pod_local_mesh()
         storage = _try_restore(
             args.snapshot_path,
             lambda p: TpuShardedStorage.restore(
-                p, cache_size=args.cache_size
+                p, mesh=mesh, cache_size=args.cache_size
             ),
             "sharded counter table",
         )
@@ -611,6 +660,7 @@ def build_limiter(args, on_partitioned=None):
                     "the checkpoint)")
         if storage is None:
             storage = TpuShardedStorage(
+                mesh=mesh,
                 local_capacity=args.tpu_capacity,
                 cache_size=args.cache_size,
                 global_namespaces=sorted(cli_global_ns),
@@ -689,6 +739,39 @@ async def _amain(args) -> int:
     if tracing_err:
         log.warning(tracing_err)
 
+    # Pod formation MUST precede any storage/jax work: after
+    # jax.distributed.initialize the device list is pod-global and the
+    # sharded branch picks the host-local mesh off it. Snapshot and
+    # failover state stay strictly per-host (each host checkpoints its
+    # own shard block; a restarted host restores only its own).
+    pod = None
+    if args.pod_processes > 1 or args.pod_coordinator:
+        if args.pod_processes > 1 and not args.pod_coordinator:
+            raise SystemExit(
+                "--pod-processes > 1 requires --pod-coordinator "
+                "(env TPU_POD_COORDINATOR)"
+            )
+        if not (0 <= args.pod_process_id < args.pod_processes):
+            raise SystemExit(
+                f"--pod-process-id {args.pod_process_id} outside "
+                f"[0, {args.pod_processes})"
+            )
+        from ..parallel import initialize_pod
+
+        pod = initialize_pod(
+            args.pod_coordinator, args.pod_processes, args.pod_process_id
+        )
+        log.info(
+            f"pod formed: process {pod.process_id}/{pod.num_processes}, "
+            f"{pod.local_device_count} local of "
+            f"{pod.global_device_count} global devices")
+        if args.snapshot_path:
+            args.snapshot_path = (
+                f"{args.snapshot_path}.host{pod.process_id}"
+            )
+            log.info(
+                f"pod: per-host snapshot path {args.snapshot_path}")
+
     initial_labels = args.metric_labels
     if args.metric_labels_file:
         try:
@@ -753,6 +836,55 @@ async def _amain(args) -> int:
             lambda v: metrics.datastore_partitioned.set(1 if v else 0)
         ),
     )
+    # Shard-aware routed frontend: wrap the limiter so every decision is
+    # either locally owned (the collective-free lean path) or forwarded
+    # ONCE over the peer lane to its owner host. Wrapping happens before
+    # any consumer captures the limiter, so the RLS/HTTP planes, the
+    # serving shards and the metrics wiring all see the routed surface.
+    pod_frontend = None
+    if pod is not None and pod.num_processes > 1:
+        from ..routing import PodRouter, PodTopology
+        from .peering import PeerLane, PodFrontend
+
+        peer_urls = args.pod_peer or [
+            u for u in (_env("TPU_POD_PEERS") or "").split(",") if u
+        ]
+        if len(peer_urls) != pod.num_processes:
+            raise SystemExit(
+                f"pod: need one --pod-peer per process "
+                f"({pod.num_processes}), got {len(peer_urls)}"
+            )
+        lane = PeerLane(
+            pod.process_id,
+            args.pod_peer_listen or f"{args.rls_host}:{args.rls_port + 2}",
+            {
+                i: url
+                for i, url in enumerate(peer_urls)
+                if i != pod.process_id
+            },
+            None,
+        )
+        # NOT started here: the lane begins serving only after the
+        # initial limits load below — a restarting host must never
+        # answer a forwarded decision against an empty limits set
+        # (it would silently admit traffic its peers expect limited).
+        router = PodRouter(PodTopology(
+            hosts=pod.num_processes,
+            host_id=pod.process_id,
+            shards_per_host=max(pod.local_device_count, 1),
+        ))
+        pod_global_ns = {
+            ns for ns in (args.global_namespaces or "").split(",") if ns
+        }
+        pod_frontend = PodFrontend(
+            limiter, router, lane, global_namespaces=pod_global_ns
+        )
+        limiter = pod_frontend
+        log.info(
+            f"pod routed ingress: host {pod.process_id} owns global "
+            f"shards "
+            f"[{pod.process_id * router.topology.shards_per_host}, "
+            f"{(pod.process_id + 1) * router.topology.shards_per_host})")
     counters_storage = limiter.storage.counters
     # Prefer the limiter (the compiled pipeline aggregates its storage's
     # stats and adds compiler eval counters); otherwise the storage itself.
@@ -853,10 +985,11 @@ async def _amain(args) -> int:
     pipelines_to_invalidate = []
 
     async def apply_limits(limits):
-        if isinstance(limiter, AsyncRateLimiter):
-            await limiter.configure_with(limits)
-        else:
-            limiter.configure_with(limits)
+        # AsyncRateLimiter and the pod frontend configure async; the
+        # host-only backends are plain sync.
+        applied = limiter.configure_with(limits)
+        if inspect.isawaitable(applied):
+            await applied
         for pipeline in pipelines_to_invalidate:
             pipeline.invalidate()
         if admission is not None:
@@ -902,8 +1035,33 @@ async def _amain(args) -> int:
         status["limits_file_version"] = 1
         watcher.start()
 
+    if pod_frontend is not None:
+        # Limits are loaded (and the router configured) — the peer
+        # lane may now answer forwarded decisions. Until this point
+        # peers' forwards to this host fail fast (connection refused,
+        # counted in their pod_peer_errors) instead of silently
+        # admitting against an empty limits set.
+        pod_frontend.lane.start()
+        log.info(
+            f"pod peer lane serving on "
+            f"{pod_frontend.lane.listen_address} "
+            f"(port {pod_frontend.lane.port})")
+
     native_pipeline = None
-    if args.storage == "tpu" and args.pipeline == "native":
+    if (
+        pod_frontend is not None
+        and args.storage == "tpu"
+        and args.pipeline == "native"
+    ):
+        # The native pipeline (and the ingress hot lane riding it)
+        # decides against the local storage directly — it would bypass
+        # the pod router and decide keys other hosts own. Until the C
+        # lane is shard-aware, pod mode serves through the routed
+        # compiled/standard plane.
+        log.warning(
+            "pod mode: the native pipeline hot lane is not shard-aware "
+            "yet; serving through the routed compiled pipeline")
+    elif args.storage == "tpu" and args.pipeline == "native":
         from .. import native as native_mod
 
         if native_mod.available():
@@ -1211,6 +1369,9 @@ async def _amain(args) -> int:
         await admission.close()
     if native_pipeline is not None:
         await native_pipeline.close()
+    if pod_frontend is not None:
+        pod_frontend.close_pod()
+        limiter = pod_frontend._limiter  # close the wrapped limiter
     if hasattr(limiter, "close"):
         # Compiled pipeline: final flush + drain in-flight collects +
         # release worker pools before the storage goes away.

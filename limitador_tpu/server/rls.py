@@ -106,7 +106,12 @@ class RlsService:
         # None = pre-admission-plane behavior.
         self.admission = admission
         self.rate_limit_headers = rate_limit_headers
-        self._is_async = isinstance(limiter, AsyncRateLimiter)
+        # Async limiters: the batched facades and the pod frontend
+        # (server/peering.py), whose forwarded decisions await the
+        # peer lane and so must be awaited here too.
+        self._is_async = isinstance(limiter, AsyncRateLimiter) or getattr(
+            limiter, "is_async_limiter", False
+        )
         # Batched storages time their own device round trips (the busy-time
         # semantics of the reference's MetricsLayer, metrics.rs:100-211);
         # wrapping here would add queue wait on top.

@@ -40,7 +40,12 @@ __all__ = [
     "tracing_findings",
 ]
 
-#: modules on the serving hot path (decision-path rules apply here)
+#: modules on the serving hot path (decision-path rules apply here).
+#: routing.py and the peer-forwarding lane joined with the pod tier
+#: (ISSUE 10): every decision consults the router, and a forwarded
+#: descriptor's whole latency budget is the peering module — a host
+#: sync or implicit asarray smuggled into either would tax ALL pod
+#: traffic.
 HOT_MODULES = (
     "limitador_tpu/tpu/native_pipeline.py",
     "limitador_tpu/tpu/storage.py",
@@ -49,6 +54,8 @@ HOT_MODULES = (
     "limitador_tpu/tpu/plan_cache.py",
     "limitador_tpu/tpu/pipeline.py",
     "limitador_tpu/native/ingress.py",
+    "limitador_tpu/routing.py",
+    "limitador_tpu/server/peering.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

@@ -54,7 +54,6 @@ from __future__ import annotations
 
 import threading
 import time
-import zlib
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -69,6 +68,7 @@ from ..storage.base import (
 )
 from ..storage.gcra import GcraValue, restore_cell, spent_tokens
 from ..ops import kernel as K
+from ..routing import RouteMemo, counter_key, stable_hash
 from ..parallel.mesh import (
     ShardedCounterState,
     batch_sharding,
@@ -94,8 +94,16 @@ __all__ = ["TpuShardedStorage", "METRIC_FAMILIES"]
 
 #: metric families this subsystem owns (cross-checked against
 #: observability/metrics.py by tools/lint.py's registry lint): per-variant
-#: multi-chip launch counts, polled off ``launch_stats()`` at render time.
-METRIC_FAMILIES = ("sharded_launches",)
+#: multi-chip launch counts + the bounded key->owner-shard memo's
+#: hit/miss/eviction/size telemetry, polled off ``launch_stats()`` at
+#: render time.
+METRIC_FAMILIES = (
+    "sharded_launches",
+    "sharded_route_memo_hits",
+    "sharded_route_memo_misses",
+    "sharded_route_memo_evictions",
+    "sharded_route_memo_size",
+)
 
 #: sharded_launches label values: lean = no collective at all, coupled =
 #: pmin request coupling only, global = psum global region present.
@@ -104,9 +112,10 @@ LAUNCH_VARIANTS = ("lean", "coupled", "global")
 _INT32_MAX = int(np.iinfo(np.int32).max)
 
 
-def _stable_hash(key: tuple) -> int:
-    """Deterministic (process-independent) hash for shard routing."""
-    return zlib.crc32(repr(key).encode())
+# Ownership hash, shared with the ingress-tier routers (routing.py) so
+# every layer agrees about who owns a key. Kept under the historical
+# name — snapshots re-route keys through it on restore.
+_stable_hash = stable_hash
 
 
 class _ShardedHandle:
@@ -191,9 +200,10 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         self._gtable = _SlotTable(self._global_region)
         self._rr = 0  # round-robin shard for global-counter deltas
         # Memoized key -> owner shard (the crc32 hash is pure; recomputing
-        # repr+crc per hit was the staging pass's hot spot). Bounded the
-        # same way as the mixin's per-limit memos.
-        self._shard_memo: Dict[tuple, int] = {}
+        # repr+crc per hit was the staging pass's hot spot). LRU-bounded
+        # (routing.RouteMemo): the old dict grew one entry per unique key
+        # — unbounded at the 1M+ key regime this storage exists for.
+        self._shard_memo = RouteMemo(4 * self._cache_size)
         # Batch input sharding: device_put hit columns with this so each
         # shard uploads only its own rows.
         self._sharding = batch_sharding(self._mesh)
@@ -238,9 +248,9 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
 
     # -- slot routing -------------------------------------------------------
 
-    @staticmethod
-    def _key_of(counter: Counter) -> tuple:
-        return (counter.limit._identity, tuple(counter.set_variables.items()))
+    # Routed identity, shared with the ingress-tier routers
+    # (routing.counter_key): both layers must hash the same bytes.
+    _key_of = staticmethod(counter_key)
 
     def _is_global(self, counter: Counter) -> bool:
         return counter.namespace in self._global_ns
@@ -303,9 +313,7 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         shard = self._shard_memo.get(key)
         if shard is None:
             shard = _stable_hash(key) % self._n
-            if len(self._shard_memo) >= 4 * self._cache_size:
-                self._shard_memo.clear()
-            self._shard_memo[key] = shard
+            self._shard_memo.put(key, shard)
         table = self._tables[shard]
         slot = table.lookup(key, qualified)
         if slot is not None:
@@ -337,9 +345,14 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         (the ``sharded_launches`` metric family, polled baseline-
         converted off library_stats at render time): a hot path that
         is mostly ``coupled``/``global`` instead of ``lean`` means the
-        limits layout is forcing collectives onto every batch."""
+        limits layout is forcing collectives onto every batch. Rides
+        along: the route-memo's hit/miss/eviction counters (a miss-
+        heavy memo means the LRU cap is thrashing under the live key
+        cardinality)."""
         with self._lock:
-            return {"sharded_launches": dict(self._launches)}
+            stats = {"sharded_launches": dict(self._launches)}
+            stats.update(self._shard_memo.stats())
+            return stats
 
     def device_stats(self) -> dict:
         """Per-shard table stats for /debug/stats and the Prometheus

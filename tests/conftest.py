@@ -58,6 +58,10 @@ SERVER_ENV_VARS = frozenset({
     # an ambient sanitizer variant would silently slow every native
     # budget test 2-20x (and a server subprocess would rebuild the .so)
     "TPU_NATIVE_SANITIZE",
+    # ambient pod topology would make a spawned server call
+    # jax.distributed.initialize and hang waiting for a coordinator
+    "TPU_POD_COORDINATOR", "TPU_POD_PROCESSES", "TPU_POD_PROCESS_ID",
+    "TPU_POD_PEERS", "TPU_POD_PEER_LISTEN",
 })
 
 

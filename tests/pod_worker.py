@@ -1,0 +1,345 @@
+"""One process of the CPU pod harness (NOT a pytest module).
+
+Spawned by tests/test_pod.py (and `make pod-smoke`) as N cooperating
+processes that form a real `jax.distributed` pod on one box:
+
+    python tests/pod_worker.py --process-id I --num-processes N \
+        --coordinator 127.0.0.1:PORT --peer-ports P0,P1 --out OUT.json
+
+Each worker proves, inside the live pod:
+
+1. **Global mesh + HLO lint** — `sharded_check_and_update` lowered on
+   the pod-wide mesh: the lean variant must contain ZERO cross-host
+   collectives (all-gather/all-reduce/collective-permute/all-to-all),
+   the coupled+global variant must contain an all-reduce (the psum/pmin
+   really compiled against the global mesh).
+2. **Cross-host psum** — a global-region drive whose rejection is only
+   explainable by the psum having read the OTHER host's partials.
+3. **Routed frontend drive** — a TpuShardedStorage over the host-local
+   mesh behind PodRouter + PeerLane: a deterministic request sequence
+   arrives round-robin across hosts, forwarded descriptors hop the
+   peer lane once, and the recorded decisions + final counter state
+   are compared (by the parent) against a single-process
+   TpuShardedStorage on the same drive — byte-identical.
+
+Exit codes: 0 ok; 3 = this backend cannot form a pod (parent skips);
+anything else is a real failure.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+POD_UNSUPPORTED = 3
+
+# The deterministic drive both the workers and the parent oracle run.
+DRIVE_REQUESTS = 48
+DRIVE_USERS = 7
+DRIVE_T0 = 1_700_000_000.0
+DRIVE_STEP_S = 0.05
+
+
+def drive_limits():
+    from limitador_tpu import Limit
+
+    return [
+        # Single-limit namespace: per-counter-key host routing (the
+        # scalable hot path).
+        Limit("pods", 3, 60, [], ["user"], name="per_user"),
+        # Two limits in one namespace: requests touch two counter keys
+        # -> the router pins the whole namespace to one host (the
+        # coupled fallback).
+        Limit("multi", 2, 60, [], ["user"], name="multi_user"),
+        Limit("multi", 30, 60, [], [], name="multi_total"),
+    ]
+
+
+def drive_request(i: int):
+    """(namespace, user, arrival_host) of drive step i — pure function
+    of i, so every process and the oracle agree byte-for-byte."""
+    ns = "pods" if i % 3 else "multi"
+    return ns, f"u{i % DRIVE_USERS}", i % 2
+
+
+class _Clock:
+    def __init__(self):
+        self.now = DRIVE_T0
+
+    def __call__(self):
+        return self.now
+
+
+def run_drive(decide, clock, end_of_step=None):
+    """Run the shared drive; ``decide(i, ns, ctx, arrival)`` returns a
+    CheckResult or None when this process doesn't decide step i.
+    ``end_of_step(i)`` is the pod's lockstep barrier: it runs AFTER the
+    step's decision (forwarded hop included), so a forwarded decision
+    is always served while the owner's clock still reads step i's
+    time — the global per-counter order and every expiry stamp match
+    the oracle's sequential drive exactly."""
+    from limitador_tpu import Context
+
+    decisions = {}
+    for i in range(DRIVE_REQUESTS):
+        clock.now = DRIVE_T0 + i * DRIVE_STEP_S
+        ns, user, arrival = drive_request(i)
+        result = decide(i, ns, Context({"user": user}), arrival)
+        if result is not None:
+            decisions[i] = {
+                "limited": bool(result.limited),
+                "name": result.limit_name,
+            }
+        if end_of_step is not None:
+            end_of_step(i)
+    return decisions
+
+
+def counter_state(limiter, namespaces=("pods", "multi")):
+    """Deterministic dump of the live counters this process owns."""
+    out = []
+    for ns in namespaces:
+        for c in limiter.get_counters(ns):
+            out.append({
+                "ns": ns,
+                "limit": c.limit.name,
+                # lists, not tuples: identical before and after the
+                # JSON round trip the parent compares across
+                "vars": [list(kv) for kv in sorted(
+                    c.set_variables.items()
+                )],
+                "remaining": c.remaining,
+                "expires_ms": int(round((c.expires_in or 0) * 1000)),
+            })
+    out.sort(key=lambda r: (r["ns"], r["limit"], r["vars"]))
+    return out
+
+
+def hlo_checks(mesh, state):
+    import numpy as np
+
+    from limitador_tpu.parallel import sharded_check_and_update
+
+    n = mesh.shape["shard"]
+    h = 8
+    b = (
+        np.full((n, h), 32, np.int32),            # slots (scratch)
+        np.zeros((n, h), np.int32),               # deltas
+        np.full((n, h), 2**31 - 1, np.int32),     # maxes
+        np.zeros((n, h), np.int32),               # windows
+        np.full((n, h), h - 1, np.int32),         # req_ids (shard-local)
+        np.zeros((n, h), bool),                   # fresh
+        np.zeros((n, h), bool),                   # bucket
+        np.zeros((n, h), bool),                   # is_global
+    )
+    collectives = (
+        "all-gather", "all-reduce", "collective-permute", "all-to-all",
+    )
+
+    def lowered(coupled, has_global, req):
+        cols = b[:4] + (req,) + b[5:]
+        return sharded_check_and_update.lower(
+            mesh, state, *cols, np.int32(1000), global_region=8,
+            coupled=coupled, has_global=has_global,
+        ).compile().as_text()
+
+    lean = lowered(False, False, b[4])
+    global_req = np.arange(n * h, dtype=np.int32).reshape(n, h)
+    coupled = lowered(True, True, global_req)
+    return {
+        "lean_collectives": [
+            op for op in collectives if f"{op}(" in lean
+        ],
+        "coupled_has_all_reduce": "all-reduce(" in coupled,
+    }
+
+
+def psum_check(mesh, info):
+    """Global-region drive: each host lands one delta-1 partial on
+    global slot 7 per local shard (t=1000, max 100 -> admitted), then a
+    single probe hit with max == total partials is REJECTED: the psum
+    base saw the REMOTE host's partials."""
+    import numpy as np
+
+    from limitador_tpu.parallel import (
+        host_local_to_global,
+        make_sharded_table,
+        sharded_check_and_update,
+    )
+
+    n_local = info.local_device_count
+    n_total = mesh.shape["shard"]
+    h = 4
+    state = make_sharded_table(mesh, 32)
+
+    def stage(maxes_first, deltas_first):
+        b = dict(
+            slots=np.full((n_local, h), 32, np.int32),
+            deltas=np.zeros((n_local, h), np.int32),
+            maxes=np.full((n_local, h), 2**31 - 1, np.int32),
+            windows_ms=np.zeros((n_local, h), np.int32),
+            req_ids=np.full((n_local, h), n_total * h - 1, np.int32),
+            fresh=np.zeros((n_local, h), bool),
+            bucket=np.zeros((n_local, h), bool),
+            is_global=np.zeros((n_local, h), bool),
+        )
+        b["slots"][:, 0] = 7
+        b["deltas"][:, 0] = deltas_first
+        b["maxes"][:, 0] = maxes_first
+        b["windows_ms"][:, 0] = 60_000
+        b["is_global"][:, 0] = True
+        base = info.process_id * n_local * h
+        b["req_ids"][:, 0] = [
+            base + s * h for s in range(n_local)
+        ]
+        return host_local_to_global(mesh, tuple(b[k] for k in (
+            "slots", "deltas", "maxes", "windows_ms", "req_ids",
+            "fresh", "bucket", "is_global",
+        )))
+
+    # Round 1: every shard of every host admits one hit on slot 7.
+    state, res = sharded_check_and_update(
+        mesh, state, *stage(100, 1), np.int32(1000), global_region=8,
+        coupled=True, has_global=True,
+    )
+    round1 = np.asarray(res.admitted)
+    # Round 2: the global value is n_total; a probe with max == n_total
+    # must be rejected ANYWHERE (value n_total + 1 > max).
+    state, res2 = sharded_check_and_update(
+        mesh, state, *stage(n_total, 1), np.int32(1000), global_region=8,
+        coupled=True, has_global=True,
+    )
+    round2 = np.asarray(res2.admitted)
+    my_req = info.process_id * n_local * h
+    return {
+        "round1_admitted": bool(round1[my_req]),
+        "round2_rejected": not bool(round2[my_req]),
+    }
+
+
+def routed_drive(args, info):
+    """The routed-ingress parity drive (module docstring, step 3)."""
+    import jax
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.parallel import make_mesh, pod_barrier
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.tpu.sharded import TpuShardedStorage
+
+    clock = _Clock()
+    storage = TpuShardedStorage(
+        mesh=make_mesh(jax.local_devices()),
+        local_capacity=1 << 12,
+        global_region=64,
+        clock=clock,
+    )
+    limiter = RateLimiter(storage)
+    topology = PodTopology(
+        hosts=info.num_processes,
+        host_id=info.process_id,
+        shards_per_host=info.local_device_count,
+    )
+    peer_ports = [int(p) for p in args.peer_ports.split(",")]
+    lane = PeerLane(
+        info.process_id,
+        f"127.0.0.1:{peer_ports[info.process_id]}",
+        {
+            i: f"127.0.0.1:{port}"
+            for i, port in enumerate(peer_ports)
+            if i != info.process_id
+        },
+        None,
+    )
+    lane.start()
+    frontend = PodFrontend(limiter, PodRouter(topology), lane)
+
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(frontend.configure_with(drive_limits()))
+        # Peers must both be serving before the first forward dials.
+        # Control-plane barriers (NOT pod_sync): the waiting host's
+        # lane thread must stay free to launch on the shared local
+        # devices while the main thread parks here.
+        pod_barrier("pod-drive-ready")
+
+        def decide(i, ns, ctx, arrival):
+            if arrival != info.process_id:
+                return None
+            return loop.run_until_complete(
+                frontend.check_rate_limited_and_update(ns, ctx, 1, False)
+            )
+
+        decisions = run_drive(
+            decide, clock,
+            end_of_step=lambda i: pod_barrier(f"pod-drive-{i}"),
+        )
+        pod_barrier("pod-drive-done")
+        return {
+            "decisions": decisions,
+            "counters": counter_state(frontend),
+            "router": frontend.router.stats(),
+            "lane": frontend.lane.stats(),
+        }
+    finally:
+        lane.stop()
+        loop.close()
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--process-id", type=int, required=True)
+    parser.add_argument("--num-processes", type=int, required=True)
+    parser.add_argument("--coordinator", required=True)
+    parser.add_argument("--peer-ports", required=True)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from limitador_tpu.parallel import (
+            initialize_pod,
+            make_global_mesh,
+            make_sharded_table,
+        )
+
+        info = initialize_pod(
+            args.coordinator, args.num_processes, args.process_id
+        )
+        mesh = make_global_mesh()
+        state = make_sharded_table(mesh, 32)
+        out = {
+            "process_id": info.process_id,
+            "num_processes": info.num_processes,
+            "local_devices": info.local_device_count,
+            "global_devices": info.global_device_count,
+            "hlo": hlo_checks(mesh, state),
+            "psum": psum_check(mesh, info),
+        }
+        out.update(routed_drive(args, info))
+    except Exception as exc:  # noqa: BLE001 - classified below
+        message = f"{type(exc).__name__}: {exc}"
+        print(f"pod worker failed: {message}", file=sys.stderr)
+        unsupported = any(
+            marker in message
+            for marker in (
+                "Multiprocess computations aren't implemented",
+                "not implemented",
+                "DEADLINE_EXCEEDED",
+                "UNAVAILABLE",
+                "barrier timed out",
+            )
+        )
+        return POD_UNSUPPORTED if unsupported else 1
+    with open(args.out, "w") as f:
+        json.dump(out, f)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

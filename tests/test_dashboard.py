@@ -119,6 +119,28 @@ def test_dashboard_covers_tenant_and_signal_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_pod_routing_families():
+    """ISSUE 10: the pod tier ships WITH its Grafana row — a "Pod
+    routing" row exists and every pod_* / route-memo family is
+    referenced by at least one panel expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("pod routing" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    for family in (
+        "pod_routed_local",
+        "pod_routed_forwarded",
+        "pod_routed_pinned",
+        "pod_peer_p99_ms",
+        "pod_peer_errors",
+        "sharded_route_memo_hits",
+        "sharded_route_memo_misses",
+        "sharded_route_memo_evictions",
+        "sharded_route_memo_size",
+    ):
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

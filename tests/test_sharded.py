@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from limitador_tpu.parallel import (
+    make_global_mesh,
     make_mesh,
     make_sharded_table,
     sharded_check_and_update,
@@ -125,8 +126,8 @@ def test_global_counter_psum_read():
     assert not np.asarray(res2.admitted)[0]
 
 
-def _lower_hlo(local_cap=64, h=8, **variant) -> str:
-    mesh = make_mesh()
+def _lower_hlo(local_cap=64, h=8, mesh=None, **variant) -> str:
+    mesh = mesh if mesh is not None else make_mesh()
     n = mesh.shape["shard"]
     state = make_sharded_table(mesh, local_cap)
     b = _empty_batch(n, h, local_cap)
@@ -165,6 +166,24 @@ def test_hlo_lean_launch_has_no_collectives_or_replication():
     for op in ("all-gather", "all-reduce", "collective-permute",
                "all-to-all"):
         assert f"{op}(" not in hlo, f"lean HLO contains {op}"
+    offenders = _full_table_ops(hlo, n, local_cap)
+    assert not offenders, f"full-table access leaked into HLO: {offenders}"
+
+
+def test_hlo_lean_launch_is_collective_free_on_the_global_mesh():
+    """ISSUE 10: the pod mesh constructor (`make_global_mesh`, the
+    process-block-ordered pod-wide mesh) must preserve the lean
+    variant's zero-collective lowering. Single-process it degenerates
+    to the local device set — the cross-host flavor of this exact
+    assertion runs inside the live 2-process pod (tests/test_pod.py);
+    this keeps the constructor's device ordering continuously linted
+    in tier-1."""
+    mesh = make_global_mesh()
+    n, local_cap = mesh.shape["shard"], 64
+    hlo = _lower_hlo(local_cap, mesh=mesh, coupled=False, has_global=False)
+    for op in ("all-gather", "all-reduce", "collective-permute",
+               "all-to-all"):
+        assert f"{op}(" not in hlo, f"global-mesh lean HLO contains {op}"
     offenders = _full_table_ops(hlo, n, local_cap)
     assert not offenders, f"full-table access leaked into HLO: {offenders}"
 
