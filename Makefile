@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench race-hunt pod-smoke
+.PHONY: check check-all lint test test-all bench race-hunt pod-smoke pod-chaos
 
 check: lint test
 
@@ -35,6 +35,14 @@ race-hunt:
 # form a pod.
 pod-smoke:
 	python -m pytest tests/test_pod.py -q
+
+# Pod resilience chaos drill (ISSUE 11): fast fault-shim/health/failover
+# tier plus the slow drill that SIGKILLs a real subprocess owner host
+# mid-soak, asserts availability through the degraded window, restarts
+# it and proves journal-replay parity vs the single-process oracle.
+# Skips cleanly when grpc (the subprocess harness) is unavailable.
+pod-chaos:
+	python -m pytest tests/test_pod_chaos.py -q
 
 bench:
 	python bench.py

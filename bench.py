@@ -1242,19 +1242,33 @@ def _bench_pod_worker(args):
     routed = {"pod_routed_local": 0, "pod_routed_forwarded": 0,
               "pod_routed_pinned": 0}
     peer_p99_ms = 0.0
+    resilience = {"pod_failover_degraded_decisions": 0,
+                  "pod_failover_seconds": 0.0}
     if p > 1:
-        from limitador_tpu.server.peering import PeerLane, PodFrontend
+        from limitador_tpu.server.peering import (
+            PeerLane,
+            PodFrontend,
+            PodResilience,
+        )
 
         ports = [int(x) for x in args.pod_peer_ports.split(",")]
+        # The server's default resilience posture (degraded-owner
+        # failover on), so pod_degraded_share / pod_failover_seconds
+        # measure the shipped configuration: 0.0 on a healthy sweep,
+        # nonzero when the sweep itself tripped a peer breaker.
+        resilience = PodResilience()
         lane = PeerLane(
             pid,
             f"127.0.0.1:{ports[pid]}",
             {i: f"127.0.0.1:{port}" for i, port in enumerate(ports)
              if i != pid},
             None,
+            resilience=resilience,
         )
         lane.start()
-        frontend = PodFrontend(limiter, PodRouter(topo), lane)
+        frontend = PodFrontend(
+            limiter, PodRouter(topo), lane, resilience=resilience
+        )
         loop = asyncio.new_event_loop()
         # Warm the single-request program BEFORE peers start
         # forwarding: a forwarded decision must never pay this
@@ -1281,6 +1295,7 @@ def _bench_pod_worker(args):
         pod_barrier("bench-pod-drive-done")
         routed = frontend.router.stats()
         peer_p99_ms = lane.stats()["pod_peer_p99_ms"]
+        resilience = frontend.resilience_stats()
         lane.stop()
 
     with open(args.pod_out, "w") as f:
@@ -1290,6 +1305,7 @@ def _bench_pod_worker(args):
             "owned_keys": len(owned),
             "routed": routed,
             "peer_p99_ms": peer_p99_ms,
+            "resilience": resilience,
             "route_memo": storage.launch_stats(),
         }, f)
     return 0
@@ -1313,6 +1329,8 @@ def bench_pod():
     by_processes = {}
     shares = {}
     peer_p99 = {}
+    degraded_shares = {}
+    failover_seconds = {}
     pod_note = ""
     for p in (1, 2, 4):
         coordinator = f"127.0.0.1:{_free_port()}"
@@ -1371,8 +1389,8 @@ def bench_pod():
                 pod_note = failed
                 continue
             rate = 0.0
-            local = forwarded = pinned = 0
-            p99 = 0.0
+            local = forwarded = pinned = degraded = 0
+            p99 = failover_s = 0.0
             for out in outs:
                 with open(out) as f:
                     r = json.load(f)
@@ -1381,11 +1399,22 @@ def bench_pod():
                 forwarded += r["routed"]["pod_routed_forwarded"]
                 pinned += r["routed"]["pod_routed_pinned"]
                 p99 = max(p99, r["peer_p99_ms"])
+                res = r.get("resilience", {})
+                degraded += int(
+                    res.get("pod_failover_degraded_decisions", 0)
+                )
+                failover_s += float(res.get("pod_failover_seconds", 0.0))
         by_processes[str(p)] = round(rate, 1)
         total_routed = local + forwarded + pinned
         if total_routed:
             shares[str(p)] = round(local / total_routed, 4)
+            # Resilience evidence (ISSUE 11): the share of routed
+            # decisions served by a degraded-owner stand-in, and the
+            # cumulative breaker-away-from-closed clock. 0.0 on a
+            # healthy sweep — nonzero means the sweep itself tripped.
+            degraded_shares[str(p)] = round(degraded / total_routed, 4)
         peer_p99[str(p)] = round(p99, 3)
+        failover_seconds[str(p)] = round(failover_s, 3)
         print(
             f"pod over {p} process(es): {rate/1e3:.1f}k decisions/s"
             + (
@@ -1414,6 +1443,8 @@ def bench_pod():
         pod_routed_share=routed_share,
         pod_routed_share_by_processes=shares,
         pod_peer_p99_ms_by_processes=peer_p99,
+        pod_degraded_share=degraded_shares.get(str(full_p), 0.0),
+        pod_failover_seconds=failover_seconds.get(str(full_p), 0.0),
         **({"pod_note": pod_note} if pod_note else {}),
     )
 

@@ -19,6 +19,12 @@ do NOT — a caller bug must never fail the whole plane over.
 
 Thread-safe: batch outcomes arrive on collect/dispatch threads while
 admission checks run on the event loop.
+
+The pod resilience plane (server/peering.py, ISSUE 11) reuses this
+class one level up: one breaker PER POD PEER gating degraded-owner
+failover, with the stall watch disarmed (peer failures arrive as
+recorded exceptions, not stalled device batches) and recovery driven
+by the lane's background probes through ``probe_succeeded``.
 """
 
 from __future__ import annotations

@@ -631,6 +631,92 @@ class PrometheusMetrics:
             "forward window — the pod's one-hop cost",
             registry=self.registry,
         )
+        # -- pod resilience plane (server/peering.py, ISSUE 11): the
+        # peer health state machine, retry/hedge traffic and the
+        # degraded-owner failover, polled off the pod frontend's
+        # library_stats. Registered in peering.METRIC_FAMILIES (lint
+        # cross-checked).
+        self.peer_health_state = Gauge(
+            "peer_health_state",
+            "Peer health state per pod peer: 0 up, 1 suspect "
+            "(consecutive failures/deadline misses), 2 down (probed "
+            "until it answers again)",
+            ["peer"],
+            registry=self.registry,
+        )
+        self.peer_health_retries = Counter(
+            "peer_health_retries",
+            "Jittered-backoff forward retries against suspect peers "
+            "(idempotent check kinds only, deadline-budgeted)",
+            registry=self.registry,
+        )
+        self.peer_health_hedges_won = Counter(
+            "peer_health_hedges_won",
+            "Hedged forwards where the raced second attempt answered "
+            "first (the original was stalled)",
+            registry=self.registry,
+        )
+        self.peer_health_hedges_lost = Counter(
+            "peer_health_hedges_lost",
+            "Hedged forwards where the original attempt still won "
+            "(the hedge was wasted work)",
+            registry=self.registry,
+        )
+        self.peer_health_redials = Counter(
+            "peer_health_redials",
+            "Cached peer channels dropped on a health trip so a "
+            "restarted peer gets a fresh dial instead of the stale "
+            "channel's backoff state",
+            registry=self.registry,
+        )
+        self.peer_health_probes = Counter(
+            "peer_health_probes",
+            "Background ping probes sent to non-up peers from the "
+            "lane's daemon loop (recovery detection)",
+            registry=self.registry,
+        )
+        self.pod_failover_degraded_decisions = Counter(
+            "pod_failover_degraded_decisions",
+            "Forwarded decisions served by a local per-owner stand-in "
+            "(exact oracle + delta journal) while the owner's breaker "
+            "was away from closed",
+            registry=self.registry,
+        )
+        self.pod_failover_journal_depth = Gauge(
+            "pod_failover_journal_depth",
+            "Counter deltas journaled against down owners, awaiting "
+            "replay — the live zero-lost-updates backlog",
+            registry=self.registry,
+        )
+        self.pod_failover_breaker_open = Gauge(
+            "pod_failover_breaker_open",
+            "Pod peers whose per-owner breaker is away from closed "
+            "(their forwarded traffic is failing over locally)",
+            registry=self.registry,
+        )
+        self.pod_failover_reconciles = Counter(
+            "pod_failover_reconciles",
+            "Journal replays completed into recovered owners "
+            "(apply_deltas over the peer lane)",
+            registry=self.registry,
+        )
+        self.pod_failover_replayed_deltas = Counter(
+            "pod_failover_replayed_deltas",
+            "Journaled counter deltas replayed into recovered owners",
+            registry=self.registry,
+        )
+        self.pod_failover_reconcile_seconds = Counter(
+            "pod_failover_reconcile_seconds",
+            "Cumulative seconds spent replaying failover journals to "
+            "recovered owners",
+            registry=self.registry,
+        )
+        self.pod_failover_seconds = Counter(
+            "pod_failover_seconds",
+            "Cumulative seconds pod peer breakers have spent away "
+            "from closed (the degraded-window clock)",
+            registry=self.registry,
+        )
         # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
         # split into pipelined sub-batches. Registered in
         # batcher.METRIC_FAMILIES (lint cross-checked).
@@ -785,6 +871,8 @@ class PrometheusMetrics:
         lease_outstanding = 0
         route_memo_size = 0
         peer_p99_ms = 0.0
+        failover_journal_depth = 0
+        failover_breaker_open = 0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -804,6 +892,26 @@ class PrometheusMetrics:
             peer_p99_ms = max(
                 peer_p99_ms, float(stats.get("pod_peer_p99_ms", 0.0))
             )
+            failover_journal_depth += int(
+                stats.get("pod_failover_journal_depth", 0)
+            )
+            failover_breaker_open += int(
+                stats.get("pod_failover_breaker_open", 0)
+            )
+            for peer, state in stats.get("peer_health_state", {}).items():
+                self.peer_health_state.labels(str(peer)).set(int(state))
+            # float-valued cumulative counters (seconds): same baseline
+            # conversion as below, without the int truncation
+            for key in (
+                "pod_failover_reconcile_seconds",
+                "pod_failover_seconds",
+            ):
+                if key in stats:
+                    seen_f = float(stats[key])
+                    baseline_f = self._counter_baselines.get((i, key), 0.0)
+                    if seen_f > baseline_f:
+                        getattr(self, key).inc(seen_f - baseline_f)
+                        self._counter_baselines[(i, key)] = seen_f
             for key in (
                 "counter_overshoot",
                 "evicted_pending_writes",
@@ -834,6 +942,14 @@ class PrometheusMetrics:
                 "pod_routed_forwarded",
                 "pod_routed_pinned",
                 "pod_peer_errors",
+                "peer_health_retries",
+                "peer_health_hedges_won",
+                "peer_health_hedges_lost",
+                "peer_health_redials",
+                "peer_health_probes",
+                "pod_failover_degraded_decisions",
+                "pod_failover_reconciles",
+                "pod_failover_replayed_deltas",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -861,6 +977,8 @@ class PrometheusMetrics:
         self.lease_outstanding_tokens.set(lease_outstanding)
         self.sharded_route_memo_size.set(route_memo_size)
         self.pod_peer_p99_ms.set(peer_p99_ms)
+        self.pod_failover_journal_depth.set(failover_journal_depth)
+        self.pod_failover_breaker_open.set(failover_breaker_open)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

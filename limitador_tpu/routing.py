@@ -59,7 +59,9 @@ __all__ = [
 #: metric families this subsystem owns (cross-checked against
 #: observability/metrics.py by the analysis registry pass): pod routing
 #: verdict counters + peer-lane health, polled off the pod frontend's
-#: library_stats at render time.
+#: library_stats at render time. The resilience-plane families
+#: (peer_health_* / pod_failover_*, ISSUE 11) are registered by their
+#: owner, server/peering.py's METRIC_FAMILIES.
 METRIC_FAMILIES = (
     "pod_routed_local",
     "pod_routed_forwarded",

@@ -315,6 +315,38 @@ def build_parser() -> argparse.ArgumentParser:
         help="pod: bind address of this host's peer lane "
         "(default 0.0.0.0:<rls-port + 2>)",
     )
+    # pod resilience plane (docs/configuration.md "Pod resilience"):
+    # peer health + retry/hedge on the lane, degraded-owner failover
+    # with journaled reconcile behind a per-peer breaker
+    p.add_argument(
+        "--pod-degraded-mode", choices=["on", "off"],
+        default=_env("TPU_POD_DEGRADED_MODE", "on"),
+        help="pod: on (default) = forward failures feed a per-peer "
+        "breaker and fail over to a local exact stand-in that journals "
+        "deltas for replay on recovery (plus one jittered retry for "
+        "suspect peers); off = PR 10 behavior, a peer failure fails "
+        "that request (UNAVAILABLE/500)",
+    )
+    p.add_argument(
+        "--pod-hedge-ms", type=float,
+        default=float(_env("TPU_POD_HEDGE_MS", "0")),
+        help="pod: >0 enables hedged forwards — when an in-flight "
+        "forward outlasts max(this floor, the tracked peer p99) a "
+        "second attempt races it on a fresh channel; 0 (default) "
+        "disables hedging",
+    )
+    p.add_argument(
+        "--pod-peer-breaker-failures", type=int,
+        default=int(_env("TPU_POD_PEER_BREAKER_FAILURES", "3")),
+        help="pod: consecutive forward failures that open a peer's "
+        "failover breaker",
+    )
+    p.add_argument(
+        "--pod-peer-breaker-reset-ms", type=float,
+        default=float(_env("TPU_POD_PEER_BREAKER_RESET_MS", "2000")),
+        help="pod: ms an open peer breaker dwells before recovery "
+        "probes may close it",
+    )
     p.add_argument(
         "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
         help="sharded: comma-separated namespaces whose counters are "
@@ -542,19 +574,25 @@ def _pod_local_mesh():
     return None
 
 
+def _pin_platform() -> None:
+    """Pin the jax backend per LIMITADOR_TPU_PLATFORM before anything
+    initializes it. The axon site hook overrides the JAX_PLATFORMS env
+    var, so this is the supported way to run the tpu storages on the
+    host backend (accelerator-less validation, on-box serving
+    measurements). Called before pod formation AND before the storage
+    build — whichever runs first wins (idempotent)."""
+    platform = os.environ.get("LIMITADOR_TPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def build_limiter(args, on_partitioned=None):
     """Limiter::new equivalent (main.rs:93-185): pick + build the backend.
     ``on_partitioned`` reaches storages that track authority partitions
     (the datastore_partitioned gauge)."""
-    platform = os.environ.get("LIMITADOR_TPU_PLATFORM")
-    if platform:
-        # Pin the jax backend before any storage initializes it. The axon
-        # site hook overrides the JAX_PLATFORMS env var, so this is the
-        # supported way to run the tpu storages on the host backend
-        # (accelerator-less validation, on-box serving measurements).
-        import jax
-
-        jax.config.update("jax_platforms", platform)
+    _pin_platform()
     if args.authority_url and args.storage != "cached":
         raise SystemExit(
             f"--authority-url only applies to the 'cached' storage "
@@ -746,6 +784,13 @@ async def _amain(args) -> int:
     # own shard block; a restarted host restores only its own).
     pod = None
     if args.pod_processes > 1 or args.pod_coordinator:
+        # The platform pin must land BEFORE pod formation, not just in
+        # build_limiter: initialize_pod's device discovery otherwise
+        # probes every backend plugin first, and on an accelerator-less
+        # box the TPU plugin's metadata retries can stall a pod host's
+        # boot for minutes (whichever process loses the libtpu lockfile
+        # race pays the slow probe).
+        _pin_platform()
         if args.pod_processes > 1 and not args.pod_coordinator:
             raise SystemExit(
                 "--pod-processes > 1 requires --pod-coordinator "
@@ -844,7 +889,7 @@ async def _amain(args) -> int:
     pod_frontend = None
     if pod is not None and pod.num_processes > 1:
         from ..routing import PodRouter, PodTopology
-        from .peering import PeerLane, PodFrontend
+        from .peering import PeerLane, PodFrontend, PodResilience
 
         peer_urls = args.pod_peer or [
             u for u in (_env("TPU_POD_PEERS") or "").split(",") if u
@@ -854,6 +899,18 @@ async def _amain(args) -> int:
                 f"pod: need one --pod-peer per process "
                 f"({pod.num_processes}), got {len(peer_urls)}"
             )
+        # --pod-degraded-mode off pins the PR 10 posture exactly: no
+        # retry, no breaker/failover — a peer failure fails that
+        # request. Hedging stays its own opt-in (--pod-hedge-ms).
+        degraded = args.pod_degraded_mode == "on"
+        resilience = PodResilience(
+            degraded=degraded,
+            retry=degraded,
+            hedge_ms=max(args.pod_hedge_ms, 0.0),
+            breaker_failures=args.pod_peer_breaker_failures,
+            breaker_reset_s=args.pod_peer_breaker_reset_ms / 1e3,
+            probe_interval_s=float(_env("TPU_POD_PROBE_MS", "500")) / 1e3,
+        )
         lane = PeerLane(
             pod.process_id,
             args.pod_peer_listen or f"{args.rls_host}:{args.rls_port + 2}",
@@ -863,6 +920,7 @@ async def _amain(args) -> int:
                 if i != pod.process_id
             },
             None,
+            resilience=resilience,
         )
         # NOT started here: the lane begins serving only after the
         # initial limits load below — a restarting host must never
@@ -877,7 +935,8 @@ async def _amain(args) -> int:
             ns for ns in (args.global_namespaces or "").split(",") if ns
         }
         pod_frontend = PodFrontend(
-            limiter, router, lane, global_namespaces=pod_global_ns
+            limiter, router, lane, global_namespaces=pod_global_ns,
+            resilience=resilience,
         )
         limiter = pod_frontend
         log.info(
@@ -885,6 +944,12 @@ async def _amain(args) -> int:
             f"shards "
             f"[{pod.process_id * router.topology.shards_per_host}, "
             f"{(pod.process_id + 1) * router.topology.shards_per_host})")
+        log.info(
+            "pod resilience: degraded-owner failover "
+            f"{'on' if degraded else 'off'}, hedge "
+            f"{resilience.hedge_ms:.0f}ms, breaker "
+            f"{resilience.breaker_failures} failures / "
+            f"{resilience.breaker_reset_s * 1e3:.0f}ms reset")
     counters_storage = limiter.storage.counters
     # Prefer the limiter (the compiled pipeline aggregates its storage's
     # stats and adds compiler eval counters); otherwise the storage itself.

@@ -24,6 +24,33 @@ delta) to the owner host and adopt its CheckResult. Attribute access
 delegates to the wrapped limiter, so the RLS/HTTP planes and the
 metrics wiring see the frontend as the limiter itself;
 ``library_stats`` additionally carries the ``pod_*`` families.
+
+The pod resilience plane (ISSUE 11) layers three mechanisms over the
+lane so a dead owner host degrades instead of hard-failing its key
+range (docs/configuration.md "Pod resilience"):
+
+* **Peer health** (:class:`PeerHealth`): per-peer up/suspect/down from
+  consecutive forward failures and deadline misses, background probes
+  on the lane's daemon loop, and a channel re-dial on every trip — a
+  peer restarted on the same address gets a fresh dial instead of the
+  stale cached channel (the PR 10 bug).
+* **Retry + hedging**: one jittered-backoff retry for idempotent check
+  forwards once a peer is suspect, and an opt-in hedge
+  (``--pod-hedge-ms``) that races a second attempt on a fresh channel
+  when an in-flight forward outlasts both the configured floor and the
+  tracked peer p99 — both budgeted against the forward deadline so a
+  retry can never outlive the request.
+* **Degraded-owner failover** (:class:`PodFrontend` +
+  ``--pod-degraded-mode``): forward failures feed a per-peer circuit
+  breaker (the admission plane's closed/open/half-open core); while it
+  is away from closed, that owner's forwarded traffic is decided
+  against a local exact stand-in (``storage/failover.py``) that
+  journals every admitted delta. When the background probe finds the
+  peer serving again, the journal replays to the owner through the
+  lane into its storage's ``apply_deltas`` contract, the stand-in
+  drains, and routing flips back — zero admitted updates are lost
+  across the partition window, and over-admission is bounded by one
+  window budget per counter (docs/serving-model.md).
 """
 
 from __future__ import annotations
@@ -33,12 +60,16 @@ import collections
 import inspect
 import json
 import logging
+import os
+import random
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
+from ..admission.breaker import BreakerState, CircuitBreaker
 from ..core.cel import Context
-from ..core.limit import Namespace
+from ..core.counter import Counter
+from ..core.limit import Limit, Namespace
 from ..core.limiter import (
     AsyncRateLimiter,
     CheckResult,
@@ -46,8 +77,19 @@ from ..core.limiter import (
 )
 from ..routing import LOCAL, PodRouter, counter_key
 from ..storage.base import StorageError
+from ..storage.failover import FailoverStore
 
-__all__ = ["PeerLane", "PodFrontend", "PEER_SERVICE", "PEER_METHOD"]
+__all__ = [
+    "PeerLane",
+    "PodFrontend",
+    "PodResilience",
+    "PeerHealth",
+    "PeerState",
+    "FaultInjector",
+    "PEER_SERVICE",
+    "PEER_METHOD",
+    "METRIC_FAMILIES",
+]
 
 log = logging.getLogger("limitador_tpu.pod")
 
@@ -62,6 +104,32 @@ FORWARD_TIMEOUT_SECONDS = 10.0
 
 #: forward-latency reservoir size for the pod_peer_p99_ms gauge
 _LATENCY_WINDOW = 2048
+
+#: forward kinds safe to retry/hedge: a duplicated check at worst
+#: double-counts one delta (conservative for a limiter — it can only
+#: under-admit); update_counters and apply_deltas carry their own
+#: replay semantics and are never retried by the lane.
+RETRYABLE_KINDS = frozenset({"check_and_update", "is_rate_limited", "ping"})
+
+#: metric families this subsystem owns (cross-checked against
+#: observability/metrics.py by the analysis registry pass): peer health
+#: state + retry/hedge traffic from the lane, degraded-owner failover
+#: from the frontend — all polled off library_stats at render time.
+METRIC_FAMILIES = (
+    "peer_health_state",
+    "peer_health_retries",
+    "peer_health_hedges_won",
+    "peer_health_hedges_lost",
+    "peer_health_redials",
+    "peer_health_probes",
+    "pod_failover_degraded_decisions",
+    "pod_failover_journal_depth",
+    "pod_failover_breaker_open",
+    "pod_failover_reconciles",
+    "pod_failover_replayed_deltas",
+    "pod_failover_reconcile_seconds",
+    "pod_failover_seconds",
+)
 
 
 def _encode_context(ctx: Context) -> dict:
@@ -78,11 +146,265 @@ def _decode_context(blob: dict) -> Context:
     return ctx
 
 
+def _counter_to_wire(counter: Counter, delta: int) -> dict:
+    """JSON-safe identity of a journaled counter delta, so the owner
+    rebuilds a Counter that hashes identically in its own storage.
+    ``policy`` is identity-bearing (core/limit.py: a fixed-window and a
+    token-bucket limit with equal parameters are DIFFERENT limits) —
+    dropping it would replay a token-bucket journal onto a phantom
+    fixed-window counter."""
+    limit = counter.limit
+    return {
+        "ns": str(limit.namespace),
+        "max": limit.max_value,
+        "seconds": limit.seconds,
+        "conditions": sorted(c.source for c in limit.conditions),
+        "variables": sorted(v.source for v in limit.variables),
+        "name": limit.name,
+        "id": limit.id,
+        "policy": limit.policy,
+        "vars": sorted(counter.set_variables.items()),
+        "delta": int(delta),
+    }
+
+
+def _counter_from_wire(blob: dict) -> Tuple[Counter, int]:
+    limit = Limit(
+        blob["ns"], blob["max"], blob["seconds"],
+        blob.get("conditions", ()), blob.get("variables", ()),
+        name=blob.get("name"), id=blob.get("id"),
+        policy=blob.get("policy", "fixed_window"),
+    )
+    return Counter(limit, dict(blob.get("vars", ()))), int(blob["delta"])
+
+
+def _is_deadline_miss(exc: BaseException) -> bool:
+    if isinstance(exc, (TimeoutError, asyncio.TimeoutError)):
+        return True
+    return "DEADLINE_EXCEEDED" in f"{exc}"
+
+
+class PodResilience:
+    """Pod resilience knobs (server flags ``--pod-hedge-ms``,
+    ``--pod-peer-breaker-*``, ``--pod-degraded-mode``; env ``TPU_POD_*``
+    — docs/configuration.md "Pod resilience"). :meth:`legacy` is the
+    PR 10 posture every direct construction defaults to: no retry, no
+    hedge, no breaker/failover — a peer failure fails that request."""
+
+    def __init__(
+        self,
+        degraded: bool = True,
+        retry: bool = True,
+        hedge_ms: float = 0.0,
+        retry_backoff_ms: float = 1.0,
+        breaker_failures: int = 3,
+        breaker_reset_s: float = 2.0,
+        suspect_after: int = 1,
+        down_after: int = 3,
+        probe_interval_s: float = 0.5,
+        probe_timeout_s: float = 1.0,
+        deadline_s: float = FORWARD_TIMEOUT_SECONDS,
+        journal_cache: int = 100_000,
+    ):
+        self.degraded = bool(degraded)
+        self.retry = bool(retry)
+        self.hedge_ms = float(hedge_ms)
+        self.retry_backoff_ms = float(retry_backoff_ms)
+        self.breaker_failures = max(int(breaker_failures), 1)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self.suspect_after = max(int(suspect_after), 1)
+        self.down_after = max(int(down_after), self.suspect_after)
+        self.probe_interval_s = float(probe_interval_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.deadline_s = float(deadline_s)
+        self.journal_cache = int(journal_cache)
+
+    @classmethod
+    def legacy(cls) -> "PodResilience":
+        return cls(degraded=False, retry=False, hedge_ms=0.0)
+
+
+class PeerState:
+    UP = "up"
+    SUSPECT = "suspect"
+    DOWN = "down"
+
+    #: gauge encoding for peer_health_state
+    GAUGE = {UP: 0, SUSPECT: 1, DOWN: 2}
+
+
+class PeerHealth:
+    """Per-peer up/suspect/down from consecutive failures. Thread-safe:
+    forwards fail from serving event loops, probes succeed on the lane
+    loop, recovery completes on its own thread. Transitions are
+    returned to the caller (never called back under the lock) so the
+    lane can re-dial exactly once per trip."""
+
+    def __init__(
+        self, peers, suspect_after: int = 1, down_after: int = 3
+    ):
+        self.suspect_after = max(int(suspect_after), 1)
+        self.down_after = max(int(down_after), self.suspect_after)
+        self._health_lock = threading.Lock()
+        self._state: Dict[int, str] = {p: PeerState.UP for p in peers}
+        self._failures: Dict[int, int] = {p: 0 for p in peers}
+        self.transitions = 0
+        self.deadline_misses = 0
+
+    def state(self, peer: int) -> str:
+        with self._health_lock:
+            return self._state.get(peer, PeerState.UP)
+
+    def states(self) -> Dict[int, int]:
+        """peer -> gauge encoding (rendered as peer_health_state)."""
+        with self._health_lock:
+            return {
+                p: PeerState.GAUGE[s] for p, s in self._state.items()
+            }
+
+    def record_failure(
+        self, peer: int, deadline_miss: bool = False
+    ) -> Optional[str]:
+        """Count one failed forward/probe; returns the new state when
+        this call transitioned the peer (the lane re-dials on it)."""
+        with self._health_lock:
+            if peer not in self._state:
+                return None
+            if deadline_miss:
+                self.deadline_misses += 1
+            self._failures[peer] = self._failures.get(peer, 0) + 1
+            fails = self._failures[peer]
+            new = (
+                PeerState.DOWN if fails >= self.down_after
+                else PeerState.SUSPECT if fails >= self.suspect_after
+                else PeerState.UP
+            )
+            if new == self._state[peer]:
+                return None
+            self._state[peer] = new
+            self.transitions += 1
+            return new
+
+    def record_success(self, peer: int) -> Optional[str]:
+        with self._health_lock:
+            if peer not in self._state:
+                return None
+            self._failures[peer] = 0
+            if self._state[peer] == PeerState.UP:
+                return None
+            self._state[peer] = PeerState.UP
+            self.transitions += 1
+            return PeerState.UP
+
+
+class FaultInjector:
+    """Deterministic per-peer fault shim for the pod chaos harness.
+
+    Applied on the lane loop just before a forward/probe attempt dials
+    its peer, so every failure mode exercises the REAL resilience path
+    (health trips, retries, breaker, failover). Modes:
+
+    * ``drop``      — the dial fails instantly (ConnectionError);
+    * ``error``     — the call fails instantly (RuntimeError);
+    * ``delay``     — the call is delayed ``delay_ms`` then proceeds;
+    * ``blackhole`` — the call consumes its whole deadline and times
+      out (the pathological stall the hedge exists for).
+
+    Env-seeded for subprocess drills: ``TPU_POD_FAULTS`` is a
+    comma-separated list of ``peer:mode[:probability[:times]]`` rules
+    (``1:drop``, ``1:error:0.5``, ``0:delay:1:3``), ``TPU_POD_FAULT_SEED``
+    seeds the probability draws so a drill replays byte-identically,
+    and ``TPU_POD_FAULT_DELAY_MS`` sets the delay-mode latency."""
+
+    MODES = ("drop", "delay", "error", "blackhole")
+
+    def __init__(self, seed: int = 0, delay_ms: float = 100.0):
+        self._rng = random.Random(seed)
+        self.delay_ms = float(delay_ms)
+        # peer -> [mode, probability, remaining_times (None = forever)]
+        self._rules: Dict[int, list] = {}
+        self.injected = 0
+
+    @classmethod
+    def from_env(cls, env=None) -> "FaultInjector":
+        env = os.environ if env is None else env
+        injector = cls(
+            seed=int(env.get("TPU_POD_FAULT_SEED", "0") or 0),
+            delay_ms=float(env.get("TPU_POD_FAULT_DELAY_MS", "100") or 100),
+        )
+        spec = env.get("TPU_POD_FAULTS", "")
+        for rule in spec.split(","):
+            rule = rule.strip()
+            if not rule:
+                continue
+            parts = rule.split(":")
+            if len(parts) < 2 or parts[1] not in cls.MODES:
+                raise ValueError(
+                    f"TPU_POD_FAULTS rule '{rule}' is not "
+                    "peer:mode[:probability[:times]] with mode in "
+                    f"{cls.MODES}"
+                )
+            injector.set_fault(
+                int(parts[0]), parts[1],
+                p=float(parts[2]) if len(parts) > 2 else 1.0,
+                times=int(parts[3]) if len(parts) > 3 else None,
+            )
+        return injector
+
+    def set_fault(
+        self, peer: int, mode: str, p: float = 1.0,
+        times: Optional[int] = None,
+    ) -> None:
+        if mode not in self.MODES:
+            raise ValueError(f"unknown fault mode '{mode}'")
+        self._rules[int(peer)] = [mode, float(p), times]
+
+    def clear(self, peer: Optional[int] = None) -> None:
+        if peer is None:
+            self._rules.clear()
+        else:
+            self._rules.pop(int(peer), None)
+
+    def verdict(self, peer: int) -> Optional[str]:
+        """The fault (or None) this attempt draws — deterministic under
+        a fixed seed and call sequence."""
+        rule = self._rules.get(int(peer))
+        if rule is None:
+            return None
+        mode, p, times = rule
+        if times is not None and times <= 0:
+            return None
+        if p < 1.0 and self._rng.random() >= p:
+            return None
+        if times is not None:
+            rule[2] = times - 1
+        self.injected += 1
+        return mode
+
+    async def apply(self, peer: int, timeout: float) -> None:
+        """Raise/delay per the drawn verdict (lane loop only)."""
+        mode = self.verdict(peer)
+        if mode is None:
+            return
+        if mode == "drop":
+            raise ConnectionError(f"injected drop for peer {peer}")
+        if mode == "error":
+            raise RuntimeError(f"injected error for peer {peer}")
+        if mode == "delay":
+            await asyncio.sleep(self.delay_ms / 1e3)
+            return
+        # blackhole: the peer never answers — consume the deadline
+        await asyncio.sleep(max(float(timeout), 0.0))
+        raise TimeoutError(f"injected blackhole for peer {peer}")
+
+
 class PeerLane:
     """The host-to-host forwarding lane: serves ``Decide`` for peers and
     dials peers for our own forwards. ``decide_cb`` is an async callable
     ``(namespace, ctx, delta, load, kind) -> CheckResult-or-None`` run
-    on the lane loop — the owner-side local decision."""
+    on the lane loop — the owner-side local decision. ``apply_cb`` (set
+    by the frontend) applies a recovered peer's journal replay into the
+    local storage's ``apply_deltas`` contract."""
 
     def __init__(
         self,
@@ -90,14 +412,51 @@ class PeerLane:
         listen_address: str,
         peers: Dict[int, str],
         decide_cb,
+        resilience: Optional[PodResilience] = None,
     ):
         self.host_id = host_id
         self.listen_address = listen_address
         self.peers = dict(peers)
         self.decide_cb = decide_cb
+        self.apply_cb: Optional[Callable[[list], int]] = None
+        #: sync callable (host) -> bool run on a recovery thread when a
+        #: background probe finds a non-up peer answering again; True
+        #: marks the peer up (the frontend replays its journal first)
+        self.on_peer_recovered: Optional[Callable[[int], bool]] = None
+        #: optional (host) -> bool: the frontend answers True while the
+        #: host still needs recovery work (breaker away from closed, or
+        #: a journal awaiting replay) even though its HEALTH is up — a
+        #: sub-threshold failure journals a delta without downing the
+        #: peer, and that delta must still drain
+        self.probe_needed: Optional[Callable[[int], bool]] = None
+        self.cfg = resilience or PodResilience.legacy()
+        self.health = PeerHealth(
+            self.peers,
+            suspect_after=self.cfg.suspect_after,
+            down_after=self.cfg.down_after,
+        )
+        # Fault shim: armed rules must be LOUD (an ambient TPU_POD_FAULTS
+        # leaked from a drill runbook would otherwise silently degrade
+        # live traffic), and a malformed spec must not abort a pod
+        # host's boot.
+        try:
+            self.faults = FaultInjector.from_env()
+        except ValueError as exc:
+            log.warning(f"ignoring malformed TPU_POD_FAULTS: {exc}")
+            self.faults = FaultInjector()
+        if self.faults._rules:
+            log.warning(
+                "pod fault injection ARMED (TPU_POD_FAULTS): "
+                f"{self.faults._rules}"
+            )
         self.forwards = 0
         self.served = 0
         self.errors = 0
+        self.retries = 0
+        self.hedges_won = 0
+        self.hedges_lost = 0
+        self.redials = 0
+        self.probes = 0
         # Guards the latency reservoir: forwards append from serving
         # event-loop threads while the Prometheus render thread
         # snapshots it (an unguarded sorted() over a mutating deque
@@ -108,6 +467,8 @@ class PeerLane:
         self._thread: Optional[threading.Thread] = None
         self._server = None
         self._channels: dict = {}
+        self._recovering: set = set()
+        self._probing: set = set()
         self._stopping = threading.Event()
         self._started = threading.Event()
         self.port: Optional[int] = None
@@ -147,8 +508,25 @@ class PeerLane:
         self.port = self._server.add_insecure_port(self.listen_address)
         await self._server.start()
         self._started.set()
+        # Background probes ride the existing daemon loop: while the
+        # frontend degrades a down owner's traffic, this is the only
+        # path that notices the owner serving again and kicks off the
+        # journal replay — recovery never depends on live traffic.
+        next_probe = self._loop.time()
         while not self._stopping.is_set():
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(0.1)
+            if not (self.cfg.degraded and self.peers):
+                continue
+            now = self._loop.time()
+            if now < next_probe:
+                continue
+            next_probe = now + self.cfg.probe_interval_s
+            for host in list(self.peers):
+                if self.health.state(host) != PeerState.UP or (
+                    self.probe_needed is not None
+                    and self.probe_needed(host)
+                ):
+                    asyncio.ensure_future(self._probe(host))
         for channel, _call in self._channels.values():
             await channel.close()
         await self._server.stop(grace=0.5)
@@ -162,13 +540,29 @@ class PeerLane:
 
     async def _serve_decide(self, blob: bytes, context) -> bytes:
         payload = json.loads(blob.decode())
+        kind = payload.get("kind", "check_and_update")
+        if kind == "ping":
+            return json.dumps({"ok": True, "pong": True}).encode()
+        if kind == "apply_deltas":
+            if self.apply_cb is None:
+                raise RuntimeError(
+                    "pod peer lane has no apply_deltas handler"
+                )
+            # Off-loop: a replay batch into a device-backed storage is
+            # a blocking, lock-taking apply — running it inline would
+            # stall every peer's forwards (and our own probes) behind
+            # the freshly recovered host's catch-up.
+            applied = await asyncio.get_running_loop().run_in_executor(
+                None, self.apply_cb, payload.get("deltas", [])
+            )
+            return json.dumps({"ok": True, "applied": int(applied)}).encode()
         self.served += 1
         result = await self.decide_cb(
             payload["ns"],
             _decode_context(payload["ctx"]),
             int(payload["delta"]),
             bool(payload.get("load", False)),
-            payload.get("kind", "check_and_update"),
+            kind,
         )
         out: dict = {"ok": True}
         if isinstance(result, CheckResult):
@@ -188,20 +582,220 @@ class PeerLane:
 
     # -- client side ---------------------------------------------------------
 
-    async def _forward_on_loop(self, host: int, blob: bytes) -> bytes:
+    def _redial(self, host: int) -> None:
+        """Drop the cached channel so the next attempt dials fresh (lane
+        loop only). A peer restarted on the same address must not keep
+        failing on the stale channel's backoff state."""
+        entry = self._channels.pop(host, None)
+        if entry is not None:
+            self.redials += 1
+            asyncio.ensure_future(entry[0].close())
+
+    def _dial(self, host: int):
+        """A genuinely fresh channel. The local subchannel pool is the
+        load-bearing option: grpc shares subchannels globally by
+        target, so without it a 're-dialed' channel silently inherits
+        the dead subchannel's connect-backoff state and keeps refusing
+        a peer that already restarted on the same address."""
         import grpc
 
+        channel = grpc.aio.insecure_channel(
+            self.peers[host],
+            options=(("grpc.use_local_subchannel_pool", 1),),
+        )
+        call = channel.unary_unary(
+            PEER_METHOD,
+            request_serializer=bytes,
+            response_deserializer=bytes,
+        )
+        return channel, call
+
+    async def _attempt(
+        self, host: int, blob: bytes, timeout: float, fresh: bool = False
+    ) -> bytes:
+        await self.faults.apply(host, timeout)
+        if fresh:
+            # Hedge/retry attempts dial their own channel: the point is
+            # to escape whatever the cached channel is stuck on.
+            channel, call = self._dial(host)
+            try:
+                return await self._call(host, call, blob, timeout)
+            finally:
+                asyncio.ensure_future(channel.close())
         entry = self._channels.get(host)
         if entry is None:
-            channel = grpc.aio.insecure_channel(self.peers[host])
-            call = channel.unary_unary(
-                PEER_METHOD,
-                request_serializer=bytes,
-                response_deserializer=bytes,
-            )
-            entry = self._channels[host] = (channel, call)
+            entry = self._channels[host] = self._dial(host)
         _channel, call = entry
-        return await call(blob, timeout=FORWARD_TIMEOUT_SECONDS)
+        return await self._call(host, call, blob, timeout)
+
+    @staticmethod
+    async def _call(host: int, call, blob: bytes, timeout: float) -> bytes:
+        try:
+            return await call(blob, timeout=timeout)
+        except asyncio.CancelledError as exc:
+            # A concurrent health trip re-dialed (closed) this channel
+            # under the in-flight call; grpc surfaces that as a call
+            # CANCELLATION, which as a BaseException would sail past
+            # every failure handler and escape to the serving plane.
+            # Surface it as the connection failure it is, so the normal
+            # retry/degraded handling applies.
+            raise ConnectionError(
+                f"peer {host} channel closed mid-call"
+            ) from exc
+
+    def _note_failure(self, host: int, exc: BaseException) -> None:
+        """Health accounting + re-dial on trip (lane loop only)."""
+        tripped = self.health.record_failure(
+            host, deadline_miss=_is_deadline_miss(exc)
+        )
+        if tripped is not None:
+            self._redial(host)
+
+    async def _forward_on_loop(
+        self, host: int, blob: bytes, kind: str
+    ) -> bytes:
+        """One forward with the lane's resilience budgeted against
+        ``cfg.deadline_s``: optional hedge race, then at most one
+        jittered-backoff retry for retryable kinds once the peer is
+        suspect. Runs on the lane loop."""
+        cfg = self.cfg
+        deadline = self._loop.time() + cfg.deadline_s
+        retryable = cfg.retry and kind in RETRYABLE_KINDS
+
+        async def one_attempt(fresh: bool = False) -> bytes:
+            remaining = deadline - self._loop.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"forward deadline exhausted for peer {host}"
+                )
+            return await self._attempt(host, blob, remaining, fresh=fresh)
+
+        try:
+            if cfg.hedge_ms > 0 and kind in RETRYABLE_KINDS:
+                raw = await self._hedged(host, one_attempt, deadline)
+            else:
+                raw = await one_attempt()
+        except Exception as exc:
+            self._note_failure(host, exc)
+            remaining = deadline - self._loop.time()
+            backoff = (cfg.retry_backoff_ms / 1e3) * (
+                0.5 + random.random()
+            )
+            if not (
+                retryable
+                and self.health.state(host) != PeerState.UP
+                and remaining > backoff
+            ):
+                raise
+            self.retries += 1
+            await asyncio.sleep(backoff)
+            try:
+                raw = await one_attempt(fresh=True)
+            except Exception as retry_exc:
+                self._note_failure(host, retry_exc)
+                raise
+        self.health.record_success(host)
+        return raw
+
+    async def _hedged(self, host: int, one_attempt, deadline) -> bytes:
+        """Race a second attempt on a fresh channel when the first
+        outlasts max(hedge floor, tracked peer p99) — the stall
+        signature of a wedged channel, not a slow decision."""
+        cfg = self.cfg
+        first = asyncio.ensure_future(one_attempt())
+        hedge_after = max(cfg.hedge_ms, self.peer_p99_ms()) / 1e3
+        done, _pending = await asyncio.wait({first}, timeout=hedge_after)
+        if first in done:
+            return first.result()
+        if deadline - self._loop.time() <= 0.001:
+            return await first  # no budget left to hedge with
+        second = asyncio.ensure_future(one_attempt(fresh=True))
+        pending = {first, second}
+        last_exc: Optional[BaseException] = None
+        while pending:
+            done, pending = await asyncio.wait(
+                pending, return_when=asyncio.FIRST_COMPLETED
+            )
+            for task in done:
+                exc = task.exception()
+                if exc is not None:
+                    last_exc = exc
+                    continue
+                for other in pending:
+                    other.cancel()
+                if task is second:
+                    self.hedges_won += 1
+                else:
+                    self.hedges_lost += 1
+                return task.result()
+        assert last_exc is not None
+        raise last_exc
+
+    async def _probe(self, host: int) -> None:
+        """Ping a non-up peer (lane loop). Success hands off to the
+        recovery thread so journal replay never blocks this loop."""
+        if host in self._probing:
+            return  # a slow probe is still in flight for this peer
+        self._probing.add(host)
+        self.probes += 1
+        blob = json.dumps({"kind": "ping", "from": self.host_id}).encode()
+        try:
+            # fresh=True every probe: the cached channel's gRPC connect
+            # backoff grows toward minutes on a long outage, and only a
+            # genuinely fresh dial notices the instant a peer restarts
+            # on the same address — recovery latency must be the probe
+            # interval, not the backoff curve.
+            await self._attempt(
+                host, blob, self.cfg.probe_timeout_s, fresh=True
+            )
+        except Exception as exc:
+            self._note_failure(host, exc)
+            return
+        finally:
+            self._probing.discard(host)
+        if host in self._recovering:
+            return
+        self._recovering.add(host)
+        threading.Thread(
+            target=self._run_recovery,
+            args=(host,),
+            name=f"pod-recover-{host}",
+            daemon=True,
+        ).start()
+
+    def _run_recovery(self, host: int) -> None:
+        """Recovery thread: let the frontend replay its journal to the
+        recovered owner, then mark the peer up. A failed replay leaves
+        the peer non-up so the next probe retries."""
+        try:
+            hook = self.on_peer_recovered
+            ok = True if hook is None else bool(hook(host))
+            if ok:
+                self.health.record_success(host)
+        except Exception as exc:
+            log.warning(
+                f"pod peer {host} recovery failed (stays degraded): {exc}"
+            )
+        finally:
+            self._recovering.discard(host)
+
+    def replay_deltas(
+        self, host: int, deltas: List[dict],
+        timeout: float = FORWARD_TIMEOUT_SECONDS,
+    ) -> int:
+        """Blocking journal replay to a recovered owner — recovery
+        thread only, NEVER the serving path. Raises on peer failure so
+        the caller's journal restore fires."""
+        blob = json.dumps({
+            "kind": "apply_deltas",
+            "deltas": deltas,
+            "from": self.host_id,
+        }).encode()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._attempt(host, blob, timeout), self._loop
+        )
+        raw = fut.result(timeout + 1.0)
+        return int(json.loads(raw.decode()).get("applied", 0))
 
     async def forward(
         self,
@@ -229,7 +823,7 @@ class PeerLane:
         }).encode()
         t0 = time.perf_counter()
         fut = asyncio.run_coroutine_threadsafe(
-            self._forward_on_loop(host, blob), self._loop
+            self._forward_on_loop(host, blob, kind), self._loop
         )
         try:
             raw = await asyncio.wrap_future(fut)
@@ -256,14 +850,77 @@ class PeerLane:
             "pod_peer_served": self.served,
             "pod_peer_errors": self.errors,
             "pod_peer_p99_ms": round(self.peer_p99_ms(), 3),
+            "peer_health_state": self.health.states(),
+            "peer_health_retries": self.retries,
+            "peer_health_hedges_won": self.hedges_won,
+            "peer_health_hedges_lost": self.hedges_lost,
+            "peer_health_redials": self.redials,
+            "peer_health_probes": self.probes,
         }
+
+
+class _OwnerGuard:
+    """Per-owner failover state: the admission plane's breaker core
+    gating a local exact stand-in (FailoverStore) whose journal replays
+    to the owner on recovery. The breaker's stall watch is disarmed —
+    peer failures arrive as recorded exceptions, not stalled batches."""
+
+    def __init__(self, owner: int, cfg: PodResilience):
+        self.owner = owner
+        self.breaker = CircuitBreaker(
+            failure_threshold=cfg.breaker_failures,
+            stall_timeout=1e9,
+            reset_timeout=cfg.breaker_reset_s,
+            warmup_stall_timeout=1e9,
+        )
+        self.store = FailoverStore(cache_size=cfg.journal_cache)
+        self.degraded_decisions = 0
+        self.reconciles = 0
+        self.replayed_deltas = 0
+        self.reconcile_seconds = 0.0
+
+
+class _PeerDeltaSink:
+    """apply_deltas adapter over the peer lane, so FailoverStore's
+    reconcile_into (journal restore on failure, oracle clear on
+    success) replays to a REMOTE owner exactly as the admission plane
+    replays to the local device table.
+
+    Chunked: a long partition can journal far more counters than one
+    gRPC message survives (the lane server runs the default 4MB
+    receive cap), so the replay ships bounded batches. A failure mid-
+    replay restores the WHOLE journal (reconcile_into's contract) and
+    already-applied chunks re-apply on the next recovery — re-applying
+    a delta over-counts, which for a limiter can only under-admit, the
+    conservative direction."""
+
+    CHUNK = 1000
+
+    def __init__(self, lane: PeerLane, owner: int):
+        self._lane = lane
+        self._owner = owner
+
+    def apply_deltas(self, items) -> None:
+        deltas = [
+            _counter_to_wire(counter, delta) for counter, delta in items
+        ]
+        for start in range(0, len(deltas), self.CHUNK):
+            self._lane.replay_deltas(
+                self._owner, deltas[start:start + self.CHUNK]
+            )
 
 
 class PodFrontend:
     """Shard-aware routed frontend over a limiter: decide locally when
     this host owns every counter the request touches, else one
     peer-lane hop to the owner. Used by RlsService/http_api exactly
-    like the limiter it wraps (attribute delegation)."""
+    like the limiter it wraps (attribute delegation).
+
+    With ``resilience.degraded`` on, a failed forward is never the
+    request's failure: the owner's traffic fails over to a per-owner
+    exact stand-in behind a circuit breaker, every admitted delta is
+    journaled, and the lane's background probe replays the journal to
+    the owner once it answers again (module docstring)."""
 
     #: RlsService awaits check/update calls when this is set even
     #: though we are not an AsyncRateLimiter instance
@@ -275,13 +932,27 @@ class PodFrontend:
         router: PodRouter,
         lane: PeerLane,
         global_namespaces=(),
+        resilience: Optional[PodResilience] = None,
     ):
         self._limiter = limiter
         self.router = router
         self.lane = lane
         self._global_ns = {str(ns) for ns in global_namespaces}
         self._inner_async = isinstance(limiter, AsyncRateLimiter)
+        self._resilience = resilience or lane.cfg
+        self._guards: Dict[int, _OwnerGuard] = {}
+        if self._resilience.degraded:
+            self._guards = {
+                owner: _OwnerGuard(owner, self._resilience)
+                for owner in lane.peers
+            }
+            lane.on_peer_recovered = self._peer_recovered
+            lane.probe_needed = self._needs_recovery
         lane.decide_cb = self._decide_for_peer
+        # The owner side of a journal replay is unconditional: a
+        # recovered host must accept its peers' journals even when its
+        # own degraded mode is off.
+        lane.apply_cb = self._apply_from_peer
 
     def __getattr__(self, name):
         return getattr(self._limiter, name)
@@ -297,17 +968,22 @@ class PodFrontend:
 
     # -- routing helpers -----------------------------------------------------
 
-    def _plan(self, namespace, ctx) -> Tuple[str, int]:
+    def _route(self, namespace, ctx) -> Tuple[str, int, List[Counter]]:
         # Known cost: the wrapped limiter re-runs this same matching on
         # the LOCAL path (no limiter entry point accepts precomputed
-        # counters yet — ROADMAP direction 1 follow-on d).
-        keys = [
-            counter_key(c)
-            for c in _counters_that_apply(
-                self._limiter.storage, Namespace.of(namespace), ctx
-            )
-        ]
-        return self.router.plan(str(namespace), keys)
+        # counters yet — ROADMAP direction 1 follow-on d). The counters
+        # ride along for the degraded stand-in, which decides on
+        # exactly the counter set the owner would have.
+        counters = _counters_that_apply(
+            self._limiter.storage, Namespace.of(namespace), ctx
+        )
+        keys = [counter_key(c) for c in counters]
+        verdict, owner = self.router.plan(str(namespace), keys)
+        return verdict, owner, counters
+
+    def _plan(self, namespace, ctx) -> Tuple[str, int]:
+        verdict, owner, _counters = self._route(namespace, ctx)
+        return verdict, owner
 
     async def _local_check(self, namespace, ctx, delta, load) -> CheckResult:
         if self._inner_async:
@@ -342,6 +1018,19 @@ class PodFrontend:
             return None
         return await self._local_check(namespace, ctx, delta, load)
 
+    def _apply_from_peer(self, deltas: List[dict]) -> int:
+        """Owner-side journal replay: a peer that failed over while we
+        were down hands us the deltas it admitted on our behalf; they
+        land through the storage's apply_deltas contract (the same lane
+        the write-behind authority role uses)."""
+        items = [_counter_from_wire(blob) for blob in deltas]
+        if not items:
+            return 0
+        storage = self._limiter.storage
+        storage = getattr(storage, "counters", storage)
+        storage.apply_deltas(items)
+        return len(items)
+
     @staticmethod
     def _adopt(resp: dict) -> CheckResult:
         """A forwarded decision's CheckResult, with owner-loaded counter
@@ -356,64 +1045,189 @@ class PodFrontend:
             bool(resp.get("limited", False)), counters, resp.get("name")
         )
 
-    async def _forward(
-        self, owner, namespace, ctx, delta, load, kind
-    ) -> dict:
+    # -- degraded-owner failover ---------------------------------------------
+
+    def _degraded_decide(
+        self, guard: _OwnerGuard, counters: List[Counter],
+        delta: int, load: bool, kind: str,
+    ) -> Optional[CheckResult]:
+        """Decide against the owner's local stand-in (exact oracle +
+        delta journal). Mirrors RateLimiter's storage-to-CheckResult
+        shape so serving planes can't tell a degraded answer apart."""
+        guard.degraded_decisions += 1
+        if kind == "is_rate_limited":
+            for counter in counters:
+                if not guard.store.is_within_limits(counter, delta):
+                    return CheckResult(True, [], counter.limit.name)
+            return CheckResult(False, [], None)
+        if kind == "update_counters":
+            for counter in counters:
+                guard.store.update_counter(counter, delta)
+            return None
+        if not counters:
+            return CheckResult(False, [], None)
+        auth = guard.store.check_and_update(counters, delta, load)
+        loaded = counters if load else []
+        if auth.limited:
+            return CheckResult(True, loaded, auth.limit_name)
+        return CheckResult(False, loaded, None)
+
+    def _needs_recovery(self, owner: int) -> bool:
+        """Probe-loop gate beyond peer health: a sub-threshold failure
+        journals a delta while the peer stays (or comes back) UP, and a
+        breaker can open without downing the peer — either way probes
+        must keep firing until the journal drains and the breaker
+        closes."""
+        guard = self._guards.get(owner)
+        if guard is None:
+            return False
+        return (
+            guard.breaker.state != BreakerState.CLOSED
+            or guard.store.journal_size() > 0
+        )
+
+    def _peer_recovered(self, owner: int) -> bool:
+        """Recovery-thread hook: replay the owner's journal through the
+        lane into its apply_deltas, drain the stand-in, close the
+        breaker. Degraded decisions racing the replay land in a fresh
+        journal, so the post-close drain below empties it — zero
+        admitted deltas are lost across the partition window."""
+        guard = self._guards.get(owner)
+        if guard is None:
+            return True
+        sink = _PeerDeltaSink(self.lane, owner)
+        t0 = time.perf_counter()
+        try:
+            replayed = guard.store.reconcile_into(sink)
+            # Requests that went degraded between the drain above and
+            # the breaker closing journal into a fresh journal; bounded
+            # re-drains chase the tail down to empty.
+            for _ in range(4):
+                if guard.store.journal_size() == 0:
+                    break
+                replayed += guard.store.reconcile_into(sink)
+        except Exception as exc:
+            guard.reconcile_seconds += time.perf_counter() - t0
+            log.warning(
+                f"pod host {owner}: journal replay failed, staying "
+                f"degraded: {exc}"
+            )
+            return False
+        guard.breaker.probe_succeeded()
+        if guard.store.journal_size():
+            try:
+                replayed += guard.store.reconcile_into(sink)
+            except Exception:
+                pass  # residue replays on the next recovery
+        guard.reconcile_seconds += time.perf_counter() - t0
+        guard.reconciles += 1
+        guard.replayed_deltas += replayed
+        log.info(
+            f"pod host {owner} recovered: replayed {replayed} journaled "
+            "deltas, routing restored"
+        )
+        return True
+
+    async def _remote(
+        self, owner, namespace, ctx, counters, delta, load, kind
+    ) -> Optional[CheckResult]:
         """One peer hop, with failures mapped to StorageError: the
         serving planes (rls.py aborts UNAVAILABLE, http_api answers
         500) already give StorageError the unavailable semantics a
         dead owner host deserves — a raw AioRpcError would surface as
-        an unhandled UNKNOWN instead."""
+        an unhandled UNKNOWN instead. With degraded mode on, the
+        failure instead feeds the owner's breaker and the decision
+        fails over to the local stand-in — the request never sees the
+        dead peer at all."""
+        guard = self._guards.get(owner)
+        if guard is not None and guard.breaker.is_open():
+            return self._degraded_decide(guard, counters, delta, load, kind)
         try:
-            return await self.lane.forward(
+            resp = await self.lane.forward(
                 owner, namespace, ctx, delta, load, kind=kind
             )
         except Exception as exc:
-            raise StorageError(
-                f"pod peer host {owner} unavailable: {exc}"
-            ) from exc
+            err = StorageError(f"pod peer host {owner} unavailable: {exc}")
+            if guard is not None:
+                guard.breaker.record_failure(err)
+                return self._degraded_decide(
+                    guard, counters, delta, load, kind
+                )
+            raise err from exc
+        if guard is not None:
+            # A successful forward resets the consecutive-failure count
+            # (the batchers do this per device batch on the admission
+            # plane); without it, transient failures spread over hours
+            # would accumulate to a trip.
+            guard.breaker.record_success()
+        if kind == "update_counters":
+            return None
+        return self._adopt(resp)
 
     # -- the limiter surface -------------------------------------------------
 
     async def check_rate_limited_and_update(
         self, namespace, ctx, delta: int, load_counters: bool = False
     ) -> CheckResult:
-        verdict, owner = self._plan(namespace, ctx)
+        verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
             return await self._local_check(
                 namespace, ctx, delta, load_counters
             )
-        resp = await self._forward(
-            owner, namespace, ctx, delta, load_counters,
-            kind="check_and_update",
+        return await self._remote(
+            owner, namespace, ctx, counters, delta, load_counters,
+            "check_and_update",
         )
-        return self._adopt(resp)
 
     async def is_rate_limited(self, namespace, ctx, delta: int) -> CheckResult:
-        verdict, owner = self._plan(namespace, ctx)
+        verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
             return await self._local_is_limited(namespace, ctx, delta)
-        resp = await self._forward(
-            owner, namespace, ctx, delta, False, kind="is_rate_limited"
+        return await self._remote(
+            owner, namespace, ctx, counters, delta, False,
+            "is_rate_limited",
         )
-        return self._adopt(resp)
 
     async def update_counters(self, namespace, ctx, delta: int) -> None:
-        verdict, owner = self._plan(namespace, ctx)
+        verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
             await self._local_update(namespace, ctx, delta)
             return
-        await self._forward(
-            owner, namespace, ctx, delta, False, kind="update_counters"
+        await self._remote(
+            owner, namespace, ctx, counters, delta, False,
+            "update_counters",
         )
 
     # -- telemetry -----------------------------------------------------------
+
+    def resilience_stats(self) -> dict:
+        degraded = journal = reconciles = replayed = open_count = 0
+        reconcile_s = failover_s = 0.0
+        for guard in self._guards.values():
+            degraded += guard.degraded_decisions
+            journal += guard.store.journal_size()
+            reconciles += guard.reconciles
+            replayed += guard.replayed_deltas
+            reconcile_s += guard.reconcile_seconds
+            failover_s += guard.breaker.open_seconds_total()
+            if guard.breaker.state != BreakerState.CLOSED:
+                open_count += 1
+        return {
+            "pod_failover_degraded_decisions": degraded,
+            "pod_failover_journal_depth": journal,
+            "pod_failover_breaker_open": open_count,
+            "pod_failover_reconciles": reconciles,
+            "pod_failover_replayed_deltas": replayed,
+            "pod_failover_reconcile_seconds": round(reconcile_s, 6),
+            "pod_failover_seconds": round(failover_s, 6),
+        }
 
     def library_stats(self) -> dict:
         inner = getattr(self._limiter, "library_stats", None)
         stats = dict(inner()) if callable(inner) else {}
         stats.update(self.router.stats())
         stats.update(self.lane.stats())
+        stats.update(self.resilience_stats())
         return stats
 
     def close_pod(self) -> None:

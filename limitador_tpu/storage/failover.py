@@ -1,12 +1,16 @@
-"""Host-plane failover store for the admission plane's circuit breaker.
+"""Host-plane failover store: the exact stand-in behind a breaker.
 
-While the device-plane breaker is OPEN, the check path decides against
-this store instead of the TPU table: an exact ``InMemoryStorage``
-oracle (the parity reference every backend is tested against) plus a
-delta journal. On recovery, ``reconcile_into`` replays the journaled
-deltas into the device table through the ``apply_deltas`` contract the
-write-behind topology already uses — zero deltas are lost across a
-failover window.
+Two planes fail over onto this store. While the ADMISSION plane's
+device breaker is OPEN, the check path decides against it instead of
+the TPU table; while a POD peer's breaker is open (server/peering.py,
+ISSUE 11), the peer's ingress hosts decide that owner's forwarded
+traffic against one instance per down owner. Either way it is an exact
+``InMemoryStorage`` oracle (the parity reference every backend is
+tested against) plus a delta journal. On recovery, ``reconcile_into``
+replays the journaled deltas through the ``apply_deltas`` contract the
+write-behind topology already uses — into the device table (admission)
+or over the peer lane into the recovered owner's storage (pod) — so
+zero deltas are lost across a failover window.
 
 Documented accuracy contract (mirrors the reference's partitioned
 write-behind behavior, counters_cache.rs): the oracle starts EMPTY at

@@ -48,14 +48,18 @@ __all__ = [
     "lock_order_findings",
 ]
 
-#: the named lock domains the acquisition graph is built over
+#: the named lock domains the acquisition graph is built over.
+#: ``peering`` (ISSUE 11) is the pod resilience plane's peer-health
+#: lock: it sits on the forwarded-decision path, so it must stay a
+#: leaf-ish outermost hold — no sync waits and no storage-plane
+#: acquisitions under it.
 TRACKED_DOMAINS = (
-    "broker", "native", "storage", "plan_cache", "observatory",
+    "peering", "broker", "native", "storage", "plan_cache", "observatory",
 )
 
 #: the documented canonical acquisition order (outermost first); the
 #: graph may use any PREFIX-compatible subset, never the reverse
-CANONICAL_ORDER = ("broker", "native", "storage", "plan_cache")
+CANONICAL_ORDER = ("peering", "broker", "native", "storage", "plan_cache")
 
 #: attribute name -> domain, regardless of receiver (``_native_lock``
 #: is unique to the native pipeline)
@@ -71,6 +75,7 @@ MODULE_SELF_DOMAINS = {
     ("limitador_tpu/lease/broker.py", "_lock"): "broker",
     ("limitador_tpu/observability/usage.py", "_lock"): "observatory",
     ("limitador_tpu/tpu/plan_cache.py", "_lock"): "plan_cache",
+    ("limitador_tpu/server/peering.py", "_health_lock"): "peering",
 }
 
 #: receiver NAME -> domain for cross-object acquisitions

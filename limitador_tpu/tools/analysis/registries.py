@@ -28,6 +28,7 @@ __all__ = [
 REGISTRY_OWNED_PREFIXES = {
     "admission_": "limitador_tpu/admission/__init__.py",
     "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
+    "peer_health_": "limitador_tpu/server/peering.py",
     "pod_": "limitador_tpu/routing.py",
     "sharded_": "limitador_tpu/tpu/sharded.py",
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",

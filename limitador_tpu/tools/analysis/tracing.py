@@ -59,9 +59,13 @@ HOT_MODULES = (
 )
 
 #: function-name prefixes that mark the decision path (begin/submit
-#: side — the finish side owns the device sync by definition)
+#: side — the finish side owns the device sync by definition).
+#: ``forward``/``_forward``/``_remote``/``_degraded`` joined with the
+#: pod resilience plane (ISSUE 11): a forwarded or failed-over
+#: decision's whole latency budget runs through them.
 DECISION_PREFIXES = (
     "decide", "submit", "begin_", "_begin", "pad_hits",
+    "forward", "_forward", "_remote", "_degraded",
 )
 
 #: modules allowed to call ops/kernel.py functions: they own the pow2

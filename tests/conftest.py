@@ -62,6 +62,12 @@ SERVER_ENV_VARS = frozenset({
     # jax.distributed.initialize and hang waiting for a coordinator
     "TPU_POD_COORDINATOR", "TPU_POD_PROCESSES", "TPU_POD_PROCESS_ID",
     "TPU_POD_PEERS", "TPU_POD_PEER_LISTEN",
+    # pod resilience plane (ISSUE 11): ambient fault injection or
+    # breaker/hedge tuning would silently reshape any pod-spawning test
+    "TPU_POD_DEGRADED_MODE", "TPU_POD_HEDGE_MS",
+    "TPU_POD_PEER_BREAKER_FAILURES", "TPU_POD_PEER_BREAKER_RESET_MS",
+    "TPU_POD_PROBE_MS", "TPU_POD_FAULTS", "TPU_POD_FAULT_SEED",
+    "TPU_POD_FAULT_DELAY_MS",
 })
 
 

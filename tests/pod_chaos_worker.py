@@ -1,0 +1,102 @@
+"""One owner host of the pod chaos drill (NOT a pytest module).
+
+Spawned by tests/test_pod_chaos.py (and `make pod-chaos`) as the
+killable half of a miniature 2-host pod: host 1 of a
+``PodTopology(hosts=2)`` serving its ``PeerLane`` over an
+``InMemoryStorage``-backed ``PodFrontend``. The drill's host 0 lives in
+the TEST process; this worker only ever answers forwarded decisions
+(and, after a restart, the journal replay the degraded window
+accumulated against it).
+
+    python tests/pod_chaos_worker.py --listen 127.0.0.1:PORT \
+        --ready READY --stop STOP --out OUT.json
+
+Protocol with the parent test:
+
+* the worker touches ``READY`` once its lane is serving (limits loaded
+  FIRST — a restarted host must never answer against an empty limits
+  set);
+* the parent SIGKILLs it mid-soak (no dump — that IS the drill), or
+* the parent touches ``STOP`` for a graceful shutdown: the worker dumps
+  its final counter state to ``OUT.json`` and exits 0 — the parity
+  evidence the drill compares against the single-process oracle.
+
+No jax anywhere: the chaos drill exercises the pod resilience plane
+(health, breaker, failover journal, reconcile), which is pure host
+code by design.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the drill's shared limit set — host 0, this worker and the oracle
+#: must agree byte-for-byte
+CHAOS_NAMESPACE = "chaos"
+CHAOS_MAX = 4
+CHAOS_WINDOW_S = 120
+
+
+def chaos_limits():
+    from limitador_tpu import Limit
+
+    return [
+        Limit(
+            CHAOS_NAMESPACE, CHAOS_MAX, CHAOS_WINDOW_S, [], ["u"],
+            name="per_u",
+        )
+    ]
+
+
+def counter_dump(limiter) -> list:
+    out = []
+    for c in limiter.get_counters(CHAOS_NAMESPACE):
+        out.append({
+            "u": c.set_variables.get("u"),
+            "remaining": c.remaining,
+        })
+    out.sort(key=lambda r: r["u"] or "")
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--listen", required=True)
+    parser.add_argument("--ready", required=True)
+    parser.add_argument("--stop", required=True)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    limiter = RateLimiter(InMemoryStorage(4096))
+    topology = PodTopology(hosts=2, host_id=1, shards_per_host=1)
+    lane = PeerLane(1, args.listen, {}, None)
+    frontend = PodFrontend(limiter, PodRouter(topology), lane)
+    asyncio.run(frontend.configure_with(chaos_limits()))
+    lane.start()
+    with open(args.ready, "w") as f:
+        f.write(str(lane.port))
+    try:
+        while not os.path.exists(args.stop):
+            time.sleep(0.05)
+        with open(args.out, "w") as f:
+            json.dump({
+                "counters": counter_dump(frontend),
+                "lane": lane.stats(),
+            }, f)
+    finally:
+        lane.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -141,6 +141,21 @@ def test_dashboard_covers_pod_routing_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_pod_resilience_families():
+    """ISSUE 11: the pod resilience plane ships WITH its Grafana row —
+    a "Pod resilience" row exists and every peer_health_* /
+    pod_failover_* family is referenced by at least one panel
+    expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("pod resilience" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.server.peering import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

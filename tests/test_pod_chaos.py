@@ -1,0 +1,759 @@
+"""Pod resilience plane (ISSUE 11).
+
+Fast tier: the fault-injection shim's verdicts and deterministic
+seeding, the peer health state machine, retry/hedge on the lane, the
+restart-same-address re-dial regression, and an in-process
+degraded-owner failover round trip (breaker trip -> local stand-in ->
+journal replay into the recovered owner) over real gRPC hops.
+
+Slow tier (`make pod-chaos`): the chaos drill — a real subprocess owner
+host (tests/pod_chaos_worker.py) is SIGKILLed mid-soak; forwarded
+traffic for its keys keeps answering through the degraded window (zero
+unavailable answers), the worker restarts on the SAME address with an
+empty store, the journal replays, and the final owner-side counter
+state matches a single-process oracle exactly for keys born inside the
+partition window — with the pre-partition keys bounded by the
+documented one-extra-window over-admission (docs/serving-model.md).
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu.routing import FORWARD, PodRouter, PodTopology
+from limitador_tpu.server.peering import (
+    METRIC_FAMILIES,
+    FaultInjector,
+    PeerHealth,
+    PeerState,
+    PodResilience,
+    _counter_from_wire,
+    _counter_to_wire,
+)
+
+REPO_ROOT = Path(__file__).parent.parent
+WORKER = Path(__file__).parent / "pod_chaos_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- the fault-injection shim (pure python, tier-1) ----------------------------
+
+
+def test_fault_injector_verdict_modes_and_times_budget():
+    injector = FaultInjector()
+    injector.set_fault(1, "drop")
+    assert injector.verdict(1) == "drop"
+    assert injector.verdict(0) is None  # only peer 1 is faulted
+    injector.set_fault(1, "error", times=2)
+    assert [injector.verdict(1) for _ in range(4)] == [
+        "error", "error", None, None,
+    ]
+    injector.clear(1)
+    assert injector.verdict(1) is None
+    with pytest.raises(ValueError, match="unknown fault mode"):
+        injector.set_fault(1, "explode")
+
+
+def test_fault_injector_seeding_is_deterministic():
+    def draws(seed):
+        injector = FaultInjector(seed=seed)
+        injector.set_fault(1, "delay", p=0.5)
+        return [injector.verdict(1) for _ in range(64)]
+
+    assert draws(7) == draws(7)  # same seed -> byte-identical drill
+    assert draws(7) != draws(8)
+    # probabilistic rules really fire partially, not all-or-nothing
+    hits = [v for v in draws(7) if v is not None]
+    assert 0 < len(hits) < 64
+
+
+def test_fault_injector_env_spec_parsing():
+    env = {
+        "TPU_POD_FAULTS": "1:drop, 0:delay:0.25:3",
+        "TPU_POD_FAULT_SEED": "42",
+        "TPU_POD_FAULT_DELAY_MS": "5",
+    }
+    injector = FaultInjector.from_env(env)
+    assert injector.delay_ms == 5.0
+    assert injector.verdict(1) == "drop"
+    assert injector._rules[0][:2] == ["delay", 0.25]
+    with pytest.raises(ValueError, match="TPU_POD_FAULTS"):
+        FaultInjector.from_env({"TPU_POD_FAULTS": "nonsense"})
+    # empty env -> transparent shim
+    assert FaultInjector.from_env({}).verdict(1) is None
+
+
+def test_fault_injector_apply_failure_modes():
+    injector = FaultInjector(delay_ms=10.0)
+
+    async def attempt(mode, timeout=0.05):
+        injector.set_fault(1, mode, times=1)
+        t0 = time.perf_counter()
+        await injector.apply(1, timeout)
+        return time.perf_counter() - t0
+
+    with pytest.raises(ConnectionError, match="injected drop"):
+        asyncio.run(attempt("drop"))
+    with pytest.raises(RuntimeError, match="injected error"):
+        asyncio.run(attempt("error"))
+    with pytest.raises(TimeoutError, match="injected blackhole"):
+        asyncio.run(attempt("blackhole"))
+    elapsed = asyncio.run(attempt("delay"))
+    assert elapsed >= 0.01  # delayed, then proceeds
+
+
+# -- the peer health state machine (tier-1) ------------------------------------
+
+
+def test_peer_health_up_suspect_down_and_recovery():
+    health = PeerHealth([1, 2], suspect_after=1, down_after=3)
+    assert health.state(1) == PeerState.UP
+    assert health.record_failure(1) == PeerState.SUSPECT
+    assert health.record_failure(1) is None  # 2 failures: still suspect
+    assert health.record_failure(1, deadline_miss=True) == PeerState.DOWN
+    assert health.state(1) == PeerState.DOWN
+    assert health.state(2) == PeerState.UP  # isolated per peer
+    assert health.deadline_misses == 1
+    assert health.record_success(1) == PeerState.UP
+    assert health.record_success(1) is None  # already up: no transition
+    assert health.transitions == 3
+    assert health.states() == {1: 0, 2: 0}
+    # unknown peers never enter the map
+    assert health.record_failure(9) is None
+    assert 9 not in health.states()
+
+
+def test_pod_resilience_legacy_is_the_pr10_posture():
+    cfg = PodResilience.legacy()
+    assert not cfg.degraded and not cfg.retry and cfg.hedge_ms == 0.0
+    on = PodResilience()
+    assert on.degraded and on.retry
+
+
+def test_counter_wire_roundtrip_preserves_identity():
+    from limitador_tpu import Context, Limit
+    from limitador_tpu.core.counter import Counter
+
+    limit = Limit("chaos", 4, 120, [], ["u"], name="per_u")
+    counter = Counter.new(limit, Context({"u": "alice"}))
+    rebuilt, delta = _counter_from_wire(_counter_to_wire(counter, 3))
+    assert delta == 3
+    assert rebuilt == counter  # identity: limit key + set variables
+    assert hash(rebuilt) == hash(counter)
+    # policy is identity-bearing: a token-bucket journal delta must not
+    # replay onto a phantom fixed-window counter
+    bucket = Limit(
+        "chaos", 4, 120, [], ["u"], name="bucket", policy="token_bucket"
+    )
+    bucket_counter = Counter.new(bucket, Context({"u": "alice"}))
+    rebuilt, _ = _counter_from_wire(_counter_to_wire(bucket_counter, 1))
+    assert rebuilt == bucket_counter
+    assert rebuilt.limit.policy == "token_bucket"
+    assert rebuilt != counter
+
+
+def test_server_resilience_flags_parse():
+    from limitador_tpu.server.__main__ import build_parser
+
+    args = build_parser().parse_args([
+        "limits.yaml", "sharded",
+        "--pod-degraded-mode", "off",
+        "--pod-hedge-ms", "3.5",
+        "--pod-peer-breaker-failures", "5",
+        "--pod-peer-breaker-reset-ms", "750",
+    ])
+    assert args.pod_degraded_mode == "off"
+    assert args.pod_hedge_ms == 3.5
+    assert args.pod_peer_breaker_failures == 5
+    assert args.pod_peer_breaker_reset_ms == 750.0
+    # resilience defaults: degraded on, hedging off
+    default = build_parser().parse_args(["limits.yaml", "memory"])
+    assert default.pod_degraded_mode == "on"
+    assert default.pod_hedge_ms == 0.0
+
+
+def test_resilience_metric_families_render():
+    """Every peer_health_*/pod_failover_* family declared, polled off
+    library_stats (labeled state dict + float-second counters
+    included), and visible in the exposition."""
+    from limitador_tpu.observability import PrometheusMetrics
+
+    class Source:
+        def library_stats(self):
+            return {
+                "peer_health_state": {1: 2, 3: 0},
+                "peer_health_retries": 4,
+                "peer_health_hedges_won": 1,
+                "peer_health_hedges_lost": 2,
+                "peer_health_redials": 3,
+                "peer_health_probes": 9,
+                "pod_failover_degraded_decisions": 7,
+                "pod_failover_journal_depth": 5,
+                "pod_failover_breaker_open": 1,
+                "pod_failover_reconciles": 2,
+                "pod_failover_replayed_deltas": 11,
+                "pod_failover_reconcile_seconds": 0.25,
+                "pod_failover_seconds": 1.5,
+            }
+
+    metrics = PrometheusMetrics()
+    metrics.attach_library_source(Source())
+    text = metrics.render().decode()
+    for family in METRIC_FAMILIES:
+        assert family in text, f"{family} missing from exposition"
+    assert 'peer_health_state{peer="1"} 2.0' in text
+    assert "pod_failover_journal_depth 5.0" in text
+    assert "pod_failover_seconds_total 1.5" in text
+    assert "pod_failover_degraded_decisions_total 7.0" in text
+    # second render: cumulative counters must not double-count
+    text = metrics.render().decode()
+    assert "pod_failover_seconds_total 1.5" in text
+
+
+# -- in-process resilience over real gRPC hops ---------------------------------
+
+
+def _lane_pair(resilience=None, limits=None):
+    """Host 0 (resilient, in-test) + host 1 (plain owner): a miniature
+    2-host pod over InMemoryStorage, host 0 carrying the resilience
+    config under test."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    limits = limits or [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+    ports = [_free_port(), _free_port()]
+    lanes, frontends = [], []
+    for host in range(2):
+        lane = PeerLane(
+            host,
+            f"127.0.0.1:{ports[host]}",
+            {1 - host: f"127.0.0.1:{ports[1 - host]}"},
+            None,
+            resilience=resilience if host == 0 else None,
+        )
+        lane.start()
+        lanes.append(lane)
+        frontends.append(PodFrontend(
+            RateLimiter(InMemoryStorage(1024)),
+            PodRouter(PodTopology(hosts=2, host_id=host, shards_per_host=1)),
+            lane,
+            resilience=resilience if host == 0 else None,
+        ))
+    for f in frontends:
+        asyncio.run(f.configure_with(limits))
+    return frontends, lanes, ports
+
+
+def _forwarded_user(frontend, owner=1, ns="fwd"):
+    from limitador_tpu import Context
+
+    for i in range(200):
+        ctx = Context({"u": f"user-{i}"})
+        if frontend._plan(ns, ctx) == (FORWARD, owner):
+            return f"user-{i}"
+    raise AssertionError("no forwarded key found")
+
+
+def _check(frontend, user, ns="fwd", delta=1):
+    from limitador_tpu import Context
+
+    return asyncio.run(frontend.check_rate_limited_and_update(
+        ns, Context({"u": user}), delta, False
+    ))
+
+
+def test_redial_after_peer_restart_on_same_address():
+    """Satellite regression (the PR 10 bug): a peer that restarts on
+    the SAME address must get a fresh dial — the lane drops the cached
+    channel on the health trip instead of failing on its stale backoff
+    state until process restart."""
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.base import StorageError
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    frontends, lanes, ports = _lane_pair()
+    restarted = []
+    try:
+        user = _forwarded_user(frontends[0])
+        assert not _check(frontends[0], user).limited  # warm the channel
+        lanes[1].stop()  # the owner dies
+        with pytest.raises(StorageError, match="pod peer host 1"):
+            _check(frontends[0], user)
+        assert lanes[0].stats()["peer_health_redials"] >= 1
+        # the owner restarts on the SAME port (fresh process state)
+        lane1b = PeerLane(1, f"127.0.0.1:{ports[1]}", {}, None)
+        lane1b.start()
+        restarted.append(lane1b)
+        frontend1b = PodFrontend(
+            RateLimiter(InMemoryStorage(1024)),
+            PodRouter(PodTopology(hosts=2, host_id=1, shards_per_host=1)),
+            lane1b,
+        )
+        asyncio.run(frontend1b.configure_with(
+            [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+        ))
+        # the very next forward succeeds on a fresh channel
+        result = _check(frontends[0], user)
+        assert result.limited is False
+        assert lanes[0].health.state(1) == PeerState.UP
+    finally:
+        for lane in lanes[:1] + restarted:
+            lane.stop()
+
+
+def test_retry_recovers_a_transient_peer_error():
+    """One jittered-backoff retry while the peer is suspect: an
+    injected one-shot error never surfaces to the caller."""
+    cfg = PodResilience(degraded=False, retry=True, retry_backoff_ms=1.0)
+    frontends, lanes, _ports = _lane_pair(resilience=cfg)
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[0].faults.set_fault(1, "error", times=1)
+        result = _check(frontends[0], user)
+        assert result.limited is False
+        stats = lanes[0].stats()
+        assert stats["peer_health_retries"] == 1
+        assert stats["pod_peer_errors"] == 0
+        assert lanes[0].health.state(1) == PeerState.UP  # success reset
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_hedged_forward_wins_when_the_first_attempt_stalls():
+    """--pod-hedge-ms: a stalled in-flight forward is raced by a second
+    attempt on a fresh channel; the hedge wins well inside the stall."""
+    cfg = PodResilience(degraded=False, retry=False, hedge_ms=30.0)
+    frontends, lanes, _ports = _lane_pair(resilience=cfg)
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[0].faults.delay_ms = 400.0
+        lanes[0].faults.set_fault(1, "delay", times=1)
+        t0 = time.perf_counter()
+        result = _check(frontends[0], user)
+        elapsed = time.perf_counter() - t0
+        assert result.limited is False
+        assert lanes[0].stats()["peer_health_hedges_won"] == 1
+        assert elapsed < 0.35, "hedge should beat the 400ms stall"
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_degraded_mode_off_keeps_pr10_failure_semantics():
+    """--pod-degraded-mode off: a dead owner still hard-fails the
+    forwarded request with StorageError (UNAVAILABLE/500 upstream) —
+    byte-identical to the PR 10 posture."""
+    from limitador_tpu.storage.base import StorageError
+
+    frontends, lanes, _ports = _lane_pair(resilience=PodResilience.legacy())
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[1].stop()
+        with pytest.raises(StorageError, match="pod peer host 1"):
+            _check(frontends[0], user)
+        assert frontends[0].resilience_stats()[
+            "pod_failover_degraded_decisions"
+        ] == 0
+    finally:
+        lanes[0].stop()
+
+
+def test_degraded_failover_journal_and_recovery_replay():
+    """The tentpole round trip, in-process: owner dies -> breaker trips
+    -> the owner's traffic is served by the local exact stand-in (zero
+    failed answers) and journaled -> owner restarts on the same address
+    -> the background probe replays the journal through apply_deltas ->
+    routing flips back and the owner's counters carry every degraded
+    admission."""
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.05, retry_backoff_ms=1.0,
+    )
+    frontends, lanes, ports = _lane_pair(resilience=cfg)
+    restarted = []
+    try:
+        user = _forwarded_user(frontends[0])
+        # two owner-side admissions before the partition
+        for _ in range(2):
+            assert not _check(frontends[0], user).limited
+        lanes[1].stop()  # SIGKILL-equivalent for the in-process tier
+
+        # the degraded window: every answer arrives, none are errors
+        degraded_answers = [_check(frontends[0], user) for _ in range(4)]
+        # the stand-in starts EMPTY (the owner's live counts are
+        # unreachable): it admits a fresh window budget of 3, limits the
+        # 4th — the documented one-extra-window over-admission bound
+        assert [r.limited for r in degraded_answers] == [
+            False, False, False, True,
+        ]
+        stats = frontends[0].resilience_stats()
+        assert stats["pod_failover_degraded_decisions"] == 4
+        assert stats["pod_failover_journal_depth"] == 1  # one counter
+        assert stats["pod_failover_breaker_open"] == 1
+
+        # the owner restarts on the SAME address, state intact
+        lane1b = PeerLane(1, f"127.0.0.1:{ports[1]}", {}, None)
+        lane1b.start()
+        restarted.append(lane1b)
+        PodFrontend(
+            frontends[1]._limiter,  # the owner's surviving storage
+            PodRouter(PodTopology(hosts=2, host_id=1, shards_per_host=1)),
+            lane1b,
+        )
+
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            stats = frontends[0].resilience_stats()
+            if (
+                stats["pod_failover_journal_depth"] == 0
+                and stats["pod_failover_reconciles"] >= 1
+            ):
+                break
+            time.sleep(0.05)
+        assert stats["pod_failover_reconciles"] >= 1, stats
+        assert stats["pod_failover_journal_depth"] == 0
+        # one journal entry: the counter, carrying its accumulated +3
+        assert stats["pod_failover_replayed_deltas"] == 1
+        assert stats["pod_failover_seconds"] > 0
+        assert lanes[0].health.state(1) == PeerState.UP
+
+        # routing flipped back AND the owner saw the journal: its
+        # counter now reads 2 (pre-kill) + 3 (replayed) = 5 >= max 3,
+        # so the next forwarded check is limited BY THE OWNER
+        result = _check(frontends[0], user)
+        assert result.limited is True
+        assert frontends[0].resilience_stats()[
+            "pod_failover_degraded_decisions"
+        ] == 4  # unchanged: that answer was a real forward
+    finally:
+        for lane in lanes[:1] + restarted:
+            lane.stop()
+
+
+def test_successful_forwards_reset_the_peer_breaker():
+    """Non-consecutive transient failures must not accumulate to a
+    trip: a successful forward between two failures resets the
+    breaker's consecutive-failure count (the per-batch record_success
+    discipline of the admission plane, applied per forward)."""
+    cfg = PodResilience(
+        degraded=True, retry=False, breaker_failures=2,
+        breaker_reset_s=60.0, probe_interval_s=60.0,  # no probe races
+    )
+    frontends, lanes, _ports = _lane_pair(resilience=cfg)
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[0].faults.set_fault(1, "error", times=1)
+        assert not _check(frontends[0], user).limited  # fail #1 -> degraded
+        assert not _check(frontends[0], user).limited  # clean forward
+        lanes[0].faults.set_fault(1, "error", times=1)
+        assert not _check(frontends[0], user).limited  # fail #2 -> degraded
+        stats = frontends[0].resilience_stats()
+        # without the reset, two cumulative failures == breaker_failures
+        # would have opened the breaker
+        assert stats["pod_failover_breaker_open"] == 0
+        assert stats["pod_failover_degraded_decisions"] == 2
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_subthreshold_journal_drains_while_peer_is_up():
+    """A single failed forward journals its degraded delta without
+    downing the peer; when the very next forward succeeds (health back
+    to up), the journal must STILL drain — the probe loop keys on
+    outstanding recovery work, not only on peer health."""
+    cfg = PodResilience(
+        degraded=True, retry=False, breaker_failures=3,
+        breaker_reset_s=0.2, probe_interval_s=0.3,
+    )
+    frontends, lanes, _ports = _lane_pair(resilience=cfg)
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[0].faults.set_fault(1, "error", times=1)
+        assert not _check(frontends[0], user).limited  # degraded + journaled
+        assert not _check(frontends[0], user).limited  # peer is UP again
+        assert lanes[0].health.state(1) == PeerState.UP
+        assert frontends[0].resilience_stats()[
+            "pod_failover_journal_depth"
+        ] == 1
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            stats = frontends[0].resilience_stats()
+            if (
+                stats["pod_failover_journal_depth"] == 0
+                and stats["pod_failover_reconciles"] >= 1
+            ):
+                break
+            time.sleep(0.05)
+        assert stats["pod_failover_journal_depth"] == 0, stats
+        assert stats["pod_failover_reconciles"] >= 1, stats
+        # the owner really absorbed the stranded delta: replayed(1) +
+        # forwarded(1) = 2 of max 3, so exactly one more forwarded hit
+        # admits and the next is limited BY THE OWNER
+        assert _check(frontends[0], user).limited is False
+        assert _check(frontends[0], user).limited is True
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_failed_journal_replay_restores_the_journal():
+    """reconcile-into-a-still-dead-peer: the drained journal is
+    restored, the breaker stays open, and the peer stays degraded."""
+    cfg = PodResilience(
+        degraded=True, retry=False, breaker_failures=1,
+        breaker_reset_s=60.0, probe_interval_s=60.0,
+    )
+    frontends, lanes, _ports = _lane_pair(resilience=cfg)
+    try:
+        user = _forwarded_user(frontends[0])
+        lanes[1].stop()
+        assert not _check(frontends[0], user).limited  # degraded + journaled
+        stats = frontends[0].resilience_stats()
+        assert stats["pod_failover_journal_depth"] == 1
+        # recovery against the still-dead peer must fail closed
+        assert frontends[0]._peer_recovered(1) is False
+        stats = frontends[0].resilience_stats()
+        assert stats["pod_failover_journal_depth"] == 1  # restored
+        assert stats["pod_failover_reconciles"] == 0
+        assert stats["pod_failover_breaker_open"] == 1
+    finally:
+        lanes[0].stop()
+
+
+def test_lock_order_pass_tracks_the_peering_domain():
+    """Satellite: the resilience plane's health lock is a tracked
+    lock-order domain, ordered outermost of the serving-path chain."""
+    from limitador_tpu.tools.analysis.lock_order import (
+        CANONICAL_ORDER,
+        MODULE_SELF_DOMAINS,
+        TRACKED_DOMAINS,
+    )
+
+    assert "peering" in TRACKED_DOMAINS
+    assert CANONICAL_ORDER[0] == "peering"
+    assert MODULE_SELF_DOMAINS[
+        ("limitador_tpu/server/peering.py", "_health_lock")
+    ] == "peering"
+
+
+def test_tracing_pass_covers_resilience_decision_paths():
+    from limitador_tpu.tools.analysis.tracing import (
+        DECISION_PREFIXES,
+        HOT_MODULES,
+    )
+
+    assert "limitador_tpu/server/peering.py" in HOT_MODULES
+    for prefix in ("forward", "_forward", "_remote", "_degraded"):
+        assert prefix in DECISION_PREFIXES
+
+
+# -- the chaos drill: a real subprocess owner host, killed mid-soak (slow) -----
+
+
+def _spawn_chaos_worker(tmp_path, port, tag):
+    ready = tmp_path / f"ready-{tag}"
+    stop = tmp_path / f"stop-{tag}"
+    out = tmp_path / f"out-{tag}.json"
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("TPU_POD_")
+    }
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    proc = subprocess.Popen(
+        [
+            sys.executable, str(WORKER),
+            "--listen", f"127.0.0.1:{port}",
+            "--ready", str(ready),
+            "--stop", str(stop),
+            "--out", str(out),
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+    )
+    deadline = time.time() + 30
+    while not ready.exists():
+        if proc.poll() is not None:
+            _stdout, stderr = proc.communicate()
+            pytest.skip(
+                f"chaos worker failed to start: {stderr.strip()[-400:]}"
+            )
+        if time.time() > deadline:
+            proc.kill()
+            pytest.skip("chaos worker did not come up in time")
+        time.sleep(0.05)
+    return proc, stop, out
+
+
+@pytest.mark.slow
+def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
+    """ISSUE 11 acceptance: with one of 2 pod hosts SIGKILLed mid-soak,
+    forwarded traffic for the dead owner's keys keeps answering (zero
+    unavailable answers through the whole partition window), and after
+    restart + journal replay the owner's final counter state equals the
+    single-process oracle for every key born inside the window — the
+    pre-partition key bounded by one extra window budget."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    from tests.pod_chaos_worker import (
+        CHAOS_MAX,
+        CHAOS_NAMESPACE,
+        chaos_limits,
+    )
+
+    port = _free_port()
+    proc, _stop, _out = _spawn_chaos_worker(tmp_path, port, "a")
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    lane = PeerLane(
+        0, f"127.0.0.1:{_free_port()}", {1: f"127.0.0.1:{port}"}, None,
+        resilience=cfg,
+    )
+    lane.start()
+    frontend = PodFrontend(
+        RateLimiter(InMemoryStorage(4096)),
+        PodRouter(PodTopology(hosts=2, host_id=0, shards_per_host=1)),
+        lane,
+        resilience=cfg,
+    )
+    asyncio.run(frontend.configure_with(chaos_limits()))
+
+    def check(user):
+        return asyncio.run(frontend.check_rate_limited_and_update(
+            CHAOS_NAMESPACE, Context({"u": user}), 1, False
+        ))
+
+    try:
+        owned = [
+            f"w{i}" for i in range(400)
+            if frontend._plan(
+                CHAOS_NAMESPACE, Context({"u": f"w{i}"})
+            ) == (FORWARD, 1)
+        ][:5]
+        assert len(owned) == 5
+        pre_user, fresh_users = owned[0], owned[1:]
+
+        # phase A (healthy soak): the pre-partition key admits twice on
+        # the real owner
+        for _ in range(2):
+            assert not check(pre_user).limited
+
+        # mid-soak: SIGKILL the owner host
+        proc.send_signal(signal.SIGKILL)
+        proc.wait(timeout=10)
+
+        # phase B (the partition window): every key the dead owner
+        # owns keeps answering — zero unavailable answers, before AND
+        # after the breaker trips
+        admitted_b = {u: 0 for u in owned}
+        for round_i in range(CHAOS_MAX + 1):
+            for user in owned:
+                result = check(user)  # raising here fails the drill
+                if not result.limited:
+                    admitted_b[user] += 1
+        stats = frontend.resilience_stats()
+        assert stats["pod_failover_degraded_decisions"] > 0
+        assert stats["pod_failover_breaker_open"] == 1
+        assert stats["pod_failover_journal_depth"] == len(owned)
+        # the stand-in is EXACT: fresh keys admit exactly one window
+        # budget during the partition, never more
+        for user in fresh_users:
+            assert admitted_b[user] == CHAOS_MAX
+        assert admitted_b[pre_user] == CHAOS_MAX  # stand-in starts empty
+
+        # the owner restarts on the SAME address (fresh process, empty
+        # store — the journal replay must rebuild the window)
+        proc2, stop2, out2 = _spawn_chaos_worker(tmp_path, port, "b")
+
+        deadline = time.time() + 30  # generous: CI boxes run loaded
+        while time.time() < deadline:
+            stats = frontend.resilience_stats()
+            if (
+                stats["pod_failover_journal_depth"] == 0
+                and stats["pod_failover_reconciles"] >= 1
+            ):
+                break
+            time.sleep(0.05)
+        assert stats["pod_failover_reconciles"] >= 1, stats
+        assert stats["pod_failover_journal_depth"] == 0
+        # one journal entry per counter, each carrying its accumulated
+        # degraded-window delta
+        assert stats["pod_failover_replayed_deltas"] == len(owned)
+        assert stats["pod_failover_seconds"] > 0
+
+        # phase C (recovered): the owner now enforces the replayed
+        # window — every forwarded check is limited, served by the
+        # OWNER (degraded counter must not move)
+        degraded_before = stats["pod_failover_degraded_decisions"]
+        for user in owned:
+            assert check(user).limited, (user, frontend.resilience_stats())
+        assert frontend.resilience_stats()[
+            "pod_failover_degraded_decisions"
+        ] == degraded_before
+
+        # graceful stop -> the owner dumps its final counter state
+        stop2.write_text("")
+        proc2.wait(timeout=15)
+        dump = json.loads(out2.read_text())
+        by_user = {c["u"]: c for c in dump["counters"]}
+
+        # the single-process oracle over the same admitted sequence
+        oracle = RateLimiter(InMemoryStorage(4096))
+        oracle.configure_with(chaos_limits())
+        for user in owned:
+            for _ in range(admitted_b[user]):
+                oracle.check_rate_limited_and_update(
+                    CHAOS_NAMESPACE, Context({"u": user}), 1, False
+                )
+        want = {
+            c.set_variables["u"]: c.remaining
+            for c in oracle.get_counters(CHAOS_NAMESPACE)
+        }
+        # keys born inside the partition window: byte-equal final state
+        for user in fresh_users:
+            assert by_user[user]["remaining"] == want[user], user
+        # the pre-partition key: its 2 pre-kill admissions died with
+        # the owner's store (a restart loses unsnapshotted state); the
+        # replayed window is exact, and TOTAL admissions stayed inside
+        # the documented bound of two window budgets
+        assert by_user[pre_user]["remaining"] == want[pre_user]
+        assert 2 + admitted_b[pre_user] <= 2 * CHAOS_MAX
+    finally:
+        lane.stop()
+        for p in (proc,):
+            if p.poll() is None:
+                p.kill()
+        try:
+            if proc2.poll() is None:
+                proc2.kill()
+        except NameError:
+            pass
