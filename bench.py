@@ -1296,7 +1296,24 @@ def _bench_pod_worker(args):
         routed = frontend.router.stats()
         peer_p99_ms = lane.stats()["pod_peer_p99_ms"]
         resilience = frontend.resilience_stats()
+        # The federated view (ISSUE 12): rollups + this worker's hop
+        # breakdown — the GET /debug/pod aggregate, embedded so pod
+        # rounds record what the pod OBSERVED about itself, not just
+        # what it decided. Give one exchange cadence a chance to land
+        # a peer column first (best-effort; a timeout records the
+        # local-only view, which is itself evidence).
+        deadline = time.perf_counter() + 3.0
+        while (
+            not frontend.aggregator.peer_hosts()
+            and time.perf_counter() < deadline
+        ):
+            time.sleep(0.05)
+        pod_debug = frontend.pod_debug()
+        pod_events = frontend.events.counts()
         lane.stop()
+    else:
+        pod_debug = {}
+        pod_events = {}
 
     with open(args.pod_out, "w") as f:
         json.dump({
@@ -1307,6 +1324,8 @@ def _bench_pod_worker(args):
             "peer_p99_ms": peer_p99_ms,
             "resilience": resilience,
             "route_memo": storage.launch_stats(),
+            "pod_debug": pod_debug,
+            "pod_events": pod_events,
         }, f)
     return 0
 
@@ -1331,6 +1350,7 @@ def bench_pod():
     peer_p99 = {}
     degraded_shares = {}
     failover_seconds = {}
+    pod_debug_by_p = {}
     pod_note = ""
     for p in (1, 2, 4):
         coordinator = f"127.0.0.1:{_free_port()}"
@@ -1404,6 +1424,16 @@ def bench_pod():
                     res.get("pod_failover_degraded_decisions", 0)
                 )
                 failover_s += float(res.get("pod_failover_seconds", 0.0))
+                # the federated view of the last multi-process sweep
+                # (ISSUE 12): worker 0's GET /debug/pod aggregate —
+                # rollups + hop breakdown — rides the row
+                if p > 1 and r.get("pod_debug"):
+                    pod_debug_by_p[str(p)] = {
+                        "rollups": r["pod_debug"].get("rollups", {}),
+                        "hosts": sorted(r["pod_debug"].get("hosts", {})),
+                        "hops": r["pod_debug"].get("hops", {}),
+                        "events": r.get("pod_events", {}),
+                    }
         by_processes[str(p)] = round(rate, 1)
         total_routed = local + forwarded + pinned
         if total_routed:
@@ -1445,6 +1475,7 @@ def bench_pod():
         pod_peer_p99_ms_by_processes=peer_p99,
         pod_degraded_share=degraded_shares.get(str(full_p), 0.0),
         pod_failover_seconds=failover_seconds.get(str(full_p), 0.0),
+        pod_debug=pod_debug_by_p.get(str(full_p), {}),
         **({"pod_note": pod_note} if pod_note else {}),
     )
 

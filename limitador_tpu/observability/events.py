@@ -1,0 +1,172 @@
+"""Structured pod event timeline (ISSUE 12).
+
+The pod's state machine — peer health trips, breaker transitions,
+degraded windows, journal replays, routing-epoch bumps, channel
+re-dials, hedges — existed only as gauges and cumulative counters
+after PR 11: an operator could see that a failover HAPPENED but not the
+ordered record of *what happened when*. This module is that record:
+
+* :data:`EVENT_KINDS` — the closed set of typed pod events. Everything
+  emitted is one of these kinds; a new mechanism adds its kind here (the
+  ``pod_events`` Prometheus family pre-seeds its ``kind`` label set from
+  this tuple, so dashboards see zeros before the first transition).
+* :class:`PodEventLog` — a bounded, thread-safe ring of monotonically
+  sequenced events. Emission is a lock + deque append (perf-smoke
+  budgeted); the ring is served at ``GET /debug/events`` and the
+  per-kind counts export as ``pod_events_total{kind}``.
+* :func:`merge_events` — pod-wide merge: each host's log is totally
+  ordered by ``seq``, and ``emit`` stamps a per-host non-decreasing
+  ``ts``, so sorting the union by ``(ts, host, seq)`` preserves every
+  host's causal order while interleaving hosts by wall clock.
+
+Events are emitted from ``server/peering.py`` (health/hedge/redial on
+the lane, breaker/degraded/replay on the frontend) and NEVER from the
+decision path itself — a locally-owned decision emits nothing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Iterable, List, Optional
+
+__all__ = [
+    "EVENT_KINDS",
+    "PodEventLog",
+    "merge_events",
+    "METRIC_FAMILIES",
+]
+
+#: Prometheus families owned by this module (cross-checked against the
+#: declarations in observability/metrics.py by the analysis registry
+#: pass). ``pod_events`` is a kind-labeled counter (rendered with the
+#: standard ``_total`` suffix), ``pod_event_seq`` the last sequence
+#: number — their divergence across hosts is the "how far behind is
+#: this host's timeline" signal.
+METRIC_FAMILIES = (
+    "pod_events",
+    "pod_event_seq",
+)
+
+#: the closed set of typed pod events (ISSUE 12): peer health
+#: transitions, per-owner breaker transitions, degraded-window
+#: boundaries, journal replay boundaries (with delta counts), routing
+#: generation bumps, channel re-dials and hedge outcomes.
+EVENT_KINDS = (
+    "peer_up",
+    "peer_suspect",
+    "peer_down",
+    "breaker_open",
+    "breaker_half_open",
+    "breaker_closed",
+    "degraded_enter",
+    "degraded_exit",
+    "journal_replay_begin",
+    "journal_replay_end",
+    "routing_epoch",
+    "channel_redial",
+    "hedge_fired",
+    "hedge_won",
+)
+
+
+class PodEventLog:
+    """Bounded ring of typed, monotonically sequenced pod events.
+
+    Thread-safe: the lane loop, recovery threads and serving event
+    loops all emit. ``seq`` is per-host monotonic (the within-host
+    causal order); ``ts`` is stamped non-decreasing per host so the
+    pod-wide ``(ts, host, seq)`` merge can never reorder one host's
+    events against its own sequence."""
+
+    def __init__(
+        self,
+        host_id: int = 0,
+        capacity: int = 512,
+        clock=time.time,
+    ):
+        self.host_id = int(host_id)
+        self.capacity = max(int(capacity), 1)
+        self._clock = clock
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._last_ts = 0.0
+        self._counts: Dict[str, int] = dict.fromkeys(EVENT_KINDS, 0)
+
+    def emit(self, kind: str, **detail) -> int:
+        """Append one event; returns its sequence number. Unknown kinds
+        are recorded too (a forward-compatible consumer problem, not an
+        emission-time crash) but count under their own key."""
+        with self._lock:
+            self._seq += 1
+            ts = max(float(self._clock()), self._last_ts)
+            self._last_ts = ts
+            self._counts[kind] = self._counts.get(kind, 0) + 1
+            self._ring.append({
+                "host": self.host_id,
+                "seq": self._seq,
+                "ts": round(ts, 6),
+                "kind": kind,
+                **({"detail": detail} if detail else {}),
+            })
+            return self._seq
+
+    @property
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def counts(self) -> Dict[str, int]:
+        """Cumulative per-kind emission counts (the ``pod_events``
+        family source — counts survive ring eviction)."""
+        with self._lock:
+            return dict(self._counts)
+
+    def snapshot(
+        self, n: Optional[int] = None, kind: Optional[str] = None
+    ) -> List[dict]:
+        """Oldest-first ring contents; ``n`` trims to the most recent,
+        ``kind`` filters."""
+        with self._lock:
+            items = list(self._ring)
+        if kind is not None:
+            items = [e for e in items if e["kind"] == kind]
+        if n is not None:
+            n = max(int(n), 0)
+            # explicit: items[-0:] would be the WHOLE ring, not zero
+            items = items[-n:] if n else []
+        return items
+
+    def events_debug(
+        self, n: Optional[int] = None, kind: Optional[str] = None
+    ) -> dict:
+        """The ``GET /debug/events`` payload."""
+        return {
+            "host": self.host_id,
+            "last_seq": self.last_seq,
+            "capacity": self.capacity,
+            "counts": self.counts(),
+            "events": self.snapshot(n=n, kind=kind),
+        }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+def merge_events(*event_lists: Iterable[dict]) -> List[dict]:
+    """Merge per-host event lists into one pod-wide timeline ordered by
+    ``(ts, host, seq)``. Within a host ``seq`` is authoritative and the
+    per-host non-decreasing ``ts`` stamp guarantees the merge preserves
+    it; across hosts wall clocks interleave (they are NTP-close, not
+    synchronized — a cross-host tie is broken by host id for
+    determinism, not causality)."""
+    merged: List[dict] = []
+    for events in event_lists:
+        merged.extend(events)
+    merged.sort(key=lambda e: (
+        e.get("ts", 0.0), e.get("host", 0), e.get("seq", 0)
+    ))
+    return merged

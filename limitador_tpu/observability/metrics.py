@@ -717,6 +717,78 @@ class PrometheusMetrics:
             "from closed (the degraded-window clock)",
             registry=self.registry,
         )
+        # -- pod observability plane (observability/pod_plane.py +
+        # observability/events.py, ISSUE 12): per-hop breakdown of
+        # forwarded decisions, the typed pod event timeline, and the
+        # federated control-signal exchange. The hop histogram is fed
+        # per-bucket by PodHopRecorder.poll (attach_render_hook); the
+        # rest polls off the pod frontend's library_stats. Registered
+        # in pod_plane.METRIC_FAMILIES / events.METRIC_FAMILIES (lint
+        # cross-checked).
+        from .events import EVENT_KINDS
+        from .pod_plane import HOP_PHASES, POD_HOP_BUCKETS_MS
+
+        self.pod_hop_phase_ms = Histogram(
+            "pod_hop_phase_ms",
+            "Per-hop breakdown of one forwarded pod decision (ms): "
+            "queue (serving loop -> lane loop handoff), serialize "
+            "(payload encode), wire (channel/network/retries — the "
+            "derived remainder), remote_decide (the owner's reported "
+            "decide time)",
+            ["phase"],
+            registry=self.registry,
+            buckets=POD_HOP_BUCKETS_MS,
+        )
+        self.pod_events = Counter(
+            "pod_events",
+            "Typed pod timeline events by kind: peer health "
+            "transitions, breaker transitions, degraded enter/exit, "
+            "journal replay begin/end, routing-epoch bumps, channel "
+            "re-dials, hedge outcomes (GET /debug/events serves the "
+            "ordered ring)",
+            ["kind"],
+            registry=self.registry,
+        )
+        self.pod_event_seq = Gauge(
+            "pod_event_seq",
+            "Last pod event sequence number emitted by this host "
+            "(monotonic; the pod-wide merge key is (host, seq))",
+            registry=self.registry,
+        )
+        self.pod_signal_hosts = Gauge(
+            "pod_signal_hosts",
+            "Pod hosts contributing a fresh federated signal column "
+            "(self included; a stale peer drops out after 10s)",
+            registry=self.registry,
+        )
+        self.pod_signal_exchanges = Counter(
+            "pod_signal_exchanges",
+            "Peer signal columns ingested (piggybacked on the health-"
+            "probe cadence, never the decision path)",
+            registry=self.registry,
+        )
+        self.pod_signal_age_s = Gauge(
+            "pod_signal_age_s",
+            "Age of the OLDEST peer signal column (s) — staleness of "
+            "the federated view",
+            registry=self.registry,
+        )
+        self.pod_signal_routed_share = Gauge(
+            "pod_signal_routed_share",
+            "This host's locally-owned decision share as joined into "
+            "the federated ControlSignals pod tail",
+            registry=self.registry,
+        )
+        self.pod_signal_degraded_share = Gauge(
+            "pod_signal_degraded_share",
+            "Share of this host's routed decisions served by degraded-"
+            "owner stand-ins (the federated degraded share column)",
+            registry=self.registry,
+        )
+        for phase in HOP_PHASES:
+            self.pod_hop_phase_ms.labels(phase)
+        for kind in EVENT_KINDS:
+            self.pod_events.labels(kind)
         # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
         # split into pipelined sub-batches. Registered in
         # batcher.METRIC_FAMILIES (lint cross-checked).
@@ -873,6 +945,9 @@ class PrometheusMetrics:
         peer_p99_ms = 0.0
         failover_journal_depth = 0
         failover_breaker_open = 0
+        pod_event_seq = 0
+        pod_signal_hosts = 0
+        pod_signal_age = 0.0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -900,6 +975,34 @@ class PrometheusMetrics:
             )
             for peer, state in stats.get("peer_health_state", {}).items():
                 self.peer_health_state.labels(str(peer)).set(int(state))
+            # pod observability plane (ISSUE 12): event-seq/signal
+            # gauges, plus the kind-labeled event counter below
+            pod_event_seq = max(
+                pod_event_seq, int(stats.get("pod_event_seq", 0))
+            )
+            pod_signal_hosts = max(
+                pod_signal_hosts, int(stats.get("pod_signal_hosts", 0))
+            )
+            pod_signal_age = max(
+                pod_signal_age, float(stats.get("pod_signal_age_s", 0.0))
+            )
+            if "pod_signal_routed_share" in stats:
+                self.pod_signal_routed_share.set(
+                    float(stats["pod_signal_routed_share"])
+                )
+            if "pod_signal_degraded_share" in stats:
+                self.pod_signal_degraded_share.set(
+                    float(stats["pod_signal_degraded_share"])
+                )
+            for kind, seen in stats.get("pod_events", {}).items():
+                seen = int(seen)
+                baseline_key = (i, "pod_events", kind)
+                baseline = self._counter_baselines.get(baseline_key, 0)
+                if seen > baseline:
+                    self.pod_events.labels(str(kind)).inc(
+                        seen - baseline
+                    )
+                    self._counter_baselines[baseline_key] = seen
             # float-valued cumulative counters (seconds): same baseline
             # conversion as below, without the int truncation
             for key in (
@@ -950,6 +1053,7 @@ class PrometheusMetrics:
                 "pod_failover_degraded_decisions",
                 "pod_failover_reconciles",
                 "pod_failover_replayed_deltas",
+                "pod_signal_exchanges",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -979,6 +1083,9 @@ class PrometheusMetrics:
         self.pod_peer_p99_ms.set(peer_p99_ms)
         self.pod_failover_journal_depth.set(failover_journal_depth)
         self.pod_failover_breaker_open.set(failover_breaker_open)
+        self.pod_event_seq.set(pod_event_seq)
+        self.pod_signal_hosts.set(pod_signal_hosts)
+        self.pod_signal_age_s.set(pod_signal_age)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

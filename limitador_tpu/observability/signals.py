@@ -118,6 +118,14 @@ class ControlSignals:
         "device_backed",
         "top_namespace",
         "near_exhaustion",
+        # pod fields (ISSUE 12) — appended at the END so the future
+        # controller's observation vector only ever GROWS; the order is
+        # pinned by tests/test_pod_plane.py and must not reshuffle.
+        "pod_routed_share",
+        "peers_up",
+        "peers_suspect",
+        "peers_down",
+        "pod_degraded_share",
     )
 
     __slots__ = FIELDS
@@ -143,6 +151,11 @@ class ControlSignals:
         self.device_backed = kw.get("device_backed", -1)
         self.top_namespace = kw.get("top_namespace", "")
         self.near_exhaustion = kw.get("near_exhaustion", 0)
+        self.pod_routed_share = kw.get("pod_routed_share", 0.0)
+        self.peers_up = kw.get("peers_up", 0)
+        self.peers_suspect = kw.get("peers_suspect", 0)
+        self.peers_down = kw.get("peers_down", 0)
+        self.pod_degraded_share = kw.get("pod_degraded_share", 0.0)
 
     def to_dict(self) -> dict:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -172,6 +185,13 @@ class ControlSignals:
             float(self.box_calibration_score),
             float(self.device_backed),
             float(self.near_exhaustion),
+            # pod tail (ISSUE 12): appended, never reordered — the
+            # controller's input shape only grows.
+            float(self.pod_routed_share),
+            float(self.peers_up),
+            float(self.peers_suspect),
+            float(self.peers_down),
+            float(self.pod_degraded_share),
         ])
         return out
 
@@ -211,6 +231,7 @@ class SignalBus:
         self._pipeline = None
         self._native_plane = None
         self._observatory = None
+        self._pod = None
         # previous cumulative shed counts + timestamp, for the rates;
         # baselines only advance once per MIN_RATE_WINDOW_S so the four
         # independent snapshot triggers (drain tick, renders, the two
@@ -237,6 +258,13 @@ class SignalBus:
 
     def attach_observatory(self, observatory) -> None:
         self._observatory = observatory
+
+    def attach_pod(self, pod) -> None:
+        """Attach the pod frontend (or anything exposing
+        ``pod_signal_fields() -> dict``): routed share, peer health
+        counts and degraded share join every snapshot (ISSUE 12) —
+        the controller's observation matches the unit of serving."""
+        self._pod = pod
 
     def warm(self) -> None:
         """Pre-compute the box calibration score off-thread so the
@@ -307,6 +335,12 @@ class SignalBus:
                 kw["near_exhaustion"] = int(
                     pressure.get("near_exhaustion", 0)
                 )
+            except Exception:
+                pass
+        pod = self._pod
+        if pod is not None:
+            try:
+                kw.update(pod.pod_signal_fields())
             except Exception:
                 pass
         if _BOX_CALIBRATION is not None:

@@ -35,6 +35,8 @@ __all__ = [
     "datastore_span",
     "device_batch_span",
     "tracing_enabled",
+    "hop_trace_metadata",
+    "peer_decide_span",
 ]
 
 
@@ -160,6 +162,59 @@ def _device_batch_span(batch_id: int, n_requests: int, attrs=None):
                 )
 
         yield record
+
+
+def hop_trace_metadata() -> list:
+    """W3C trace-context key/value pairs for a pod peer hop (ISSUE 12):
+    the origin's current span context, injected so the owner host can
+    LINK its decide span back across the hop. Empty (zero-cost) when no
+    exporter is configured — the common case never pays the propagation
+    machinery."""
+    if not _enabled or _tracer is None:
+        return []
+    try:
+        from opentelemetry.propagate import inject
+
+        carrier: dict = {}
+        inject(carrier)
+        return list(carrier.items())
+    except Exception:
+        return []
+
+
+def peer_decide_span(namespace, request_id, carrier=None):
+    """Owner-side span around one forwarded decision (the remote half
+    of a pod hop). ``carrier`` is the forward's gRPC metadata mapping:
+    when it carries a W3C trace context the span LINKS to the origin's
+    span (span links across the hop, ISSUE 12) rather than parenting —
+    the hop is a causal reference between two hosts' traces, not one
+    host's child."""
+    if not _enabled or _tracer is None:
+        return _NULLCONTEXT
+    return _peer_decide_span(namespace, request_id, carrier)
+
+
+@contextmanager
+def _peer_decide_span(namespace, request_id, carrier):
+    links = []
+    if carrier:
+        try:
+            from opentelemetry.propagate import extract
+
+            remote = _trace.get_current_span(
+                extract(carrier)
+            ).get_span_context()
+            if remote.is_valid:
+                links.append(_trace.Link(remote))
+        except Exception:  # malformed traceparent must not fail a hop
+            pass
+    with _tracer.start_as_current_span(
+        "pod_peer_decide", links=links
+    ) as span:
+        span.set_attribute("ratelimit.namespace", str(namespace))
+        if request_id:
+            span.set_attribute("request.id", str(request_id))
+        yield
 
 
 def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):

@@ -178,6 +178,11 @@ class PodRouter:
         self.routed_local = 0
         self.routed_forwarded = 0
         self.routed_pinned = 0
+        #: routing generation: bumped by every configure() (limits
+        #: reload). The pod event timeline (ISSUE 12) records each bump
+        #: so cross-host verdict changes are attributable to a limits
+        #: generation, not a mystery.
+        self.epoch = 0
 
     # -- configuration -------------------------------------------------------
 
@@ -207,6 +212,7 @@ class PodRouter:
             pinned[str(ns)] = self.pin_host(str(ns), self.topology.hosts)
         with self._lock:
             self._pinned_ns = pinned
+            self.epoch += 1
 
     # -- the per-request verdict ---------------------------------------------
 

@@ -347,6 +347,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="pod: ms an open peer breaker dwells before recovery "
         "probes may close it",
     )
+    # pod observability plane (docs/observability.md, ISSUE 12)
+    p.add_argument(
+        "--pod-events", type=int,
+        default=int(_env("TPU_POD_EVENTS", "512")),
+        help="pod: capacity of the typed pod event ring served at "
+        "GET /debug/events (per-kind counts export as "
+        "pod_events_total regardless of ring size)",
+    )
     p.add_argument(
         "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
         help="sharded: comma-separated namespaces whose counters are "
@@ -937,6 +945,7 @@ async def _amain(args) -> int:
         pod_frontend = PodFrontend(
             limiter, router, lane, global_namespaces=pod_global_ns,
             resilience=resilience,
+            events_capacity=max(args.pod_events, 1),
         )
         limiter = pod_frontend
         log.info(
@@ -1225,6 +1234,26 @@ async def _amain(args) -> int:
             f"every {args.usage_drain_interval:.1f}s"
             + (", native leased merge on"
                if native_pipeline is not None else ""))
+
+    # Pod observability plane (ISSUE 12): hop breakdown into the
+    # process flight recorder + the pod_hop_phase_ms family, the local
+    # ControlSignals bus federated over the lane, and the event
+    # counters polled off library_stats (wired by PodFrontend itself).
+    if pod_frontend is not None:
+        pod_recorder = (
+            getattr(limiter, "recorder", None)
+            or getattr(counters_storage, "recorder", None)
+        )
+        if pod_recorder is not None:
+            pod_frontend.attach_flight(pod_recorder)
+        if signal_bus is not None:
+            pod_frontend.attach_signal_bus(signal_bus)
+        metrics.attach_render_hook(pod_frontend.hops)
+        log.info(
+            "pod observability plane: hop tracing, "
+            f"{args.pod_events}-event timeline, federated signals "
+            f"{'with' if signal_bus is not None else 'without'} the "
+            "local signal bus")
 
     authority_server = None
     if args.authority_listen:

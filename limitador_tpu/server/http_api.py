@@ -22,6 +22,13 @@ Beyond the reference surface, the device-plane debug endpoints
                             and utilization (?k=N trims)
     GET  /debug/signals     unified ControlSignals snapshot + flattened
                             observation vector + ring timeline
+    GET  /debug/pod         federated pod view: per-host ControlSignals
+                            columns + min/max/sum rollups + the per-hop
+                            forward breakdown (404 off pod mode)
+    GET  /debug/events      typed pod event timeline: sequenced peer/
+                            breaker/degraded/replay/hedge events
+                            (?n=N trims, ?kind= filters; 404 off pod
+                            mode)
     GET  /debug/profile     jax.profiler capture status
     POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
                             toggles an on-demand jax.profiler trace
@@ -68,6 +75,8 @@ DEBUG_SOURCE_SECTIONS = (
     ("device_backed", "device_backed"),
     ("tenant_usage", "tenant_usage"),
     ("signals", "signals_debug"),
+    ("pod", "pod_debug"),
+    ("pod_events", "events_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -87,6 +96,8 @@ DEBUG_STATS_SECTIONS = (
     "device_backed",
     "tenant_usage",
     "signals",
+    "pod",
+    "pod_events",
 )
 
 
@@ -238,6 +249,30 @@ def _openapi_spec() -> dict:
                     "responses": {
                         "200": {"description": "control signals"},
                         "404": {"description": "signal bus not running"},
+                    },
+                }
+            },
+            "/debug/pod": {
+                "get": {
+                    "summary": "Federated pod view: per-host "
+                               "ControlSignals columns, min/max/sum "
+                               "rollups, and the per-hop forward "
+                               "breakdown",
+                    "responses": {
+                        "200": {"description": "pod snapshot"},
+                        "404": {"description": "not a pod"},
+                    },
+                }
+            },
+            "/debug/events": {
+                "get": {
+                    "summary": "Typed pod event timeline (peer health, "
+                               "breaker, degraded window, journal "
+                               "replay, routing epoch, hedges), "
+                               "sequenced per host",
+                    "responses": {
+                        "200": {"description": "pod events"},
+                        "404": {"description": "not a pod"},
                     },
                 }
             },
@@ -505,6 +540,38 @@ class _Api:
             )
         return web.json_response(fn())
 
+    async def get_debug_pod(self, request: web.Request) -> web.Response:
+        """Federated pod observability view: per-host ControlSignals
+        columns with min/max/sum rollups, column ages, the signal
+        timeline and this host's per-hop forward breakdown."""
+        fn = self._debug_source_fn("pod_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "not a pod (single-host deployment)"},
+                status=404,
+            )
+        return web.json_response(fn())
+
+    async def get_debug_events(self, request: web.Request) -> web.Response:
+        """The typed pod event timeline (?n=N trims to the most recent
+        N, ?kind= filters to one event kind); mergeable pod-wide by
+        (host, seq)."""
+        fn = self._debug_source_fn("events_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "not a pod (single-host deployment)"},
+                status=404,
+            )
+        try:
+            n = int(request.query["n"]) if "n" in request.query else None
+        except ValueError:
+            return web.json_response(
+                {"error": "n must be an integer"}, status=400
+            )
+        return web.json_response(
+            fn(n=n, kind=request.query.get("kind"))
+        )
+
     async def get_debug_profile(self, request: web.Request) -> web.Response:
         return web.json_response(self.profiler.status())
 
@@ -669,6 +736,8 @@ def make_http_app(
     app.router.add_get("/debug/stats", api.get_debug_stats)
     app.router.add_get("/debug/top", api.get_debug_top)
     app.router.add_get("/debug/signals", api.get_debug_signals)
+    app.router.add_get("/debug/pod", api.get_debug_pod)
+    app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)
     app.router.add_post("/debug/profile", api.post_debug_profile)
     app.router.add_get("/limits/{namespace}", api.get_limits)

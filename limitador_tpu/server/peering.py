@@ -51,6 +51,28 @@ range (docs/configuration.md "Pod resilience"):
   drains, and routing flips back — zero admitted updates are lost
   across the partition window, and over-admission is bounded by one
   window budget per counter (docs/serving-model.md).
+
+The pod observability plane (ISSUE 12) makes the pod the unit of
+observation, not just of serving:
+
+* **Cross-host decision tracing**: a forward carries the originating
+  ``x-request-id`` contextvar and the W3C trace context in its gRPC
+  metadata; the owner stamps the id into ITS flight-recorder entries
+  (and offers one itself when a recorder is attached) and opens a
+  ``pod_peer_decide`` span LINKED to the origin's span. The owner
+  reports its decide time back, and the origin records the per-hop
+  breakdown (queue / serialize / wire / remote_decide) into the
+  ``pod_hop_phase_ms`` family plus the process flight recorder
+  (observability/pod_plane.py).
+* **Federated signals**: each host's ``ControlSignals`` column is
+  exchanged piggybacked on the probe cadence (``kind: "signals"`` —
+  never on the decision path) and joined by ``PodSignalAggregator``
+  into the ``GET /debug/pod`` snapshot.
+* **Structured pod events**: every health transition, breaker
+  transition, degraded enter/exit, journal replay begin/end, routing
+  epoch bump, channel re-dial and hedge outcome lands as a typed,
+  sequenced event in the ``PodEventLog`` ring (``GET /debug/events``,
+  ``pod_events_total{kind}`` — observability/events.py).
 """
 
 from __future__ import annotations
@@ -75,6 +97,13 @@ from ..core.limiter import (
     CheckResult,
     _counters_that_apply,
 )
+from ..observability.device_plane import (
+    current_request_id,
+    set_request_id,
+)
+from ..observability.events import PodEventLog
+from ..observability.pod_plane import PodHopRecorder, PodSignalAggregator
+from ..observability.tracing import hop_trace_metadata, peer_decide_span
 from ..routing import LOCAL, PodRouter, counter_key
 from ..storage.base import StorageError
 from ..storage.failover import FailoverStore
@@ -176,6 +205,25 @@ def _counter_from_wire(blob: dict) -> Tuple[Counter, int]:
         policy=blob.get("policy", "fixed_window"),
     )
     return Counter(limit, dict(blob.get("vars", ()))), int(blob["delta"])
+
+
+def _wire_request_id(request_id: Optional[str]) -> Optional[str]:
+    """The id as it may ride gRPC metadata. The contextvar value
+    originates from an UNVALIDATED client header (middleware.py echoes
+    whatever arrived); gRPC rejects non-printable/non-ASCII metadata
+    values at call time, and that rejection would fail the forward and
+    feed peer-health accounting — a single misbehaving client must not
+    get a healthy peer marked suspect. Non-conforming characters are
+    dropped (correlation still works on the surviving prefix); an id
+    that sanitizes to nothing stays off the wire."""
+    if not request_id:
+        return None
+    rid = str(request_id)[:128]
+    if not (rid.isascii() and rid.isprintable()):
+        rid = "".join(
+            c for c in rid if c.isascii() and c.isprintable()
+        )[:128]
+    return rid or None
 
 
 def _is_deadline_miss(exc: BaseException) -> bool:
@@ -457,6 +505,26 @@ class PeerLane:
         self.hedges_lost = 0
         self.redials = 0
         self.probes = 0
+        # Pod observability plane (ISSUE 12) attach points, all
+        # optional (None = zero cost): the frontend wires them up.
+        #: PodEventLog — typed lane events (health trips, redials,
+        #: hedge outcomes)
+        self.events: Optional[PodEventLog] = None
+        #: callable(host, request_id, namespace, total_s, phases_s):
+        #: per-hop breakdown of one completed forward
+        self.on_hop = None
+        #: callable() -> dict: this host's signal column, exchanged
+        #: with every peer on the probe cadence
+        self.signals_provider = None
+        #: callable(host, payload): a peer's signal column arrived
+        self.on_peer_signals = None
+        #: DeviceStatsRecorder (or bare FlightRecorder): forwarded
+        #: decisions WE decide for peers land here with the
+        #: originating request id
+        self.recorder = None
+        self.signal_exchanges = 0
+        self.signal_exchange_failures = 0
+        self._signal_inflight: set = set()
         # Guards the latency reservoir: forwards append from serving
         # event-loop threads while the Prometheus render thread
         # snapshots it (an unguarded sorted() over a mutating deque
@@ -515,18 +583,30 @@ class PeerLane:
         next_probe = self._loop.time()
         while not self._stopping.is_set():
             await asyncio.sleep(0.1)
-            if not (self.cfg.degraded and self.peers):
+            if not self.peers:
                 continue
             now = self._loop.time()
             if now < next_probe:
                 continue
             next_probe = now + self.cfg.probe_interval_s
-            for host in list(self.peers):
-                if self.health.state(host) != PeerState.UP or (
-                    self.probe_needed is not None
-                    and self.probe_needed(host)
-                ):
-                    asyncio.ensure_future(self._probe(host))
+            if self.cfg.degraded:
+                for host in list(self.peers):
+                    if self.health.state(host) != PeerState.UP or (
+                        self.probe_needed is not None
+                        and self.probe_needed(host)
+                    ):
+                        asyncio.ensure_future(self._probe(host))
+            # Federated signal exchange (ISSUE 12): piggybacked on the
+            # SAME cadence — the only background chatter frequency the
+            # pod has — and only with peers believed up (a down peer's
+            # column goes stale, which is itself the signal; probes own
+            # detecting its return).
+            if self.signals_provider is not None:
+                for host in list(self.peers):
+                    if self.health.state(host) == PeerState.UP:
+                        asyncio.ensure_future(
+                            self._exchange_signals(host)
+                        )
         for channel, _call in self._channels.values():
             await channel.close()
         await self._server.stop(grace=0.5)
@@ -543,6 +623,27 @@ class PeerLane:
         kind = payload.get("kind", "check_and_update")
         if kind == "ping":
             return json.dumps({"ok": True, "pong": True}).encode()
+        if kind == "signals":
+            # Federated signal exchange (ISSUE 12): ingest the caller's
+            # column, answer with ours — symmetric, one RPC per pair
+            # per cadence direction, never on the decision path.
+            hook = self.on_peer_signals
+            if hook is not None:
+                try:
+                    hook(
+                        int(payload.get("from", -1)),
+                        payload.get("signals") or {},
+                    )
+                except Exception:
+                    pass  # a bad column must not fail the exchange
+            mine: dict = {}
+            provider = self.signals_provider
+            if provider is not None:
+                try:
+                    mine = provider()
+                except Exception:
+                    mine = {}
+            return json.dumps({"ok": True, "signals": mine}).encode()
         if kind == "apply_deltas":
             if self.apply_cb is None:
                 raise RuntimeError(
@@ -557,14 +658,49 @@ class PeerLane:
             )
             return json.dumps({"ok": True, "applied": int(applied)}).encode()
         self.served += 1
-        result = await self.decide_cb(
-            payload["ns"],
-            _decode_context(payload["ctx"]),
-            int(payload["delta"]),
-            bool(payload.get("load", False)),
-            kind,
-        )
-        out: dict = {"ok": True}
+        # Cross-host decision tracing (ISSUE 12): adopt the origin's
+        # request id for this task's context, so OUR flight-recorder
+        # entries (batcher-recorded or lane-offered below) and spans
+        # correlate with the hop's originating request.
+        meta: dict = {}
+        try:
+            meta = dict(context.invocation_metadata() or ())
+        except Exception:
+            meta = {}
+        rid = meta.get("x-request-id")
+        if rid is not None:
+            set_request_id(str(rid))
+        t_decide = time.perf_counter()
+        with peer_decide_span(payload["ns"], rid, meta):
+            result = await self.decide_cb(
+                payload["ns"],
+                _decode_context(payload["ctx"]),
+                int(payload["delta"]),
+                bool(payload.get("load", False)),
+                kind,
+            )
+        decide_s = time.perf_counter() - t_decide
+        recorder = self.recorder
+        if recorder is not None:
+            flight = getattr(recorder, "flight", recorder)
+            if flight.would_admit(decide_s):
+                # The owner-side record of a forwarded decision: the
+                # batched storages also record through the contextvar,
+                # but this entry exists for EVERY storage topology.
+                flight.offer(decide_s, {
+                    "request_id": str(rid) if rid is not None else None,
+                    "namespace": str(payload["ns"]),
+                    "batch_id": None,
+                    "queue_wait_ms": 0.0,
+                    "phases_ms": {
+                        "pod_remote_decide": round(decide_s * 1e3, 4),
+                    },
+                    "pod_hop": {
+                        "origin": int(payload.get("from", -1)),
+                        "host": self.host_id,
+                    },
+                })
+        out: dict = {"ok": True, "decide_ns": int(decide_s * 1e9)}
         if isinstance(result, CheckResult):
             out["limited"] = bool(result.limited)
             out["name"] = result.limit_name
@@ -582,6 +718,13 @@ class PeerLane:
 
     # -- client side ---------------------------------------------------------
 
+    def _emit(self, kind: str, **detail) -> None:
+        """Typed pod event, when a log is attached (None = zero cost).
+        Called with NO lane/health locks held — the log takes its own."""
+        events = self.events
+        if events is not None:
+            events.emit(kind, **detail)
+
     def _redial(self, host: int) -> None:
         """Drop the cached channel so the next attempt dials fresh (lane
         loop only). A peer restarted on the same address must not keep
@@ -589,6 +732,7 @@ class PeerLane:
         entry = self._channels.pop(host, None)
         if entry is not None:
             self.redials += 1
+            self._emit("channel_redial", peer=host)
             asyncio.ensure_future(entry[0].close())
 
     def _dial(self, host: int):
@@ -611,7 +755,8 @@ class PeerLane:
         return channel, call
 
     async def _attempt(
-        self, host: int, blob: bytes, timeout: float, fresh: bool = False
+        self, host: int, blob: bytes, timeout: float, fresh: bool = False,
+        metadata=None,
     ) -> bytes:
         await self.faults.apply(host, timeout)
         if fresh:
@@ -619,19 +764,21 @@ class PeerLane:
             # to escape whatever the cached channel is stuck on.
             channel, call = self._dial(host)
             try:
-                return await self._call(host, call, blob, timeout)
+                return await self._call(host, call, blob, timeout, metadata)
             finally:
                 asyncio.ensure_future(channel.close())
         entry = self._channels.get(host)
         if entry is None:
             entry = self._channels[host] = self._dial(host)
         _channel, call = entry
-        return await self._call(host, call, blob, timeout)
+        return await self._call(host, call, blob, timeout, metadata)
 
     @staticmethod
-    async def _call(host: int, call, blob: bytes, timeout: float) -> bytes:
+    async def _call(
+        host: int, call, blob: bytes, timeout: float, metadata=None
+    ) -> bytes:
         try:
-            return await call(blob, timeout=timeout)
+            return await call(blob, timeout=timeout, metadata=metadata)
         except asyncio.CancelledError as exc:
             # A concurrent health trip re-dialed (closed) this channel
             # under the in-flight call; grpc surfaces that as a call
@@ -649,15 +796,31 @@ class PeerLane:
             host, deadline_miss=_is_deadline_miss(exc)
         )
         if tripped is not None:
+            self._emit(
+                f"peer_{tripped}", peer=host, error=f"{exc}"[:200]
+            )
             self._redial(host)
 
+    def _note_success(self, host: int) -> None:
+        """Health accounting for a successful call: a transition back
+        to up is a timeline event."""
+        if self.health.record_success(host) is not None:
+            self._emit("peer_up", peer=host)
+
     async def _forward_on_loop(
-        self, host: int, blob: bytes, kind: str
-    ) -> bytes:
+        self, host: int, blob: bytes, kind: str, metadata=None,
+        t_submit: Optional[float] = None,
+    ):
         """One forward with the lane's resilience budgeted against
         ``cfg.deadline_s``: optional hedge race, then at most one
         jittered-backoff retry for retryable kinds once the peer is
-        suspect. Runs on the lane loop."""
+        suspect. Runs on the lane loop; returns ``(raw, queue_s)`` —
+        the serving-loop -> lane-loop handoff time is the ``queue``
+        phase of the hop breakdown (ISSUE 12)."""
+        queue_s = (
+            max(time.perf_counter() - t_submit, 0.0)
+            if t_submit is not None else 0.0
+        )
         cfg = self.cfg
         deadline = self._loop.time() + cfg.deadline_s
         retryable = cfg.retry and kind in RETRYABLE_KINDS
@@ -668,7 +831,9 @@ class PeerLane:
                 raise TimeoutError(
                     f"forward deadline exhausted for peer {host}"
                 )
-            return await self._attempt(host, blob, remaining, fresh=fresh)
+            return await self._attempt(
+                host, blob, remaining, fresh=fresh, metadata=metadata
+            )
 
         try:
             if cfg.hedge_ms > 0 and kind in RETRYABLE_KINDS:
@@ -694,8 +859,8 @@ class PeerLane:
             except Exception as retry_exc:
                 self._note_failure(host, retry_exc)
                 raise
-        self.health.record_success(host)
-        return raw
+        self._note_success(host)
+        return raw, queue_s
 
     async def _hedged(self, host: int, one_attempt, deadline) -> bytes:
         """Race a second attempt on a fresh channel when the first
@@ -709,6 +874,7 @@ class PeerLane:
             return first.result()
         if deadline - self._loop.time() <= 0.001:
             return await first  # no budget left to hedge with
+        self._emit("hedge_fired", peer=host)
         second = asyncio.ensure_future(one_attempt(fresh=True))
         pending = {first, second}
         last_exc: Optional[BaseException] = None
@@ -725,6 +891,7 @@ class PeerLane:
                     other.cancel()
                 if task is second:
                     self.hedges_won += 1
+                    self._emit("hedge_won", peer=host)
                 else:
                     self.hedges_lost += 1
                 return task.result()
@@ -771,13 +938,45 @@ class PeerLane:
             hook = self.on_peer_recovered
             ok = True if hook is None else bool(hook(host))
             if ok:
-                self.health.record_success(host)
+                self._note_success(host)
         except Exception as exc:
             log.warning(
                 f"pod peer {host} recovery failed (stays degraded): {exc}"
             )
         finally:
             self._recovering.discard(host)
+
+    async def _exchange_signals(self, host: int) -> None:
+        """One federated-signal exchange with an up peer (lane loop,
+        probe cadence — ISSUE 12). Failures are counted but deliberately
+        NOT fed into peer health: health is the forwards'/probes'
+        verdict, and a refused diagnostics exchange must never down a
+        peer that is serving traffic fine."""
+        if host in self._signal_inflight:
+            return  # a slow exchange is still in flight for this peer
+        provider = self.signals_provider
+        if provider is None:
+            return
+        self._signal_inflight.add(host)
+        try:
+            payload = provider()
+            blob = json.dumps({
+                "kind": "signals",
+                "from": self.host_id,
+                "signals": payload,
+            }).encode()
+            raw = await self._attempt(
+                host, blob, self.cfg.probe_timeout_s
+            )
+            self.signal_exchanges += 1
+            hook = self.on_peer_signals
+            theirs = json.loads(raw.decode()).get("signals") or {}
+            if hook is not None and theirs:
+                hook(host, theirs)
+        except Exception:
+            self.signal_exchange_failures += 1
+        finally:
+            self._signal_inflight.discard(host)
 
     def replay_deltas(
         self, host: int, deltas: List[dict],
@@ -813,6 +1012,12 @@ class PeerLane:
         if host not in self.peers:
             self.errors += 1
             raise RuntimeError(f"no peer lane for pod host {host}")
+        # Cross-host decision tracing (ISSUE 12): the originating
+        # request id and (when an exporter is live) the W3C trace
+        # context ride the hop as gRPC metadata, so the owner's
+        # flight-recorder entries and spans correlate back to us.
+        request_id = _wire_request_id(current_request_id())
+        t0 = time.perf_counter()
         blob = json.dumps({
             "ns": str(namespace),
             "ctx": _encode_context(ctx),
@@ -821,19 +1026,45 @@ class PeerLane:
             "kind": kind,
             "from": self.host_id,
         }).encode()
-        t0 = time.perf_counter()
+        serialize_s = time.perf_counter() - t0
+        metadata = None
+        pairs = hop_trace_metadata()
+        if request_id is not None:
+            pairs.append(("x-request-id", request_id))
+        if pairs:
+            metadata = tuple(pairs)
         fut = asyncio.run_coroutine_threadsafe(
-            self._forward_on_loop(host, blob, kind), self._loop
+            self._forward_on_loop(
+                host, blob, kind, metadata=metadata,
+                t_submit=time.perf_counter(),
+            ),
+            self._loop,
         )
         try:
-            raw = await asyncio.wrap_future(fut)
+            raw, queue_s = await asyncio.wrap_future(fut)
         except Exception:
             self.errors += 1
             raise
         self.forwards += 1
+        total_s = time.perf_counter() - t0
         with self._latency_lock:
-            self._latencies_ms.append((time.perf_counter() - t0) * 1e3)
-        return json.loads(raw.decode())
+            self._latencies_ms.append(total_s * 1e3)
+        resp = json.loads(raw.decode())
+        hook = self.on_hop
+        if hook is not None:
+            # The per-hop breakdown: the owner reports its decide time,
+            # wire is the unaccounted remainder (channel, network,
+            # retries/hedges, response parse).
+            remote_s = max(float(resp.get("decide_ns", 0)) / 1e9, 0.0)
+            hook(host, request_id, namespace, total_s, {
+                "queue": queue_s,
+                "serialize": serialize_s,
+                "wire": max(
+                    total_s - queue_s - serialize_s - remote_s, 0.0
+                ),
+                "remote_decide": remote_s,
+            })
+        return resp
 
     # -- telemetry -----------------------------------------------------------
 
@@ -856,6 +1087,10 @@ class PeerLane:
             "peer_health_hedges_lost": self.hedges_lost,
             "peer_health_redials": self.redials,
             "peer_health_probes": self.probes,
+            # client-side exchange outcomes (the aggregator owns the
+            # pod_signal_exchanges family — columns actually ingested)
+            "pod_signal_sent": self.signal_exchanges,
+            "pod_signal_send_failures": self.signal_exchange_failures,
         }
 
 
@@ -878,6 +1113,15 @@ class _OwnerGuard:
         self.reconciles = 0
         self.replayed_deltas = 0
         self.reconcile_seconds = 0.0
+        # wall clock of the current degraded window's first stand-in
+        # decision (None = not degraded) — the degraded_enter/exit
+        # event pair brackets it on the pod timeline (ISSUE 12).
+        # Guarded: degraded decisions race in from EVERY serving loop
+        # while the recovery thread clears, and an unsynchronized
+        # check-then-set would double degraded_enter (or strand an
+        # exit inside a re-opened window) on a flapping owner.
+        self.degraded_since: Optional[float] = None
+        self._degraded_lock = threading.Lock()
 
 
 class _PeerDeltaSink:
@@ -933,6 +1177,7 @@ class PodFrontend:
         lane: PeerLane,
         global_namespaces=(),
         resilience: Optional[PodResilience] = None,
+        events_capacity: int = 512,
     ):
         self._limiter = limiter
         self.router = router
@@ -941,6 +1186,20 @@ class PodFrontend:
         self._inner_async = isinstance(limiter, AsyncRateLimiter)
         self._resilience = resilience or lane.cfg
         self._guards: Dict[int, _OwnerGuard] = {}
+        # Pod observability plane (ISSUE 12): the typed event timeline,
+        # the per-hop breakdown recorder and the federated signal
+        # aggregator — always on (bounded rings, off the decision
+        # path); the lane emits through the hooks below.
+        self.events = PodEventLog(
+            host_id=lane.host_id, capacity=events_capacity
+        )
+        self.hops = PodHopRecorder(host_id=lane.host_id)
+        self.aggregator = PodSignalAggregator(host_id=lane.host_id)
+        self.aggregator.local_fields = self.pod_signal_fields
+        lane.events = self.events
+        lane.on_hop = self._record_hop
+        lane.signals_provider = self.aggregator.local_payload
+        lane.on_peer_signals = self.aggregator.ingest
         if self._resilience.degraded:
             self._guards = {
                 owner: _OwnerGuard(owner, self._resilience)
@@ -948,6 +1207,10 @@ class PodFrontend:
             }
             lane.on_peer_recovered = self._peer_recovered
             lane.probe_needed = self._needs_recovery
+            for owner, guard in self._guards.items():
+                guard.breaker.listeners.append(
+                    self._breaker_listener(owner)
+                )
         lane.decide_cb = self._decide_for_peer
         # The owner side of a journal replay is unconditional: a
         # recovered host must accept its peers' journals even when its
@@ -962,9 +1225,91 @@ class PodFrontend:
     async def configure_with(self, limits) -> None:
         limits = list(limits)
         self.router.configure(limits, self._global_ns)
+        self.events.emit(
+            "routing_epoch", epoch=self.router.epoch, limits=len(limits)
+        )
         res = self._limiter.configure_with(limits)
         if inspect.isawaitable(res):
             await res
+
+    # -- pod observability plane (ISSUE 12) ----------------------------------
+
+    def _breaker_listener(self, owner: int):
+        """Per-owner breaker transition -> typed timeline event (the
+        breaker calls listeners OUTSIDE its lock)."""
+        kinds = {
+            BreakerState.OPEN: "breaker_open",
+            BreakerState.HALF_OPEN: "breaker_half_open",
+            BreakerState.CLOSED: "breaker_closed",
+        }
+
+        def on_transition(state: str) -> None:
+            kind = kinds.get(state)
+            if kind is not None:
+                self.events.emit(kind, owner=owner)
+
+        return on_transition
+
+    def _record_hop(
+        self, host, request_id, namespace, total_s, phases_s
+    ) -> None:
+        self.hops.record(request_id, host, namespace, total_s, phases_s)
+
+    def attach_flight(self, recorder) -> None:
+        """Wire the process flight recorder into BOTH hop directions:
+        the origin-side per-hop breakdown entries and the owner-side
+        forwarded-decide entries (every storage topology, not just the
+        batched ones that record through the contextvar)."""
+        self.hops.attach_flight(recorder)
+        self.lane.recorder = recorder
+
+    def attach_signal_bus(self, bus) -> None:
+        """Join the local ControlSignals bus into the federated view
+        (and the pod fields into the bus — both directions)."""
+        self.aggregator.local_signals = bus.snapshot
+        attach = getattr(bus, "attach_pod", None)
+        if callable(attach):
+            attach(self)
+
+    def pod_signal_fields(self) -> dict:
+        """The ControlSignals pod tail (ISSUE 12): this host's routed
+        share, peer health counts, and degraded share — cheap reads of
+        existing counters, safe from any thread."""
+        routed = self.router.stats()
+        total = (
+            routed["pod_routed_local"]
+            + routed["pod_routed_forwarded"]
+            + routed["pod_routed_pinned"]
+        )
+        states = self.lane.health.states()
+        degraded = sum(
+            guard.degraded_decisions for guard in self._guards.values()
+        )
+        gauge_counts = {0: 0, 1: 0, 2: 0}
+        for state in states.values():
+            gauge_counts[state] = gauge_counts.get(state, 0) + 1
+        return {
+            "pod_routed_share": round(
+                routed["pod_routed_local"] / total, 6
+            ) if total else 0.0,
+            "peers_up": gauge_counts[0],
+            "peers_suspect": gauge_counts[1],
+            "peers_down": gauge_counts[2],
+            "pod_degraded_share": round(
+                degraded / total, 6
+            ) if total else 0.0,
+        }
+
+    def pod_debug(self) -> dict:
+        """``GET /debug/pod``: per-host signal columns + rollups, plus
+        this host's hop breakdown summary."""
+        out = self.aggregator.pod_debug()
+        out["hops"] = self.hops.hop_debug()
+        return out
+
+    def events_debug(self, n=None, kind=None) -> dict:
+        """``GET /debug/events``: the typed pod event timeline."""
+        return self.events.events_debug(n=n, kind=kind)
 
     # -- routing helpers -----------------------------------------------------
 
@@ -1054,6 +1399,13 @@ class PodFrontend:
         """Decide against the owner's local stand-in (exact oracle +
         delta journal). Mirrors RateLimiter's storage-to-CheckResult
         shape so serving planes can't tell a degraded answer apart."""
+        entered = False
+        with guard._degraded_lock:
+            if guard.degraded_since is None:
+                guard.degraded_since = time.time()
+                entered = True
+        if entered:  # emit OUTSIDE the lock (lock-order hygiene)
+            self.events.emit("degraded_enter", owner=guard.owner)
         guard.degraded_decisions += 1
         if kind == "is_rate_limited":
             for counter in counters:
@@ -1097,6 +1449,10 @@ class PodFrontend:
             return True
         sink = _PeerDeltaSink(self.lane, owner)
         t0 = time.perf_counter()
+        self.events.emit(
+            "journal_replay_begin", owner=owner,
+            journal=guard.store.journal_size(),
+        )
         try:
             replayed = guard.store.reconcile_into(sink)
             # Requests that went degraded between the drain above and
@@ -1108,6 +1464,10 @@ class PodFrontend:
                 replayed += guard.store.reconcile_into(sink)
         except Exception as exc:
             guard.reconcile_seconds += time.perf_counter() - t0
+            self.events.emit(
+                "journal_replay_end", owner=owner, ok=False,
+                replayed=0, error=f"{exc}"[:200],
+            )
             log.warning(
                 f"pod host {owner}: journal replay failed, staying "
                 f"degraded: {exc}"
@@ -1122,6 +1482,17 @@ class PodFrontend:
         guard.reconcile_seconds += time.perf_counter() - t0
         guard.reconciles += 1
         guard.replayed_deltas += replayed
+        self.events.emit(
+            "journal_replay_end", owner=owner, ok=True, replayed=replayed
+        )
+        with guard._degraded_lock:
+            since, guard.degraded_since = guard.degraded_since, None
+        if since is not None:
+            self.events.emit(
+                "degraded_exit", owner=owner,
+                degraded_s=round(time.time() - since, 6),
+                decisions=guard.degraded_decisions,
+            )
         log.info(
             f"pod host {owner} recovered: replayed {replayed} journaled "
             "deltas, routing restored"
@@ -1228,6 +1599,12 @@ class PodFrontend:
         stats.update(self.router.stats())
         stats.update(self.lane.stats())
         stats.update(self.resilience_stats())
+        # pod observability plane (ISSUE 12): event counts (the
+        # pod_events{kind} family feed), the last sequence number, and
+        # the federated-signal gauges
+        stats["pod_events"] = self.events.counts()
+        stats["pod_event_seq"] = self.events.last_seq
+        stats.update(self.aggregator.stats())
         return stats
 
     def close_pod(self) -> None:

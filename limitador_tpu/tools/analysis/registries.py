@@ -30,6 +30,12 @@ REGISTRY_OWNED_PREFIXES = {
     "plan_cache_": "limitador_tpu/tpu/plan_cache.py",
     "peer_health_": "limitador_tpu/server/peering.py",
     "pod_": "limitador_tpu/routing.py",
+    # pod observability plane (ISSUE 12): hop breakdown + federated
+    # signals own pod_hop_/pod_signal_; the event timeline owns
+    # pod_event (covers pod_events + pod_event_seq)
+    "pod_hop_": "limitador_tpu/observability/pod_plane.py",
+    "pod_signal_": "limitador_tpu/observability/pod_plane.py",
+    "pod_event": "limitador_tpu/observability/events.py",
     "sharded_": "limitador_tpu/tpu/sharded.py",
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
     "native_lane_": "limitador_tpu/tpu/native_pipeline.py",

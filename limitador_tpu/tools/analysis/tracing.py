@@ -56,6 +56,12 @@ HOT_MODULES = (
     "limitador_tpu/native/ingress.py",
     "limitador_tpu/routing.py",
     "limitador_tpu/server/peering.py",
+    # pod observability plane (ISSUE 12): hop recording runs per
+    # forwarded decision and event emission inside the resilience
+    # paths — aggregation must stay off the decision path, so the
+    # no-sync/no-implicit-asarray rules watch these modules too.
+    "limitador_tpu/observability/pod_plane.py",
+    "limitador_tpu/observability/events.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

@@ -68,6 +68,9 @@ SERVER_ENV_VARS = frozenset({
     "TPU_POD_PEER_BREAKER_FAILURES", "TPU_POD_PEER_BREAKER_RESET_MS",
     "TPU_POD_PROBE_MS", "TPU_POD_FAULTS", "TPU_POD_FAULT_SEED",
     "TPU_POD_FAULT_DELAY_MS",
+    # pod observability plane (ISSUE 12): an ambient event-ring cap
+    # would silently reshape /debug/events assertions
+    "TPU_POD_EVENTS",
 })
 
 

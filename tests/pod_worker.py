@@ -221,10 +221,19 @@ def psum_check(mesh, info):
 
 
 def routed_drive(args, info):
-    """The routed-ingress parity drive (module docstring, step 3)."""
+    """The routed-ingress parity drive (module docstring, step 3),
+    plus the pod observability evidence (ISSUE 12): every drive step
+    carries a deterministic x-request-id, a flight recorder is
+    attached on both hop ends, and the worker exports its flight
+    snapshot, typed event timeline and federated GET /debug/pod
+    aggregate for the parent to assert on."""
     import jax
 
     from limitador_tpu import RateLimiter
+    from limitador_tpu.observability.device_plane import (
+        DeviceStatsRecorder,
+        set_request_id,
+    )
     from limitador_tpu.parallel import make_mesh, pod_barrier
     from limitador_tpu.routing import PodRouter, PodTopology
     from limitador_tpu.server.peering import PeerLane, PodFrontend
@@ -256,6 +265,10 @@ def routed_drive(args, info):
     )
     lane.start()
     frontend = PodFrontend(limiter, PodRouter(topology), lane)
+    recorder = DeviceStatsRecorder(flight_capacity=128)
+    frontend.attach_flight(recorder)
+
+    import time as _time
 
     loop = asyncio.new_event_loop()
     try:
@@ -269,6 +282,10 @@ def routed_drive(args, info):
         def decide(i, ns, ctx, arrival):
             if arrival != info.process_id:
                 return None
+            # Deterministic per-step request id: the parent asserts
+            # the SAME id shows up in BOTH hosts' flight recorders for
+            # forwarded steps (cross-host decision tracing, ISSUE 12).
+            set_request_id(f"drive-{i}")
             return loop.run_until_complete(
                 frontend.check_rate_limited_and_update(ns, ctx, 1, False)
             )
@@ -278,11 +295,25 @@ def routed_drive(args, info):
             end_of_step=lambda i: pod_barrier(f"pod-drive-{i}"),
         )
         pod_barrier("pod-drive-done")
+        # Federated signals ride the probe cadence (0.5s): give the
+        # exchange a moment so the exported pod view carries the
+        # peer's column, not just our own.
+        deadline = _time.time() + 10
+        while (
+            len(frontend.aggregator.peer_hosts())
+            < info.num_processes - 1
+            and _time.time() < deadline
+        ):
+            _time.sleep(0.1)
+        pod_barrier("pod-signals-settled")
         return {
             "decisions": decisions,
             "counters": counter_state(frontend),
             "router": frontend.router.stats(),
             "lane": frontend.lane.stats(),
+            "flight": recorder.flight.snapshot(),
+            "events": frontend.events_debug(),
+            "pod_debug": frontend.pod_debug(),
         }
     finally:
         lane.stop()

@@ -156,6 +156,26 @@ def test_dashboard_covers_pod_resilience_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_pod_observability_families():
+    """ISSUE 12: the pod observability plane ships WITH its Grafana row
+    — a "Pod observability" row exists and every pod_hop_* /
+    pod_event* / pod_signal_* family is referenced by at least one
+    panel expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("pod observability" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.observability.events import (
+        METRIC_FAMILIES as EVENT_FAMILIES,
+    )
+    from limitador_tpu.observability.pod_plane import (
+        METRIC_FAMILIES as POD_PLANE_FAMILIES,
+    )
+
+    for family in EVENT_FAMILIES + POD_PLANE_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()
@@ -168,7 +188,7 @@ def test_dashboard_metrics_all_exported():
                 continue
             # labels, not metrics
             if ident in ("limitador_namespace", "shard", "phase", "reason",
-                         "batcher", "priority", "state"):
+                         "batcher", "priority", "state", "kind", "peer"):
                 continue
             # identifiers followed by ( are function calls; filter by
             # checking against the metric-shaped remainder

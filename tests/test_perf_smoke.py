@@ -84,6 +84,22 @@ SIGNALS_RENDER_BUDGET_MS = 20.0
 #: keeps the tier-1 gate under 10 s — a pass that re-parses per rule
 #: or goes quadratic over the call graph blows it immediately.
 ANALYSIS_GATE_BUDGET_S = 10.0
+#: per-emit budget for the pod event timeline (µs): one lock + deque
+#: append + a counts bump. Measures well under 5 µs; the budget is the
+#: tripwire for someone sneaking I/O, metric renders or unbounded work
+#: into the emission path the resilience plane calls mid-incident
+#: (ISSUE 12 — event emission must stay off the decision path AND
+#: cheap on the failure path).
+POD_EVENT_EMIT_BUDGET_US = 25.0
+#: per-record budget for the forward hop breakdown (µs): four bucket
+#: increments + an optional flight-recorder offer. The forward it
+#: rides is a network hop (ms-scale), so the accounting must stay
+#: 2-3 orders of magnitude below it.
+POD_HOP_RECORD_BUDGET_US = 60.0
+#: per-ingest budget for a federated signal column (µs): dict store +
+#: a throttled rollup tick. Exchanges ride the probe cadence (2/s per
+#: peer), so this budget is about a pathological pod size, not rate.
+POD_SIGNAL_INGEST_BUDGET_US = 400.0
 
 
 def _blobs(n, users=512):
@@ -406,6 +422,81 @@ def test_tel_drain_within_budget():
     assert per_call_us <= TEL_DRAIN_BUDGET_US, (
         f"hp_tel_drain costs {per_call_us:.0f} µs/call "
         f"(budget {TEL_DRAIN_BUDGET_US} µs)"
+    )
+
+
+def test_pod_event_emission_within_budget():
+    """ISSUE 12: the pod event timeline is written from the lane loop
+    and recovery threads mid-incident — emission must stay a bounded
+    lock + append, amortized well under the decision budget."""
+    from limitador_tpu.observability.events import PodEventLog
+
+    log = PodEventLog(host_id=0, capacity=512)
+    log.emit("peer_up", peer=1)  # warm
+    n = 20_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            log.emit("peer_suspect", peer=1, error="x")
+        best = min(best, time.perf_counter() - t0)
+    per_emit_us = best / n * 1e6
+    assert per_emit_us <= POD_EVENT_EMIT_BUDGET_US, (
+        f"pod event emit costs {per_emit_us:.2f} µs "
+        f"(budget {POD_EVENT_EMIT_BUDGET_US} µs)"
+    )
+    assert log.last_seq == 1 + 3 * n  # nothing dropped, ring bounded
+
+
+def test_pod_hop_record_within_budget():
+    """ISSUE 12: the per-forward hop breakdown accounting must stay
+    orders of magnitude below the network hop it measures."""
+    from limitador_tpu.observability.pod_plane import PodHopRecorder
+
+    rec = PodHopRecorder(host_id=0)
+    phases = {
+        "queue": 1e-4, "serialize": 5e-5,
+        "wire": 2e-3, "remote_decide": 1e-3,
+    }
+    rec.record("rid", 1, "ns", 3.15e-3, phases)  # warm
+    n = 5_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _i in range(n):
+            rec.record("rid", 1, "ns", 3.15e-3, phases)
+        best = min(best, time.perf_counter() - t0)
+    per_record_us = best / n * 1e6
+    assert per_record_us <= POD_HOP_RECORD_BUDGET_US, (
+        f"pod hop record costs {per_record_us:.2f} µs "
+        f"(budget {POD_HOP_RECORD_BUDGET_US} µs)"
+    )
+
+
+def test_pod_signal_ingest_within_budget():
+    """ISSUE 12: ingesting a peer's federated signal column (lane
+    loop) must stay cheap — the rollup tick is timeline-throttled, so
+    the steady state is a dict store."""
+    from limitador_tpu.observability.pod_plane import PodSignalAggregator
+    from limitador_tpu.observability.signals import ControlSignals
+
+    agg = PodSignalAggregator(host_id=0)
+    payload = {
+        "host": 1, "ts": time.time(),
+        "signals": ControlSignals().to_dict(),
+    }
+    agg.ingest(1, payload)  # warm
+    n = 2_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _i in range(n):
+            agg.ingest(1, payload)
+        best = min(best, time.perf_counter() - t0)
+    per_ingest_us = best / n * 1e6
+    assert per_ingest_us <= POD_SIGNAL_INGEST_BUDGET_US, (
+        f"pod signal ingest costs {per_ingest_us:.2f} µs "
+        f"(budget {POD_SIGNAL_INGEST_BUDGET_US} µs)"
     )
 
 

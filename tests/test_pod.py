@@ -341,6 +341,98 @@ def test_forwarded_load_counters_build_headers():
             lane.stop()
 
 
+def test_forwarded_request_id_propagates_to_owner():
+    """Satellite regression (ISSUE 12): the origin's x-request-id
+    contextvar crosses the PeerLane hop in gRPC metadata and is
+    republished on the owner — its flight-recorder entries and spans
+    correlate with the originating request. Before this PR the id died
+    at the hop (zero propagation in peering.py)."""
+    from limitador_tpu import Context, Limit
+    from limitador_tpu.observability.device_plane import (
+        current_request_id,
+        set_request_id,
+    )
+
+    frontends, lanes = _two_host_frontends()
+    try:
+        limits = [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+        seen_on_owner = []
+        owner_cb = {}
+
+        def capture(owner_frontend):
+            inner = owner_frontend.lane.decide_cb
+
+            async def wrapped(ns, ctx, delta, load, kind):
+                seen_on_owner.append(current_request_id())
+                return await inner(ns, ctx, delta, load, kind)
+
+            return wrapped
+
+        for host, f in enumerate(frontends):
+            owner_cb[host] = capture(f)
+            f.lane.decide_cb = owner_cb[host]
+
+        async def scenario():
+            for f in frontends:
+                await f.configure_with(limits)
+            forwarded = 0
+            for i in range(200):
+                ctx = Context({"u": f"user-{i}"})
+                verdict, owner = frontends[0]._plan("fwd", ctx)
+                if verdict != FORWARD:
+                    continue
+                set_request_id(f"trace-{i}")
+                result = await frontends[0].check_rate_limited_and_update(
+                    "fwd", ctx, 1, False
+                )
+                assert result is not None
+                forwarded += 1
+                if forwarded == 3:
+                    return
+            raise AssertionError("not enough forwarded keys found")
+
+        asyncio.run(scenario())
+        assert len(seen_on_owner) == 3
+        # every owner-side decide saw the ORIGINATING id, verbatim
+        assert all(
+            rid is not None and rid.startswith("trace-")
+            for rid in seen_on_owner
+        )
+        assert len(set(seen_on_owner)) == 3  # per-request, not sticky
+        # and the owner offered flight entries carrying those ids when
+        # a recorder is attached (every storage topology, ISSUE 12)
+        from limitador_tpu.observability.device_plane import (
+            DeviceStatsRecorder,
+        )
+
+        recorder = DeviceStatsRecorder()
+        frontends[1].attach_flight(recorder)
+        seen_on_owner.clear()
+
+        async def one_more():
+            for i in range(200, 400):
+                ctx = Context({"u": f"user-{i}"})
+                verdict, owner = frontends[0]._plan("fwd", ctx)
+                if verdict == FORWARD and owner == 1:
+                    set_request_id(f"trace-{i}")
+                    await frontends[0].check_rate_limited_and_update(
+                        "fwd", ctx, 1, False
+                    )
+                    return f"trace-{i}"
+            raise AssertionError("no forwarded key found")
+
+        rid = asyncio.run(one_more())
+        entries = recorder.flight.snapshot()
+        assert any(
+            e["request_id"] == rid
+            and "pod_remote_decide" in e["phases_ms"]
+            for e in entries
+        ), entries
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
 # -- the real 2-process jax.distributed pod (slow) -----------------------------
 
 
@@ -423,6 +515,56 @@ def test_pod_psum_reads_remote_partials(pod_results):
     for result in pod_results:
         assert result["psum"]["round1_admitted"]
         assert result["psum"]["round2_rejected"]
+
+
+@pytest.mark.slow
+def test_pod_cross_host_tracing_and_federated_view(pod_results):
+    """ISSUE 12 acceptance, live 2-process pod: a forwarded decision
+    produces flight-recorder entries on BOTH hosts sharing one request
+    id — the origin's with a populated per-hop breakdown, the owner's
+    with its decide time — and GET /debug/pod serves per-host signal
+    columns with rollups on every host."""
+    flights = [
+        {
+            e["request_id"]: e for e in result["flight"]
+            if e.get("request_id")
+        }
+        for result in pod_results
+    ]
+    shared = [
+        (rid, host)
+        for host, flight in enumerate(flights)
+        for rid in flight
+        if rid in flights[1 - host]
+    ]
+    assert shared, "no request id crossed the hop into both recorders"
+    matched = 0
+    for rid, host in shared:
+        mine, theirs = flights[host][rid], flights[1 - host][rid]
+        # exactly one side is the origin (full four-phase breakdown),
+        # the other the owner (remote decide only)
+        origin = (
+            mine if "pod_wire" in mine["phases_ms"] else theirs
+        )
+        owner = theirs if origin is mine else mine
+        if "pod_wire" not in origin["phases_ms"]:
+            continue
+        matched += 1
+        for phase in ("pod_queue", "pod_serialize", "pod_wire",
+                      "pod_remote_decide"):
+            assert phase in origin["phases_ms"], origin
+        assert origin["phases_ms"]["pod_remote_decide"] > 0
+        assert owner["phases_ms"]["pod_remote_decide"] > 0
+    assert matched > 0
+    for result in pod_results:
+        pod = result["pod_debug"]
+        assert set(pod["hosts"]) == {"0", "1"}, pod["hosts"].keys()
+        assert "pod_routed_share" in pod["rollups"]
+        assert pod["exchanges"] >= 1
+        events = result["events"]
+        assert events["counts"]["routing_epoch"] >= 1
+        seqs = [e["seq"] for e in events["events"]]
+        assert seqs == sorted(seqs)
 
 
 @pytest.mark.slow

@@ -446,6 +446,42 @@ def test_degraded_failover_journal_and_recovery_replay():
         assert frontends[0].resilience_stats()[
             "pod_failover_degraded_decisions"
         ] == 4  # unchanged: that answer was a real forward
+
+        # ISSUE 12 acceptance: the full failover cycle appears on the
+        # typed event timeline (what GET /debug/events serves) in
+        # causal order, replay delta counts matching
+        events = frontends[0].events_debug()["events"]
+        by_kind = {}
+        for event in events:
+            by_kind.setdefault(event["kind"], event)  # first of kind
+        for kind in (
+            "degraded_enter", "journal_replay_begin",
+            "journal_replay_end", "degraded_exit", "breaker_open",
+            "breaker_closed",
+        ):
+            assert kind in by_kind, (kind, [e["kind"] for e in events])
+        seq = {k: e["seq"] for k, e in by_kind.items()}
+        assert (
+            seq["degraded_enter"] < seq["journal_replay_begin"]
+            < seq["journal_replay_end"] < seq["degraded_exit"]
+        ), seq
+        # the breaker closes INSIDE the replay window (probe_succeeded
+        # between the initial drain and the tail re-drain); it opened
+        # after degraded_enter (the first failed forward degrades
+        # before the consecutive-failure threshold trips the breaker)
+        assert (
+            seq["journal_replay_begin"] < seq["breaker_closed"]
+            < seq["journal_replay_end"]
+        ), seq
+        begin = by_kind["journal_replay_begin"]["detail"]
+        end = by_kind["journal_replay_end"]["detail"]
+        assert begin["journal"] == 1 and end["replayed"] == 1
+        assert end["ok"] is True
+        # the counts family agrees with the ring
+        counts = frontends[0].events.counts()
+        assert counts["degraded_enter"] == 1
+        assert counts["degraded_exit"] == 1
+        assert counts["peer_suspect"] >= 1  # the lane saw the outage
     finally:
         for lane in lanes[:1] + restarted:
             lane.stop()
@@ -709,6 +745,25 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
         # degraded-window delta
         assert stats["pod_failover_replayed_deltas"] == len(owned)
         assert stats["pod_failover_seconds"] > 0
+
+        # ISSUE 12: the drill's whole failover cycle is on the typed
+        # event timeline in causal order, replay counts matching the
+        # journaled counter set
+        events = frontend.events_debug()["events"]
+        first = {}
+        for event in events:
+            first.setdefault(event["kind"], event)
+        seq = {k: e["seq"] for k, e in first.items()}
+        assert (
+            seq["degraded_enter"] < seq["journal_replay_begin"]
+            < seq["journal_replay_end"] < seq["degraded_exit"]
+        ), seq
+        assert first["journal_replay_begin"]["detail"]["journal"] == len(
+            owned
+        )
+        assert first["journal_replay_end"]["detail"]["replayed"] == len(
+            owned
+        )
 
         # phase C (recovered): the owner now enforces the replayed
         # window — every forwarded check is limited, served by the

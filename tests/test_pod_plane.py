@@ -1,0 +1,649 @@
+"""Pod observability plane (ISSUE 12): the typed event timeline, the
+per-hop forward breakdown, the federated signal aggregator, the
+ControlSignals pod tail, and their metrics/HTTP surfaces.
+
+The cross-host halves (request-id propagation over a real gRPC hop,
+the failover cycle's causal event order) live in tests/test_pod.py and
+tests/test_pod_chaos.py next to the machinery they exercise.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from limitador_tpu.observability.events import (
+    EVENT_KINDS,
+    PodEventLog,
+    merge_events,
+)
+from limitador_tpu.observability.pod_plane import (
+    HOP_PHASES,
+    PodHopRecorder,
+    PodSignalAggregator,
+)
+from limitador_tpu.observability.signals import ControlSignals, SignalBus
+
+
+class _Clock:
+    def __init__(self, now=1_700_000_000.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+# -- the event timeline --------------------------------------------------------
+
+
+def test_event_log_sequences_and_bounds():
+    log = PodEventLog(host_id=3, capacity=4)
+    seqs = [log.emit("peer_up", peer=1) for _ in range(6)]
+    assert seqs == [1, 2, 3, 4, 5, 6]  # monotonic, never reused
+    events = log.snapshot()
+    assert len(events) == 4  # ring bound
+    assert [e["seq"] for e in events] == [3, 4, 5, 6]
+    assert all(e["host"] == 3 for e in events)
+    # counts survive ring eviction — the pod_events family is exact
+    assert log.counts()["peer_up"] == 6
+    payload = log.events_debug(n=2)
+    assert payload["last_seq"] == 6
+    assert [e["seq"] for e in payload["events"]] == [5, 6]
+
+
+def test_event_log_kind_filter_and_detail():
+    log = PodEventLog(host_id=0)
+    log.emit("degraded_enter", owner=1)
+    log.emit("journal_replay_begin", owner=1, journal=7)
+    log.emit("journal_replay_end", owner=1, ok=True, replayed=7)
+    only = log.snapshot(kind="journal_replay_begin")
+    assert len(only) == 1
+    assert only[0]["detail"] == {"owner": 1, "journal": 7}
+    assert set(log.counts()) >= set(EVENT_KINDS)
+
+
+def test_event_log_ts_is_monotonic_per_host():
+    """A wall-clock step backwards must not let a later event sort
+    before an earlier one — the (ts, host, seq) merge key depends on
+    per-host non-decreasing stamps."""
+    clock = _Clock()
+    log = PodEventLog(host_id=0, clock=clock)
+    log.emit("peer_up", peer=1)
+    clock.now -= 100.0  # NTP step
+    log.emit("peer_down", peer=1)
+    a, b = log.snapshot()
+    assert b["ts"] >= a["ts"]
+
+
+def test_event_log_n_zero_returns_nothing():
+    """?n=0 must trim to ZERO events — items[-0:] is the whole ring,
+    the opposite of the contract (code-review regression)."""
+    log = PodEventLog(host_id=0)
+    for _ in range(3):
+        log.emit("peer_up", peer=1)
+    assert log.snapshot(n=0) == []
+    assert log.events_debug(n=0)["events"] == []
+    assert log.snapshot(n=-1) == []
+
+
+def test_wire_request_id_sanitizes_client_bytes():
+    """The contextvar id originates from an UNVALIDATED client header;
+    gRPC rejects non-printable/non-ASCII metadata values at call time,
+    which would fail the forward and poison peer health for a healthy
+    peer (code-review regression). Non-conforming characters drop,
+    empty results stay off the wire."""
+    from limitador_tpu.server.peering import _wire_request_id
+
+    assert _wire_request_id("req-42") == "req-42"
+    assert _wire_request_id(None) is None
+    assert _wire_request_id("") is None
+    assert _wire_request_id("café-7") == "caf-7"
+    assert _wire_request_id("a\x00b\nc") == "abc"
+    assert _wire_request_id("é\x7f") is None
+    assert len(_wire_request_id("x" * 500)) == 128
+
+
+def test_forward_survives_hostile_request_id():
+    """End to end: a forwarded decision whose contextvar id carries
+    non-ASCII bytes must still succeed (sanitized on the wire), not
+    fail the hop and trip the owner's health."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.observability.device_plane import set_request_id
+    from limitador_tpu.routing import FORWARD, PodRouter, PodTopology
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    ports = [_free_port(), _free_port()]
+    lanes, frontends = [], []
+    try:
+        for host in range(2):
+            lane = PeerLane(
+                host,
+                f"127.0.0.1:{ports[host]}",
+                {1 - host: f"127.0.0.1:{ports[1 - host]}"},
+                None,
+            )
+            lane.start()
+            lanes.append(lane)
+            frontends.append(PodFrontend(
+                RateLimiter(InMemoryStorage(64)),
+                PodRouter(
+                    PodTopology(hosts=2, host_id=host, shards_per_host=1)
+                ),
+                lane,
+            ))
+        limits = [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+
+        async def scenario():
+            for f in frontends:
+                await f.configure_with(limits)
+            for i in range(200):
+                ctx = Context({"u": f"user-{i}"})
+                if frontends[0]._plan("fwd", ctx) == (FORWARD, 1):
+                    set_request_id("café-\x01-evil☃")
+                    return await frontends[0].check_rate_limited_and_update(
+                        "fwd", ctx, 1, False
+                    )
+            raise AssertionError("no forwarded key found")
+
+        result = asyncio.run(scenario())
+        assert result.limited is False
+        assert lanes[0].stats()["pod_peer_errors"] == 0
+        assert lanes[0].health.state(1) == "up"
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_local_payload_is_cached_per_cadence_round():
+    """One SignalBus sweep per exchange round, not per peer/direction
+    (code-review regression): the snapshot cost and the bus ring's
+    append cadence must not scale with pod size."""
+    clock = _Clock()
+    agg = PodSignalAggregator(host_id=0, clock=clock)
+    calls = []
+    agg.local_signals = lambda: calls.append(1) or ControlSignals()
+    first = agg.local_payload()
+    for _ in range(10):  # the whole round reuses the built column
+        assert agg.local_payload() is first
+    assert len(calls) == 1
+    clock.now += 1.0  # next cadence round rebuilds
+    assert agg.local_payload() is not first
+    assert len(calls) == 2
+
+
+def test_local_payload_skips_redundant_pod_fields():
+    """When the bus snapshot already joined the pod tail (attach_pod),
+    local_fields must not recompute it."""
+    clock = _Clock()
+    agg = PodSignalAggregator(host_id=0, clock=clock)
+    agg.local_signals = lambda: ControlSignals(pod_routed_share=0.5)
+    fields_calls = []
+    agg.local_fields = lambda: fields_calls.append(1) or {
+        "pod_routed_share": 0.9
+    }
+    payload = agg.local_payload()
+    assert payload["signals"]["pod_routed_share"] == 0.5
+    assert not fields_calls
+
+
+def test_merge_events_is_causal_per_host():
+    clock0, clock1 = _Clock(100.0), _Clock(100.05)
+    log0 = PodEventLog(host_id=0, clock=clock0)
+    log1 = PodEventLog(host_id=1, clock=clock1)
+    log0.emit("degraded_enter", owner=1)
+    clock1.now += 1
+    log1.emit("peer_down", peer=0)
+    clock0.now += 2
+    log0.emit("degraded_exit", owner=1)
+    merged = merge_events(log0.snapshot(), log1.snapshot())
+    kinds = [e["kind"] for e in merged]
+    assert kinds == ["degraded_enter", "peer_down", "degraded_exit"]
+    # within host 0, seq order survived the interleave
+    host0 = [e["seq"] for e in merged if e["host"] == 0]
+    assert host0 == sorted(host0)
+
+
+# -- the hop recorder ----------------------------------------------------------
+
+
+def _phases(queue=1e-4, serialize=5e-5, wire=2e-3, remote=1e-3):
+    return {
+        "queue": queue, "serialize": serialize,
+        "wire": wire, "remote_decide": remote,
+    }
+
+
+def test_hop_recorder_debug_summary():
+    rec = PodHopRecorder(host_id=0)
+    for _ in range(10):
+        rec.record("rid", 1, "ns", 3.15e-3, _phases())
+    debug = rec.hop_debug()
+    assert debug["forwards_recorded"] == 10
+    for phase in HOP_PHASES:
+        assert debug["phases"][phase]["count"] == 10
+    # log2 buckets: p99 is the bucket upper edge containing the value
+    assert debug["phases"]["wire"]["p99_ms"] == pytest.approx(2.048)
+    assert debug["phases"]["remote_decide"]["mean_ms"] == pytest.approx(
+        1.0
+    )
+
+
+def test_hop_recorder_feeds_prometheus_histogram():
+    from limitador_tpu.observability import PrometheusMetrics
+
+    metrics = PrometheusMetrics()
+    rec = PodHopRecorder(host_id=0)
+    for _ in range(5):
+        rec.record(None, 1, None, 3.15e-3, _phases())
+    rec.poll(metrics)
+    text = metrics.render().decode()
+    assert 'pod_hop_phase_ms_count{phase="wire"} 5.0' in text
+    # 2ms wire lands in the (1.024, 2.048] bucket
+    assert 'pod_hop_phase_ms_bucket{le="2.048",phase="wire"} 5.0' in text
+    assert 'pod_hop_phase_ms_bucket{le="1.024",phase="wire"} 0.0' in text
+    # second poll with no new records must not double-count
+    rec.poll(metrics)
+    text = metrics.render().decode()
+    assert 'pod_hop_phase_ms_count{phase="wire"} 5.0' in text
+
+
+def test_hop_recorder_offers_flight_entries():
+    from limitador_tpu.observability.device_plane import FlightRecorder
+
+    rec = PodHopRecorder(host_id=0)
+    flight = FlightRecorder(capacity=4)
+    rec.attach_flight(flight)
+    rec.record("req-9", 1, "api", 3.15e-3, _phases())
+    entries = flight.snapshot()
+    assert len(entries) == 1
+    entry = entries[0]
+    assert entry["request_id"] == "req-9"
+    assert entry["namespace"] == "api"
+    assert entry["pod_hop"] == {"owner": 1, "host": 0}
+    for phase in HOP_PHASES:
+        assert f"pod_{phase}" in entry["phases_ms"]
+    assert entry["phases_ms"]["pod_remote_decide"] == pytest.approx(1.0)
+
+
+# -- the federated signal aggregator -------------------------------------------
+
+
+def _column(host, clock, **pod_fields):
+    signals = ControlSignals(**pod_fields).to_dict()
+    return {"host": host, "ts": clock(), "signals": signals}
+
+
+def test_aggregator_joins_columns_with_rollups():
+    clock = _Clock()
+    agg = PodSignalAggregator(host_id=0, clock=clock)
+    agg.local_fields = lambda: {
+        "pod_routed_share": 0.8, "peers_up": 1, "peers_suspect": 0,
+        "peers_down": 0, "pod_degraded_share": 0.0,
+    }
+    agg.ingest(1, _column(
+        1, clock, pod_routed_share=0.4, peers_up=1, pod_degraded_share=0.2,
+    ))
+    debug = agg.pod_debug()
+    assert set(debug["hosts"]) == {"0", "1"}
+    assert debug["ages_s"]["0"] == 0.0
+    roll = debug["rollups"]["pod_routed_share"]
+    assert roll["min"] == 0.4 and roll["max"] == 0.8
+    assert roll["mean"] == pytest.approx(0.6)
+    assert debug["rollups"]["peers_up"]["sum"] == 2
+    # strings never roll up
+    assert "top_namespace" not in debug["rollups"]
+    assert debug["exchanges"] == 1
+    assert debug["timeline"], "ingest ticks the rollup timeline"
+
+
+def test_aggregator_staleness_and_stats():
+    clock = _Clock()
+    agg = PodSignalAggregator(host_id=0, clock=clock)
+    agg.local_fields = lambda: {
+        "pod_routed_share": 0.5, "pod_degraded_share": 0.25,
+    }
+    agg.ingest(1, _column(1, clock))
+    stats = agg.stats()
+    assert stats["pod_signal_hosts"] == 2
+    assert stats["pod_signal_exchanges"] == 1
+    assert stats["pod_signal_routed_share"] == 0.5
+    assert stats["pod_signal_degraded_share"] == 0.25
+    clock.now += 60  # the peer goes silent
+    stats = agg.stats()
+    assert stats["pod_signal_hosts"] == 1  # stale column dropped
+    assert stats["pod_signal_age_s"] == pytest.approx(60.0)
+    # ...but the column is still SERVED, age attached
+    debug = agg.pod_debug()
+    assert debug["ages_s"]["1"] == pytest.approx(60.0)
+
+
+# -- the ControlSignals pod tail -----------------------------------------------
+
+
+def test_control_signals_field_order_is_pinned():
+    """Satellite (ISSUE 12): the observation vector's field order is
+    the adaptive controller's input contract — pod fields append at
+    the END and nothing ever reshuffles. This test IS the pin."""
+    assert ControlSignals.FIELDS == (
+        "ts",
+        "queue_wait_ms",
+        "batch_fill",
+        "breaker_state",
+        "shed_rate_by_priority",
+        "lease_outstanding_tokens",
+        "native_phase_p99_us",
+        "slo_burn_5m",
+        "slo_burn_1h",
+        "slo_breached",
+        "box_calibration_score",
+        "device_backed",
+        "top_namespace",
+        "near_exhaustion",
+        "pod_routed_share",
+        "peers_up",
+        "peers_suspect",
+        "peers_down",
+        "pod_degraded_share",
+    )
+
+
+def test_control_signals_vector_order_is_pinned():
+    s = ControlSignals(
+        ts=1.0, queue_wait_ms=2.0, batch_fill=0.5, breaker_state=1,
+        shed_rate_by_priority={
+            "low": 1.0, "normal": 2.0, "high": 3.0, "critical": 4.0,
+        },
+        lease_outstanding_tokens=7,
+        native_phase_p99_us={
+            "hot_lookup": 10.0, "hot_stage": 11.0, "lease_hit": 12.0,
+            "hot_finish": 13.0, "h2i_respond": 14.0,
+        },
+        slo_burn_5m=0.1, slo_burn_1h=0.2, slo_breached=1,
+        box_calibration_score=27.5, device_backed=1, near_exhaustion=3,
+        pod_routed_share=0.75, peers_up=2, peers_suspect=1,
+        peers_down=1, pod_degraded_share=0.125,
+    )
+    assert s.vector() == [
+        1.0, 2.0, 0.5, 1.0,              # ts, queue, fill, breaker
+        1.0, 2.0, 3.0, 4.0,              # sheds in _PRIORITIES order
+        7.0,                             # lease outstanding
+        10.0, 11.0, 12.0, 13.0, 14.0,    # native p99s in _PHASES order
+        0.1, 0.2, 1.0, 27.5, 1.0, 3.0,   # slo/box/device/near
+        0.75, 2.0, 1.0, 1.0, 0.125,      # the pod tail, appended LAST
+    ]
+
+
+def test_signal_bus_joins_pod_fields():
+    class Pod:
+        def pod_signal_fields(self):
+            return {
+                "pod_routed_share": 0.9, "peers_up": 3,
+                "peers_suspect": 0, "peers_down": 1,
+                "pod_degraded_share": 0.05,
+            }
+
+    bus = SignalBus()
+    bus.attach_pod(Pod())
+    snap = bus.snapshot()
+    assert snap.pod_routed_share == 0.9
+    assert snap.peers_down == 1
+    assert snap.vector()[-5:] == [0.9, 3.0, 0.0, 1.0, 0.05]
+    # without a pod the tail stays at neutral defaults (same schema)
+    bare = SignalBus().snapshot()
+    assert bare.vector()[-5:] == [0.0, 0.0, 0.0, 0.0, 0.0]
+
+
+# -- metrics + HTTP surfaces ---------------------------------------------------
+
+
+def test_pod_plane_families_render_from_library_stats():
+    from limitador_tpu.observability import PrometheusMetrics
+
+    class Source:
+        def __init__(self):
+            self.events = dict.fromkeys(EVENT_KINDS, 0)
+            self.events["degraded_enter"] = 2
+            self.events["hedge_won"] = 1
+
+        def library_stats(self):
+            return {
+                "pod_events": dict(self.events),
+                "pod_event_seq": 17,
+                "pod_signal_hosts": 2,
+                "pod_signal_exchanges": 9,
+                "pod_signal_age_s": 0.4,
+                "pod_signal_routed_share": 0.7,
+                "pod_signal_degraded_share": 0.1,
+            }
+
+    metrics = PrometheusMetrics()
+    metrics.attach_library_source(Source())
+    text = metrics.render().decode()
+    assert 'pod_events_total{kind="degraded_enter"} 2.0' in text
+    assert 'pod_events_total{kind="hedge_won"} 1.0' in text
+    # pre-seeded kinds render at zero before their first emission
+    assert 'pod_events_total{kind="breaker_open"} 0.0' in text
+    assert "pod_event_seq 17.0" in text
+    assert "pod_signal_hosts 2.0" in text
+    assert "pod_signal_exchanges_total 9.0" in text
+    assert "pod_signal_age_s 0.4" in text
+    assert "pod_signal_routed_share 0.7" in text
+    assert "pod_signal_degraded_share 0.1" in text
+    # second render: cumulative counters must not double-count
+    text = metrics.render().decode()
+    assert 'pod_events_total{kind="degraded_enter"} 2.0' in text
+    assert "pod_signal_exchanges_total 9.0" in text
+
+
+def test_debug_pod_and_events_endpoints():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    class PodLimiter(RateLimiter):
+        """A limiter wearing the pod frontend's debug surface."""
+
+        def __init__(self):
+            super().__init__()
+            self.log = PodEventLog(host_id=0)
+            self.log.emit("degraded_enter", owner=1)
+            self.log.emit("degraded_exit", owner=1)
+            agg = PodSignalAggregator(host_id=0)
+            agg.local_fields = lambda: {"pod_routed_share": 1.0}
+            self.agg = agg
+
+        def pod_debug(self):
+            return self.agg.pod_debug()
+
+        def events_debug(self, n=None, kind=None):
+            return self.log.events_debug(n=n, kind=kind)
+
+    async def main(limiter):
+        app = make_http_app(limiter, None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            pod = await client.get("/debug/pod")
+            events = await client.get("/debug/events")
+            trimmed = await (
+                await client.get("/debug/events?n=1")
+            ).json()
+            filtered = await (
+                await client.get("/debug/events?kind=degraded_exit")
+            ).json()
+            bad = (await client.get("/debug/events?n=x")).status
+            stats = await (await client.get("/debug/stats")).json()
+            return (
+                pod.status, await pod.json(), events.status,
+                await events.json(), trimmed, filtered, bad, stats,
+            )
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        (
+            pod_status, pod, ev_status, events, trimmed, filtered, bad,
+            stats,
+        ) = loop.run_until_complete(main(PodLimiter()))
+    finally:
+        loop.close()
+    assert pod_status == 200
+    assert pod["hosts"]["0"]["pod_routed_share"] == 1.0
+    assert "rollups" in pod
+    assert ev_status == 200
+    assert [e["kind"] for e in events["events"]] == [
+        "degraded_enter", "degraded_exit",
+    ]
+    assert len(trimmed["events"]) == 1
+    assert [e["kind"] for e in filtered["events"]] == ["degraded_exit"]
+    assert bad == 400
+    assert "pod" in stats and "pod_events" in stats
+
+    # a plain single-host limiter 404s both endpoints
+    async def plain():
+        app = make_http_app(RateLimiter(), None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            return (
+                (await client.get("/debug/pod")).status,
+                (await client.get("/debug/events")).status,
+            )
+        finally:
+            await client.close()
+
+    loop = asyncio.new_event_loop()
+    try:
+        pod_status, ev_status = loop.run_until_complete(plain())
+    finally:
+        loop.close()
+    assert pod_status == 404 and ev_status == 404
+
+
+# -- in-process pod: hop breakdown + exchange over real gRPC -------------------
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_frontend_records_hop_breakdown_over_real_lane():
+    """A forwarded decision populates all four hop phases on the
+    origin, with remote_decide reported by the owner (not derived)."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.routing import FORWARD, PodRouter, PodTopology
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    ports = [_free_port(), _free_port()]
+    lanes, frontends = [], []
+    try:
+        for host in range(2):
+            lane = PeerLane(
+                host,
+                f"127.0.0.1:{ports[host]}",
+                {1 - host: f"127.0.0.1:{ports[1 - host]}"},
+                None,
+            )
+            lane.start()
+            lanes.append(lane)
+            frontends.append(PodFrontend(
+                RateLimiter(InMemoryStorage(256)),
+                PodRouter(
+                    PodTopology(hosts=2, host_id=host, shards_per_host=1)
+                ),
+                lane,
+            ))
+        limits = [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+
+        async def scenario():
+            for f in frontends:
+                await f.configure_with(limits)
+            for i in range(200):
+                ctx = Context({"u": f"user-{i}"})
+                if frontends[0]._plan("fwd", ctx) == (FORWARD, 1):
+                    await frontends[0].check_rate_limited_and_update(
+                        "fwd", ctx, 1, False
+                    )
+                    return
+            raise AssertionError("no forwarded key found")
+
+        asyncio.run(scenario())
+        debug = frontends[0].hops.hop_debug()
+        assert debug["forwards_recorded"] == 1
+        for phase in HOP_PHASES:
+            assert debug["phases"][phase]["count"] == 1
+        assert debug["phases"]["remote_decide"]["mean_ms"] > 0
+        # the owner recorded nothing (it decided locally)
+        assert frontends[1].hops.hop_debug()["forwards_recorded"] == 0
+        # routing_epoch from configure_with landed on both timelines
+        for f in frontends:
+            assert f.events.counts()["routing_epoch"] == 1
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_signal_exchange_rides_probe_cadence():
+    """Federated columns cross the lane without any decision traffic:
+    within a few probe intervals each host holds the other's column
+    and GET /debug/pod rolls them up."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    cfg = PodResilience(probe_interval_s=0.05)
+    ports = [_free_port(), _free_port()]
+    lanes, frontends = [], []
+    try:
+        for host in range(2):
+            lane = PeerLane(
+                host,
+                f"127.0.0.1:{ports[host]}",
+                {1 - host: f"127.0.0.1:{ports[1 - host]}"},
+                None,
+                resilience=cfg,
+            )
+            lanes.append(lane)
+            frontends.append(PodFrontend(
+                RateLimiter(InMemoryStorage(64)),
+                PodRouter(
+                    PodTopology(hosts=2, host_id=host, shards_per_host=1)
+                ),
+                lane,
+                resilience=cfg,
+            ))
+        for lane in lanes:
+            lane.start()
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if all(f.aggregator.peer_hosts() for f in frontends):
+                break
+            time.sleep(0.05)
+        for i, f in enumerate(frontends):
+            assert f.aggregator.peer_hosts() == [1 - i]
+            debug = f.pod_debug()
+            assert set(debug["hosts"]) == {"0", "1"}
+            assert "pod_routed_share" in debug["rollups"]
+            assert debug["hosts"][str(1 - i)]["peers_up"] >= 0
+            stats = f.library_stats()
+            assert stats["pod_signal_hosts"] == 2
+            assert stats["pod_signal_exchanges"] >= 1
+    finally:
+        for lane in lanes:
+            lane.stop()
