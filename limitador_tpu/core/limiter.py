@@ -125,10 +125,16 @@ class RateLimiter:
     # -- checks ------------------------------------------------------------
 
     def is_rate_limited(
-        self, namespace: Union[str, Namespace], ctx: Context, delta: int
+        self, namespace: Union[str, Namespace], ctx: Context, delta: int,
+        counters: Optional[List[Counter]] = None,
     ) -> CheckResult:
-        """Read-only check (lib.rs:362-385)."""
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        """Read-only check (lib.rs:362-385). ``counters`` short-circuits
+        limit matching with a precomputed set (the pod frontend matched
+        once at routing time — ISSUE 13's single-matching contract)."""
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         with datastore_span("is_within_limits"):
             for counter in counters:
                 if not self.storage.is_within_limits(counter, delta):
@@ -136,9 +142,13 @@ class RateLimiter:
         return CheckResult(False, [], None)
 
     def update_counters(
-        self, namespace: Union[str, Namespace], ctx: Context, delta: int
+        self, namespace: Union[str, Namespace], ctx: Context, delta: int,
+        counters: Optional[List[Counter]] = None,
     ) -> None:
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         with datastore_span("update_counter"):
             for counter in counters:
                 self.storage.update_counter(counter, delta)
@@ -149,9 +159,15 @@ class RateLimiter:
         ctx: Context,
         delta: int,
         load_counters: bool = False,
+        counters: Optional[List[Counter]] = None,
     ) -> CheckResult:
-        """THE hot path: check-and-update in one storage call (lib.rs:425-464)."""
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        """THE hot path: check-and-update in one storage call
+        (lib.rs:425-464). ``counters`` short-circuits matching with a
+        precomputed set (single-matching contract, ISSUE 13)."""
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         if not counters:
             return CheckResult(False, counters, None)
         with datastore_span("check_and_update"):
@@ -214,9 +230,13 @@ class AsyncRateLimiter:
         await self.storage.delete_limits(Namespace.of(namespace))
 
     async def is_rate_limited(
-        self, namespace: Union[str, Namespace], ctx: Context, delta: int
+        self, namespace: Union[str, Namespace], ctx: Context, delta: int,
+        counters: Optional[List[Counter]] = None,
     ) -> CheckResult:
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         with datastore_span("is_within_limits"):
             for counter in counters:
                 if not await self.storage.is_within_limits(counter, delta):
@@ -224,9 +244,13 @@ class AsyncRateLimiter:
         return CheckResult(False, [], None)
 
     async def update_counters(
-        self, namespace: Union[str, Namespace], ctx: Context, delta: int
+        self, namespace: Union[str, Namespace], ctx: Context, delta: int,
+        counters: Optional[List[Counter]] = None,
     ) -> None:
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         with datastore_span("update_counter"):
             for counter in counters:
                 await self.storage.update_counter(counter, delta)
@@ -237,8 +261,12 @@ class AsyncRateLimiter:
         ctx: Context,
         delta: int,
         load_counters: bool = False,
+        counters: Optional[List[Counter]] = None,
     ) -> CheckResult:
-        counters = _counters_that_apply(self.storage, Namespace.of(namespace), ctx)
+        if counters is None:
+            counters = _counters_that_apply(
+                self.storage, Namespace.of(namespace), ctx
+            )
         if not counters:
             return CheckResult(False, counters, None)
         with datastore_span("check_and_update"):

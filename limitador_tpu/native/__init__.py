@@ -46,6 +46,10 @@ __all__ = [
     "LANE_UNKNOWN",
     "LANE_OVER",
     "LANE_ERROR",
+    "LANE_FOREIGN",
+    "LANE_FOREIGN_BASE",
+    "pod_available",
+    "pod_hash",
     "TEL_PHASES",
     "TEL_BUCKETS",
 ]
@@ -57,6 +61,11 @@ LANE_OK = 2
 LANE_UNKNOWN = 3
 LANE_OVER = 4
 LANE_ERROR = 5
+#: plan kind of a foreign-owned blob in the C mirror (never a row code)
+LANE_FOREIGN = 6
+#: a begin answers a foreign-owned row as LANE_FOREIGN_BASE + owner —
+#: codes >= this are bulk-forward verdicts, not local outcomes
+LANE_FOREIGN_BASE = 8
 
 _INT32_MAX = (1 << 31) - 1
 
@@ -122,6 +131,27 @@ def _bind(lib) -> None:
         ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
         ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
         ctypes.c_void_p, ctypes.c_int32,
+    ]
+    # -- pod ownership mirror (ISSUE 13): crc32 verdict + plan stamps --
+    lib.hp_pod_hash.restype = ctypes.c_int64
+    lib.hp_pod_hash.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+    lib.hp_pod_config.restype = ctypes.c_int32
+    lib.hp_pod_config.argtypes = [
+        ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+    ]
+    lib.hp_pod_owner.restype = ctypes.c_int32
+    lib.hp_pod_owner.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32,
+    ]
+    lib.hp_plan_stamp_owner.restype = ctypes.c_int32
+    lib.hp_plan_stamp_owner.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_char_p, ctypes.c_int32,
+    ]
+    lib.hp_plan_set_owner.restype = ctypes.c_int32
+    lib.hp_plan_set_owner.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32,
     ]
     lib.hp_plan_invalidate_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hp_plan_count.restype = ctypes.c_int64
@@ -236,6 +266,23 @@ def lease_available() -> bool:
     old pre-stamped binary without them serves without the lease tier)."""
     lib = _load()
     return lib is not None and hasattr(lib, "hp_lease_grant")
+
+
+def pod_available() -> bool:
+    """True when the loaded library exports the pod ownership mirror
+    (an old pre-stamped binary without it cannot serve the shard-aware
+    hot lane — pod mode then falls back to the routed compiled plane)."""
+    lib = _load()
+    return lib is not None and hasattr(lib, "hp_pod_config")
+
+
+def pod_hash(data: bytes) -> int:
+    """The C-side crc32 over raw bytes (== zlib.crc32 — the parity-fuzz
+    anchor for routing.stable_hash's mirror)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "hp_pod_hash"):
+        raise RuntimeError("native pod ownership mirror unavailable")
+    return lib.hp_pod_hash(data, len(data))
 
 
 def loaded():
@@ -444,12 +491,37 @@ class HostPath:
         return self._lib.hp_plan_count(self._ctx)
 
     def lane_stats(self) -> dict:
-        out = np.zeros(8, np.int64)
+        out = np.zeros(9, np.int64)
         if self._ctx:  # zeros after close (interner recycle)
             self._lib.hp_lane_stats(self._ctx, out.ctypes.data)
         keys = ("hits", "misses", "staged_hits", "insertions",
-                "invalidations", "overflows", "plans", "epoch")
+                "invalidations", "overflows", "plans", "epoch", "foreign")
         return dict(zip(keys, out.tolist()))
+
+    # -- pod ownership mirror (ISSUE 13) -------------------------------------
+
+    def pod_config(self, hosts: int, host_id: int,
+                   shards_per_host: int) -> None:
+        """Arm the foreign split: begins classify plans stamped with a
+        non-local owner as LANE_FOREIGN_BASE + owner instead of staging
+        them. hosts <= 1 keeps the single-host posture byte-identical.
+        Raises when the topology exceeds the int8 lane-code encoding
+        (owner > 127 - LANE_FOREIGN_BASE); callers fall back to the
+        routed compiled plane rather than mis-route."""
+        rc = self._lib.hp_pod_config(
+            self._ctx, int(hosts), int(host_id), int(shards_per_host)
+        )
+        if rc != 0:
+            raise RuntimeError(
+                f"pod topology of {hosts} hosts exceeds the native "
+                "lane's int8 owner encoding (max "
+                f"{128 - LANE_FOREIGN_BASE} hosts)"
+            )
+
+    def pod_owner(self, key_repr: bytes) -> int:
+        """Owner host of one counter key's repr bytes under the armed
+        topology (== routing.PodTopology.owner_host; parity-fuzzed)."""
+        return self._lib.hp_pod_owner(self._ctx, key_repr, len(key_repr))
 
     # -- slot map -----------------------------------------------------------
 
@@ -483,12 +555,12 @@ class HotStaged:
     __slots__ = (
         "codes", "k", "nhits", "H", "rows", "row_nhits", "row_delta",
         "row_ns", "hit_names", "ok_aggr", "fill_results", "leased_rows",
-        "lookup_ns", "stage_ns", "trace_id",
+        "lookup_ns", "stage_ns", "trace_id", "foreign_rows",
     )
 
     def __init__(self, codes, k, nhits, H, rows, row_nhits, row_delta,
                  row_ns, hit_names, ok_aggr, leased_rows=0, lookup_ns=0,
-                 stage_ns=0, trace_id=0):
+                 stage_ns=0, trace_id=0, foreign_rows=0):
         self.codes = codes
         self.k = k
         self.nhits = nhits
@@ -507,6 +579,9 @@ class HotStaged:
         self.lookup_ns = lookup_ns
         self.stage_ns = stage_ns
         self.trace_id = trace_id
+        #: rows classified foreign-owned (codes >= LANE_FOREIGN_BASE) —
+        #: zero means the caller may skip the bulk-forward scan entirely
+        self.foreign_rows = foreign_rows
 
 
 def staged_trace_attrs(staged: "HotStaged") -> dict:
@@ -605,6 +680,26 @@ class NativeHotLane:
             self._ctx, blob, len(blob), epoch, kind, ns_token,
             min(int(delta), _INT32_MAX), int(delta_capped), ptr, nhits,
         )
+
+    # -- pod ownership stamps (ISSUE 13) -------------------------------------
+    # Called right after plan_put on the miss path, under the same
+    # native+storage locks as the begins that read the stamp.
+
+    def plan_stamp_owner(self, blob: bytes, epoch: int,
+                         key_repr: bytes) -> int:
+        """Stamp the plan with the owner of its single counter key —
+        the crc32 verdict computed IN C from the key's repr bytes.
+        Returns the owner, or -1 when the plan is gone / epoch moved."""
+        return self._lib.hp_plan_stamp_owner(
+            self._ctx, blob, len(blob), epoch, key_repr, len(key_repr)
+        )
+
+    def plan_set_owner(self, blob: bytes, epoch: int, owner: int) -> bool:
+        """Stamp a pre-resolved owner (pinned namespace / multi-key
+        router verdict); owner < 0 clears the stamp (locally owned)."""
+        return bool(self._lib.hp_plan_set_owner(
+            self._ctx, blob, len(blob), epoch, int(owner)
+        ))
 
     # -- quota leasing (lease/broker.py) -------------------------------------
     # All lease calls run under the pipeline's native lock, the same lock
@@ -773,6 +868,7 @@ class NativeHotLane:
             self._hit_names[:nhits].copy(), ok_aggr,
             leased_rows=int(meta[10]), lookup_ns=int(meta[8]),
             stage_ns=int(meta[9]), trace_id=int(meta[11]),
+            foreign_rows=int(meta[7]),
         )
 
     def kernel_columns(self, H: int):

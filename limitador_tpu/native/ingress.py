@@ -492,10 +492,35 @@ class NativeIngress:
         submitted = False
         slow: set = set()
         try:
-            results, slow_rows, pendings = self.pipeline._begin_batch(blobs)
+            results, slow_rows, pendings, foreign = (
+                self.pipeline._begin_batch(blobs)
+            )
             slow = set(slow_rows)
             for r in slow_rows:
                 self._submit_slow(rids[r], blobs[r])
+            if foreign:
+                # Pod split (ISSUE 13): foreign-owned rows leave in ONE
+                # bulk forward per owner, submitted NOW from the pump
+                # thread (non-blocking) and answered by a done-callback
+                # on the lane future — NEVER collected on the finish
+                # pool, whose 2 threads gate the sem the pump blocks
+                # on: a slow peer must not head-of-line-block local
+                # traffic. Their ``results`` rows stay None, so the
+                # batch finish below skips them.
+                pod = self.pipeline._pod
+                for owner, rows in foreign.items():
+                    fut = pod.forward_bulk_submit(
+                        owner, [blobs[r] for r in rows]
+                    )
+                    if fut is None:  # lane loop down: exact fallback
+                        for r in rows:
+                            self._submit_slow(rids[r], blobs[r])
+                        continue
+                    fut.add_done_callback(
+                        lambda f, rows=rows: self._foreign_done(
+                            f, rows, rids, blobs
+                        )
+                    )
             finish_pool.submit(
                 self._finish_decided, rids, slow, results, pendings, sem
             )
@@ -606,6 +631,39 @@ class NativeIngress:
             )
         finally:
             sem.release()
+
+    def _foreign_done(self, fut, rows, rids, blobs) -> None:
+        """Answer one owner's bulk hop from its done-callback (runs on
+        the lane loop the moment the RPC resolves — the lane's own
+        deadline/retry/hedge budget bounds that). Payload rows answer
+        in one respond; a failed hop, a short payload column (a
+        version-skewed peer must not silently drop tail rows) or a row
+        the owner could not decide terminally falls back to the
+        per-request exact path — routed by the pod frontend, so the
+        degraded-owner machinery owns that failure mode. Every rid is
+        answered exactly once from here."""
+        try:
+            payloads = fut.result()  # done: never blocks
+        except Exception:
+            payloads = None
+        if payloads is None or len(payloads) != len(rows):
+            payloads = [None] * len(rows)
+        out = []
+        for r, payload in zip(rows, payloads):
+            if payload is None:
+                try:
+                    self._submit_slow(rids[r], blobs[r])
+                except Exception:
+                    out.append(
+                        (rids[r], GRPC_INTERNAL, b"foreign hop failed")
+                    )
+            else:
+                out.append((rids[r], 0, payload))
+        if out:
+            try:
+                self._respond(out)
+            except Exception:
+                pass  # ingress closed mid-answer: the streams are gone
 
     def _answer_from_loop(self, rid: int, coro, ok_status: int = 0) -> None:
         """Run a coroutine on the server loop and answer ``rid`` with its

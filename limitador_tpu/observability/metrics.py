@@ -789,6 +789,78 @@ class PrometheusMetrics:
             self.pod_hop_phase_ms.labels(phase)
         for kind in EVENT_KINDS:
             self.pod_events.labels(kind)
+        # -- pod fast path (ISSUE 13): the shard-aware native hot
+        # lane's local/foreign split (native_pipeline.METRIC_FAMILIES),
+        # the bulk-forward lane (peering.METRIC_FAMILIES) and the
+        # lockstep psum lane (parallel/mesh.METRIC_FAMILIES) — all
+        # polled off the pod frontend's library_stats.
+        self.pod_hot_local_rows = Counter(
+            "pod_hot_local_rows",
+            "Hot-lane rows the C ownership pass classified locally "
+            "owned (staged zero-Python; pod_hot_local_share = "
+            "local / (local + foreign))",
+            registry=self.registry,
+        )
+        self.pod_hot_foreign_rows = Counter(
+            "pod_hot_foreign_rows",
+            "Hot-lane rows the C ownership pass classified foreign-"
+            "owned (bulk-forwarded to their owner, one RPC per owner "
+            "per flush)",
+            registry=self.registry,
+        )
+        self.pod_bulk_forward_batches = Counter(
+            "pod_bulk_forward_batches",
+            "Bulk forwards sent: one peer-lane RPC carrying a whole "
+            "flush's foreign-owned rows for one owner host",
+            registry=self.registry,
+        )
+        self.pod_bulk_forward_rows = Counter(
+            "pod_bulk_forward_rows",
+            "Rows carried by outgoing bulk forwards (rows / batches = "
+            "the mean bulk batch size)",
+            registry=self.registry,
+        )
+        self.pod_bulk_served_rows = Counter(
+            "pod_bulk_served_rows",
+            "Rows this host decided for peers' bulk forwards (the "
+            "owner side, one local decide_many pass per batch)",
+            registry=self.registry,
+        )
+        self.pod_psum_namespaces = Gauge(
+            "pod_psum_namespaces",
+            "Global namespaces the lockstep psum lane serves locally "
+            "on every host (fixed-window only; the rest stay pinned)",
+            registry=self.registry,
+        )
+        self.pod_psum_decisions = Counter(
+            "pod_psum_decisions",
+            "Decisions answered by the psum lane (local partial + "
+            "folded remote base; never a peer hop)",
+            registry=self.registry,
+        )
+        self.pod_psum_limited = Counter(
+            "pod_psum_limited",
+            "Psum-lane decisions answered over-limit",
+            registry=self.registry,
+        )
+        self.pod_psum_exchanges = Counter(
+            "pod_psum_exchanges",
+            "Lockstep exchange rounds completed (each folds every "
+            "other host's live partials into the remote base)",
+            registry=self.registry,
+        )
+        self.pod_psum_cells = Gauge(
+            "pod_psum_cells",
+            "Live local partial cells held by the psum lane "
+            "(LRU-bounded)",
+            registry=self.registry,
+        )
+        self.pod_psum_remote_slots = Gauge(
+            "pod_psum_remote_slots",
+            "Folded remote-base slots currently live (non-zero and "
+            "unexpired)",
+            registry=self.registry,
+        )
         # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
         # split into pipelined sub-batches. Registered in
         # batcher.METRIC_FAMILIES (lint cross-checked).
@@ -948,6 +1020,9 @@ class PrometheusMetrics:
         pod_event_seq = 0
         pod_signal_hosts = 0
         pod_signal_age = 0.0
+        pod_psum_namespaces = 0
+        pod_psum_cells = 0
+        pod_psum_remote_slots = 0
         for i, source in enumerate(self._library_sources):
             self._poll_device_stats(i, source)
             try:
@@ -985,6 +1060,14 @@ class PrometheusMetrics:
             )
             pod_signal_age = max(
                 pod_signal_age, float(stats.get("pod_signal_age_s", 0.0))
+            )
+            pod_psum_namespaces = max(
+                pod_psum_namespaces,
+                int(stats.get("pod_psum_namespaces", 0)),
+            )
+            pod_psum_cells += int(stats.get("pod_psum_cells", 0))
+            pod_psum_remote_slots += int(
+                stats.get("pod_psum_remote_slots", 0)
             )
             if "pod_signal_routed_share" in stats:
                 self.pod_signal_routed_share.set(
@@ -1054,6 +1137,14 @@ class PrometheusMetrics:
                 "pod_failover_reconciles",
                 "pod_failover_replayed_deltas",
                 "pod_signal_exchanges",
+                "pod_hot_local_rows",
+                "pod_hot_foreign_rows",
+                "pod_bulk_forward_batches",
+                "pod_bulk_forward_rows",
+                "pod_bulk_served_rows",
+                "pod_psum_decisions",
+                "pod_psum_limited",
+                "pod_psum_exchanges",
             ):
                 if key in stats:
                     seen = int(stats[key])
@@ -1086,6 +1177,9 @@ class PrometheusMetrics:
         self.pod_event_seq.set(pod_event_seq)
         self.pod_signal_hosts.set(pod_signal_hosts)
         self.pod_signal_age_s.set(pod_signal_age)
+        self.pod_psum_namespaces.set(pod_psum_namespaces)
+        self.pod_psum_cells.set(pod_psum_cells)
+        self.pod_psum_remote_slots.set(pod_psum_remote_slots)
 
     def _poll_device_stats(self, i: int, source) -> None:
         """Per-shard device-table stats from a ``device_stats()`` source:

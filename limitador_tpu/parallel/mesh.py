@@ -55,11 +55,15 @@ HLO regression test in tests/test_sharded.py pins this).
 
 from __future__ import annotations
 
+import base64
 import functools
+import threading
+import time
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -82,7 +86,23 @@ __all__ = [
     "host_local_to_global",
     "pod_sync",
     "pod_barrier",
+    "PodPsumLane",
+    "METRIC_FAMILIES",
 ]
+
+#: metric families this subsystem owns (cross-checked against
+#: observability/metrics.py by the analysis registry pass): the
+#: lockstep pod psum lane (ISSUE 13) — global-namespace limits decided
+#: locally on every host against read-as-sum partials, instead of
+#: funneling through one pin host.
+METRIC_FAMILIES = (
+    "pod_psum_namespaces",
+    "pod_psum_decisions",
+    "pod_psum_limited",
+    "pod_psum_exchanges",
+    "pod_psum_cells",
+    "pod_psum_remote_slots",
+)
 
 _NEVER = jnp.iinfo(jnp.int32).max
 
@@ -508,3 +528,397 @@ def sharded_drain_top_hits(
     return _shard_map(
         fn, mesh=mesh, in_specs=(spec,), out_specs=(spec, spec, spec),
     )(hits)
+
+
+# -- lockstep pod psum lane (ISSUE 13) ----------------------------------------
+#
+# PR 10 pinned every global-limit namespace whole to one deterministic
+# host: correct, but it re-creates the hot spot the pod exists to
+# remove — 1-1/N of that namespace's traffic pays a peer hop and ONE
+# host's device plane carries the whole namespace. The psum lane is the
+# read-as-sum CRDT of the single-host global counters (module
+# docstring, "Replicated global counters") lifted to host granularity:
+# every host keeps an EXACT local partial per counter and decides
+# against remote partials folded in by a lockstep exchange, so every
+# ingress host answers locally and the namespace stops funneling.
+#
+# "Lockstep" is load-bearing: the exchange transport is collective
+# (every pod host must run round k together, in round order), which is
+# what makes the folded base a consistent pod-wide snapshot. The
+# default transport rides the coordination-service KV store + barrier
+# of the live `jax.distributed` runtime — pure control-plane RPC, no
+# device program, because a device-collective exchange would deadlock
+# against concurrent local launches exactly like `pod_sync` documents.
+# The inaccuracy contract matches the device psum's: between exchange
+# rounds a host cannot see deltas admitted remotely, so over-admission
+# is bounded by one exchange interval per remote host (the reference's
+# cached-Redis bound, redis_cached.rs:25-41).
+
+
+class PodPsumLane:
+    """Host-local exact partials + lockstep-folded remote base for
+    global-namespace limits.
+
+    ``configure(limits, global_namespaces)`` claims the namespaces this
+    lane can serve (fixed-window only — a GCRA TAT cell cannot be a
+    summed partial, the same exclusion the device psum region applies);
+    the pod frontend then stops pinning them. The decision surface
+    (``check_and_update`` / ``is_rate_limited`` / ``update_counters``)
+    is synchronous and lock-cheap: one dict pass over local cells plus
+    an int read of the folded remote vector — never an RPC.
+
+    ``exchange()`` runs ONE lockstep round: publish my live partials,
+    fold everyone else's. Every pod host must call it the same number
+    of times in the same order (the transport is collective); the
+    built-in pacing thread keeps hosts in lockstep by construction
+    because each round's barrier waits for the slowest host.
+    """
+
+    #: remote partials fold into a fixed slot vector so the exchange
+    #: payload is bounded; colliding keys MERGE their remote sums —
+    #: strictly conservative (a merged base can only under-admit).
+    DEFAULT_SLOTS = 2048
+
+    def __init__(
+        self,
+        hosts: int,
+        host_id: int,
+        clock=time.time,
+        slots: int = DEFAULT_SLOTS,
+        cell_cap: int = 1 << 16,
+        transport=None,
+        barrier_timeout_ms: int = 30_000,
+    ):
+        from ..core.limiter import CheckResult
+        from ..routing import counter_key
+        from ..storage.expiring_value import ExpiringValue
+
+        # bound once: the decision surface is registered as a hot
+        # module (tracing-safety pass) — per-call `from x import y`
+        # inside check_and_update/is_rate_limited would re-run a
+        # sys.modules lookup on every psum-served request.
+        self._CheckResult = CheckResult
+        self._counter_key = counter_key
+        self._ExpiringValue = ExpiringValue
+        self.hosts = int(hosts)
+        self.host_id = int(host_id)
+        self._clock = clock
+        self._slots = int(slots)
+        self._cell_cap = int(cell_cap)
+        self._barrier_timeout_ms = int(barrier_timeout_ms)
+        #: namespaces (str) this lane serves; read lock-free by the
+        #: frontend's `_psum_serves` (set replacement is atomic).
+        self.namespaces: frozenset = frozenset()
+        self._lock = threading.Lock()
+        # counter key tuple -> ExpiringValue (this host's partial),
+        # LRU-bounded like the in-memory qualified cache.
+        from collections import OrderedDict
+
+        from ..routing import stable_hash
+
+        self._stable_hash = stable_hash
+        self._cells: "OrderedDict" = OrderedDict()
+        # key -> slot, filled at cell insertion and evicted with the
+        # cell: the decision path and every _pack round then never
+        # re-run repr+crc32 per key (the staging-pass hot spot
+        # routing.RouteMemo documents) — _pack holds the decision lock,
+        # so its per-cell cost is latency every psum decision pays.
+        self._slot_memo: dict = {}
+        # folded remote base (sum of OTHER hosts' live partials at the
+        # last exchange round) per slot, with the latest expiry stamp —
+        # reads treat an expired slot as 0, mirroring the device psum's
+        # live_partial mask.
+        self._remote_vals = np.zeros(self._slots, np.int64)
+        self._remote_exp = np.zeros(self._slots, np.float64)
+        self._transport = transport
+        self.rounds = 0
+        self.decisions = 0
+        self.limited = 0
+        self.exchanges = 0
+        self._pacer: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        # set when the pacing thread dies on a failed exchange; a dead
+        # lane must stay unclaimed across limits reloads (configure()
+        # would otherwise re-claim namespaces nobody is folding).
+        self._pacer_dead = False
+
+    # -- configuration -------------------------------------------------------
+
+    def configure(self, limits, global_namespaces) -> frozenset:
+        """Claim the global namespaces every limit of which this lane
+        can count (fixed-window policies only). Returns the served set;
+        the caller pins the remainder as before."""
+        if self._pacer_dead:
+            self.namespaces = frozenset()
+            return self.namespaces
+        by_ns: dict = {}
+        for limit in limits:
+            by_ns.setdefault(str(limit.namespace), []).append(limit)
+        served = frozenset(
+            ns for ns in (str(n) for n in global_namespaces)
+            if ns in by_ns and all(
+                lim.policy == "fixed_window" for lim in by_ns[ns]
+            )
+        )
+        self.namespaces = served
+        return served
+
+    # -- internals -----------------------------------------------------------
+
+    def _slot_of(self, key: tuple) -> int:
+        s = self._slot_memo.get(key)
+        if s is None:
+            s = self._stable_hash(key) % self._slots
+        return s
+
+    def _cell(self, key: tuple, window_s: int, now: float):
+        ev = self._cells.get(key)
+        if ev is None:
+            # fresh window even on a pure check (the in-memory oracle's
+            # in_memory.rs:122-127 semantics)
+            ev = self._ExpiringValue(0, now + window_s)
+            self._cells[key] = ev
+            self._slot_memo[key] = (
+                self._stable_hash(key) % self._slots
+            )
+            while len(self._cells) > self._cell_cap:
+                evicted, _ = self._cells.popitem(last=False)
+                self._slot_memo.pop(evicted, None)
+        else:
+            self._cells.move_to_end(key)
+        return ev
+
+    def _remote_live(self, key: tuple, now: float) -> int:
+        s = self._slot_of(key)
+        if now >= self._remote_exp[s]:
+            return 0
+        return int(self._remote_vals[s])
+
+    # -- the decision surface (sync, called by PodFrontend) ------------------
+
+    def check_and_update(
+        self, counters, delta: int, load_counters: bool = False
+    ):
+        """Check-all-then-update-all over base+partial, the in-memory
+        oracle's discipline (never over-admits locally; remote deltas
+        since the last round are the bounded blind spot)."""
+        CheckResult = self._CheckResult
+        counter_key = self._counter_key
+        now = self._clock()
+        with self._lock:
+            self.decisions += 1
+            first_limited = None
+            to_update = []
+            # simple counters first, then qualified — the oracle's
+            # first_limited order
+            for qualified_pass in (False, True):
+                for counter in counters:
+                    if counter.is_qualified() is not qualified_pass:
+                        continue
+                    key = counter_key(counter)
+                    ev = self._cell(key, counter.window_seconds, now)
+                    value = ev.value_at(now) + self._remote_live(key, now)
+                    over = value + delta > counter.max_value
+                    if load_counters:
+                        remaining = counter.max_value - (value + delta)
+                        counter.remaining = max(remaining, 0)
+                        counter.expires_in = ev.ttl(now)
+                        if first_limited is None and remaining < 0:
+                            first_limited = counter.limit.name
+                    elif over:
+                        self.limited += 1
+                        return CheckResult(True, [], counter.limit.name)
+                    to_update.append((ev, counter.window_seconds))
+            if first_limited is not None:
+                self.limited += 1
+                return CheckResult(True, list(counters), first_limited)
+            for ev, window in to_update:
+                ev.update(delta, window, now)
+        return CheckResult(False, list(counters) if load_counters else [],
+                           None)
+
+    def is_rate_limited(self, counters, delta: int):
+        CheckResult = self._CheckResult
+        counter_key = self._counter_key
+        now = self._clock()
+        with self._lock:
+            self.decisions += 1
+            for counter in counters:
+                key = counter_key(counter)
+                ev = self._cells.get(key)
+                value = (ev.value_at(now) if ev is not None else 0) + \
+                    self._remote_live(key, now)
+                if value + delta > counter.max_value:
+                    self.limited += 1
+                    return CheckResult(True, [counter], counter.limit.name)
+        return CheckResult(False, [], None)
+
+    def update_counters(self, counters, delta: int) -> None:
+        counter_key = self._counter_key
+        now = self._clock()
+        with self._lock:
+            for counter in counters:
+                key = counter_key(counter)
+                ev = self._cell(key, counter.window_seconds, now)
+                ev.update(delta, counter.window_seconds, now)
+
+    # -- the lockstep exchange -----------------------------------------------
+
+    def _pack(self, now: float) -> bytes:
+        vals = np.zeros(self._slots, np.int64)
+        exps = np.zeros(self._slots, np.float64)
+        for key, ev in self._cells.items():
+            v = ev.value_at(now)
+            if v <= 0:
+                continue
+            s = self._slot_of(key)
+            vals[s] += v
+            if ev.expiry > exps[s]:
+                exps[s] = ev.expiry
+        return vals.tobytes() + exps.tobytes()
+
+    def _unpack(self, payload: bytes):
+        n = self._slots
+        vals = np.frombuffer(payload[: n * 8], np.int64)
+        exps = np.frombuffer(payload[n * 8:], np.float64)
+        return vals, exps
+
+    def _kv_transport(self, round_idx: int, payload: bytes):
+        """The live-pod default: coordination-service KV + barrier of
+        the `jax.distributed` runtime. Pure control-plane RPC — a
+        device-collective exchange would deadlock against concurrent
+        local launches (the pod_sync caveat)."""
+        try:
+            from jax._src.distributed import global_state
+        except ImportError:  # pragma: no cover - newer jax layouts
+            global_state = getattr(jax.distributed, "global_state", None)
+        client = getattr(global_state, "client", None)
+        if client is None:
+            # A multi-host lane without a coordination client must FAIL
+            # the round, not fabricate a healthy one: returning
+            # all-None here would keep pod_psum_exchanges advancing
+            # while every host folds a permanent-zero remote base —
+            # exactly the N-times over-admission the pacer-death
+            # unclaim path exists to prevent. Raising routes this
+            # through that path (log + unclaim + stop pacing).
+            raise RuntimeError(
+                "pod psum lane: no jax.distributed coordination client "
+                "for the KV exchange"
+            )
+        client.key_value_set(
+            f"psum-lane/{round_idx}/{self.host_id}",
+            base64.b64encode(payload).decode(),
+        )
+        client.wait_at_barrier(
+            f"psum-lane-r{round_idx}", self._barrier_timeout_ms
+        )
+        # Reclaim my previous round's payload: passing round k's barrier
+        # means every host completed round k-1 entirely (the lockstep
+        # invariant), so the k-1 key can never be read again. Without
+        # this the coordination service accrues ~slots*16B per host per
+        # round forever (~1.4MB/s on an 8-host pod at the default
+        # cadence) until the coordinator OOMs. Best-effort: a client
+        # without key_value_delete just leaks like before.
+        if round_idx > 0:
+            delete = getattr(client, "key_value_delete", None)
+            if delete is not None:
+                try:
+                    delete(f"psum-lane/{round_idx - 1}/{self.host_id}")
+                except Exception:
+                    pass
+        out = []
+        for h in range(self.hosts):
+            if h == self.host_id:
+                out.append(payload)
+                continue
+            raw = client.blocking_key_value_get(
+                f"psum-lane/{round_idx}/{h}", self._barrier_timeout_ms
+            )
+            out.append(base64.b64decode(raw))
+        return out
+
+    def exchange(self) -> int:
+        """One lockstep exchange round; returns the round count. Every
+        pod host MUST call this the same number of times, in order (the
+        transport is collective — the round's barrier paces all hosts
+        to the slowest). Single-host pods fold nothing and stay
+        exact."""
+        now = self._clock()
+        with self._lock:
+            payload = self._pack(now)
+            round_idx = self.rounds
+        transport = self._transport or self._kv_transport
+        payloads = transport(round_idx, payload)
+        rv = np.zeros(self._slots, np.int64)
+        re_ = np.zeros(self._slots, np.float64)
+        for h, p in enumerate(payloads):
+            if h == self.host_id or p is None:
+                continue
+            pv, pe = self._unpack(p)
+            rv += pv
+            np.maximum(re_, pe, out=re_)
+        with self._lock:
+            self._remote_vals = rv
+            self._remote_exp = re_
+            self.rounds = round_idx + 1
+            self.exchanges += 1
+        return self.rounds
+
+    def start(self, interval_s: float = 0.25) -> None:
+        """Pace lockstep rounds on a daemon thread: sleep, then
+        exchange — the per-round barrier keeps every host's thread on
+        the same round index (the fastest host waits). A host that
+        stops responding times every peer's barrier out; each pacer
+        then UNCLAIMS its namespaces before exiting, so the frontend's
+        per-decision `_psum_serves` check reverts them to the pinned
+        (exact, single-owner) path — a dead exchange must not leave N
+        hosts each admitting the full limit on a base going stale."""
+        if self._pacer is not None or self.hosts <= 1:
+            return
+
+        def run():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.exchange()
+                except Exception:
+                    if not self._stop.is_set():
+                        import logging
+
+                        logging.getLogger("limitador").warning(
+                            "pod psum lane: exchange failed at round "
+                            f"{self.rounds} (barrier timeout or peer "
+                            "loss); unclaiming "
+                            f"{len(self.namespaces)} namespaces — "
+                            "they revert to the pinned path",
+                            exc_info=True,
+                        )
+                    self._pacer_dead = True
+                    self.namespaces = frozenset()
+                    return
+
+        self._pacer = threading.Thread(
+            target=run, name="pod-psum-lane", daemon=True
+        )
+        self._pacer.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # -- telemetry -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            live_remote = int(
+                np.count_nonzero(
+                    self._remote_vals
+                    * (self._remote_exp > self._clock())
+                )
+            )
+            return {
+                "pod_psum_namespaces": len(self.namespaces),
+                "pod_psum_decisions": self.decisions,
+                "pod_psum_limited": self.limited,
+                "pod_psum_exchanges": self.exchanges,
+                "pod_psum_cells": len(self._cells),
+                "pod_psum_remote_slots": live_remote,
+            }

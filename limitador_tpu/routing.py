@@ -216,6 +216,51 @@ class PodRouter:
 
     # -- the per-request verdict ---------------------------------------------
 
+    def pinned_host(self, namespace: str) -> Optional[int]:
+        """The pin host of a namespace, or None when it routes per key.
+        The native derivation pass consults this to pick the stamping
+        authority (ISSUE 13): pinned namespaces stamp the ROUTER's
+        verdict (plan_set_owner) — the key hash would disagree with the
+        pin — while un-pinned single-key plans stamp through the C-side
+        crc32 (plan_stamp_owner), which is parity-identical."""
+        with self._lock:
+            return self._pinned_ns.get(str(namespace))
+
+    def verdict(
+        self, namespace: str, keys: List[tuple]
+    ) -> Tuple[str, int]:
+        """The pure routing verdict — no counters mutated. Used by the
+        native pipeline's plan-derivation pass (ISSUE 13), which counts
+        routed traffic through the C lane's own local/foreign tallies
+        instead of these per-request counters."""
+        with self._lock:
+            return self._verdict_locked(namespace, keys)
+
+    def _verdict_locked(
+        self, namespace: str, keys: List[tuple]
+    ) -> Tuple[str, int]:
+        # caller holds self._lock; one acquisition covers the pinned
+        # lookup AND (in plan()) the verdict counters — plan() runs per
+        # request on every serving shard's loop, so acquisition count
+        # on this one contended lock is the hot-path cost.
+        me = self.topology.host_id
+        pin = self._pinned_ns.get(str(namespace))
+        if pin is not None:
+            return (LOCAL, me) if pin == me else (PINNED, pin)
+        owners = {self.topology.owner_host(key) for key in keys}
+        if not owners or owners == {me}:
+            return LOCAL, me
+        if len(owners) == 1:
+            return FORWARD, owners.pop()
+        # Keys spanning hosts under an unpinned namespace: a limits
+        # generation raced the request (configure() pins multi-limit
+        # namespaces). Deterministic fallback: the namespace pin
+        # host — which, when it is us, must come back LOCAL like
+        # the pinned-map branch (the frontend forwards every
+        # non-LOCAL verdict, and there is no peer lane to self).
+        pin = self.pin_host(str(namespace), self.topology.hosts)
+        return (LOCAL, me) if pin == me else (PINNED, pin)
+
     def plan(
         self, namespace: str, keys: List[tuple]
     ) -> Tuple[str, int]:
@@ -223,37 +268,45 @@ class PodRouter:
         ``LOCAL`` means decide here; ``FORWARD``/``PINNED`` name the
         host that must decide (== our own host id for pinned
         namespaces we happen to own — callers treat that as local)."""
-        me = self.topology.host_id
-        # Verdict counters mutate under the lock: plan() runs
-        # concurrently on every serving shard's event loop, and a lost
-        # increment skews pod_routed_share — the bench headline.
+        # ONE lock acquisition per request: verdict + counters (a lost
+        # increment skews pod_routed_share — the bench headline; two
+        # acquisitions double contention on the routing hot path).
         with self._lock:
-            pin = self._pinned_ns.get(str(namespace))
-            if pin is not None:
-                if pin == me:
-                    self.routed_local += 1
-                    return LOCAL, me
-                self.routed_pinned += 1
-                return PINNED, pin
-            owners = {self.topology.owner_host(key) for key in keys}
-            if not owners or owners == {me}:
+            verdict, owner = self._verdict_locked(namespace, keys)
+            if verdict == LOCAL:
                 self.routed_local += 1
-                return LOCAL, me
-            if len(owners) == 1:
+            elif verdict == FORWARD:
                 self.routed_forwarded += 1
-                return FORWARD, owners.pop()
-            # Keys spanning hosts under an unpinned namespace: a limits
-            # generation raced the request (configure() pins multi-limit
-            # namespaces). Deterministic fallback: the namespace pin
-            # host — which, when it is us, must come back LOCAL like
-            # the pinned-map branch (the frontend forwards every
-            # non-LOCAL verdict, and there is no peer lane to self).
-            pin = self.pin_host(str(namespace), self.topology.hosts)
-            if pin == me:
-                self.routed_local += 1
-                return LOCAL, me
-            self.routed_pinned += 1
-            return PINNED, pin
+            else:
+                self.routed_pinned += 1
+        return verdict, owner
+
+    def ownership_map(self) -> dict:
+        """The routing truth an upstream load balancer can learn
+        (``GET /debug/pod/routing``, ISSUE 13): topology, per-host
+        contiguous shard blocks, the pinned-namespace map and the
+        routing epoch — everything needed to send a descriptor straight
+        to its owner (Envoy ring-hash on descriptor keys approximates
+        it statistically; this map is the exact verdict)."""
+        topo = self.topology
+        with self._lock:
+            pinned = dict(self._pinned_ns)
+            epoch = self.epoch
+        return {
+            "hosts": topo.hosts,
+            "host_id": topo.host_id,
+            "shards_per_host": topo.shards_per_host,
+            "total_shards": topo.total_shards,
+            "hash": "crc32(repr(counter_key))",
+            "owner": "crc32 % total_shards // shards_per_host",
+            "shard_blocks": {
+                str(h): [h * topo.shards_per_host,
+                         (h + 1) * topo.shards_per_host]
+                for h in range(topo.hosts)
+            },
+            "pinned_namespaces": pinned,
+            "epoch": epoch,
+        }
 
     def stats(self) -> dict:
         with self._lock:

@@ -355,6 +355,26 @@ def build_parser() -> argparse.ArgumentParser:
         "GET /debug/events (per-kind counts export as "
         "pod_events_total regardless of ring size)",
     )
+    # pod fast path (docs/configuration.md "Pod fast path", ISSUE 13):
+    # shard-aware native hot lane + lockstep psum lane for global limits
+    p.add_argument(
+        "--pod-psum-lane", choices=["on", "off"],
+        default=_env("TPU_POD_PSUM_LANE", "off"),
+        help="pod: on = fixed-window --global-namespaces limits are "
+        "decided LOCALLY on every host against lockstep-exchanged "
+        "remote partials (pod-wide psum) instead of pinning the whole "
+        "namespace to one host; trades bounded over-admission (one "
+        "exchange interval per remote host, like the reference's "
+        "cached-Redis mode) for routed-share -> 1 on those namespaces. "
+        "off (default) = exact namespace pinning. Every pod host must "
+        "agree on this flag (the exchange is collective)",
+    )
+    p.add_argument(
+        "--pod-psum-interval-ms", type=float,
+        default=float(_env("TPU_POD_PSUM_INTERVAL_MS", "250")),
+        help="pod: pacing of the lockstep psum exchange rounds (also "
+        "the over-admission bound's time constant)",
+    )
     p.add_argument(
         "--global-namespaces", default=_env("GLOBAL_NAMESPACES"),
         help="sharded: comma-separated namespaces whose counters are "
@@ -579,6 +599,67 @@ def _pod_local_mesh():
         from ..parallel import make_mesh
 
         return make_mesh(jax.local_devices())
+    return None
+
+
+def _pod_native_capable(args, log) -> bool:
+    """Pod-mode native-pipeline capability check (ISSUE 13): the
+    shard-aware hot lane is the only native plane that classifies
+    foreign-owned keys, so pod mode serves the native pipeline ONLY
+    when that lane can come up — ``--native-hot-lane on`` AND a built
+    library exporting both the lane and the pod ownership mirror.
+    Anything less warns and falls back to the routed compiled plane,
+    the same warn-and-fallback shape as ``--native-hot-lane`` itself
+    (never a hard refusal, never a silently wrong fast path)."""
+    from .. import native as native_mod
+
+    if args.native_hot_lane != "on":
+        log.warning(
+            "pod mode: --native-hot-lane off leaves the native pipeline "
+            "without the shard-aware lane; serving through the routed "
+            "compiled pipeline")
+        return False
+    if not native_mod.available():
+        log.warning(
+            "pod mode: native hostpath library unavailable; serving "
+            "through the routed compiled pipeline")
+        return False
+    if not native_mod.pod_available():
+        log.warning(
+            "pod mode: native library lacks the pod ownership exports "
+            "(stale binary — rebuild native/hostpath.cc); serving "
+            "through the routed compiled pipeline")
+        return False
+    if args.plan_cache_size <= 0:
+        log.warning(
+            "pod mode: --plan-cache-size 0 disables the plan mirror "
+            "the shard-aware lane rides; serving through the routed "
+            "compiled pipeline")
+        return False
+    if args.pod_processes - 1 > 127 - native_mod.LANE_FOREIGN_BASE:
+        log.warning(
+            f"pod mode: {args.pod_processes} hosts exceed the native "
+            "lane's int8 owner encoding (max "
+            f"{128 - native_mod.LANE_FOREIGN_BASE}); serving through "
+            "the routed compiled pipeline")
+        return False
+    return True
+
+
+async def _discard_pipeline(pipeline):
+    """Dispose a constructed-but-unserved NativeRlsPipeline (pod-mode
+    fallback): its __init__ already wired eviction hooks on the live
+    storage table — left attached they would call into an abandoned
+    native context on every slot release for the process lifetime —
+    and started its thread pools. Returns None for assignment."""
+    table = pipeline.storage._table
+    table.on_native_release = None
+    table.on_slot_release = None
+    table.on_clear = None
+    try:
+        await pipeline.close()
+    except Exception:
+        pass  # a half-built pipeline must not fail the fallback boot
     return None
 
 
@@ -959,6 +1040,25 @@ async def _amain(args) -> int:
             f"{resilience.hedge_ms:.0f}ms, breaker "
             f"{resilience.breaker_failures} failures / "
             f"{resilience.breaker_reset_s * 1e3:.0f}ms reset")
+        if args.pod_psum_lane == "on" and pod_global_ns:
+            # Lockstep psum lane (ISSUE 13): eligible fixed-window
+            # global namespaces decide locally on EVERY host against
+            # lockstep-exchanged remote partials instead of funneling
+            # through one pin host. Attached before the initial limits
+            # load so configure_with claims namespaces on first apply;
+            # the pacer starts only then (all hosts reach the first
+            # barrier with limits loaded).
+            from ..parallel.mesh import PodPsumLane
+
+            psum_lane = PodPsumLane(pod.num_processes, pod.process_id)
+            pod_frontend.attach_psum_lane(psum_lane)
+            psum_lane.start(
+                interval_s=max(args.pod_psum_interval_ms, 10.0) / 1e3
+            )
+            log.info(
+                "pod psum lane: lockstep exchange every "
+                f"{max(args.pod_psum_interval_ms, 10.0):.0f}ms "
+                f"(global namespaces: {sorted(pod_global_ns)})")
     counters_storage = limiter.storage.counters
     # Prefer the limiter (the compiled pipeline aggregates its storage's
     # stats and adds compiler eval counters); otherwise the storage itself.
@@ -1126,15 +1226,14 @@ async def _amain(args) -> int:
         pod_frontend is not None
         and args.storage == "tpu"
         and args.pipeline == "native"
+        and not _pod_native_capable(args, log)
     ):
-        # The native pipeline (and the ingress hot lane riding it)
-        # decides against the local storage directly — it would bypass
-        # the pod router and decide keys other hosts own. Until the C
-        # lane is shard-aware, pod mode serves through the routed
-        # compiled/standard plane.
-        log.warning(
-            "pod mode: the native pipeline hot lane is not shard-aware "
-            "yet; serving through the routed compiled pipeline")
+        # Capability check (ISSUE 13): the shard-aware hot lane is the
+        # only native plane that routes foreign-owned keys, so pod mode
+        # refuses the pipeline ONLY when that lane cannot serve (C
+        # library absent/stale, or --native-hot-lane off) — the same
+        # warn-and-fallback shape as --native-hot-lane itself.
+        pass
     elif args.storage == "tpu" and args.pipeline == "native":
         from .. import native as native_mod
 
@@ -1155,11 +1254,45 @@ async def _amain(args) -> int:
                     "native hot lane requested but unavailable (library "
                     "without lane symbols, or plan cache disabled); "
                     "serving through the pure-Python cached lane")
-            pipelines_to_invalidate.append(native_pipeline)
-            metrics.attach_library_source(native_pipeline)
-            if admission is not None:
+            if pod_frontend is not None:
+                if native_pipeline.hot_lane_active:
+                    # Pod fast path (ISSUE 13): the C mirror learns the
+                    # topology, plans stamp their owner host, and the
+                    # lane's bulk_decide handler decides forwarded blob
+                    # batches — the zero-Python plane now serves pod
+                    # mode. The pipeline's exact fallback is the pod
+                    # frontend itself (limiter == pod_frontend here),
+                    # so slow rows keep full routed semantics.
+                    try:
+                        pod_frontend.attach_pipeline(native_pipeline)
+                    except RuntimeError as exc:
+                        # e.g. a pod bigger than the int8 owner
+                        # encoding — mis-routing is never an option.
+                        log.warning(
+                            f"pod mode: cannot arm the hot lane "
+                            f"({exc}); serving through the routed "
+                            "compiled pipeline")
+                        native_pipeline = await _discard_pipeline(
+                            native_pipeline)
+                    else:
+                        log.info(
+                            "pod fast path: shard-aware native hot "
+                            "lane on (foreign-owned rows bulk-forward "
+                            "per flush)")
+                else:
+                    # Without the plan mirror the pipeline would decide
+                    # against local storage only, bypassing the router.
+                    log.warning(
+                        "pod mode: the hot lane did not come up; "
+                        "serving through the routed compiled pipeline")
+                    native_pipeline = await _discard_pipeline(
+                        native_pipeline)
+            if native_pipeline is not None:
+                pipelines_to_invalidate.append(native_pipeline)
+                metrics.attach_library_source(native_pipeline)
+            if admission is not None and native_pipeline is not None:
                 admission.add_drainable(native_pipeline)
-            if args.lease_mode == "on":
+            if args.lease_mode == "on" and native_pipeline is not None:
                 if native_pipeline.hot_lane_active:
                     from ..lease import LeaseConfig
 

@@ -29,6 +29,10 @@ Beyond the reference surface, the device-plane debug endpoints
                             breaker/degraded/replay/hedge events
                             (?n=N trims, ?kind= filters; 404 off pod
                             mode)
+    GET  /debug/pod/routing the pod ownership map an upstream load
+                            balancer can learn: topology, per-host
+                            shard blocks, pinned namespaces, routing
+                            epoch (404 off pod mode)
     GET  /debug/profile     jax.profiler capture status
     POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
                             toggles an on-demand jax.profiler trace
@@ -77,6 +81,9 @@ DEBUG_SOURCE_SECTIONS = (
     ("signals", "signals_debug"),
     ("pod", "pod_debug"),
     ("pod_events", "events_debug"),
+    # pod fast path (ISSUE 13): the ownership map an upstream LB can
+    # learn (topology, shard blocks, pinned namespaces, epoch)
+    ("pod_routing", "routing_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -98,6 +105,7 @@ DEBUG_STATS_SECTIONS = (
     "signals",
     "pod",
     "pod_events",
+    "pod_routing",
 )
 
 
@@ -260,6 +268,18 @@ def _openapi_spec() -> dict:
                                "breakdown",
                     "responses": {
                         "200": {"description": "pod snapshot"},
+                        "404": {"description": "not a pod"},
+                    },
+                }
+            },
+            "/debug/pod/routing": {
+                "get": {
+                    "summary": "Pod ownership map for upstream load "
+                               "balancers: topology, per-host shard "
+                               "blocks, pinned namespaces, routing "
+                               "epoch",
+                    "responses": {
+                        "200": {"description": "ownership map"},
                         "404": {"description": "not a pod"},
                     },
                 }
@@ -552,6 +572,23 @@ class _Api:
             )
         return web.json_response(fn())
 
+    async def get_debug_pod_routing(
+        self, request: web.Request
+    ) -> web.Response:
+        """The routing truth an upstream LB can learn (ISSUE 13):
+        topology, per-host contiguous shard blocks, the pinned-
+        namespace map and the routing epoch — enough to send a
+        descriptor straight to its owner host (an Envoy ring-hash on
+        descriptor keys approximates it; this map is the exact
+        verdict)."""
+        fn = self._debug_source_fn("routing_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "not a pod (single-host deployment)"},
+                status=404,
+            )
+        return web.json_response(fn())
+
     async def get_debug_events(self, request: web.Request) -> web.Response:
         """The typed pod event timeline (?n=N trims to the most recent
         N, ?kind= filters to one event kind); mergeable pod-wide by
@@ -737,6 +774,7 @@ def make_http_app(
     app.router.add_get("/debug/top", api.get_debug_top)
     app.router.add_get("/debug/signals", api.get_debug_signals)
     app.router.add_get("/debug/pod", api.get_debug_pod)
+    app.router.add_get("/debug/pod/routing", api.get_debug_pod_routing)
     app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)
     app.router.add_post("/debug/profile", api.post_debug_profile)

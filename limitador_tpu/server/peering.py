@@ -78,6 +78,7 @@ observation, not just of serving:
 from __future__ import annotations
 
 import asyncio
+import base64
 import collections
 import inspect
 import json
@@ -136,9 +137,12 @@ _LATENCY_WINDOW = 2048
 
 #: forward kinds safe to retry/hedge: a duplicated check at worst
 #: double-counts one delta (conservative for a limiter — it can only
-#: under-admit); update_counters and apply_deltas carry their own
-#: replay semantics and are never retried by the lane.
-RETRYABLE_KINDS = frozenset({"check_and_update", "is_rate_limited", "ping"})
+#: under-admit; a duplicated bulk batch double-counts one batch's
+#: deltas, the same direction); update_counters and apply_deltas carry
+#: their own replay semantics and are never retried by the lane.
+RETRYABLE_KINDS = frozenset({
+    "check_and_update", "is_rate_limited", "ping", "bulk_decide",
+})
 
 #: metric families this subsystem owns (cross-checked against
 #: observability/metrics.py by the analysis registry pass): peer health
@@ -158,6 +162,12 @@ METRIC_FAMILIES = (
     "pod_failover_replayed_deltas",
     "pod_failover_reconcile_seconds",
     "pod_failover_seconds",
+    # pod fast path (ISSUE 13): the bulk-forward lane — foreign-owned
+    # hot-lane rows ride ONE RPC per (owner, flush) instead of one per
+    # decision; batches/rows give the mean bulk batch size.
+    "pod_bulk_forward_batches",
+    "pod_bulk_forward_rows",
+    "pod_bulk_served_rows",
 )
 
 
@@ -467,6 +477,12 @@ class PeerLane:
         self.peers = dict(peers)
         self.decide_cb = decide_cb
         self.apply_cb: Optional[Callable[[list], int]] = None
+        #: async callable (blobs) -> [response bytes or None] run on the
+        #: lane loop — the owner side of a bulk forward (ISSUE 13). None
+        #: per row means "could not decide terminally" (the origin falls
+        #: back to its per-request hop). Wired by PodFrontend.
+        #: attach_pipeline.
+        self.bulk_cb = None
         #: sync callable (host) -> bool run on a recovery thread when a
         #: background probe finds a non-up peer answering again; True
         #: marks the peer up (the frontend replays its journal first)
@@ -500,6 +516,9 @@ class PeerLane:
         self.forwards = 0
         self.served = 0
         self.errors = 0
+        self.bulk_forwards = 0
+        self.bulk_forward_rows = 0
+        self.bulk_served_rows = 0
         self.retries = 0
         self.hedges_won = 0
         self.hedges_lost = 0
@@ -644,6 +663,44 @@ class PeerLane:
                 except Exception:
                     mine = {}
             return json.dumps({"ok": True, "signals": mine}).encode()
+        if kind == "bulk_decide":
+            # Pod fast path (ISSUE 13): a peer's flush of foreign-owned
+            # raw request blobs, decided here in ONE local bulk pass
+            # (the zero-Python lane at bulk batch sizes). The hop's
+            # trace metadata rides exactly like a single forward: adopt
+            # the origin's request id so owner-side flight entries and
+            # spans still correlate.
+            handler = self.bulk_cb
+            if handler is None:
+                raise RuntimeError(
+                    "pod peer lane has no bulk_decide handler (native "
+                    "pipeline not attached)"
+                )
+            meta = {}
+            try:
+                meta = dict(context.invocation_metadata() or ())
+            except Exception:
+                meta = {}
+            rid = meta.get("x-request-id")
+            if rid is not None:
+                set_request_id(str(rid))
+            blobs = [
+                base64.b64decode(b) for b in payload.get("blobs", ())
+            ]
+            self.bulk_served_rows += len(blobs)
+            self.served += 1
+            t_decide = time.perf_counter()
+            with peer_decide_span("_bulk", rid, meta):
+                payloads = await handler(blobs)
+            decide_s = time.perf_counter() - t_decide
+            return json.dumps({
+                "ok": True,
+                "decide_ns": int(decide_s * 1e9),
+                "payloads": [
+                    None if p is None else base64.b64encode(p).decode()
+                    for p in payloads
+                ],
+            }).encode()
         if kind == "apply_deltas":
             if self.apply_cb is None:
                 raise RuntimeError(
@@ -1066,6 +1123,68 @@ class PeerLane:
             })
         return resp
 
+    async def forward_bulk(
+        self, host: int, blobs: List[bytes]
+    ) -> List[Optional[bytes]]:
+        """One bulk forward of foreign-owned raw request blobs to their
+        owner host (ISSUE 13): the whole flush group rides ONE lane RPC
+        with the lane's full resilience (retry while suspect, hedging,
+        health accounting). Returns one response payload per blob; None
+        rows could not be decided terminally by the owner — the caller
+        falls back to its per-request hop. Raises on peer failure after
+        counting it. The per-hop breakdown (PR 12) is recorded exactly
+        like a single forward's, under the ``_bulk`` namespace."""
+        if host not in self.peers:
+            self.errors += 1
+            raise RuntimeError(f"no peer lane for pod host {host}")
+        request_id = _wire_request_id(current_request_id())
+        t0 = time.perf_counter()
+        blob = json.dumps({
+            "kind": "bulk_decide",
+            "from": self.host_id,
+            "blobs": [base64.b64encode(b).decode() for b in blobs],
+        }).encode()
+        serialize_s = time.perf_counter() - t0
+        metadata = None
+        pairs = hop_trace_metadata()
+        if request_id is not None:
+            pairs.append(("x-request-id", request_id))
+        if pairs:
+            metadata = tuple(pairs)
+        fut = asyncio.run_coroutine_threadsafe(
+            self._forward_on_loop(
+                host, blob, "bulk_decide", metadata=metadata,
+                t_submit=time.perf_counter(),
+            ),
+            self._loop,
+        )
+        try:
+            raw, queue_s = await asyncio.wrap_future(fut)
+        except Exception:
+            self.errors += 1
+            raise
+        self.bulk_forwards += 1
+        self.bulk_forward_rows += len(blobs)
+        total_s = time.perf_counter() - t0
+        with self._latency_lock:
+            self._latencies_ms.append(total_s * 1e3)
+        resp = json.loads(raw.decode())
+        hook = self.on_hop
+        if hook is not None:
+            remote_s = max(float(resp.get("decide_ns", 0)) / 1e9, 0.0)
+            hook(host, request_id, "_bulk", total_s, {
+                "queue": queue_s,
+                "serialize": serialize_s,
+                "wire": max(
+                    total_s - queue_s - serialize_s - remote_s, 0.0
+                ),
+                "remote_decide": remote_s,
+            })
+        return [
+            None if p is None else base64.b64decode(p)
+            for p in resp.get("payloads", ())
+        ]
+
     # -- telemetry -----------------------------------------------------------
 
     def peer_p99_ms(self) -> float:
@@ -1080,6 +1199,9 @@ class PeerLane:
             "pod_peer_forwards": self.forwards,
             "pod_peer_served": self.served,
             "pod_peer_errors": self.errors,
+            "pod_bulk_forward_batches": self.bulk_forwards,
+            "pod_bulk_forward_rows": self.bulk_forward_rows,
+            "pod_bulk_served_rows": self.bulk_served_rows,
             "pod_peer_p99_ms": round(self.peer_p99_ms(), 3),
             "peer_health_state": self.health.states(),
             "peer_health_retries": self.retries,
@@ -1186,6 +1308,13 @@ class PodFrontend:
         self._inner_async = isinstance(limiter, AsyncRateLimiter)
         self._resilience = resilience or lane.cfg
         self._guards: Dict[int, _OwnerGuard] = {}
+        #: native pipeline with the shard-aware hot lane attached
+        #: (attach_pipeline, ISSUE 13); None = routed compiled plane
+        self.pipeline = None
+        #: lockstep global-mesh psum lane (parallel/mesh.py
+        #: PodPsumLane, ISSUE 13); eligible global namespaces decide
+        #: LOCALLY through it instead of funneling to a pin host
+        self.psum_lane = None
         # Pod observability plane (ISSUE 12): the typed event timeline,
         # the per-hop breakdown recorder and the federated signal
         # aggregator — always on (bounded rings, off the decision
@@ -1224,13 +1353,99 @@ class PodFrontend:
 
     async def configure_with(self, limits) -> None:
         limits = list(limits)
-        self.router.configure(limits, self._global_ns)
+        # The psum lane claims eligible global namespaces FIRST: the
+        # router must not pin what the lane decides locally everywhere
+        # (routed-share -> 1 is the whole point, ISSUE 13).
+        pinned_global = self._global_ns
+        if self.psum_lane is not None:
+            served = self.psum_lane.configure(limits, self._global_ns)
+            pinned_global = self._global_ns - served
+        self.router.configure(limits, pinned_global)
         self.events.emit(
             "routing_epoch", epoch=self.router.epoch, limits=len(limits)
         )
         res = self._limiter.configure_with(limits)
         if inspect.isawaitable(res):
             await res
+
+    # -- pod fast path (ISSUE 13) --------------------------------------------
+
+    def attach_pipeline(self, pipeline) -> None:
+        """Wire the native pipeline into the pod: the C hot lane learns
+        the topology + per-plan owner stamps (``attach_pod``) and this
+        lane's ``bulk_decide`` handler decides forwarded blob batches
+        on the local plane — the zero-Python path now serves pod mode."""
+        pipeline.attach_pod(self)
+        self.pipeline = pipeline
+        self.lane.bulk_cb = pipeline.decide_blobs_for_peer
+
+    def attach_psum_lane(self, lane) -> None:
+        """Attach the lockstep global-mesh psum lane: global-namespace
+        limits it can serve stop pinning to one host — every ingress
+        decides them locally against the pod-wide psum aggregate."""
+        self.psum_lane = lane
+
+    async def forward_bulk(
+        self, owner: int, blobs: List[bytes]
+    ) -> List[Optional[bytes]]:
+        """One bulk forward with the degraded-owner machinery applied
+        at BATCH granularity: an open breaker refuses the hop outright
+        (the pipeline falls back per-row into the frontend's stand-in
+        path), and batch failures feed the same breaker single forwards
+        feed."""
+        guard = self._guards.get(owner)
+        if guard is not None and guard.breaker.is_open():
+            raise StorageError(
+                f"pod peer host {owner} degraded (breaker open)"
+            )
+        try:
+            payloads = await self.lane.forward_bulk(owner, blobs)
+        except Exception as exc:
+            if guard is not None:
+                guard.breaker.record_failure(exc)
+            raise
+        if guard is not None:
+            guard.breaker.record_success()
+        return payloads
+
+    def forward_bulk_submit(self, owner: int, blobs: List[bytes]):
+        """Submit a bulk hop WITHOUT blocking: returns the
+        concurrent.futures handle (or ``None`` when the lane loop is
+        down). The engine path submits every owner's hop first and only
+        then collects, so a chunk spanning p-1 foreign owners pays
+        max-of-RPC-latencies, not sum."""
+        lane = self.lane
+        if lane._loop is None:
+            return None
+        return asyncio.run_coroutine_threadsafe(
+            self.forward_bulk(owner, blobs), lane._loop
+        )
+
+    def forward_bulk_collect(self, fut, n: int) -> List[Optional[bytes]]:
+        """Resolve a ``forward_bulk_submit`` handle; failures answer
+        all-None so every row falls back to its per-request path
+        instead of failing the chunk."""
+        if fut is None:
+            return [None] * n
+        try:
+            return fut.result(self.lane.cfg.deadline_s + 1.0)
+        except Exception:
+            return [None] * n
+
+    def routing_debug(self) -> dict:
+        """``GET /debug/pod/routing``: the ownership map an upstream LB
+        can learn (topology, shard blocks, pinned namespaces, epoch),
+        plus what the pod fast path is serving with."""
+        out = self.router.ownership_map()
+        out["peers"] = {
+            str(h): addr for h, addr in self.lane.peers.items()
+        }
+        out["native_hot_lane"] = self.pipeline is not None
+        out["psum_lane_namespaces"] = (
+            sorted(self.psum_lane.namespaces)
+            if self.psum_lane is not None else []
+        )
+        return out
 
     # -- pod observability plane (ISSUE 12) ----------------------------------
 
@@ -1314,11 +1529,10 @@ class PodFrontend:
     # -- routing helpers -----------------------------------------------------
 
     def _route(self, namespace, ctx) -> Tuple[str, int, List[Counter]]:
-        # Known cost: the wrapped limiter re-runs this same matching on
-        # the LOCAL path (no limiter entry point accepts precomputed
-        # counters yet — ROADMAP direction 1 follow-on d). The counters
-        # ride along for the degraded stand-in, which decides on
-        # exactly the counter set the owner would have.
+        # Matching runs ONCE per decision (ISSUE 13): the counters
+        # resolved here feed the wrapped limiter's ``counters=`` entry
+        # point on the local path, the degraded stand-in, and the psum
+        # lane — no path re-matches what the router already matched.
         counters = _counters_that_apply(
             self._limiter.storage, Namespace.of(namespace), ctx
         )
@@ -1330,38 +1544,59 @@ class PodFrontend:
         verdict, owner, _counters = self._route(namespace, ctx)
         return verdict, owner
 
-    async def _local_check(self, namespace, ctx, delta, load) -> CheckResult:
+    async def _local_check(
+        self, namespace, ctx, delta, load, counters=None
+    ) -> CheckResult:
         if self._inner_async:
             return await self._limiter.check_rate_limited_and_update(
-                namespace, ctx, delta, load
+                namespace, ctx, delta, load, counters=counters
             )
         return self._limiter.check_rate_limited_and_update(
-            namespace, ctx, delta, load
+            namespace, ctx, delta, load, counters=counters
         )
 
-    async def _local_is_limited(self, namespace, ctx, delta) -> CheckResult:
+    async def _local_is_limited(
+        self, namespace, ctx, delta, counters=None
+    ) -> CheckResult:
         if self._inner_async:
-            return await self._limiter.is_rate_limited(namespace, ctx, delta)
-        return self._limiter.is_rate_limited(namespace, ctx, delta)
+            return await self._limiter.is_rate_limited(
+                namespace, ctx, delta, counters=counters
+            )
+        return self._limiter.is_rate_limited(
+            namespace, ctx, delta, counters=counters
+        )
 
-    async def _local_update(self, namespace, ctx, delta) -> None:
+    async def _local_update(
+        self, namespace, ctx, delta, counters=None
+    ) -> None:
         if self._inner_async:
-            await self._limiter.update_counters(namespace, ctx, delta)
+            await self._limiter.update_counters(
+                namespace, ctx, delta, counters=counters
+            )
         else:
-            self._limiter.update_counters(namespace, ctx, delta)
+            self._limiter.update_counters(
+                namespace, ctx, delta, counters=counters
+            )
 
     async def _decide_for_peer(
         self, namespace, ctx, delta, load, kind
     ) -> Optional[CheckResult]:
         """Owner-side handler of a forwarded decision: we own it, so it
         runs the LOCAL path directly (no re-routing — a forward is
-        always terminal, one hop by construction)."""
+        always terminal, one hop by construction). Matching runs once,
+        here, and flows into the limiter's precomputed-counters entry
+        point."""
+        counters = _counters_that_apply(
+            self._limiter.storage, Namespace.of(namespace), ctx
+        )
         if kind == "is_rate_limited":
-            return await self._local_is_limited(namespace, ctx, delta)
+            return await self._local_is_limited(
+                namespace, ctx, delta, counters
+            )
         if kind == "update_counters":
-            await self._local_update(namespace, ctx, delta)
+            await self._local_update(namespace, ctx, delta, counters)
             return None
-        return await self._local_check(namespace, ctx, delta, load)
+        return await self._local_check(namespace, ctx, delta, load, counters)
 
     def _apply_from_peer(self, deltas: List[dict]) -> int:
         """Owner-side journal replay: a peer that failed over while we
@@ -1537,13 +1772,24 @@ class PodFrontend:
 
     # -- the limiter surface -------------------------------------------------
 
+    def _psum_serves(self, namespace) -> bool:
+        lane = self.psum_lane
+        return lane is not None and str(namespace) in lane.namespaces
+
     async def check_rate_limited_and_update(
         self, namespace, ctx, delta: int, load_counters: bool = False
     ) -> CheckResult:
+        if self._psum_serves(namespace):
+            counters = _counters_that_apply(
+                self._limiter.storage, Namespace.of(namespace), ctx
+            )
+            return self.psum_lane.check_and_update(
+                counters, delta, load_counters
+            )
         verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
             return await self._local_check(
-                namespace, ctx, delta, load_counters
+                namespace, ctx, delta, load_counters, counters
             )
         return await self._remote(
             owner, namespace, ctx, counters, delta, load_counters,
@@ -1551,18 +1797,31 @@ class PodFrontend:
         )
 
     async def is_rate_limited(self, namespace, ctx, delta: int) -> CheckResult:
+        if self._psum_serves(namespace):
+            counters = _counters_that_apply(
+                self._limiter.storage, Namespace.of(namespace), ctx
+            )
+            return self.psum_lane.is_rate_limited(counters, delta)
         verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
-            return await self._local_is_limited(namespace, ctx, delta)
+            return await self._local_is_limited(
+                namespace, ctx, delta, counters
+            )
         return await self._remote(
             owner, namespace, ctx, counters, delta, False,
             "is_rate_limited",
         )
 
     async def update_counters(self, namespace, ctx, delta: int) -> None:
+        if self._psum_serves(namespace):
+            counters = _counters_that_apply(
+                self._limiter.storage, Namespace.of(namespace), ctx
+            )
+            self.psum_lane.update_counters(counters, delta)
+            return
         verdict, owner, counters = self._route(namespace, ctx)
         if verdict == LOCAL:
-            await self._local_update(namespace, ctx, delta)
+            await self._local_update(namespace, ctx, delta, counters)
             return
         await self._remote(
             owner, namespace, ctx, counters, delta, False,
@@ -1605,9 +1864,13 @@ class PodFrontend:
         stats["pod_events"] = self.events.counts()
         stats["pod_event_seq"] = self.events.last_seq
         stats.update(self.aggregator.stats())
+        if self.psum_lane is not None:
+            stats.update(self.psum_lane.stats())
         return stats
 
     def close_pod(self) -> None:
+        if self.psum_lane is not None:
+            self.psum_lane.close()
         self.lane.stop()
 
 

@@ -62,6 +62,11 @@ HOT_MODULES = (
     # no-sync/no-implicit-asarray rules watch these modules too.
     "limitador_tpu/observability/pod_plane.py",
     "limitador_tpu/observability/events.py",
+    # pod fast path (ISSUE 13): the lockstep psum lane's decision
+    # surface (check_and_update/is_rate_limited/update_counters) is
+    # sync and lock-cheap by contract — never an RPC, never a device
+    # sync; the exchange round alone owns the collective transport.
+    "limitador_tpu/parallel/mesh.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit
@@ -69,9 +74,13 @@ HOT_MODULES = (
 #: ``forward``/``_forward``/``_remote``/``_degraded`` joined with the
 #: pod resilience plane (ISSUE 11): a forwarded or failed-over
 #: decision's whole latency budget runs through them.
+#: ``check_and_update``/``is_rate_limited``/``update_counters`` joined
+#: with the pod psum lane (ISSUE 13): its whole point is a local-only
+#: decision, so a sync or RPC smuggled into it defeats the lane.
 DECISION_PREFIXES = (
     "decide", "submit", "begin_", "_begin", "pad_hits",
     "forward", "_forward", "_remote", "_degraded",
+    "check_and_update", "is_rate_limited", "update_counters",
 )
 
 #: modules allowed to call ops/kernel.py functions: they own the pow2

@@ -329,6 +329,7 @@ class CompiledTpuLimiter(AsyncRateLimiter):
         ctx: Union[Context, Dict[str, str]],
         delta: int,
         load_counters: bool = False,
+        counters=None,
     ) -> CheckResult:
         namespace = Namespace.of(namespace)
         adm = getattr(self._tpu, "admission", None)
@@ -341,15 +342,20 @@ class CompiledTpuLimiter(AsyncRateLimiter):
             if isinstance(ctx, dict):
                 values, ctx = ctx, Context()
                 ctx.list_binding("descriptors", [values])
+                counters = None  # matched against the rebuilt context
             return await super().check_rate_limited_and_update(
-                namespace, ctx, delta, load_counters
+                namespace, ctx, delta, load_counters, counters=counters
             )
         values = _values_of(ctx)
         if values is None:
             # Context shape the compiler doesn't cover: exact inherited path.
             return await super().check_rate_limited_and_update(
-                namespace, ctx, delta, load_counters
+                namespace, ctx, delta, load_counters, counters=counters
             )
+        # The batched fast lane below matches columnar per FLUSH (one
+        # vectorized evaluation for the whole batch) — a per-request
+        # ``counters`` precompute has no second matching to save there,
+        # so it is deliberately ignored on this branch (ISSUE 13).
         # The wait for the batched device decision IS this request's
         # datastore time: a record span here rolls it up under the
         # should_rate_limit aggregate (queue/linger counts as idle, the

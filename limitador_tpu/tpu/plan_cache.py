@@ -53,6 +53,7 @@ __all__ = [
     "PLAN_KERNEL",
     "PLAN_OK",
     "PLAN_UNKNOWN",
+    "PLAN_FOREIGN",
 ]
 
 #: Prometheus families owned by this subsystem (lint-enforced against
@@ -68,6 +69,7 @@ METRIC_FAMILIES = (
 PLAN_KERNEL = 0   # resolved device hits; decision comes from the kernel
 PLAN_OK = 1       # no limit applies: answer the OK template directly
 PLAN_UNKNOWN = 2  # empty/absent domain: answer the UNKNOWN template
+PLAN_FOREIGN = 3  # pod: another host owns the counters — bulk-forward
 
 
 class DecisionPlan:
@@ -85,11 +87,11 @@ class DecisionPlan:
 
     __slots__ = (
         "kind", "namespace", "delta", "delta_capped", "nhits", "record",
-        "limit_names", "slots",
+        "limit_names", "slots", "owner",
     )
 
     def __init__(self, kind, namespace=None, delta=1, delta_capped=1,
-                 record=(), limit_names=(), slots=()):
+                 record=(), limit_names=(), slots=(), owner=-1):
         self.kind = kind
         self.namespace = namespace
         self.delta = delta
@@ -98,6 +100,10 @@ class DecisionPlan:
         self.nhits = len(record) // 4
         self.limit_names = limit_names
         self.slots = slots  # tuple of ints, for the reverse index
+        #: pod ownership (ISSUE 13): the host that must decide this
+        #: blob; -1 = locally owned (single-host deployments always -1).
+        #: PLAN_FOREIGN plans pin no slots — the counters live remote.
+        self.owner = owner
 
 
 class _BaseCache:

@@ -283,6 +283,39 @@ struct Cursor {
 };
 
 // ---------------------------------------------------------------------------
+// Pod ownership mirror (ISSUE 13): the C side of routing.py's
+// stable_hash — a zlib-identical CRC-32 (polynomial 0xEDB88320, init
+// and xor-out 0xFFFFFFFF) over the Python repr bytes of a counter key,
+// so the zero-Python hot lane can classify a repeat descriptor as
+// locally-owned or foreign without running any Python. The repr bytes
+// are produced once per unique blob on the Python miss path; the owner
+// verdict is stamped on the mirrored plan and every later begin reads
+// it as one int compare. Parity with zlib.crc32 is fuzz-asserted
+// (tests/test_pod.py).
+// ---------------------------------------------------------------------------
+
+const uint32_t* crc32_table() {
+  static uint32_t table[256];
+  static std::once_flag once;
+  std::call_once(once, [] {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++)
+        c = (c & 1u) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+  });
+  return table;
+}
+
+uint32_t crc32_bytes(const uint8_t* p, int64_t n) {
+  const uint32_t* t = crc32_table();
+  uint32_t c = 0xFFFFFFFFu;
+  for (int64_t i = 0; i < n; i++) c = t[(c ^ p[i]) & 0xFFu] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
 // Parallel pool: a tiny persistent worker pool for the hot lane's
 // GIL-free passes (ctypes releases the GIL around every call into this
 // library, so these threads parallelize host staging for real).
@@ -482,7 +515,16 @@ enum LaneKind {
   LANE_UNKNOWN = 3,
   LANE_OVER = 4,
   LANE_ERROR = 5,
+  // Pod tier (ISSUE 13): the plan's counters live on another host —
+  // the row is never staged locally; the begin answers
+  // LANE_FOREIGN_BASE + owner so Python bulk-forwards it.
+  LANE_FOREIGN = 6,
 };
+
+// out_kind encoding of a foreign-owned row: kind = BASE + owner host.
+// int8 bounds the pod at BASE..127 -> 119 hosts, far above any
+// deployment this repo targets (the Python binding mirrors this).
+constexpr int32_t LANE_FOREIGN_BASE = 8;
 
 //: per staged hit: slot, max_value, window_ms, bucket flag, name token
 constexpr int REC_STRIDE = 5;
@@ -498,6 +540,9 @@ struct PlanEntry {
   int32_t delta_capped = 1;
   int32_t nhits = 0;
   uint64_t rec_off = 0;  // into recs, REC_STRIDE per hit
+  // Pod ownership (ISSUE 13): the host that must decide this blob;
+  // -1 = locally owned / not stamped (single-host mode).
+  int32_t owner = -1;
   // Quota lease (ISSUE 6): admissions this plan may answer locally with
   // zero device work. The broker pre-debited the device counters for
   // the whole grant, so local consumption never outruns the table; the
@@ -541,6 +586,15 @@ struct PlanMirror {
   // cumulative stats (polled into the native_lane_* metric families)
   uint64_t hits = 0, misses = 0, staged_hits = 0, insertions = 0,
            invalidations = 0, overflows = 0;
+  // ---- pod ownership (ISSUE 13) ----------------------------------------
+  // hosts <= 1 disables the foreign split (single-host posture is
+  // byte-identical to the pre-pod lane). Set once via hp_pod_config
+  // under the pipeline's native lock, like every other mirror mutation.
+  int32_t pod_hosts = 0;
+  int32_t pod_host_id = 0;
+  int32_t pod_shards_per_host = 1;
+  // rows classified foreign-owned by the begin pass (cumulative)
+  uint64_t foreign = 0;
   // ---- quota leasing (ISSUE 6) ----------------------------------------
   // Disabled by default: with lease_enabled == 0 the begin pass is
   // byte-identical to the pre-lease lane (no consume, no candidates).
@@ -692,6 +746,17 @@ struct PlanMirror {
     by_slot.erase(it);
   }
 };
+
+// routing.PodTopology.owner_host over repr bytes: crc32 % total
+// shards, integer-divided into the owner's contiguous block.
+int32_t pod_owner_of(const PlanMirror& m, const uint8_t* key_repr,
+                     int32_t len) {
+  if (m.pod_hosts <= 1) return m.pod_host_id;
+  uint64_t total =
+      (uint64_t)m.pod_hosts * (uint64_t)m.pod_shards_per_host;
+  uint64_t h = (uint64_t)crc32_bytes(key_repr, len);
+  return (int32_t)((h % total) / (uint64_t)m.pod_shards_per_host);
+}
 
 struct Ctx {
   Interner interner{1 << 12};
@@ -910,8 +975,8 @@ int64_t hp_plan_count(void* c) {
   return (int64_t)((Ctx*)c)->mirror.live;
 }
 
-// out[8]: hits, misses, staged_hits, insertions, invalidations,
-// overflows, live plans, epoch
+// out[9]: hits, misses, staged_hits, insertions, invalidations,
+// overflows, live plans, epoch, foreign rows
 void hp_lane_stats(void* c, int64_t* out) {
   PlanMirror& m = ((Ctx*)c)->mirror;
   out[0] = (int64_t)m.hits;
@@ -922,6 +987,74 @@ void hp_lane_stats(void* c, int64_t* out) {
   out[5] = (int64_t)m.overflows;
   out[6] = (int64_t)m.live;
   out[7] = m.epoch;
+  out[8] = (int64_t)m.foreign;
+}
+
+// ---- pod ownership (ISSUE 13) ---------------------------------------------
+// The C mirror of routing.py's crc32 ownership verdict. hp_pod_hash is
+// context-free (the parity-fuzz anchor against zlib.crc32);
+// hp_pod_config arms the foreign split on a mirror; the two stamp
+// exports attach the deciding host to an already-mirrored plan — one
+// with the owner resolved in C from the counter key's repr bytes (the
+// single-key hot path), one with a pre-resolved owner (pinned
+// namespaces and key sets spanning hosts, where the verdict is the
+// router's, not one key's hash). All mirror-mutating calls run under
+// the pipeline's native lock, like plan_put.
+
+int64_t hp_pod_hash(const uint8_t* data, int32_t len) {
+  return (int64_t)crc32_bytes(data, len);
+}
+
+int32_t hp_pod_config(void* c, int32_t hosts, int32_t host_id,
+                      int32_t shards_per_host) {
+  // The foreign verdict rides an int8 lane code (LANE_FOREIGN_BASE +
+  // owner), so the largest encodable owner is 127 - LANE_FOREIGN_BASE:
+  // a bigger pod would wrap the code negative and fancy-index the
+  // WRONG response template instead of forwarding. Refuse to arm
+  // (return -1) — the caller serves the routed compiled plane.
+  if (hosts - 1 > 127 - LANE_FOREIGN_BASE) return -1;
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  m.pod_hosts = hosts;
+  m.pod_host_id = host_id;
+  m.pod_shards_per_host = shards_per_host < 1 ? 1 : shards_per_host;
+  return 0;
+}
+
+// Owner host of one counter key's repr bytes under the configured
+// topology (== routing.PodTopology.owner_host, parity-fuzzed).
+int32_t hp_pod_owner(void* c, const uint8_t* key_repr, int32_t len) {
+  return pod_owner_of(((Ctx*)c)->mirror, key_repr, len);
+}
+
+// Stamp a mirrored plan with the owner of its (single) counter key,
+// hashed HERE — the C side is authoritative for the per-key verdict.
+// Returns the stamped owner, or -1 when the plan is gone or the epoch
+// moved (the caller derived against dead limits; the next miss
+// re-stamps).
+int32_t hp_plan_stamp_owner(void* c, const uint8_t* blob, int32_t len,
+                            int64_t epoch, const uint8_t* key_repr,
+                            int32_t repr_len) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  if (epoch != m.epoch) return -1;
+  uint64_t h = Interner::fnv1a((const char*)blob, len);
+  int64_t j = m.find(blob, (uint32_t)len, h);
+  if (j < 0) return -1;
+  int32_t owner = pod_owner_of(m, key_repr, repr_len);
+  m.table[j].owner = owner;
+  return owner;
+}
+
+// Stamp a pre-resolved owner (pinned namespace / multi-key verdict);
+// owner < 0 clears the stamp (locally owned). Returns 1 on success.
+int32_t hp_plan_set_owner(void* c, const uint8_t* blob, int32_t len,
+                          int64_t epoch, int32_t owner) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  if (epoch != m.epoch) return 0;
+  uint64_t h = Interner::fnv1a((const char*)blob, len);
+  int64_t j = m.find(blob, (uint32_t)len, h);
+  if (j < 0) return 0;
+  m.table[j].owner = owner < 0 ? -1 : owner;
+  return 1;
 }
 
 // ---- quota leasing (ISSUE 6) ----------------------------------------------
@@ -1197,7 +1330,9 @@ int32_t hp_tel_exemplars(int64_t* out, int32_t cap) {
 //   out_ok_ns/out_ok_calls/out_ok_hits[n]: begin-time OK metric
 //       aggregation (plan-OK rows), n_ok_ns distinct namespaces
 //   out_meta[12]: k, nhits, H, hit_rows, miss_rows, overflow_rows,
-//       n_ok_ns, 0, then the telemetry tail (zeros with telemetry off):
+//       n_ok_ns, foreign_rows (pod: rows answered LANE_FOREIGN_BASE +
+//       owner for the bulk-forward lane), then the telemetry tail
+//       (zeros with telemetry off):
 //       lookup_ns, stage_ns, leased_rows, trace_id (nonzero only for
 //       1-in-N sampled begins when hp_tel_config set trace_sample)
 // Returns k (kernel rows staged).
@@ -1253,6 +1388,8 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   int64_t nhits = 0;
   int64_t leased_rows = 0;
   int64_t hit_rows = 0, miss_rows = 0, overflow_rows = 0;
+  int64_t foreign_rows = 0;
+  const bool pod_split = m.pod_hosts > 1;
   int32_t n_ok_ns = 0;
   auto aggregate_ok = [&](int32_t ns_token, int32_t delta) {
     int32_t g = 0;
@@ -1276,8 +1413,28 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
       miss_rows++;
       continue;
     }
-    hit_rows++;
     PlanEntry& e = m.table[j];
+    // Pod split (ISSUE 13): a plan stamped with a foreign owner never
+    // stages locally — the row's code carries the owner host and the
+    // Python side bulk-forwards it over the peer lane. Checked before
+    // lease consume on purpose: a foreign plan must never hold (or
+    // spend) a local lease.
+    if (pod_split && e.owner >= 0 && e.owner != m.pod_host_id) {
+      out_kind[r] = (int8_t)(LANE_FOREIGN_BASE + e.owner);
+      ent[r] = -1;
+      foreign_rows++;
+      continue;
+    }
+    if (e.kind == LANE_FOREIGN) {
+      // A foreign-kind plan whose owner stamp is missing or now maps
+      // to us (topology re-arm, stamp raced an epoch bump): re-derive
+      // through the miss lane rather than guess.
+      out_kind[r] = LANE_MISS;
+      ent[r] = -1;
+      miss_rows++;
+      continue;
+    }
+    hit_rows++;
     if (e.kind == LANE_KERNEL) {
       if (m.lease_enabled && e.lease_tokens > 0) {
         // Leased admission: the device counters were pre-debited at
@@ -1331,6 +1488,7 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   m.misses += (uint64_t)miss_rows;
   m.staged_hits += (uint64_t)nhits;
   m.overflows += (uint64_t)overflow_rows;
+  m.foreign += (uint64_t)foreign_rows;
 
   // Pass 3 (parallel): scatter plan records into the staging columns.
   auto stage_range = [&](int part, int parts) {
@@ -1381,7 +1539,7 @@ int32_t hp_hot_begin(void* c, const uint8_t* const* ptrs,
   out_meta[4] = miss_rows;
   out_meta[5] = overflow_rows;
   out_meta[6] = n_ok_ns;
-  out_meta[7] = 0;
+  out_meta[7] = foreign_rows;
   out_meta[8] = 0;
   out_meta[9] = 0;
   out_meta[10] = 0;

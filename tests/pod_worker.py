@@ -320,12 +320,155 @@ def routed_drive(args, info):
         loop.close()
 
 
+# -- pod fast path: the shard-aware native hot lane (ISSUE 13) ----------------
+
+HOT_D = "descriptors[0]"
+
+
+def hot_limits():
+    from limitador_tpu import Limit
+
+    return [
+        # single-limit namespace: per-key routing -> local + forwarded
+        # bulk traffic through the C ownership split
+        Limit("hotpods", 3, 60, [], [f"{HOT_D}.u"], name="per_user"),
+        # two limits -> the whole namespace pins to one host; its rows
+        # bulk-forward from the other ingress
+        Limit("hotmulti", 2, 60, [], [f"{HOT_D}.u"], name="multi_user"),
+        Limit("hotmulti", 30, 60, [], [], name="multi_total"),
+    ]
+
+
+def hot_blob(ns: str, user: str) -> bytes:
+    from limitador_tpu.server.proto import rls_pb2
+
+    req = rls_pb2.RateLimitRequest(domain=ns)
+    d = req.descriptors.add()
+    e = d.entries.add()
+    e.key, e.value = "u", user
+    return req.SerializeToString()
+
+
+def hot_drive_request(i: int):
+    ns = "hotpods" if i % 3 else "hotmulti"
+    return ns, f"u{i % DRIVE_USERS}", i % 2
+
+
+def hot_code(pipeline, out) -> str:
+    if out is None:
+        return "none"
+    if out == pipeline.OK_BLOB:
+        return "ok"
+    if out == pipeline.OVER_BLOB:
+        return "over"
+    if out is pipeline.STORAGE_ERROR:
+        return "storage_error"
+    return "other:" + out.hex()
+
+
+def hot_counter_state(loop, limiter, namespaces=("hotpods", "hotmulti")):
+    out = []
+    for ns in namespaces:
+        for c in loop.run_until_complete(limiter.get_counters(ns)):
+            out.append({
+                "ns": ns,
+                "limit": c.limit.name,
+                "vars": [list(kv) for kv in sorted(
+                    c.set_variables.items()
+                )],
+                "remaining": c.remaining,
+                "expires_ms": int(round((c.expires_in or 0) * 1000)),
+            })
+    out.sort(key=lambda r: (r["ns"], r["limit"], r["vars"]))
+    return out
+
+
+def hot_lane_drive(args, info):
+    """ISSUE 13 acceptance, inside the live pod: the shard-aware native
+    hot lane serves raw blobs — locally-owned repeats stage zero-Python
+    through the C ownership split, foreign-owned rows bulk-forward one
+    RPC per flush, pinned namespaces funnel whole — and the recorded
+    decisions + final counter state are compared (by the parent)
+    against a single-process hot pipeline on the same lockstep drive,
+    byte-identically."""
+    from limitador_tpu import native
+
+    if not (native.available() and native.pod_available()):
+        return {"hot_skipped": "native pod ownership mirror unavailable"}
+    from limitador_tpu.parallel import pod_barrier
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+    from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+    clock = _Clock()
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=1 << 12, clock=clock), max_delay=0.001
+        )
+    )
+    ports = [int(p) for p in args.hot_peer_ports.split(",")]
+    lane = PeerLane(
+        info.process_id,
+        f"127.0.0.1:{ports[info.process_id]}",
+        {
+            i: f"127.0.0.1:{port}"
+            for i, port in enumerate(ports)
+            if i != info.process_id
+        },
+        None,
+    )
+    router = PodRouter(PodTopology(
+        hosts=info.num_processes,
+        host_id=info.process_id,
+        shards_per_host=info.local_device_count,
+    ))
+    frontend = PodFrontend(limiter, router, lane)
+    loop = asyncio.new_event_loop()
+    try:
+        loop.run_until_complete(frontend.configure_with(hot_limits()))
+        pipeline = NativeRlsPipeline(
+            frontend, None, max_delay=0.001, hot_lane=True
+        )
+        if not pipeline.hot_lane_active:
+            return {"hot_skipped": "native hot lane inactive"}
+        frontend.attach_pipeline(pipeline)
+        lane.start()
+        pod_barrier("hot-drive-ready")
+        decisions = {}
+        for i in range(DRIVE_REQUESTS):
+            clock.now = DRIVE_T0 + i * DRIVE_STEP_S
+            ns, user, arrival = hot_drive_request(i)
+            if arrival == info.process_id:
+                out = pipeline.decide_many([hot_blob(ns, user)],
+                                           chunk=8)[0]
+                decisions[i] = hot_code(pipeline, out)
+            pod_barrier(f"hot-drive-{i}")
+        pod_barrier("hot-drive-done")
+        return {
+            "hot_decisions": decisions,
+            "hot_counters": hot_counter_state(loop, frontend),
+            "hot_lane": pipeline.lane_stats(),
+            "hot_bulk": {
+                "batches": lane.bulk_forwards,
+                "rows": lane.bulk_forward_rows,
+                "served": lane.bulk_served_rows,
+                "errors": lane.errors,
+            },
+        }
+    finally:
+        lane.stop()
+        loop.close()
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--process-id", type=int, required=True)
     parser.add_argument("--num-processes", type=int, required=True)
     parser.add_argument("--coordinator", required=True)
     parser.add_argument("--peer-ports", required=True)
+    parser.add_argument("--hot-peer-ports", default="")
     parser.add_argument("--out", required=True)
     args = parser.parse_args()
 
@@ -353,6 +496,8 @@ def main() -> int:
             "psum": psum_check(mesh, info),
         }
         out.update(routed_drive(args, info))
+        if args.hot_peer_ports:
+            out.update(hot_lane_drive(args, info))
     except Exception as exc:  # noqa: BLE001 - classified below
         message = f"{type(exc).__name__}: {exc}"
         print(f"pod worker failed: {message}", file=sys.stderr)

@@ -176,6 +176,28 @@ def test_dashboard_covers_pod_observability_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_pod_fast_path_families():
+    """ISSUE 13: the pod fast path ships WITH its Grafana row — a "Pod
+    fast path" row exists and every pod_hot_* / pod_bulk_* / pod_psum_*
+    family is referenced by at least one panel expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("pod fast path" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.parallel.mesh import (
+        METRIC_FAMILIES as PSUM_FAMILIES,
+    )
+
+    for family in PSUM_FAMILIES + (
+        "pod_hot_local_rows",
+        "pod_hot_foreign_rows",
+        "pod_bulk_forward_batches",
+        "pod_bulk_forward_rows",
+        "pod_bulk_served_rows",
+    ):
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

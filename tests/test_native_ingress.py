@@ -96,6 +96,57 @@ def test_enforces_exactly(ingress):
     assert codes == [OK, OK, OK, OVER, OVER]
 
 
+def test_enforces_with_hot_lane_off():
+    """The pipelined (non-coded) pump path: hot_lane=False forces every
+    blob batch through ``_decide_pipelined`` → ``_begin_batch``, which
+    no other test reaches (the default fixture's lane answers batches
+    coded). Regression: the ISSUE 13 pod split widened _begin_batch's
+    return to a 4-tuple and this call site kept unpacking 3, turning
+    ALL pipelined ingress traffic into INTERNAL errors."""
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(TpuStorage(capacity=1 << 10), max_delay=0.001)
+    )
+    limiter.add_limit(
+        Limit("api", 3, 60, [f"{D}.m == 'GET'"], [f"{D}.u"], name="q")
+    )
+    loop = asyncio.new_event_loop()
+    t = threading.Thread(target=loop.run_forever, daemon=True)
+    t.start()
+    pipeline = NativeRlsPipeline(
+        limiter, None, max_delay=0.001, hot_lane=False
+    )
+    assert pipeline.lane_code_templates() is None  # pipelined, not coded
+    ing = NativeIngress(
+        pipeline, host="127.0.0.1", port=0, loop=loop, poll_ms=2
+    )
+    channel = grpc.insecure_channel(f"127.0.0.1:{ing.port}")
+    call = channel.unary_unary(
+        ENVOY_METHOD,
+        request_serializer=rls_pb2.RateLimitRequest.SerializeToString,
+        response_deserializer=rls_pb2.RateLimitResponse.FromString,
+    )
+    try:
+        req = make_blob(entries={"m": "GET", "u": "alice"})
+        codes = [call(req, timeout=10).overall_code for _ in range(5)]
+        assert codes == [OK, OK, OK, OVER, OVER]
+    finally:
+        ing.close()
+        channel.close()
+
+        async def shutdown():
+            await pipeline.close()
+            await limiter.storage.counters.close()
+
+        asyncio.run_coroutine_threadsafe(shutdown(), loop).result(
+            timeout=10
+        )
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        loop.close()
+
+
 def test_empty_domain_unknown(ingress):
     _ing, call, *_ = ingress
     assert call(make_blob(domain=""), timeout=10).overall_code == UNKNOWN

@@ -155,7 +155,7 @@ def _decide_cached(pipeline, batch):
     (cached rows launch before miss rows), so parity here is exact
     byte-for-byte, ordering included."""
     with pipeline._native_lock:
-        results, _slow, pendings = pipeline._begin_batch_locked(
+        results, _slow, pendings, _foreign = pipeline._begin_batch_locked(
             list(batch), use_cache=True
         )
     for pending in pendings:
@@ -200,7 +200,7 @@ def test_fuzz_corpus_matches_no_cache_lane_serially(seed):
         for b in blobs:
             out_on = _norm(p_on.decide_many([b], chunk=8), p_on)
             with p_off._native_lock:
-                results, _slow, pendings = p_off._begin_batch_locked(
+                results, _slow, pendings, _foreign = p_off._begin_batch_locked(
                     [b], use_cache=False
                 )
             for pending in pendings:
@@ -383,6 +383,239 @@ def test_lease_corpus_conservation_and_settle(seed):
     assert stats["lease_granted_tokens"] == (
         stats["lease_admissions"] + stats["lease_returned_tokens"]
     ), stats
+
+
+# -- pod-mode shard-aware hot lane (ISSUE 13) ---------------------------------
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _build_pod_pair(resilient: bool = False):
+    """Two hot pipelines behind PodFrontends + real PeerLanes on
+    localhost — the server's pod wiring shape: each pipeline wraps its
+    host's frontend (the exact path keeps routed semantics) and
+    ``attach_pipeline`` arms the C ownership split + bulk lane.
+    ``resilient=True`` opts into the PR 11 degraded-owner machinery
+    (the server default); False pins the legacy fail-fast posture the
+    parity drives want."""
+    pytest.importorskip("grpc")
+    import asyncio
+
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+
+    if not native.pod_available():
+        pytest.skip("native pod ownership mirror unavailable")
+    resilience = PodResilience(probe_interval_s=0.1) if resilient else None
+    ports = [_free_port(), _free_port()]
+    pipelines, frontends, lanes, limiters = [], [], [], []
+    for host in range(2):
+        limiter = CompiledTpuLimiter(
+            AsyncTpuStorage(
+                TpuStorage(capacity=1 << 12, clock=lambda: FROZEN_NOW),
+                max_delay=0.001,
+            )
+        )
+        lane = PeerLane(
+            host,
+            f"127.0.0.1:{ports[host]}",
+            {
+                other: f"127.0.0.1:{ports[other]}"
+                for other in range(2)
+                if other != host
+            },
+            None,
+            resilience=resilience,
+        )
+        lane.start()
+        router = PodRouter(
+            PodTopology(hosts=2, host_id=host, shards_per_host=1)
+        )
+        frontend = PodFrontend(limiter, router, lane)
+        asyncio.run(frontend.configure_with(_limits()))
+        pipeline = NativeRlsPipeline(
+            frontend, None, max_delay=0.001, hot_lane=True
+        )
+        assert pipeline.hot_lane_active
+        frontend.attach_pipeline(pipeline)
+        pipelines.append(pipeline)
+        frontends.append(frontend)
+        lanes.append(lane)
+        limiters.append(limiter)
+    return pipelines, frontends, lanes, limiters
+
+
+@pytest.mark.parametrize("seed", [21, 22])
+def test_pod_hot_lane_fuzz_matches_single_process_oracle(seed):
+    """THE pod byte-parity drive (ISSUE 13): the full fuzz corpus
+    arrives round-robin at a 2-host pod whose native hot lanes are
+    shard-aware — locally-owned rows stage zero-Python, foreign-owned
+    rows bulk-forward one RPC per (owner, flush), pinned namespaces
+    funnel whole — and every response is byte-identical to a
+    single-process hot pipeline on the same sequence, with the UNION of
+    both hosts' final counter state identical to the oracle's (each
+    counter lives on exactly one host)."""
+    blobs = _corpus(seed, n=260)
+    pipelines, frontends, lanes, limiters = _build_pod_pair()
+    p_oracle, lim_oracle = _build(True)
+    try:
+        for _pass in range(2):  # pass 2 rides the mirrored owner stamps
+            for step, ofs in enumerate(range(0, len(blobs), 48)):
+                batch = blobs[ofs:ofs + 48]
+                arrival = pipelines[step % 2]  # round-robin ingress
+                out_pod = _norm(
+                    arrival.decide_many(batch, chunk=16), arrival
+                )
+                out_oracle = _norm(
+                    p_oracle.decide_many(batch, chunk=16), p_oracle
+                )
+                assert out_pod == out_oracle, f"pass {_pass} batch {ofs}"
+        state_pod = _counter_state(limiters[0]) | _counter_state(
+            limiters[1]
+        )
+        assert state_pod == _counter_state(lim_oracle)
+        # no counter is double-homed
+        assert not (
+            _counter_state(limiters[0]) & _counter_state(limiters[1])
+        )
+        # the split really engaged on BOTH sides of the lane
+        foreign = sum(
+            p.lane_stats()["foreign"] for p in pipelines
+        )
+        assert foreign > 0, "no foreign rows classified"
+        bulk_batches = sum(lane.bulk_forwards for lane in lanes)
+        bulk_rows = sum(lane.bulk_forward_rows for lane in lanes)
+        served_rows = sum(lane.bulk_served_rows for lane in lanes)
+        assert bulk_batches > 0 and bulk_rows >= bulk_batches
+        assert served_rows == bulk_rows  # every forwarded row served
+        # bulk amortization: strictly fewer RPCs than rows forwarded
+        # (the 1-RPC-per-decision floor this lane exists to beat) —
+        # the corpus repeats descriptors, so flushes group rows
+        assert bulk_batches < bulk_rows
+        stats = pipelines[0].pod_stats()
+        assert stats["pod_hot_foreign_rows"] + stats[
+            "pod_hot_local_rows"] > 0
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_pod_hot_lane_degraded_owner_falls_back_exact():
+    """A dead owner host must not fail (or mis-decide) its foreign
+    rows: the bulk forward fails, every row falls back to the exact
+    per-request path whose limiter is the pod frontend — the PR 11
+    degraded stand-in decides exactly, so the sequence still matches
+    the single-process oracle byte for byte."""
+    import asyncio
+    import threading
+
+    from limitador_tpu.routing import PodRouter
+    from limitador_tpu.server.proto import rls_pb2
+
+    pipelines, frontends, lanes, limiters = _build_pod_pair(
+        resilient=True
+    )
+    p_oracle, _ = _build(True)
+
+    def blob(u):
+        req = rls_pb2.RateLimitRequest(domain="api")
+        d = req.descriptors.add()
+        e = d.entries.add()
+        e.key, e.value = "m", "GET"
+        e = d.entries.add()
+        e.key, e.value = "u", u
+        return req.SerializeToString()
+
+    # "api" is multi-limit -> pinned whole to one deterministic host;
+    # drive from the OTHER host with the pin host's lane dead.
+    pin = PodRouter.pin_host("api", 2)
+    origin = pipelines[1 - pin]
+    try:
+        lanes[pin].stop()  # the owner is gone mid-serve
+        seq = [blob("degraded-user")] * 6
+
+        async def drive():
+            outs = []
+            for b in seq:
+                outs.append(await origin.submit_async(b))
+            return outs
+
+        loop = asyncio.new_event_loop()
+        t = threading.Thread(target=loop.run_forever, daemon=True)
+        t.start()
+        try:
+            outs = asyncio.run_coroutine_threadsafe(
+                drive(), loop
+            ).result(60)
+        finally:
+            loop.call_soon_threadsafe(loop.stop)
+            t.join(5)
+        want = [p_oracle.decide_many([b], chunk=8)[0] for b in seq]
+        assert outs == want  # 3 OK then 3 OVER (per-get limit 3)
+        # the decisions came from the degraded machinery, not the lane
+        stats = frontends[1 - pin].library_stats()
+        assert stats["pod_failover_degraded_decisions"] >= 1, stats
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+def test_pod_psum_served_namespace_takes_exact_path():
+    """A psum-claimed global namespace must NOT ride the columnar hot
+    lane (the device table would double-count what the psum lane
+    serves): its rows answer None from the engine path — the exact
+    per-request fallback owns them — while other namespaces keep the
+    fast path."""
+    import asyncio
+
+    from limitador_tpu.parallel.mesh import PodPsumLane
+    from limitador_tpu.server.proto import rls_pb2
+
+    pipelines, frontends, lanes, limiters = _build_pod_pair()
+    try:
+        for host, f in enumerate(frontends):
+            lane = PodPsumLane(2, host, clock=lambda: FROZEN_NOW)
+            f.attach_psum_lane(lane)
+            asyncio.run(f.configure_with(_limits()))
+        # re-derive namespace plans under the new claim
+        for p in pipelines:
+            p.invalidate()
+        # "shared" (fixed-window, empty vars) becomes psum-served once
+        # it is global; claim it on both hosts
+        for f in frontends:
+            f._global_ns = {"shared"}
+            asyncio.run(f.configure_with(_limits()))
+        for p in pipelines:
+            p.invalidate()
+
+        def blob(domain, u):
+            req = rls_pb2.RateLimitRequest(domain=domain)
+            d = req.descriptors.add()
+            e = d.entries.add()
+            e.key, e.value = "m", "GET"
+            e = d.entries.add()
+            e.key, e.value = "u", u
+            return req.SerializeToString()
+
+        out = pipelines[0].decide_many(
+            [blob("shared", "s1"), blob("api", "a1")], chunk=8
+        )
+        assert out[0] is None  # psum-served: exact path owns it
+        assert out[1] is not None  # other namespaces keep the lane
+    finally:
+        for lane in lanes:
+            lane.stop()
 
 
 def test_native_partition_matches_numpy():

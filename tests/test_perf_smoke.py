@@ -78,6 +78,13 @@ USAGE_DRAIN_BUDGET_MS = 50.0
 #: device round trip or a full metrics render inside the snapshot blows
 #: this by an order of magnitude.
 SIGNALS_RENDER_BUDGET_MS = 20.0
+#: per-row budget for the pod-armed hot lane (ISSUE 13): the C-side
+#: ownership pass adds ONE int compare per plan-hit row (the stamped
+#: owner vs this host), so a pod-armed begin over locally-owned
+#: repeats must cost the same as the plain lane — a regression that
+#: re-routes the ownership verdict through per-row Python (repr +
+#: crc32 per row) measures 2-5 µs/row and blows this immediately.
+POD_OWNERSHIP_BUDGET_NS = 1200.0
 #: wall-clock budget for the ENTIRE static-analysis gate (ISSUE 9):
 #: every registered pass over the full default target set, one shared
 #: parse per file. Measures ~4-5 s on the throttled CI box; the budget
@@ -242,6 +249,77 @@ def test_native_lane_staging_and_response_build_within_budget(pipeline):
         f"(budget {NATIVE_LANE_BUDGET_NS} ns — did staging or response "
         "build fall back to Python?)"
     )
+
+
+def test_pod_ownership_pass_within_budget(pipeline):
+    """Pod-armed begins over locally-owned repeats: the ownership pass
+    is one stamped-int compare per row IN C — staged.k must stay == n
+    (no row leaks to the miss/foreign lanes) and the per-row cost must
+    match the plain lane's budget. Foreign-owned repeats must classify
+    with ZERO staging (k == 0, every code carries the owner) at the
+    same cost — the split itself is free either way."""
+    p, _limiter = pipeline
+    lane = p._hot_lane
+    if lane is None or not native.pod_available():
+        pytest.skip("native pod ownership mirror unavailable")
+    blobs = _blobs(4096)
+    p.decide_many(blobs, chunk=len(blobs))  # derive + mirror the plans
+    epoch = p.plan_cache.epoch
+    uniques = sorted(set(blobs))
+    admitted = np.ones(len(blobs), bool)
+    hit_ok = np.ones(lane.cap, bool)
+    try:
+        with p._native_lock:
+            # arm a 2-host pod; every plan stamped LOCAL (host 0)
+            p.hp.pod_config(2, 0, 1)
+            for blob in uniques:
+                lane.plan_set_owner(blob, epoch, 0)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            with p._native_lock:
+                staged = lane.begin(blobs, epoch)
+            lane.finish(staged, admitted, hit_ok)
+            best = min(best, time.perf_counter() - t0)
+        assert staged.k == len(blobs), (
+            f"pod-armed lane staged only {staged.k}/{len(blobs)} "
+            "locally-owned rows (ownership pass leaked rows to the "
+            "miss/foreign lanes)"
+        )
+        assert staged.foreign_rows == 0
+        per_row_ns = best / len(blobs) * 1e9
+        assert per_row_ns <= POD_OWNERSHIP_BUDGET_NS, (
+            f"pod ownership pass costs {per_row_ns:.0f} ns/row "
+            f"(budget {POD_OWNERSHIP_BUDGET_NS} ns — did the verdict "
+            "fall back to per-row Python?)"
+        )
+        # flip every plan foreign: the begin must classify all rows
+        # with zero staging, still within budget
+        with p._native_lock:
+            for blob in uniques:
+                lane.plan_set_owner(blob, epoch, 1)
+        best = float("inf")
+        for _ in range(5):
+            t0 = time.perf_counter()
+            with p._native_lock:
+                staged = lane.begin(blobs, epoch)
+            best = min(best, time.perf_counter() - t0)
+        assert staged.k == 0
+        assert staged.foreign_rows == len(blobs)
+        assert int(
+            (staged.codes >= native.LANE_FOREIGN_BASE).sum()
+        ) == len(blobs)
+        per_row_ns = best / len(blobs) * 1e9
+        assert per_row_ns <= POD_OWNERSHIP_BUDGET_NS, (
+            f"foreign classification costs {per_row_ns:.0f} ns/row "
+            f"(budget {POD_OWNERSHIP_BUDGET_NS} ns)"
+        )
+    finally:
+        # module-scoped pipeline: restore the single-host posture
+        with p._native_lock:
+            for blob in uniques:
+                lane.plan_set_owner(blob, epoch, -1)
+            p.hp.pod_config(0, 0, 1)
 
 
 def test_leased_hit_lane_within_budget(pipeline):

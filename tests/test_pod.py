@@ -433,12 +433,422 @@ def test_forwarded_request_id_propagates_to_owner():
             lane.stop()
 
 
+# -- pod fast path (ISSUE 13): crc32 mirror, bulk lane, psum lane --------------
+
+
+def _adversarial_keys():
+    """Counter-key corpus for the C/Python ownership parity fuzz: every
+    repr shape the crc32 mirror must hash byte-identically — empty
+    values, long values, non-ASCII, multi-variable identity tuples,
+    namespace-pinned single-key tuples, quotes/backslashes (repr
+    escaping), and surrogate-free astral unicode."""
+    keys = []
+    idents = [
+        ("ns", "limit"),
+        ("ns", "limit", 5, 60),
+        ("", ""),
+        ("näme-spaçe", "límît"),
+        ("ns'quoted\"", "back\\slash"),
+        ("\U0001f680pod", "astral"),
+    ]
+    values = [
+        "", "plain", "x" * 500, "non-ascii-é-ü-ß", "线程-池",
+        "it's \"quoted\"", "tab\tnewline\n", "\U0001f680",
+    ]
+    for ident in idents:
+        for v in values:
+            keys.append((ident, (("u", v),)))
+            keys.append((ident, (("a", v), ("b", v + "2"))))
+        keys.append((ident, ()))
+    return keys
+
+
+def test_crc32_ownership_parity_fuzz():
+    """Tentpole anchor (ISSUE 13): the C-side crc32 (hp_pod_hash) and
+    the plan-owner verdict (hp_pod_owner) are byte-identical to
+    routing.stable_hash / PodTopology.owner_host for every adversarial
+    key — the zero-Python lane's ownership split can never disagree
+    with the router."""
+    from limitador_tpu import native
+
+    if not (native.available() and native.pod_available()):
+        pytest.skip("native pod ownership mirror unavailable")
+    hp = native.HostPath()
+    try:
+        for hosts, sph in ((2, 1), (2, 4), (3, 2), (7, 8)):
+            topo = PodTopology(hosts=hosts, host_id=0,
+                               shards_per_host=sph)
+            hp.pod_config(hosts, 0, sph)
+            for key in _adversarial_keys():
+                data = repr(key).encode()
+                assert native.pod_hash(data) == stable_hash(key), key
+                assert hp.pod_owner(data) == topo.owner_host(key), (
+                    hosts, sph, key,
+                )
+        # hosts <= 1 disables the split: every key answers host_id
+        hp.pod_config(1, 0, 4)
+        assert all(
+            hp.pod_owner(repr(k).encode()) == 0
+            for k in _adversarial_keys()[:8]
+        )
+        # the int8 lane-code encoding caps the pod at
+        # 128 - LANE_FOREIGN_BASE hosts: the largest legal topology
+        # arms, one past it refuses (mis-routing is never an option)
+        cap = 128 - native.LANE_FOREIGN_BASE
+        hp.pod_config(cap, 0, 1)
+        with pytest.raises(RuntimeError, match="int8 owner encoding"):
+            hp.pod_config(cap + 1, 0, 1)
+    finally:
+        hp.close()
+
+
+def test_crc32_parity_against_zlib_random_bytes():
+    """The C table IS zlib's polynomial: raw random byte strings (not
+    just reprs) hash identically, so any future caller hashing
+    non-repr bytes stays correct."""
+    import zlib
+
+    from limitador_tpu import native
+
+    if not (native.available() and native.pod_available()):
+        pytest.skip("native pod ownership mirror unavailable")
+    import random
+
+    rng = random.Random(13)
+    for n in (0, 1, 7, 64, 1024, 9000):
+        data = bytes(rng.getrandbits(8) for _ in range(n))
+        assert native.pod_hash(data) == zlib.crc32(data)
+
+
+def test_router_verdict_is_pure_and_plan_counts():
+    """``verdict()`` (the native derivation pass's entry point) returns
+    exactly what ``plan()`` returns but never mutates the routed-share
+    counters — the C lane's own local/foreign tallies count routed hot
+    traffic instead."""
+    topo = PodTopology(hosts=2, host_id=0, shards_per_host=2)
+    router = PodRouter(topo)
+    keys = [(("solo", f"{i}"), ()) for i in range(40)]
+    before = router.stats()
+    verdicts = [router.verdict("solo", [k]) for k in keys]
+    assert router.stats() == before  # pure
+    plans = [router.plan("solo", [k]) for k in keys]
+    assert verdicts == plans
+    after = router.stats()
+    assert (
+        after["pod_routed_local"] + after["pod_routed_forwarded"]
+        == before["pod_routed_local"] + before["pod_routed_forwarded"]
+        + len(keys)
+    )
+
+
+def test_ownership_map_debug_surface():
+    """``GET /debug/pod/routing`` (ISSUE 13): the ownership map carries
+    everything an upstream LB needs — topology, contiguous shard
+    blocks, the pinned-namespace map and the routing epoch — and the
+    frontend's surface adds peers + fast-path state."""
+    from limitador_tpu import Limit
+
+    topo = PodTopology(hosts=2, host_id=1, shards_per_host=4)
+    router = PodRouter(topo)
+    router.configure(
+        [
+            Limit("multi", 2, 60, [], ["u"], name="a"),
+            Limit("multi", 30, 60, [], [], name="b"),
+        ],
+        global_namespaces=[],
+    )
+    m = router.ownership_map()
+    assert m["hosts"] == 2 and m["host_id"] == 1
+    assert m["shards_per_host"] == 4 and m["total_shards"] == 8
+    assert m["shard_blocks"] == {"0": [0, 4], "1": [4, 8]}
+    assert m["pinned_namespaces"] == {
+        "multi": PodRouter.pin_host("multi", 2)
+    }
+    assert m["epoch"] >= 1
+    # the map is the exact verdict: owner_host recomputes from it
+    key = (("solo", "k"), (("u", "alice"),))
+    g = stable_hash(key) % m["total_shards"]
+    assert g // m["shards_per_host"] == topo.owner_host(key)
+
+
+def test_bulk_forward_carries_request_id_and_hop_breakdown():
+    """The bulk-forward lane (ISSUE 13) keeps the PR 12 hop contract:
+    the origin's x-request-id rides the gRPC metadata and is adopted on
+    the owner, and the origin records the 4-phase hop breakdown under
+    the ``_bulk`` namespace with the owner's reported decide time."""
+    from limitador_tpu.observability.device_plane import (
+        current_request_id,
+        set_request_id,
+    )
+
+    frontends, lanes = _two_host_frontends()
+    try:
+        seen = {}
+
+        async def bulk_handler(blobs):
+            seen["rid"] = current_request_id()
+            seen["n"] = len(blobs)
+            return [b"ok:" + b for b in blobs]
+
+        lanes[1].bulk_cb = bulk_handler
+        hops = []
+        lanes[0].on_hop = (
+            lambda host, rid, ns, total, phases:
+            hops.append((host, rid, ns, total, phases))
+        )
+
+        async def scenario():
+            set_request_id("bulk-rid-7")
+            return await lanes[0].forward_bulk(1, [b"a", b"bb", b"ccc"])
+
+        payloads = asyncio.run(scenario())
+        assert payloads == [b"ok:a", b"ok:bb", b"ok:ccc"]
+        assert seen == {"rid": "bulk-rid-7", "n": 3}
+        assert lanes[0].bulk_forwards == 1
+        assert lanes[0].bulk_forward_rows == 3
+        assert lanes[1].bulk_served_rows == 3
+        stats = lanes[0].stats()
+        assert stats["pod_bulk_forward_batches"] == 1
+        assert stats["pod_bulk_forward_rows"] == 3
+        (host, rid, ns, total, phases), = hops
+        assert host == 1 and rid == "bulk-rid-7" and ns == "_bulk"
+        assert set(phases) == {
+            "queue", "serialize", "wire", "remote_decide",
+        }
+        assert total > 0 and phases["remote_decide"] >= 0
+        # None rows survive the wire round trip as None (the origin's
+        # per-request fallback contract)
+        async def none_handler(blobs):
+            return [None for _ in blobs]
+
+        lanes[1].bulk_cb = none_handler
+
+        async def scenario_none():
+            return await lanes[0].forward_bulk(1, [b"x", b"y"])
+
+        assert asyncio.run(scenario_none()) == [None, None]
+        # no handler attached: the bulk hop fails loudly (counted), it
+        # never silently admits
+        lanes[1].bulk_cb = None
+
+        async def scenario_refused():
+            with pytest.raises(Exception):
+                await lanes[0].forward_bulk(1, [b"z"])
+
+        asyncio.run(scenario_refused())
+    finally:
+        for lane in lanes:
+            lane.stop()
+
+
+# -- the lockstep psum lane (parallel/mesh.py PodPsumLane) ---------------------
+
+
+def _psum_pair(clock):
+    """Two psum lanes glued by an in-process lockstep transport (each
+    round folds the OTHER lane's live partials, packed at the same
+    logical time — exactly what the KV transport does over the
+    coordination service)."""
+    from limitador_tpu.parallel.mesh import PodPsumLane
+
+    lanes = [
+        PodPsumLane(2, 0, clock=clock),
+        PodPsumLane(2, 1, clock=clock),
+    ]
+
+    def transport_for(me):
+        other = lanes[1 - me]
+
+        def transport(round_idx, payload):
+            peer_payload = other._pack(clock())
+            out = [None, None]
+            out[me] = payload
+            out[1 - me] = peer_payload
+            return out
+
+        return transport
+
+    for host, lane in enumerate(lanes):
+        lane._transport = transport_for(host)
+    return lanes
+
+
+def _mk_counter(limit, **vars_):
+    from limitador_tpu import Context
+    from limitador_tpu.core.counter import Counter
+
+    return Counter.new(limit, Context(dict(vars_)))
+
+
+def test_psum_lane_configure_claims_fixed_window_only():
+    """The GCRA TAT cell cannot be a summed partial — token-bucket
+    namespaces stay pinned (the device psum region's own exclusion)."""
+    from limitador_tpu import Limit
+    from limitador_tpu.parallel.mesh import PodPsumLane
+
+    lane = PodPsumLane(2, 0)
+    limits = [
+        Limit("gfw", 5, 60, [], ["u"], name="a"),
+        Limit("gtb", 5, 60, [], ["u"], name="b", policy="token_bucket"),
+        Limit("gmix", 5, 60, [], ["u"], name="c"),
+        Limit("gmix", 9, 60, [], [], name="d", policy="token_bucket"),
+    ]
+    served = lane.configure(limits, {"gfw", "gtb", "gmix", "gmissing"})
+    assert served == frozenset({"gfw"})
+    assert lane.namespaces == frozenset({"gfw"})
+
+
+def test_psum_lane_folds_remote_partials():
+    """Host A cannot see B's admissions between rounds (the bounded
+    blind spot); after one lockstep exchange the folded base makes A
+    reject exactly where a single global counter would."""
+    from limitador_tpu import Limit
+
+    now = {"t": 1_700_000_000.0}
+    a, b = _psum_pair(lambda: now["t"])
+    limit = Limit("gfw", 5, 60, [], ["u"], name="a")
+    for lane in (a, b):
+        lane.configure([limit], {"gfw"})
+    c = _mk_counter(limit, u="alice")
+    # 3 admits on A, 2 on B — every one admitted (5 total == max)
+    for _ in range(3):
+        assert not a.check_and_update([c], 1).limited
+    for _ in range(2):
+        assert not b.check_and_update([c], 1).limited
+    # blind spot: A still sees only its own 3
+    assert not a.is_rate_limited([c], 1).limited
+    # lockstep round: both lanes fold the other's partials
+    a.exchange()
+    b.exchange()
+    assert a.is_rate_limited([c], 1).limited
+    r = a.check_and_update([c], 1)
+    assert r.limited and r.limit_name == "a"
+    assert b.check_and_update([c], 1).limited
+    stats = a.stats()
+    assert stats["pod_psum_exchanges"] == 1
+    assert stats["pod_psum_limited"] >= 1
+    assert stats["pod_psum_remote_slots"] >= 1
+    assert stats["pod_psum_cells"] >= 1
+
+
+def test_psum_lane_over_admission_bounded_by_exchange_interval():
+    """The inaccuracy contract: between rounds each host over-admits at
+    most its own headroom view — never more than max_value per host —
+    and one exchange collapses the view to the global sum."""
+    from limitador_tpu import Limit
+
+    now = {"t": 1_700_000_000.0}
+    a, b = _psum_pair(lambda: now["t"])
+    limit = Limit("gfw", 4, 60, [], ["u"], name="a")
+    for lane in (a, b):
+        lane.configure([limit], {"gfw"})
+    c = _mk_counter(limit, u="bob")
+    admitted = 0
+    for _ in range(10):
+        if not a.check_and_update([c], 1).limited:
+            admitted += 1
+        if not b.check_and_update([c], 1).limited:
+            admitted += 1
+    # worst case bound: each host admits up to max_value on its own
+    assert admitted <= 2 * limit.max_value
+    a.exchange()
+    b.exchange()
+    assert a.check_and_update([c], 1).limited
+    assert b.check_and_update([c], 1).limited
+
+
+def test_psum_lane_expiry_and_load_counters():
+    """Remote partials expire with their window (an expired slot folds
+    as zero), and load_counters populates remaining/expires_in from the
+    summed view."""
+    from limitador_tpu import Limit
+
+    now = {"t": 1_700_000_000.0}
+    a, b = _psum_pair(lambda: now["t"])
+    limit = Limit("gfw", 10, 60, [], ["u"], name="a")
+    for lane in (a, b):
+        lane.configure([limit], {"gfw"})
+    c = _mk_counter(limit, u="eve")
+    for _ in range(4):
+        assert not b.check_and_update([c], 1).limited
+    a.exchange()
+    b.exchange()
+    r = a.check_and_update([c], 1, load_counters=True)
+    assert not r.limited
+    loaded, = r.counters
+    # summed view: B's 4 + this admit = 5 -> remaining 5
+    assert loaded.remaining == 5
+    assert loaded.expires_in is not None and loaded.expires_in > 0
+    # window rolls: the remote base expires out, local cell restarts
+    now["t"] += 61.0
+    r2 = a.check_and_update([c], 1, load_counters=True)
+    assert not r2.limited
+    assert r2.counters[0].remaining == 9
+    assert a.stats()["pod_psum_remote_slots"] == 0
+
+
+def test_psum_lane_update_counters_and_frontend_claim():
+    """update_counters (Report lane) lands in the local partial; the
+    frontend's configure_with carves served namespaces out of the
+    pinned set and routes their decisions to the lane (never a hop)."""
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.parallel.mesh import PodPsumLane
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    pytest.importorskip("grpc")
+    now = {"t": 1_700_000_000.0}
+    lane = PodPsumLane(2, 0, clock=lambda: now["t"])
+    port = _free_port()
+    peer = PeerLane(0, f"127.0.0.1:{port}", {}, None)
+    router = PodRouter(
+        PodTopology(hosts=2, host_id=0, shards_per_host=1)
+    )
+    frontend = PodFrontend(
+        RateLimiter(InMemoryStorage(1024)), router, peer,
+        global_namespaces={"gfw", "gtb"},
+    )
+    frontend.attach_psum_lane(lane)
+    limits = [
+        Limit("gfw", 5, 60, [], ["u"], name="a"),
+        Limit("gtb", 5, 60, [], ["u"], name="b",
+              policy="token_bucket"),
+    ]
+
+    async def scenario():
+        await frontend.configure_with(limits)
+        # gfw is psum-served: LOCAL decision on every host, no hop,
+        # even though pin_host("gfw", 2) may be host 1
+        r1 = await frontend.check_rate_limited_and_update(
+            "gfw", Context({"u": "zoe"}), 1, False
+        )
+        await frontend.update_counters("gfw", Context({"u": "zoe"}), 2)
+        r2 = await frontend.is_rate_limited(
+            "gfw", Context({"u": "zoe"}), 3
+        )
+        return r1, r2
+
+    r1, r2 = asyncio.run(scenario())
+    assert not r1.limited
+    assert r2.limited  # 1 + 2 + probe 3 > 5
+    # the router pins ONLY the unclaimed global namespace
+    assert router.ownership_map()["pinned_namespaces"] == {
+        "gtb": PodRouter.pin_host("gtb", 2)
+    }
+    assert lane.stats()["pod_psum_decisions"] >= 2
+    assert frontend.library_stats()["pod_psum_namespaces"] == 1
+    assert frontend.routing_debug()["psum_lane_namespaces"] == ["gfw"]
+
+
 # -- the real 2-process jax.distributed pod (slow) -----------------------------
 
 
 def _spawn_pod(tmp_path, num_processes=2, local_devices=2, timeout=420):
     coordinator = f"127.0.0.1:{_free_port()}"
     peer_ports = ",".join(str(_free_port()) for _ in range(num_processes))
+    hot_peer_ports = ",".join(
+        str(_free_port()) for _ in range(num_processes)
+    )
     env = {
         k: v for k, v in os.environ.items()
         if not k.startswith("TPU_POD_")
@@ -460,6 +870,7 @@ def _spawn_pod(tmp_path, num_processes=2, local_devices=2, timeout=420):
                 "--num-processes", str(num_processes),
                 "--coordinator", coordinator,
                 "--peer-ports", peer_ports,
+                "--hot-peer-ports", hot_peer_ports,
                 "--out", str(out),
             ],
             env=env,
@@ -565,6 +976,75 @@ def test_pod_cross_host_tracing_and_federated_view(pod_results):
         assert events["counts"]["routing_epoch"] >= 1
         seqs = [e["seq"] for e in events["events"]]
         assert seqs == sorted(seqs)
+
+
+@pytest.mark.slow
+def test_pod_hot_lane_drive_matches_single_process(pod_results):
+    """ISSUE 13 acceptance, live 2-process pod: the shard-aware native
+    hot lane's decisions (forwarded-in-bulk descriptors included) and
+    the UNION of both hosts' final counter state are byte-identical to
+    a single-process hot pipeline on the same lockstep drive — and the
+    bulk-forward lane really carried the foreign rows."""
+    if any("hot_skipped" in r for r in pod_results):
+        pytest.skip(pod_results[0].get(
+            "hot_skipped", pod_results[1].get("hot_skipped")
+        ))
+    from limitador_tpu.tpu import AsyncTpuStorage, TpuStorage
+    from limitador_tpu.tpu.native_pipeline import NativeRlsPipeline
+    from limitador_tpu.tpu.pipeline import CompiledTpuLimiter
+
+    from tests import pod_worker
+
+    clock = pod_worker._Clock()
+    limiter = CompiledTpuLimiter(
+        AsyncTpuStorage(
+            TpuStorage(capacity=1 << 12, clock=clock), max_delay=0.001
+        )
+    )
+    for limit in pod_worker.hot_limits():
+        limiter.add_limit(limit)
+    pipeline = NativeRlsPipeline(
+        limiter, None, max_delay=0.001, hot_lane=True
+    )
+    if not pipeline.hot_lane_active:
+        pytest.skip("native hot lane unavailable for the oracle")
+    want = {}
+    for i in range(pod_worker.DRIVE_REQUESTS):
+        clock.now = pod_worker.DRIVE_T0 + i * pod_worker.DRIVE_STEP_S
+        ns, user, _arrival = pod_worker.hot_drive_request(i)
+        out = pipeline.decide_many(
+            [pod_worker.hot_blob(ns, user)], chunk=8
+        )[0]
+        want[i] = pod_worker.hot_code(pipeline, out)
+    loop = asyncio.new_event_loop()
+    try:
+        want_counters = pod_worker.hot_counter_state(loop, limiter)
+    finally:
+        loop.close()
+
+    merged = {}
+    pod_counters = []
+    foreign = 0
+    bulk_batches = 0
+    bulk_rows = 0
+    served = 0
+    for result in pod_results:
+        for i, code in result["hot_decisions"].items():
+            assert int(i) not in merged, "a hot drive step decided twice"
+            merged[int(i)] = code
+        pod_counters.extend(result["hot_counters"])
+        foreign += result["hot_lane"]["foreign"]
+        bulk_batches += result["hot_bulk"]["batches"]
+        bulk_rows += result["hot_bulk"]["rows"]
+        served += result["hot_bulk"]["served"]
+        assert result["hot_bulk"]["errors"] == 0
+        assert result["hot_lane"]["hits"] > 0, result["hot_lane"]
+    pod_counters.sort(key=lambda r: (r["ns"], r["limit"], r["vars"]))
+    assert merged == want
+    assert pod_counters == want_counters
+    # the split + bulk lane really served the foreign traffic
+    assert foreign > 0 and bulk_batches > 0
+    assert served == bulk_rows
 
 
 @pytest.mark.slow
