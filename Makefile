@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench race-hunt pod-smoke pod-chaos
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos
 
 check: lint test
 
@@ -46,3 +46,11 @@ pod-chaos:
 
 bench:
 	python bench.py
+
+# Bench trajectory (ISSUE 14): read every BENCH_r*.json round capture,
+# normalize headline rates by box_calibration_score (the r1-rN boxes
+# swing 2-6x) and print the markdown trend table; exits nonzero when
+# the latest round's normalized figure regresses beyond tolerance vs
+# the best same-backend prior round.
+bench-trend:
+	python -m limitador_tpu.tools.bench_trend

@@ -145,14 +145,43 @@ def sanitizer_variant_tag() -> str:
     return sanitizer_variant() or ""
 
 
+def serving_model_fit() -> dict:
+    """The live online serving-model fit (ISSUE 14) at row-emit time:
+    the process estimator is fed by every DeviceStatsRecorder the
+    bench's drives construct (observability/model.py), so forcing one
+    refit here yields the coefficients the row's traffic actually
+    trained. Returns the compact ``fit_row()`` summary — coefficients +
+    prequential R² + drift state + calibration — or ``{}`` when the fit
+    is disabled (TPU_MODEL_FIT=off) or saw no device launches (host-only
+    configs). Rows become cross-comparable by MODEL rather than by raw
+    absolutes: two rounds on different box phases agree on the
+    normalized coefficients even when every raw rate differs 2-6x."""
+    try:
+        from limitador_tpu.observability.model import (
+            model_fit_enabled, process_estimator,
+        )
+
+        if not model_fit_enabled():
+            return {}
+        est = process_estimator()
+        est.refit(force=True)
+        if not est.observations:
+            return {}
+        return est.fit_row()
+    except Exception:
+        return {}
+
+
 def emit(metric: str, value: float, unit: str, baseline: float,
          ndigits: int = 1, lower_is_better: bool = False, **extra) -> None:
     """One JSON result line. ``vs_baseline`` is uniformly >1-is-better:
     value/baseline for throughput rows, baseline/value when
     ``lower_is_better`` (latency targets). Every row carries the box
     calibration score (see ``box_calibration_score``), the
-    ``device_backed`` probe result, the ``analysis_clean`` gate bit and
-    the active ``sanitizer`` variant (ISSUE 9 bench hygiene)."""
+    ``device_backed`` probe result, the ``analysis_clean`` gate bit,
+    the active ``sanitizer`` variant (ISSUE 9 bench hygiene) and the
+    live ``serving_model`` fit (ISSUE 14 — coefficients + R², see
+    ``serving_model_fit``)."""
     ratio = (baseline / value) if lower_is_better else (value / baseline)
     payload = {
         "metric": metric,
@@ -165,6 +194,7 @@ def emit(metric: str, value: float, unit: str, baseline: float,
     payload.setdefault("device_backed", device_backed())
     payload.setdefault("analysis_clean", analysis_clean())
     payload.setdefault("sanitizer", sanitizer_variant_tag())
+    payload.setdefault("serving_model", serving_model_fit())
     print(json.dumps(payload))
 
 

@@ -174,6 +174,20 @@ class DeviceStatsRecorder:
         # the bus never has to read histograms back out of Prometheus.
         self.signal_queue_wait_s = 0.0
         self.signal_batch_fill = 0.0
+        # Serving-model observatory (observability/model.py): per-launch
+        # observations (rows, host/device split, queue wait) feed the
+        # online coefficient fit. The tap is a lock + bounded append on
+        # the estimator side (perf-smoke MODEL_INGEST_BUDGET_US); the
+        # fit itself runs on the observatory drain thread. None =
+        # detached, zero cost.
+        self.model = None
+        try:
+            from .model import model_fit_enabled, process_estimator
+
+            if model_fit_enabled():
+                self.model = process_estimator()
+        except Exception:
+            pass  # the recorder must construct without the fit
 
     def next_batch_id(self) -> int:
         return next(self._batch_ids)
@@ -271,7 +285,12 @@ class DeviceStatsRecorder:
         slo = self.slo
         totals: Optional[list] = [] if slo is not None else None
         t_now = time.perf_counter()
+        n_rows = 0
+        min_enq: Optional[float] = None
         for t_enq, rid, namespace in entries:
+            n_rows += 1
+            if min_enq is None or t_enq < min_enq:
+                min_enq = t_enq
             total = t_now - t_enq
             if totals is not None:
                 totals.append(total)
@@ -285,6 +304,31 @@ class DeviceStatsRecorder:
                 slo.observe_many(totals)
             except Exception:
                 pass  # the watchdog must never fail a collect
+        model = self.model
+        if model is not None and n_rows:
+            device_s = float(phases.get("device_sync", 0.0))
+            # host target = the launch-shaped host WORK phases only.
+            # native_lane is excluded deliberately: on the submit lane
+            # its measured value absorbs event-loop interleaving (~10
+            # µs/row of future machinery vs the C call's real ~0.3
+            # µs/row — measured OLS R² 0.01 against rows), which would
+            # drown the fit; lease is a broker refresh, not per-flush
+            # work; dispatch is executor QUEUEING (it balloons under
+            # sustained pressure, preferentially on small deadline
+            # flushes — a negative-slope confounder), so it joins the
+            # queue-wait side of the observation instead.
+            host_s = sum(
+                float(phases.get(k, 0.0))
+                for k in ("host_cache", "host_stage", "unpack")
+            )
+            try:
+                model.ingest(
+                    n_rows, host_s, device_s,
+                    max(t_flush - min_enq, 0.0)
+                    + float(phases.get("dispatch", 0.0)),
+                )
+            except Exception:
+                pass  # the fit must never fail a collect
 
     @staticmethod
     def phases_ms(phases: Dict[str, float]) -> dict:

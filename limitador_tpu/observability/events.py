@@ -68,6 +68,11 @@ EVENT_KINDS = (
     "channel_redial",
     "hedge_fired",
     "hedge_won",
+    # serving-model observatory (ISSUE 14): the residual drift detector
+    # confirmed a code/config regression (calibration flat, residuals
+    # up) — box phase changes classify as calibration_shift and do NOT
+    # emit
+    "model_drift",
 )
 
 

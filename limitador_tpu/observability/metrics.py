@@ -450,6 +450,14 @@ class PrometheusMetrics:
             "budget breach), else 0",
             registry=self.registry,
         )
+        self.slo_breached_actionable = Gauge(
+            "slo_breached_actionable",
+            "1 while the SLO is breached AND a non-CPU device backs "
+            "this process — the pageable combination (a CPU-fallback "
+            "breach is real but not operator-fixable; alert on THIS, "
+            "graph slo_breached)",
+            registry=self.registry,
+        )
         self.device_backed = Gauge(
             "device_backed",
             "1 when a non-CPU jax backend serves this process, 0 on "
@@ -861,6 +869,72 @@ class PrometheusMetrics:
             "unexpired)",
             registry=self.registry,
         )
+        # -- serving-model observatory (observability/model.py,
+        # ISSUE 14): the online coefficient fit, its residual drift
+        # state and the SLO-headroom forecast. Refreshed by the
+        # estimator's render hook (attach_render_hook). Registered in
+        # model.METRIC_FAMILIES (lint cross-checked).
+        from .model import ATTRIBUTION_STAGES, MODEL_TARGETS, MODEL_TERMS
+
+        self.model_r2 = Gauge(
+            "model_r2",
+            "Prequential (held-out) R² of the online serving-model fit "
+            "over recent launches",
+            registry=self.registry,
+        )
+        self.model_observations = Gauge(
+            "model_observations",
+            "Device-launch observations the online fit has consumed",
+            registry=self.registry,
+        )
+        self.model_drift = Gauge(
+            "model_drift",
+            "1 while the residual drift detector holds a confirmed "
+            "code/config regression (calibration flat, residuals up); "
+            "box phase changes classify as calibration shifts and stay 0",
+            registry=self.registry,
+        )
+        self.model_drift_cusum = Gauge(
+            "model_drift_cusum",
+            "One-sided CUSUM statistic over standardized prediction "
+            "residuals (trips at 8; slower-than-model only)",
+            registry=self.registry,
+        )
+        self.model_coefficient = Gauge(
+            "model_coefficient",
+            "Fitted serving-model coefficients in normalized units "
+            "(seconds × box calibration score), per target (host/"
+            "device) and term (launch/row/lease_row/pod_row/"
+            "collective_row)",
+            ["target", "term"],
+            registry=self.registry,
+        )
+        self.capacity_headroom_ratio = Gauge(
+            "capacity_headroom_ratio",
+            "Max sustainable decisions/s at the current traffic mix "
+            "(fitted model inverted against the SLO budget) divided by "
+            "the current rate — <1 means the SLO is already paying",
+            registry=self.registry,
+        )
+        self.capacity_max_decisions_per_sec = Gauge(
+            "capacity_max_decisions_per_sec",
+            "Max sustainable decisions/s under the SLO budget at the "
+            "current traffic mix, per the fitted serving model",
+            registry=self.registry,
+        )
+        self.capacity_stage_share = Gauge(
+            "capacity_stage_share",
+            "Share of predicted decision latency each serving-model "
+            "stage owns at the operating point — where the next "
+            "millisecond of p99 comes from",
+            ["stage"],
+            registry=self.registry,
+        )
+        for target in MODEL_TARGETS:
+            for term in MODEL_TERMS:
+                self.model_coefficient.labels(target, term)
+        for stage in ATTRIBUTION_STAGES:
+            self.capacity_stage_share.labels(stage)
         # -- chunked dispatch (tpu/batcher.py ChunkPlanner): how flushes
         # split into pipelined sub-batches. Registered in
         # batcher.METRIC_FAMILIES (lint cross-checked).

@@ -72,6 +72,7 @@ METRIC_FAMILIES = (
     "slo_burn_rate_1h",
     "slo_budget_ms",
     "slo_breached",
+    "slo_breached_actionable",
     "device_backed",
 )
 
@@ -377,6 +378,15 @@ class NativePlane:
         backed = device_backed_runtime()
         if backed is not None:
             metrics.device_backed.set(1 if backed else 0)
+        # The PAGEABLE breach signal (ISSUE 14 satellite): on a
+        # CPU-fallback box slo_breached fires legitimately but
+        # un-actionably — the p99 budget was derived for device-backed
+        # serving, and no operator action fixes a missing device. The
+        # Grafana alert panel gates on THIS gauge; slo_breached stays
+        # the raw truth.
+        actionable = getattr(metrics, "slo_breached_actionable", None)
+        if actionable is not None:
+            actionable.set(1 if (wd["breached"] and backed) else 0)
 
     def _offer_exemplars(self) -> None:
         rec = self.recorder
@@ -438,7 +448,14 @@ class NativePlane:
         return out
 
     def slo_status(self) -> dict:
-        return self.watchdog.status()
+        """Watchdog status plus the device_backed companion: breached
+        AND device-backed is the actionable (pageable) combination —
+        a CPU-fallback breach is real but not operator-fixable."""
+        status = self.watchdog.status()
+        backed = device_backed_runtime()
+        status["device_backed"] = backed
+        status["actionable"] = bool(status["breached"] and backed)
+        return status
 
     def device_backed(self) -> Optional[bool]:
         return device_backed_runtime()

@@ -126,6 +126,12 @@ class ControlSignals:
         "peers_suspect",
         "peers_down",
         "pod_degraded_share",
+        # serving-model observatory tail (ISSUE 14) — same append-only
+        # contract, pinned by tests/test_model.py; direction 4's
+        # controller consumes these as pure observations.
+        "model_r2",
+        "capacity_headroom_ratio",
+        "model_drift",
     )
 
     __slots__ = FIELDS
@@ -156,6 +162,11 @@ class ControlSignals:
         self.peers_suspect = kw.get("peers_suspect", 0)
         self.peers_down = kw.get("peers_down", 0)
         self.pod_degraded_share = kw.get("pod_degraded_share", 0.0)
+        self.model_r2 = kw.get("model_r2", 0.0)
+        self.capacity_headroom_ratio = kw.get(
+            "capacity_headroom_ratio", 0.0
+        )
+        self.model_drift = kw.get("model_drift", 0)
 
     def to_dict(self) -> dict:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -192,6 +203,10 @@ class ControlSignals:
             float(self.peers_suspect),
             float(self.peers_down),
             float(self.pod_degraded_share),
+            # serving-model tail (ISSUE 14): same append-only contract.
+            float(self.model_r2),
+            float(self.capacity_headroom_ratio),
+            float(self.model_drift),
         ])
         return out
 
@@ -232,6 +247,7 @@ class SignalBus:
         self._native_plane = None
         self._observatory = None
         self._pod = None
+        self._model = None
         # previous cumulative shed counts + timestamp, for the rates;
         # baselines only advance once per MIN_RATE_WINDOW_S so the four
         # independent snapshot triggers (drain tick, renders, the two
@@ -265,6 +281,13 @@ class SignalBus:
         counts and degraded share join every snapshot (ISSUE 12) —
         the controller's observation matches the unit of serving."""
         self._pod = pod
+
+    def attach_model(self, model) -> None:
+        """Attach the serving-model estimator (or anything exposing
+        ``signal_fields() -> dict``): the fitted R², capacity headroom
+        and drift bit join every snapshot (ISSUE 14) — the tail
+        direction 4's controller consumes without touching the fit."""
+        self._model = model
 
     def warm(self) -> None:
         """Pre-compute the box calibration score off-thread so the
@@ -341,6 +364,12 @@ class SignalBus:
         if pod is not None:
             try:
                 kw.update(pod.pod_signal_fields())
+            except Exception:
+                pass
+        model = self._model
+        if model is not None:
+            try:
+                kw.update(model.signal_fields())
             except Exception:
                 pass
         if _BOX_CALIBRATION is not None:

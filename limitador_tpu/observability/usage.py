@@ -100,6 +100,9 @@ class TenantUsageObservatory:
         self.near_threshold = float(near_threshold)
         self.max_tracked = max(int(max_tracked), 2)
         self.signal_bus = signal_bus
+        # serving-model estimator (observability/model.py) whose refit
+        # rides this drain thread; assigned by the server wiring
+        self.model = None
         self._clock = clock
         self._lock = threading.Lock()
         # identity -> [cumulative hits, last attributed record]
@@ -144,6 +147,15 @@ class TenantUsageObservatory:
             if bus is not None:
                 try:
                     bus.snapshot()
+                except Exception:
+                    pass
+            model = self.model
+            if model is not None:
+                try:
+                    # the online serving-model fit rides THIS drain
+                    # thread (ISSUE 14): the decision path only ever
+                    # pays the lock+append ingest
+                    model.refit()
                 except Exception:
                     pass
 

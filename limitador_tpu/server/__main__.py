@@ -553,6 +553,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="value/max_value utilization at which a sampled counter "
         "counts as near-exhaustion (tenant_near_exhaustion gauge)",
     )
+    p.add_argument(
+        "--model-fit",
+        choices=["on", "off"],
+        default=_env("TPU_MODEL_FIT", "on"),
+        help="online serving-model observatory (ISSUE 14): fit the "
+        "serving-model coefficients from live launch telemetry "
+        "(model_*/capacity_* gauges, GET /debug/capacity, the "
+        "model_r2/capacity_headroom_ratio/model_drift ControlSignals "
+        "tail). 'off' detaches the ingest tap entirely",
+    )
     return p
 
 
@@ -865,6 +875,13 @@ async def _amain(args) -> int:
     tracing_err = configure_tracing(args.tracing_endpoint)
     if tracing_err:
         log.warning(tracing_err)
+
+    # Arm/disarm the serving-model fit BEFORE any storage construction:
+    # DeviceStatsRecorder attaches its ingest tap at creation time
+    # (set_metrics), so the flag must win over the ambient env first.
+    from ..observability import model as model_mod
+
+    model_mod.set_model_fit_enabled(args.model_fit == "on")
 
     # Pod formation MUST precede any storage/jax work: after
     # jax.distributed.initialize the device list is pod-global and the
@@ -1388,6 +1405,46 @@ async def _amain(args) -> int:
             f"{'with' if signal_bus is not None else 'without'} the "
             "local signal bus")
 
+    # Serving-model observatory (ISSUE 14): the online coefficient fit
+    # over the recorder's per-launch observations, refit on the usage
+    # observatory's drain thread, served at GET /debug/capacity and
+    # joined into the ControlSignals tail. Device storages only — the
+    # fit's observation unit is a device launch.
+    model_estimator = None
+    model_recorder = (
+        getattr(limiter, "recorder", None)
+        or getattr(counters_storage, "recorder", None)
+    )
+    if args.model_fit == "on" and model_recorder is not None:
+        model_estimator = model_mod.process_estimator()
+        model_estimator.budget_ms = args.slo_budget_ms
+        # set_metrics predates the flag resolution in subprocess-spawn
+        # orders; make the attachment explicit either way
+        model_recorder.model = model_estimator
+        model_estimator.attach_context(model_mod.pipeline_context(
+            pipeline=native_pipeline, pod=pod_frontend,
+            # sharded_launches lives on the STORAGE's library_stats
+            # (merged by the batcher over the sharded pipeline) —
+            # the native pipeline's stats never carry it
+            storage=(
+                counters_storage
+                if hasattr(counters_storage, "library_stats") else None
+            ),
+        ))
+        if pod_frontend is not None:
+            events_log = getattr(pod_frontend, "events", None)
+            if events_log is not None:
+                model_estimator.attach_event_log(events_log)
+        if signal_bus is not None:
+            signal_bus.attach_model(model_estimator)
+        if observatory is not None:
+            observatory.model = model_estimator
+        metrics.attach_render_hook(model_estimator)
+        log.info(
+            "serving-model observatory: online fit armed "
+            f"(SLO budget {args.slo_budget_ms:.1f}ms, refit on the "
+            "usage drain cadence; GET /debug/capacity)")
+
     authority_server = None
     if args.authority_listen:
         from ..storage.authority import serve_authority
@@ -1502,6 +1559,8 @@ async def _amain(args) -> int:
         debug_sources.append(observatory)
     if signal_bus is not None:
         debug_sources.append(signal_bus)
+    if model_estimator is not None:
+        debug_sources.append(model_estimator)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,

@@ -33,6 +33,11 @@ Beyond the reference surface, the device-plane debug endpoints
                             balancer can learn: topology, per-host
                             shard blocks, pinned namespaces, routing
                             epoch (404 off pod mode)
+    GET  /debug/capacity    the online serving-model observatory:
+                            fitted coefficients, R², drift state,
+                            SLO headroom, and what-if forecasts
+                            (?batch=, ?lease_share=, ?procs=; 404
+                            when the fit is off)
     GET  /debug/profile     jax.profiler capture status
     POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
                             toggles an on-demand jax.profiler trace
@@ -46,6 +51,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import math
 from typing import Optional
 
 from aiohttp import web
@@ -84,6 +90,9 @@ DEBUG_SOURCE_SECTIONS = (
     # pod fast path (ISSUE 13): the ownership map an upstream LB can
     # learn (topology, shard blocks, pinned namespaces, epoch)
     ("pod_routing", "routing_debug"),
+    # serving-model observatory (ISSUE 14): fitted coefficients, R²,
+    # drift state and SLO headroom (GET /debug/capacity adds what-ifs)
+    ("capacity", "capacity_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -106,6 +115,7 @@ DEBUG_STATS_SECTIONS = (
     "pod",
     "pod_events",
     "pod_routing",
+    "capacity",
 )
 
 
@@ -281,6 +291,18 @@ def _openapi_spec() -> dict:
                     "responses": {
                         "200": {"description": "ownership map"},
                         "404": {"description": "not a pod"},
+                    },
+                }
+            },
+            "/debug/capacity": {
+                "get": {
+                    "summary": "Online serving-model observatory: "
+                               "fitted coefficients, R², drift state, "
+                               "SLO headroom and what-if forecasts "
+                               "(?batch=, ?lease_share=, ?procs=)",
+                    "responses": {
+                        "200": {"description": "capacity forecast"},
+                        "404": {"description": "model fit not running"},
                     },
                 }
             },
@@ -589,6 +611,48 @@ class _Api:
             )
         return web.json_response(fn())
 
+    async def get_debug_capacity(
+        self, request: web.Request
+    ) -> web.Response:
+        """The serving-model observatory (ISSUE 14): fitted
+        coefficients, R², drift state, SLO headroom, and what-if
+        forecasts — ``?batch=`` overrides the batch size,
+        ``?lease_share=`` the lease coverage, ``?procs=`` the
+        host count."""
+        fn = self._debug_source_fn("capacity_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "serving-model fit not running "
+                          "(--model-fit off or host-only storage)"},
+                status=404,
+            )
+        kwargs: dict = {}
+        try:
+            if "batch" in request.query:
+                kwargs["batch"] = int(request.query["batch"])
+                if kwargs["batch"] < 1:
+                    raise ValueError
+            if "lease_share" in request.query:
+                kwargs["lease_share"] = float(
+                    request.query["lease_share"]
+                )
+                # float() happily parses nan/inf, which would ride the
+                # clamp into the features and serialize as bare NaN —
+                # invalid JSON for any strict client
+                if not math.isfinite(kwargs["lease_share"]):
+                    raise ValueError
+            if "procs" in request.query:
+                kwargs["procs"] = int(request.query["procs"])
+                if kwargs["procs"] < 1:
+                    raise ValueError
+        except ValueError:
+            return web.json_response(
+                {"error": "batch and procs must be positive integers, "
+                          "lease_share a finite float"},
+                status=400,
+            )
+        return web.json_response(fn(**kwargs))
+
     async def get_debug_events(self, request: web.Request) -> web.Response:
         """The typed pod event timeline (?n=N trims to the most recent
         N, ?kind= filters to one event kind); mergeable pod-wide by
@@ -775,6 +839,7 @@ def make_http_app(
     app.router.add_get("/debug/signals", api.get_debug_signals)
     app.router.add_get("/debug/pod", api.get_debug_pod)
     app.router.add_get("/debug/pod/routing", api.get_debug_pod_routing)
+    app.router.add_get("/debug/capacity", api.get_debug_capacity)
     app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)
     app.router.add_post("/debug/profile", api.post_debug_profile)

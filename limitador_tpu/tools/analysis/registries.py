@@ -44,6 +44,10 @@ REGISTRY_OWNED_PREFIXES = {
     "slo_": "limitador_tpu/observability/native_plane.py",
     "tenant_": "limitador_tpu/observability/usage.py",
     "signal_": "limitador_tpu/observability/signals.py",
+    # serving-model observatory (ISSUE 14): the online coefficient
+    # fit's model_* gauges and the capacity_* headroom forecast
+    "model_": "limitador_tpu/observability/model.py",
+    "capacity_": "limitador_tpu/observability/model.py",
 }
 
 #: the native telemetry plane's phase registry module

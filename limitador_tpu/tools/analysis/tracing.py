@@ -67,6 +67,11 @@ HOT_MODULES = (
     # sync and lock-cheap by contract — never an RPC, never a device
     # sync; the exchange round alone owns the collective transport.
     "limitador_tpu/parallel/mesh.py",
+    # serving-model observatory (ISSUE 14): ingest() rides every
+    # batch collect — lock + bounded append ONLY; the refit, probe
+    # and forecast belong to the observatory drain thread, and a
+    # sync/launch smuggled into the module would tax every flush.
+    "limitador_tpu/observability/model.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

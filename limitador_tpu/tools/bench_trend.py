@@ -1,0 +1,298 @@
+"""Bench trajectory tool (ISSUE 14 satellite): the r1-rN trend,
+machine-readable instead of folklore.
+
+Every round's ``BENCH_r*.json`` is a driver capture — a single object
+whose ``parsed`` field holds the headline row and whose ``tail`` text
+embeds the bench's emitted JSON result lines. The absolute numbers in
+those rows are per-box: CHANGES.md documents 2-6x phase swings between
+rounds, which is why every row since PR 5 carries
+``box_calibration_score`` (a fixed spin+memcpy workload — higher =
+faster box). This tool reads all rounds, NORMALIZES each headline rate
+by its row's calibration score (throughput ÷ score; latency × score, so
+both become box-independent "per unit of box" figures), and emits the
+trend as JSON and/or a markdown table.
+
+Regression gate: for each metric present in the latest round AND at
+least one calibrated earlier round, the latest normalized value is
+compared against the best prior normalized value; a drop beyond
+``--tolerance`` (default 0.5 — CI boxes are genuinely noisy even after
+normalization; tighten on pinned hardware) makes the exit code nonzero
+so ``make bench-trend`` can gate. Uncalibrated rows (r1-r4 headline
+rows predate the score) and device/CPU-mixed comparisons are reported
+but never gated: a TPU round vs a CPU-fallback round is a backend
+change, not a regression.
+
+Usage::
+
+    python -m limitador_tpu.tools.bench_trend [--glob 'BENCH_r*.json']
+        [--json out.json] [--markdown out.md] [--tolerance 0.5]
+        [--gate-metrics m1,m2,...]
+
+With no output flags the markdown table prints to stdout.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import math
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional
+
+__all__ = [
+    "load_round", "collect_rounds", "normalized_value", "trend_table",
+    "regressions", "render_markdown", "main",
+]
+
+#: a metric is lower-is-better when its name or unit says latency
+_LATENCY_RE = re.compile(r"(_ms$|_ms_|_p50|_p99|latency|_wait)")
+
+
+def _is_latency(metric: str, unit: str) -> bool:
+    return bool(_LATENCY_RE.search(metric)) or "ms" in (unit or "")
+
+
+def _iter_json_lines(text: str):
+    """Yield every parseable JSON object embedded line-wise in the
+    driver tail (lines may be interleaved with log noise)."""
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{") or '"metric"' not in line:
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            yield obj
+
+
+def load_round(path: Path) -> dict:
+    """One driver capture -> {"round": N, "rows": {metric: row}}.
+    The headline ``parsed`` row and every JSON result line found in
+    ``tail`` are folded in (last occurrence of a metric wins — reruns
+    within a round supersede)."""
+    data = json.loads(path.read_text())
+    rows: Dict[str, dict] = {}
+    parsed = data.get("parsed")
+    candidates: List[dict] = []
+    if isinstance(parsed, dict) and "metric" in parsed:
+        candidates.append(parsed)
+    elif isinstance(parsed, list):
+        candidates.extend(
+            r for r in parsed if isinstance(r, dict) and "metric" in r
+        )
+    candidates.extend(_iter_json_lines(str(data.get("tail", ""))))
+    for row in candidates:
+        try:
+            float(row.get("value"))
+        except (TypeError, ValueError):
+            continue
+        rows[str(row["metric"])] = row
+    m = re.search(r"r(\d+)", path.stem)
+    return {
+        "round": int(m.group(1)) if m else -1,
+        "path": path.name,
+        "rc": data.get("rc"),
+        "rows": rows,
+    }
+
+
+def collect_rounds(pattern: str, root: Path) -> List[dict]:
+    rounds = []
+    for p in globlib.glob(str(root / pattern)):
+        try:
+            rounds.append(load_round(Path(p)))
+        except (ValueError, OSError) as exc:
+            print(f"bench_trend: skipping {p}: {exc}", file=sys.stderr)
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def normalized_value(row: dict) -> Optional[float]:
+    """Box-normalized figure: throughput ÷ calibration score, latency
+    × score. None when the row predates ``box_calibration_score``."""
+    cal = row.get("box_calibration_score")
+    try:
+        cal = float(cal)
+        value = float(row["value"])
+    except (TypeError, ValueError):
+        return None
+    if cal <= 0 or not math.isfinite(cal):
+        return None
+    if _is_latency(str(row.get("metric", "")), str(row.get("unit", ""))):
+        return value * cal
+    return value / cal
+
+
+def trend_table(rounds: List[dict]) -> dict:
+    """{metric: [{round, value, normalized, calibration, device_backed,
+    r2}, ...]} over every metric any round recorded."""
+    out: Dict[str, List[dict]] = {}
+    for rnd in rounds:
+        for metric, row in rnd["rows"].items():
+            fit = row.get("serving_model") or {}
+            out.setdefault(metric, []).append({
+                "round": rnd["round"],
+                "value": float(row["value"]),
+                "unit": row.get("unit", ""),
+                "normalized": normalized_value(row),
+                "calibration": row.get("box_calibration_score"),
+                "device_backed": row.get("device_backed"),
+                "model_r2": fit.get("r2"),
+            })
+    return out
+
+def regressions(
+    table: dict, tolerance: float, gate_metrics=None
+) -> List[dict]:
+    """Latest round vs best prior, normalized; a finding per metric
+    whose latest normalized figure fell beyond tolerance. Only
+    same-backend (device_backed equal) calibrated pairs gate."""
+    found = []
+    for metric, series in sorted(table.items()):
+        if gate_metrics is not None and metric not in gate_metrics:
+            continue
+        latest = series[-1]
+        if latest["normalized"] is None:
+            continue
+        lower_better = _is_latency(metric, latest.get("unit", ""))
+        prior = [
+            s for s in series[:-1]
+            if s["normalized"] is not None
+            and s.get("device_backed") == latest.get("device_backed")
+        ]
+        if not prior:
+            continue
+        if lower_better:
+            best = min(p["normalized"] for p in prior)
+            ratio = best / latest["normalized"] if latest["normalized"] else 1.0
+        else:
+            best = max(p["normalized"] for p in prior)
+            ratio = latest["normalized"] / best if best else 1.0
+        if ratio < 1.0 - tolerance:
+            found.append({
+                "metric": metric,
+                "latest_round": latest["round"],
+                "latest_normalized": latest["normalized"],
+                "best_prior_normalized": best,
+                "retained_share": round(ratio, 4),
+                "tolerance": tolerance,
+            })
+    return found
+
+
+def render_markdown(table: dict, regs: List[dict]) -> str:
+    lines = [
+        "# Bench trend (box-normalized)",
+        "",
+        "Normalized = value / box_calibration_score for rates, "
+        "value * score for latencies; `-` = row predates the score. "
+        "`dev` marks device-backed rounds.",
+        "",
+        "| metric | " + "trajectory (round: normalized [raw]) |",
+        "|---|---|",
+    ]
+    for metric, series in sorted(table.items()):
+        cells = []
+        for s in series:
+            norm = (
+                f"{s['normalized']:.4g}" if s["normalized"] is not None
+                else "-"
+            )
+            dev = " dev" if s.get("device_backed") else ""
+            r2 = (
+                f" R²={s['model_r2']:.2f}"
+                if s.get("model_r2") is not None else ""
+            )
+            cells.append(
+                f"r{s['round']}: {norm} [{s['value']:.4g}{dev}{r2}]"
+            )
+        lines.append(f"| `{metric}` | " + " → ".join(cells) + " |")
+    lines.append("")
+    if regs:
+        lines.append("## Normalized regressions beyond tolerance")
+        lines.append("")
+        for r in regs:
+            lines.append(
+                f"- `{r['metric']}`: r{r['latest_round']} retains "
+                f"{r['retained_share'] * 100:.1f}% of the best prior "
+                f"normalized figure (tolerance "
+                f"{r['tolerance'] * 100:.0f}%)"
+            )
+    else:
+        lines.append(
+            "No normalized regression beyond tolerance in the latest "
+            "round."
+        )
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bench_trend", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument(
+        "--glob", default="BENCH_r*.json",
+        help="round-capture glob, relative to --root",
+    )
+    ap.add_argument(
+        "--root", default=".", help="directory holding the captures"
+    )
+    ap.add_argument("--json", help="write the trend table as JSON here")
+    ap.add_argument("--markdown", help="write the markdown table here")
+    ap.add_argument(
+        "--tolerance", type=float, default=0.5,
+        help="allowed normalized drop vs best prior round (0.5 = 50%% — "
+        "CI boxes stay noisy even normalized; tighten on pinned "
+        "hardware)",
+    )
+    ap.add_argument(
+        "--gate-metrics",
+        help="comma-separated metrics the exit code gates on "
+        "(default: every calibrated metric)",
+    )
+    args = ap.parse_args(argv)
+    rounds = collect_rounds(args.glob, Path(args.root))
+    if not rounds:
+        print(f"bench_trend: no captures match {args.glob}",
+              file=sys.stderr)
+        return 2
+    table = trend_table(rounds)
+    gate = (
+        {m.strip() for m in args.gate_metrics.split(",") if m.strip()}
+        if args.gate_metrics else None
+    )
+    regs = regressions(table, args.tolerance, gate)
+    payload = {
+        "rounds": [
+            {"round": r["round"], "path": r["path"],
+             "metrics": sorted(r["rows"])}
+            for r in rounds
+        ],
+        "trend": table,
+        "regressions": regs,
+        "tolerance": args.tolerance,
+    }
+    if args.json:
+        Path(args.json).write_text(json.dumps(payload, indent=2) + "\n")
+    md = render_markdown(table, regs)
+    if args.markdown:
+        Path(args.markdown).write_text(md)
+    if not args.json and not args.markdown:
+        print(md)
+    else:
+        for r in regs:
+            print(
+                f"bench_trend: REGRESSION {r['metric']} retains "
+                f"{r['retained_share'] * 100:.1f}%", file=sys.stderr,
+            )
+    return 1 if regs else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

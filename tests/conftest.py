@@ -71,6 +71,9 @@ SERVER_ENV_VARS = frozenset({
     # pod observability plane (ISSUE 12): an ambient event-ring cap
     # would silently reshape /debug/events assertions
     "TPU_POD_EVENTS",
+    # serving-model observatory (ISSUE 14): an ambient off would 404
+    # every /debug/capacity assertion in a spawned server
+    "TPU_MODEL_FIT",
 })
 
 

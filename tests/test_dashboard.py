@@ -198,6 +198,50 @@ def test_dashboard_covers_pod_fast_path_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_capacity_model_families():
+    """ISSUE 14: the serving-model observatory ships WITH its Grafana
+    row — a "Capacity & model" row exists and every family the
+    estimator owns (model.METRIC_FAMILIES) is referenced by at least
+    one panel expression, plus the pageable-breach gauge the SLO
+    alerting gates on."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("capacity & model" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.observability.model import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES + ("slo_breached_actionable",):
+        assert family in exprs, f"no panel queries {family}"
+
+
+def test_dashboard_slo_alert_panel_gated_on_device_backing():
+    """The PR 7 false-page fix (ISSUE 14 satellite): the pageable
+    breach panel must alert on slo_breached_actionable — raw
+    slo_breached fires legitimately-but-unactionably on CPU-fallback
+    boxes, so no panel may present it as the pageable signal without
+    the device-backed gate alongside."""
+    doc = json.loads(DASHBOARD.read_text())
+    pageable = [
+        p for p in doc["panels"]
+        if any(
+            t.get("expr") == "slo_breached_actionable"
+            for t in p.get("targets", []) or []
+        )
+    ]
+    assert pageable, "no panel queries slo_breached_actionable"
+    # every panel querying raw slo_breached must also graph the
+    # device-backed context (device_backed or the actionable gauge)
+    for p in doc["panels"]:
+        exprs = [
+            t.get("expr", "") for t in p.get("targets", []) or []
+        ]
+        if any(e == "slo_breached" for e in exprs):
+            assert any(
+                "device_backed" in e or "actionable" in e
+                for e in exprs
+            ), f"panel {p.get('title')!r} presents slo_breached ungated"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

@@ -1,0 +1,550 @@
+"""Online serving-model observatory (ISSUE 14).
+
+Covers the direction-4 contracts: the pinned ``ControlSignals`` tail,
+the coefficient fit against a synthetic generator with KNOWN ground
+truth, the residual drift detector (fires on an injected slowdown,
+stays quiet on a box calibration shift), the headroom forecaster's
+budget inversion, ``GET /debug/capacity`` (+ 404 + what-if params),
+and the recorder/bench integration that makes every bench row carry
+the fitted coefficients + R².
+"""
+
+import asyncio
+import itertools
+import random
+
+import pytest
+
+from limitador_tpu.observability.model import (
+    ATTRIBUTION_STAGES,
+    METRIC_FAMILIES,
+    MODEL_TARGETS,
+    MODEL_TERMS,
+    ServingModelEstimator,
+    model_fit_enabled,
+    pipeline_context,
+    process_estimator,
+    set_model_fit_enabled,
+)
+from limitador_tpu.observability.signals import ControlSignals
+
+# ground-truth serving model for the synthetic generator, in seconds at
+# box speed 1.0: host = H0 + Hr·rows + Hl·rows·lease;
+# device = D0 + Dr·rows (pow2 row buckets, like the real kernel lanes)
+_H0, _HR, _HL = 50e-6, 2e-6, -1e-6
+_D0, _DR = 300e-6, 0.5e-6
+_ROWS = (64, 256, 1024, 2048)
+
+
+class _Log:
+    def __init__(self):
+        self.events = []
+
+    def emit(self, kind, **detail):
+        self.events.append((kind, detail))
+        return len(self.events)
+
+
+def _estimator(cal_holder, **kw):
+    """Deterministic estimator: injectable calibration probe + a fake
+    monotonic clock ticking 1 ms per ingest."""
+    clock = itertools.count(0, 0.001)
+    return ServingModelEstimator(
+        calibration=lambda: cal_holder[0],
+        clock=lambda: next(clock) * 1.0,
+        **kw,
+    )
+
+
+def _drive(est, n, speed=1.0, slow=1.0, lease=0.0, noise=0.02,
+           refit_every=40, seed=7):
+    """Feed n launches of synthetic traffic. ``speed`` is the box
+    phase (times scale by 1/speed — the CALIBRATION probe must be
+    moved by the caller to match); ``slow`` is a code regression
+    (times scale, probe does NOT move)."""
+    rng = random.Random(seed)
+    for i in range(n):
+        rows = rng.choice(_ROWS)
+        host = (_H0 + _HR * rows + _HL * rows * lease) * slow / speed
+        dev = (_D0 + _DR * rows) * slow / speed
+        eps = 1 + rng.gauss(0, noise)
+        est.ingest(rows, host * eps, dev * eps, 5e-6)
+        if i % refit_every == refit_every - 1:
+            est.refit(force=True)
+    est.refit(force=True)
+
+
+# -- the direction-4 ControlSignals tail --------------------------------------
+
+
+def test_control_signals_tail_order_is_pinned():
+    """The observation vector is the adaptive controller's input
+    contract: the ISSUE 14 model fields append at the very END, after
+    the ISSUE 11/12 pod tail, and nothing ever reshuffles. This test
+    IS the pin (the full-order pin lives in test_pod_plane)."""
+    assert ControlSignals.FIELDS[-3:] == (
+        "model_r2",
+        "capacity_headroom_ratio",
+        "model_drift",
+    )
+    s = ControlSignals(
+        model_r2=0.9, capacity_headroom_ratio=2.5, model_drift=1
+    )
+    assert s.vector()[-3:] == [0.9, 2.5, 1.0]
+    # defaults: schema identical with no estimator attached
+    assert ControlSignals().vector()[-3:] == [0.0, 0.0, 0.0]
+
+
+def test_signal_bus_joins_model_fields():
+    from limitador_tpu.observability.signals import SignalBus
+
+    cal = [10.0]
+    est = _estimator(cal)
+    _drive(est, 200)
+    bus = SignalBus()
+    bus.attach_model(est)
+    snap = bus.snapshot()
+    assert snap.model_r2 == est.signal_fields()["model_r2"]
+    assert snap.model_r2 > 0.8
+    assert snap.model_drift == 0
+
+
+# -- the fit vs known ground truth --------------------------------------------
+
+
+def test_fit_recovers_known_coefficients():
+    """Prequential R² ≥ 0.8 against held-out flushes (every residual
+    is computed BEFORE its observation updates the fit) and the
+    normalized coefficients recover the generator's ground truth:
+    coefficients are seconds × calibration score, so at score 10 the
+    per-row host term must come back as 10·(Hr + Hl·lease) within a
+    few percent."""
+    cal = [10.0]
+    est = _estimator(cal)
+    lease = 0.4
+    est.attach_context(lambda: {"lease_share": lease})
+    _drive(est, 600, lease=lease)
+    assert est.observations >= 500
+    assert est._r2 >= 0.8, f"prequential R² {est._r2}"
+    coef = est.coefficients()
+    assert set(coef) == set(MODEL_TARGETS)
+    assert set(coef["host"]) == set(MODEL_TERMS)
+    # with a CONSTANT mix, row and lease_row are collinear — the
+    # identified quantity is the effective per-row cost at the mix
+    eff_host_row = coef["host"]["row"] + coef["host"]["lease_row"] * lease
+    eff_dev_row = (
+        coef["device"]["row"] + coef["device"]["lease_row"] * lease
+    )
+    assert eff_host_row == pytest.approx(
+        10.0 * (_HR + _HL * lease), rel=0.10
+    )
+    assert eff_dev_row == pytest.approx(10.0 * _DR, rel=0.10)
+    # launch intercepts: host + device split correctly (not summed)
+    assert coef["host"]["launch"] == pytest.approx(10.0 * _H0, rel=0.35)
+    assert coef["device"]["launch"] == pytest.approx(
+        10.0 * _D0, rel=0.35
+    )
+
+
+def test_fit_is_box_phase_invariant():
+    """The WHOLE point of normalizing by the calibration score: two
+    fits trained on the same traffic at 2x-different box speeds must
+    agree on the normalized coefficients."""
+    cal_a, cal_b = [10.0], [5.0]
+    ea, eb = _estimator(cal_a), _estimator(cal_b)
+    _drive(ea, 400, speed=1.0)
+    _drive(eb, 400, speed=0.5)  # box half as fast, probe says so
+    ca, cb = ea.coefficients(), eb.coefficients()
+    assert ca["host"]["row"] == pytest.approx(
+        cb["host"]["row"], rel=0.10
+    )
+    assert ca["device"]["launch"] == pytest.approx(
+        cb["device"]["launch"], rel=0.15
+    )
+    assert eb._r2 >= 0.8
+
+
+def test_prediction_matches_generator_2x_batch():
+    """The what-if acceptance shape: predicted latency at 2x the batch
+    size agrees with the generator's actual 2x cost."""
+    cal = [10.0]
+    est = _estimator(cal)
+    _drive(est, 500)
+    w = est.what_if(batch=2048)
+    truth_ms = (
+        (_H0 + _HR * 2048) + (_D0 + _DR * 2048)
+    ) * 1e3
+    assert w["predicted_host_ms"] + w["predicted_device_ms"] == (
+        pytest.approx(truth_ms, rel=0.10)
+    )
+    half = est.what_if(batch=1024)
+    # per-row dominance at these sizes: 2x batch ≈ <2x latency (the
+    # launch intercept amortizes), and throughput must not shrink
+    assert w["predicted_latency_ms"] < 2.0 * half["predicted_latency_ms"]
+    assert w["predicted_decisions_per_sec"] >= (
+        0.9 * half["predicted_decisions_per_sec"]
+    )
+
+
+# -- the drift detector -------------------------------------------------------
+
+
+def test_drift_fires_on_injected_slowdown():
+    """Code/config regression: times double, the box probe does NOT
+    move — the CUSUM trips, the state machine lands on 'drifted', a
+    typed model_drift event hits the log and the signal bit rises."""
+    cal = [10.0]
+    est = _estimator(cal)
+    log = _Log()
+    est.attach_event_log(log)
+    _drive(est, 400)
+    assert est.drift_state == "ok"
+    assert est.signal_fields()["model_drift"] == 0
+    _drive(est, 200, slow=2.0)
+    assert est.drift_state == "drifted"
+    assert est.signal_fields()["model_drift"] == 1
+    kinds = [k for k, _ in log.events]
+    assert kinds.count("model_drift") == 1  # edge-triggered, not spam
+    _, detail = log.events[0]
+    assert detail["cusum"] >= 8.0
+    assert detail["observations"] > 400
+    import json
+
+    json.dumps(detail)  # the event payload must be JSON-clean
+
+
+def test_drift_stays_quiet_on_calibration_shift():
+    """Box phase change: times double AND the probe halves — the
+    normalized target is flat (or the trip classifies as
+    calibration_shift), so the drift BIT stays 0 and no event fires.
+    This is the 'box throttled' vs 'code regressed' distinction."""
+    for throttle in (2.0, 4.0):
+        cal = [10.0]
+        est = _estimator(cal)
+        log = _Log()
+        est.attach_event_log(log)
+        _drive(est, 400)
+        cal[0] = 10.0 / throttle
+        _drive(est, 600, speed=1.0 / throttle)
+        assert est.drift_state != "drifted", throttle
+        assert est.signal_fields()["model_drift"] == 0, throttle
+        assert not log.events, throttle
+        # and the fit re-converges IN the new phase
+        assert est._r2 >= 0.8, throttle
+
+
+def test_drift_recovers_after_fit_adapts():
+    """The RLS forgets the old regime: sustained post-regression
+    traffic re-converges the fit, residuals normalize, the CUSUM
+    drains and the state returns to ok."""
+    cal = [10.0]
+    est = _estimator(cal)
+    _drive(est, 300)
+    _drive(est, 150, slow=2.0)
+    assert est.drift_state == "drifted"
+    _drive(est, 3500, slow=2.0, seed=11)
+    assert est.drift_state == "ok"
+    assert est._r2 >= 0.8
+
+
+# -- headroom + attribution ---------------------------------------------------
+
+
+def test_headroom_inverts_the_slo_budget():
+    """capacity_headroom_ratio = max sustainable dec/s ÷ current rate,
+    with max rate the overlap bound B/max(host, device) over batch
+    sizes whose predicted latency fits the budget. A tighter budget
+    must never report MORE capacity."""
+    cal = [10.0]
+    est = _estimator(cal, budget_ms=2.0)
+    _drive(est, 500)
+    dbg = est.capacity_debug()
+    assert dbg["headroom"]["max_decisions_per_sec"] > 0
+    assert dbg["headroom"]["capacity_headroom_ratio"] > 0
+    rate_2ms = dbg["headroom"]["max_decisions_per_sec"]
+    est.budget_ms = 0.5
+    est.refit(force=True)
+    est._forecast_locked()
+    assert est._max_rate <= rate_2ms
+    # the forecast agrees with a brute-force inversion of the same
+    # fitted model (the grid the estimator searches)
+    best = 0.0
+    b = 1.0
+    while b <= est.max_batch:
+        host_s, dev_s = est._predict_seconds(b, 0.0, 0.0, 0.0)
+        if host_s + dev_s + est._queue_wait_s <= 0.5e-3:
+            best = max(best, b / max(host_s, dev_s, 1e-9))
+        b *= 2
+    assert est._max_rate == pytest.approx(best, rel=1e-6)
+
+
+def test_stage_attribution_shares_sum_to_one():
+    cal = [10.0]
+    est = _estimator(cal)
+    _drive(est, 400)
+    dbg = est.capacity_debug()
+    attr = dbg["attribution"]
+    assert set(attr) == set(ATTRIBUTION_STAGES)
+    assert sum(attr.values()) == pytest.approx(1.0, abs=0.02)
+    # the generator's device intercept dominates at these batch sizes
+    assert attr["device_launch"] > 0.0
+
+
+def test_what_if_param_overrides():
+    cal = [10.0]
+    est = _estimator(cal)
+    est.attach_context(lambda: {"lease_share": 0.2})
+    _drive(est, 400, lease=0.2)
+    base = est.what_if()
+    assert base["procs"] == 1
+    scaled = est.what_if(procs=4)
+    assert scaled["predicted_decisions_per_sec"] == pytest.approx(
+        4 * base["predicted_decisions_per_sec"], rel=1e-6
+    )
+    lease = est.what_if(lease_share=0.9)
+    assert lease["lease_share"] == 0.9
+    assert lease["batch"] == base["batch"]
+
+
+# -- ingest bounds + wiring ---------------------------------------------------
+
+
+def test_ingest_is_bounded_and_counts_drops():
+    est = ServingModelEstimator()
+    for _ in range(est.INGEST_CAP + 100):
+        est.ingest(64, 1e-4, 3e-4)
+    assert len(est._pending) == est.INGEST_CAP
+    assert est.dropped == 100
+
+
+def test_refit_subsamples_big_drains_but_reports_all():
+    cal = [10.0]
+    est = _estimator(cal)
+    for _ in range(est.INGEST_CAP):
+        est.ingest(256, 1e-4, 3e-4, 1e-5)
+    consumed = est.refit(force=True)
+    assert consumed == est.INGEST_CAP  # the DRAIN is complete
+    assert est.observations <= est.REFIT_SAMPLE + 1  # the FIT sampled
+
+
+def test_recorder_tap_feeds_the_estimator():
+    """DeviceStatsRecorder.record_batch is the ingest tap: one
+    finished device batch = one observation (rows, host phases minus
+    device_sync, device_sync, queue wait)."""
+    import time as _time
+
+    from limitador_tpu.observability import PrometheusMetrics
+    from limitador_tpu.observability.device_plane import (
+        DeviceStatsRecorder,
+    )
+
+    metrics = PrometheusMetrics()
+    recorder = DeviceStatsRecorder(metrics)
+    est = ServingModelEstimator()
+    recorder.model = est
+    t = _time.perf_counter()
+    recorder.record_batch(
+        [(t - 0.004, None, None), (t - 0.002, None, None)],
+        batch_id=1, t_flush=t,
+        phases={"host_stage": 0.001, "device_sync": 0.003},
+    )
+    assert len(est._pending) == 1
+    ts, rows, host_s, device_s, queue_wait_s = est._pending[0]
+    assert rows == 2
+    assert host_s == pytest.approx(0.001)
+    assert device_s == pytest.approx(0.003)
+    assert queue_wait_s >= 0.0
+
+
+def test_estimator_poll_renders_metric_families():
+    """est.poll(metrics) refreshes every family in METRIC_FAMILIES —
+    the render-hook contract the analysis registry pass cross-checks."""
+    from limitador_tpu.observability import PrometheusMetrics
+
+    cal = [10.0]
+    est = _estimator(cal)
+    _drive(est, 300)
+    metrics = PrometheusMetrics()
+    est.poll(metrics)
+    text = metrics.render().decode()
+    for family in METRIC_FAMILIES:
+        assert family in text, family
+    assert 'model_coefficient{target="host",term="row"}' in text
+    assert 'capacity_stage_share{stage="device_launch"}' in text
+
+
+def test_process_estimator_is_a_singleton_and_flag_gates():
+    est = process_estimator()
+    assert process_estimator() is est
+    was = model_fit_enabled()
+    try:
+        set_model_fit_enabled(False)
+        assert not model_fit_enabled()
+        set_model_fit_enabled(True)
+        assert model_fit_enabled()
+    finally:
+        set_model_fit_enabled(was)
+
+
+def test_pipeline_context_samples_delta_shares():
+    """The refit-time mix sampler reads inter-refit DELTAS of the
+    cumulative library counters, so the mix tracks current traffic.
+    Leased admissions are a SUBSET of the lane rows counter (the C
+    lane counts the hit before the leased branch), so the lease-share
+    denominator is rows + misses — a fully-leased window reads 1.0,
+    not 0.5. ``sharded_launches`` comes from the STORAGE source (the
+    batcher merges it over the sharded pipeline, never the native
+    pipeline's stats)."""
+
+    class Source:
+        def __init__(self, **stats):
+            self.stats = stats
+
+        def library_stats(self):
+            return dict(self.stats)
+
+    p = Source(lease_admissions=0, native_lane_rows=0,
+               native_lane_misses=0)
+    st = Source(sharded_launches={"lean": 0, "coupled": 0, "global": 0})
+    sample = pipeline_context(pipeline=p, storage=st)
+    assert sample() == {}  # no traffic yet
+    # 100 lane rows of which 80 admitted via lease, no misses
+    p.stats.update(
+        lease_admissions=80, native_lane_rows=100, native_lane_misses=0
+    )
+    st.stats["sharded_launches"] = {"lean": 6, "coupled": 2, "global": 2}
+    out = sample()
+    assert out["lease_share"] == pytest.approx(0.8)
+    assert out["collective_share"] == pytest.approx(0.4)
+    # second window: fully-leased traffic reads 1.0 (subset, not sum)
+    p.stats.update(lease_admissions=130, native_lane_rows=150)
+    out = sample()
+    assert out["lease_share"] == pytest.approx(1.0)
+    # third window: all-lean, no leases — the DELTA mix flips to 0
+    p.stats.update(native_lane_rows=250)
+    st.stats["sharded_launches"] = {"lean": 16, "coupled": 2, "global": 2}
+    out = sample()
+    assert out["lease_share"] == pytest.approx(0.0)
+    assert out["collective_share"] == pytest.approx(0.0)
+
+
+# -- GET /debug/capacity ------------------------------------------------------
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _capacity_client(debug_sources):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    app = make_http_app(
+        RateLimiter(), None, {}, debug_sources=debug_sources
+    )
+    return TestClient(TestServer(app))
+
+
+def test_debug_capacity_endpoint_and_what_if_params():
+    cal = [10.0]
+    est = _estimator(cal)
+    est.min_refit_s = 3600.0  # the endpoint must serve CACHED state
+    _drive(est, 400)
+
+    async def main():
+        client = _capacity_client([est])
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/capacity")
+            bare = await resp.json()
+            status = resp.status
+            resp2 = await client.get(
+                "/debug/capacity",
+                params={"batch": "2048", "lease_share": "0.5",
+                        "procs": "4"},
+            )
+            what_if = await resp2.json()
+            bad = []
+            for params in (
+                {"batch": "not-a-number"},
+                {"lease_share": "nan"},   # parses as float, breaks JSON
+                {"lease_share": "inf"},
+                {"batch": "-5"},
+                {"procs": "0"},
+            ):
+                r = await client.get("/debug/capacity", params=params)
+                bad.append(r.status)
+            # the bare /debug/stats render carries the same section
+            stats = await (await client.get("/debug/stats")).json()
+            return status, bare, what_if, bad, stats
+        finally:
+            await client.close()
+
+    status, bare, what_if, bad, stats = _run(main())
+    assert status == 200
+    assert bare["r2"] >= 0.8
+    assert bare["drift"]["state"] == "ok"
+    assert set(bare["coefficients"]) == set(MODEL_TARGETS)
+    assert "what_if" not in bare
+    wf = what_if["what_if"]
+    assert wf["batch"] == 2048
+    assert wf["lease_share"] == 0.5
+    assert wf["procs"] == 4
+    assert bad == [400] * 5
+    assert "capacity" in stats
+    assert stats["capacity"]["r2"] == bare["r2"]
+
+
+def test_debug_capacity_404_without_the_fit():
+    async def main():
+        client = _capacity_client([])
+        await client.start_server()
+        try:
+            resp = await client.get("/debug/capacity")
+            return resp.status, await resp.json()
+        finally:
+            await client.close()
+
+    status, body = _run(main())
+    assert status == 404
+    assert "not running" in body["error"]
+
+
+# -- bench integration --------------------------------------------------------
+
+
+def test_bench_rows_carry_the_serving_model_fit():
+    """bench.serving_model_fit() reads the PROCESS estimator the
+    bench's own drives feed — coefficients + R² on every emitted row
+    (the cross-round comparability contract)."""
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "bench", Path(__file__).parent.parent / "bench.py"
+    )
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    est = process_estimator()
+    for _ in range(64):
+        est.ingest(256, 1e-4, 3e-4, 1e-5)
+    was = model_fit_enabled()
+    try:
+        set_model_fit_enabled(True)
+        row = bench.serving_model_fit()
+        assert set(row) >= {"r2", "observations", "drift",
+                            "calibration", "coefficients"}
+        assert row["observations"] >= 64
+        assert set(row["coefficients"]) == set(MODEL_TARGETS)
+        # disabled -> rows carry {} instead of stale numbers
+        set_model_fit_enabled(False)
+        assert bench.serving_model_fit() == {}
+    finally:
+        set_model_fit_enabled(was)

@@ -281,6 +281,47 @@ def test_device_backed_runtime_matches_jax(pipeline):
     assert backed == (jax.devices()[0].platform not in ("", "cpu"))
 
 
+def test_slo_breach_is_only_actionable_when_device_backed(monkeypatch):
+    """The false-page fix (ISSUE 14 satellite): on a CPU-fallback box
+    ``slo_breached`` fires legitimately but un-actionably — the budget
+    was derived for device-backed serving and no operator action fixes
+    a missing device. ``slo_breached_actionable`` (the gauge the
+    Grafana alert panel gates on) and ``slo_status()['actionable']``
+    must require breached AND device-backed; raw ``slo_breached``
+    stays the unconditioned truth."""
+    from limitador_tpu.observability import native_plane as np_mod
+
+    clock = [0.0]
+    wd = SloWatchdog(budget_ms=2.0, clock=lambda: clock[0])
+    for _ in range(31):  # sustained burn across both windows
+        wd.observe_many([0.0001] * 190 + [0.005] * 10)
+        clock[0] += 10.0
+    assert wd.status()["breached"]
+    plane = NativePlane(watchdog=wd)
+    for backed, want_actionable in ((False, 0), (True, 1)):
+        monkeypatch.setattr(
+            np_mod, "device_backed_runtime", lambda b=backed: b
+        )
+        metrics = PrometheusMetrics()
+        plane.poll(metrics)
+        text = metrics.render().decode()
+        assert "slo_breached 1.0" in text  # the raw truth, ungated
+        assert (
+            f"slo_breached_actionable {want_actionable:.1f}" in text
+        ), (backed, text)
+        status = plane.slo_status()
+        assert status["breached"] is True
+        assert status["device_backed"] is backed
+        assert status["actionable"] is (backed and True)
+    # and an un-breached watchdog is never actionable, device or not
+    calm = NativePlane(budget_ms=2.0)
+    monkeypatch.setattr(np_mod, "device_backed_runtime", lambda: True)
+    metrics = PrometheusMetrics()
+    calm.poll(metrics)
+    assert "slo_breached_actionable 0.0" in metrics.render().decode()
+    assert calm.slo_status()["actionable"] is False
+
+
 # -- /debug/stats schema under live mixed traffic ----------------------------
 
 

@@ -107,6 +107,18 @@ POD_HOP_RECORD_BUDGET_US = 60.0
 #: a throttled rollup tick. Exchanges ride the probe cadence (2/s per
 #: peer), so this budget is about a pathological pod size, not rate.
 POD_SIGNAL_INGEST_BUDGET_US = 400.0
+#: per-launch budget for the serving-model observatory's ingest tap
+#: (µs): one lock + one bounded deque append, called by
+#: DeviceStatsRecorder.record_batch on the collect thread (ISSUE 14).
+#: The FIT must never ride this path — a refit, probe or numpy solve
+#: smuggled into ingest blows this budget by orders of magnitude.
+MODEL_INGEST_BUDGET_US = 25.0
+#: per-refit budget for the online coefficient fit (ms): drain a FULL
+#: ingest buffer (INGEST_CAP launches) through the RLS updates plus
+#: the miniaturized calibration probe + drift + headroom forecast.
+#: Runs on the usage observatory's drain thread (1 s cadence) or a
+#: debug render — 50 ms keeps it invisible at either cadence.
+MODEL_FIT_BUDGET_MS = 50.0
 
 
 def _blobs(n, users=512):
@@ -714,6 +726,54 @@ def test_analysis_gate_within_budget():
         f"analysis gate took {elapsed:.1f} s "
         f"(budget {ANALYSIS_GATE_BUDGET_S} s — did a pass start "
         "re-parsing per rule or walking the call graph quadratically?)"
+    )
+
+
+def test_model_ingest_within_budget():
+    """µs budget for the serving-model ingest tap: it runs once per
+    finished device batch ON the collect thread, so it must stay a
+    lock + bounded append — the fit itself belongs to refit()."""
+    from limitador_tpu.observability.model import ServingModelEstimator
+
+    est = ServingModelEstimator()
+    n = 20_000
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for i in range(n):
+            est.ingest(256, 1e-4, 3e-4, 1e-5)
+        best = min(best, time.perf_counter() - t0)
+    per_ingest_us = best / n * 1e6
+    assert per_ingest_us <= MODEL_INGEST_BUDGET_US, (
+        f"model ingest costs {per_ingest_us:.2f} µs/launch "
+        f"(budget {MODEL_INGEST_BUDGET_US} µs — did a refit, probe or "
+        "numpy solve sneak onto the collect thread?)"
+    )
+
+
+def test_model_refit_within_budget():
+    """ms budget for one refit over a FULL ingest buffer: the RLS
+    updates, prequential stats, CUSUM, calibration probe and headroom
+    grid-search all together, as the observatory drain thread pays it."""
+    from limitador_tpu.observability.model import ServingModelEstimator
+
+    est = ServingModelEstimator(min_refit_s=0.0)
+    rng = np.random.default_rng(7)
+    best = float("inf")
+    for _ in range(3):
+        for _i in range(est.INGEST_CAP):
+            rows = int(rng.choice([64, 256, 1024, 4096]))
+            est.ingest(rows, 5e-5 + 2e-6 * rows, 3e-4 + 5e-7 * rows,
+                       1e-5)
+        t0 = time.perf_counter()
+        consumed = est.refit(force=True)
+        best = min(best, time.perf_counter() - t0)
+        assert consumed == est.INGEST_CAP
+    per_refit_ms = best * 1e3
+    assert per_refit_ms <= MODEL_FIT_BUDGET_MS, (
+        f"model refit over {est.INGEST_CAP} launches costs "
+        f"{per_refit_ms:.1f} ms (budget {MODEL_FIT_BUDGET_MS} ms — "
+        "the drain thread pays this once a second)"
     )
 
 

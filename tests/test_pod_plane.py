@@ -346,6 +346,11 @@ def test_control_signals_field_order_is_pinned():
         "peers_suspect",
         "peers_down",
         "pod_degraded_share",
+        # serving-model observatory tail (ISSUE 14), appended LAST —
+        # also pinned (with the full order) by tests/test_model.py
+        "model_r2",
+        "capacity_headroom_ratio",
+        "model_drift",
     )
 
 
@@ -364,6 +369,7 @@ def test_control_signals_vector_order_is_pinned():
         box_calibration_score=27.5, device_backed=1, near_exhaustion=3,
         pod_routed_share=0.75, peers_up=2, peers_suspect=1,
         peers_down=1, pod_degraded_share=0.125,
+        model_r2=0.93, capacity_headroom_ratio=1.4, model_drift=1,
     )
     assert s.vector() == [
         1.0, 2.0, 0.5, 1.0,              # ts, queue, fill, breaker
@@ -371,7 +377,8 @@ def test_control_signals_vector_order_is_pinned():
         7.0,                             # lease outstanding
         10.0, 11.0, 12.0, 13.0, 14.0,    # native p99s in _PHASES order
         0.1, 0.2, 1.0, 27.5, 1.0, 3.0,   # slo/box/device/near
-        0.75, 2.0, 1.0, 1.0, 0.125,      # the pod tail, appended LAST
+        0.75, 2.0, 1.0, 1.0, 0.125,      # the pod tail
+        0.93, 1.4, 1.0,                  # the model tail, appended LAST
     ]
 
 
@@ -389,10 +396,11 @@ def test_signal_bus_joins_pod_fields():
     snap = bus.snapshot()
     assert snap.pod_routed_share == 0.9
     assert snap.peers_down == 1
-    assert snap.vector()[-5:] == [0.9, 3.0, 0.0, 1.0, 0.05]
+    # the pod slice sits just above the ISSUE 14 model tail (last 3)
+    assert snap.vector()[-8:-3] == [0.9, 3.0, 0.0, 1.0, 0.05]
     # without a pod the tail stays at neutral defaults (same schema)
     bare = SignalBus().snapshot()
-    assert bare.vector()[-5:] == [0.0, 0.0, 0.0, 0.0, 0.0]
+    assert bare.vector()[-8:-3] == [0.0, 0.0, 0.0, 0.0, 0.0]
 
 
 # -- metrics + HTTP surfaces ---------------------------------------------------
