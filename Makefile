@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos
 
 check: lint test
 
@@ -43,6 +43,15 @@ pod-smoke:
 # Skips cleanly when grpc (the subprocess harness) is unavailable.
 pod-chaos:
 	python -m pytest tests/test_pod_chaos.py -q
+
+# Elastic-pod resize drill (ISSUE 15): fast retarget/stale-epoch/
+# migration tier plus the slow resize-under-fire drill — a live 2->3
+# resize mid-soak with a subprocess host SIGKILLed mid-migration; the
+# transition aborts cleanly to the old topology with zero failed
+# answers outside the degraded window and final owner counter state
+# equal to the single-process oracle for window-born keys.
+pod-resize-chaos:
+	python -m pytest tests/test_pod_resize_chaos.py -q
 
 bench:
 	python bench.py

@@ -35,7 +35,11 @@ The other BASELINE configs run with --config:
                         local/forwarded split (round-robin AND ring-hash
                         arrivals) with the peer hop's p99, and the
                         shard-aware native hot lane's per-host engine
-                        rate / local-foreign split / bulk-forward sizes
+                        rate / local-foreign split / bulk-forward sizes,
+                        plus the elastic-pod resize row (decisions/sec
+                        and p99 before/during/after a live 2->4 resize
+                        with pod_resize_seconds and the routed-share
+                        recovery clock)
     --config backends   reference criterion scenarios per backend
     --config onbox      serving-stack closed-loop latency with the jax
                         backend pinned on-box (LIMITADOR_TPU_PLATFORM=cpu):
@@ -1761,6 +1765,180 @@ def bench_pod():
         pod_debug=pod_debug_by_p.get(str(full_p), {}),
         **({"pod_note": pod_note} if pod_note else {}),
         **({"pod_native_note": native_note} if native_note else {}),
+    )
+    bench_pod_resize()
+
+
+def bench_pod_resize():
+    """Elastic-pod resize row (ISSUE 15): decisions/sec and p99 sampled
+    BEFORE / DURING / AFTER a live 2->4 membership transition on an
+    in-process mini-pod (InMemory frontends over real gRPC peer lanes —
+    the resize control/migration plane is pure host code by design, so
+    this measures the machinery itself, not a device). The row embeds
+    ``pod_resize_seconds`` (wall time of the transition) and
+    ``pod_routed_share_recovery_s`` — how long after ``resize_end`` the
+    ring-hash-routed local share takes to return to >=0.9 of its
+    pre-resize value (the acceptance criterion's convergence clock)."""
+    import asyncio
+    import threading
+
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        print("bench_pod_resize: grpc unavailable, skipped",
+              file=sys.stderr)
+        return
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    n_full = 4
+    ports = [_free_port() for _ in range(n_full)]
+    addrs = {h: f"127.0.0.1:{ports[h]}" for h in range(n_full)}
+    limits = [Limit("bench_resize", 1 << 30, 3600, [], ["u"], name="u")]
+    lanes, fronts = [], []
+    for host in range(n_full):
+        member = host < 2
+        cfg = PodResilience(
+            degraded=True, retry=True, breaker_failures=2,
+            breaker_reset_s=0.2, probe_interval_s=0.2,
+        )
+        lane = PeerLane(
+            host, addrs[host],
+            {o: addrs[o] for o in range(2) if member and o != host},
+            None, resilience=cfg,
+        )
+        lane.start()
+        front = PodFrontend(
+            RateLimiter(InMemoryStorage(65536)),
+            PodRouter(PodTopology(
+                hosts=2 if member else n_full, host_id=host,
+                shards_per_host=1,
+            )),
+            lane, resilience=cfg,
+        )
+        coordinator = PodResizeCoordinator(
+            front,
+            peers={
+                h: addrs[h] for h in (range(2) if member else (host,))
+            },
+            listen_address=addrs[host],
+        )
+        front.attach_resize(coordinator)
+        asyncio.run(front.configure_with(limits))
+        lanes.append(lane)
+        fronts.append(front)
+    users = [f"u{i}" for i in range(256)]
+    # ring-hash arrivals: each user lands at its CURRENT owner (what an
+    # upstream that learned GET /debug/pod/routing would do)
+    phase_stats = {}
+
+    def drive(tag, seconds, hosts):
+        lat = []
+        n = 0
+        loop_deadline = time.perf_counter() + seconds
+        while time.perf_counter() < loop_deadline:
+            user = users[n % len(users)]
+            ctx = Context({"u": user})
+            front = fronts[n % hosts]
+            t0 = time.perf_counter()
+            asyncio.run(front.check_rate_limited_and_update(
+                "bench_resize", ctx, 1, False
+            ))
+            lat.append(time.perf_counter() - t0)
+            n += 1
+        lat.sort()
+        phase_stats[tag] = {
+            "decisions_per_sec": round(n / seconds, 1),
+            "p99_ms": round(
+                lat[int(0.99 * (len(lat) - 1))] * 1e3, 3
+            ) if lat else 0.0,
+        }
+
+    drive("before", 1.0, 2)
+    resize_out = {}
+
+    def run_resize():
+        try:
+            resize_out.update(fronts[0].resize.resize(
+                n_full, peers={h: addrs[h] for h in range(n_full)}
+            ))
+        except Exception as exc:
+            resize_out["error"] = f"{exc}"
+
+    t_resize = threading.Thread(target=run_resize, daemon=True)
+    t0 = time.perf_counter()
+    t_resize.start()
+    drive("during", 1.0, 2)  # arrivals keep hitting the old ingresses
+    t_resize.join(timeout=60)
+    resize_s = time.perf_counter() - t0
+    transition = resize_out.get("transition") or {}
+    if transition.get("seconds"):
+        # the headline is the transition's own wall time; the thread
+        # join above also absorbed the interleaved "during" drive
+        resize_s = float(transition["seconds"])
+    # routed-share recovery: drive ring-hash arrivals on the new
+    # topology until the local share is back over 0.9
+    recovery_s = None
+    t_rec = time.perf_counter()
+    for _ in range(50):
+        before_stats = [f.router.stats() for f in fronts]
+        for user in users:
+            key = (limits[0]._identity, (("u", user),))
+            owner = fronts[0].router.topology.owner_host(key)
+            front = fronts[owner if owner < len(fronts) else 0]
+            asyncio.run(front.check_rate_limited_and_update(
+                "bench_resize", Context({"u": user}), 1, False
+            ))
+        after_stats = [f.router.stats() for f in fronts]
+        local = sum(
+            a["pod_routed_local"] - b["pod_routed_local"]
+            for a, b in zip(after_stats, before_stats)
+        )
+        total = sum(
+            sum(a[k] - b[k] for k in (
+                "pod_routed_local", "pod_routed_forwarded",
+                "pod_routed_pinned",
+            ))
+            for a, b in zip(after_stats, before_stats)
+        )
+        if total and local / total >= 0.9:
+            recovery_s = round(time.perf_counter() - t_rec, 3)
+            break
+    drive("after", 1.0, n_full)
+    for lane in lanes:
+        lane.stop()
+    ok = bool(resize_out.get("ok"))
+    emit(
+        "pod_resize_seconds", resize_s, "s", 1.0, ndigits=3,
+        lower_is_better=True,
+        pod_resize_ok=ok,
+        pod_resize_hosts="2->4",
+        pod_resize_phases=phase_stats,
+        pod_resize_transition=resize_out.get("transition"),
+        pod_routed_share_recovery_s=recovery_s,
+        pod_resize_stats=fronts[0].resize.stats(),
+        **(
+            {"pod_resize_error": resize_out["error"]}
+            if "error" in resize_out else {}
+        ),
+    )
+    print(
+        f"pod resize 2->4: {'ok' if ok else 'FAILED'} in {resize_s:.2f}s, "
+        f"before {phase_stats['before']['decisions_per_sec']/1e3:.1f}k/s "
+        f"p99 {phase_stats['before']['p99_ms']:.1f}ms, during "
+        f"{phase_stats['during']['decisions_per_sec']/1e3:.1f}k/s p99 "
+        f"{phase_stats['during']['p99_ms']:.1f}ms, after "
+        f"{phase_stats['after']['decisions_per_sec']/1e3:.1f}k/s p99 "
+        f"{phase_stats['after']['p99_ms']:.1f}ms, routed-share recovery "
+        f"{recovery_s}s",
+        file=sys.stderr,
     )
 
 

@@ -73,6 +73,16 @@ EVENT_KINDS = (
     # up) — box phase changes classify as calibration_shift and do NOT
     # emit
     "model_drift",
+    # elastic pod (ISSUE 15): the live-resize state machine. Causal
+    # chain per transition: resize_begin < epoch_bump < migrate_begin/
+    # migrate_end per moving slice < resize_end (or resize_abort when
+    # the transition reverts to the old topology).
+    "resize_begin",
+    "epoch_bump",
+    "migrate_begin",
+    "migrate_end",
+    "resize_end",
+    "resize_abort",
 )
 
 

@@ -793,6 +793,71 @@ class PrometheusMetrics:
             "owner stand-ins (the federated degraded share column)",
             registry=self.registry,
         )
+        # -- elastic pod (server/resize.py, ISSUE 15): the live
+        # membership-transition plane, polled off the pod frontend's
+        # library_stats. Registered in resize.METRIC_FAMILIES (lint
+        # cross-checked).
+        self.pod_resize_epoch = Gauge(
+            "pod_resize_epoch",
+            "Current pod topology epoch (bumped by every membership "
+            "transition commit/revert; forwards are stamped with it "
+            "and wrong-epoch forwards rejected rerouteable)",
+            registry=self.registry,
+        )
+        self.pod_resize_active = Gauge(
+            "pod_resize_active",
+            "1 while a membership transition is in flight on this "
+            "host (armed or migrating)",
+            registry=self.registry,
+        )
+        self.pod_resize_completed = Counter(
+            "pod_resize_completed",
+            "Membership transitions completed on this host",
+            registry=self.registry,
+        )
+        self.pod_resize_aborted = Counter(
+            "pod_resize_aborted",
+            "Membership transitions aborted (reverted to the old "
+            "topology with received slices pushed back)",
+            registry=self.registry,
+        )
+        self.pod_resize_slices_moved = Counter(
+            "pod_resize_slices_moved",
+            "Table slices this host migrated out (snapshot + "
+            "convergence sweeps + release)",
+            registry=self.registry,
+        )
+        self.pod_resize_moved_deltas = Counter(
+            "pod_resize_moved_deltas",
+            "Counter rows shipped over the migrate lane (outbound "
+            "sweeps plus inbound ledger applies)",
+            registry=self.registry,
+        )
+        self.pod_resize_released_counters = Counter(
+            "pod_resize_released_counters",
+            "Old-owner counter cells released after their slice's "
+            "final marker was acknowledged by the new owner",
+            registry=self.registry,
+        )
+        self.pod_resize_seconds = Counter(
+            "pod_resize_seconds",
+            "Cumulative seconds spent inside membership transitions "
+            "(resize_begin to resize_end/resize_abort)",
+            registry=self.registry,
+        )
+        self.pod_resize_stale_rejects = Counter(
+            "pod_resize_stale_rejects",
+            "Forwards rejected by the owner-side topology-epoch gate "
+            "(stamped with an epoch this host is not on; the origin "
+            "re-plans)",
+            registry=self.registry,
+        )
+        self.pod_resize_replans = Counter(
+            "pod_resize_replans",
+            "Forwards that came back stale_epoch and were re-planned "
+            "in-band under the adopted topology",
+            registry=self.registry,
+        )
         for phase in HOP_PHASES:
             self.pod_hop_phase_ms.labels(phase)
         for kind in EVENT_KINDS:
@@ -1160,11 +1225,19 @@ class PrometheusMetrics:
                         seen - baseline
                     )
                     self._counter_baselines[baseline_key] = seen
+            # elastic pod (ISSUE 15): transition gauges set directly
+            if "pod_resize_epoch" in stats:
+                self.pod_resize_epoch.set(int(stats["pod_resize_epoch"]))
+            if "pod_resize_active" in stats:
+                self.pod_resize_active.set(
+                    int(stats["pod_resize_active"])
+                )
             # float-valued cumulative counters (seconds): same baseline
             # conversion as below, without the int truncation
             for key in (
                 "pod_failover_reconcile_seconds",
                 "pod_failover_seconds",
+                "pod_resize_seconds",
             ):
                 if key in stats:
                     seen_f = float(stats[key])
@@ -1219,6 +1292,13 @@ class PrometheusMetrics:
                 "pod_psum_decisions",
                 "pod_psum_limited",
                 "pod_psum_exchanges",
+                "pod_resize_completed",
+                "pod_resize_aborted",
+                "pod_resize_slices_moved",
+                "pod_resize_moved_deltas",
+                "pod_resize_released_counters",
+                "pod_resize_stale_rejects",
+                "pod_resize_replans",
             ):
                 if key in stats:
                     seen = int(stats[key])

@@ -212,6 +212,10 @@ _PAYLOAD_CACHE_S = 0.25
 _POD_FIELDS = (
     "pod_routed_share", "peers_up", "peers_suspect", "peers_down",
     "pod_degraded_share",
+    # elastic pod (ISSUE 15): the sum rollup counts hosts currently
+    # inside a membership transition — a resize stuck on one host
+    # shows as a persistent nonzero on the pod-wide timeline
+    "pod_resize_active",
 )
 
 

@@ -183,6 +183,19 @@ class PodRouter:
         #: so cross-host verdict changes are attributable to a limits
         #: generation, not a mystery.
         self.epoch = 0
+        #: TOPOLOGY generation (ISSUE 15): bumped only by a membership
+        #: transition (``retarget``), never by a limits reload — the
+        #: per-host limits-configure ``epoch`` above is not comparable
+        #: across hosts, but the topology epoch is synchronized by the
+        #: resize protocol, so forwards can stamp it and a wrong-epoch
+        #: owner can refuse to decide what it no longer owns. Plain int
+        #: read (no lock) on the forward path by design.
+        self.topology_epoch = 0
+        # the last applied limits generation, kept so retarget() can
+        # re-derive the pinned-namespace map under a NEW hosts count
+        # (pin_host depends on it) without waiting for a limits reload
+        self._last_limits: List = []
+        self._last_global: Tuple[str, ...] = ()
 
     # -- configuration -------------------------------------------------------
 
@@ -192,29 +205,81 @@ class PodRouter:
         agrees without coordination."""
         return stable_hash(("ns", str(namespace))) % hosts
 
-    def configure(
-        self, limits: Iterable, global_namespaces: Iterable[str] = ()
-    ) -> None:
-        """Re-derive the pinned-namespace map from a limits generation:
-        a namespace whose requests can touch >1 counter key (more than
+    @classmethod
+    def _derive_pinned(
+        cls, limits, global_namespaces, hosts: int
+    ) -> Dict[str, int]:
+        """THE pinning policy, shared by configure() and retarget(): a
+        namespace whose requests can touch >1 counter key (more than
         one limit) or whose budget is pod-global cannot be routed
-        per-key and is pinned whole to one host."""
+        per-key and is pinned whole to one host — the pin host is a
+        function of the hosts count, so a membership change re-derives
+        through the same code path a limits reload uses."""
         per_ns: Dict[str, int] = {}
         for limit in limits:
             ns = str(limit.namespace)
             per_ns[ns] = per_ns.get(ns, 0) + 1
         pinned = {
-            ns: self.pin_host(ns, self.topology.hosts)
+            ns: cls.pin_host(ns, hosts)
             for ns, count in per_ns.items()
             if count > 1
         }
         for ns in global_namespaces:
-            pinned[str(ns)] = self.pin_host(str(ns), self.topology.hosts)
+            pinned[str(ns)] = cls.pin_host(str(ns), hosts)
+        return pinned
+
+    def configure(
+        self, limits: Iterable, global_namespaces: Iterable[str] = ()
+    ) -> None:
+        """Apply a limits generation: re-derive the pinned-namespace
+        map (see ``_derive_pinned``) and bump the limits epoch."""
+        limits = list(limits)
+        global_namespaces = tuple(str(ns) for ns in global_namespaces)
+        pinned = self._derive_pinned(
+            limits, global_namespaces, self.topology.hosts
+        )
         with self._lock:
             self._pinned_ns = pinned
             self.epoch += 1
+            self._last_limits = limits
+            self._last_global = global_namespaces
+
+    def retarget(
+        self, topology: PodTopology, epoch: Optional[int] = None
+    ) -> int:
+        """Install a NEW pod topology on a running router (ISSUE 15:
+        live membership change). New arrivals route by the new geometry
+        from the moment this returns; the pinned-namespace map is
+        re-derived from the last applied limits generation because the
+        deterministic pin host is a function of the hosts count.
+        Returns the new topology epoch — bumped by one, or set to the
+        protocol-agreed ``epoch`` (every member of a transition must
+        land on the SAME number or the wrong-owner gate would reject
+        healthy forwards forever). The data migration that makes the
+        new routing TRUE is the resize coordinator's job — this method
+        is only the epoch-gated verdict flip."""
+        with self._lock:
+            limits, global_ns = self._last_limits, self._last_global
+        pinned = self._derive_pinned(limits, global_ns, topology.hosts)
+        with self._lock:
+            self.topology = topology
+            self._pinned_ns = pinned
+            if epoch is not None:
+                self.topology_epoch = int(epoch)
+            else:
+                self.topology_epoch += 1
+            return self.topology_epoch
 
     # -- the per-request verdict ---------------------------------------------
+
+    def pinned_map(self) -> Dict[str, int]:
+        """A copy of the pinned-namespace map (the resize coordinator
+        captures it on both sides of a retarget: a pinned namespace's
+        counters live on the PIN host, not their hash owner, so the
+        migration source predicate needs the map, not just the
+        geometry)."""
+        with self._lock:
+            return dict(self._pinned_ns)
 
     def pinned_host(self, namespace: str) -> Optional[int]:
         """The pin host of a namespace, or None when it routes per key.
@@ -288,11 +353,13 @@ class PodRouter:
         routing epoch — everything needed to send a descriptor straight
         to its owner (Envoy ring-hash on descriptor keys approximates
         it statistically; this map is the exact verdict)."""
-        topo = self.topology
         with self._lock:
+            topo = self.topology
             pinned = dict(self._pinned_ns)
             epoch = self.epoch
+            tepoch = self.topology_epoch
         return {
+            "topology_epoch": tepoch,
             "hosts": topo.hosts,
             "host_id": topo.host_id,
             "shards_per_host": topo.shards_per_host,

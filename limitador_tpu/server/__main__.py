@@ -355,6 +355,19 @@ def build_parser() -> argparse.ArgumentParser:
         "GET /debug/events (per-kind counts export as "
         "pod_events_total regardless of ring size)",
     )
+    # elastic pod (docs/configuration.md "Elastic pod", ISSUE 15):
+    # live resharding + membership change on a running pod
+    p.add_argument(
+        "--pod-resize", choices=["on", "off"],
+        default=_env("TPU_POD_RESIZE", "off"),
+        help="pod: on = arm the elastic-membership plane — forwards "
+        "stamp the topology epoch (wrong-epoch forwards are rejected "
+        "rerouteable), the migrate/resize lane kinds serve, and "
+        "POST /debug/pod/resize drives a live resize/add_host/"
+        "drain_host with slice-by-slice migration and zero lost "
+        "updates (an abort reverts to the old topology). off "
+        "(default) = byte-identical PR 14 wire format and behavior",
+    )
     # pod fast path (docs/configuration.md "Pod fast path", ISSUE 13):
     # shard-aware native hot lane + lockstep psum lane for global limits
     p.add_argument(
@@ -583,6 +596,66 @@ def _try_restore(path, restore_fn, what: str):
     return storage
 
 
+def _seed_from_sibling_snapshots(storage, base, owned, total_shards):
+    """Slice-mapped restore after a membership change (ISSUE 15): the
+    exact checkpoint for this host's owned shard range does not exist,
+    so decode every sibling checkpoint (current ``.shards<lo>-<hi>``
+    names AND legacy ``.host<id>`` ones) and seed ONLY the counters
+    this host owns under the CURRENT topology, through apply_deltas
+    (fresh windows, exact spends — the failover-replay accuracy
+    contract). Disjoint by construction: every host filters to its own
+    contiguous range, so a pod-wide rolling restart re-homes each slice
+    exactly once."""
+    import glob
+
+    from ..routing import counter_key, stable_hash
+    from ..tpu.sharded import snapshot_items
+
+    lo, hi = owned
+    files = sorted(
+        set(glob.glob(base + ".shards*") + glob.glob(base + ".host*"))
+    )
+    files = [
+        f for f in files
+        if not (f.endswith(".rejected") or f.endswith(".tmp"))
+    ]
+    # Newest checkpoint first, and each counter seeds from exactly ONE
+    # file: a live counter can appear in several files (a legacy
+    # .host<id> left behind next to the .shards name that replaced it,
+    # or stale files from a previous shard range) and applying it per
+    # file would double its spend.
+    files.sort(key=lambda f: os.path.getmtime(f), reverse=True)
+    seeded = 0
+    seen = set()
+    for path in files:
+        try:
+            items = snapshot_items(path)
+        except Exception as exc:
+            log.warning(
+                f"pod: sibling snapshot {path} undecodable ({exc}); "
+                "skipped")
+            continue
+        mine = []
+        for counter, value in items:
+            key = counter_key(counter)
+            if key in seen:
+                continue
+            if lo <= stable_hash(key) % total_shards < hi:
+                seen.add(key)
+                mine.append((counter, value))
+        if not mine:
+            continue
+        try:
+            storage.apply_deltas(mine)
+            seeded += len(mine)
+        except Exception as exc:
+            log.warning(f"pod: seeding from {path} failed: {exc}")
+    if seeded:
+        log.info(
+            f"pod: slice-mapped restore seeded {seeded} owned "
+            f"counters from {len(files)} sibling checkpoint(s)")
+
+
 def _preserve_rejected_snapshot(path: str) -> None:
     """A checkpoint we could not restore must be moved aside, NOT left in
     place: the fresh table's periodic snapshot loop would overwrite it,
@@ -803,6 +876,20 @@ def build_limiter(args, on_partitioned=None):
                 global_namespaces=sorted(cli_global_ns),
                 global_region=args.global_region,
             )
+            # Slice-mapped restore (ISSUE 15): the exact checkpoint for
+            # this host's CURRENT shard range is missing (first boot,
+            # or the membership changed since the last checkpoint) —
+            # re-key every sibling checkpoint and seed only the
+            # counters this host owns now.
+            if getattr(args, "_pod_snapshot_base", None):
+                _seed_from_sibling_snapshots(
+                    storage,
+                    args._pod_snapshot_base,
+                    args._pod_owned_shards,
+                    args._pod_total_shards,
+                )
+        if getattr(args, "_pod_snapshot_meta", None):
+            storage.snapshot_meta = args._pod_snapshot_meta
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6,
             dispatch_chunk=args.dispatch_chunk,
@@ -917,11 +1004,34 @@ async def _amain(args) -> int:
             f"{pod.local_device_count} local of "
             f"{pod.global_device_count} global devices")
         if args.snapshot_path:
+            # Snapshot names are keyed by OWNED SHARD RANGE, not host
+            # id (ISSUE 15): after a membership change the exact file
+            # for the new range is missing and the sharded branch
+            # re-keys every sibling checkpoint (including legacy
+            # .host<id> names) through the slice-granular decode,
+            # seeding only the counters this host owns under the NEW
+            # topology — instead of silently loading the wrong host's
+            # table (or refusing).
+            sph = max(pod.local_device_count, 1)
+            lo = pod.process_id * sph
+            args._pod_snapshot_base = args.snapshot_path
+            args._pod_owned_shards = (lo, lo + sph)
+            args._pod_total_shards = pod.num_processes * sph
+            args._pod_snapshot_meta = {
+                "owned_shards": [lo, lo + sph],
+                "topology": {
+                    "hosts": pod.num_processes,
+                    "host_id": pod.process_id,
+                    "shards_per_host": sph,
+                    "total_shards": pod.num_processes * sph,
+                },
+            }
             args.snapshot_path = (
-                f"{args.snapshot_path}.host{pod.process_id}"
+                f"{args.snapshot_path}.shards{lo}-{lo + sph}"
             )
             log.info(
-                f"pod: per-host snapshot path {args.snapshot_path}")
+                f"pod: per-shard-range snapshot path "
+                f"{args.snapshot_path}")
 
     initial_labels = args.metric_labels
     if args.metric_labels_file:
@@ -1057,6 +1167,30 @@ async def _amain(args) -> int:
             f"{resilience.hedge_ms:.0f}ms, breaker "
             f"{resilience.breaker_failures} failures / "
             f"{resilience.breaker_reset_s * 1e3:.0f}ms reset")
+        if args.pod_resize == "on":
+            # Elastic pod (ISSUE 15): arm the live-resize plane.
+            # Everything stays inert until POST /debug/pod/resize (or a
+            # peer's resize proposal) drives a transition — except that
+            # forwards now stamp the topology epoch and the wrong-owner
+            # gate serves, which is the point of arming.
+            from .resize import PodResizeCoordinator
+
+            coordinator = PodResizeCoordinator(
+                pod_frontend,
+                peers={i: url for i, url in enumerate(peer_urls)},
+                listen_address=peer_urls[pod.process_id],
+                slice_pause_s=float(
+                    _env("TPU_POD_RESIZE_SLICE_PAUSE_MS", "0") or 0
+                ) / 1e3,
+                transition_timeout_s=float(
+                    _env("TPU_POD_RESIZE_TIMEOUT_S", "60") or 60
+                ),
+            )
+            pod_frontend.attach_resize(coordinator)
+            log.info(
+                "elastic pod armed: POST /debug/pod/resize drives live "
+                "resize/add_host/drain_host (topology epoch "
+                f"{pod_frontend.router.topology_epoch})")
         if args.pod_psum_lane == "on" and pod_global_ns:
             # Lockstep psum lane (ISSUE 13): eligible fixed-window
             # global namespaces decide locally on EVERY host against

@@ -93,6 +93,9 @@ DEBUG_SOURCE_SECTIONS = (
     # serving-model observatory (ISSUE 14): fitted coefficients, R²,
     # drift state and SLO headroom (GET /debug/capacity adds what-ifs)
     ("capacity", "capacity_debug"),
+    # elastic pod (ISSUE 15): the live-resize state machine —
+    # transition state, received-slice ledger, topology epoch
+    ("pod_resize", "resize_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -116,6 +119,7 @@ DEBUG_STATS_SECTIONS = (
     "pod_events",
     "pod_routing",
     "capacity",
+    "pod_resize",
 )
 
 
@@ -293,6 +297,32 @@ def _openapi_spec() -> dict:
                         "404": {"description": "not a pod"},
                     },
                 }
+            },
+            "/debug/pod/resize": {
+                "get": {
+                    "summary": "Elastic pod: the live membership-"
+                               "transition state machine (epochs, "
+                               "moved slices, received ledger)",
+                    "responses": {
+                        "200": {"description": "resize status"},
+                        "404": {"description": "not a pod or "
+                                               "--pod-resize off"},
+                    },
+                },
+                "post": {
+                    "summary": "Drive a live pod resize: {hosts: N, "
+                               "peers: {id: addr}} migrates owned "
+                               "slices epoch-gated with zero lost "
+                               "updates; aborts revert to the old "
+                               "topology",
+                    "responses": {
+                        "200": {"description": "transition complete"},
+                        "400": {"description": "malformed proposal"},
+                        "404": {"description": "not a pod or "
+                                               "--pod-resize off"},
+                        "409": {"description": "refused or aborted"},
+                    },
+                },
             },
             "/debug/capacity": {
                 "get": {
@@ -611,6 +641,67 @@ class _Api:
             )
         return web.json_response(fn())
 
+    def _resize_coordinator(self):
+        fn = self._debug_source_fn("resize_debug")
+        if fn is None:
+            return None, web.json_response(
+                {"error": "not a pod (single-host deployment)"},
+                status=404,
+            )
+        out = fn()
+        if not out.get("armed"):
+            return None, web.json_response(
+                {"error": "pod resize not armed (--pod-resize off)"},
+                status=404,
+            )
+        return out, None
+
+    async def get_debug_pod_resize(
+        self, request: web.Request
+    ) -> web.Response:
+        """The elastic-membership state machine (ISSUE 15): the live
+        transition (state, epochs, moved slices), the received-slice
+        ledger and cumulative resize counters."""
+        out, err = self._resize_coordinator()
+        if err is not None:
+            return err
+        return web.json_response(out)
+
+    async def post_debug_pod_resize(
+        self, request: web.Request
+    ) -> web.Response:
+        """Drive a LIVE membership transition: ``{"hosts": N,
+        "peers": {"2": "host:port", ...}}`` resizes the running pod to
+        N hosts (peers must name every member the coordinator does not
+        already know). Blocks until the transition completes or aborts;
+        an abort reverts to the old topology with nothing lost
+        (docs/configuration.md, "Elastic pod")."""
+        _out, err = self._resize_coordinator()
+        if err is not None:
+            return err
+        try:
+            data = await request.json()
+            hosts = int(data["hosts"])
+            peers = {
+                int(h): str(a)
+                for h, a in (data.get("peers") or {}).items()
+            }
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response(
+                {"error": f"bad request: {exc}"}, status=400
+            )
+        resize_fn = self._debug_source_fn("pod_resize_admin")
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None, lambda: resize_fn(hosts, peers)
+            )
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(out, status=200 if out.get("ok") else 409)
+
     async def get_debug_capacity(
         self, request: web.Request
     ) -> web.Response:
@@ -839,6 +930,8 @@ def make_http_app(
     app.router.add_get("/debug/signals", api.get_debug_signals)
     app.router.add_get("/debug/pod", api.get_debug_pod)
     app.router.add_get("/debug/pod/routing", api.get_debug_pod_routing)
+    app.router.add_get("/debug/pod/resize", api.get_debug_pod_resize)
+    app.router.add_post("/debug/pod/resize", api.post_debug_pod_resize)
     app.router.add_get("/debug/capacity", api.get_debug_capacity)
     app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)

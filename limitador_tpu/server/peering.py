@@ -142,6 +142,9 @@ _LATENCY_WINDOW = 2048
 #: their own replay semantics and are never retried by the lane.
 RETRYABLE_KINDS = frozenset({
     "check_and_update", "is_rate_limited", "ping", "bulk_decide",
+    # NOT "migrate": slice batches ride admin_call (no lane retry) —
+    # the resize coordinator owns its own bounded retry loop, and the
+    # receiver ledger makes re-delivery idempotent either way.
 })
 
 #: metric families this subsystem owns (cross-checked against
@@ -169,6 +172,12 @@ METRIC_FAMILIES = (
     "pod_bulk_forward_rows",
     "pod_bulk_served_rows",
 )
+
+#: the typed, rerouteable status a wrong-epoch forward is rejected with
+#: (ISSUE 15): the owner-side gate answers this instead of deciding a
+#: key it no longer (or does not yet) own; the origin adopts the newer
+#: topology when one is attached and re-plans the request.
+STALE_EPOCH = "stale_epoch"
 
 
 def _encode_context(ctx: Context) -> dict:
@@ -343,6 +352,19 @@ class PeerHealth:
             self.transitions += 1
             return new
 
+    def set_peers(self, peers) -> None:
+        """Adopt a new peer set (live membership change, ISSUE 15):
+        new peers start UP with a clean failure count; departed peers
+        drop out of the map (their forwards stop existing)."""
+        with self._health_lock:
+            peers = set(peers)
+            for peer in peers - set(self._state):
+                self._state[peer] = PeerState.UP
+                self._failures[peer] = 0
+            for peer in set(self._state) - peers:
+                self._state.pop(peer, None)
+                self._failures.pop(peer, None)
+
     def record_success(self, peer: int) -> Optional[str]:
         with self._health_lock:
             if peer not in self._state:
@@ -483,6 +505,23 @@ class PeerLane:
         #: back to its per-request hop). Wired by PodFrontend.
         #: attach_pipeline.
         self.bulk_cb = None
+        #: elastic pod (ISSUE 15) attach points, all wired by the
+        #: resize coordinator; None = the PR 14 wire format and serve
+        #: path, byte-identical. ``epoch_provider`` -> current topology
+        #: epoch (stamped on forwards, gated on serves);
+        #: ``stale_info_provider`` -> the topology/peers blob a stale
+        #: rejection carries so a behind origin can adopt;
+        #: ``migrate_cb(payload) -> dict`` applies one migrated slice
+        #: batch (blocking — run off-loop); ``resize_cb(payload) ->
+        #: dict`` answers resize control ops (fast, lane loop).
+        self.epoch_provider: Optional[Callable[[], int]] = None
+        self.stale_info_provider: Optional[Callable[[], dict]] = None
+        self.migrate_cb = None
+        self.resize_cb = None
+        #: callable(resp dict): a forward came back stale_epoch — the
+        #: origin-side adoption hook (coordinator.adopt_remote)
+        self.on_stale = None
+        self.stale_rejects = 0
         #: sync callable (host) -> bool run on a recovery thread when a
         #: background probe finds a non-up peer answering again; True
         #: marks the peer up (the frontend replays its journal first)
@@ -635,13 +674,108 @@ class PeerLane:
         if self._thread is not None:
             self._thread.join(timeout=5)
 
+    def set_peers(self, peers: Dict[int, str]) -> None:
+        """Adopt a new peer map on a running lane (live membership
+        change, ISSUE 15). Safe from any thread: the dict swap is
+        atomic, health adds/removes under its own lock, and departed
+        peers' cached channels are closed on the lane loop (an
+        in-flight call on one surfaces as the usual connection
+        failure)."""
+        peers = {int(h): str(addr) for h, addr in peers.items()}
+        peers.pop(self.host_id, None)
+        old = self.peers
+        self.peers = peers
+        self.health.set_peers(peers)
+        removed = [h for h in old if h not in peers]
+        if removed and self._loop is not None:
+            def _close_removed():
+                for host in removed:
+                    entry = self._channels.pop(host, None)
+                    if entry is not None:
+                        asyncio.ensure_future(entry[0].close())
+            self._loop.call_soon_threadsafe(_close_removed)
+
+    def admin_call(
+        self, host: int, payload: dict, timeout: float = 5.0
+    ) -> dict:
+        """One blocking control-plane RPC to a peer (resize protocol —
+        coordinator/recovery threads only, NEVER a serving loop).
+        Raises on peer failure; the caller owns retries/abort."""
+        blob = json.dumps(payload).encode()
+        fut = asyncio.run_coroutine_threadsafe(
+            self._attempt(host, blob, timeout), self._loop
+        )
+        return json.loads(fut.result(timeout + 1.0).decode())
+
     # -- server side ---------------------------------------------------------
+
+    def _stale_response(self) -> bytes:
+        """The typed rerouteable rejection a wrong-epoch forward gets
+        (ISSUE 15): carries our topology epoch and (when the resize
+        plane is armed) the full topology/peers blob so a behind
+        origin can adopt and re-plan instead of failing the request."""
+        self.stale_rejects += 1
+        provider = self.epoch_provider
+        out = {
+            "ok": False,
+            STALE_EPOCH: True,
+            "tepoch": int(provider()) if provider is not None else 0,
+        }
+        info_provider = self.stale_info_provider
+        if info_provider is not None:
+            try:
+                out.update(info_provider() or {})
+            except Exception:
+                pass
+        return json.dumps(out).encode()
+
+    def _epoch_mismatch(self, payload: dict) -> bool:
+        """The owner-side epoch gate: ONE int compare per payload (per
+        batch on the bulk path — never per row), and only when both
+        sides are resize-armed; un-stamped payloads (PR 14 peers,
+        resize off) serve unconditionally."""
+        provider = self.epoch_provider
+        if provider is None or "tepoch" not in payload:
+            return False
+        return int(payload["tepoch"]) != int(provider())
 
     async def _serve_decide(self, blob: bytes, context) -> bytes:
         payload = json.loads(blob.decode())
         kind = payload.get("kind", "check_and_update")
         if kind == "ping":
             return json.dumps({"ok": True, "pong": True}).encode()
+        if kind == "resize_admin":
+            # Elastic-pod control plane (ISSUE 15): propose/commit/
+            # status/abort ops answered by the coordinator. Handlers
+            # are fast (lock + state flip; migration work happens on
+            # coordinator threads) so they run inline on the lane loop.
+            handler = self.resize_cb
+            if handler is None:
+                return json.dumps({
+                    "ok": False, "error": "pod resize not armed",
+                }).encode()
+            try:
+                out = handler(payload) or {}
+            except Exception as exc:
+                out = {"ok": False, "error": f"{exc}"[:200]}
+            return json.dumps(out).encode()
+        if kind == "migrate":
+            # One migrated slice batch (absolute counter values; the
+            # receiver applies diffs against its transition ledger).
+            # Epoch-gated: a migrate stamped for a transition we have
+            # already left (aborted or completed past it) must not
+            # seed counters we do not own.
+            handler = self.migrate_cb
+            if handler is None:
+                return json.dumps({
+                    "ok": False, "error": "pod resize not armed",
+                }).encode()
+            if self._epoch_mismatch(payload):
+                return self._stale_response()
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, handler, payload
+            )
+            return json.dumps(out or {"ok": True}).encode()
         if kind == "signals":
             # Federated signal exchange (ISSUE 12): ingest the caller's
             # column, answer with ours — symmetric, one RPC per pair
@@ -676,6 +810,11 @@ class PeerLane:
                     "pod peer lane has no bulk_decide handler (native "
                     "pipeline not attached)"
                 )
+            if self._epoch_mismatch(payload):
+                # a whole bulk batch routed by a dead topology: reject
+                # once (one compare per BATCH); the origin re-plans
+                # every row through its per-request path
+                return self._stale_response()
             meta = {}
             try:
                 meta = dict(context.invocation_metadata() or ())
@@ -714,6 +853,11 @@ class PeerLane:
                 None, self.apply_cb, payload.get("deltas", [])
             )
             return json.dumps({"ok": True, "applied": int(applied)}).encode()
+        if self._epoch_mismatch(payload):
+            # unary (FORWARD and PINNED verdicts alike): a forward
+            # stamped with a topology epoch we are not on would be
+            # decided by a wrong owner — reject rerouteable instead
+            return self._stale_response()
         self.served += 1
         # Cross-host decision tracing (ISSUE 12): adopt the origin's
         # request id for this task's context, so OUR flight-recorder
@@ -1075,14 +1219,20 @@ class PeerLane:
         # flight-recorder entries and spans correlate back to us.
         request_id = _wire_request_id(current_request_id())
         t0 = time.perf_counter()
-        blob = json.dumps({
+        wire = {
             "ns": str(namespace),
             "ctx": _encode_context(ctx),
             "delta": int(delta),
             "load": bool(load),
             "kind": kind,
             "from": self.host_id,
-        }).encode()
+        }
+        provider = self.epoch_provider
+        if provider is not None:
+            # resize armed: stamp the topology epoch the routing
+            # verdict was computed under (one int per payload)
+            wire["tepoch"] = int(provider())
+        blob = json.dumps(wire).encode()
         serialize_s = time.perf_counter() - t0
         metadata = None
         pairs = hop_trace_metadata()
@@ -1107,6 +1257,16 @@ class PeerLane:
         with self._latency_lock:
             self._latencies_ms.append(total_s * 1e3)
         resp = json.loads(raw.decode())
+        if resp.get(STALE_EPOCH):
+            # adopt the rejection's (possibly newer) topology BEFORE
+            # the caller re-plans, so the re-plan routes by it
+            hook = self.on_stale
+            if hook is not None:
+                try:
+                    hook(resp)
+                except Exception:
+                    pass
+            return resp
         hook = self.on_hop
         if hook is not None:
             # The per-hop breakdown: the owner reports its decide time,
@@ -1139,11 +1299,15 @@ class PeerLane:
             raise RuntimeError(f"no peer lane for pod host {host}")
         request_id = _wire_request_id(current_request_id())
         t0 = time.perf_counter()
-        blob = json.dumps({
+        wire = {
             "kind": "bulk_decide",
             "from": self.host_id,
             "blobs": [base64.b64encode(b).decode() for b in blobs],
-        }).encode()
+        }
+        provider = self.epoch_provider
+        if provider is not None:
+            wire["tepoch"] = int(provider())
+        blob = json.dumps(wire).encode()
         serialize_s = time.perf_counter() - t0
         metadata = None
         pairs = hop_trace_metadata()
@@ -1169,6 +1333,17 @@ class PeerLane:
         with self._latency_lock:
             self._latencies_ms.append(total_s * 1e3)
         resp = json.loads(raw.decode())
+        if resp.get(STALE_EPOCH):
+            # the whole batch was routed by a dead topology: adopt the
+            # newer one, answer all-None — every row falls back to its
+            # per-request path, which re-plans under the new epoch
+            hook = self.on_stale
+            if hook is not None:
+                try:
+                    hook(resp)
+                except Exception:
+                    pass
+            return [None] * len(blobs)
         hook = self.on_hop
         if hook is not None:
             remote_s = max(float(resp.get("decide_ns", 0)) / 1e9, 0.0)
@@ -1203,6 +1378,9 @@ class PeerLane:
             "pod_bulk_forward_rows": self.bulk_forward_rows,
             "pod_bulk_served_rows": self.bulk_served_rows,
             "pod_peer_p99_ms": round(self.peer_p99_ms(), 3),
+            # owner-side wrong-epoch rejections (ISSUE 15; family owned
+            # by server/resize.py — the value lives on the lane's gate)
+            "pod_resize_stale_rejects": self.stale_rejects,
             "peer_health_state": self.health.states(),
             "peer_health_retries": self.retries,
             "peer_health_hedges_won": self.hedges_won,
@@ -1254,11 +1432,12 @@ class _PeerDeltaSink:
 
     Chunked: a long partition can journal far more counters than one
     gRPC message survives (the lane server runs the default 4MB
-    receive cap), so the replay ships bounded batches. A failure mid-
-    replay restores the WHOLE journal (reconcile_into's contract) and
-    already-applied chunks re-apply on the next recovery — re-applying
-    a delta over-counts, which for a limiter can only under-admit, the
-    conservative direction."""
+    receive cap), so the replay ships bounded batches. The sink exposes
+    ``apply_deltas_acked`` so FailoverStore's reconcile tracks the
+    acknowledged-chunk high-water mark: a failure mid-replay restores
+    only the UN-acked tail, and a re-driven reconcile (a mid-migration
+    peer death, ISSUE 15 satellite) never double-applies the prefix
+    the owner already counted."""
 
     CHUNK = 1000
 
@@ -1266,14 +1445,17 @@ class _PeerDeltaSink:
         self._lane = lane
         self._owner = owner
 
-    def apply_deltas(self, items) -> None:
+    def apply_deltas_acked(self, items, ack) -> None:
         deltas = [
             _counter_to_wire(counter, delta) for counter, delta in items
         ]
         for start in range(0, len(deltas), self.CHUNK):
-            self._lane.replay_deltas(
-                self._owner, deltas[start:start + self.CHUNK]
-            )
+            chunk = deltas[start:start + self.CHUNK]
+            self._lane.replay_deltas(self._owner, chunk)
+            ack(start + len(chunk))
+
+    def apply_deltas(self, items) -> None:
+        self.apply_deltas_acked(items, lambda _n: None)
 
 
 class PodFrontend:
@@ -1315,6 +1497,14 @@ class PodFrontend:
         #: PodPsumLane, ISSUE 13); eligible global namespaces decide
         #: LOCALLY through it instead of funneling to a pin host
         self.psum_lane = None
+        #: PodResizeCoordinator (server/resize.py, ISSUE 15); None =
+        #: PR 14 behavior byte-identical (no epoch stamping, no gate)
+        self.resize = None
+        #: forwards answered stale_epoch that re-planned in-band
+        self.stale_replans = 0
+        #: the last applied limits generation — the resize coordinator
+        #: enumerates migratable counters from it
+        self._last_limits: List = []
         # Pod observability plane (ISSUE 12): the typed event timeline,
         # the per-hop breakdown recorder and the federated signal
         # aggregator — always on (bounded rings, off the decision
@@ -1360,6 +1550,7 @@ class PodFrontend:
         if self.psum_lane is not None:
             served = self.psum_lane.configure(limits, self._global_ns)
             pinned_global = self._global_ns - served
+        self._last_limits = limits
         self.router.configure(limits, pinned_global)
         self.events.emit(
             "routing_epoch", epoch=self.router.epoch, limits=len(limits)
@@ -1384,6 +1575,118 @@ class PodFrontend:
         limits it can serve stop pinning to one host — every ingress
         decides them locally against the pod-wide psum aggregate."""
         self.psum_lane = lane
+
+    # -- elastic pod (ISSUE 15) ----------------------------------------------
+
+    def attach_resize(self, coordinator) -> None:
+        """Arm the elastic-membership plane: forwards stamp the
+        topology epoch, the owner-side gate rejects wrong-epoch
+        forwards rerouteable, and the lane's migrate/resize_admin
+        kinds route to the coordinator. Without this call the wire
+        format and serve path are byte-identical to PR 14."""
+        self.resize = coordinator
+        self.lane.epoch_provider = (
+            lambda: self.router.topology_epoch
+        )
+        self.lane.stale_info_provider = coordinator.stale_info
+        self.lane.migrate_cb = coordinator.handle_migrate
+        self.lane.resize_cb = coordinator.handle_admin
+        self.lane.on_stale = coordinator.adopt_remote
+
+    def ensure_guards(self) -> None:
+        """Create degraded-owner guards for peers that joined after
+        construction (live membership change): every forwardable owner
+        keeps the failover safety net."""
+        if not self._resilience.degraded:
+            return
+        for owner in self.lane.peers:
+            if owner not in self._guards:
+                guard = _OwnerGuard(owner, self._resilience)
+                guard.breaker.listeners.append(
+                    self._breaker_listener(owner)
+                )
+                self._guards[owner] = guard
+
+    async def _stale_replan(
+        self, namespace, ctx, delta, load, kind
+    ):
+        """A forward was rejected stale_epoch: the topology moved under
+        the request. Re-plan under the (possibly just-adopted) current
+        topology, bounded: the commit broadcast lands within
+        milliseconds, so a couple of spaced re-plans cover both the
+        we-are-behind and the owner-is-behind races; the degraded
+        stand-in is the terminal fallback — a membership change must
+        never fail a request that PR 11 machinery can answer."""
+        self.stale_replans += 1
+        owner = None
+        counters: List[Counter] = []
+        for attempt in range(3):
+            verdict, owner, counters = self._route(namespace, ctx)
+            if verdict == LOCAL:
+                if kind == "is_rate_limited":
+                    return await self._local_is_limited(
+                        namespace, ctx, delta, counters
+                    )
+                if kind == "update_counters":
+                    await self._local_update(
+                        namespace, ctx, delta, counters
+                    )
+                    return None
+                return await self._local_check(
+                    namespace, ctx, delta, load, counters
+                )
+            guard = self._guards.get(owner)
+            if guard is not None and guard.breaker.is_open():
+                return self._degraded_decide(
+                    guard, counters, delta, load, kind
+                )
+            try:
+                resp = await self.lane.forward(
+                    owner, namespace, ctx, delta, load, kind=kind
+                )
+            except Exception as exc:
+                err = StorageError(
+                    f"pod peer host {owner} unavailable: {exc}"
+                )
+                if guard is not None:
+                    guard.breaker.record_failure(err)
+                    return self._degraded_decide(
+                        guard, counters, delta, load, kind
+                    )
+                raise err from exc
+            if isinstance(resp, dict) and resp.get(STALE_EPOCH):
+                # either side may still be mid-commit: give the
+                # broadcast a moment, then re-plan again
+                await asyncio.sleep(0.02 * (attempt + 1))
+                continue
+            if guard is not None:
+                guard.breaker.record_success()
+            if kind == "update_counters":
+                return None
+            return self._adopt(resp)
+        guard = self._guards.get(owner)
+        if guard is not None:
+            return self._degraded_decide(guard, counters, delta, load, kind)
+        raise StorageError(
+            f"pod topology epoch disagreement with host {owner} "
+            "(resize in flight, no degraded fallback)"
+        )
+
+    def resize_debug(self) -> dict:
+        """``GET /debug/pod/resize`` + the ``pod_resize`` /debug/stats
+        section: the transition state machine's live view."""
+        if self.resize is None:
+            return {"armed": False}
+        out = self.resize.status()
+        out["armed"] = True
+        return out
+
+    def pod_resize_admin(self, hosts: int, peers=None) -> dict:
+        """The admin surface behind ``POST /debug/pod/resize``
+        (blocking — the HTTP handler runs it in an executor)."""
+        if self.resize is None:
+            raise StorageError("pod resize not armed (--pod-resize off)")
+        return self.resize.resize(int(hosts), peers=peers)
 
     async def forward_bulk(
         self, owner: int, blobs: List[bytes]
@@ -1513,6 +1816,13 @@ class PodFrontend:
             "pod_degraded_share": round(
                 degraded / total, 6
             ) if total else 0.0,
+            # elastic pod (ISSUE 15): hosts mid-transition sum across
+            # the federated view — a stuck resize is visible pod-wide
+            "pod_resize_active": (
+                1 if self.resize is not None and self.resize.active
+                else 0
+            ),
+            "tepoch": self.router.topology_epoch,
         }
 
     def pod_debug(self) -> dict:
@@ -1760,6 +2070,13 @@ class PodFrontend:
                     guard, counters, delta, load, kind
                 )
             raise err from exc
+        if isinstance(resp, dict) and resp.get(STALE_EPOCH):
+            # rejected by a wrong-epoch owner (ISSUE 15): the lane
+            # already ran the adoption hook; re-plan under the current
+            # topology instead of failing the request
+            return await self._stale_replan(
+                namespace, ctx, delta, load, kind
+            )
         if guard is not None:
             # A successful forward resets the consecutive-failure count
             # (the batchers do this per device batch on the admission
@@ -1866,6 +2183,9 @@ class PodFrontend:
         stats.update(self.aggregator.stats())
         if self.psum_lane is not None:
             stats.update(self.psum_lane.stats())
+        if self.resize is not None:
+            stats.update(self.resize.stats())
+            stats["pod_resize_replans"] = self.stale_replans
         return stats
 
     def close_pod(self) -> None:

@@ -42,6 +42,13 @@ class FailoverStore:
         self._journal: Dict[Counter, int] = {}
         self.decisions = 0          # checks served host-side (cumulative)
         self.reconciled_deltas = 0  # deltas replayed to device (cumulative)
+        #: drained-high-water mark (ISSUE 15 satellite): cumulative
+        #: count of drained deltas whose apply was ACKNOWLEDGED by the
+        #: sink. A chunked reconcile that fails partway restores only
+        #: the un-acked tail, so re-driving the reconcile (exactly what
+        #: a mid-migration peer death causes) can never double-apply
+        #: the already-acknowledged prefix.
+        self.drained_high_water = 0
 
     # -- the failed-over check path ------------------------------------------
 
@@ -83,27 +90,69 @@ class FailoverStore:
             self._journal.clear()
         return items
 
+    def reset_oracle(self) -> None:
+        """Forget the stand-in's window state without a reconcile —
+        used when the journal was redistributed out-of-band (elastic
+        pod abort, ISSUE 15): keeping the oracle would double-count on
+        the next degraded window for the same keys."""
+        self._oracle.clear()
+
+    def rejournal(self, items: List[Tuple[Counter, int]]) -> None:
+        """Put drained-but-unapplied deltas BACK (merging with anything
+        journaled since): an out-of-band redistributor (elastic pod
+        orphan-journal sweep) that fails to land part of a drain must
+        restore that part, exactly as reconcile_into restores its
+        un-acked tail — a drained delta is only gone once some owner
+        acknowledged it."""
+        with self._lock:
+            for counter, delta in items:
+                self._journal[counter] = (
+                    self._journal.get(counter, 0) + int(delta)
+                )
+
     def reconcile_into(self, storage) -> int:
         """Replay the journal into ``storage`` (the device table) via its
         ``apply_deltas`` contract; returns the number of counter deltas
-        applied. On failure the journal is RESTORED — a half-applied
-        reconcile must not lose the unapplied tail (apply_deltas is
-        all-or-nothing under the storage lock)."""
+        applied. On failure only the UN-ACKNOWLEDGED tail of the journal
+        is restored: a sink that applies in acknowledged chunks (the
+        peer-lane replay sink exposes ``apply_deltas_acked``) reports
+        its applied prefix, and a re-driven reconcile must not
+        double-apply deltas the owner already counted. All-or-nothing
+        sinks (a plain ``apply_deltas``, e.g. the local device table)
+        keep their historical restore-everything semantics — nothing
+        was applied when they raise."""
         items = self.drain()
         if not items:
             self._oracle.clear()
             return 0
+        acked = 0
+
+        def ack(n: int) -> None:
+            # chunked sinks call this after each acknowledged chunk;
+            # `n` is the applied item-count prefix so far
+            nonlocal acked
+            acked = max(acked, min(int(n), len(items)))
+
         try:
-            storage.apply_deltas(items)
+            apply_acked = getattr(storage, "apply_deltas_acked", None)
+            if apply_acked is not None:
+                apply_acked(items, ack)
+                acked = len(items)
+            else:
+                storage.apply_deltas(items)
+                acked = len(items)
         except BaseException:
             with self._lock:
-                for counter, delta in items:
+                for counter, delta in items[acked:]:
                     self._journal[counter] = (
                         self._journal.get(counter, 0) + delta
                     )
+                self.drained_high_water += acked
+                self.reconciled_deltas += acked
             raise
         with self._lock:
             self.reconciled_deltas += len(items)
+            self.drained_high_water += len(items)
         # The oracle's window state is now folded into the device table;
         # keeping it would double-count on the next failover window.
         self._oracle.clear()

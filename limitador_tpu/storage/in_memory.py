@@ -171,6 +171,17 @@ class InMemoryStorage(CounterStorage):
                     ]:
                         del self._qualified[counter]
 
+    def drop_counter(self, counter: Counter) -> bool:
+        """Forget ONE counter's window cell (elastic pod, ISSUE 15): a
+        migrated slice releases its cells on the old owner once the new
+        owner acknowledged the copy — per-key, unlike
+        ``delete_counters`` which drops a whole limit. Returns whether
+        a cell existed."""
+        with self._lock:
+            if counter.is_qualified():
+                return self._qualified.pop(counter.key(), None) is not None
+            return self._simple.pop(counter.limit, None) is not None
+
     def clear(self) -> None:
         with self._lock:
             self._simple.clear()

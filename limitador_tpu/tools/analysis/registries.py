@@ -36,6 +36,8 @@ REGISTRY_OWNED_PREFIXES = {
     "pod_hop_": "limitador_tpu/observability/pod_plane.py",
     "pod_signal_": "limitador_tpu/observability/pod_plane.py",
     "pod_event": "limitador_tpu/observability/events.py",
+    # elastic pod (ISSUE 15): the live membership-transition plane
+    "pod_resize_": "limitador_tpu/server/resize.py",
     "sharded_": "limitador_tpu/tpu/sharded.py",
     "dispatch_chunk_": "limitador_tpu/tpu/batcher.py",
     "native_lane_": "limitador_tpu/tpu/native_pipeline.py",

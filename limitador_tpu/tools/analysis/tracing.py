@@ -72,6 +72,11 @@ HOT_MODULES = (
     # and forecast belong to the observatory drain thread, and a
     # sync/launch smuggled into the module would tax every flush.
     "limitador_tpu/observability/model.py",
+    # elastic pod (ISSUE 15): the coordinator's decision-path surface
+    # is the epoch check the lane runs per forward (one int compare
+    # per payload); migration/abort work lives on its own threads and
+    # must never be named with a decision prefix.
+    "limitador_tpu/server/resize.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

@@ -90,7 +90,12 @@ from .storage import (
     hot_attribution,
 )
 
-__all__ = ["TpuShardedStorage", "METRIC_FAMILIES"]
+__all__ = [
+    "TpuShardedStorage",
+    "METRIC_FAMILIES",
+    "snapshot_manifest",
+    "snapshot_items",
+]
 
 #: metric families this subsystem owns (cross-checked against
 #: observability/metrics.py by tools/lint.py's registry lint): per-variant
@@ -219,6 +224,12 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
         self._reset_tables()
         self._state = make_sharded_table(self._mesh, self._local_capacity)
         self._epoch = clock()
+        #: pod-mode snapshot manifest (ISSUE 15): the server sets
+        #: ``{"owned_shards": [lo, hi), "topology": {...}}`` so every
+        #: checkpoint records WHICH global shard block this host owned
+        #: when it was taken — the key a post-membership-change restore
+        #: re-maps slices by (``snapshot_manifest``/``snapshot_items``).
+        self.snapshot_meta: Optional[dict] = None
 
     def _reset_tables(self) -> None:
         self._tables = []
@@ -1032,7 +1043,12 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
     def snapshot(self, path: str) -> None:
         """Sparse checkpoint of the sharded table: occupied shard-local
         cells + the global region's per-shard partials + the host key
-        space (same reopen semantics as TpuStorage.snapshot)."""
+        space (same reopen semantics as TpuStorage.snapshot). When the
+        server set :attr:`snapshot_meta` (pod mode, ISSUE 15) the
+        payload additionally carries the OWNED-SHARD-RANGE manifest —
+        ``owned_shards``/``topology`` — so a restore after a membership
+        change can map slices to the new topology (``snapshot_items``)
+        instead of silently loading the wrong host's table."""
         import pickle
 
         with self._lock:
@@ -1079,6 +1095,8 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
                     for key, (cell, counter) in self._big.items()
                 },
             }
+            if self.snapshot_meta:
+                payload["manifest"] = dict(self.snapshot_meta)
         with open(path, "wb") as f:
             pickle.dump(payload, f)
 
@@ -1156,3 +1174,94 @@ class TpuShardedStorage(_BigLimitMixin, CounterStorage):
 
     def close(self) -> None:
         pass
+
+
+# -- slice-granular checkpoint decode (elastic pod, ISSUE 15) ------------------
+
+
+def snapshot_manifest(path: str) -> dict:
+    """The shard-ownership manifest of a sharded checkpoint, WITHOUT
+    building a storage: which global shard block the writing host owned
+    and under which topology. Pre-ISSUE-15 checkpoints (no manifest)
+    return an empty ``manifest`` — the caller falls back to the legacy
+    ``.host<id>`` interpretation."""
+    import pickle
+
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return {
+        "format": data.get("format"),
+        "n_shards": data.get("n_shards"),
+        "manifest": dict(data.get("manifest") or {}),
+    }
+
+
+def _decoded_value(counter, value: int, expiry_ms: int, now_rel: int,
+                   ) -> int:
+    """One device cell's host-visible spend at ``now_rel`` (ms since the
+    checkpoint's epoch): fixed windows read the values lane gated on
+    expiry; bucket cells derive spent tokens from the TAT lane (the
+    values lane is unspecified for buckets — same rule as read_slots)."""
+    if counter.limit.policy == "token_bucket":
+        base_rel = max(int(expiry_ms) - now_rel, 0)
+        return spent_tokens(
+            counter.max_value, counter.limit.seconds, base_rel
+        )
+    if int(expiry_ms) <= now_rel:
+        return 0
+    return int(value)
+
+
+def snapshot_items(path: str, clock=time.time):
+    """Decode a sharded checkpoint into live ``(counter, spend)`` items
+    host-side — the slice-granular restore lane (ISSUE 15): after a
+    membership change the owned shard ranges no longer match any single
+    checkpoint file, so a restarting host decodes every sibling
+    checkpoint and seeds ONLY the counters it owns under the current
+    topology through the storage's ``apply_deltas`` contract (fresh
+    windows, exact spends — the same accuracy contract as a failover
+    journal replay). Expired cells decode to nothing."""
+    import pickle
+
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    now = float(clock())
+    now_rel = int((now - float(data["epoch"])) * 1000)
+    items = []
+    tables = [dict(d.get("info", {})) for d in data.get("tables", ())]
+    lvalues = np.asarray(data.get("lvalues", ()))
+    lexpiry = np.asarray(data.get("lexpiry", ()))
+    for i, (shard, slot) in enumerate(data.get("locs", ())):
+        entry = tables[shard].get(slot) if shard < len(tables) else None
+        if entry is None:
+            continue
+        _key, counter = entry
+        value = _decoded_value(
+            counter, int(lvalues[i]), int(lexpiry[i]), now_rel
+        )
+        if value > 0:
+            items.append((counter, value))
+    # global region: the read-as-sum of every shard's partial
+    ginfo = dict(data.get("gtable", {}).get("info", {}))
+    gslots = np.asarray(data.get("gslots", ())).tolist()
+    gvalues = np.asarray(data.get("gvalues", ()))
+    gexpiry = np.asarray(data.get("gexpiry", ()))
+    for j, slot in enumerate(gslots):
+        entry = ginfo.get(int(slot))
+        if entry is None:
+            continue
+        _key, counter = entry
+        if counter.limit.policy == "token_bucket":
+            continue  # _is_big keeps global-ns buckets host-side
+        if gexpiry.size and int(gexpiry[:, j].max()) <= now_rel:
+            continue
+        value = int(gvalues[:, j].sum()) if gvalues.size else 0
+        if value > 0:
+            items.append((counter, value))
+    # host-side big map (over-cap limits and host buckets)
+    for _key, (a, b, counter) in data.get("big", {}).items():
+        cell = restore_cell(counter.limit, a, b)
+        value = int(cell.value_at(now))
+        if value > 0:
+            items.append((counter, value))
+    return items

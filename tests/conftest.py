@@ -74,6 +74,10 @@ SERVER_ENV_VARS = frozenset({
     # serving-model observatory (ISSUE 14): an ambient off would 404
     # every /debug/capacity assertion in a spawned server
     "TPU_MODEL_FIT",
+    # elastic pod (ISSUE 15): ambient arming or chaos pauses would
+    # silently reshape any pod-spawning test's wire format and timing
+    "TPU_POD_RESIZE", "TPU_POD_RESIZE_SLICE_PAUSE_MS",
+    "TPU_POD_RESIZE_TIMEOUT_S",
 })
 
 

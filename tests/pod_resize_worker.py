@@ -1,0 +1,126 @@
+"""One member host of the elastic-pod resize drill (NOT a pytest module).
+
+Spawned by tests/test_pod_resize_chaos.py (and `make pod-resize-chaos`)
+as a killable member of a miniature pod: host ``--host-id`` of a
+``PodTopology`` serving its ``PeerLane`` over an ``InMemoryStorage``-
+backed ``PodFrontend`` with the resize coordinator ARMED — it answers
+the prepare/commit/migrate/abort protocol the drill's in-test initiator
+drives, and (as host 2) is the mid-migration SIGKILL target.
+
+    python tests/pod_resize_worker.py --listen 127.0.0.1:PORT \
+        --host-id 1 --hosts 2 --peer 0=127.0.0.1:PORT0 \
+        --ready READY --stop STOP --out OUT.json
+
+Protocol with the parent test: touch READY once serving (limits loaded
+first); on STOP dump final counter state to OUT.json and exit 0; a
+SIGKILL mid-migration IS the drill.
+
+No jax anywhere: the elastic-membership plane is pure host code by
+design.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: the drill's shared limit set — every member and the oracle must
+#: agree byte-for-byte
+RESIZE_NAMESPACE = "elastic"
+RESIZE_MAX = 40
+RESIZE_WINDOW_S = 300
+
+
+def resize_limits():
+    from limitador_tpu import Limit
+
+    return [
+        Limit(
+            RESIZE_NAMESPACE, RESIZE_MAX, RESIZE_WINDOW_S, [], ["u"],
+            name="per_u",
+        )
+    ]
+
+
+def counter_dump(limiter) -> list:
+    out = []
+    for c in limiter.get_counters(RESIZE_NAMESPACE):
+        out.append({
+            "u": c.set_variables.get("u"),
+            "remaining": c.remaining,
+        })
+    out.sort(key=lambda r: r["u"] or "")
+    return out
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--listen", required=True)
+    parser.add_argument("--host-id", type=int, required=True)
+    parser.add_argument("--hosts", type=int, required=True)
+    parser.add_argument("--peer", action="append", default=[],
+                        help="id=host:port of an initial pod member")
+    parser.add_argument("--ready", required=True)
+    parser.add_argument("--stop", required=True)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    peers = {}
+    for spec in args.peer:
+        host, addr = spec.split("=", 1)
+        peers[int(host)] = addr
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    limiter = RateLimiter(InMemoryStorage(8192))
+    lane = PeerLane(args.host_id, args.listen, dict(peers), None,
+                    resilience=cfg)
+    frontend = PodFrontend(
+        limiter,
+        PodRouter(PodTopology(
+            hosts=args.hosts, host_id=args.host_id, shards_per_host=1,
+        )),
+        lane, resilience=cfg,
+    )
+    coordinator = PodResizeCoordinator(
+        frontend,
+        peers={**peers, args.host_id: args.listen},
+        listen_address=args.listen,
+        transition_timeout_s=30.0,
+    )
+    frontend.attach_resize(coordinator)
+    asyncio.run(frontend.configure_with(resize_limits()))
+    lane.start()
+    with open(args.ready, "w") as f:
+        f.write(str(lane.port))
+    try:
+        while not os.path.exists(args.stop):
+            time.sleep(0.05)
+        with open(args.out, "w") as f:
+            json.dump({
+                "counters": counter_dump(frontend),
+                "resize": coordinator.status(),
+                "events": frontend.events_debug()["events"],
+            }, f)
+    finally:
+        lane.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -214,6 +214,21 @@ def test_dashboard_covers_capacity_model_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_elastic_pod_families():
+    """ISSUE 15: the elastic-membership plane ships WITH its Grafana
+    row — an "Elastic pod" row exists and every family the resize
+    coordinator owns (resize.METRIC_FAMILIES) is referenced by at
+    least one panel expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("elastic pod" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.server.resize import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_slo_alert_panel_gated_on_device_backing():
     """The PR 7 false-page fix (ISSUE 14 satellite): the pageable
     breach panel must alert on slo_breached_actionable — raw

@@ -119,6 +119,13 @@ MODEL_INGEST_BUDGET_US = 25.0
 #: Runs on the usage observatory's drain thread (1 s cadence) or a
 #: debug render — 50 ms keeps it invisible at either cadence.
 MODEL_FIT_BUDGET_MS = 50.0
+#: per-payload budget for the elastic-pod topology-epoch gate (ns,
+#: ISSUE 15): one provider call + one dict probe + one int compare per
+#: FORWARD PAYLOAD — a bulk batch of 4096 rows pays it once. The hot
+#: lane itself never sees the gate (locally-owned rows carry no
+#: payload); a rewrite that consults the epoch per ROW measures in the
+#: µs and blows this immediately.
+RESIZE_EPOCH_GATE_BUDGET_NS = 2500.0
 
 
 def _blobs(n, users=512):
@@ -774,6 +781,32 @@ def test_model_refit_within_budget():
         f"model refit over {est.INGEST_CAP} launches costs "
         f"{per_refit_ms:.1f} ms (budget {MODEL_FIT_BUDGET_MS} ms — "
         "the drain thread pays this once a second)"
+    )
+
+
+def test_resize_epoch_gate_within_budget():
+    """ISSUE 15: the owner-side topology-epoch gate costs one provider
+    call + one dict probe + one int compare PER PAYLOAD — a 4096-row
+    bulk batch pays it once, and locally-owned hot-lane rows never see
+    it at all. A rewrite that consults the epoch per row (or takes a
+    lock in the provider) measures in the µs and blows this budget."""
+    from limitador_tpu.server.peering import PeerLane
+
+    lane = PeerLane.__new__(PeerLane)
+    lane.epoch_provider = lambda: 7
+    payload = {"tepoch": 7, "blobs": ["b"] * 4096}
+    n = 20000
+    best = float("inf")
+    for _pass in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            lane._epoch_mismatch(payload)
+        best = min(best, time.perf_counter() - t0)
+    per_call_ns = best / n * 1e9
+    assert per_call_ns <= RESIZE_EPOCH_GATE_BUDGET_NS, (
+        f"epoch gate costs {per_call_ns:.0f} ns/payload "
+        f"(budget {RESIZE_EPOCH_GATE_BUDGET_NS} ns — did per-row work "
+        "or a lock sneak into the forward-path epoch check?)"
     )
 
 

@@ -1,0 +1,754 @@
+"""Elastic pod (ISSUE 15) — fast tier.
+
+Live membership change on in-process miniature pods (InMemory-backed
+``PodFrontend``s over real gRPC peer lanes): router retargeting and the
+synchronized topology epoch, a live 2->3 resize with oracle parity and
+the causal event chain, a 3->2 drain, the stale-epoch gate (unary,
+bulk and pinned-namespace paths) with in-band re-planning, the
+idempotent migrate ledger, and the ``--pod-resize off`` wire-format
+byte-compat pin. The resize-under-fire chaos drill lives in
+tests/test_pod_resize_chaos.py (`make pod-resize-chaos`).
+"""
+
+import asyncio
+import json
+import socket
+
+import pytest
+
+from limitador_tpu.routing import FORWARD, PodRouter, PodTopology
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- router retargeting (pure python) ------------------------------------------
+
+
+def test_retarget_bumps_topology_epoch_and_repins():
+    from limitador_tpu import Limit
+
+    router = PodRouter(PodTopology(hosts=2, host_id=0, shards_per_host=2))
+    limits = [
+        Limit("multi", 2, 60, [], ["u"], name="a"),
+        Limit("multi", 30, 60, [], [], name="b"),
+        Limit("solo", 5, 60, [], ["u"], name="c"),
+    ]
+    router.configure(limits, global_namespaces=["g"])
+    assert router.topology_epoch == 0  # limits reloads never bump it
+    pins_2 = router.pinned_map()
+    assert pins_2 == {
+        "multi": PodRouter.pin_host("multi", 2),
+        "g": PodRouter.pin_host("g", 2),
+    }
+    tepoch = router.retarget(
+        PodTopology(hosts=3, host_id=0, shards_per_host=2)
+    )
+    assert tepoch == 1 and router.topology_epoch == 1
+    assert router.topology.hosts == 3
+    # pins re-derive under the NEW hosts count without a limits reload
+    assert router.pinned_map() == {
+        "multi": PodRouter.pin_host("multi", 3),
+        "g": PodRouter.pin_host("g", 3),
+    }
+    # the protocol-agreed epoch wins over +1 (every member must agree)
+    assert router.retarget(
+        PodTopology(hosts=2, host_id=0, shards_per_host=2), epoch=7
+    ) == 7
+    assert router.topology_epoch == 7
+    m = router.ownership_map()
+    assert m["topology_epoch"] == 7
+    # configure() still bumps only the limits epoch
+    before = router.topology_epoch
+    router.configure(limits, global_namespaces=["g"])
+    assert router.topology_epoch == before
+
+
+# -- the in-process miniature pod ----------------------------------------------
+
+
+def _elastic_pod(n_members, n_total=None, limits=None, resize_kwargs=None):
+    """``n_members`` live pod members + idle-but-running extra hosts up
+    to ``n_total`` (the add_host targets), all resize-armed."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    n_total = n_total or n_members
+    limits = limits or [
+        Limit("elastic", 50, 300, [], ["u"], name="per_u")
+    ]
+    ports = [_free_port() for _ in range(n_total)]
+    addrs = {h: f"127.0.0.1:{ports[h]}" for h in range(n_total)}
+    lanes, fronts, coords = [], [], []
+    for host in range(n_total):
+        member = host < n_members
+        cfg = PodResilience(
+            degraded=True, retry=True, breaker_failures=2,
+            breaker_reset_s=0.2, probe_interval_s=0.1,
+            retry_backoff_ms=1.0,
+        )
+        lane = PeerLane(
+            host, addrs[host],
+            {
+                o: addrs[o] for o in range(n_members)
+                if member and o != host
+            },
+            None, resilience=cfg,
+        )
+        lane.start()
+        front = PodFrontend(
+            RateLimiter(InMemoryStorage(4096)),
+            PodRouter(PodTopology(
+                hosts=n_members if member else n_total,
+                host_id=host, shards_per_host=1,
+            )),
+            lane, resilience=cfg,
+        )
+        coordinator = PodResizeCoordinator(
+            front,
+            peers={
+                h: addrs[h]
+                for h in (range(n_members) if member else (host,))
+            },
+            listen_address=addrs[host],
+            **(resize_kwargs or {}),
+        )
+        front.attach_resize(coordinator)
+        asyncio.run(front.configure_with(limits))
+        lanes.append(lane)
+        fronts.append(front)
+        coords.append(coordinator)
+    return lanes, fronts, coords, addrs, limits
+
+
+def _check(front, user, ns="elastic", delta=1):
+    from limitador_tpu import Context
+
+    return asyncio.run(front.check_rate_limited_and_update(
+        ns, Context({"u": user}), delta, False
+    ))
+
+
+def _stop(lanes):
+    for lane in lanes:
+        lane.stop()
+
+
+def test_live_resize_2_to_3_zero_lost_updates():
+    """The tentpole acceptance: a live 2->3 resize mid-traffic keeps
+    every decision byte-identical to a single-process oracle, re-homes
+    every counter to its new owner, and records the causal chain
+    resize_begin < epoch_bump < migrate_begin/end < resize_end."""
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    lanes, fronts, coords, addrs, limits = _elastic_pod(2, n_total=3)
+    try:
+        oracle = RateLimiter(InMemoryStorage(4096))
+        oracle.configure_with(limits)
+        users = [f"user-{i}" for i in range(40)]
+
+        def drive(rounds, hosts):
+            for _ in range(rounds):
+                for i, u in enumerate(users):
+                    got = _check(fronts[i % hosts], u)
+                    want = oracle.check_rate_limited_and_update(
+                        "elastic", Context({"u": u}), 1, False
+                    )
+                    assert bool(got.limited) == bool(want.limited), u
+
+        drive(3, 2)
+        out = coords[0].resize(3, peers={2: addrs[2]})
+        assert out["ok"], out
+        assert out["transition"]["state"] == "complete"
+        drive(3, 3)
+
+        # every counter lives on exactly ONE host, per the NEW topology
+        counts = [len(f.get_counters("elastic")) for f in fronts]
+        assert sum(counts) == len(users), counts
+        assert counts[2] > 0  # the new host really owns a slice
+        topo = fronts[0].router.topology
+        assert topo.hosts == 3
+        for host, front in enumerate(fronts):
+            for counter in front.get_counters("elastic"):
+                from limitador_tpu.routing import counter_key
+
+                assert topo.owner_host(counter_key(counter)) == host
+
+        # the causal chain, per host
+        for front in fronts[:2]:
+            seq = {}
+            for event in front.events_debug()["events"]:
+                seq.setdefault(event["kind"], event["seq"])
+            assert (
+                seq["resize_begin"] < seq["epoch_bump"]
+                < seq["migrate_begin"] <= seq["migrate_end"]
+                < seq["resize_end"]
+            ), seq
+        # epochs agree pod-wide
+        assert {
+            f.router.topology_epoch for f in fronts
+        } == {1}
+        stats = fronts[0].library_stats()
+        assert stats["pod_resize_completed"] == 1
+        assert stats["pod_resize_epoch"] == 1
+        assert stats["pod_resize_active"] == 0
+        assert stats["pod_resize_seconds"] > 0
+    finally:
+        _stop(lanes)
+
+
+def test_drain_host_migrates_slices_to_survivors():
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    lanes, fronts, coords, addrs, limits = _elastic_pod(3)
+    try:
+        oracle = RateLimiter(InMemoryStorage(4096))
+        oracle.configure_with(limits)
+        users = [f"user-{i}" for i in range(30)]
+        for i, u in enumerate(users):
+            _check(fronts[i % 3], u)
+            oracle.check_rate_limited_and_update(
+                "elastic", Context({"u": u}), 1, False
+            )
+        assert len(fronts[2].get_counters("elastic")) > 0
+        out = coords[0].drain_host()
+        assert out["ok"], out
+        # the drained host's slices moved to the survivors
+        counts = [len(f.get_counters("elastic")) for f in fronts]
+        assert counts[2] == 0, counts
+        assert sum(counts) == len(users)
+        # parity holds after the drain (arrivals only at survivors)
+        for i, u in enumerate(users):
+            got = _check(fronts[i % 2], u)
+            want = oracle.check_rate_limited_and_update(
+                "elastic", Context({"u": u}), 1, False
+            )
+            assert bool(got.limited) == bool(want.limited), u
+    finally:
+        _stop(lanes)
+
+
+def test_resize_validates_proposals():
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(2)
+    try:
+        with pytest.raises(ValueError, match="hosts >= 1"):
+            coords[0].resize(0)
+        with pytest.raises(ValueError, match="surviving host"):
+            coords[1].resize(1)  # host 1 cannot drain itself
+        with pytest.raises(ValueError, match="peer address"):
+            coords[0].resize(4)  # no addresses for hosts 2/3
+        noop = coords[0].resize(2)
+        assert noop["ok"] and noop.get("noop")
+    finally:
+        _stop(lanes)
+
+
+# -- the stale-epoch gate (ISSUE 15 satellite) ---------------------------------
+
+
+def _forwarded_user(front, owner, ns="elastic"):
+    from limitador_tpu import Context
+
+    for i in range(400):
+        ctx = Context({"u": f"user-{i}"})
+        if front._plan(ns, ctx) == (FORWARD, owner):
+            return f"user-{i}"
+    raise AssertionError("no forwarded key found")
+
+
+def test_stale_epoch_unary_rejected_and_replanned():
+    """A forward stamped with epoch k arriving at a host on epoch k+1
+    is rejected with the typed rerouteable status; the origin ADOPTS
+    the newer topology and re-plans in-band — the request never fails,
+    and it is never decided by a wrong owner."""
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(2)
+    try:
+        user = _forwarded_user(fronts[0], owner=1)
+        # host 1 moves ahead alone (a commit host 0 has not seen yet):
+        # SAME geometry, newer epoch — so the adopted re-plan still
+        # routes the key to host 1 and the answer is the owner's
+        fronts[1].router.retarget(
+            PodTopology(hosts=2, host_id=1, shards_per_host=1), epoch=1
+        )
+        result = _check(fronts[0], user)
+        assert not result.limited
+        # the gate fired, the origin re-planned and adopted
+        assert lanes[1].stale_rejects >= 1
+        assert fronts[0].stale_replans >= 1
+        assert fronts[0].router.topology_epoch == 1  # adopted
+        # the decision landed on the owner, not a stand-in
+        assert len(fronts[1].get_counters("elastic")) == 1
+        stats = fronts[1].library_stats()
+        assert stats["pod_resize_stale_rejects"] >= 1
+        stats0 = fronts[0].library_stats()
+        assert stats0["pod_resize_replans"] >= 1
+    finally:
+        _stop(lanes)
+
+
+def test_stale_epoch_pinned_namespace_replans():
+    from limitador_tpu import Limit
+
+    limits = [
+        Limit("pinned", 10, 300, [], ["u"], name="a"),
+        Limit("pinned", 100, 300, [], [], name="b"),
+    ]
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(
+        2, limits=limits
+    )
+    try:
+        pin = PodRouter.pin_host("pinned", 2)
+        origin = 1 - pin
+        fronts[pin].router.retarget(
+            PodTopology(hosts=2, host_id=pin, shards_per_host=1),
+            epoch=1,
+        )
+        result = _check(fronts[origin], "alice", ns="pinned")
+        assert not result.limited
+        assert lanes[pin].stale_rejects >= 1
+        assert fronts[origin].stale_replans >= 1
+        # decided by the pin host (2 limits -> 2 counters there)
+        assert len(fronts[pin].get_counters("pinned")) == 2
+    finally:
+        _stop(lanes)
+
+
+def test_stale_epoch_bulk_answers_all_none_and_adopts():
+    """A bulk forward routed by a dead topology is rejected ONCE (one
+    epoch compare per batch, never per row) and answers all-None, so
+    every row falls back to its per-request path under the adopted
+    epoch."""
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(2)
+    try:
+        served = []
+
+        async def bulk_handler(blobs):
+            served.append(len(blobs))
+            return [b"ok" for b in blobs]
+
+        lanes[1].bulk_cb = bulk_handler
+        fronts[1].router.retarget(
+            PodTopology(hosts=2, host_id=1, shards_per_host=1), epoch=3
+        )
+
+        async def scenario():
+            return await fronts[0].lane.forward_bulk(
+                1, [b"r1", b"r2", b"r3"]
+            )
+
+        out = asyncio.run(scenario())
+        assert out == [None, None, None]
+        assert served == []  # the batch never reached the handler
+        assert lanes[1].stale_rejects == 1
+        assert fronts[0].router.topology_epoch == 3  # adopted
+    finally:
+        _stop(lanes)
+
+
+def test_resize_off_wire_format_byte_identical():
+    """--pod-resize off (no coordinator attached) is the PR 14 wire
+    format exactly: no ``tepoch`` stamp on forwards, and un-stamped
+    payloads serve unconditionally even on a resize-armed owner."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.peering import PeerLane, PodFrontend
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    limits = [Limit("fwd", 3, 60, [], ["u"], name="per_u")]
+    ports = [_free_port(), _free_port()]
+    captured = []
+    lanes, fronts = [], []
+    for host in range(2):
+        lane = PeerLane(
+            host, f"127.0.0.1:{ports[host]}",
+            {1 - host: f"127.0.0.1:{ports[1 - host]}"}, None,
+        )
+        if host == 1:
+            real = lane._serve_decide
+
+            async def capturing(blob, context, _real=real):
+                captured.append(json.loads(blob.decode()))
+                return await _real(blob, context)
+
+            lane._serve_decide = capturing
+        lane.start()
+        lanes.append(lane)
+        fronts.append(PodFrontend(
+            RateLimiter(InMemoryStorage(1024)),
+            PodRouter(PodTopology(
+                hosts=2, host_id=host, shards_per_host=1
+            )),
+            lane,
+        ))
+    try:
+        for f in fronts:
+            asyncio.run(f.configure_with(limits))
+        user = _forwarded_user(fronts[0], owner=1, ns="fwd")
+        result = _check(fronts[0], user, ns="fwd")
+        assert not result.limited
+        assert captured, "forward never reached the owner"
+        # the PR 14 payload, byte-for-byte key set: no tepoch stamp
+        assert sorted(captured[-1]) == [
+            "ctx", "delta", "from", "kind", "load", "ns",
+        ]
+        # and a resize-armed owner still serves un-stamped payloads:
+        # arm host 1 only, forward again from the un-armed host 0
+        from limitador_tpu.server.resize import PodResizeCoordinator
+
+        coordinator = PodResizeCoordinator(
+            fronts[1], peers={1: f"127.0.0.1:{ports[1]}"},
+            listen_address=f"127.0.0.1:{ports[1]}",
+        )
+        fronts[1].attach_resize(coordinator)
+        assert not _check(fronts[0], user, ns="fwd").limited
+        assert lanes[1].stale_rejects == 0
+    finally:
+        _stop(lanes)
+
+
+# -- the migrate ledger (idempotent delivery) ----------------------------------
+
+
+def test_migrate_ledger_applies_diffs_idempotently():
+    """A migrate batch carries ABSOLUTE values; the receiver's ledger
+    turns them into apply-once diffs — a duplicated delivery (retry,
+    re-driven transition) applies nothing, a grown value applies only
+    the growth, and a shrunk value (window roll at the source) applies
+    nothing and keeps the high-water mark."""
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(1)
+    try:
+        from limitador_tpu import Limit
+        from limitador_tpu.server.peering import _counter_to_wire
+        from limitador_tpu.core.counter import Counter
+        from limitador_tpu.core.cel import Context as CelContext
+
+        limit = Limit("elastic", 50, 300, [], ["u"], name="per_u")
+        counter = Counter.new(limit, CelContext({"u": "alice"}))
+        coordinator = coords[0]
+
+        def migrate(value, final=False):
+            return coordinator.handle_migrate({
+                "slice": 0, "from": 9, "final": final,
+                "rows": [_counter_to_wire(counter, value)],
+            })
+
+        assert migrate(5)["applied"] == 1
+        assert migrate(5)["applied"] == 0   # duplicate: nothing
+        assert migrate(8)["applied"] == 1   # growth: the diff only
+        assert migrate(3)["applied"] == 0   # window rolled at source
+        assert migrate(8)["applied"] == 0   # still at the high-water
+        got = fronts[0].get_counters("elastic")
+        assert len(got) == 1
+        c = next(iter(got))
+        assert c.max_value - c.remaining == 8  # 5 + 3, applied once
+    finally:
+        _stop(lanes)
+
+
+# -- surfaces ------------------------------------------------------------------
+
+
+def test_server_resize_flag_parses_with_off_default():
+    from limitador_tpu.server.__main__ import build_parser
+
+    default = build_parser().parse_args(["limits.yaml", "memory"])
+    assert default.pod_resize == "off"
+    on = build_parser().parse_args(
+        ["limits.yaml", "sharded", "--pod-resize", "on"]
+    )
+    assert on.pod_resize == "on"
+
+
+def test_resize_debug_surface_and_admin():
+    from limitador_tpu.storage.base import StorageError
+
+    lanes, fronts, coords, _addrs, _limits = _elastic_pod(2)
+    try:
+        out = fronts[0].resize_debug()
+        assert out["armed"] and out["hosts"] == 2
+        assert out["topology_epoch"] == 0
+        assert out["transition"] is None
+        # the admin surface delegates to the coordinator
+        noop = fronts[0].pod_resize_admin(2)
+        assert noop["ok"] and noop.get("noop")
+        # an un-armed frontend 404s through StorageError
+        fronts[1].resize = None
+        assert fronts[1].resize_debug() == {"armed": False}
+        with pytest.raises(StorageError, match="not armed"):
+            fronts[1].pod_resize_admin(3)
+    finally:
+        _stop(lanes)
+
+
+def test_resize_event_kinds_registered():
+    from limitador_tpu.observability.events import EVENT_KINDS
+
+    for kind in (
+        "resize_begin", "epoch_bump", "migrate_begin", "migrate_end",
+        "resize_end", "resize_abort",
+    ):
+        assert kind in EVENT_KINDS
+
+
+def test_tracing_pass_covers_resize_module():
+    from limitador_tpu.tools.analysis.tracing import HOT_MODULES
+
+    assert "limitador_tpu/server/resize.py" in HOT_MODULES
+
+
+def test_registry_owns_pod_resize_prefix():
+    from limitador_tpu.server.resize import METRIC_FAMILIES
+    from limitador_tpu.tools.analysis.registries import (
+        REGISTRY_OWNED_PREFIXES,
+    )
+
+    assert (
+        REGISTRY_OWNED_PREFIXES["pod_resize_"]
+        == "limitador_tpu/server/resize.py"
+    )
+    for family in (
+        "pod_resize_epoch", "pod_resize_active", "pod_resize_seconds",
+        "pod_resize_stale_rejects", "pod_resize_replans",
+    ):
+        assert family in METRIC_FAMILIES
+
+
+def test_resize_metric_families_render():
+    """Every pod_resize_* family declared, polled off library_stats
+    (gauges set directly, counters baseline-converted, float seconds),
+    visible in the exposition."""
+    from limitador_tpu.observability import PrometheusMetrics
+
+    class Source:
+        def library_stats(self):
+            return {
+                "pod_resize_epoch": 3,
+                "pod_resize_active": 1,
+                "pod_resize_completed": 2,
+                "pod_resize_aborted": 1,
+                "pod_resize_slices_moved": 7,
+                "pod_resize_moved_deltas": 120,
+                "pod_resize_released_counters": 64,
+                "pod_resize_seconds": 1.25,
+                "pod_resize_stale_rejects": 4,
+                "pod_resize_replans": 3,
+            }
+
+    metrics = PrometheusMetrics()
+    metrics.attach_library_source(Source())
+    text = metrics.render().decode()
+    assert "pod_resize_epoch 3.0" in text
+    assert "pod_resize_active 1.0" in text
+    assert "pod_resize_completed_total 2.0" in text
+    assert "pod_resize_aborted_total 1.0" in text
+    assert "pod_resize_slices_moved_total 7.0" in text
+    assert "pod_resize_moved_deltas_total 120.0" in text
+    assert "pod_resize_released_counters_total 64.0" in text
+    assert "pod_resize_seconds_total 1.25" in text
+    assert "pod_resize_stale_rejects_total 4.0" in text
+    assert "pod_resize_replans_total 3.0" in text
+
+
+# -- slice-granular snapshot re-keying (ISSUE 15 satellite) --------------------
+
+
+def test_sharded_snapshot_manifest_and_slice_rekey(tmp_path):
+    """Pod checkpoints carry an owned-shard-range manifest, and a
+    restore after a membership change decodes sibling checkpoints
+    slice-granularly — each host seeds ONLY the counters it owns under
+    the NEW topology instead of silently loading the wrong host's
+    table."""
+    jax = pytest.importorskip("jax")
+    from limitador_tpu import Context, Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.routing import counter_key, stable_hash
+    from limitador_tpu.tpu.sharded import (
+        TpuShardedStorage,
+        snapshot_items,
+        snapshot_manifest,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (sharded mesh)")
+    limit = Limit("elastic", 50, 300, [], ["u"], name="per_u")
+    bucket = Limit(
+        "elastic", 20, 100, [], ["u"], name="bucket",
+        policy="token_bucket",
+    )
+    storage = TpuShardedStorage(local_capacity=64, cache_size=256, global_region=8)
+    spends = {}
+    for i in range(12):
+        counter = Counter.new(limit, Context({"u": f"user-{i}"}))
+        storage.apply_deltas([(counter, 1 + i % 3)])
+        spends[counter_key(counter)] = (counter, 1 + i % 3)
+    bucket_counter = Counter.new(bucket, Context({"u": "bob"}))
+    storage.apply_deltas([(bucket_counter, 4)])
+    path = tmp_path / "snap.shards0-2"
+    storage.snapshot_meta = {
+        "owned_shards": [0, 2],
+        "topology": {"hosts": 1, "host_id": 0, "shards_per_host": 2,
+                     "total_shards": 2},
+    }
+    storage.snapshot(str(path))
+
+    manifest = snapshot_manifest(str(path))
+    assert manifest["manifest"]["owned_shards"] == [0, 2]
+    assert manifest["manifest"]["topology"]["hosts"] == 1
+
+    items = snapshot_items(str(path))
+    by_key = {counter_key(c): v for c, v in items}
+    for key, (counter, spend) in spends.items():
+        assert by_key.get(key) == spend, counter
+    assert by_key.get(counter_key(bucket_counter)) == 4  # spent tokens
+
+    # the membership-change mapping: a host owning shards [0, 3) of a
+    # 6-shard topology takes exactly its keys, no more
+    total, lo, hi = 6, 0, 3
+    mine = [
+        (c, v) for c, v in items
+        if lo <= stable_hash(counter_key(c)) % total < hi
+    ]
+    assert 0 < len(mine) < len(items)
+    fresh = TpuShardedStorage(local_capacity=64, cache_size=256, global_region=8)
+    fresh.apply_deltas(mine)
+    seeded = {
+        counter_key(c): c.max_value - c.remaining
+        for c in fresh.get_counters({limit, bucket})
+    }
+    for counter, value in mine:
+        assert seeded.get(counter_key(counter)) == value
+
+
+def test_sharded_snapshot_without_meta_has_no_manifest(tmp_path):
+    jax = pytest.importorskip("jax")
+    from limitador_tpu.tpu.sharded import (
+        TpuShardedStorage,
+        snapshot_manifest,
+    )
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 devices (sharded mesh)")
+    storage = TpuShardedStorage(local_capacity=64, cache_size=256, global_region=8)
+    path = tmp_path / "snap"
+    storage.snapshot(str(path))
+    assert snapshot_manifest(str(path))["manifest"] == {}
+    # and the classic exact-geometry restore still round-trips
+    restored = TpuShardedStorage.restore(str(path))
+    assert restored._local_capacity == 64
+
+
+# -- the epoch check stays off the per-row path (perf satellite) ---------------
+
+
+def test_epoch_gate_is_one_compare_per_payload():
+    """The owner-side epoch gate consults the provider ONCE per payload
+    — a bulk batch of any size pays one int compare, never per-row
+    Python (the perf-smoke budget pins the latency; this pins the
+    shape)."""
+    from limitador_tpu.server.peering import PeerLane
+
+    lane = PeerLane.__new__(PeerLane)
+    calls = []
+    lane.epoch_provider = lambda: calls.append(1) or 5
+    payload = {"tepoch": 5, "blobs": ["x"] * 4096}
+    assert lane._epoch_mismatch(payload) is False
+    assert len(calls) == 1
+    payload["tepoch"] = 4
+    assert lane._epoch_mismatch(payload) is True
+    assert len(calls) == 2
+    # un-stamped payloads never consult the provider
+    assert lane._epoch_mismatch({"blobs": []}) is False
+    assert len(calls) == 2
+    lane.epoch_provider = None
+    assert lane._epoch_mismatch({"tepoch": 9}) is False
+
+
+def test_debug_pod_resize_endpoints():
+    """GET/POST /debug/pod/resize: 404 off pod mode and with the plane
+    un-armed, 200 with the state machine, POST driving the admin
+    surface (blocking resize runs in the handler's executor) with 400
+    on malformed proposals and 409 on refused ones."""
+    pytest.importorskip("aiohttp")
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    class ResizeLimiter(RateLimiter):
+        """A limiter wearing the elastic-pod debug surface."""
+
+        def __init__(self):
+            super().__init__()
+            self.calls = []
+
+        def resize_debug(self):
+            return {
+                "armed": True, "active": False, "hosts": 2,
+                "topology_epoch": 1, "transition": None,
+            }
+
+        def pod_resize_admin(self, hosts, peers=None):
+            self.calls.append((hosts, peers))
+            if hosts == 9:
+                raise ValueError("a pod resize is already in flight")
+            return {"ok": True, "hosts": hosts}
+
+    async def main(limiter):
+        app = make_http_app(limiter, None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            got = await client.get("/debug/pod/resize")
+            posted = await client.post(
+                "/debug/pod/resize",
+                json={"hosts": 3, "peers": {"2": "h:1"}},
+            )
+            bad = await client.post(
+                "/debug/pod/resize", json={"peers": {}}
+            )
+            refused = await client.post(
+                "/debug/pod/resize", json={"hosts": 9}
+            )
+            return (
+                got.status, await got.json(), posted.status,
+                await posted.json(), bad.status, refused.status,
+            )
+        finally:
+            await client.close()
+
+    limiter = ResizeLimiter()
+    (status, body, post_status, post_body, bad_status,
+     refused_status) = asyncio.run(main(limiter))
+    assert status == 200 and body["armed"] and body["hosts"] == 2
+    assert post_status == 200 and post_body == {"ok": True, "hosts": 3}
+    assert limiter.calls[0] == (3, {2: "h:1"})
+    assert bad_status == 400
+    assert refused_status == 409
+
+    # un-armed (not a pod): both verbs 404
+    async def main_404():
+        app = make_http_app(RateLimiter(), None, {})
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        try:
+            got = await client.get("/debug/pod/resize")
+            posted = await client.post(
+                "/debug/pod/resize", json={"hosts": 3}
+            )
+            return got.status, posted.status
+        finally:
+            await client.close()
+
+    assert asyncio.run(main_404()) == (404, 404)

@@ -1,0 +1,577 @@
+"""Elastic-pod resize under fire (ISSUE 15).
+
+Fast tier: the FailoverStore drained-high-water regression (a chunked
+reconcile re-driven after a mid-replay failure must not double-apply
+the acknowledged prefix — exactly what a mid-migration peer death
+causes) and an in-process abort: a resize toward an unreachable new
+host reverts cleanly to the old topology with every counter intact.
+
+Slow tier (`make pod-resize-chaos`): the resize-under-fire drill — a
+live 2->3 resize mid-soak with the NEW host (a real subprocess,
+tests/pod_resize_worker.py) SIGKILLed mid-migration. The transition
+aborts to the old topology; every decision through the whole window
+keeps answering (the PR 11 degraded-owner stand-in is the safety net),
+and final owner counter state equals the single-process oracle for
+every window-born key, with pre-transition keys under the documented
+one-extra-window bound.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu.routing import PodRouter, PodTopology
+
+REPO_ROOT = Path(__file__).parent.parent
+WORKER = Path(__file__).parent / "pod_resize_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- FailoverStore drained-high-water (ISSUE 15 satellite, tier-1) -------------
+
+
+class _FlakyChunkSink:
+    """apply_deltas_acked sink that dies after ``fail_after`` chunks —
+    the mid-migration peer-death shape. Applies into a dict so the test
+    can assert exactly-once totals."""
+
+    def __init__(self, chunk=2, fail_after=None):
+        self.chunk = chunk
+        self.fail_after = fail_after
+        self.applied = {}
+        self.calls = 0
+
+    def apply_deltas_acked(self, items, ack):
+        done = 0
+        for start in range(0, len(items), self.chunk):
+            if self.fail_after is not None and done >= self.fail_after:
+                raise ConnectionError("peer died mid-replay")
+            chunk = items[start:start + self.chunk]
+            for counter, delta in chunk:
+                self.applied[counter] = (
+                    self.applied.get(counter, 0) + delta
+                )
+            self.calls += 1
+            done += 1
+            ack(start + len(chunk))
+
+
+def _journaled_store(n=6):
+    from limitador_tpu import Context, Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.storage.failover import FailoverStore
+
+    limit = Limit("chaos", 100, 300, [], ["u"], name="per_u")
+    store = FailoverStore()
+    counters = []
+    for i in range(n):
+        counter = Counter.new(limit, Context({"u": f"u{i}"}))
+        store.check_and_update([counter], 1 + i, False)
+        counters.append(counter)
+    return store, counters
+
+
+def test_failover_reconcile_redrive_never_double_applies():
+    """ISSUE 15 satellite: a chunked reconcile that fails partway and
+    is RE-DRIVEN applies every delta exactly once — the acknowledged
+    prefix is tracked by the drained-high-water mark and only the
+    un-acked tail is restored to the journal."""
+    store, counters = _journaled_store(6)
+    sink = _FlakyChunkSink(chunk=2, fail_after=1)  # dies on chunk 2
+    with pytest.raises(ConnectionError):
+        store.reconcile_into(sink)
+    # the acked prefix (one 2-item chunk) left the journal for good
+    assert store.drained_high_water == 2
+    assert store.journal_size() == 4
+    assert len(sink.applied) == 2
+    # the re-drive (recovery probe fires again) ships ONLY the tail
+    sink.fail_after = None
+    replayed = store.reconcile_into(sink)
+    assert replayed == 4
+    assert store.journal_size() == 0
+    assert store.drained_high_water == 6
+    # exactly-once: every counter carries its original delta, once
+    want = {counters[i].key(): 1 + i for i in range(6)}
+    assert sink.applied == want
+
+
+def test_failover_reconcile_allornothing_sink_keeps_restore_semantics():
+    """A sink with only plain apply_deltas (the local device table)
+    keeps the historical contract: nothing was applied on raise, the
+    WHOLE journal restores."""
+    store, _counters = _journaled_store(4)
+
+    class Sink:
+        def apply_deltas(self, items):
+            raise RuntimeError("device busy")
+
+    with pytest.raises(RuntimeError):
+        store.reconcile_into(Sink())
+    assert store.journal_size() == 4
+    assert store.drained_high_water == 0
+
+    class OkSink:
+        def __init__(self):
+            self.items = []
+
+        def apply_deltas(self, items):
+            self.items.extend(items)
+
+    ok = OkSink()
+    assert store.reconcile_into(ok) == 4
+    assert store.drained_high_water == 4
+    assert store.journal_size() == 0
+
+
+def test_peer_delta_sink_acks_per_chunk():
+    from limitador_tpu import Context, Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.server.peering import _PeerDeltaSink
+
+    class Lane:
+        def __init__(self):
+            self.batches = []
+
+        def replay_deltas(self, owner, deltas, timeout=None):
+            self.batches.append(len(deltas))
+            return len(deltas)
+
+    lane = Lane()
+    sink = _PeerDeltaSink(lane, owner=1)
+    sink.CHUNK = 2
+    limit = Limit("chaos", 100, 300, [], ["u"], name="per_u")
+    items = [
+        (Counter.new(limit, Context({"u": f"u{i}"})), 1)
+        for i in range(5)
+    ]
+    acks = []
+    sink.apply_deltas_acked(items, acks.append)
+    assert lane.batches == [2, 2, 1]
+    assert acks == [2, 4, 5]  # the high-water after each chunk
+
+
+# -- in-process abort: unreachable new host (tier-1) ---------------------------
+
+
+def test_resize_abort_to_old_topology_with_nothing_lost():
+    """A resize toward a dead new host ABORTS: the pod reverts to the
+    old topology (epochs move forward), every counter stays intact and
+    parity with the oracle holds straight through — and the timeline
+    records resize_begin < epoch_bump < resize_abort."""
+    from tests.test_pod_resize import _check, _elastic_pod, _stop
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    lanes, fronts, coords, addrs, limits = _elastic_pod(
+        2,
+        resize_kwargs={
+            "migrate_timeout_s": 1.0, "transition_timeout_s": 8.0,
+        },
+    )
+    try:
+        oracle = RateLimiter(InMemoryStorage(4096))
+        oracle.configure_with(limits)
+        users = [f"user-{i}" for i in range(24)]
+        for i, u in enumerate(users):
+            _check(fronts[i % 2], u)
+            oracle.check_rate_limited_and_update(
+                "elastic", Context({"u": u}), 1, False
+            )
+        # host 2's address points at a dead port: prepare fails fast
+        dead = f"127.0.0.1:{_free_port()}"
+        with pytest.raises(ValueError, match="unreachable at prepare"):
+            coords[0].resize(3, peers={2: dead})
+        # nothing changed: same topology, same epoch, all counters
+        assert fronts[0].router.topology.hosts == 2
+        assert fronts[0].router.topology_epoch == 0
+        counts = [len(f.get_counters("elastic")) for f in fronts]
+        assert sum(counts) == len(users)
+
+        # now die MID-migration: the new host answers prepare/commit
+        # then vanishes. Simulate with a lane that goes down after
+        # commit — easiest real shape: a live third host whose process
+        # we cannot SIGKILL in-process, so instead blackhole its
+        # migrate lane via the fault injector on the SENDER.
+        lanes2, fronts2, coords2, addrs2, _limits2 = _elastic_pod(
+            2, n_total=3,
+            resize_kwargs={
+                "migrate_timeout_s": 0.5, "transition_timeout_s": 6.0,
+            },
+        )
+        try:
+            oracle2 = RateLimiter(InMemoryStorage(4096))
+            oracle2.configure_with(limits)
+            for i, u in enumerate(users):
+                _check(fronts2[i % 2], u)
+                oracle2.check_rate_limited_and_update(
+                    "elastic", Context({"u": u}), 1, False
+                )
+            # every migrate/admin RPC from host 0 and 1 to host 2 is
+            # dropped AFTER commit: arm the fault just-in-time from a
+            # commit-observing thread would race — instead stop host
+            # 2's lane right after its commit lands, via the event log
+            stopper = {}
+
+            def stop_host2_after_commit():
+                deadline = time.time() + 5
+                while time.time() < deadline:
+                    kinds = [
+                        e["kind"]
+                        for e in fronts2[2].events_debug()["events"]
+                    ]
+                    if "epoch_bump" in kinds:
+                        lanes2[2].stop()
+                        stopper["stopped"] = True
+                        return
+                    time.sleep(0.005)
+
+            t = threading.Thread(
+                target=stop_host2_after_commit, daemon=True
+            )
+            t.start()
+            out = coords2[0].resize(3, peers={2: addrs2[2]})
+            t.join(timeout=6)
+            assert stopper.get("stopped"), "host 2 never committed"
+            assert not out["ok"] and out.get("aborted"), out
+            # reverted: old geometry, epoch moved FORWARD past the
+            # transition epoch (1 -> abort lands on 2)
+            assert fronts2[0].router.topology.hosts == 2
+            assert fronts2[0].router.topology_epoch == 2
+            kinds0 = [
+                e["kind"] for e in fronts2[0].events_debug()["events"]
+            ]
+            assert "resize_begin" in kinds0
+            assert "resize_abort" in kinds0
+            assert kinds0.index("resize_begin") < kinds0.index(
+                "resize_abort"
+            )
+            stats = fronts2[0].library_stats()
+            assert stats["pod_resize_aborted"] == 1
+            # nothing lost: parity with the oracle still byte-exact
+            # (counters that migrated to host 2 before it died came
+            # back via the push-back lane or never finalized)
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                counts = [
+                    len(f.get_counters("elastic")) for f in fronts2[:2]
+                ]
+                if sum(counts) == len(users):
+                    break
+                time.sleep(0.05)
+            for i, u in enumerate(users):
+                got = _check(fronts2[i % 2], u)
+                want = oracle2.check_rate_limited_and_update(
+                    "elastic", Context({"u": u}), 1, False
+                )
+                assert bool(got.limited) == bool(want.limited), u
+        finally:
+            _stop(lanes2)
+    finally:
+        _stop(lanes)
+
+
+# -- the resize-under-fire chaos drill (slow) ----------------------------------
+
+
+def _spawn_resize_worker(tmp_path, port, host_id, hosts, peers, tag):
+    ready = tmp_path / f"ready-{tag}"
+    stop = tmp_path / f"stop-{tag}"
+    out = tmp_path / f"out-{tag}.json"
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("TPU_POD_")
+    }
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    cmd = [
+        sys.executable, str(WORKER),
+        "--listen", f"127.0.0.1:{port}",
+        "--host-id", str(host_id),
+        "--hosts", str(hosts),
+        "--ready", str(ready),
+        "--stop", str(stop),
+        "--out", str(out),
+    ]
+    for peer_id, addr in peers.items():
+        cmd += ["--peer", f"{peer_id}={addr}"]
+    proc = subprocess.Popen(
+        cmd, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.time() + 30
+    while not ready.exists():
+        if proc.poll() is not None:
+            _stdout, stderr = proc.communicate()
+            pytest.skip(
+                f"resize worker failed to start: {stderr.strip()[-400:]}"
+            )
+        if time.time() > deadline:
+            proc.kill()
+            pytest.skip("resize worker did not come up in time")
+        time.sleep(0.05)
+    return proc, stop, out
+
+
+@pytest.mark.slow
+def test_pod_resize_chaos_drill_sigkill_mid_migration(tmp_path):
+    """ISSUE 15 acceptance: a live 2->3 resize mid-soak with the NEW
+    host SIGKILLed mid-migration cleanly aborts to the old topology
+    with zero failed answers outside the documented degraded window
+    and final owner counter state equal to the single-process oracle
+    for every window-born key."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    from tests.pod_resize_worker import (
+        RESIZE_MAX,
+        RESIZE_NAMESPACE,
+        resize_limits,
+    )
+
+    port0, port1, port2 = _free_port(), _free_port(), _free_port()
+    addr0 = f"127.0.0.1:{port0}"
+    addr1 = f"127.0.0.1:{port1}"
+    addr2 = f"127.0.0.1:{port2}"
+
+    # host 1: a live member; host 2: the new host (the kill target)
+    proc1, stop1, out1 = _spawn_resize_worker(
+        tmp_path, port1, host_id=1, hosts=2, peers={0: addr0}, tag="h1"
+    )
+    proc2, _stop2, _out2 = _spawn_resize_worker(
+        tmp_path, port2, host_id=2, hosts=2, peers={}, tag="h2"
+    )
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    lane = PeerLane(0, addr0, {1: addr1}, None, resilience=cfg)
+    lane.start()
+    frontend = PodFrontend(
+        RateLimiter(InMemoryStorage(8192)),
+        PodRouter(PodTopology(hosts=2, host_id=0, shards_per_host=1)),
+        lane, resilience=cfg,
+    )
+    coordinator = PodResizeCoordinator(
+        frontend,
+        peers={0: addr0, 1: addr1},
+        listen_address=addr0,
+        migrate_timeout_s=1.0,
+        transition_timeout_s=20.0,
+        # the chaos hook: every slice pauses before its first copy, so
+        # the SIGKILL deterministically lands MID-migration (after
+        # epoch_bump + migrate_begin, before any slice finalizes)
+        slice_pause_s=1.5,
+    )
+    frontend.attach_resize(coordinator)
+    asyncio.run(frontend.configure_with(resize_limits()))
+
+    oracle = RateLimiter(InMemoryStorage(8192))
+    oracle.configure_with(resize_limits())
+
+    def check(user):
+        got = asyncio.run(frontend.check_rate_limited_and_update(
+            RESIZE_NAMESPACE, Context({"u": user}), 1, False
+        ))
+        want = oracle.check_rate_limited_and_update(
+            RESIZE_NAMESPACE, Context({"u": user}), 1, False
+        )
+        return got, want
+
+    try:
+        # phase A (healthy 2-host soak): pre-transition keys
+        pre_users = [f"pre-{i}" for i in range(12)]
+        for _ in range(3):
+            for u in pre_users:
+                got, want = check(u)
+                assert bool(got.limited) == bool(want.limited)
+
+        # launch the resize; it will stall on the slice pause
+        resize_out = {}
+
+        def run_resize():
+            try:
+                resize_out.update(coordinator.resize(
+                    3, peers={2: addr2}
+                ))
+            except Exception as exc:  # the drill asserts on the dict
+                resize_out["error"] = f"{exc}"
+
+        resize_thread = threading.Thread(target=run_resize, daemon=True)
+        resize_thread.start()
+
+        # SIGKILL the new host the moment migration begins
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            kinds = [
+                e["kind"] for e in frontend.events_debug()["events"]
+            ]
+            if "migrate_begin" in kinds:
+                break
+            time.sleep(0.01)
+        assert "migrate_begin" in kinds, "migration never began"
+        proc2.send_signal(signal.SIGKILL)
+        proc2.wait(timeout=10)
+
+        # phase B (the fire): window-born keys arrive all through the
+        # transition + abort. Every answer must come back (zero failed
+        # answers); admissions stay under each key's budget so the
+        # final counts are pure zero-lost-updates evidence.
+        born = [f"born-{i}" for i in range(16)]
+        admitted = {u: 0 for u in born}
+        want_admitted = {u: 0 for u in born}
+        b_deadline = time.time() + 10
+        rounds = 0
+        while time.time() < b_deadline and rounds < RESIZE_MAX - 1:
+            rounds += 1
+            for u in born:
+                got, want = check(u)  # raising here fails the drill
+                if not got.limited:
+                    admitted[u] += 1
+                if not want.limited:
+                    want_admitted[u] += 1
+            if not resize_thread.is_alive():
+                break
+        resize_thread.join(timeout=30)
+        assert not resize_thread.is_alive(), "transition never resolved"
+        assert resize_out.get("aborted") or not resize_out.get("ok"), (
+            resize_out
+        )
+
+        # reverted to the 2-host topology, epochs moved forward
+        assert frontend.router.topology.hosts == 2
+        assert frontend.router.topology_epoch >= 2
+        kinds = [e["kind"] for e in frontend.events_debug()["events"]]
+        assert "resize_abort" in kinds
+        # the causal chain up to the abort
+        seq = {}
+        for e in frontend.events_debug()["events"]:
+            seq.setdefault(e["kind"], e["seq"])
+        assert (
+            seq["resize_begin"] < seq["epoch_bump"]
+            < seq["migrate_begin"] < seq["resize_abort"]
+        ), seq
+
+        # drain the degraded window: journals accrued against the dead
+        # host redistribute to the surviving owners
+        settle_deadline = time.time() + 10
+        while time.time() < settle_deadline:
+            coordinator.sweep_orphan_journals()
+            stats = frontend.resilience_stats()
+            if stats["pod_failover_journal_depth"] == 0:
+                break
+            time.sleep(0.1)
+        assert (
+            frontend.resilience_stats()["pod_failover_journal_depth"]
+            == 0
+        )
+
+        # a few settle rounds so in-flight push-backs land
+        for _ in range(2):
+            for u in born:
+                got, want = check(u)
+                if not got.limited:
+                    admitted[u] += 1
+                if not want.limited:
+                    want_admitted[u] += 1
+
+        # stop host 1 gracefully and read its final counters
+        stop1.touch()
+        proc1.wait(timeout=15)
+        with open(out1) as f:
+            dump1 = json.load(f)
+        spend1 = {
+            row["u"]: RESIZE_MAX - row["remaining"]
+            for row in dump1["counters"]
+        }
+        spend0 = {
+            c.set_variables.get("u"): c.max_value - c.remaining
+            for c in frontend.get_counters(RESIZE_NAMESPACE)
+        }
+
+        # zero lost updates: every window-born key's total spend across
+        # the surviving hosts equals the oracle's, byte-equal
+        oracle_spend = {
+            c.set_variables.get("u"): c.max_value - c.remaining
+            for c in oracle.get_counters(RESIZE_NAMESPACE)
+        }
+        for u in born:
+            total = spend0.get(u, 0) + spend1.get(u, 0)
+            assert total == oracle_spend.get(u, 0), (
+                u, total, oracle_spend.get(u), spend0.get(u),
+                spend1.get(u),
+            )
+            assert admitted[u] == want_admitted[u] == rounds + 2
+        # pre-transition keys: bounded by one extra window budget
+        for u in pre_users:
+            total = spend0.get(u, 0) + spend1.get(u, 0)
+            assert (
+                oracle_spend.get(u, 0)
+                <= total
+                <= oracle_spend.get(u, 0) + RESIZE_MAX
+            ), (u, total, oracle_spend.get(u))
+    finally:
+        lane.stop()
+        for proc in (proc1, proc2):
+            if proc.poll() is None:
+                proc.kill()
+
+
+def test_sweep_orphan_journals_restores_on_failed_redistribute():
+    """Review hardening: the orphan-journal sweep must keep the
+    reconcile contract — a drained delta is only GONE once some owner
+    acknowledged it. A redistribute that fails re-journals the unlanded
+    tail (and keeps the oracle) so the next sweep finishes the job."""
+    from tests.test_pod_resize import _elastic_pod, _stop
+    from limitador_tpu.core.cel import Context as CelContext
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.server.peering import _OwnerGuard
+
+    lanes, fronts, coords, _addrs, limits = _elastic_pod(1)
+    try:
+        front, coord = fronts[0], coords[0]
+        guard = _OwnerGuard(5, front._resilience)
+        front._guards[5] = guard  # a phantom removed member
+        for i in range(2):
+            counter = Counter.new(limits[0], CelContext({"u": f"j{i}"}))
+            guard.store.check_and_update([counter], 1, False)
+        assert guard.store.journal_size() == 2
+
+        storage = coord._storage()  # the counters store behind the wrap
+
+        def boom(items):
+            raise RuntimeError("storage down")
+
+        real = storage.apply_deltas
+        storage.apply_deltas = boom
+        try:
+            assert coord.sweep_orphan_journals() == 0
+            # restored, not lost
+            assert guard.store.journal_size() == 2
+        finally:
+            storage.apply_deltas = real
+        assert coord.sweep_orphan_journals() == 2
+        assert guard.store.journal_size() == 0
+        assert len(front.get_counters("elastic")) == 2
+    finally:
+        _stop(lanes)
