@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill
 
 check: lint test
 
@@ -40,6 +40,9 @@ pod-smoke:
 # tier plus the slow drill that SIGKILLs a real subprocess owner host
 # mid-soak, asserts availability through the degraded window, restarts
 # it and proves journal-replay parity vs the single-process oracle.
+# Since ISSUE 16 the SIGKILL also auto-produces a flight-recorder
+# incident bundle (breaker_open trigger, degraded-window exemplars,
+# peer rings patched in after the restart).
 # Skips cleanly when grpc (the subprocess harness) is unavailable.
 pod-chaos:
 	python -m pytest tests/test_pod_chaos.py -q
@@ -52,6 +55,13 @@ pod-chaos:
 # equal to the single-process oracle for window-born keys.
 pod-resize-chaos:
 	python -m pytest tests/test_pod_resize_chaos.py -q
+
+# Flight-recorder drill (ISSUE 16): under live decision traffic, fire
+# the manual trigger through POST /debug/flight/trigger and validate
+# the round trip — the bundle lists on GET /debug/flight, serves back
+# verbatim (?name=), and carries exemplars from the traffic window.
+flight-drill:
+	python -m pytest tests/test_flight.py -q -k drill
 
 bench:
 	python bench.py

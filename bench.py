@@ -41,6 +41,9 @@ The other BASELINE configs run with --config:
                         with pod_resize_seconds and the routed-share
                         recovery clock)
     --config backends   reference criterion scenarios per backend
+    --config flight     flight recorder on vs off: tap nanosecond cost
+                        across a sample-stride sweep + in-memory
+                        decisions/s with the recorder attached/detached
     --config onbox      serving-stack closed-loop latency with the jax
                         backend pinned on-box (LIMITADOR_TPU_PLATFORM=cpu):
                         the p99<=2ms evidence with the WAN tunnel excluded
@@ -217,6 +220,68 @@ def bench_memory():
     dt = time.perf_counter() - t0
     print(f"memory oracle: {n/dt/1e3:.1f}k decisions/s", file=sys.stderr)
     emit("inmemory_decisions_per_sec", n / dt, "decisions/s", 1e7)
+
+
+def bench_flight():
+    """ISSUE 16: the flight recorder's hot-path cost, on vs off. Three
+    evidence shapes: (a) the in-memory serving loop's decisions/s with
+    the recorder tapping every decision vs detached (the end-to-end
+    overhead at the default stride), (b) the raw ``tap()`` nanosecond
+    cost across a sample-stride sweep (1 = ring every decision, up to
+    256), and (c) the sampled-exemplar count each stride retains so the
+    cost rows carry their coverage."""
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.observability.flight import FlightRecorder
+
+    limiter = RateLimiter()
+    limiter.add_limit(Limit("ns", 10**9, 60, [], ["u"]))
+    ctxs = [Context({"u": str(i)}) for i in range(1000)]
+    n = 50_000
+
+    def serving_loop(tap):
+        t0 = time.perf_counter()
+        for i in range(n):
+            d0 = time.perf_counter()
+            limiter.check_rate_limited_and_update(
+                "ns", ctxs[i % 1000], 1
+            )
+            if tap is not None:
+                tap.tap(time.perf_counter() - d0, "lean", namespace="ns")
+        return n / (time.perf_counter() - t0)
+
+    off = serving_loop(None)
+    recorder = FlightRecorder(sample_stride=64)
+    on = serving_loop(recorder)
+    overhead_pct = (off / on - 1.0) * 100.0 if on > 0 else 0.0
+    print(
+        f"flight recorder: {off/1e3:.1f}k decisions/s off, "
+        f"{on/1e3:.1f}k on (stride 64, {recorder.exemplars} exemplars "
+        f"ringed, overhead {overhead_pct:.2f}%)",
+        file=sys.stderr,
+    )
+    emit(
+        "flight_decisions_per_sec", on, "decisions/s", 1e7,
+        recorder="on", sample_stride=64,
+        decisions_per_sec_off=round(off, 1),
+        overhead_pct=round(overhead_pct, 3),
+    )
+    m = 200_000
+    for stride in (1, 16, 64, 256):
+        rec = FlightRecorder(sample_stride=stride)
+        t0 = time.perf_counter()
+        for _ in range(m):
+            rec.tap(1e-4, "lean")
+        tap_ns = (time.perf_counter() - t0) / m * 1e9
+        print(
+            f"flight tap @ stride {stride}: {tap_ns:.0f}ns "
+            f"({rec.exemplars} exemplars)",
+            file=sys.stderr,
+        )
+        emit(
+            "flight_tap_ns", tap_ns, "ns", 1000.0, ndigits=1,
+            lower_is_better=True, sample_stride=stride,
+            exemplars=rec.exemplars, tail_retained=rec.tail_retained,
+        )
 
 
 class _LatencySink:
@@ -2792,7 +2857,7 @@ def main():
         default="device",
         choices=["device", "memory", "pipeline", "native", "lease",
                  "tenants", "sharded", "backends", "grpc", "fleet",
-                 "onbox", "pod"],
+                 "onbox", "pod", "flight"],
     )
     # internal: one process of the pod sweep (spawned by bench_pod)
     parser.add_argument("--pod-worker-id", type=int, default=None,
@@ -2845,6 +2910,8 @@ def main():
         return bench_fleet()
     if args.config == "onbox":
         return bench_onbox()
+    if args.config == "flight":
+        return bench_flight()
 
     # End-to-end gRPC latency evidence rides along with the headline
     # (device) run only. It runs FIRST — before this process initializes

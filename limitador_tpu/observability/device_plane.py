@@ -157,6 +157,11 @@ class DeviceStatsRecorder:
             metrics = None
         self.metrics = metrics
         self.flight = FlightRecorder(flight_capacity)
+        # Process flight recorder (observability/flight.py, ISSUE 16):
+        # the always-on sampled-exemplar tap riding the per-decision
+        # loop below. None = detached, zero cost; the tap itself is
+        # lock-free on the unsampled path (FLIGHT_TAP_BUDGET_NS).
+        self.flight_tap = None
         self.flush_reasons: Dict[str, int] = dict.fromkeys(FLUSH_REASONS, 0)
         self._lock = threading.Lock()
         self._batch_ids = itertools.count(1)
@@ -282,6 +287,7 @@ class DeviceStatsRecorder:
         self.record_phases(phases)
         phases_ms = self.phases_ms(phases)
         flight = self.flight
+        tap = self.flight_tap
         slo = self.slo
         totals: Optional[list] = [] if slo is not None else None
         t_now = time.perf_counter()
@@ -294,6 +300,11 @@ class DeviceStatsRecorder:
             total = t_now - t_enq
             if totals is not None:
                 totals.append(total)
+            if tap is not None:
+                tap.tap(
+                    total, "lean", request_id=rid,
+                    namespace=namespace, phases_ms=phases_ms,
+                )
             if flight.would_admit(total):
                 self.record_decision(
                     total, rid, namespace, batch_id,

@@ -858,6 +858,55 @@ class PrometheusMetrics:
             "in-band under the adopted topology",
             registry=self.registry,
         )
+        # -- flight recorder (observability/flight.py, ISSUE 16): the
+        # always-on decision exemplar rings + triggered incident
+        # bundles, fed by the recorder's render hook. Registered in
+        # flight.METRIC_FAMILIES (lint cross-checked).
+        from .flight import TRIGGER_REASONS
+
+        self.flight_taps = Gauge(
+            "flight_taps",
+            "Decisions observed by the flight recorder's hot-path tap "
+            "(all lanes, cumulative)",
+            registry=self.registry,
+        )
+        self.flight_exemplars = Counter(
+            "flight_exemplars",
+            "Sampled decision exemplars admitted into the flight "
+            "recorder ring (1-in-N head sampling)",
+            registry=self.registry,
+        )
+        self.flight_tail_retained = Counter(
+            "flight_tail_retained",
+            "Decisions retained by a per-lane worst-K tail reservoir "
+            "(kept regardless of sample rate)",
+            registry=self.registry,
+        )
+        self.flight_triggers = Counter(
+            "flight_triggers",
+            "Incident bundles fired, by trigger reason (slo_burn, "
+            "breaker_open, resize_abort, drift, device_probe, manual)",
+            ["reason"],
+            registry=self.registry,
+        )
+        self.flight_bundles = Gauge(
+            "flight_bundles",
+            "Incident bundles currently retained in the flight spool",
+            registry=self.registry,
+        )
+        self.flight_spool_bytes = Gauge(
+            "flight_spool_bytes",
+            "Total bytes of the retention-capped flight bundle spool",
+            registry=self.registry,
+        )
+        self.flight_peer_rings = Counter(
+            "flight_peer_rings",
+            "Peer ring contributions merged into incident bundles "
+            "(pod-correlated autopsies over the peer lane)",
+            registry=self.registry,
+        )
+        for reason in TRIGGER_REASONS:
+            self.flight_triggers.labels(reason)
         for phase in HOP_PHASES:
             self.pod_hop_phase_ms.labels(phase)
         for kind in EVENT_KINDS:
@@ -1108,6 +1157,11 @@ class PrometheusMetrics:
         self._counter_baselines: dict = {}
         self._native_planes: list = []
         self._render_hooks: list = []
+        # OpenMetrics exemplar rendering (ISSUE 16 satellite): off by
+        # default — enable_exemplars() arms trace-id exemplars on the
+        # decision-latency tail buckets and the OpenMetrics exposition.
+        self.exemplars_enabled = False
+        self._exemplar_min_s = 0.025
 
     def attach_native_plane(self, plane) -> None:
         """Attach a ``native_plane.NativePlane``; its ``poll(self)``
@@ -1432,11 +1486,47 @@ class PrometheusMetrics:
         else:
             self.limited_calls.labels(namespace, *extra).inc(n)
 
+    def enable_exemplars(self, min_seconds: float = 0.025) -> None:
+        """Arm OpenMetrics exemplar rendering (ISSUE 16 satellite):
+        decision-latency observations landing in the tail buckets
+        (>= ``min_seconds``) carry a ``trace_id`` exemplar, and
+        ``render`` switches to the OpenMetrics exposition (the only
+        format that serializes exemplars). Off by default — the text
+        0.0.4 exposition stays byte-identical."""
+        self.exemplars_enabled = True
+        self._exemplar_min_s = float(min_seconds)
+
+    def _latency_exemplar(self, seconds: float) -> Optional[dict]:
+        if (
+            not getattr(self, "exemplars_enabled", False)
+            or seconds < getattr(self, "_exemplar_min_s", 0.025)
+        ):
+            return None
+        from .device_plane import current_request_id
+        from .tracing import current_trace_id
+
+        trace_id = current_trace_id() or current_request_id()
+        if not trace_id:
+            return None
+        return {"trace_id": str(trace_id)[:64]}
+
+    def _observe_datastore_latency(self, seconds: float) -> None:
+        exemplar = self._latency_exemplar(seconds)
+        if exemplar is not None:
+            try:
+                self.datastore_latency.observe(
+                    seconds, exemplar=exemplar
+                )
+                return
+            except Exception:
+                pass  # exemplar support must never fail the metric
+        self.datastore_latency.observe(seconds)
+
     def record_datastore_latency(self, timings) -> None:
         """MetricsLayer consumer (prometheus_metrics.rs:131-133): the
         aggregated busy+idle duration of all ``datastore`` child spans
         under one aggregate root."""
-        self.datastore_latency.observe(timings.duration)
+        self._observe_datastore_latency(timings.duration)
 
     @contextmanager
     def time_datastore(self):
@@ -1444,8 +1534,29 @@ class PrometheusMetrics:
         try:
             yield
         finally:
-            self.datastore_latency.observe(time.perf_counter() - start)
+            self._observe_datastore_latency(
+                time.perf_counter() - start
+            )
+
+    @property
+    def content_type(self) -> str:
+        """The exposition content type ``render`` currently emits."""
+        if getattr(self, "exemplars_enabled", False):
+            from prometheus_client.openmetrics.exposition import (
+                CONTENT_TYPE_LATEST as OPENMETRICS_CONTENT_TYPE,
+            )
+
+            return OPENMETRICS_CONTENT_TYPE
+        from prometheus_client import CONTENT_TYPE_LATEST
+
+        return CONTENT_TYPE_LATEST
 
     def render(self) -> bytes:
         self._poll_library_sources()
+        if getattr(self, "exemplars_enabled", False):
+            from prometheus_client.openmetrics.exposition import (
+                generate_latest as openmetrics_latest,
+            )
+
+            return openmetrics_latest(self.registry)
         return generate_latest(self.registry)

@@ -398,6 +398,7 @@ class NativePlane:
         exemplars = self.drain_exemplars()
         if not exemplars:
             return
+        tap = getattr(rec, "flight_tap", None)
         for ex in exemplars:
             phases_ms = {
                 "native_lane": round(
@@ -406,6 +407,17 @@ class NativePlane:
             }
             if ex["leased_rows"] > 0:
                 phases_ms["lease"] = round(ex["total_ns"] / 1e6, 4)
+            if tap is not None:
+                # ISSUE 16: the zero-Python lane's slow rows ride the
+                # native_hot lane of the process flight recorder (the
+                # C ring IS the sample — every drained row taps).
+                tap.tap(
+                    ex["total_ns"] / 1e9, "native_hot",
+                    phases_ms=phases_ms,
+                    key=format(
+                        ex["blob_digest"] & 0xFFFFFFFFFFFFFFFF, "016x"
+                    ),
+                )
             rec.flight.offer(ex["total_ns"] / 1e9, {
                 "request_id": None,
                 "namespace": None,

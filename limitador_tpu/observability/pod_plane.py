@@ -104,6 +104,10 @@ class PodHopRecorder:
         # bare FlightRecorder): forwarded decisions are offered under
         # pod_* phase keys so the slowest-N view spans both planes.
         self._flight = None
+        # ISSUE 16: the always-on sampled-exemplar tap (a
+        # flight.FlightRecorder) — forwarded decisions ride the
+        # pod_forward lane with their hop phase breakdown attached.
+        self.tap = None
 
     def attach_flight(self, recorder) -> None:
         self._flight = getattr(recorder, "flight", recorder)
@@ -124,6 +128,20 @@ class PodHopRecorder:
                 seconds = float(phases_s.get(phase, 0.0))
                 self._counts[i, _bucket_of(seconds)] += 1
                 self._sums_s[i] += max(seconds, 0.0)
+        tap = self.tap
+        if tap is not None:
+            tap.tap(
+                total_s, "pod_forward", request_id=request_id,
+                namespace=(
+                    None if namespace is None else str(namespace)
+                ),
+                phases_ms={
+                    phase: round(
+                        float(phases_s.get(phase, 0.0)) * 1e3, 4
+                    )
+                    for phase in HOP_PHASES
+                },
+            )
         flight = self._flight
         if flight is not None and flight.would_admit(total_s):
             flight.offer(total_s, {

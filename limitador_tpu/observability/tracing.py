@@ -10,7 +10,9 @@ exports real spans without code changes.
 
 from __future__ import annotations
 
+import itertools
 from contextlib import contextmanager, nullcontext
+from contextvars import ContextVar
 from typing import Optional
 
 from .metrics_layer import installed as metrics_layer_installed
@@ -29,6 +31,26 @@ except Exception:  # pragma: no cover - otel API absent
 # path, which is not "free" at 10^5 req/s.
 _enabled = False
 
+# Head sampling (ISSUE 16 satellite): at --tracing-sample-rate < 1.0
+# the ROOT spans (should_rate_limit / pod_peer_decide) make a 1-in-N
+# decision and child spans inherit it through a contextvar, so spans
+# can stay on in production at 1% instead of paying the full ProxyTracer
+# cost per request. Rate 1.0 (the default) preserves current behavior
+# exactly: every gate short-circuits before touching the counter.
+_sample_rate = 1.0
+_sample_stride = 1
+_sample_counter = itertools.count()
+
+#: the root span's head-sampling verdict for the current request
+#: context; children (datastore spans) read it instead of re-deciding
+_sampled_cv: ContextVar[bool] = ContextVar("trace_sampled", default=True)
+
+#: trace id adopted from an incoming ``traceparent`` header (server
+#: middleware) — exemplars correlate even without a local exporter
+_adopted_trace_id: ContextVar[Optional[str]] = ContextVar(
+    "adopted_trace_id", default=None
+)
+
 __all__ = [
     "configure_tracing",
     "should_rate_limit_span",
@@ -37,12 +59,84 @@ __all__ = [
     "tracing_enabled",
     "hop_trace_metadata",
     "peer_decide_span",
+    "set_sample_rate",
+    "sample_rate",
+    "current_trace_id",
+    "adopt_traceparent",
 ]
 
 
 def tracing_enabled() -> bool:
     """True once an OTLP exporter is installed (configure_tracing)."""
     return _enabled
+
+
+def set_sample_rate(rate: float) -> None:
+    """Set the head-sampling rate: 1.0 records every request (the
+    default, current behavior), 0.0 none, 0.01 one in a hundred. The
+    MetricsLayer aggregation is NOT sampled — it feeds the
+    ``datastore_latency`` parity metric and must see every request."""
+    global _sample_rate, _sample_stride
+    _sample_rate = min(max(float(rate), 0.0), 1.0)
+    _sample_stride = (
+        1 if _sample_rate >= 1.0
+        else 0 if _sample_rate <= 0.0
+        else max(int(round(1.0 / _sample_rate)), 1)
+    )
+
+
+def sample_rate() -> float:
+    return _sample_rate
+
+
+def _head_decision() -> bool:
+    """The root span's sampling verdict, published for children. Only
+    called once an exporter is live (the _enabled gates run first)."""
+    if _sample_stride == 1:
+        return True
+    ok = (
+        _sample_stride > 0
+        and next(_sample_counter) % _sample_stride == 0
+    )
+    _sampled_cv.set(ok)
+    return ok
+
+
+def _span_sampled() -> bool:
+    """Child spans inherit the root's head-sampling verdict (True when
+    no root made one — standalone spans keep current behavior)."""
+    return _sample_stride == 1 or _sampled_cv.get()
+
+
+def adopt_traceparent(header: Optional[str]) -> Optional[str]:
+    """Adopt the trace id of an incoming W3C ``traceparent`` header
+    into the request context (server middleware), so flight-recorder
+    and Prometheus exemplars carry the caller's trace id even when no
+    local exporter is configured. Returns the adopted id."""
+    if not header:
+        return None
+    parts = str(header).split("-")
+    if len(parts) < 3 or len(parts[1]) != 32:
+        return None
+    trace_id = parts[1].lower()
+    if trace_id.strip("0") == "":
+        return None
+    _adopted_trace_id.set(trace_id)
+    return trace_id
+
+
+def current_trace_id() -> Optional[str]:
+    """The trace id of the active span (exporter configured), else the
+    id adopted from the incoming traceparent, else None. Cheap enough
+    for sampled exemplar paths; not meant for the unsampled hot path."""
+    if _enabled and _tracer is not None:
+        try:
+            ctx = _trace.get_current_span().get_span_context()
+            if ctx.is_valid:
+                return format(ctx.trace_id, "032x")
+        except Exception:
+            pass
+    return _adopted_trace_id.get()
 
 
 def configure_tracing(endpoint: Optional[str]) -> Optional[str]:
@@ -115,7 +209,7 @@ def datastore_span(op: str):
 @contextmanager
 def _datastore_span(op: str):
     with metrics_span("datastore"):
-        if _tracer is None or not _enabled:
+        if _tracer is None or not _enabled or not _span_sampled():
             yield
             return
         with _tracer.start_as_current_span("datastore") as span:
@@ -140,7 +234,7 @@ def device_batch_span(batch_id: int, n_requests: int, attrs=None):
     per-request datastore spans already account this wall clock, and a
     second accounting here would double-count it. No exporter -> shared
     no-op, zero per-batch cost."""
-    if not _enabled or _tracer is None:
+    if not _enabled or _tracer is None or not _head_decision():
         return _noop_record_span()
     return _device_batch_span(batch_id, n_requests, attrs)
 
@@ -189,7 +283,7 @@ def peer_decide_span(namespace, request_id, carrier=None):
     span (span links across the hop, ISSUE 12) rather than parenting —
     the hop is a causal reference between two hosts' traces, not one
     host's child."""
-    if not _enabled or _tracer is None:
+    if not _enabled or _tracer is None or not _head_decision():
         return _NULLCONTEXT
     return _peer_decide_span(namespace, request_id, carrier)
 
@@ -232,7 +326,7 @@ def should_rate_limit_span(namespace: str, hits_addend: int, carrier=None):
 @contextmanager
 def _should_rate_limit_span(namespace, hits_addend, carrier):
     with metrics_span("should_rate_limit"):
-        if _tracer is None or not _enabled:
+        if _tracer is None or not _enabled or not _head_decision():
             yield _noop_record
             return
         parent = None

@@ -576,6 +576,59 @@ def build_parser() -> argparse.ArgumentParser:
         "model_r2/capacity_headroom_ratio/model_drift ControlSignals "
         "tail). 'off' detaches the ingest tap entirely",
     )
+    p.add_argument(
+        "--flight",
+        choices=["on", "off"],
+        default=_env("TPU_FLIGHT", "on"),
+        help="flight recorder (ISSUE 16): always-on sampled decision "
+        "exemplars + worst-K tails per lane, trigger engine (SLO burn, "
+        "breaker open, resize abort, drift, device-probe fall, manual "
+        "POST /debug/flight/trigger) persisting pod-correlated "
+        "incident bundles (GET /debug/flight)",
+    )
+    p.add_argument(
+        "--flight-sample", type=int,
+        default=int(_env("TPU_FLIGHT_SAMPLE", "64")),
+        help="flight recorder exemplar sampling stride: 1 in N "
+        "decisions rings a full stage breakdown (worst-K tails are "
+        "kept regardless; 1 records every decision)",
+    )
+    p.add_argument(
+        "--flight-spool-dir",
+        default=_env("TPU_FLIGHT_SPOOL", "/tmp/limitador-flight"),
+        help="retention-capped directory incident bundles persist to "
+        "(self-contained JSON, served back at GET /debug/flight)",
+    )
+    p.add_argument(
+        "--flight-window", type=float,
+        default=float(_env("TPU_FLIGHT_WINDOW_S", "10.0")),
+        help="seconds of exemplar/signal history a fired bundle "
+        "freezes (also the window peers contribute over)",
+    )
+    p.add_argument(
+        "--flight-profile-s", type=float,
+        default=float(_env("TPU_FLIGHT_PROFILE_S", "0.0")),
+        help="bounded jax.profiler capture attached to automatic "
+        "trigger fires, in seconds (0 = off; manual triggers opt in "
+        "per request)",
+    )
+    p.add_argument(
+        "--tracing-sample-rate", type=float,
+        default=float(_env("TRACING_SAMPLE_RATE", "1.0")),
+        help="head-sampling rate for exported spans: 1.0 records "
+        "every request (the default, current behavior), 0.01 one in "
+        "a hundred; the datastore_latency aggregation is never "
+        "sampled",
+    )
+    p.add_argument(
+        "--metrics-exemplars",
+        choices=["on", "off"],
+        default=_env("TPU_METRICS_EXEMPLARS", "off"),
+        help="attach trace-id exemplars to tail-bucket "
+        "datastore-latency observations and render /metrics in the "
+        "OpenMetrics exposition (the only format carrying exemplars); "
+        "off keeps the text 0.0.4 exposition byte-identical",
+    )
     return p
 
 
@@ -957,11 +1010,17 @@ def build_limiter(args, on_partitioned=None):
 
 
 async def _amain(args) -> int:
+    from ..observability import tracing as tracing_mod
     from ..observability.tracing import configure_tracing
 
     tracing_err = configure_tracing(args.tracing_endpoint)
     if tracing_err:
         log.warning(tracing_err)
+    tracing_mod.set_sample_rate(args.tracing_sample_rate)
+    if args.tracing_sample_rate < 1.0:
+        log.info(
+            f"tracing head sampling: {tracing_mod.sample_rate():.4f} "
+            "(datastore_latency aggregation unsampled)")
 
     # Arm/disarm the serving-model fit BEFORE any storage construction:
     # DeviceStatsRecorder attaches its ingest tap at creation time
@@ -1048,6 +1107,11 @@ async def _amain(args) -> int:
         use_limit_name_label=args.limit_name_in_labels,
         metric_labels=initial_labels,
     )
+    if args.metrics_exemplars == "on":
+        metrics.enable_exemplars()
+        log.info(
+            "metrics exemplars on: /metrics renders the OpenMetrics "
+            "exposition with trace-id exemplars on tail latency buckets")
     # Span-tree latency aggregation — the same two aggregates the
     # reference's subscriber registers (main.rs:908-917): request-path
     # datastore spans roll up under should_rate_limit, write-behind
@@ -1579,6 +1643,61 @@ async def _amain(args) -> int:
             f"(SLO budget {args.slo_budget_ms:.1f}ms, refit on the "
             "usage drain cadence; GET /debug/capacity)")
 
+    # Flight recorder (ISSUE 16): always-on sampled exemplar rings +
+    # worst-K tails on every decision lane, a trigger engine turning
+    # SLO-burn/breaker/resize/drift/probe edges (and manual POST
+    # /debug/flight/trigger) into self-contained incident bundles, and
+    # pod-correlated peer ring collection over the peer lane.
+    flight_engine = None
+    if args.flight == "on":
+        from ..observability.device_plane import (
+            JaxProfiler as _FlightProfiler,
+        )
+        from ..observability.flight import (
+            BundleSpool,
+            FlightRecorder,
+            TriggerEngine,
+        )
+
+        flight = FlightRecorder(
+            sample_stride=max(args.flight_sample, 1),
+            host_id=pod.process_id if pod is not None else 0,
+        )
+        flight.trace_provider = tracing_mod.current_trace_id
+        flight_rec_target = (
+            getattr(limiter, "recorder", None)
+            or getattr(counters_storage, "recorder", None)
+        )
+        if flight_rec_target is not None:
+            # The lean-lane tap: every batched decision the device
+            # recorder times now offers the sampled stage breakdown.
+            flight_rec_target.flight_tap = flight
+        if pod_frontend is not None:
+            pod_frontend.attach_flight_recorder(flight)
+        flight_engine = TriggerEngine(
+            flight,
+            BundleSpool(args.flight_spool_dir),
+            signals=signal_bus,
+            events=(
+                getattr(pod_frontend, "events", None)
+                if pod_frontend is not None else None
+            ),
+            lane=pod_frontend.lane if pod_frontend is not None else None,
+            profiler=(
+                _FlightProfiler(args.profile_dir)
+                if args.flight_profile_s > 0 else None
+            ),
+            window_s=args.flight_window,
+            profile_s=args.flight_profile_s,
+        )
+        flight_engine.start()
+        metrics.attach_render_hook(flight)
+        log.info(
+            "flight recorder armed: 1-in-"
+            f"{max(args.flight_sample, 1)} exemplars + worst-K tails, "
+            f"{args.flight_window:.0f}s bundle window, spool "
+            f"{args.flight_spool_dir} (GET /debug/flight)")
+
     authority_server = None
     if args.authority_listen:
         from ..storage.authority import serve_authority
@@ -1695,6 +1814,8 @@ async def _amain(args) -> int:
         debug_sources.append(signal_bus)
     if model_estimator is not None:
         debug_sources.append(model_estimator)
+    if flight_engine is not None:
+        debug_sources.append(flight_engine)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,
@@ -1785,6 +1906,8 @@ async def _amain(args) -> int:
     await http_runner.cleanup()
     if observatory is not None:
         observatory.close()
+    if flight_engine is not None:
+        flight_engine.stop()
     if admission is not None:
         await admission.close()
     if native_pipeline is not None:

@@ -41,6 +41,14 @@ Beyond the reference surface, the device-plane debug endpoints
     GET  /debug/profile     jax.profiler capture status
     POST /debug/profile     {"action": "start"|"stop", "trace_dir"?: str}
                             toggles an on-demand jax.profiler trace
+    GET  /debug/flight      flight-recorder incident bundles: list the
+                            spool (?name= serves one bundle verbatim;
+                            404 recorder off / unknown bundle)
+    POST /debug/flight/trigger
+                            fire a manual flight-recorder trigger:
+                            freezes the exemplar rings, collects pod
+                            peers' rings and persists a bundle
+                            ({"note"?: str, "profile"?: bool})
 
 POST bodies are CheckAndReportInfo: {"namespace", "values": {str: str},
 "delta", "response_headers": optional "DRAFT_VERSION_03"}
@@ -96,6 +104,9 @@ DEBUG_SOURCE_SECTIONS = (
     # elastic pod (ISSUE 15): the live-resize state machine —
     # transition state, received-slice ledger, topology epoch
     ("pod_resize", "resize_debug"),
+    # flight recorder (ISSUE 16): exemplar-ring occupancy, trigger
+    # tallies, pending peer retries and the bundle spool
+    ("flight", "flight_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -120,6 +131,7 @@ DEBUG_STATS_SECTIONS = (
     "pod_routing",
     "capacity",
     "pod_resize",
+    "flight",
 )
 
 
@@ -373,6 +385,51 @@ def _openapi_spec() -> dict:
                     },
                 },
             },
+            "/debug/flight": {
+                "get": {
+                    "summary": "Flight-recorder incident bundles: list "
+                               "the retention-capped spool, or serve "
+                               "one self-contained bundle verbatim "
+                               "(?name=)",
+                    "responses": {
+                        "200": {"description": "bundle list or bundle"},
+                        "404": {"description": "recorder off / unknown "
+                                               "bundle"},
+                    },
+                }
+            },
+            "/debug/flight/trigger": {
+                "post": {
+                    "summary": "Fire a manual flight-recorder trigger: "
+                               "freeze the exemplar rings, collect pod "
+                               "peers' rings for the same window, "
+                               "persist an incident bundle",
+                    "requestBody": {
+                        "required": False,
+                        "content": {
+                            "application/json": {
+                                "schema": {
+                                    "type": "object",
+                                    "properties": {
+                                        "note": {
+                                            "type": "string",
+                                            "nullable": True,
+                                        },
+                                        "profile": {
+                                            "type": "boolean",
+                                            "default": False,
+                                        },
+                                    },
+                                }
+                            }
+                        },
+                    },
+                    "responses": {
+                        "200": {"description": "bundle persisted"},
+                        "404": {"description": "recorder off"},
+                    },
+                }
+            },
             "/limits/{namespace}": {
                 "get": {
                     "summary": "Limits configured for a namespace",
@@ -523,8 +580,15 @@ class _Api:
         return web.json_response(_openapi_spec())
 
     async def get_metrics(self, request: web.Request) -> web.Response:
-        body = self.metrics.render() if self.metrics else b""
-        return web.Response(body=body, content_type="text/plain")
+        if self.metrics is None:
+            return web.Response(body=b"", content_type="text/plain")
+        body = self.metrics.render()
+        # OpenMetrics exposition (exemplars armed) carries its own
+        # content type; headers= keeps the full parameterized value.
+        return web.Response(
+            body=body,
+            headers={"Content-Type": self.metrics.content_type},
+        )
 
     async def get_debug_stats(self, request: web.Request) -> web.Response:
         """Device-plane state without a debugger: queue depths, per-shard
@@ -795,6 +859,62 @@ class _Api:
         except Exception as exc:  # jax.profiler failures must not crash
             return web.json_response({"error": str(exc)}, status=500)
 
+    async def get_debug_flight(self, request: web.Request) -> web.Response:
+        """The flight-recorder bundle spool: the list of persisted
+        incident bundles (newest first), or — with ``?name=`` — one
+        self-contained bundle verbatim for offline autopsy."""
+        list_fn = self._debug_source_fn("flight_bundles")
+        if list_fn is None:
+            return web.json_response(
+                {"error": "flight recorder not running (--flight off)"},
+                status=404,
+            )
+        name = request.query.get("name")
+        if name is None:
+            return web.json_response({"bundles": list_fn()})
+        read_fn = self._debug_source_fn("flight_bundle")
+        bundle = read_fn(name) if read_fn is not None else None
+        if bundle is None:
+            return web.json_response(
+                {"error": f"unknown bundle {name!r}"}, status=404
+            )
+        return web.json_response(bundle)
+
+    async def post_debug_flight_trigger(
+        self, request: web.Request
+    ) -> web.Response:
+        """Fire a manual flight-recorder trigger (``{"note"?: str,
+        "profile"?: bool}``): freezes the exemplar rings, asks pod
+        peers for their rings over the same window, and persists a
+        self-contained incident bundle. Runs off-loop — the peer
+        collection is blocking control-plane RPC."""
+        fn = self._debug_source_fn("flight_trigger")
+        if fn is None:
+            return web.json_response(
+                {"error": "flight recorder not running (--flight off)"},
+                status=404,
+            )
+        note, profile = None, False
+        if request.can_read_body:
+            try:
+                data = await request.json()
+                note = data.get("note")
+                profile = bool(data.get("profile", False))
+                if note is not None and not isinstance(note, str):
+                    raise ValueError("note must be a string")
+            except ValueError as exc:
+                return web.json_response(
+                    {"error": f"bad request: {exc}"}, status=400
+                )
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None, lambda: fn(note, profile)
+            )
+        except Exception as exc:  # diagnostics must never 500 opaquely
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(out)
+
     async def get_limits(self, request: web.Request) -> web.Response:
         ns = request.match_info["namespace"]
         limits = self.limiter.get_limits(ns)
@@ -936,6 +1056,8 @@ def make_http_app(
     app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)
     app.router.add_post("/debug/profile", api.post_debug_profile)
+    app.router.add_get("/debug/flight", api.get_debug_flight)
+    app.router.add_post("/debug/flight/trigger", api.post_debug_flight_trigger)
     app.router.add_get("/limits/{namespace}", api.get_limits)
     app.router.add_get("/counters/{namespace}", api.get_counters)
     app.router.add_post("/check", api.post_check)

@@ -18,10 +18,12 @@ import grpc
 from aiohttp import web
 
 from ..observability.device_plane import set_request_id
+from ..observability.tracing import adopt_traceparent
 
 __all__ = ["http_request_id_middleware", "GrpcRequestIdInterceptor"]
 
 HEADER = "x-request-id"
+TRACEPARENT = "traceparent"
 
 
 @web.middleware
@@ -29,6 +31,10 @@ async def http_request_id_middleware(request: web.Request, handler):
     request_id = request.headers.get(HEADER) or uuid.uuid4().hex
     request["request_id"] = request_id
     set_request_id(request_id)
+    # Adopt the caller's W3C trace id (ISSUE 16): flight-recorder and
+    # Prometheus exemplars then correlate with the caller's trace even
+    # when no local exporter is configured.
+    adopt_traceparent(request.headers.get(TRACEPARENT))
     try:
         response = await handler(request)
     except web.HTTPException as exc:
@@ -58,6 +64,7 @@ class GrpcRequestIdInterceptor(grpc.aio.ServerInterceptor):
             # coroutine runs in the request's context, so the batcher's
             # flight recorder sees this id for decisions it coalesces.
             set_request_id(request_id)
+            adopt_traceparent(metadata.get(TRACEPARENT))
             return context.send_initial_metadata(((HEADER, request_id),))
 
         for attr, factory, streams_out in (

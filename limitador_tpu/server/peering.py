@@ -580,6 +580,11 @@ class PeerLane:
         #: decisions WE decide for peers land here with the
         #: originating request id
         self.recorder = None
+        #: flight.FlightRecorder (ISSUE 16): the always-on exemplar
+        #: rings — owner-side decides tap it, and the ``flight`` admin
+        #: kind serves our frozen rings to a triggered peer building a
+        #: pod-correlated incident bundle
+        self.flight = None
         self.signal_exchanges = 0
         self.signal_exchange_failures = 0
         self._signal_inflight: set = set()
@@ -797,6 +802,22 @@ class PeerLane:
                 except Exception:
                     mine = {}
             return json.dumps({"ok": True, "signals": mine}).encode()
+        if kind == "flight":
+            # Pod-correlated autopsy (ISSUE 16): a triggered peer asks
+            # for our rings over its incident window. contribute() is
+            # one lock + list copies — fine inline on the lane loop.
+            flight = self.flight
+            if flight is None:
+                return json.dumps({
+                    "ok": False,
+                    "error": "no flight recorder attached",
+                }).encode()
+            return json.dumps({
+                "ok": True,
+                "flight": flight.contribute(
+                    payload.get("t0"), payload.get("t1")
+                ),
+            }).encode()
         if kind == "bulk_decide":
             # Pod fast path (ISSUE 13): a peer's flush of foreign-owned
             # raw request blobs, decided here in ONE local bulk pass
@@ -881,6 +902,19 @@ class PeerLane:
                 kind,
             )
         decide_s = time.perf_counter() - t_decide
+        tap = self.flight
+        if tap is not None:
+            # Owner-side exemplar of a forwarded decision (ISSUE 16):
+            # same request id as the origin's pod_forward entry, so one
+            # bundle shows both sides of the hop.
+            tap.tap(
+                decide_s, "pod_forward",
+                request_id=str(rid) if rid is not None else None,
+                namespace=str(payload["ns"]),
+                phases_ms={
+                    "pod_remote_decide": round(decide_s * 1e3, 4),
+                },
+            )
         recorder = self.recorder
         if recorder is not None:
             flight = getattr(recorder, "flight", recorder)
@@ -1512,6 +1546,9 @@ class PodFrontend:
         self.events = PodEventLog(
             host_id=lane.host_id, capacity=events_capacity
         )
+        #: flight.FlightRecorder (ISSUE 16): the always-on exemplar
+        #: rings; None = detached (attach_flight_recorder arms it)
+        self.flight = None
         self.hops = PodHopRecorder(host_id=lane.host_id)
         self.aggregator = PodSignalAggregator(host_id=lane.host_id)
         self.aggregator.local_fields = self.pod_signal_fields
@@ -1781,6 +1818,16 @@ class PodFrontend:
         self.hops.attach_flight(recorder)
         self.lane.recorder = recorder
 
+    def attach_flight_recorder(self, flight) -> None:
+        """Arm the ISSUE 16 flight recorder on every pod lane: origin-
+        side forwards (hop tap), owner-side decides and the ``flight``
+        ring-contribution kind (lane), the degraded stand-in path, and
+        the topology epoch stamped into every sampled exemplar."""
+        self.flight = flight
+        self.lane.flight = flight
+        self.hops.tap = flight
+        flight.epoch_provider = lambda: self.router.topology_epoch
+
     def attach_signal_bus(self, bus) -> None:
         """Join the local ControlSignals bus into the federated view
         (and the pod fields into the bus — both directions)."""
@@ -1944,6 +1991,34 @@ class PodFrontend:
         """Decide against the owner's local stand-in (exact oracle +
         delta journal). Mirrors RateLimiter's storage-to-CheckResult
         shape so serving planes can't tell a degraded answer apart."""
+        tap = getattr(self, "flight", None)
+        if tap is None:
+            return self._degraded_decide_inner(
+                guard, counters, delta, load, kind
+            )
+        # ISSUE 16: degraded-lane exemplars — the failover window is
+        # exactly what an incident bundle needs to show.
+        t0 = time.perf_counter()
+        try:
+            return self._degraded_decide_inner(
+                guard, counters, delta, load, kind
+            )
+        finally:
+            namespace = None
+            if counters:
+                limit = getattr(counters[0], "limit", None)
+                namespace = getattr(limit, "namespace", None)
+            tap.tap(
+                time.perf_counter() - t0, "degraded",
+                request_id=current_request_id(),
+                namespace=namespace,
+                phases_ms=None,
+            )
+
+    def _degraded_decide_inner(
+        self, guard: _OwnerGuard, counters: List[Counter],
+        delta: int, load: bool, kind: str,
+    ) -> Optional[CheckResult]:
         entered = False
         with guard._degraded_lock:
             if guard.degraded_since is None:

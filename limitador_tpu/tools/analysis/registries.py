@@ -18,9 +18,10 @@ from . import Finding, RepoContext, register_pass
 
 __all__ = [
     "REGISTRY_OWNED_PREFIXES", "NATIVE_PLANE_MODULE", "HTTP_API_MODULE",
+    "OBSERVABILITY_DOC", "EVENTS_MODULE",
     "declared_metric_families", "registered_metric_families",
     "metric_registry_findings", "native_phase_findings",
-    "debug_section_findings",
+    "debug_section_findings", "docs_sync_findings",
 ]
 
 #: metric prefixes whose declarations must be covered by a subsystem
@@ -50,6 +51,9 @@ REGISTRY_OWNED_PREFIXES = {
     # fit's model_* gauges and the capacity_* headroom forecast
     "model_": "limitador_tpu/observability/model.py",
     "capacity_": "limitador_tpu/observability/model.py",
+    # flight recorder (ISSUE 16): exemplar rings, trigger tallies and
+    # the incident-bundle spool
+    "flight_": "limitador_tpu/observability/flight.py",
 }
 
 #: the native telemetry plane's phase registry module
@@ -60,6 +64,13 @@ NATIVE_PLANE_MODULE = "limitador_tpu/observability/native_plane.py"
 HTTP_API_MODULE = "limitador_tpu/server/http_api.py"
 
 METRICS_MODULE = "limitador_tpu/observability/metrics.py"
+
+#: the human-facing observability reference every telemetry surface
+#: must appear in (docs-sync pass, ISSUE 16)
+OBSERVABILITY_DOC = "docs/observability.md"
+
+#: the typed pod event registry whose kinds the doc must enumerate
+EVENTS_MODULE = "limitador_tpu/observability/events.py"
 
 
 def declared_metric_families(ctx: RepoContext):
@@ -245,6 +256,74 @@ def debug_section_findings(ctx: RepoContext) -> List[Finding]:
     return findings
 
 
+def _debug_routes(ctx: RepoContext, path: Path):
+    """(route, lineno) for every ``/debug/*`` string literal passed to
+    ``router.add_get``/``add_post`` in the HTTP API module."""
+    tree = ctx.tree(path)
+    out = []
+    if tree is None:
+        return out
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("add_get", "add_post")
+            and node.args
+        ):
+            continue
+        first = node.args[0]
+        if (
+            isinstance(first, ast.Constant)
+            and isinstance(first.value, str)
+            and first.value.startswith("/debug")
+        ):
+            out.append((first.value, node.lineno))
+    return out
+
+
+def docs_sync_findings(ctx: RepoContext) -> List[Finding]:
+    """Every telemetry surface must appear in docs/observability.md:
+    each EVENT_KINDS entry, each registered METRIC_FAMILIES family, and
+    each /debug route the HTTP API serves. A surface shipped without its
+    doc line is invisible to the operator who needs it during an
+    incident — exactly when nobody reads source. Trees without the doc
+    (synthetic lint fixtures) are exempt."""
+    doc_path = ctx.path(OBSERVABILITY_DOC)
+    if not doc_path.exists():
+        return []
+    doc = ctx.source(doc_path)
+    findings = []
+    events_path = ctx.path(EVENTS_MODULE)
+    if events_path.exists():
+        for kind in ctx.module_string_tuple(events_path, "EVENT_KINDS"):
+            if f"`{kind}`" not in doc and kind not in doc:
+                findings.append(Finding(
+                    "docs-sync", EVENTS_MODULE, 0,
+                    f"event kind '{kind}' is not documented in "
+                    f"{OBSERVABILITY_DOC}",
+                    hint="add it to the event-kind enumeration",
+                ))
+    for path, lineno, family in registered_metric_families(ctx):
+        if family not in doc:
+            findings.append(Finding(
+                "docs-sync", ctx.rel(path), lineno,
+                f"metric family '{family}' is not documented in "
+                f"{OBSERVABILITY_DOC}",
+                hint="name the family in the doc's metrics coverage",
+            ))
+    api_path = ctx.path(HTTP_API_MODULE)
+    if api_path.exists():
+        for route, lineno in _debug_routes(ctx, api_path):
+            if route not in doc:
+                findings.append(Finding(
+                    "docs-sync", HTTP_API_MODULE, lineno,
+                    f"debug endpoint '{route}' is not documented in "
+                    f"{OBSERVABILITY_DOC}",
+                    hint="add an endpoint row to the doc",
+                ))
+    return findings
+
+
 @register_pass(
     "metric-registry",
     "subsystem METRIC_FAMILIES registries vs PrometheusMetrics "
@@ -270,3 +349,12 @@ def run_native_phases(ctx: RepoContext) -> List[Finding]:
 )
 def run_debug_sections(ctx: RepoContext) -> List[Finding]:
     return debug_section_findings(ctx)
+
+
+@register_pass(
+    "docs-sync",
+    "every event kind, registered metric family and /debug endpoint "
+    "must appear in docs/observability.md",
+)
+def run_docs_sync(ctx: RepoContext) -> List[Finding]:
+    return docs_sync_findings(ctx)

@@ -28,16 +28,16 @@ from .analysis.native_abi import (        # noqa: re-exported legacy API
     abi_findings, declared_ctypes_signatures, exported_c_symbols,
 )
 from .analysis.registries import (        # noqa: re-exported legacy API
-    HTTP_API_MODULE, NATIVE_PLANE_MODULE, REGISTRY_OWNED_PREFIXES,
-    debug_section_findings, metric_registry_findings,
-    native_phase_findings,
+    HTTP_API_MODULE, NATIVE_PLANE_MODULE, OBSERVABILITY_DOC,
+    REGISTRY_OWNED_PREFIXES, debug_section_findings, docs_sync_findings,
+    metric_registry_findings, native_phase_findings,
 )
 from .analysis.style import lint_file, lint_paths  # noqa: re-exported
 
 __all__ = [
     "lint_file", "lint_paths", "lint_metric_registry", "lint_donation",
     "lint_ctypes_signatures", "lint_native_phases",
-    "lint_debug_sections", "main", "DEFAULT_TARGETS",
+    "lint_debug_sections", "lint_docs_sync", "main", "DEFAULT_TARGETS",
 ]
 
 DEFAULT_TARGETS = ("limitador_tpu", "tests", "bench.py",
@@ -68,6 +68,11 @@ def lint_native_phases(repo_root) -> List[str]:
 def lint_debug_sections(repo_root) -> List[str]:
     ctx = RepoContext(repo_root)
     return _legacy(ctx, debug_section_findings(ctx))
+
+
+def lint_docs_sync(repo_root) -> List[str]:
+    ctx = RepoContext(repo_root)
+    return _legacy(ctx, docs_sync_findings(ctx))
 
 
 def lint_ctypes_signatures(repo_root) -> List[str]:

@@ -73,6 +73,7 @@ def main() -> int:
     args = parser.parse_args()
 
     from limitador_tpu import RateLimiter
+    from limitador_tpu.observability.flight import FlightRecorder
     from limitador_tpu.routing import PodRouter, PodTopology
     from limitador_tpu.server.peering import PeerLane, PodFrontend
     from limitador_tpu.storage.in_memory import InMemoryStorage
@@ -81,6 +82,14 @@ def main() -> int:
     topology = PodTopology(hosts=2, host_id=1, shards_per_host=1)
     lane = PeerLane(1, args.listen, {}, None)
     frontend = PodFrontend(limiter, PodRouter(topology), lane)
+    # ISSUE 16: this worker is a pod PEER in the flight-recorder
+    # autopsy — it answers ``kind: "flight"`` ring requests and taps
+    # every owner-side forwarded decision, so the parent's incident
+    # bundle carries both sides of the hop (and, after the SIGKILL
+    # restart, the retried contribution that patches the bundle).
+    frontend.attach_flight_recorder(
+        FlightRecorder(sample_stride=1, host_id=1)
+    )
     asyncio.run(frontend.configure_with(chaos_limits()))
     lane.start()
     with open(args.ready, "w") as f:

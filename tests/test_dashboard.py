@@ -229,6 +229,25 @@ def test_dashboard_covers_elastic_pod_families():
         assert family in exprs, f"no panel queries {family}"
 
 
+def test_dashboard_covers_flight_families():
+    """ISSUE 16: the flight recorder ships WITH its Grafana row — a
+    "Flight recorder" row exists, every family the recorder owns
+    (flight.METRIC_FAMILIES) is referenced by at least one panel
+    expression, and trigger fires surface as dashboard annotations."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("flight recorder" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.observability.flight import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+    annotations = doc.get("annotations", {}).get("list", [])
+    assert any(
+        "flight_triggers" in (a.get("expr") or "") for a in annotations
+    ), "no trigger annotation on the dashboard"
+
+
 def test_dashboard_slo_alert_panel_gated_on_device_backing():
     """The PR 7 false-page fix (ISSUE 14 satellite): the pageable
     breach panel must alert on slo_breached_actionable — raw

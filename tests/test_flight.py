@@ -1,0 +1,778 @@
+"""Flight recorder: always-on decision exemplars, triggered incident
+bundles, and pod-correlated autopsies (ISSUE 16).
+
+Four tiers, all fast: the FlightRecorder rings (sampling stride,
+worst-K tail retention, windowed contribution), the BundleSpool
+(retention caps, path safety, torn-read protection), the TriggerEngine
+(signal/event edge detection with injected clocks and fake buses —
+``tick()`` is documented safe to call inline), and the HTTP surface
+(GET /debug/flight, POST /debug/flight/trigger) through the same
+aiohttp TestClient idiom the server suite uses. ``make flight-drill``
+runs the ``-k drill`` subset: the manual trigger fired under live
+decision traffic must round-trip through GET /debug/flight as a
+self-contained bundle carrying exemplars from the traffic window.
+
+The slow pod-correlated autopsy (SIGKILL + peer retry over a real
+PeerLane) lives in tests/test_pod_chaos.py; here peers are faked.
+"""
+
+import json
+import threading
+
+import pytest
+
+from limitador_tpu.observability.flight import (
+    FLIGHT_LANES,
+    TRIGGER_REASONS,
+    BundleSpool,
+    FlightRecorder,
+    TriggerEngine,
+)
+from limitador_tpu.observability.signals import ControlSignals
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# -- FlightRecorder ----------------------------------------------------------
+
+
+def test_recorder_samples_one_in_stride():
+    clock = FakeClock()
+    rec = FlightRecorder(sample_stride=8, clock=clock)
+    for i in range(80):
+        rec.tap(0.001, "lean", request_id=f"r{i}", namespace="api")
+    assert rec.taps() == 80
+    assert rec.exemplars == 10  # 1-in-8
+    snap = rec.contribute()
+    assert len(snap["exemplars"]) == 10
+    e = snap["exemplars"][0]
+    assert e["lane"] == "lean"
+    assert e["namespace"] == "api"
+    assert e["duration_ms"] == 1.0
+    assert e["request_id"] == "r0"
+
+
+def test_recorder_stride_one_records_everything():
+    rec = FlightRecorder(sample_stride=1, capacity=64)
+    for i in range(32):
+        rec.tap(0.002, "native_hot")
+    assert rec.exemplars == 32
+
+
+def test_recorder_ring_is_bounded():
+    rec = FlightRecorder(capacity=16, sample_stride=1)
+    for i in range(100):
+        rec.tap(0.001, "lean", request_id=f"r{i}")
+    snap = rec.contribute()
+    assert len(snap["exemplars"]) == 16
+    # newest survive
+    assert snap["exemplars"][-1]["request_id"] == "r99"
+    assert snap["exemplars"][0]["request_id"] == "r84"
+
+
+def test_recorder_worst_k_retained_regardless_of_stride():
+    """The tail reservoir is the point: even at a stride that samples
+    almost nothing, the slowest decisions per lane are retained."""
+    rec = FlightRecorder(sample_stride=10_000, worst_k=4)
+    for i in range(1000):
+        rec.tap(0.0001 * (i % 7 + 1), "lean", request_id=f"fast{i}")
+    for i in range(4):
+        rec.tap(1.0 + i, "lean", request_id=f"slow{i}")
+    snap = rec.contribute()
+    worst = snap["worst"]["lean"]
+    assert len(worst) == 4
+    assert {e["request_id"] for e in worst} == {
+        "slow0", "slow1", "slow2", "slow3"
+    }
+    # sorted slowest-first in the contribution
+    assert worst[0]["request_id"] == "slow3"
+    # and the tails are per-lane: other lanes stayed empty
+    assert snap["worst"]["native_hot"] == []
+    assert set(snap["worst"]) == set(FLIGHT_LANES)
+
+
+def test_recorder_tail_floor_rises():
+    """Once the per-lane heap is full, sub-floor observations must not
+    take the lock path (the floor read is the hot-path gate)."""
+    rec = FlightRecorder(sample_stride=10_000, worst_k=2)
+    rec.tap(0.5, "degraded")
+    rec.tap(0.7, "degraded")
+    retained = rec.tail_retained
+    assert rec._tail_floor["degraded"] == 0.5
+    rec.tap(0.1, "degraded")  # below floor: dropped
+    assert rec.tail_retained == retained
+    rec.tap(0.9, "degraded")  # beats floor: replaces 0.5
+    assert rec._tail_floor["degraded"] == 0.7
+
+
+def test_recorder_contribute_filters_exemplars_by_window_not_tails():
+    clock = FakeClock(100.0)
+    rec = FlightRecorder(sample_stride=1, clock=clock)
+    rec.tap(0.001, "lean", request_id="early")
+    clock.advance(50)
+    rec.tap(2.0, "lean", request_id="late-slow")
+    snap = rec.contribute(t0=140.0, t1=160.0)
+    assert [e["request_id"] for e in snap["exemplars"]] == ["late-slow"]
+    # worst-K tails ship WHOLE — the tail is always evidence
+    ids = {e["request_id"] for e in snap["worst"]["lean"]}
+    assert ids == {"early", "late-slow"}
+
+
+def test_recorder_stamps_epoch_trace_and_key_hash():
+    rec = FlightRecorder(sample_stride=1)
+    rec.epoch_provider = lambda: 7
+    rec.trace_provider = lambda: "abc123"
+    rec.tap(0.001, "pod_forward", namespace="api", key="api/u=alice")
+    rec.tap(0.001, "pod_forward", namespace="api", key="api/u=alice",
+            trace_id="explicit")
+    e0, e1 = rec.contribute()["exemplars"]
+    assert e0["tepoch"] == 7 and e1["tepoch"] == 7
+    assert e0["trace_id"] == "abc123"  # provider fallback
+    assert e1["trace_id"] == "explicit"  # explicit wins
+    assert e0["key_hash"] == e1["key_hash"] != 0
+
+
+def test_recorder_signal_snapshots_ring():
+    clock = FakeClock(10.0)
+    rec = FlightRecorder(signal_capacity=4, clock=clock)
+    for i in range(9):
+        rec.note_signals(ControlSignals(ts=float(i), slo_burn_5m=0.1 * i))
+    snap = rec.contribute()
+    assert len(snap["signals"]) == 4
+    assert snap["signals"][-1]["ts"] == 8.0
+    assert len(snap["signals"][-1]["vector"]) == len(
+        ControlSignals(ts=0.0).vector()
+    )
+    assert rec.signal_snapshots == 9
+
+
+def test_recorder_flight_debug_counts():
+    rec = FlightRecorder(sample_stride=2, worst_k=2)
+    for i in range(10):
+        rec.tap(0.001 * (i + 1), "lean")
+    d = rec.flight_debug()
+    assert d["taps"] == 10
+    assert d["exemplars"] == 5
+    assert d["sample_stride"] == 2
+    assert d["tail_depth"]["lean"] == 2
+    assert d["ring_depth"] == 5
+
+
+def test_recorder_provider_failure_never_breaks_tap():
+    rec = FlightRecorder(sample_stride=1)
+    rec.epoch_provider = lambda: 1 / 0
+    rec.trace_provider = lambda: 1 / 0
+    rec.tap(0.001, "lean")
+    e = rec.contribute()["exemplars"][0]
+    assert e["tepoch"] is None and e["trace_id"] is None
+
+
+def test_recorder_tap_is_thread_safe_under_contention():
+    rec = FlightRecorder(sample_stride=4, worst_k=8)
+    n, threads = 2000, 4
+
+    def worker(tid):
+        for i in range(n):
+            rec.tap(0.0001 * (i % 11), FLIGHT_LANES[tid % 4],
+                    request_id=f"t{tid}-{i}")
+
+    ts = [threading.Thread(target=worker, args=(t,)) for t in range(threads)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert rec.taps() == n * threads
+    snap = rec.contribute()
+    assert rec.exemplars > 0
+    for lane in FLIGHT_LANES:
+        assert len(snap["worst"][lane]) <= 8
+
+
+# -- BundleSpool -------------------------------------------------------------
+
+
+def _bundle(i=0):
+    return {"schema": 1, "reason": "manual", "i": i}
+
+
+def test_spool_write_read_round_trip(tmp_path):
+    spool = BundleSpool(tmp_path)
+    name = "flight-1700000000000-manual-h0.json"
+    path = spool.write(name, _bundle())
+    assert json.loads(open(path).read()) == _bundle()
+    assert spool.read(name) == _bundle()
+    idx = spool.list()
+    assert len(idx) == 1
+    assert idx[0]["name"] == name
+    assert idx[0]["reason"] == "manual"
+    assert idx[0]["bytes"] > 0
+    assert spool.total_bytes() == idx[0]["bytes"]
+
+
+def test_spool_retention_caps_bundle_count(tmp_path):
+    spool = BundleSpool(tmp_path, max_bundles=3)
+    for i in range(6):
+        spool.write(f"flight-{1000 + i}-manual-h0.json", _bundle(i))
+    idx = spool.list()
+    assert len(idx) == 3
+    # newest-first, oldest evicted
+    assert [b["name"] for b in idx] == [
+        "flight-1005-manual-h0.json",
+        "flight-1004-manual-h0.json",
+        "flight-1003-manual-h0.json",
+    ]
+
+
+def test_spool_retention_caps_total_bytes(tmp_path):
+    spool = BundleSpool(tmp_path, max_bundles=100, max_bytes=400)
+    for i in range(8):
+        spool.write(f"flight-{1000 + i}-manual-h0.json",
+                    {"pad": "x" * 100, "i": i})
+    assert spool.total_bytes() <= 400
+    assert spool.list()[0]["name"] == "flight-1007-manual-h0.json"
+
+
+def test_spool_read_rejects_path_traversal(tmp_path):
+    spool = BundleSpool(tmp_path / "spool")
+    outside = tmp_path / "flight-1-manual-h0.json"
+    outside.write_text("{}")
+    assert spool.read("../flight-1-manual-h0.json") is None
+    assert spool.read("/etc/passwd") is None
+    assert spool.read("notes.txt") is None  # not a bundle name
+    assert spool.read("flight-1-manual-h0.json") is None  # absent is None
+
+
+def test_spool_ignores_foreign_files(tmp_path):
+    (tmp_path / "README.md").write_text("not a bundle")
+    (tmp_path / "flight-bad.json").write_text("{}")
+    spool = BundleSpool(tmp_path, max_bundles=1)
+    spool.write("flight-2000-drift-h1.json", _bundle())
+    assert [b["name"] for b in spool.list()] == [
+        "flight-2000-drift-h1.json"
+    ]
+    assert (tmp_path / "README.md").exists()  # retention never eats it
+
+
+# -- TriggerEngine -----------------------------------------------------------
+
+
+class FakeBus:
+    def __init__(self):
+        self.sig = ControlSignals(ts=0.0)
+
+    def snapshot(self):
+        return self.sig
+
+
+class FakeEvents:
+    def __init__(self):
+        self._counts = {}
+        self.tail = [{"kind": "peer_up", "host": 1}]
+
+    def counts(self):
+        return dict(self._counts)
+
+    def snapshot(self, n=64):
+        return list(self.tail)[-n:]
+
+
+class FakeLane:
+    """admin_call-shaped peer set: host -> contribution dict, callable,
+    or Exception to raise."""
+
+    def __init__(self, peers):
+        self.peers = peers
+        self.calls = []
+
+    def admin_call(self, host, payload, timeout=5.0):
+        self.calls.append((host, payload))
+        value = self.peers[host]
+        if isinstance(value, Exception):
+            raise value
+        if callable(value):
+            value = value()
+        return {"ok": True, "flight": value}
+
+
+def _engine(tmp_path, clock, **kw):
+    rec = kw.pop("recorder", None) or FlightRecorder(
+        sample_stride=1, clock=clock
+    )
+    spool = BundleSpool(tmp_path / "spool")
+    kw.setdefault("cooldown_s", 30.0)
+    kw.setdefault("window_s", 10.0)
+    eng = TriggerEngine(rec, spool, clock=clock, **kw)
+    return eng, rec, spool
+
+
+def test_trigger_fire_builds_self_contained_bundle(tmp_path):
+    clock = FakeClock(2000.0)
+    eng, rec, spool = _engine(tmp_path, clock, events=FakeEvents())
+    rec.epoch_provider = lambda: 3
+    rec.tap(0.005, "lean", request_id="r1", namespace="api",
+            phases_ms={"hot_lookup": 1.2})
+    name = eng.fire("manual", note="test fire")
+    assert name is not None and name.startswith("flight-2000000-manual-h0")
+    bundle = spool.read(name)
+    assert bundle["schema"] == 1
+    assert bundle["reason"] == "manual"
+    assert bundle["note"] == "test fire"
+    assert bundle["tepoch"] == 3
+    assert bundle["window"] == [1990.0, 2000.0]
+    assert bundle["signal_fields"] == list(ControlSignals.FIELDS)
+    assert bundle["events"] == [{"kind": "peer_up", "host": 1}]
+    assert bundle["peers"] == {}  # no lane attached
+    assert bundle["profile"] is None
+    local = bundle["local"]
+    assert local["exemplars"][0]["request_id"] == "r1"
+    assert local["exemplars"][0]["phases_ms"] == {"hot_lookup": 1.2}
+    assert eng.trigger_counts["manual"] == 1
+    assert eng.last_bundle == name
+
+
+def test_trigger_cooldown_suppresses_and_force_bypasses(tmp_path):
+    clock = FakeClock(3000.0)
+    eng, _rec, _spool = _engine(tmp_path, clock, cooldown_s=30.0)
+    assert eng.fire("drift") is not None
+    clock.advance(5)
+    assert eng.fire("drift") is None  # suppressed
+    assert eng.suppressed == 1
+    assert eng.fire("slo_burn") is not None  # per-reason cooldowns
+    assert eng.fire("drift", force=True) is not None
+    clock.advance(31)
+    assert eng.fire("drift") is not None
+    assert eng.trigger_counts["drift"] == 3
+
+
+def test_trigger_unknown_reason_coerced_to_manual(tmp_path):
+    clock = FakeClock(1.0)
+    eng, _rec, spool = _engine(tmp_path, clock)
+    name = eng.fire("nonsense")
+    assert "-manual-" in name
+    assert spool.read(name)["reason"] == "manual"
+
+
+def test_trigger_signal_edges_fire_once_with_priming(tmp_path):
+    """First snapshot only baselines: an engine restarted mid-incident
+    must not fire on pre-existing state. Each edge fires exactly once
+    until it resets and crosses again."""
+    clock = FakeClock(5000.0)
+    bus = FakeBus()
+    eng, rec, _spool = _engine(
+        tmp_path, clock, signals=bus, slo_burn_threshold=2.0,
+        cooldown_s=0.0,
+    )
+    bus.sig = ControlSignals(ts=clock(), slo_burn_5m=5.0,
+                             device_backed=1)
+    eng.tick()  # priming tick: burn already high, no fire
+    assert eng.trigger_counts["slo_burn"] == 0
+    eng.tick()  # still high: no NEW edge
+    assert eng.trigger_counts["slo_burn"] == 0
+    bus.sig = ControlSignals(ts=clock(), slo_burn_5m=0.5, device_backed=1)
+    eng.tick()
+    bus.sig = ControlSignals(ts=clock(), slo_burn_5m=3.0, device_backed=1)
+    eng.tick()  # rising edge
+    assert eng.trigger_counts["slo_burn"] == 1
+    # drift flip edge
+    bus.sig = ControlSignals(ts=clock(), model_drift=1, device_backed=1)
+    eng.tick()
+    assert eng.trigger_counts["drift"] == 1
+    # device-backed falling edge
+    bus.sig = ControlSignals(ts=clock(), device_backed=0)
+    eng.tick()
+    assert eng.trigger_counts["device_probe"] == 1
+    # snapshots were ringed alongside
+    assert rec.signal_snapshots >= 5
+
+
+def test_trigger_event_deltas_fire(tmp_path):
+    clock = FakeClock(6000.0)
+    ev = FakeEvents()
+    ev._counts = {"breaker_open": 2, "resize_abort": 1}
+    eng, _rec, spool = _engine(tmp_path, clock, events=ev,
+                               cooldown_s=0.0)
+    eng.tick()  # priming: pre-existing counts are baseline
+    assert eng.trigger_counts["breaker_open"] == 0
+    ev._counts = {"breaker_open": 3, "resize_abort": 1}
+    eng.tick()
+    assert eng.trigger_counts["breaker_open"] == 1
+    assert eng.trigger_counts["resize_abort"] == 0
+    bundle = spool.read(eng.last_bundle)
+    assert bundle["reason"] == "breaker_open"
+    assert bundle["note"] == "pod event breaker_open"
+
+
+def test_trigger_collects_peer_rings(tmp_path):
+    clock = FakeClock(7000.0)
+    peer_rec = FlightRecorder(sample_stride=1, host_id=1, clock=clock)
+    peer_rec.tap(0.004, "lean", request_id="peer-r1")
+    lane = FakeLane({1: lambda: peer_rec.contribute(),
+                     2: OSError("connect refused")})
+    eng, _rec, spool = _engine(tmp_path, clock, lane=lane,
+                               peer_retry_s=0.0)
+    name = eng.fire("manual")
+    bundle = spool.read(name)
+    assert bundle["peers"]["1"]["host"] == 1
+    assert bundle["peers"]["1"]["exemplars"][0]["request_id"] == "peer-r1"
+    assert "error" in bundle["peers"]["2"]
+    assert eng.peer_rings == 1
+    # the lane request carries the window and epoch for correlation
+    host, payload = lane.calls[0]
+    assert payload["kind"] == "flight"
+    assert payload["t1"] == 7000.0
+
+
+def test_trigger_retries_dead_peer_and_patches_bundle(tmp_path):
+    """The chaos shape: peer 1 is DOWN at fire time (error entry in
+    the bundle on disk), comes back, and the next poll tick patches
+    the persisted bundle in place with its rings."""
+    clock = FakeClock(8000.0)
+    down = {"state": "down"}
+    lane = FakeLane({1: OSError("peer down")})
+    eng, _rec, spool = _engine(tmp_path, clock, lane=lane,
+                               peer_retry_s=60.0)
+    name = eng.fire("breaker_open")
+    assert "error" in spool.read(name)["peers"]["1"]
+    assert eng.flight_debug()["pending_peers"] == 1
+    clock.advance(1)
+    eng.tick()  # still down
+    assert eng.flight_debug()["pending_peers"] == 1
+    # peer restarts and has served traffic again
+    back = FlightRecorder(sample_stride=1, host_id=1, clock=clock)
+    back.tap(0.002, "lean", request_id="post-restart")
+    lane.peers[1] = lambda: back.contribute()
+    clock.advance(1)
+    eng.tick()
+    patched = spool.read(name)["peers"]["1"]
+    assert patched["exemplars"][0]["request_id"] == "post-restart"
+    assert eng.flight_debug()["pending_peers"] == 0
+    assert down["state"] == "down"  # unused sentinel, keeps intent clear
+
+
+def test_trigger_retry_deadline_lapses(tmp_path):
+    clock = FakeClock(9000.0)
+    lane = FakeLane({1: OSError("peer down")})
+    eng, _rec, _spool = _engine(tmp_path, clock, lane=lane,
+                                peer_retry_s=10.0)
+    eng.fire("manual")
+    assert eng.flight_debug()["pending_peers"] == 1
+    clock.advance(11)
+    eng.tick()
+    assert eng.flight_debug()["pending_peers"] == 0
+
+
+def test_trigger_thread_lifecycle(tmp_path):
+    eng, rec, _spool = _engine(tmp_path, FakeClock(),
+                               signals=FakeBus(),
+                               poll_interval_s=0.01)
+    eng.start()
+    try:
+        deadline = 50
+        while rec.signal_snapshots == 0 and deadline:
+            import time as _t
+
+            _t.sleep(0.01)
+            deadline -= 1
+        assert rec.signal_snapshots > 0
+    finally:
+        eng.stop()
+        eng.join(timeout=2.0)
+    assert not eng.is_alive()
+
+
+def test_trigger_prometheus_poll(tmp_path):
+    from limitador_tpu.observability import PrometheusMetrics
+
+    clock = FakeClock(9500.0)
+    eng, rec, _spool = _engine(tmp_path, clock)
+    for _ in range(5):
+        rec.tap(0.001, "lean")
+    eng.fire("manual", force=True)
+    metrics = PrometheusMetrics()
+    metrics.attach_render_hook(rec)
+    body = metrics.render().decode()
+    assert "flight_taps 5.0" in body
+    assert 'flight_triggers_total{reason="manual"} 1.0' in body
+    assert "flight_bundles 1.0" in body
+    # render twice: cumulative counts must not double-increment
+    body = metrics.render().decode()
+    assert 'flight_triggers_total{reason="manual"} 1.0' in body
+    # every TRIGGER_REASONS label is pre-seeded (dashboards see zeros)
+    for reason in TRIGGER_REASONS:
+        assert f'reason="{reason}"' in body
+
+
+# -- HTTP surface ------------------------------------------------------------
+
+
+def _http_round_trip(coro_fn):
+    import asyncio
+
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro_fn())
+    finally:
+        loop.close()
+
+
+def _flight_app(tmp_path, clock=None):
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    clock = clock or FakeClock(10_000.0)
+    rec = FlightRecorder(sample_stride=1, clock=clock)
+    spool = BundleSpool(tmp_path / "spool")
+    eng = TriggerEngine(rec, spool, clock=clock)
+    app = make_http_app(RateLimiter(), None, {}, debug_sources=[eng])
+    return app, eng, rec
+
+
+def test_http_flight_endpoints(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    app, eng, rec = _flight_app(tmp_path)
+    rec.tap(0.003, "lean", request_id="h1", namespace="api")
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        out = {}
+        resp = await client.get("/debug/flight")
+        out["empty"] = (resp.status, await resp.json())
+        resp = await client.post(
+            "/debug/flight/trigger", json={"note": "from http"}
+        )
+        out["trigger"] = (resp.status, await resp.json())
+        resp = await client.get("/debug/flight")
+        out["list"] = (resp.status, await resp.json())
+        name = out["trigger"][1]["bundle"]
+        resp = await client.get("/debug/flight", params={"name": name})
+        out["bundle"] = (resp.status, await resp.json())
+        resp = await client.get(
+            "/debug/flight", params={"name": "no-such-bundle.json"}
+        )
+        out["missing"] = resp.status
+        resp = await client.post(
+            "/debug/flight/trigger", json={"note": 42}
+        )
+        out["bad_note"] = resp.status
+        resp = await client.get("/debug/stats")
+        out["stats"] = await resp.json()
+        await client.close()
+        return out
+
+    out = _http_round_trip(main)
+    assert out["empty"] == (200, {"bundles": []})
+    status, fired = out["trigger"]
+    assert status == 200 and fired["fired"] is True
+    assert fired["bundle"].startswith("flight-")
+    status, listing = out["list"]
+    assert status == 200
+    assert [b["name"] for b in listing["bundles"]] == [fired["bundle"]]
+    status, bundle = out["bundle"]
+    assert status == 200
+    assert bundle["reason"] == "manual"
+    assert bundle["note"] == "from http"
+    assert bundle["local"]["exemplars"][0]["request_id"] == "h1"
+    assert out["missing"] == 404
+    assert out["bad_note"] == 400
+    assert out["stats"]["flight"]["triggers"]["manual"] == 1
+
+
+def test_http_flight_404_when_recorder_off(tmp_path):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    app = make_http_app(RateLimiter(), None, {})
+
+    async def main():
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        get = await client.get("/debug/flight")
+        post = await client.post("/debug/flight/trigger")
+        await client.close()
+        return get.status, post.status
+
+    assert _http_round_trip(main) == (404, 404)
+
+
+def test_api_spec_covers_flight_endpoints():
+    from limitador_tpu.server.http_api import _openapi_spec
+
+    spec = _openapi_spec()
+    assert "get" in spec["paths"]["/debug/flight"]
+    trigger = spec["paths"]["/debug/flight/trigger"]
+    assert "post" in trigger
+    body = trigger["post"]["requestBody"]["content"]["application/json"]
+    assert set(body["schema"]["properties"]) == {"note", "profile"}
+
+
+# -- the drill (`make flight-drill`) -----------------------------------------
+
+
+def test_flight_drill_manual_trigger_under_live_traffic(tmp_path):
+    """The flight-drill round trip: live decisions flow through a real
+    RateLimiter with the recorder tapped in, the manual trigger fires
+    over POST /debug/flight/trigger, and the bundle both lists on
+    GET /debug/flight and serves back verbatim carrying exemplars and
+    worst-K tails from the traffic window."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from limitador_tpu import Limit, RateLimiter
+    from limitador_tpu.server.http_api import make_http_app
+
+    rec = FlightRecorder(sample_stride=4)
+    spool = BundleSpool(tmp_path / "spool")
+    eng = TriggerEngine(rec, spool, window_s=60.0)
+    limiter = RateLimiter()
+    limiter.add_limit(
+        Limit("drill", 10**6, 60, [], ["descriptors[0].u"])
+    )
+    app = make_http_app(limiter, None, {}, debug_sources=[eng])
+
+    async def main():
+        import time as _t
+
+        client = TestClient(TestServer(app))
+        await client.start_server()
+        # live traffic: every decision taps the recorder
+        for i in range(200):
+            t0 = _t.perf_counter()
+            resp = await client.post("/check", json={
+                "namespace": "drill",
+                "values": {"u": f"user-{i % 8}"},
+                "delta": 1,
+            })
+            assert resp.status == 200
+            rec.tap(_t.perf_counter() - t0, "lean",
+                    request_id=f"drill-{i}", namespace="drill")
+        resp = await client.post("/debug/flight/trigger",
+                                 json={"note": "flight drill"})
+        fired = await resp.json()
+        assert resp.status == 200
+        resp = await client.get("/debug/flight")
+        listing = await resp.json()
+        resp = await client.get("/debug/flight",
+                                params={"name": fired["bundle"]})
+        bundle = await resp.json()
+        await client.close()
+        return fired, listing, bundle
+
+    fired, listing, bundle = _http_round_trip(main)
+    assert fired["fired"] is True
+    assert any(
+        b["name"] == fired["bundle"] for b in listing["bundles"]
+    ), "triggered bundle must list on GET /debug/flight"
+    assert bundle["reason"] == "manual"
+    assert bundle["note"] == "flight drill"
+    local = bundle["local"]
+    assert len(local["exemplars"]) >= 200 // 4, (
+        "bundle must carry sampled exemplars from the traffic window"
+    )
+    assert all(e["namespace"] == "drill" for e in local["exemplars"])
+    assert local["worst"]["lean"], "worst-K tail must be retained"
+    assert local["counts"]["exemplars_total"] == rec.exemplars
+    # bundle is self-contained JSON: a copy parses stand-alone
+    assert json.loads(json.dumps(bundle)) == bundle
+
+
+def test_flight_drill_bundle_survives_spool_round_trip(tmp_path):
+    """Drill tail: the bundle on disk IS the served bundle — byte-level
+    spool integrity under a concurrent retention pass."""
+    spool = BundleSpool(tmp_path, max_bundles=4)
+    rec = FlightRecorder(sample_stride=1)
+    eng = TriggerEngine(rec, spool, clock=FakeClock(12_000.0))
+    for i in range(6):
+        rec.tap(0.001, "lean", request_id=f"d{i}")
+        eng.fire("manual", force=True)
+        eng._clock.advance(1)
+
+    names = [b["name"] for b in eng.flight_bundles()]
+    assert len(names) == 4  # retention enforced during the drill
+    served = eng.flight_bundle(names[0])
+    on_disk = json.load(open(tmp_path / names[0]))
+    assert served == on_disk
+
+
+# -- satellite surfaces: metrics exemplars + tracing head sampling ----------
+
+
+def test_metrics_exemplars_openmetrics_exposition():
+    """``--metrics-exemplars on``: tail-bucket latency observations
+    made with a trace id in context render an OpenMetrics exemplar;
+    the default exposition stays byte-identical classic text."""
+    from limitador_tpu.observability import tracing
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+
+    plain = PrometheusMetrics()
+    assert "openmetrics" not in plain.content_type
+    plain._observe_datastore_latency(0.5)
+    assert b"# {" not in plain.render()
+
+    armed = PrometheusMetrics()
+    armed.enable_exemplars(min_seconds=0.025)
+    assert "openmetrics" in armed.content_type
+    tracing.adopt_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    try:
+        armed._observe_datastore_latency(0.5)    # tail bucket: exemplar
+        armed._observe_datastore_latency(0.001)  # below min_s: plain
+    finally:
+        tracing._adopted_trace_id.set(None)  # don't leak into later tests
+    body = armed.render().decode()
+    exemplar_lines = [l for l in body.splitlines() if "# {" in l]
+    assert len(exemplar_lines) == 1, body
+    assert 'trace_id="' + "ab" * 16 + '"' in exemplar_lines[0]
+    assert body.rstrip().endswith("# EOF")
+
+
+def test_metrics_exemplar_needs_trace_context():
+    from limitador_tpu.observability import tracing
+    from limitador_tpu.observability.metrics import PrometheusMetrics
+
+    armed = PrometheusMetrics()
+    armed.enable_exemplars()
+    # no trace id and no request id in this context: the observation
+    # must land plainly, never be dropped
+    tracing._adopted_trace_id.set(None)
+    armed._observe_datastore_latency(0.5)
+    body = armed.render().decode()
+    assert "# {" not in body
+    assert 'datastore_latency_bucket{le="0.5"} 1.0' in body
+
+
+def test_tracing_head_sampling_stride():
+    """``--tracing-sample-rate``: 1.0 keeps every root span (the
+    default), 0.0 none, 0.25 one in four; children inherit the root's
+    verdict within the context."""
+    from limitador_tpu.observability import tracing
+
+    try:
+        tracing.set_sample_rate(1.0)
+        assert all(tracing._head_decision() for _ in range(8))
+        tracing.set_sample_rate(0.0)
+        assert not any(tracing._head_decision() for _ in range(8))
+        assert not tracing._span_sampled()  # child follows the root
+        tracing.set_sample_rate(0.25)
+        kept = sum(tracing._head_decision() for _ in range(100))
+        assert kept in (25, 26)  # 1-in-4 stride, phase-dependent edge
+        tracing.set_sample_rate(7.5)  # clamped
+        assert tracing.sample_rate() == 1.0
+        assert tracing._span_sampled()
+    finally:
+        tracing.set_sample_rate(1.0)  # module global: restore
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

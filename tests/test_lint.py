@@ -79,6 +79,46 @@ def test_metric_registry_lint_is_clean_and_catches_drift(tmp_path):
                for f in findings)
 
 
+def test_docs_sync_lint_is_clean_and_catches_drift(tmp_path):
+    """Every event kind, registered metric family and /debug endpoint
+    must appear in docs/observability.md (ISSUE 16) — and the lint must
+    catch each undocumented-surface direction on a synthetic tree while
+    exempting trees without the doc."""
+    from limitador_tpu.tools.lint import lint_docs_sync
+
+    assert lint_docs_sync(REPO_ROOT) == []
+
+    pkg = tmp_path / "limitador_tpu"
+    (pkg / "observability").mkdir(parents=True)
+    (pkg / "server").mkdir()
+    (pkg / "observability" / "events.py").write_text(
+        "EVENT_KINDS = ('peer_up', 'undocumented_kind')\n"
+    )
+    (pkg / "observability" / "flight.py").write_text(
+        "METRIC_FAMILIES = ('flight_taps', 'flight_undocumented')\n"
+    )
+    (pkg / "server" / "http_api.py").write_text(
+        "def make_app(app, api):\n"
+        "    app.router.add_get('/debug/stats', api.s)\n"
+        "    app.router.add_post('/debug/undocumented', api.u)\n"
+    )
+    # no doc at all -> exempt (synthetic lint fixtures must stay clean)
+    assert lint_docs_sync(tmp_path) == []
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "observability.md").write_text(
+        "`peer_up` events, the `flight_taps` family and "
+        "`GET /debug/stats`.\n"
+    )
+    findings = lint_docs_sync(tmp_path)
+    assert any("undocumented_kind" in f for f in findings)
+    assert any("flight_undocumented" in f for f in findings)
+    assert any("/debug/undocumented" in f for f in findings)
+    assert not any("peer_up" in f for f in findings)
+    assert not any("'flight_taps'" in f for f in findings)
+    assert not any("'/debug/stats'" in f for f in findings)
+
+
 def test_donation_lint_is_clean_and_catches_missing_donation(tmp_path):
     """Every table-carrying jax.jit kernel in the repo donates its
     buffers — and the lint must actually flag a site that stops

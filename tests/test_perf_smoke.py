@@ -126,6 +126,15 @@ MODEL_FIT_BUDGET_MS = 50.0
 #: payload); a rewrite that consults the epoch per ROW measures in the
 #: µs and blows this immediately.
 RESIZE_EPOCH_GATE_BUDGET_NS = 2500.0
+#: per-decision budget for the flight-recorder tap at the DEFAULT
+#: sample stride (ns, ISSUE 16): the common path is a counter bump,
+#: a stride modulo and one unlocked tail-floor read — no lock, no
+#: entry allocation. Measured ~310 ns on this box at stride 64; the
+#: stride-1 path (every decision sampled, lock + dict build) runs
+#: ~2 µs and must never become the default. A tap that resolves the
+#: trace id or topology epoch BEFORE the sampling decision blows
+#: this immediately.
+FLIGHT_TAP_BUDGET_NS = 2000.0
 
 
 def _blobs(n, users=512):
@@ -807,6 +816,40 @@ def test_resize_epoch_gate_within_budget():
         f"epoch gate costs {per_call_ns:.0f} ns/payload "
         f"(budget {RESIZE_EPOCH_GATE_BUDGET_NS} ns — did per-row work "
         "or a lock sneak into the forward-path epoch check?)"
+    )
+
+
+def test_flight_tap_within_budget():
+    """ISSUE 16: the always-on flight-recorder tap rides EVERY decision
+    on every lane, so at the default sampling stride its common path
+    must stay two counter reads — unsampled, below the lane tail floor,
+    no lock taken. Providers (trace id, topology epoch) are attached to
+    prove they are only consulted after the sampling decision."""
+    from limitador_tpu.observability.flight import (
+        DEFAULT_SAMPLE_STRIDE,
+        FlightRecorder,
+    )
+
+    rec = FlightRecorder(sample_stride=DEFAULT_SAMPLE_STRIDE)
+    rec.epoch_provider = lambda: 1
+    rec.trace_provider = lambda: "0123456789abcdef"
+    # saturate the lean-lane worst-K heap so the floor gate is active
+    # (steady-state shape: most taps fall below the retained tail)
+    for i in range(64):
+        rec.tap(1.0 + i, "lean")
+    n = 20000
+    best = float("inf")
+    for _pass in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            rec.tap(0.0001, "lean")
+        best = min(best, time.perf_counter() - t0)
+    per_tap_ns = best / n * 1e9
+    assert per_tap_ns <= FLIGHT_TAP_BUDGET_NS, (
+        f"flight tap costs {per_tap_ns:.0f} ns/decision "
+        f"(budget {FLIGHT_TAP_BUDGET_NS} ns — did a lock, an entry "
+        "allocation or a provider call sneak ahead of the sampling "
+        "decision?)"
     )
 
 

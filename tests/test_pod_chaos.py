@@ -651,9 +651,20 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
     unavailable answers through the whole partition window), and after
     restart + journal replay the owner's final counter state equals the
     single-process oracle for every key born inside the window — the
-    pre-partition key bounded by one extra window budget."""
+    pre-partition key bounded by one extra window budget.
+
+    ISSUE 16 rides the same drill: the breaker-open crossing must
+    auto-produce a flight-recorder incident bundle carrying the
+    degraded window's decision exemplars, and the SIGKILLed peer — dead
+    at exactly the moment the bundle fires — must patch its rings into
+    the persisted bundle once it restarts and serves again."""
     pytest.importorskip("grpc")
     from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.observability.flight import (
+        BundleSpool,
+        FlightRecorder,
+        TriggerEngine,
+    )
     from limitador_tpu.server.peering import PeerLane, PodFrontend
     from limitador_tpu.storage.in_memory import InMemoryStorage
 
@@ -682,6 +693,19 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
         resilience=cfg,
     )
     asyncio.run(frontend.configure_with(chaos_limits()))
+
+    # ISSUE 16: the drill runs under the flight recorder — the SIGKILL
+    # must auto-produce a pod-correlated incident bundle. stride 1 so
+    # the short drill's every decision is evidence; ticks are driven
+    # inline (no engine thread) to keep the drill deterministic.
+    flight = FlightRecorder(sample_stride=1, host_id=0)
+    frontend.attach_flight_recorder(flight)
+    spool = BundleSpool(tmp_path / "flight-spool")
+    engine = TriggerEngine(
+        flight, spool, events=frontend.events, lane=lane,
+        window_s=120.0, cooldown_s=0.0, peer_retry_s=120.0,
+    )
+    engine.tick()  # priming tick: baseline the event counts
 
     def check(user):
         return asyncio.run(frontend.check_rate_limited_and_update(
@@ -726,6 +750,30 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
             assert admitted_b[user] == CHAOS_MAX
         assert admitted_b[pre_user] == CHAOS_MAX  # stand-in starts empty
 
+        # ISSUE 16: the breaker-open event auto-fires an incident
+        # bundle on the next trigger tick — reason breaker_open, the
+        # degraded window's decisions in the local rings, and the dead
+        # peer queued for a ring retry (error entry patched in place
+        # once the worker is back)
+        engine.tick()
+        assert engine.trigger_counts["breaker_open"] == 1
+        bundle_name = engine.last_bundle
+        assert bundle_name is not None
+        bundle = spool.read(bundle_name)
+        assert bundle["reason"] == "breaker_open"
+        local_lanes = {e["lane"] for e in bundle["local"]["exemplars"]}
+        assert "degraded" in local_lanes, (
+            "bundle must carry degraded-window decision exemplars"
+        )
+        assert "pod_forward" in local_lanes, (
+            "bundle must carry forwarded-decision exemplars"
+        )
+        assert any(
+            e["kind"] == "breaker_open" for e in bundle["events"]
+        )
+        assert "error" in bundle["peers"]["1"]  # dead at fire time
+        assert engine.flight_debug()["pending_peers"] == 1
+
         # the owner restarts on the SAME address (fresh process, empty
         # store — the journal replay must rebuild the window)
         proc2, stop2, out2 = _spawn_chaos_worker(tmp_path, port, "b")
@@ -748,22 +796,29 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
 
         # ISSUE 12: the drill's whole failover cycle is on the typed
         # event timeline in causal order, replay counts matching the
-        # journaled counter set
+        # journaled counter set. The probe loop may legitimately
+        # ATTEMPT (and fail) a replay while the peer is still dead —
+        # ok=False, replayed=0, journal restored — so the causal chain
+        # is anchored on the SUCCESSFUL replay, not the first attempt.
         events = frontend.events_debug()["events"]
         first = {}
         for event in events:
             first.setdefault(event["kind"], event)
-        seq = {k: e["seq"] for k, e in first.items()}
+        ok_end = next(
+            e for e in events
+            if e["kind"] == "journal_replay_end" and e["detail"]["ok"]
+        )
+        ok_begin = [
+            e for e in events
+            if e["kind"] == "journal_replay_begin"
+            and e["seq"] < ok_end["seq"]
+        ][-1]
         assert (
-            seq["degraded_enter"] < seq["journal_replay_begin"]
-            < seq["journal_replay_end"] < seq["degraded_exit"]
-        ), seq
-        assert first["journal_replay_begin"]["detail"]["journal"] == len(
-            owned
-        )
-        assert first["journal_replay_end"]["detail"]["replayed"] == len(
-            owned
-        )
+            first["degraded_enter"]["seq"] < ok_begin["seq"]
+            < ok_end["seq"] < first["degraded_exit"]["seq"]
+        ), [(e["kind"], e["seq"]) for e in events]
+        assert ok_begin["detail"]["journal"] == len(owned)
+        assert ok_end["detail"]["replayed"] == len(owned)
 
         # phase C (recovered): the owner now enforces the replayed
         # window — every forwarded check is limited, served by the
@@ -774,6 +829,21 @@ def test_pod_chaos_drill_kill_restart_reconcile(tmp_path):
         assert frontend.resilience_stats()[
             "pod_failover_degraded_decisions"
         ] == degraded_before
+
+        # ISSUE 16: the restarted worker has served again (phase C),
+        # so the pending ring retry now patches the bundle on disk —
+        # the autopsy completes with a non-error peer contribution
+        # (post-restart evidence rides the window-independent worst-K
+        # tails)
+        engine.tick()
+        patched = spool.read(bundle_name)["peers"]["1"]
+        assert "error" not in patched, patched
+        assert patched["host"] == 1
+        assert any(patched["worst"].values()), (
+            "restarted peer must contribute owner-side decision tails"
+        )
+        assert engine.flight_debug()["pending_peers"] == 0
+        assert engine.peer_rings >= 1
 
         # graceful stop -> the owner dumps its final counter state
         stop2.write_text("")
