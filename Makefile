@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill tier-soak
 
 check: lint test
 
@@ -65,6 +65,16 @@ flight-drill:
 
 bench:
 	python bench.py
+
+# Tiered-storage soak (ISSUE 17): the migration-churn fuzz (byte-exact
+# decision + final-state parity vs the single-tier oracle, including
+# the kill-mid-migration abort rounds) followed by the large-keyspace
+# bench sweep — 1M/10M/100M logical keys over a fixed device table,
+# reporting per-tier p50/p99 and the device-p99 flatness headline.
+# Pass BENCH_TIER_DECISIONS to change the sweep's decision bound.
+tier-soak:
+	python -m pytest tests/test_tier_fuzz.py -q
+	python bench.py --config tiered
 
 # Bench trajectory (ISSUE 14): read every BENCH_r*.json round capture,
 # normalize headline rates by box_calibration_score (the r1-rN boxes

@@ -284,6 +284,164 @@ def bench_flight():
         )
 
 
+def bench_tiered(require_device: bool = False):
+    """ISSUE 17: tiered storage under the large-keyspace regime. Sweeps
+    the logical keyspace across three decades (1M / 10M / 100M keys)
+    against a FIXED device table: a Zipf-distributed batched decision
+    stream — only touched keys materialize, so the stream length is the
+    honest coverage bound and rides every row as ``decision_bound`` —
+    with TierManager rounds interleaved so heat promotes the working
+    set device-side while the LRU tail demotes exactly into the cold
+    tier. Per-keyspace rows report the device/cold resident split, the
+    cold share of decisions and the per-tier per-decision p50/p99; the
+    final row is the headline claim — the device-resident p99 stays
+    flat while the keyspace grows 100x past device capacity."""
+    import os
+
+    from limitador_tpu import Limit
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.tier import TieredStorage, TierManager
+    from limitador_tpu.tpu.storage import _Request
+
+    device_ok = _device_available(
+        window_s=float(os.environ.get("BENCH_PROBE_WINDOW_S", "60"))
+    )
+    _record_device_probe(
+        "tiered sweep" if device_ok else
+        "tiered sweep: CPU fallback"
+        + (" refused by --require-device" if require_device
+           else " accepted; sweep runs on CPU")
+    )
+    if not device_ok and require_device:
+        print(
+            "ERROR: --require-device: device backend unavailable — "
+            "refusing to record CPU numbers as a tiered device round. "
+            "See the DEVICE_PROBES log.",
+            file=sys.stderr,
+        )
+        sys.exit(3)
+
+    decisions = int(os.environ.get("BENCH_TIER_DECISIONS", "40000"))
+    batch = 256
+    # Device table sized WELL below the stream's unique-key count so the
+    # tail must spill cold whatever the decision bound is set to.
+    cache_size = max(256, min(1 << 13, decisions // 8))
+    capacity = cache_size * 2
+    limit = Limit("ns", 10**9, 60, [], ["u"])
+    rng = np.random.default_rng(17)
+    device_p99_by_keyspace = {}
+    for keyspace in (1_000_000, 10_000_000, 100_000_000):
+        storage = TieredStorage(capacity=capacity, cache_size=cache_size)
+        mgr = TierManager(storage, interval_s=3600.0, batch=1024)
+        # Zipf ranks folded into the keyspace: a heavy head that fits
+        # the device table plus a long tail that must spill cold.
+        keys = (rng.zipf(1.1, size=decisions) - 1) % keyspace
+        # Untimed warmup, structurally identical to the timed loop
+        # (same batch shape, same interleaved manager rounds): compiles
+        # the check/evict/peek/seed kernels and fills the table so the
+        # timed phase measures steady-state churn.
+        warm = (rng.zipf(1.1, size=16 * batch) - 1) % keyspace
+        for off in range(0, warm.size, batch):
+            storage.check_many([
+                _Request([Counter(limit, {"u": str(int(k))})], 1, False)
+                for k in warm[off:off + batch]
+            ])
+            if (off // batch) % 8 == 7:
+                mgr.run_once()
+        # Cold hits shrink a batch's device half, so the mixed stream
+        # produces every pow2 launch bucket up to the batch size —
+        # compile them all now (Zipf head keys are device-resident).
+        size = 1
+        while size <= batch:
+            storage.check_many([
+                _Request([Counter(limit, {"u": str(i)})], 1, False)
+                for i in range(size)
+            ])
+            size *= 2
+        storage.drain_cold_decide_samples()
+        device_per_dec = []
+        cold_per_dec = []
+        cold_total = 0
+        t0 = time.perf_counter()
+        for off in range(0, decisions, batch):
+            chunk = keys[off:off + batch]
+            reqs = [
+                _Request([Counter(limit, {"u": str(int(k))})], 1, False)
+                for k in chunk
+            ]
+            c0 = storage._cold.decisions
+            storage.drain_cold_decide_samples()
+            b0 = time.perf_counter()
+            storage.check_many(reqs)
+            bdt = time.perf_counter() - b0
+            cold_n = storage._cold.decisions - c0
+            cold_total += cold_n
+            cold_dt = sum(storage.drain_cold_decide_samples())
+            if cold_n:
+                cold_per_dec.append(cold_dt / cold_n)
+            dev_n = len(chunk) - cold_n
+            if dev_n:
+                device_per_dec.append(max(bdt - cold_dt, 0.0) / dev_n)
+            if (off // batch) % 8 == 7:
+                mgr.run_once()
+        wall = time.perf_counter() - t0
+        mgr.run_once()
+        stats = storage.tier_stats()
+        touched = int(np.unique(keys).size)
+        dev_us = np.asarray(device_per_dec) * 1e6
+        cold_us = np.asarray(cold_per_dec) * 1e6
+        dev_p50 = float(np.percentile(dev_us, 50)) if dev_us.size else 0.0
+        dev_p99 = float(np.percentile(dev_us, 99)) if dev_us.size else 0.0
+        cold_p50 = float(np.percentile(cold_us, 50)) if cold_us.size else 0.0
+        cold_p99 = float(np.percentile(cold_us, 99)) if cold_us.size else 0.0
+        device_p99_by_keyspace[keyspace] = dev_p99
+        print(
+            f"tiered @ {keyspace/1e6:.0f}M keys: "
+            f"{decisions/wall/1e3:.1f}k decisions/s, "
+            f"{touched} touched ({stats['device_resident']} device / "
+            f"{stats['cold']['resident']} cold resident), "
+            f"cold share {cold_total/decisions:.1%}, "
+            f"device p99 {dev_p99:.1f}us, cold p99 {cold_p99:.1f}us, "
+            f"{mgr.promoted} promoted / {mgr.demoted} demoted",
+            file=sys.stderr,
+        )
+        emit(
+            "tiered_decisions_per_sec", decisions / wall, "decisions/s",
+            1e5, keyspace=keyspace, decision_bound=decisions,
+            touched_keys=touched,
+            device_resident=stats["device_resident"],
+            cold_resident=stats["cold"]["resident"],
+            resident_share=round(
+                stats["device_resident"] / max(touched, 1), 4
+            ),
+            cold_share=round(cold_total / decisions, 4),
+            device_decide_p50_us=round(dev_p50, 2),
+            device_decide_p99_us=round(dev_p99, 2),
+            cold_decide_p50_us=round(cold_p50, 2),
+            cold_decide_p99_us=round(cold_p99, 2),
+            migrations_promoted=mgr.promoted,
+            migrations_demoted=mgr.demoted,
+        )
+        mgr.close()
+        storage.close()
+    # The headline: device-resident per-decision p99 across the sweep,
+    # worst/best ratio (1.0 = perfectly flat across 100x keyspace).
+    p99s = [v for v in device_p99_by_keyspace.values() if v > 0]
+    flatness = (max(p99s) / min(p99s)) if p99s else 0.0
+    print(
+        f"tiered device p99 flatness across 1M->100M keys: "
+        f"{flatness:.2f}x (1.0 = flat)",
+        file=sys.stderr,
+    )
+    emit(
+        "tiered_device_p99_flatness", flatness, "ratio", 2.0,
+        ndigits=3, lower_is_better=True,
+        device_p99_us_by_keyspace={
+            str(k): round(v, 2) for k, v in device_p99_by_keyspace.items()
+        },
+    )
+
+
 class _LatencySink:
     """Duck-typed metrics object for the batcher: collects the
     queue-excluded per-request device round-trip (the datastore
@@ -2857,7 +3015,7 @@ def main():
         default="device",
         choices=["device", "memory", "pipeline", "native", "lease",
                  "tenants", "sharded", "backends", "grpc", "fleet",
-                 "onbox", "pod", "flight"],
+                 "onbox", "pod", "flight", "tiered"],
     )
     # internal: one process of the pod sweep (spawned by bench_pod)
     parser.add_argument("--pod-worker-id", type=int, default=None,
@@ -2912,6 +3070,8 @@ def main():
         return bench_onbox()
     if args.config == "flight":
         return bench_flight()
+    if args.config == "tiered":
+        return bench_tiered(require_device=args.require_device)
 
     # End-to-end gRPC latency evidence rides along with the headline
     # (device) run only. It runs FIRST — before this process initializes

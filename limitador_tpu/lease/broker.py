@@ -476,6 +476,32 @@ class LeaseBroker:
                     out[slot] = out.get(slot, 0) + tokens * d
         return out
 
+    def reclaim_slots(self, slots) -> int:
+        """Revoke every lease touching ``slots`` and credit the
+        unconsumed tokens back through the floor-guarded columnar lane
+        — the tier demotion pre-pass (tier/manager.py): settling while
+        the slot identity still matches means a demoted counter strands
+        no phantom quota and its slot's next tenant pays no dead debit.
+        Lock order broker -> native -> storage, same as refresh."""
+        doomed = set(slots)
+        pipeline = self.pipeline
+        with self._lock:
+            returns: List[Tuple[int, int]] = []
+            with pipeline._native_lock:
+                lane = pipeline._hot_lane
+                if lane is None:
+                    return 0
+                for lease_id, lease in list(self._leases.items()):
+                    if not any(h[0] in doomed for h in lease.hits):
+                        continue
+                    remaining = lane.lease_revoke(lease.blob, lease_id)
+                    if remaining > 0:
+                        returns.append((lease_id, remaining))
+                    else:
+                        # consumed to zero or settled by a racing drain
+                        self._leases.pop(lease_id, None)
+            return self._settle(returns)
+
     def stats(self) -> dict:
         """Cumulative lease-tier stats: C consume counters (carried
         across context swaps) + Python grant/settle counters. Shaped

@@ -83,6 +83,11 @@ EVENT_KINDS = (
     "migrate_end",
     "resize_end",
     "resize_abort",
+    # tiered storage (ISSUE 17): one TierManager migration round moved
+    # counters between the device hot set and the host cold tier
+    # (detail carries promoted/demoted counts, backlog and the
+    # model-priced benefit of the round)
+    "tier_migration",
 )
 
 

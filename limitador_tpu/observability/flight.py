@@ -72,8 +72,9 @@ METRIC_FAMILIES = (
 
 #: the serving lanes one decision can ride, in tap order: the
 #: zero-Python native hot lane, the lean batched device path, a pod
-#: forward (either side of the hop), and the degraded-owner stand-in.
-FLIGHT_LANES = ("native_hot", "lean", "pod_forward", "degraded")
+#: forward (either side of the hop), the degraded-owner stand-in, and
+#: a cold-tier decide (exact host cell for a non-resident key).
+FLIGHT_LANES = ("native_hot", "lean", "pod_forward", "degraded", "cold_tier")
 
 #: the closed trigger-reason set (bounded Prometheus label values)
 TRIGGER_REASONS = (

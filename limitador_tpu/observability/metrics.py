@@ -1119,6 +1119,56 @@ class PrometheusMetrics:
             "table on breaker recovery (apply_deltas reconcile)",
             registry=self.registry,
         )
+        # -- tiered storage (ISSUE 17): device-resident hot set over
+        # the exact host cold tier. Family names are registered in
+        # tier.METRIC_FAMILIES (lint cross-checked); fed by the
+        # TierManager's render hook.
+        self.tier_resident = Gauge(
+            "tier_resident",
+            "Counters resident per storage tier (device = slot-table "
+            "occupancy, cold = exact host cells)",
+            ["tier"],
+            registry=self.registry,
+        )
+        self.tier_migrations = Counter(
+            "tier_migrations",
+            "Counters moved between tiers by the TierManager, by "
+            "direction (promote = cold->device, demote = device->cold; "
+            "demand-path evictions also demote but settle no leases)",
+            ["direction"],
+            registry=self.registry,
+        )
+        self.tier_migration_backlog = Gauge(
+            "tier_migration_backlog",
+            "Migration candidates the last TierManager round priced in "
+            "but could not move (headroom, in-flight guards)",
+            registry=self.registry,
+        )
+        self.tier_cold_decide_seconds = Histogram(
+            "tier_cold_decide_seconds",
+            "Host evaluation latency of decisions served by the cold "
+            "tier (the exact dict-lane decide, device untouched)",
+            registry=self.registry,
+            buckets=(
+                0.000005, 0.00001, 0.000025, 0.00005, 0.0001, 0.00025,
+                0.0005, 0.001, 0.0025, 0.005, 0.01,
+            ),
+        )
+        self.tier_decision_benefit = Gauge(
+            "tier_decision_benefit",
+            "Model-priced benefit (seconds of host decide time per "
+            "interval) of the last TierManager migration decision",
+            registry=self.registry,
+        )
+        self.tier_cold_spilled = Counter(
+            "tier_cold_spilled",
+            "Cold-tier journal rows appended to the disk spill log",
+            registry=self.registry,
+        )
+        for tier in ("device", "cold"):
+            self.tier_resident.labels(tier)
+        for direction in ("promote", "demote"):
+            self.tier_migrations.labels(direction)
         # Pre-seed the bounded label sets so the families render (and
         # dashboards/benches see zeros) before the first flush.
         from ..admission import SHED_REASONS

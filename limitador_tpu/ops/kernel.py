@@ -622,6 +622,32 @@ def credit_batch(
     return CounterTableState(values, expiry, state.hits)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def seed_slots(
+    state: CounterTableState,
+    slots: jax.Array,      # int32[H] slot per seed (C for padding)
+    values: jax.Array,     # int32[H] absolute value to write
+    expiry_ms: jax.Array,  # int32[H] absolute (epoch-relative) expiry
+) -> CounterTableState:
+    """Absolute cell seed for tier migration (tier/storage.py): write
+    each slot's (value, expiry) verbatim — no window arithmetic — so a
+    counter promoted from the host cold tier keeps its exact remaining
+    window and count instead of starting a fresh one (the update lane's
+    ``fresh`` flag would reset the window to full length). Bucket cells
+    seed the TAT through the expiry lane the same way (values lane 0).
+    Callers pad to a pow2 bucket with the scratch slot, value 0,
+    expiry 0. The hit accumulator starts at 0 for seeded slots: the
+    counter's host-side traffic history stays host-side; device heat
+    accrues from its first device hit."""
+    v = state.values.at[slots].set(values)
+    e = state.expiry_ms.at[slots].set(expiry_ms)
+    hits = None if state.hits is None else state.hits.at[slots].set(0)
+    # Scratch cell stays inert (it absorbed the padding writes).
+    v = v.at[-1].set(0)
+    e = e.at[-1].set(0)
+    return CounterTableState(v, e, hits)
+
+
 @jax.jit
 def read_slots(
     state: CounterTableState, slots: jax.Array, now_ms: jax.Array

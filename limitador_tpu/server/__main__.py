@@ -368,6 +368,33 @@ def build_parser() -> argparse.ArgumentParser:
         "updates (an abort reverts to the old topology). off "
         "(default) = byte-identical PR 14 wire format and behavior",
     )
+    # tiered storage (docs/configuration.md "Tiered storage", ISSUE 17):
+    # device-resident hot set over an exact host cold tier
+    p.add_argument(
+        "--tier-mode", choices=["on", "off"],
+        default=_env("TPU_TIER_MODE", "off"),
+        help="tpu: on = tiered counter storage — the device table "
+        "serves the resident hot set, LRU evictions demote their exact "
+        "cell (value + remaining window) to a host cold tier instead "
+        "of dropping it, cold keys decide exactly on the host, and a "
+        "TierManager thread migrates counters on observed heat priced "
+        "against the fitted serving model (plain tpu storage only; "
+        "GET /debug/tiering serves the live state). off (default) = "
+        "byte-identical single-tier behavior",
+    )
+    p.add_argument(
+        "--tier-cold", default=_env("TPU_TIER_COLD", ""),
+        help="tiered: path of the cold tier's append-log disk spill "
+        "(JSON lines, absolute cell state, last-row-wins; empty = "
+        "no disk spill)",
+    )
+    p.add_argument(
+        "--tier-migrate-interval", type=float,
+        default=float(_env("TPU_TIER_MIGRATE_INTERVAL", "2.0")),
+        help="tiered: seconds between TierManager migration rounds "
+        "(each round drains the heat accumulators, prices candidates "
+        "and runs the two-phase ledgered moves)",
+    )
     # pod fast path (docs/configuration.md "Pod fast path", ISSUE 13):
     # shard-aware native hot lane + lockstep psum lane for global limits
     p.add_argument(
@@ -862,9 +889,18 @@ def build_limiter(args, on_partitioned=None):
                         f"restored replicated counter table from "
                         f"{args.snapshot_path}")
         else:
+            # Tiered storage (ISSUE 17): the facade is a TpuStorage, so
+            # the whole fast path (plan cache, native hot lane, lease
+            # tier) rides it unchanged; off (default) keeps the exact
+            # single-tier construction below byte-identical.
+            cls = TpuStorage
+            if getattr(args, "tier_mode", "off") == "on":
+                from ..tier import TieredStorage
+
+                cls = TieredStorage
             storage = _try_restore(
                 args.snapshot_path,
-                lambda p: TpuStorage.restore(p, cache_size=args.cache_size),
+                lambda p: cls.restore(p, cache_size=args.cache_size),
                 "counter table",
             )
             if storage is not None and storage._capacity != args.tpu_capacity:
@@ -872,8 +908,21 @@ def build_limiter(args, on_partitioned=None):
                     f"warning: snapshot capacity {storage._capacity} "
                     f"overrides --tpu-capacity {args.tpu_capacity}")
             if storage is None:
-                storage = TpuStorage(
-                    capacity=args.tpu_capacity, cache_size=args.cache_size
+                if cls is TpuStorage:
+                    storage = cls(
+                        capacity=args.tpu_capacity,
+                        cache_size=args.cache_size,
+                    )
+                else:
+                    storage = cls(
+                        capacity=args.tpu_capacity,
+                        cache_size=args.cache_size,
+                        spill_path=getattr(args, "tier_cold", "") or None,
+                    )
+            elif cls is not TpuStorage:
+                # restore() has no spill knob; arm it post-restore
+                storage._cold._spill_path = (
+                    getattr(args, "tier_cold", "") or None
                 )
         async_storage = AsyncTpuStorage(
             storage, max_delay=args.batch_delay_us / 1e6,
@@ -1698,6 +1747,49 @@ async def _amain(args) -> int:
             f"{args.flight_window:.0f}s bundle window, spool "
             f"{args.flight_spool_dir} (GET /debug/flight)")
 
+    # Tiered storage (ISSUE 17): arm the migration thread over the
+    # TieredStorage facade constructed in _build_limiter. Wired late so
+    # it can see the lease broker (demotions settle outstanding tokens
+    # first), the serving-model estimator (migration pricing), the pod
+    # event log (tier_migration timeline) and the flight recorder (the
+    # cold_tier decision lane).
+    tier_manager = None
+    if getattr(args, "tier_mode", "off") == "on":
+        from ..tier import TieredStorage, TierManager
+
+        tier_storage = getattr(counters_storage, "inner", counters_storage)
+        if not isinstance(tier_storage, TieredStorage):
+            log.warning(
+                "--tier-mode on requires plain tpu storage (no "
+                "peer/sharded mode); serving single-tier")
+        else:
+            tier_manager = TierManager(
+                tier_storage,
+                broker=(
+                    native_pipeline.lease_broker
+                    if native_pipeline is not None else None
+                ),
+                estimator=model_estimator,
+                events=(
+                    getattr(pod_frontend, "events", None)
+                    if pod_frontend is not None else None
+                ),
+                observatory=observatory,
+                interval_s=args.tier_migrate_interval,
+            )
+            if args.flight == "on":
+                tier_storage.flight_tap = flight
+            tier_manager.start()
+            metrics.attach_render_hook(tier_manager)
+            log.info(
+                "tiered storage: device hot set over exact host cold "
+                f"tier, migration every {args.tier_migrate_interval:.1f}s"
+                + (
+                    f", cold spill -> {args.tier_cold}"
+                    if args.tier_cold else ""
+                )
+                + " (GET /debug/tiering)")
+
     authority_server = None
     if args.authority_listen:
         from ..storage.authority import serve_authority
@@ -1816,6 +1908,8 @@ async def _amain(args) -> int:
         debug_sources.append(model_estimator)
     if flight_engine is not None:
         debug_sources.append(flight_engine)
+    if tier_manager is not None:
+        debug_sources.append(tier_manager)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,
@@ -1906,6 +2000,10 @@ async def _amain(args) -> int:
     await http_runner.cleanup()
     if observatory is not None:
         observatory.close()
+    if tier_manager is not None:
+        # Before the pipeline/storage close: the last round may still
+        # settle leases and drain the cold journal to the spill log.
+        tier_manager.close()
     if flight_engine is not None:
         flight_engine.stop()
     if admission is not None:

@@ -49,6 +49,10 @@ Beyond the reference surface, the device-plane debug endpoints
                             freezes the exemplar rings, collects pod
                             peers' rings and persists a bundle
                             ({"note"?: str, "profile"?: bool})
+    GET  /debug/tiering     tiered-storage state: per-tier resident
+                            counts, migration/backlog accounting,
+                            cold-decide latency and the model-priced
+                            row costs (404 when --tier-mode off)
 
 POST bodies are CheckAndReportInfo: {"namespace", "values": {str: str},
 "delta", "response_headers": optional "DRAFT_VERSION_03"}
@@ -107,6 +111,9 @@ DEBUG_SOURCE_SECTIONS = (
     # flight recorder (ISSUE 16): exemplar-ring occupancy, trigger
     # tallies, pending peer retries and the bundle spool
     ("flight", "flight_debug"),
+    # tiered storage (ISSUE 17): per-tier residency, migration rounds,
+    # cold-decide latency and the model-priced row costs
+    ("tiering", "tiering_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -132,6 +139,7 @@ DEBUG_STATS_SECTIONS = (
     "capacity",
     "pod_resize",
     "flight",
+    "tiering",
 )
 
 
@@ -676,6 +684,19 @@ class _Api:
             )
         return web.json_response(fn())
 
+    async def get_debug_tiering(self, request: web.Request) -> web.Response:
+        """Tiered-storage state (ISSUE 17): per-tier resident counts,
+        the TierManager's migration/backlog accounting, cold-decide
+        latency percentiles and the model-priced per-row costs the
+        promotion/demotion pricing used last round."""
+        fn = self._debug_source_fn("tiering_debug")
+        if fn is None:
+            return web.json_response(
+                {"error": "tiered storage not enabled (--tier-mode on)"},
+                status=404,
+            )
+        return web.json_response(fn())
+
     async def get_debug_pod(self, request: web.Request) -> web.Response:
         """Federated pod observability view: per-host ControlSignals
         columns with min/max/sum rollups, column ages, the signal
@@ -1058,6 +1079,7 @@ def make_http_app(
     app.router.add_post("/debug/profile", api.post_debug_profile)
     app.router.add_get("/debug/flight", api.get_debug_flight)
     app.router.add_post("/debug/flight/trigger", api.post_debug_flight_trigger)
+    app.router.add_get("/debug/tiering", api.get_debug_tiering)
     app.router.add_get("/limits/{namespace}", api.get_limits)
     app.router.add_get("/counters/{namespace}", api.get_counters)
     app.router.add_post("/check", api.post_check)

@@ -1,0 +1,53 @@
+"""Tiered counter storage (ISSUE 17): a device-resident hot set over an
+exact host cold tier, heat-driven migration, 100M-key regime.
+
+The device table holds ~1M slots of HBM; the north star is a
+millions-of-users keyspace. This package decouples "keys served" from
+"HBM bytes" the way Maxwell (PAPERS.md) and the reference's
+write-behind cached-Redis topology both do: keep the Zipf-hot head
+resident in fast memory, back it with a large exact host store, and
+migrate on observed heat.
+
+Three pieces:
+
+* :class:`~limitador_tpu.tier.cold.ColdStore` — the exact host cold
+  tier, promoted from the degraded-owner fallback's journaled host
+  store (storage/failover.py) to a first-class resident set, with an
+  optional append-log disk spill. Externally synchronized by the
+  device storage's lock, exactly like the big-limit host map.
+* :class:`~limitador_tpu.tier.storage.TieredStorage` — the facade: a
+  TpuStorage whose LRU eviction is an EXACT demotion (the evicted
+  cell's value and remaining window move to the cold tier instead of
+  being dropped) and whose big-limit host lane also serves cold
+  residents, so cold keys decide exactly with zero device work and
+  residency is purely a performance fact, never a correctness fact.
+* :class:`~limitador_tpu.tier.manager.TierManager` — the migration
+  thread: consumes the per-slot device hit accumulators and the cold
+  tier's touch counts as the heat signal, prices promotion/demotion
+  against the fitted serving model, and moves counters with the
+  resize lane's absolute-value/receiver-ledger protocol (idempotent
+  under retry; abort pushes back with nothing doubled or lost).
+"""
+
+from .cold import ColdStore
+from .manager import TierManager
+from .storage import TieredStorage
+
+__all__ = [
+    "ColdStore",
+    "TieredStorage",
+    "TierManager",
+    "METRIC_FAMILIES",
+]
+
+#: Prometheus families owned by the tier subsystem (cross-checked
+#: against the declarations in observability/metrics.py by the
+#: analysis registry pass).
+METRIC_FAMILIES = (
+    "tier_resident",
+    "tier_migrations",
+    "tier_migration_backlog",
+    "tier_cold_decide_seconds",
+    "tier_decision_benefit",
+    "tier_cold_spilled",
+)

@@ -1,0 +1,343 @@
+"""TierManager: the heat-driven migration thread.
+
+One daemon thread owns all tier movement. Each tick it:
+
+1. Drains the cold tier's touch accumulator (the host-side mirror of
+   the device table's per-slot ``hits`` column) for promotion
+   candidates, hottest first.
+2. Walks the device LRU from its demand-free front for demotion
+   candidates when resident occupancy crosses the high watermark —
+   demand-driven eviction (TieredStorage._evict_one) still demotes
+   exactly when the table fills between ticks, but a manager demotion
+   is STRICTLY better: it settles outstanding lease tokens through the
+   broker's floor-guarded credit lane before the slot is released, so a
+   demoted counter can never strand phantom quota or pay a dead debit
+   to its slot's next tenant. The tenant-usage observatory's hot set
+   (``top()`` — non-destructive) steers demotion away from slots with
+   live demand; the veto is a preference, never a block — the
+   observatory ranks by cumulative hits, so on any long-lived server
+   its top-K covers every slot, and the watermark must still drain
+   from the (by definition stale) LRU front.
+3. Prices each move against the fitted serving model: a cold decide
+   costs one host ``row`` coefficient of wall time, a device-resident
+   decide one device ``row`` (overlapped); promotion buys
+   ``heat x (host_row - device_row)`` seconds per interval and pays one
+   device slot. Until the model has fit, the measured cold-decide p50
+   (or a static prior) stands in. The model-priced benefit of the last
+   decision is exported (``tier_decision_benefit``) so the pricing is
+   inspectable, and docs/serving-model.md derives the terms.
+4. Runs the two-phase moves (TieredStorage promote/demote begin/finish)
+   and drains the cold write journal to the append-log spill OFF the
+   storage lock.
+
+Lock order: the manager's own ``_lock`` (domain ``tier``) is the
+outermost of everything it touches — tier -> broker -> native ->
+storage. The tick never holds ``_lock`` across its interval wait, and
+the decision path never takes it at all.
+
+The injectable ``kill_hook`` fires between phase A and phase B of each
+round (the fuzz drive's kill-mid-migration lever): raising there leaves
+both ledgers to ``migrate_abort`` push-back — nothing doubled, nothing
+lost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["TierManager"]
+
+#: static priors (seconds) for the two per-decision costs until the
+#: serving model has fit: a host dict-lane decide is ~tens of µs of
+#: Python; a device-resident decide's marginal row cost is ~1 µs
+#: (overlapped under the launch).
+_HOST_ROW_PRIOR_S = 20e-6
+_DEVICE_ROW_PRIOR_S = 1e-6
+
+#: demote from the LRU front when qualified occupancy crosses the high
+#: watermark, down to the low watermark — the headroom keeps demand-path
+#: evictions (which cannot settle leases) rare.
+_HIGH_WATERMARK = 0.90
+_LOW_WATERMARK = 0.80
+
+
+class TierManager:
+    """Migration policy + thread over a :class:`TieredStorage`."""
+
+    def __init__(
+        self,
+        storage,
+        broker=None,
+        estimator=None,
+        events=None,
+        observatory=None,
+        interval_s: float = 2.0,
+        batch: int = 256,
+        clock: Callable[[], float] = time.time,
+    ):
+        self.storage = storage
+        self.broker = broker
+        self.estimator = estimator
+        self.events = events
+        self.observatory = observatory
+        self.interval_s = max(float(interval_s), 0.05)
+        self.batch = max(int(batch), 1)
+        self._clock = clock
+        self._lock = threading.Lock()  # domain: tier
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # round accounting (tier_* families / /debug/tiering)
+        self.rounds = 0
+        self.promoted = 0
+        self.demoted = 0
+        self.aborted = 0
+        self.last_benefit_s = 0.0
+        self.backlog = 0
+        #: test lever: called between phase A and phase B of each round;
+        #: raising simulates a mid-migration death (the round aborts and
+        #: the ledgers push back).
+        self.kill_hook: Optional[Callable[[], None]] = None
+
+    # -- pricing -----------------------------------------------------------
+
+    def _row_costs(self) -> tuple:
+        """(host_row_s, device_row_s): fitted ``row`` coefficients when
+        the model has them, measured/static priors otherwise."""
+        host_s, device_s = 0.0, 0.0
+        est = self.estimator
+        if est is not None:
+            try:
+                coeff = est.coefficients()
+                host_s = float(coeff.get("host", {}).get("row", 0.0))
+                device_s = float(coeff.get("device", {}).get("row", 0.0))
+            except Exception:
+                pass
+        if host_s <= 0.0:
+            stats = self.storage.tier_stats()
+            p50_ms = stats.get("cold_decide_p50_ms", 0.0)
+            host_s = (p50_ms / 1000.0) if p50_ms > 0 else _HOST_ROW_PRIOR_S
+        if device_s <= 0.0:
+            device_s = _DEVICE_ROW_PRIOR_S
+        return host_s, device_s
+
+    # -- one migration round -----------------------------------------------
+
+    def run_once(self) -> dict:
+        """One migration round (also the soak/fuzz entry point — drive
+        it inline with no thread). Returns the round's accounting."""
+        with self._lock:
+            return self._round()
+
+    def _round(self) -> dict:
+        storage = self.storage
+        host_row_s, device_row_s = self._row_costs()
+        margin_s = host_row_s - device_row_s
+
+        # Promotion candidates: hottest cold keys since the last round,
+        # bounded by free device headroom — a promotion that forces an
+        # eviction just churns the LRU, so a full table promotes nothing
+        # until demotions (below) open room. The drain is read-and-reset,
+        # so skipped candidates re-accumulate heat and return next round.
+        stats = storage.tier_stats()
+        cap = max(storage._cache_size, 1)
+        resident = stats["device_resident"]
+        headroom = max(int(cap * _HIGH_WATERMARK) - resident, 0)
+        hot = storage.cold_hot_candidates(min(self.batch, headroom))
+        promo_keys = [key for key, heat in hot if heat * margin_s > 0.0]
+        benefit_s = sum(heat for _k, heat in hot) * margin_s
+
+        # Demotion candidates: LRU front, only above the high watermark,
+        # minus the observatory's live hot set.
+        demo_keys: List[tuple] = []
+        want_out = 0
+        if resident > cap * _HIGH_WATERMARK:
+            want_out = min(
+                resident - int(cap * _LOW_WATERMARK), self.batch
+            )
+        if want_out > 0:
+            hot_slots = set()
+            obs = self.observatory
+            if obs is not None:
+                try:
+                    hot_slots = {
+                        r.get("slot") for r in obs.top(self.batch)
+                    }
+                except Exception:
+                    pass
+            vetoed: List[tuple] = []
+            for key in storage.demotion_candidates(want_out + len(hot_slots)):
+                if storage.slot_of(key) in hot_slots:
+                    vetoed.append(key)
+                    continue
+                demo_keys.append(key)
+                if len(demo_keys) >= want_out:
+                    break
+            # The veto is a preference, not a block: the observatory
+            # ranks by cumulative hits, so its top-K eventually covers
+            # every resident slot and a hard veto would stall the
+            # watermark forever. Fill the shortfall from the vetoed
+            # LRU front — a key sits at the front precisely because it
+            # is not live, whatever its lifetime hit count says.
+            if len(demo_keys) < want_out:
+                demo_keys.extend(vetoed[: want_out - len(demo_keys)])
+
+        # Phase A: ledger both directions.
+        promo_accepted = storage.promote_begin(promo_keys)
+        demo_accepted = storage.demote_begin(demo_keys)
+
+        kill = self.kill_hook
+        if kill is not None:
+            try:
+                kill()
+            except Exception:
+                storage.migrate_abort()
+                self.aborted += 1
+                self.rounds += 1
+                self.backlog = len(promo_keys) + len(demo_keys)
+                return {"aborted": True, "promoted": 0, "demoted": 0}
+
+        # Demotions settle outstanding lease tokens BEFORE the slot is
+        # released: broker credits flow through the floor-guarded
+        # columnar lane while the slot identity still matches.
+        if demo_accepted and self.broker is not None:
+            slots = [
+                s for s in (storage.slot_of(k) for k in demo_accepted)
+                if s is not None
+            ]
+            if slots:
+                try:
+                    self.broker.reclaim_slots(slots)
+                except Exception:
+                    pass  # unsettled tokens die on the identity check
+
+        # Phase B: re-read absolute state, move, flip residency.
+        promoted = storage.promote_finish(promo_accepted)
+        demoted = storage.demote_finish(demo_accepted)
+
+        # Spill the cold write journal (serialization off the lock).
+        rows = storage.drain_cold_journal()
+        if rows:
+            storage.spill_cold_rows(rows)
+
+        self.rounds += 1
+        self.promoted += promoted
+        self.demoted += demoted
+        self.last_benefit_s = round(benefit_s, 9)
+        self.backlog = max(
+            len(promo_keys) - promoted, 0
+        ) + max(want_out - demoted, 0)
+        events = self.events
+        if events is not None and (promoted or demoted):
+            try:
+                events.emit(
+                    "tier_migration",
+                    promoted=promoted,
+                    demoted=demoted,
+                    backlog=self.backlog,
+                    benefit_s=self.last_benefit_s,
+                )
+            except Exception:
+                pass
+        return {"aborted": False, "promoted": promoted, "demoted": demoted}
+
+    # -- thread ------------------------------------------------------------
+
+    def start(self) -> "TierManager":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tier-manager", daemon=True
+            )
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval_s)  # no lock held across the wait
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.run_once()
+            except Exception:
+                pass  # policy failure must never kill the thread
+
+    def poke(self) -> None:
+        self._wake.set()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=5.0)
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "rounds": self.rounds,
+                "promoted": self.promoted,
+                "demoted": self.demoted,
+                "aborted": self.aborted,
+                "backlog": self.backlog,
+                "last_benefit_s": self.last_benefit_s,
+                "interval_s": self.interval_s,
+            }
+
+    def tiering_debug(self) -> dict:
+        """The ``tiering`` /debug/stats section and the
+        ``GET /debug/tiering`` body: manager accounting + the storage's
+        per-tier residency/latency stats."""
+        out = self.stats()
+        out.update(self.storage.tier_stats())
+        host_row_s, device_row_s = self._row_costs()
+        out["host_row_s"] = round(host_row_s, 9)
+        out["device_row_s"] = round(device_row_s, 9)
+        return out
+
+    def poll(self, metrics) -> None:
+        """``PrometheusMetrics.attach_render_hook`` protocol: feed the
+        ``tier_*`` families (counters converted cumulative->increment
+        against kept baselines, getattr-guarded like every hook)."""
+        stats = self.storage.tier_stats()
+        resident = getattr(metrics, "tier_resident", None)
+        if resident is not None:
+            resident.labels("device").set(stats["device_resident"])
+            resident.labels("cold").set(stats["cold"]["resident"])
+        backlog = getattr(metrics, "tier_migration_backlog", None)
+        if backlog is not None:
+            backlog.set(self.backlog)
+        benefit = getattr(metrics, "tier_decision_benefit", None)
+        if benefit is not None:
+            benefit.set(self.last_benefit_s)
+        migrations = getattr(metrics, "tier_migrations", None)
+        if migrations is not None:
+            base = getattr(self, "_prom_base", None)
+            if base is None:
+                base = self._prom_base = {}
+            for direction, value in (
+                ("promote", self.promoted),
+                ("demote", self.demoted),
+            ):
+                prev = base.get(direction, 0)
+                if value > prev:
+                    migrations.labels(direction).inc(value - prev)
+                    base[direction] = value
+        spilled = getattr(metrics, "tier_cold_spilled", None)
+        if spilled is not None:
+            base = getattr(self, "_prom_base", None)
+            if base is None:
+                base = self._prom_base = {}
+            value = stats["cold"]["spilled"]
+            prev = base.get("spilled", 0)
+            if value > prev:
+                spilled.inc(value - prev)
+                base["spilled"] = value
+        decide = getattr(metrics, "tier_cold_decide_seconds", None)
+        if decide is not None:
+            for dt in self.storage.drain_cold_decide_samples():
+                decide.observe(dt)

@@ -54,12 +54,15 @@ __all__ = [
 #: leaf-ish outermost hold — no sync waits and no storage-plane
 #: acquisitions under it.
 TRACKED_DOMAINS = (
-    "peering", "broker", "native", "storage", "plan_cache", "observatory",
+    "peering", "tier", "broker", "native", "storage", "plan_cache",
+    "observatory",
 )
 
 #: the documented canonical acquisition order (outermost first); the
 #: graph may use any PREFIX-compatible subset, never the reverse
-CANONICAL_ORDER = ("peering", "broker", "native", "storage", "plan_cache")
+CANONICAL_ORDER = (
+    "peering", "tier", "broker", "native", "storage", "plan_cache",
+)
 
 #: attribute name -> domain, regardless of receiver (``_native_lock``
 #: is unique to the native pipeline)
@@ -76,6 +79,10 @@ MODULE_SELF_DOMAINS = {
     ("limitador_tpu/observability/usage.py", "_lock"): "observatory",
     ("limitador_tpu/tpu/plan_cache.py", "_lock"): "plan_cache",
     ("limitador_tpu/server/peering.py", "_health_lock"): "peering",
+    # tiered storage (ISSUE 17): the facade's inherited storage lock
+    # guards both tiers; only the migration thread owns the tier lock
+    ("limitador_tpu/tier/storage.py", "_lock"): "storage",
+    ("limitador_tpu/tier/manager.py", "_lock"): "tier",
 }
 
 #: receiver NAME -> domain for cross-object acquisitions

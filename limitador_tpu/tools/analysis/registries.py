@@ -54,6 +54,9 @@ REGISTRY_OWNED_PREFIXES = {
     # flight recorder (ISSUE 16): exemplar rings, trigger tallies and
     # the incident-bundle spool
     "flight_": "limitador_tpu/observability/flight.py",
+    # tiered storage (ISSUE 17): per-tier residency, migration rates
+    # and the cold-tier decide latency
+    "tier_": "limitador_tpu/tier/__init__.py",
 }
 
 #: the native telemetry plane's phase registry module

@@ -77,6 +77,14 @@ HOT_MODULES = (
     # per payload); migration/abort work lives on its own threads and
     # must never be named with a decision prefix.
     "limitador_tpu/server/resize.py",
+    # tiered storage (ISSUE 17): cold-tier decides ride the big-limit
+    # host lane per batch (is_within_limits/_eval_big_hits overrides),
+    # so the no-sync/no-implicit-asarray rules apply; migration work
+    # belongs to the TierManager thread and must never be named with a
+    # decision prefix. Device access goes through the TpuStorage
+    # peek/seed helpers — tier/ is NOT a kernel owner.
+    "limitador_tpu/tier/storage.py",
+    "limitador_tpu/tier/manager.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

@@ -1098,6 +1098,41 @@ class TpuStorage(_BigLimitMixin, CounterStorage):
             padded += (_staged(arrays[6], H, False, bool),)
         return padded
 
+    # -- tier migration primitives (tier/storage.py) -----------------------
+
+    def peek_slots(self, slots) -> Tuple[np.ndarray, np.ndarray]:
+        """(value, ttl_ms) host arrays for ``slots`` at the current
+        clock — the read half of an exact demotion. Caller holds the
+        lock: the read must be atomic with the residency change it
+        feeds. Padded to the kernel's pow2 buckets so migration-batch
+        peeks of any size reuse a handful of compiled read programs."""
+        n = len(slots)
+        H = _bucket(n)
+        now_ms = self._now_ms()
+        values, ttls = K.read_slots(
+            self._state,
+            _staged(np.asarray(slots, np.int32), H, self._scratch, np.int32),
+            np.int32(now_ms),
+        )
+        return np.asarray(values)[:n], np.asarray(ttls)[:n]
+
+    def seed_slot_values(self, slots, values, expiry_rel_ms) -> None:
+        """Absolute cell write for ``slots`` (tier promotion): value and
+        epoch-relative expiry land verbatim (ops/kernel.py seed_slots),
+        preserving the counter's exact remaining window — the update
+        lane's ``fresh`` flag would restart it. Caller holds the lock;
+        rows are padded to the pow2 bucket with inert scratch writes."""
+        n = len(slots)
+        if n == 0:
+            return
+        H = _bucket(n)
+        self._state = K.seed_slots(
+            self._state,
+            _staged(np.asarray(slots, np.int32), H, self._scratch, np.int32),
+            _staged(np.asarray(values, np.int32), H, 0, np.int32),
+            _staged(np.asarray(expiry_rel_ms, np.int32), H, 0, np.int32),
+        )
+
     def get_counters(self, limits: Set[Limit]) -> Set[Counter]:
         out: Set[Counter] = set()
         with self._lock:

@@ -78,6 +78,9 @@ SERVER_ENV_VARS = frozenset({
     # silently reshape any pod-spawning test's wire format and timing
     "TPU_POD_RESIZE", "TPU_POD_RESIZE_SLICE_PAUSE_MS",
     "TPU_POD_RESIZE_TIMEOUT_S",
+    # tiered storage (ISSUE 17): ambient tiering would silently swap
+    # the storage class (and migration timing) under any spawned server
+    "TPU_TIER_MODE", "TPU_TIER_COLD", "TPU_TIER_MIGRATE_INTERVAL",
 })
 
 

@@ -248,6 +248,21 @@ def test_dashboard_covers_flight_families():
     ), "no trigger annotation on the dashboard"
 
 
+def test_dashboard_covers_tier_families():
+    """ISSUE 17: tiered storage ships WITH its Grafana row — a "Tiered
+    storage" row exists and every family the tier owns
+    (tier.METRIC_FAMILIES) is referenced by at least one panel
+    expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("tiered storage" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.tier import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_slo_alert_panel_gated_on_device_backing():
     """The PR 7 false-page fix (ISSUE 14 satellite): the pageable
     breach panel must alert on slo_breached_actionable — raw

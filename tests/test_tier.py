@@ -1,0 +1,456 @@
+"""Tiered counter storage (ISSUE 17): the exactness contract.
+
+Every test pins one clause of the tier contract:
+
+- eviction IS demotion: an LRU eviction seats the exact device cell
+  (value + remaining window, GCRA TAT for buckets) in the cold tier,
+  and cold keys keep deciding exactly;
+- promotion seeds the device slot from the exact cold cell and the
+  key keeps deciding exactly device-side;
+- the full storage surface (is_within_limits, get_counters,
+  delete_counters, clear) sees cold residents as ordinary counters;
+- the two-phase migration ledgers are idempotent under retry, and
+  migrate_abort pushes every ledgered key back to its source tier;
+- manager-driven demotion settles outstanding lease tokens through
+  the broker's floor-guarded credit lane (reclaim_slots) BEFORE the
+  slot is released;
+- ``--tier-mode off`` (the default) constructs the plain single-tier
+  TpuStorage — byte-identical current behavior, test-pinned.
+
+The randomized churn parity drive lives in test_tier_fuzz.py.
+"""
+
+import json
+
+import pytest
+
+from limitador_tpu import Context, Limit, RateLimiter, native
+from limitador_tpu.storage.in_memory import InMemoryStorage
+from limitador_tpu.tier import ColdStore, TieredStorage, TierManager
+from limitador_tpu.tpu.storage import TpuStorage
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1_700_000_000.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, s):
+        self.now += s
+
+
+def make_tiered(capacity=1 << 6, cache_size=8, **kw):
+    clock = FakeClock()
+    storage = TieredStorage(
+        capacity=capacity, cache_size=cache_size, clock=clock, **kw
+    )
+    limiter = RateLimiter(storage)
+    return clock, storage, limiter
+
+
+LIMIT = Limit("ns", 10, 60, [], ["u"])
+
+
+def test_eviction_demotes_the_exact_cell():
+    """Filling the qualified LRU past cache_size demotes the evicted
+    counters' exact state instead of dropping it: a demoted counter
+    resumes with its spent quota and its original window."""
+    clock, storage, limiter = make_tiered(cache_size=4)
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "victim"}), 7)
+    clock.advance(10)
+    for u in range(8):  # rolls the 4-slot LRU; "victim" spills cold
+        limiter.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    assert any(
+        counter.set_variables.get("u") == "victim"
+        for _cell, counter in storage._cold.cells.values()
+    ), "victim never went cold"
+    # exact state survived the demotion: 7 spent, window continues
+    counters = {
+        c.set_variables["u"]: (c.remaining, c.expires_in)
+        for c in limiter.get_counters("ns")
+    }
+    remaining, expires_in = counters["victim"]
+    assert remaining == 3
+    assert abs(expires_in - 50) <= 0.002  # 60s window, 10s elapsed
+    # and the cold key keeps deciding exactly on the host lane
+    assert not limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "victim"}), 3).limited
+    assert limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "victim"}), 1).limited
+
+
+def test_eviction_demotes_gcra_buckets_exactly():
+    """Token buckets demote through the TAT lane: the demoted cell's
+    refill schedule equals the device cell's (ttl parity within the
+    device's ms quantization)."""
+    clock = FakeClock()
+    bucket = Limit("ns", 10, 60, [], ["u"], policy="token_bucket")
+    mem = RateLimiter(InMemoryStorage(10_000, clock=clock))
+    tiered = RateLimiter(
+        TieredStorage(capacity=1 << 6, cache_size=4, clock=clock)
+    )
+    storage = tiered.storage.counters
+    for limiter in (mem, tiered):
+        limiter.add_limit(bucket)
+    for limiter in (mem, tiered):
+        limiter.update_counters("ns", Context({"u": "b"}), 6)
+    clock.advance(7)
+    for u in range(8):  # force "b" cold
+        tiered.update_counters("ns", Context({"u": f"f{u}"}), 1)
+        mem.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    assert storage._cold.cells, "nothing demoted"
+    c1 = {c.set_variables["u"]: (c.remaining, c.expires_in)
+          for c in mem.get_counters("ns")}
+    c2 = {c.set_variables["u"]: (c.remaining, c.expires_in)
+          for c in tiered.get_counters("ns")}
+    assert c1.keys() == c2.keys()
+    for u in c1:
+        assert c1[u][0] == c2[u][0], f"{u}: remaining diverged"
+        assert abs(c1[u][1] - c2[u][1]) <= 0.002, f"{u}: ttl diverged"
+    # the refill keeps flowing from the exact TAT: decisions agree
+    clock.advance(30)
+    for delta in (5, 5, 1):
+        r1 = mem.check_rate_limited_and_update(
+            "ns", Context({"u": "b"}), delta).limited
+        r2 = tiered.check_rate_limited_and_update(
+            "ns", Context({"u": "b"}), delta).limited
+        assert r1 == r2
+
+
+def test_storage_surface_sees_cold_residents():
+    """is_within_limits / get_counters / delete_counters / clear treat
+    cold residents as ordinary counters."""
+    clock, storage, limiter = make_tiered(cache_size=4)
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "cold"}), 9)
+    for u in range(8):
+        limiter.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    assert storage._cold.cells
+    from limitador_tpu.core.counter import Counter
+
+    cold_counter = Counter(LIMIT, {"u": "cold"})
+    assert storage.is_within_limits(cold_counter, 1)
+    assert not storage.is_within_limits(cold_counter, 2)
+    assert len(limiter.get_counters("ns")) == 9
+    limiter.delete_limit(LIMIT)  # delete_counters path
+    assert not storage._cold.cells
+    assert not limiter.get_counters("ns")
+    # clear: reseat one cold resident, then wipe everything
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "cold"}), 5)
+    for u in range(8):
+        limiter.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    assert storage._cold.cells
+    storage.clear()
+    assert not storage._cold.cells
+    assert not limiter.get_counters("ns")
+
+
+def test_promotion_seeds_the_exact_cell_and_is_idempotent():
+    """promote_begin/promote_finish move a cold key device-side with
+    its exact state; a retried phase B (and a finish with no begin) is
+    a no-op."""
+    clock, storage, limiter = make_tiered(cache_size=8)
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "p"}), 6)
+    clock.advance(12)
+    for u in range(12):
+        limiter.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    cold_keys = [k for k in storage._cold.cells]
+    assert cold_keys
+    key = next(
+        k for k, (cell, counter) in storage._cold.cells.items()
+        if counter.set_variables.get("u") == "p"
+    )
+    accepted = storage.promote_begin([key])
+    assert accepted == [key]
+    # double-begin is a no-op while the ledger holds the key
+    assert storage.promote_begin([key]) == []
+    assert storage.promote_finish([key]) == 1
+    assert key not in storage._cold.cells
+    # retried phase B: ledger settled, nothing moves twice
+    assert storage.promote_finish([key]) == 0
+    # exact state followed the key to the device
+    counters = {
+        c.set_variables["u"]: (c.remaining, c.expires_in)
+        for c in limiter.get_counters("ns")
+    }
+    remaining, expires_in = counters["p"]
+    assert remaining == 4
+    assert abs(expires_in - 48) <= 0.002
+    assert not limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "p"}), 4).limited
+    assert limiter.check_rate_limited_and_update(
+        "ns", Context({"u": "p"}), 1).limited
+
+
+def test_demotion_two_phase_is_idempotent_and_abortable():
+    """demote_begin/demote_finish mirror the promotion ledger; a
+    migrate_abort between the phases pushes every ledgered key back to
+    its source tier untouched."""
+    clock, storage, limiter = make_tiered(cache_size=8)
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "d"}), 5)
+    key = next(iter(storage._table.qualified))
+    accepted = storage.demote_begin([key])
+    assert accepted == [key]
+    assert storage.demote_begin([key]) == []  # ledgered: no double-begin
+    # abort: the ledger drops, the key stays device-resident
+    counts = storage.migrate_abort()
+    assert counts["demotions_aborted"] == 1
+    assert key in storage._table.qualified
+    assert storage.demote_finish([key]) == 0  # aborted: finish no-ops
+    # the real move
+    assert storage.demote_begin([key]) == [key]
+    assert storage.demote_finish([key]) == 1
+    assert key not in storage._table.qualified
+    assert key in storage._cold.cells
+    assert storage.demote_finish([key]) == 0  # retried phase B no-ops
+    (remaining, expires_in) = next(
+        (c.remaining, c.expires_in) for c in limiter.get_counters("ns")
+    )
+    assert remaining == 5
+
+
+def test_manager_round_promotes_on_heat_and_demotes_on_watermark():
+    """One TierManager round: heat drained from the cold tier promotes
+    into free headroom; occupancy above the high watermark demotes the
+    LRU front down to the low watermark."""
+    clock, storage, limiter = make_tiered(cache_size=16)
+    limiter.add_limit(LIMIT)
+    mgr = TierManager(storage, interval_s=3600.0, clock=clock)
+    # overfill: 20 users through a 16-slot LRU -> 4+ cold residents
+    for u in range(20):
+        limiter.update_counters("ns", Context({"u": f"u{u}"}), 1)
+    assert storage.tier_stats()["cold"]["resident"] >= 4
+    # occupancy 16 > 0.9*16: the round demotes down to 0.8*16 = 12
+    out = mgr.run_once()
+    assert not out["aborted"]
+    assert out["demoted"] >= 2
+    resident = storage.tier_stats()["device_resident"]
+    assert resident <= 13
+    # hammer one cold key: heat promotes it into the freed headroom
+    cold_key = next(iter(storage._cold.cells))
+    for _ in range(5):
+        storage._cold.touch(cold_key)
+    out = mgr.run_once()
+    assert out["promoted"] >= 1
+    assert cold_key not in storage._cold.cells
+    assert mgr.stats()["rounds"] == 2
+
+
+def test_demotion_watermark_wins_over_a_blanket_veto():
+    """The observatory veto is a preference, not a block. The usage
+    observatory ranks by CUMULATIVE hits, so once the server has seen
+    more distinct keys than device slots its top-K covers every
+    resident slot — a veto that blocks outright then stalls the
+    watermark forever (live-fire regression: a real server froze at
+    backlog 13 with zero demotions per round). When every candidate is
+    vetoed, the round must still demote the LRU front — it is at the
+    front precisely because it is NOT live."""
+    clock, storage, limiter = make_tiered(cache_size=16)
+    limiter.add_limit(LIMIT)
+    for u in range(40):
+        limiter.update_counters("ns", Context({"u": f"u{u}"}), 1)
+    assert storage.tier_stats()["device_resident"] == 16
+
+    class BlanketObservatory:
+        # every slot id the table could ever use, with stale ids too
+        def top(self, k):
+            return [{"slot": s} for s in range(64)]
+
+    mgr = TierManager(
+        storage, interval_s=3600.0, clock=clock,
+        observatory=BlanketObservatory(),
+    )
+    out = mgr.run_once()
+    assert not out["aborted"]
+    assert out["demoted"] >= 2, "blanket veto stalled the watermark"
+    assert storage.tier_stats()["device_resident"] <= 13
+    # and the freed headroom admits heat-driven promotion again
+    cold_key = next(iter(storage._cold.cells))
+    for _ in range(5):
+        storage._cold.touch(cold_key)
+    assert mgr.run_once()["promoted"] >= 1
+
+
+def test_kill_mid_migration_aborts_with_pushback():
+    """The kill_hook fires between phase A and phase B: the round
+    aborts, both ledgers push back, and every key still decides from
+    its source tier."""
+    clock, storage, limiter = make_tiered(cache_size=8)
+    limiter.add_limit(LIMIT)
+    for u in range(12):
+        limiter.update_counters("ns", Context({"u": f"u{u}"}), 1)
+    mgr = TierManager(storage, interval_s=3600.0, clock=clock)
+
+    def die():
+        raise RuntimeError("killed mid-migration")
+
+    mgr.kill_hook = die
+    out = mgr.run_once()
+    assert out == {"aborted": True, "promoted": 0, "demoted": 0}
+    assert mgr.stats()["aborted"] == 1
+    stats = storage.tier_stats()
+    assert stats["promo_ledger"] == 0 and stats["demo_ledger"] == 0
+    # nothing doubled, nothing lost: 12 counters still decide
+    assert len(limiter.get_counters("ns")) == 12
+    mgr.kill_hook = None
+    assert not mgr.run_once()["aborted"]
+
+
+def test_cold_spill_journal_writes_absolute_rows(tmp_path):
+    """The cold write journal spills absolute cell state as JSON lines
+    (last-row-wins recovery format), counted by tier_stats."""
+    spill = str(tmp_path / "cold.jsonl")
+    clock, storage, limiter = make_tiered(cache_size=4, spill_path=spill)
+    limiter.add_limit(LIMIT)
+    limiter.update_counters("ns", Context({"u": "s"}), 7)
+    for u in range(8):
+        limiter.update_counters("ns", Context({"u": f"f{u}"}), 1)
+    assert storage._cold.cells
+    limiter.update_counters("ns", Context({"u": "s"}), 1)  # a cold write
+    rows = storage.drain_cold_journal()
+    assert rows
+    assert storage.spill_cold_rows(rows) == len(rows)
+    storage._cold.close()
+    lines = [json.loads(l) for l in open(spill)]
+    assert {r["ns"] for r in lines} == {"ns"}
+    assert all({"ns", "limit", "vars", "a", "b", "ts"} <= set(r)
+               for r in lines)
+    assert storage.tier_stats()["cold"]["spilled"] == len(rows)
+
+
+def test_tiering_debug_surface():
+    """tiering_debug() (the /debug/tiering body and the ``tiering``
+    /debug/stats section) carries the manager accounting, the per-tier
+    residency and the live pricing terms."""
+    clock, storage, limiter = make_tiered(cache_size=4)
+    limiter.add_limit(LIMIT)
+    for u in range(8):
+        limiter.update_counters("ns", Context({"u": f"u{u}"}), 1)
+    mgr = TierManager(storage, interval_s=3600.0, clock=clock)
+    mgr.run_once()
+    out = mgr.tiering_debug()
+    for field in (
+        "rounds", "promoted", "demoted", "aborted", "backlog",
+        "device_resident", "device_capacity", "cold",
+        "cold_decide_p50_ms", "cold_decide_p99_ms",
+        "host_row_s", "device_row_s",
+    ):
+        assert field in out, f"tiering_debug missing {field}"
+    assert out["host_row_s"] > out["device_row_s"] > 0
+
+
+def test_tier_metrics_render():
+    """The tier_* Prometheus families render through the manager's
+    attach_render_hook poll (cumulative->increment against kept
+    baselines, like every other hook)."""
+    from limitador_tpu.observability import PrometheusMetrics
+
+    clock, storage, limiter = make_tiered(cache_size=4)
+    limiter.add_limit(LIMIT)
+    for u in range(8):
+        limiter.update_counters("ns", Context({"u": f"u{u}"}), 1)
+    mgr = TierManager(storage, interval_s=3600.0, clock=clock)
+    mgr.run_once()
+    metrics = PrometheusMetrics()
+    metrics.attach_render_hook(mgr)
+    text = metrics.render().decode()
+    assert 'tier_resident{tier="cold"}' in text
+    assert 'tier_resident{tier="device"}' in text
+    assert "tier_migration_backlog" in text
+    assert "tier_cold_decide_seconds" in text
+    assert "tier_decision_benefit" in text
+    assert 'tier_migrations_total{direction="demote"}' in text
+    # second render: counters must not double-count the same round
+    first = [
+        l for l in text.splitlines()
+        if l.startswith('tier_migrations_total{direction="demote"}')
+    ][0]
+    again = [
+        l for l in metrics.render().decode().splitlines()
+        if l.startswith('tier_migrations_total{direction="demote"}')
+    ][0]
+    assert first == again
+
+
+@pytest.mark.skipif(
+    not native.available() or not native.lease_available(),
+    reason="native lease lane unavailable",
+)
+def test_manager_demotion_settles_leases_through_reclaim():
+    """Manager-driven demotion settles outstanding lease tokens
+    through the broker's floor-guarded credit lane (reclaim_slots)
+    BEFORE the slot is released — no phantom quota strands on the
+    lease, no dead debit hits the slot's next tenant."""
+    from tests.test_lease import _blob, _build, _drive, _remaining
+
+    D = "descriptors[0]"
+    pipeline, limiter, broker, _clock = _build(
+        [Limit("api", 1000, 60, [f"{D}.m == 'GET'"], [f"{D}.u"],
+               name="per-user")]
+    )
+    b = _blob()
+    _drive(pipeline, [b] * 2)
+    _drive(pipeline, [b] * 2)
+    broker.refresh()
+    assert broker.stats()["lease_outstanding_tokens"] > 0
+    storage = pipeline.storage
+    slots = [
+        h[0] for lease in broker._leases.values() for h in lease.hits
+    ]
+    assert slots
+    returned = broker.reclaim_slots(slots)
+    assert returned > 0
+    stats = broker.stats()
+    assert stats["lease_outstanding_tokens"] == 0
+    assert stats["lease_returned_tokens"] >= returned
+    # the device collapses to exact usage once the tokens come home
+    used = 1000 - _remaining(limiter)[("per-user", ("hot",))]
+    assert used == 4
+    del storage
+
+
+def test_tier_mode_off_is_the_default_and_builds_plain_storage(
+    monkeypatch, tmp_path
+):
+    """The ``--tier-mode off`` pin: the flag defaults to off, and the
+    off path constructs a plain TpuStorage (not a TieredStorage) — the
+    current single-tier behavior, byte-identical."""
+    for var in ("TPU_TIER_MODE", "TPU_TIER_COLD",
+                "TPU_TIER_MIGRATE_INTERVAL"):
+        monkeypatch.delenv(var, raising=False)
+    from limitador_tpu.server.__main__ import build_limiter, build_parser
+
+    args = build_parser().parse_args(["x.yaml", "tpu"])
+    assert args.tier_mode == "off"
+    assert args.tier_cold == ""
+    assert args.tier_migrate_interval == 2.0
+    limiter = build_limiter(args)
+    inner = limiter.storage.counters.inner
+    assert type(inner) is TpuStorage
+    assert not isinstance(inner, TieredStorage)
+
+    on = build_parser().parse_args(["x.yaml", "tpu", "--tier-mode", "on"])
+    limiter_on = build_limiter(on)
+    inner_on = limiter_on.storage.counters.inner
+    assert type(inner_on) is TieredStorage
+
+
+def test_cold_store_heat_drain_is_read_and_reset():
+    cold = ColdStore()
+    from limitador_tpu.core.counter import Counter
+    from limitador_tpu.storage.expiring_value import ExpiringValue
+
+    for u, hits in (("a", 3), ("b", 7), ("c", 1)):
+        key = ("ns", 60, None, (("u", u),))
+        cold.seat(key, ExpiringValue(1, 2e9), Counter(LIMIT, {"u": u}))
+        for _ in range(hits):
+            cold.touch(key)
+    top = cold.drain_hot(2)
+    assert [heat for _k, heat in top] == [7, 3]
+    assert cold.drain_hot(2) == []  # reset: heat re-accumulates
