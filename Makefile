@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill tier-soak
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill tier-soak pod-join-drill
 
 check: lint test
 
@@ -55,6 +55,15 @@ pod-chaos:
 # equal to the single-process oracle for window-born keys.
 pod-resize-chaos:
 	python -m pytest tests/test_pod_resize_chaos.py -q
+
+# Warm-standby join drill (ISSUE 18): the fast join/standby tier plus
+# the slow promotion-under-fire drill — SIGKILL a subprocess member
+# mid-soak, promote the warm standby as its replacement through
+# POST /debug/pod/join, and assert zero failed answers outside the
+# degraded window with the causal join_begin < epoch_bump < join_end
+# order on the merged pod event timeline.
+pod-join-drill:
+	python -m pytest tests/test_standby.py tests/test_pod_join_drill.py -q
 
 # Flight-recorder drill (ISSUE 16): under live decision traffic, fire
 # the manual trigger through POST /debug/flight/trigger and validate

@@ -1990,6 +1990,7 @@ def bench_pod():
         **({"pod_native_note": native_note} if native_note else {}),
     )
     bench_pod_resize()
+    bench_pod_join()
 
 
 def bench_pod_resize():
@@ -2161,6 +2162,170 @@ def bench_pod_resize():
         f"{phase_stats['after']['decisions_per_sec']/1e3:.1f}k/s p99 "
         f"{phase_stats['after']['p99_ms']:.1f}ms, routed-share recovery "
         f"{recovery_s}s",
+        file=sys.stderr,
+    )
+
+
+def bench_pod_join():
+    """Warm-standby join row (ISSUE 18): time-to-first-decision and
+    time-to-routed-share-1 for a host joining a live 2-host in-process
+    mini-pod (InMemory frontends over real gRPC peer lanes — like the
+    resize row, this measures the membership machinery, not a device),
+    cold vs warm. Both arms pay a REAL kernel warm-up
+    (``WarmStandby.warm()`` jit-compiles the decision kernels on this
+    box's backend); the warm arm pays it BEFORE the join clock starts,
+    the cold arm inside the ttfd window — exactly the cost the standby
+    design moves off the critical path. The PR 15 resize row
+    (``pod_resize_seconds``) lands alongside in the same artifact as
+    the membership-change baseline."""
+    import asyncio
+    import threading
+
+    try:
+        import grpc  # noqa: F401
+    except ImportError:
+        print("bench_pod_join: grpc unavailable, skipped",
+              file=sys.stderr)
+        return
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.server.standby import WarmStandby
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    limits = [Limit("bench_join", 1 << 30, 3600, [], ["u"], name="u")]
+    users = [f"u{i}" for i in range(256)]
+
+    def run_arm(warm_before):
+        ports = [_free_port() for _ in range(3)]
+        addrs = {h: f"127.0.0.1:{ports[h]}" for h in range(3)}
+        lanes, fronts = [], []
+        for host in range(3):
+            member = host < 2
+            cfg = PodResilience(
+                degraded=True, retry=True, breaker_failures=2,
+                breaker_reset_s=0.2, probe_interval_s=0.2,
+            )
+            lane = PeerLane(
+                host if member else 0, addrs[host],
+                {o: addrs[o] for o in range(2) if member and o != host},
+                None, resilience=cfg,
+            )
+            lane.start()
+            front = PodFrontend(
+                RateLimiter(InMemoryStorage(65536)),
+                PodRouter(PodTopology(
+                    hosts=2 if member else 1,
+                    host_id=host if member else 0,
+                    shards_per_host=1,
+                )),
+                lane, resilience=cfg,
+            )
+            coordinator = PodResizeCoordinator(
+                front,
+                peers=(
+                    {h: addrs[h] for h in range(2)} if member else {}
+                ),
+                listen_address=addrs[host],
+            )
+            front.attach_resize(coordinator)
+            if member:
+                asyncio.run(front.configure_with(limits))
+            lanes.append(lane)
+            fronts.append(front)
+        # small kernel set keeps the bench quick; both arms compile the
+        # SAME set so cold-vs-warm isolates placement, not workload
+        standby = WarmStandby(
+            fronts[2], fronts[2].resize, warm_buckets=(8, 16)
+        )
+        compile_s = None
+        if warm_before:
+            standby.warm()
+            compile_s = standby.warm_seconds
+        # a little pre-join traffic so the pod is live, not idle
+        for user in users[:32]:
+            asyncio.run(fronts[0].check_rate_limited_and_update(
+                "bench_join", Context({"u": user}), 1, False
+            ))
+        t0 = time.perf_counter()
+        out = fronts[0].resize.join_host(addrs[2])
+        if not warm_before:
+            # the compile a cold joiner pays before its first decision
+            standby.warm()
+            compile_s = standby.warm_seconds
+        ttfd = None
+        for user in users:
+            key = (limits[0]._identity, (("u", user),))
+            if fronts[0].router.topology.owner_host(key) != 2:
+                continue
+            asyncio.run(fronts[0].check_rate_limited_and_update(
+                "bench_join", Context({"u": user}), 1, False
+            ))
+            ttfd = round(time.perf_counter() - t0, 3)
+            break
+        # routed-share-1: ring-hash arrivals on the NEW topology until
+        # the pod-wide local share converges (the upstream re-learned
+        # GET /debug/pod/routing and every key lands at its owner)
+        share1_s = None
+        for _ in range(50):
+            before = [f.router.stats() for f in fronts]
+            for user in users:
+                key = (limits[0]._identity, (("u", user),))
+                owner = fronts[0].router.topology.owner_host(key)
+                asyncio.run(
+                    fronts[owner].check_rate_limited_and_update(
+                        "bench_join", Context({"u": user}), 1, False
+                    )
+                )
+            after = [f.router.stats() for f in fronts]
+            local = sum(
+                a["pod_routed_local"] - b["pod_routed_local"]
+                for a, b in zip(after, before)
+            )
+            total = sum(
+                sum(a[k] - b[k] for k in (
+                    "pod_routed_local", "pod_routed_forwarded",
+                    "pod_routed_pinned",
+                ))
+                for a, b in zip(after, before)
+            )
+            if total and local / total >= 0.99:
+                share1_s = round(time.perf_counter() - t0, 3)
+                break
+        joiner_stats = fronts[2].resize.stats()
+        for lane in lanes:
+            lane.stop()
+        return {
+            "ok": bool(out.get("ok")),
+            "ttfd_s": ttfd,
+            "time_to_routed_share_1_s": share1_s,
+            "join_seconds": out.get("join_seconds"),
+            "seeded": out.get("seeded"),
+            "compile_s": compile_s,
+            "joiner_ttfd_s": joiner_stats.get("join_ttfd_seconds"),
+        }
+
+    cold = run_arm(warm_before=False)
+    warm = run_arm(warm_before=True)
+    emit(
+        "pod_join_ttfd_seconds", warm["ttfd_s"] or 0.0, "s", 1.0,
+        ndigits=3, lower_is_better=True,
+        pod_join_warm=warm,
+        pod_join_cold=cold,
+        pod_join_hosts="2->3",
+        pod_join_warm_buckets=[8, 16],
+        device_backed=device_backed(),
+    )
+    print(
+        f"pod join 2->3: warm ttfd {warm['ttfd_s']}s "
+        f"(routed-share-1 {warm['time_to_routed_share_1_s']}s, "
+        f"{warm['seeded']} plans seeded), cold ttfd {cold['ttfd_s']}s "
+        f"(compile {cold['compile_s']}s inside the window)",
         file=sys.stderr,
     )
 

@@ -20,6 +20,7 @@ frames and no GIL.
 from __future__ import annotations
 
 import ctypes
+import struct
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -156,6 +157,11 @@ def _bind(lib) -> None:
     lib.hp_plan_invalidate_slot.argtypes = [ctypes.c_void_p, ctypes.c_int64]
     lib.hp_plan_count.restype = ctypes.c_int64
     lib.hp_plan_count.argtypes = [ctypes.c_void_p]
+    if hasattr(lib, "hp_plan_export"):  # pre-ISSUE-18 prebuilt binary
+        lib.hp_plan_export.restype = ctypes.c_int64
+        lib.hp_plan_export.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+        ]
     lib.hp_lane_stats.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     # -- quota leasing (lease/broker.py drives these under the native
     # lock; consume itself rides hp_hot_begin) -------------------------
@@ -497,6 +503,60 @@ class HostPath:
         keys = ("hits", "misses", "staged_hits", "insertions",
                 "invalidations", "overflows", "plans", "epoch", "foreign")
         return dict(zip(keys, out.tolist()))
+
+    def plan_export(self) -> list:
+        """Snapshot every live mirror entry (ISSUE 18 plan-seed lane).
+
+        Tokens in the C table (ns_token, the rec name column) are THIS
+        process's interner values, and device slots are host-local — so
+        the snapshot resolves both to strings here and ships {blob,
+        kind, ns, delta, delta_capped, owner, hits:[{slot, max,
+        window_ms, bucket, name}]}. An importer replays entries through
+        NativeHotLane.plan_put with its own tokens/slots; a raw byte
+        copy between processes would alias unrelated strings."""
+        if not self._ctx or not hasattr(self._lib, "hp_plan_export"):
+            return []
+        need = self._lib.hp_plan_export(self._ctx, None, 0)
+        if need <= 0:
+            return []
+        buf = (ctypes.c_uint8 * need)()
+        got = self._lib.hp_plan_export(self._ctx, buf, need)
+        if got <= 0 or got > need:
+            return []  # mirror grew between probe and copy; skip seed
+        raw = bytes(buf[:got])
+        (count,) = struct.unpack_from("<q", raw, 0)
+        off = 8
+        out = []
+        for _ in range(count):
+            (blob_len,) = struct.unpack_from("<i", raw, off)
+            off += 4
+            blob = raw[off:off + blob_len]
+            off += blob_len
+            kind, ns_token, delta, delta_capped, owner, nhits = (
+                struct.unpack_from("<6i", raw, off)
+            )
+            off += 24
+            hits = []
+            for _h in range(nhits):
+                slot, mx, window_ms, bucket, name_token = (
+                    struct.unpack_from("<5i", raw, off)
+                )
+                off += 20
+                try:
+                    name = self.string(name_token) if name_token >= 0 else None
+                except KeyError:
+                    name = None
+                hits.append({"slot": slot, "max": mx,
+                             "window_ms": window_ms, "bucket": bucket,
+                             "name": name})
+            try:
+                ns = self.string(ns_token) if ns_token >= 0 else None
+            except KeyError:
+                ns = None
+            out.append({"blob": blob, "kind": kind, "ns": ns,
+                        "delta": delta, "delta_capped": delta_capped,
+                        "owner": owner, "hits": hits})
+        return out
 
     # -- pod ownership mirror (ISSUE 13) -------------------------------------
 

@@ -88,6 +88,16 @@ EVENT_KINDS = (
     # (detail carries promoted/demoted counts, backlog and the
     # model-priced benefit of the round)
     "tier_migration",
+    # fast join (ISSUE 18): a warm standby's promotion into the pod.
+    # Causal chain per join: join_begin < epoch_bump < join_end (the
+    # drill asserts it on the merged timeline); standby_ready marks
+    # the standby's warm-up complete (mesh formed, kernels compiled),
+    # plan_seeded one shipped plan-cache seed applied (or discarded
+    # stale) on the joiner.
+    "join_begin",
+    "join_end",
+    "standby_ready",
+    "plan_seeded",
 )
 
 

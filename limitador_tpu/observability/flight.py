@@ -72,9 +72,14 @@ METRIC_FAMILIES = (
 
 #: the serving lanes one decision can ride, in tap order: the
 #: zero-Python native hot lane, the lean batched device path, a pod
-#: forward (either side of the hop), the degraded-owner stand-in, and
-#: a cold-tier decide (exact host cell for a non-resident key).
-FLIGHT_LANES = ("native_hot", "lean", "pod_forward", "degraded", "cold_tier")
+#: forward (either side of the hop), the degraded-owner stand-in, a
+#: cold-tier decide (exact host cell for a non-resident key), and a
+#: just-promoted joiner's first answered decision (ISSUE 18 — the
+#: time-to-first-decision exemplar an incident bundle shows next to
+#: the join_begin/join_end timeline).
+FLIGHT_LANES = (
+    "native_hot", "lean", "pod_forward", "degraded", "cold_tier", "join",
+)
 
 #: the closed trigger-reason set (bounded Prometheus label values)
 TRIGGER_REASONS = (

@@ -858,6 +858,64 @@ class PrometheusMetrics:
             "in-band under the adopted topology",
             registry=self.registry,
         )
+        # -- fast join (server/resize.py join surface, ISSUE 18):
+        # warm-standby promotion counters, polled off the pod
+        # frontend's library_stats. Registered in
+        # resize.METRIC_FAMILIES (lint cross-checked).
+        self.join_completed = Counter(
+            "join_completed",
+            "Warm-standby joins this host initiated that completed "
+            "(grow or replace mode)",
+            registry=self.registry,
+        )
+        self.join_aborted = Counter(
+            "join_aborted",
+            "Warm-standby joins that failed at the state ship or "
+            "whose membership transition aborted",
+            registry=self.registry,
+        )
+        self.join_seconds = Counter(
+            "join_seconds",
+            "Cumulative seconds spent driving warm-standby joins "
+            "(join_begin to join_end, state ship included)",
+            registry=self.registry,
+        )
+        self.join_seed_entries = Counter(
+            "join_seed_entries",
+            "Plan-cache seed entries joiners applied from this "
+            "host's shipped decision-plan exports",
+            registry=self.registry,
+        )
+        self.join_ttfd_seconds = Gauge(
+            "join_ttfd_seconds",
+            "Time from this host's join adopt to its first answered "
+            "decision (the joiner-side time-to-first-decision; 0 = "
+            "never joined)",
+            registry=self.registry,
+        )
+        # -- warm standby (server/standby.py, ISSUE 18): the
+        # pre-join warm-up plane. Registered in
+        # standby.METRIC_FAMILIES (lint cross-checked).
+        self.standby_ready = Gauge(
+            "standby_ready",
+            "1 once this standby's warm-up finished (host mesh "
+            "formed, pow2 hit-bucket kernels compiled) and the join "
+            "callbacks are armed",
+            registry=self.registry,
+        )
+        self.standby_warm_kernels = Gauge(
+            "standby_warm_kernels",
+            "Decision kernels pre-compiled during standby warm-up "
+            "(check+update per pow2 hit bucket)",
+            registry=self.registry,
+        )
+        self.standby_warm_seconds = Gauge(
+            "standby_warm_seconds",
+            "Seconds the standby's kernel warm-up took (served from "
+            "the persistent XLA cache on a re-boot when "
+            "--xla-cache-dir is set)",
+            registry=self.registry,
+        )
         # -- flight recorder (observability/flight.py, ISSUE 16): the
         # always-on decision exemplar rings + triggered incident
         # bundles, fed by the recorder's render hook. Registered in
@@ -1336,12 +1394,28 @@ class PrometheusMetrics:
                 self.pod_resize_active.set(
                     int(stats["pod_resize_active"])
                 )
+            # fast join / warm standby (ISSUE 18): gauges set directly
+            if "join_ttfd_seconds" in stats:
+                self.join_ttfd_seconds.set(
+                    float(stats["join_ttfd_seconds"])
+                )
+            if "standby_ready" in stats:
+                self.standby_ready.set(int(stats["standby_ready"]))
+            if "standby_warm_kernels" in stats:
+                self.standby_warm_kernels.set(
+                    int(stats["standby_warm_kernels"])
+                )
+            if "standby_warm_seconds" in stats:
+                self.standby_warm_seconds.set(
+                    float(stats["standby_warm_seconds"])
+                )
             # float-valued cumulative counters (seconds): same baseline
             # conversion as below, without the int truncation
             for key in (
                 "pod_failover_reconcile_seconds",
                 "pod_failover_seconds",
                 "pod_resize_seconds",
+                "join_seconds",
             ):
                 if key in stats:
                     seen_f = float(stats[key])
@@ -1403,6 +1477,9 @@ class PrometheusMetrics:
                 "pod_resize_released_counters",
                 "pod_resize_stale_rejects",
                 "pod_resize_replans",
+                "join_completed",
+                "join_aborted",
+                "join_seed_entries",
             ):
                 if key in stats:
                     seen = int(stats[key])

@@ -87,6 +87,9 @@ __all__ = [
     "pod_sync",
     "pod_barrier",
     "PodPsumLane",
+    "PodMembership",
+    "PeerPsumTransport",
+    "make_host_mesh",
     "METRIC_FAMILIES",
 ]
 
@@ -220,11 +223,90 @@ def pod_info() -> PodInfo:
     )
 
 
+class PodMembership:
+    """The pod's membership as a pure control-plane record (ISSUE 18).
+
+    Under per-host meshes nothing about the device plane encodes which
+    hosts are in the pod — that fact lives here: (hosts, host_id,
+    peers, topology_epoch), flipped by the resize/join coordinator
+    under commit and observed by subscribers (the warm standby's
+    "am I live yet" signal, metrics). A flip is O(listeners): no jax
+    re-form, no process restart — the property the sub-second join
+    rides. `jax.process_count()`-style facts keep coming from the
+    local runtime (always 1 process in per-host mode); THIS is the
+    source of truth for pod-level membership."""
+
+    def __init__(self, hosts: int = 1, host_id: int = 0,
+                 peers=(), epoch: int = 0):
+        self._lock = threading.Lock()
+        self.hosts = int(hosts)
+        self.host_id = int(host_id)
+        self.peers = tuple(peers)
+        self.epoch = int(epoch)
+        self._listeners = []
+
+    def subscribe(self, fn) -> None:
+        """fn(membership) after every apply(); called outside the
+        lock (a listener may read snapshot())."""
+        with self._lock:
+            self._listeners.append(fn)
+
+    def apply(self, hosts: int, host_id: int, peers=(),
+              epoch: Optional[int] = None) -> dict:
+        with self._lock:
+            self.hosts = int(hosts)
+            self.host_id = int(host_id)
+            self.peers = tuple(peers)
+            self.epoch = (
+                self.epoch + 1 if epoch is None else int(epoch)
+            )
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(self)
+            except Exception:  # a bad listener must not fail a commit
+                pass
+        return self.snapshot()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hosts": self.hosts,
+                "host_id": self.host_id,
+                "peers": list(self.peers),
+                "epoch": self.epoch,
+            }
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+def make_host_mesh(axis: str = "shard") -> Mesh:
+    """The PER-HOST mesh (ISSUE 18): this process's devices only, no
+    matter how many hosts the pod has. Every pod member's device plane
+    is one of these — membership is a pure control-plane fact
+    (:class:`PodMembership` / routing.PodTopology) that the resize
+    coordinator flips without re-forming any jax runtime, and
+    cross-host reads ride the PeerLane (forwarded/bulk decisions) and
+    the psum lane instead of cross-host device collectives. Identical
+    geometry whether or not `jax.distributed` was ever initialized, so
+    a warm standby can form (and compile against) this mesh long
+    before it knows which pod it will join."""
+    return Mesh(jax.local_devices(), (axis,))
+
+
 def make_global_mesh(axis: str = "shard") -> Mesh:
     """The pod-wide mesh: every device of every process on one shard
     axis, ordered so each host's addressable devices form a contiguous
     block (global shard `g` belongs to host `g // local_device_count` —
-    the contract routing.PodTopology encodes)."""
+    the contract routing.PodTopology encodes).
+
+    Since ISSUE 18 this is the LEGACY formation: it requires the
+    stop-the-world `jax.distributed` pod (fixed num_processes at boot),
+    so the serving stack prefers per-host meshes (`make_host_mesh`)
+    with the PeerLane for cross-host reads — the jax.distributed bench
+    and parity harnesses are its remaining users."""
     procs = sorted(
         {d.process_index for d in jax.devices()}
     )
@@ -922,3 +1004,93 @@ class PodPsumLane:
                 "pod_psum_cells": len(self._cells),
                 "pod_psum_remote_slots": live_remote,
             }
+
+
+class PeerPsumTransport:
+    """PeerLane-backed exchange for :class:`PodPsumLane` (ISSUE 18).
+
+    Under per-host meshes there is no `jax.distributed` coordination
+    client, so the psum lane's KV+barrier transport is unavailable —
+    this transport replaces it with a push over the pod's gRPC peer
+    lane. The contract loosens from barrier-lockstep to PACED: each
+    host publishes its newest partials every round (``send(host_id,
+    payload)`` — peering wires it to a ``kind:"psum_share"`` unary)
+    and folds the newest payload it has RECEIVED from each peer
+    (``receive()`` is the lane handler's delivery). A missing peer
+    contributes None (the fold skips it), so a dead host costs
+    staleness bounded by the pacing interval instead of stalling a
+    pod-wide barrier.
+
+    The pacer-death safety contract carries over: a peer whose
+    payloads stop arriving ages out after ``stale_after_s`` (its
+    partials fold as zero — bounded over-admission, the same blind
+    spot a slow barrier round had), and when EVERY peer has been
+    silent for ``dead_after_rounds`` consecutive rounds the transport
+    raises, routing the lane through its unclaim path — N hosts must
+    not each admit the full limit against a permanently-zero base."""
+
+    def __init__(self, host_id: int, send, hosts: int = 1,
+                 stale_after_s: float = 2.0,
+                 dead_after_rounds: int = 8, clock=time.time):
+        self.host_id = int(host_id)
+        self.hosts = int(hosts)
+        self._send = send
+        self._stale_after_s = float(stale_after_s)
+        self._dead_after_rounds = int(dead_after_rounds)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rx: dict = {}  # host -> (recv_monotonic, payload)
+        self._silent_rounds = 0
+        self.published = 0
+        self.send_errors = 0
+
+    def attach(self, hosts: int, host_id: Optional[int] = None) -> None:
+        """Membership flip (resize/join commit): widen or shrink the
+        fold without dropping already-received payloads."""
+        with self._lock:
+            self.hosts = int(hosts)
+            if host_id is not None:
+                self.host_id = int(host_id)
+            self._silent_rounds = 0
+
+    def receive(self, host: int, payload: bytes) -> None:
+        """Lane delivery: a peer's published partials."""
+        with self._lock:
+            self._rx[int(host)] = (self._clock(), payload)
+
+    def __call__(self, round_idx: int, payload: bytes):
+        with self._lock:
+            hosts, host_id = self.hosts, self.host_id
+        for h in range(hosts):
+            if h == host_id:
+                continue
+            try:
+                self._send(h, payload)
+            except Exception:
+                self.send_errors += 1
+        self.published += 1
+        now = self._clock()
+        out = []
+        fresh_peers = 0
+        with self._lock:
+            for h in range(hosts):
+                if h == host_id:
+                    out.append(payload)
+                    continue
+                got = self._rx.get(h)
+                if got is None or now - got[0] > self._stale_after_s:
+                    out.append(None)
+                else:
+                    out.append(got[1])
+                    fresh_peers += 1
+            if hosts > 1 and fresh_peers == 0:
+                self._silent_rounds += 1
+            else:
+                self._silent_rounds = 0
+            if (hosts > 1
+                    and self._silent_rounds >= self._dead_after_rounds):
+                raise RuntimeError(
+                    "peer psum transport: every peer silent for "
+                    f"{self._silent_rounds} rounds; unclaiming"
+                )
+        return out

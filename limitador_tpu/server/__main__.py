@@ -368,6 +368,27 @@ def build_parser() -> argparse.ArgumentParser:
         "updates (an abort reverts to the old topology). off "
         "(default) = byte-identical PR 14 wire format and behavior",
     )
+    # warm standby & fast join (docs/configuration.md "Warm standby &
+    # fast join", ISSUE 18)
+    p.add_argument(
+        "--standby", choices=["on", "off"],
+        default=_env("TPU_POD_STANDBY", "off"),
+        help="pod: on = boot as a warm standby — form the host-local "
+        "mesh, pre-compile the pow2 hit-bucket decision kernels, serve "
+        "the peer lane, and wait for a coordinator's join_admin adopt "
+        "(POST /debug/pod/join on any member promotes this host in "
+        "under a second). Requires --pod-resize on wiring; off "
+        "(default) = byte-identical PR 17 construction and wire "
+        "format",
+    )
+    p.add_argument(
+        "--xla-cache-dir", default=_env("TPU_XLA_CACHE_DIR", ""),
+        help="persistent XLA compilation cache directory "
+        "(jax.config.jax_compilation_cache_dir): compiled programs "
+        "survive process restarts, so a warm standby — or ANY "
+        "restarting host — skips recompiling kernels it has compiled "
+        "before; empty (default) = in-memory jit cache only",
+    )
     # tiered storage (docs/configuration.md "Tiered storage", ISSUE 17):
     # device-resident hot set over an exact host cold tier
     p.add_argument(
@@ -759,9 +780,9 @@ def _pod_local_mesh():
     import jax
 
     if jax.process_count() > 1:
-        from ..parallel import make_mesh
+        from ..parallel import make_host_mesh
 
-        return make_mesh(jax.local_devices())
+        return make_host_mesh()
     return None
 
 
@@ -1078,6 +1099,27 @@ async def _amain(args) -> int:
 
     model_mod.set_model_fit_enabled(args.model_fit == "on")
 
+    # Persistent XLA compilation cache (ISSUE 18): armed BEFORE pod
+    # formation / any jit so every compile this process does lands in
+    # (or is served from) the on-disk cache — the cross-restart half of
+    # the warm-standby story, and a straight warm-up win for ANY
+    # restarting host.
+    if args.xla_cache_dir:
+        import jax
+
+        os.makedirs(args.xla_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", args.xla_cache_dir)
+        # cache everything: the default heuristics skip "fast" compiles,
+        # which is exactly the pow2 bucket fleet a standby re-pays
+        try:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.0
+            )
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        except Exception:
+            pass  # knob names vary across jax versions; dir alone works
+        log.info(f"persistent XLA compilation cache: {args.xla_cache_dir}")
+
     # Pod formation MUST precede any storage/jax work: after
     # jax.distributed.initialize the device list is pod-global and the
     # sharded branch picks the host-local mesh off it. Snapshot and
@@ -1323,6 +1365,75 @@ async def _amain(args) -> int:
                 "pod psum lane: lockstep exchange every "
                 f"{max(args.pod_psum_interval_ms, 10.0):.0f}ms "
                 f"(global namespaces: {sorted(pod_global_ns)})")
+    if args.standby == "on":
+        if pod_frontend is not None:
+            log.warning(
+                "--standby on ignored: this process already formed a "
+                "pod (a member is not a standby)")
+        else:
+            # Warm standby (ISSUE 18): a single-host boot that forms
+            # its host-local mesh, pre-compiles the pow2 hit-bucket
+            # kernels and serves the peer lane memberless — hosts=1 /
+            # host_id=0 is provisional, overwritten when a running
+            # pod's join_host ships the real topology over the
+            # join_admin lane kind.
+            from ..routing import PodRouter, PodTopology  # noqa: lazy per-branch
+            from .peering import PeerLane, PodFrontend, PodResilience  # noqa: lazy per-branch
+            from .resize import PodResizeCoordinator  # noqa: lazy per-branch
+            from .standby import WarmStandby
+
+            degraded = args.pod_degraded_mode == "on"
+            resilience = PodResilience(
+                degraded=degraded,
+                retry=degraded,
+                hedge_ms=max(args.pod_hedge_ms, 0.0),
+                breaker_failures=args.pod_peer_breaker_failures,
+                breaker_reset_s=args.pod_peer_breaker_reset_ms / 1e3,
+                probe_interval_s=float(
+                    _env("TPU_POD_PROBE_MS", "500")
+                ) / 1e3,
+            )
+            standby_listen = (
+                args.pod_peer_listen
+                or f"{args.rls_host}:{args.rls_port + 2}"
+            )
+            lane = PeerLane(
+                0, standby_listen, {}, None, resilience=resilience,
+            )
+            router = PodRouter(PodTopology(
+                hosts=1, host_id=0, shards_per_host=1,
+            ))
+            pod_frontend = PodFrontend(
+                limiter, router, lane,
+                global_namespaces={
+                    ns for ns in
+                    (args.global_namespaces or "").split(",") if ns
+                },
+                resilience=resilience,
+                events_capacity=max(args.pod_events, 1),
+            )
+            limiter = pod_frontend
+            coordinator = PodResizeCoordinator(
+                pod_frontend,
+                peers={},
+                listen_address=standby_listen,
+                transition_timeout_s=float(
+                    _env("TPU_POD_RESIZE_TIMEOUT_S", "60") or 60
+                ),
+            )
+            pod_frontend.attach_resize(coordinator)
+            standby = WarmStandby(
+                pod_frontend, coordinator,
+                table_capacity=(
+                    args.tpu_capacity
+                    if args.storage in ("tpu", "sharded") else None
+                ),
+            )
+            standby.warm()
+            log.info(
+                f"warm standby: peer lane at {standby_listen}, "
+                "waiting for a coordinator's join "
+                "(POST /debug/pod/join on any pod member)")
     counters_storage = limiter.storage.counters
     # Prefer the limiter (the compiled pipeline aggregates its storage's
     # stats and adds compiler eval counters); otherwise the storage itself.

@@ -53,6 +53,15 @@ Beyond the reference surface, the device-plane debug endpoints
                             counts, migration/backlog accounting,
                             cold-decide latency and the model-priced
                             row costs (404 when --tier-mode off)
+    GET  /debug/pod/standby warm-standby state: compiled kernel
+                            buckets, warm-up seconds, join readiness
+                            and time-to-first-decision (404 when
+                            --standby off)
+    POST /debug/pod/join    promote a warm standby into the pod:
+                            {"address"} grows by one host; adding
+                            "replace": <dead id> re-points a dead
+                            member with zero slice movement (404 when
+                            --pod-resize off)
 
 POST bodies are CheckAndReportInfo: {"namespace", "values": {str: str},
 "delta", "response_headers": optional "DRAFT_VERSION_03"}
@@ -108,6 +117,9 @@ DEBUG_SOURCE_SECTIONS = (
     # elastic pod (ISSUE 15): the live-resize state machine —
     # transition state, received-slice ledger, topology epoch
     ("pod_resize", "resize_debug"),
+    # warm standby (ISSUE 18): warm-up state (compiled kernel buckets,
+    # warm seconds) and join readiness / time-to-first-decision
+    ("standby", "standby_debug"),
     # flight recorder (ISSUE 16): exemplar-ring occupancy, trigger
     # tallies, pending peer retries and the bundle spool
     ("flight", "flight_debug"),
@@ -138,6 +150,7 @@ DEBUG_STATS_SECTIONS = (
     "pod_routing",
     "capacity",
     "pod_resize",
+    "standby",
     "flight",
     "tiering",
 )
@@ -343,6 +356,32 @@ def _openapi_spec() -> dict:
                         "409": {"description": "refused or aborted"},
                     },
                 },
+            },
+            "/debug/pod/standby": {
+                "get": {
+                    "summary": "Warm standby: warm-up state (compiled "
+                               "kernel buckets, seconds), join "
+                               "readiness and time-to-first-decision",
+                    "responses": {
+                        "200": {"description": "standby status"},
+                        "404": {"description": "not a warm standby"},
+                    },
+                }
+            },
+            "/debug/pod/join": {
+                "post": {
+                    "summary": "Promote a warm standby into the pod: "
+                               "{address} grows by one host; {address, "
+                               "replace: id} re-points a dead member "
+                               "with zero slice movement",
+                    "responses": {
+                        "200": {"description": "join complete"},
+                        "400": {"description": "malformed request"},
+                        "404": {"description": "not a pod or "
+                                               "--pod-resize off"},
+                        "409": {"description": "refused or aborted"},
+                    },
+                }
             },
             "/debug/capacity": {
                 "get": {
@@ -787,6 +826,60 @@ class _Api:
             return web.json_response({"error": str(exc)}, status=500)
         return web.json_response(out, status=200 if out.get("ok") else 409)
 
+    async def get_debug_pod_standby(
+        self, request: web.Request
+    ) -> web.Response:
+        """Warm-standby state (ISSUE 18): warm-up progress (compiled
+        kernel buckets, seconds), join readiness and — after a
+        promotion — the joiner's time-to-first-decision."""
+        fn = self._debug_source_fn("standby_debug")
+        out = fn() if fn is not None else None
+        if out is None or not out.get("armed"):
+            return web.json_response(
+                {"error": "not a warm standby (--standby off)"},
+                status=404,
+            )
+        return web.json_response(out)
+
+    async def post_debug_pod_join(
+        self, request: web.Request
+    ) -> web.Response:
+        """Promote a warm standby into the running pod:
+        ``{"address": "host:port"}`` grows the pod by one host (the
+        standby becomes the next host id); ``{"address": ...,
+        "replace": <dead id>}`` re-points a dead member's host id at
+        the standby with zero slice movement. Blocks until the join
+        completes or aborts (docs/configuration.md, "Warm standby &
+        fast join")."""
+        _out, err = self._resize_coordinator()
+        if err is not None:
+            return err
+        try:
+            data = await request.json()
+            address = str(data["address"])
+            replace = data.get("replace")
+            if replace is not None:
+                replace = int(replace)
+            seed_plans = bool(data.get("seed_plans", True))
+        except (KeyError, ValueError, TypeError) as exc:
+            return web.json_response(
+                {"error": f"bad request: {exc}"}, status=400
+            )
+        join_fn = self._debug_source_fn("pod_join_admin")
+        loop = asyncio.get_running_loop()
+        try:
+            out = await loop.run_in_executor(
+                None,
+                lambda: join_fn(
+                    address, replace=replace, seed_plans=seed_plans
+                ),
+            )
+        except ValueError as exc:
+            return web.json_response({"error": str(exc)}, status=409)
+        except StorageError as exc:
+            return web.json_response({"error": str(exc)}, status=500)
+        return web.json_response(out, status=200 if out.get("ok") else 409)
+
     async def get_debug_capacity(
         self, request: web.Request
     ) -> web.Response:
@@ -1073,6 +1166,8 @@ def make_http_app(
     app.router.add_get("/debug/pod/routing", api.get_debug_pod_routing)
     app.router.add_get("/debug/pod/resize", api.get_debug_pod_resize)
     app.router.add_post("/debug/pod/resize", api.post_debug_pod_resize)
+    app.router.add_get("/debug/pod/standby", api.get_debug_pod_standby)
+    app.router.add_post("/debug/pod/join", api.post_debug_pod_join)
     app.router.add_get("/debug/capacity", api.get_debug_capacity)
     app.router.add_get("/debug/events", api.get_debug_events)
     app.router.add_get("/debug/profile", api.get_debug_profile)

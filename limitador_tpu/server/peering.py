@@ -80,6 +80,7 @@ from __future__ import annotations
 import asyncio
 import base64
 import collections
+import hashlib
 import inspect
 import json
 import logging
@@ -518,6 +519,17 @@ class PeerLane:
         self.stale_info_provider: Optional[Callable[[], dict]] = None
         self.migrate_cb = None
         self.resize_cb = None
+        #: fast-join plane (ISSUE 18) attach points, None = PR 17 wire
+        #: and serve path byte-identical. ``plan_seed_cb(payload) ->
+        #: dict`` imports a shipped plan-cache seed (blocking cache
+        #: work — run off-loop); ``join_cb(payload) -> dict`` answers
+        #: join control ops on the coordinator (fast, lane loop);
+        #: ``psum_share_cb(host, raw)`` delivers a peer's published
+        #: psum partials to the PeerPsumTransport fold (dict store —
+        #: inline).
+        self.plan_seed_cb = None
+        self.join_cb = None
+        self.psum_share_cb = None
         #: callable(resp dict): a forward came back stale_epoch — the
         #: origin-side adoption hook (coordinator.adopt_remote)
         self.on_stale = None
@@ -691,14 +703,20 @@ class PeerLane:
         old = self.peers
         self.peers = peers
         self.health.set_peers(peers)
-        removed = [h for h in old if h not in peers]
-        if removed and self._loop is not None:
-            def _close_removed():
-                for host in removed:
+        # a host id whose ADDRESS moved (a standby adopting a dead
+        # member's id, ISSUE 18) must drop its cached channel too, or
+        # every call to the id keeps dialing the corpse
+        stale = [h for h in old if h not in peers] + [
+            h for h, addr in peers.items()
+            if h in old and old[h] != addr
+        ]
+        if stale and self._loop is not None:
+            def _close_stale():
+                for host in stale:
                     entry = self._channels.pop(host, None)
                     if entry is not None:
                         asyncio.ensure_future(entry[0].close())
-            self._loop.call_soon_threadsafe(_close_removed)
+            self._loop.call_soon_threadsafe(_close_stale)
 
     def admin_call(
         self, host: int, payload: dict, timeout: float = 5.0
@@ -764,6 +782,54 @@ class PeerLane:
             except Exception as exc:
                 out = {"ok": False, "error": f"{exc}"[:200]}
             return json.dumps(out).encode()
+        if kind == "join_admin":
+            # Fast-join control plane (ISSUE 18): limits ship /
+            # membership ops answered by the standby's joiner or the
+            # coordinator. Fast (state flip) — inline on the lane loop.
+            handler = self.join_cb
+            if handler is None:
+                return json.dumps({
+                    "ok": False, "error": "fast join not armed",
+                }).encode()
+            try:
+                out = handler(payload)
+                if inspect.isawaitable(out):
+                    # the joiner's "limits" op runs configure_with —
+                    # a coroutine on this very loop
+                    out = await out
+                out = out or {}
+            except Exception as exc:
+                out = {"ok": False, "error": f"{exc}"[:200]}
+            return json.dumps(out).encode()
+        if kind == "plan_seed":
+            # A peer shipping its plan-cache seed state (ISSUE 18).
+            # NOT topology-epoch gated: the seed lands on a joiner
+            # mid-adoption, and staleness is decided where it belongs —
+            # the cache's put() discards entries whose LIMITS epoch
+            # moved (a reload racing the ship). Blocking cache/slot
+            # work — off-loop like migrate.
+            handler = self.plan_seed_cb
+            if handler is None:
+                return json.dumps({
+                    "ok": False, "error": "fast join not armed",
+                }).encode()
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, handler, payload
+            )
+            return json.dumps(out or {"ok": True}).encode()
+        if kind == "psum_share":
+            # A peer's published psum partials (ISSUE 18, per-host
+            # meshes): one dict store — inline, never fails the RPC.
+            hook = self.psum_share_cb
+            if hook is not None:
+                try:
+                    hook(
+                        int(payload.get("from", -1)),
+                        base64.b64decode(payload.get("payload") or b""),
+                    )
+                except Exception:
+                    pass
+            return json.dumps({"ok": True}).encode()
         if kind == "migrate":
             # One migrated slice batch (absolute counter values; the
             # receiver applies diffs against its transition ledger).
@@ -1534,6 +1600,9 @@ class PodFrontend:
         #: PodResizeCoordinator (server/resize.py, ISSUE 15); None =
         #: PR 14 behavior byte-identical (no epoch stamping, no gate)
         self.resize = None
+        #: server/standby.WarmStandby (ISSUE 18); None = not a warm
+        #: standby (the default — join callbacks stay unarmed)
+        self.standby = None
         #: forwards answered stale_epoch that re-planned in-band
         self.stale_replans = 0
         #: the last applied limits generation — the resize coordinator
@@ -1612,6 +1681,91 @@ class PodFrontend:
         limits it can serve stop pinning to one host — every ingress
         decides them locally against the pod-wide psum aggregate."""
         self.psum_lane = lane
+
+    def attach_psum_transport(self, transport) -> None:
+        """Per-host meshes (ISSUE 18): wire a
+        parallel.PeerPsumTransport into this lane — peers' published
+        partials arrive through the ``psum_share`` kind, and our own
+        publishes ride the lane's admin_call from the psum pacer
+        thread (psum_share_sender below). The psum lane then needs no
+        `jax.distributed` coordination client at all."""
+        self.lane.psum_share_cb = transport.receive
+
+    # -- fast join: shipped plan caches (ISSUE 18) ---------------------------
+
+    def _limits_fingerprint(self) -> str:
+        """A stable digest of the applied limits generation: the
+        plan-seed ship stamps it so a seed derived under one limits
+        file never lands on a joiner that configured a different one
+        (the cross-process half of the stale-epoch contract — epoch
+        counters themselves are process-local)."""
+        from ..tpu.plan_cache import _limit_identity_to_wire
+
+        idents = sorted(
+            json.dumps(_limit_identity_to_wire(lim), sort_keys=True)
+            for lim in self._last_limits
+        )
+        return hashlib.sha256(
+            "\n".join(idents).encode()
+        ).hexdigest()[:16]
+
+    def plan_seed_export(self, max_entries: int = 4096) -> dict:
+        """This host's decision-plan cache as one shippable seed
+        payload (the coordinator sends it to a joiner over the
+        ``plan_seed`` lane kind). Kernel plans ship counter IDENTITY,
+        not slots — device slots are host-local; the importer
+        re-resolves each hit against its own table."""
+        cache = (
+            getattr(self.pipeline, "plan_cache", None)
+            if self.pipeline is not None else None
+        )
+        if cache is None:
+            return {"entries": [], "limits_fp": self._limits_fingerprint()}
+        table = self.pipeline.storage._table
+
+        def counter_of_slot(slot):
+            entry = table.info.get(slot)
+            return entry[1] if entry is not None else None
+
+        return {
+            "entries": cache.export_seed(
+                counter_of_slot, max_entries=max_entries
+            ),
+            "limits_fp": self._limits_fingerprint(),
+        }
+
+    def plan_seed_import(self, payload: dict) -> dict:
+        """The joiner side of a shipped seed: rebuild every entry
+        against OUR slot table and ride the cache's put() so a limits
+        reload racing the ship discards in flight (epoch moved).
+        A seed stamped with a different limits fingerprint is
+        discarded whole — it was derived under limits we never
+        applied."""
+        cache = (
+            getattr(self.pipeline, "plan_cache", None)
+            if self.pipeline is not None else None
+        )
+        if cache is None:
+            return {"ok": False, "error": "no plan cache attached"}
+        fp = payload.get("limits_fp")
+        if fp is not None and fp != self._limits_fingerprint():
+            self.events.emit("plan_seeded", seeded=0, stale=True)
+            return {"ok": True, "seeded": 0, "stale_limits": True}
+        storage = self.pipeline.storage
+
+        def slot_of_counter(counter):
+            with storage._lock:
+                slot, _fresh = storage._slot_for(counter, create=True)
+            return slot
+
+        entries = payload.get("entries") or ()
+        seeded = cache.import_seed(
+            entries, slot_of_counter, epoch=cache.epoch
+        )
+        self.events.emit(
+            "plan_seeded", entries=len(entries), seeded=seeded
+        )
+        return {"ok": True, "seeded": seeded}
 
     # -- elastic pod (ISSUE 15) ----------------------------------------------
 
@@ -1724,6 +1878,27 @@ class PodFrontend:
         if self.resize is None:
             raise StorageError("pod resize not armed (--pod-resize off)")
         return self.resize.resize(int(hosts), peers=peers)
+
+    def standby_debug(self) -> dict:
+        """``GET /debug/pod/standby`` + the ``standby`` /debug/stats
+        section (ISSUE 18): warm-up state and join readiness."""
+        if self.standby is None:
+            return {"armed": False}
+        out = self.standby.status()
+        out["armed"] = True
+        return out
+
+    def pod_join_admin(
+        self, address: str, replace=None, seed_plans: bool = True
+    ) -> dict:
+        """The admin surface behind ``POST /debug/pod/join``: promote
+        the warm standby at ``address`` into the pod (blocking — the
+        HTTP handler runs it in an executor)."""
+        if self.resize is None:
+            raise StorageError("pod resize not armed (--pod-resize off)")
+        return self.resize.join_host(
+            address, replace=replace, seed_plans=seed_plans
+        )
 
     async def forward_bulk(
         self, owner: int, blobs: List[bytes]
@@ -1943,6 +2118,32 @@ class PodFrontend:
         always terminal, one hop by construction). Matching runs once,
         here, and flows into the limiter's precomputed-counters entry
         point."""
+        rz = self.resize
+        if rz is not None and rz._join_adopted_at is not None:
+            # a just-promoted joiner's first answered decision (ISSUE
+            # 18): stamp time-to-first-decision and leave a join-lane
+            # exemplar in the flight ring. Self-disarming — one
+            # attribute read per forward once stamped.
+            t0 = time.perf_counter()
+            try:
+                return await self._decide_for_peer_inner(
+                    namespace, ctx, delta, load, kind
+                )
+            finally:
+                rz.note_first_decision()
+                if self.flight is not None:
+                    self.flight.tap(
+                        time.perf_counter() - t0, "join",
+                        request_id=current_request_id(),
+                        namespace=namespace,
+                    )
+        return await self._decide_for_peer_inner(
+            namespace, ctx, delta, load, kind
+        )
+
+    async def _decide_for_peer_inner(
+        self, namespace, ctx, delta, load, kind
+    ) -> Optional[CheckResult]:
         counters = _counters_that_apply(
             self._limiter.storage, Namespace.of(namespace), ctx
         )
@@ -2261,6 +2462,8 @@ class PodFrontend:
         if self.resize is not None:
             stats.update(self.resize.stats())
             stats["pod_resize_replans"] = self.stale_replans
+        if self.standby is not None:
+            stats.update(self.standby.stats())
         return stats
 
     def close_pod(self) -> None:
@@ -2290,3 +2493,20 @@ class _ForwardedCounter:
         self.expires_in = expires_in
         self.window_seconds = window
         self.limit = _ForwardedLimit(name)
+
+
+def psum_share_sender(lane: PeerLane, timeout: float = 2.0):
+    """The publish half of parallel.PeerPsumTransport over this lane:
+    a ``send(host, payload)`` callable for the transport's constructor.
+    Runs on the psum pacer thread (a dedicated daemon) — admin_call's
+    blocking control-plane RPC is fine there and never touches a
+    serving loop."""
+
+    def send(host: int, payload: bytes) -> None:
+        lane.admin_call(host, {
+            "kind": "psum_share",
+            "from": lane.host_id,
+            "payload": base64.b64encode(payload).decode(),
+        }, timeout=timeout)
+
+    return send

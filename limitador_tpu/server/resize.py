@@ -85,6 +85,14 @@ METRIC_FAMILIES = (
     "pod_resize_seconds",
     "pod_resize_stale_rejects",
     "pod_resize_replans",
+    # fast join (ISSUE 18): warm-standby promotion through join_host —
+    # counters on the initiator; ttfd/routed-share parity gauges are
+    # stamped by the joiner when it answers its first decision
+    "join_completed",
+    "join_aborted",
+    "join_seconds",
+    "join_seed_entries",
+    "join_ttfd_seconds",
 )
 
 
@@ -207,6 +215,18 @@ class PodResizeCoordinator:
         self.moved_deltas = 0
         self.released_counters = 0
         self.resize_seconds = 0.0
+        # fast-join counters (ISSUE 18; the join_* family feed). The
+        # initiator counts completions and shipped seed entries; the
+        # joiner stamps join_ttfd_seconds when its first decision
+        # answers after the adopt (0.0 = never joined / not a joiner).
+        self.joins_completed = 0
+        self.joins_aborted = 0
+        self.join_seconds = 0.0
+        self.join_seed_entries = 0
+        self.join_ttfd_seconds = 0.0
+        # set at join_admin "adopt" on the joiner; the first decision
+        # after it resolves the ttfd gauge (frontend calls note_first_decision)
+        self._join_adopted_at: Optional[float] = None
 
     # -- small accessors -------------------------------------------------------
 
@@ -440,6 +460,187 @@ class PodResizeCoordinator:
             raise ValueError("cannot drain a single-host pod")
         return self.resize(hosts - 1)
 
+    # -- fast join: warm-standby promotion (ISSUE 18) --------------------------
+
+    def join_host(
+        self,
+        address: str,
+        replace: Optional[int] = None,
+        seed_plans: bool = True,
+        max_seed_entries: int = 4096,
+    ) -> dict:
+        """Promote a warm standby at ``address`` into the pod, overlap
+        its state ship with serving. Two modes:
+
+        * grow (``replace=None``) — the standby becomes the next host
+          id; after the ship this is exactly :meth:`add_host` (the PR 15
+          migrate lane moves its shard slice, already overlapped with
+          serving), so the joiner answers forwards the moment the
+          commit broadcast lands — before its slice finishes copying.
+        * replace (``replace=<dead id>``) — the standby takes over a
+          dead member's host id at the SAME geometry: no slice moves,
+          only an epoch bump re-points the dead id's address and
+          re-plans in-flight forwards. The PR 11 journal replay
+          back-fills whatever the survivors admitted on the dead id's
+          behalf once their probes find the standby serving.
+
+        The ship itself (``_ship_join_state``) runs BEFORE any routing
+        changes: the standby adopts our CURRENT topology + epoch (so
+        the subsequent prepare's FROM-epoch check passes), configures
+        our limits generation, and imports the plan-cache seed — all
+        while the pod keeps serving on the old membership."""
+        started = time.time()
+        old = self.router.topology
+        if replace is None:
+            new_id = old.hosts
+            mode = "grow"
+        else:
+            new_id = int(replace)
+            mode = "replace"
+            if not (0 <= new_id < old.hosts):
+                raise ValueError(
+                    f"replace target {new_id} outside the "
+                    f"{old.hosts}-host topology"
+                )
+            if new_id == self.host_id:
+                raise ValueError("a host cannot replace itself")
+        self.frontend.events.emit(
+            "join_begin", mode=mode, joiner=new_id, address=address,
+        )
+        # the joiner must be dialable for the ship (and, in replace
+        # mode, this overwrites the dead member's address)
+        member_map = dict(self._peers)
+        member_map[new_id] = str(address)
+        self._peers = member_map
+        self.lane.set_peers({
+            h: a for h, a in member_map.items() if h != self.host_id
+        })
+        self.frontend.ensure_guards()
+        try:
+            seeded = self._ship_join_state(
+                new_id, seed_plans, max_seed_entries
+            )
+            if mode == "grow":
+                out = self.resize(
+                    old.hosts + 1, peers={new_id: address}
+                )
+            else:
+                out = self._drive_replace(new_id, member_map)
+        except Exception:
+            with self._lock:
+                self.joins_aborted += 1
+            self.frontend.events.emit(
+                "join_end", mode=mode, joiner=new_id, ok=False,
+            )
+            raise
+        seconds = time.time() - started
+        with self._lock:
+            if out.get("ok"):
+                self.joins_completed += 1
+            else:
+                self.joins_aborted += 1
+            self.join_seconds += seconds
+            self.join_seed_entries += seeded
+        self.frontend.events.emit(
+            "join_end", mode=mode, joiner=new_id,
+            ok=bool(out.get("ok")), seconds=round(seconds, 6),
+            seeded=seeded,
+        )
+        return {
+            **out, "mode": mode, "joiner": new_id,
+            "join_seconds": round(seconds, 6), "seeded": seeded,
+        }
+
+    def _ship_join_state(
+        self, host: int, seed_plans: bool, max_seed_entries: int
+    ) -> int:
+        """Ship the control-plane state a warm standby needs BEFORE the
+        membership flip: adopt (topology + epoch + peers + its host
+        id), limits (our applied generation, as identity wire), and —
+        overlap, not critical path — the plan-cache seed. Returns the
+        number of seed entries the joiner applied."""
+        topo = self.router.topology
+        resp = self.lane.admin_call(
+            host,
+            {
+                "kind": "join_admin", "op": "adopt",
+                "host_id": host,
+                "hosts": topo.hosts,
+                "shards_per_host": topo.shards_per_host,
+                "tepoch": self.router.topology_epoch,
+                "peers": {str(h): a for h, a in self._peers.items()},
+                "from": self.host_id,
+            },
+            timeout=self.migrate_timeout_s,
+        )
+        if not resp.get("ok"):
+            raise ValueError(
+                f"joiner {host} refused adopt: {resp.get('error')}"
+            )
+        from ..tpu.plan_cache import _limit_identity_to_wire
+
+        resp = self.lane.admin_call(
+            host,
+            {
+                "kind": "join_admin", "op": "limits",
+                "limits": [
+                    _limit_identity_to_wire(lim)
+                    for lim in self.frontend._last_limits
+                ],
+                "global_namespaces": sorted(self.frontend._global_ns),
+                "from": self.host_id,
+            },
+            timeout=self.migrate_timeout_s,
+        )
+        if not resp.get("ok"):
+            raise ValueError(
+                f"joiner {host} refused limits: {resp.get('error')}"
+            )
+        if not seed_plans:
+            return 0
+        seed = self.frontend.plan_seed_export(
+            max_entries=max_seed_entries
+        )
+        if not seed.get("entries"):
+            return 0
+        try:
+            resp = self.lane.admin_call(
+                host, {"kind": "plan_seed", **seed},
+                timeout=self.migrate_timeout_s,
+            )
+        except Exception as exc:
+            # the seed is an optimization, never a join blocker: a
+            # joiner without it just compiles its plans on first miss
+            log.warning(f"plan seed ship to joiner {host} failed: {exc}")
+            return 0
+        return int(resp.get("seeded", 0) or 0)
+
+    def _drive_replace(self, new_id: int, member_map) -> dict:
+        """Drive a same-geometry transition: the topology does not
+        change shape, only the member map (a dead host id now answers
+        at the standby's address) — so ``resize()``'s hosts==old noop
+        shortcut cannot express it. Zero slices move; the epoch bump is
+        what re-plans in-flight forwards stamped for the dead member
+        and re-arms every member's guards at the new address."""
+        old = self.router.topology
+        with self._lock:
+            if self.active or self._proposing:
+                raise ValueError("a pod resize is already in flight")
+            self._proposing = True
+            transition = _Transition(
+                old, old, member_map,
+                tepoch_from=self.router.topology_epoch,
+                tepoch_to=self.router.topology_epoch + 1,
+                initiator=self.host_id,
+            )
+        try:
+            return self._drive(
+                transition, range(old.hosts), member_map
+            )
+        finally:
+            with self._lock:
+                self._proposing = False
+
     # -- member-side protocol handlers (lane loop — keep them fast) -----------
 
     def handle_admin(self, payload: dict) -> dict:
@@ -548,6 +749,120 @@ class PodResizeCoordinator:
                 return {"ok": True, "state": "none"}
         self._complete(t)
         return {"ok": True}
+
+    # -- joiner-side fast-join handlers (ISSUE 18) -----------------------------
+
+    def handle_join(self, payload: dict):
+        """The standby's side of the state ship (``kind:"join_admin"``
+        lane RPC; armed by WarmStandby, never by attach_resize — the
+        default construction stays byte-identical to PR 17). ``limits``
+        returns a coroutine the lane dispatch awaits."""
+        op = payload.get("op")
+        if op == "adopt":
+            return self._handle_join_adopt(payload)
+        if op == "limits":
+            return self._handle_join_limits(payload)
+        if op == "status":
+            return {
+                "ok": True,
+                "host": self.host_id,
+                "tepoch": self.router.topology_epoch,
+                "hosts": self.router.topology.hosts,
+                "join_ttfd_seconds": self.join_ttfd_seconds,
+            }
+        return {"ok": False, "error": f"unknown join op {op!r}"}
+
+    def _handle_join_adopt(self, payload: dict) -> dict:
+        """Become host ``host_id`` of the shipped topology at its
+        CURRENT epoch — the membership flip as a pure control-plane
+        fact: no mesh reforms, no process restarts; the pre-formed
+        host-local mesh and warm kernels keep serving. After this the
+        initiator's prepare passes our FROM-epoch check and, in grow
+        mode, every key still routes away from us (our id is outside
+        the pre-grow geometry) until the commit lands."""
+        new_id = int(payload["host_id"])
+        tepoch = int(payload["tepoch"])
+        peers = {
+            int(h): str(a)
+            for h, a in (payload.get("peers") or {}).items()
+        }
+        with self._lock:
+            if self.active or self._proposing:
+                return {
+                    "ok": False,
+                    "error": "a pod resize is already in flight",
+                }
+            if tepoch < self.router.topology_epoch:
+                return {
+                    "ok": False,
+                    "error": (
+                        f"adopt would move the topology epoch backward "
+                        f"({self.router.topology_epoch} -> {tepoch})"
+                    ),
+                }
+            self.host_id = new_id
+            self.lane.host_id = new_id
+            fe = self.frontend
+            fe.events.host_id = new_id
+            fe.hops.host_id = new_id
+            fe.aggregator.host_id = new_id
+            if peers:
+                self._peers = peers
+            topo = PodTopology(
+                hosts=int(payload["hosts"]),
+                host_id=new_id,
+                shards_per_host=int(payload["shards_per_host"]),
+            )
+            self.router.retarget(topo, epoch=tepoch)
+            self._join_adopted_at = time.time()
+        self.lane.set_peers({
+            h: a for h, a in peers.items() if h != new_id
+        })
+        self.frontend.ensure_guards()
+        self.frontend.events.emit(
+            "epoch_bump", tepoch=tepoch, hosts=int(payload["hosts"]),
+            adopted=True, joiner=True,
+        )
+        return {"ok": True, "host": new_id, "tepoch": tepoch}
+
+    def _handle_join_limits(self, payload: dict):
+        """Configure the shipped limits generation (a coroutine — the
+        lane loop awaits it; configure_with is async because the inner
+        limiter may be). Limits arrive as identity wire dicts, the same
+        portable form the plan-seed blobs carry."""
+        from ..core import Limit
+
+        limits = []
+        for ident in payload.get("limits") or ():
+            limits.append(Limit(
+                ident["ns"], ident["max"], ident["seconds"],
+                list(ident.get("conditions") or ()),
+                list(ident.get("variables") or ()),
+                name=ident.get("name"), id=ident.get("id"),
+                policy=ident.get("policy") or "fixed_window",
+            ))
+        self.frontend._global_ns = {
+            str(ns) for ns in payload.get("global_namespaces") or ()
+        }
+
+        async def _apply():
+            await self.frontend.configure_with(limits)
+            return {"ok": True, "limits": len(limits)}
+
+        return _apply()
+
+    def note_first_decision(self) -> None:
+        """Stamp time-to-first-decision on the joiner: called from the
+        forwarded-decision path after a join adopt. Self-disarming —
+        one unlocked read once stamped."""
+        if self._join_adopted_at is None:
+            return
+        with self._lock:
+            adopted = self._join_adopted_at
+            if adopted is None:
+                return
+            self._join_adopted_at = None
+            self.join_ttfd_seconds = round(time.time() - adopted, 6)
 
     # -- the transition machinery ----------------------------------------------
 
@@ -1162,4 +1477,9 @@ class PodResizeCoordinator:
             "pod_resize_moved_deltas": self.moved_deltas,
             "pod_resize_released_counters": self.released_counters,
             "pod_resize_seconds": round(self.resize_seconds, 6),
+            "join_completed": self.joins_completed,
+            "join_aborted": self.joins_aborted,
+            "join_seconds": round(self.join_seconds, 6),
+            "join_seed_entries": self.join_seed_entries,
+            "join_ttfd_seconds": self.join_ttfd_seconds,
         }

@@ -54,14 +54,15 @@ __all__ = [
 #: leaf-ish outermost hold — no sync waits and no storage-plane
 #: acquisitions under it.
 TRACKED_DOMAINS = (
-    "peering", "tier", "broker", "native", "storage", "plan_cache",
-    "observatory",
+    "peering", "join", "tier", "broker", "native", "storage",
+    "plan_cache", "observatory",
 )
 
 #: the documented canonical acquisition order (outermost first); the
 #: graph may use any PREFIX-compatible subset, never the reverse
 CANONICAL_ORDER = (
-    "peering", "tier", "broker", "native", "storage", "plan_cache",
+    "peering", "join", "tier", "broker", "native", "storage",
+    "plan_cache",
 )
 
 #: attribute name -> domain, regardless of receiver (``_native_lock``
@@ -83,6 +84,11 @@ MODULE_SELF_DOMAINS = {
     # guards both tiers; only the migration thread owns the tier lock
     ("limitador_tpu/tier/storage.py", "_lock"): "storage",
     ("limitador_tpu/tier/manager.py", "_lock"): "tier",
+    # fast join (ISSUE 18): the membership plane's coordinator lock
+    # (resize + join share it — one membership state machine). It is
+    # held for state flips only; the ship/migrate RPCs, the kernel
+    # warm-up and every admin_call run OUTSIDE it.
+    ("limitador_tpu/server/resize.py", "_lock"): "join",
 }
 
 #: receiver NAME -> domain for cross-object acquisitions

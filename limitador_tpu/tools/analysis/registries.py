@@ -57,6 +57,11 @@ REGISTRY_OWNED_PREFIXES = {
     # tiered storage (ISSUE 17): per-tier residency, migration rates
     # and the cold-tier decide latency
     "tier_": "limitador_tpu/tier/__init__.py",
+    # fast join (ISSUE 18): the join counters live on the resize
+    # coordinator (one membership plane, one owner); the warm-up
+    # plane owns standby_*
+    "join_": "limitador_tpu/server/resize.py",
+    "standby_": "limitador_tpu/server/standby.py",
 }
 
 #: the native telemetry plane's phase registry module

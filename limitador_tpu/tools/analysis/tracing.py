@@ -85,6 +85,11 @@ HOT_MODULES = (
     # peek/seed helpers — tier/ is NOT a kernel owner.
     "limitador_tpu/tier/storage.py",
     "limitador_tpu/tier/manager.py",
+    # fast join (ISSUE 18): the joiner's decision-path surface is one
+    # attribute read per forwarded decision (the ttfd stamp hook);
+    # warm-up and the state ship run at boot / on the join driver
+    # thread and must never be named with a decision prefix.
+    "limitador_tpu/server/standby.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit
@@ -109,6 +114,10 @@ KERNEL_OWNER_MODULES = (
     "limitador_tpu/tpu/sharded.py",
     "limitador_tpu/tpu/replicated.py",
     "limitador_tpu/parallel/mesh.py",
+    # warm standby (ISSUE 18): warm-up intentionally drives the jitted
+    # kernels at every pow2 hit bucket (all-padding batches against a
+    # scratch table) so the serving path never pays the compile
+    "limitador_tpu/server/standby.py",
 )
 
 KERNEL_MODULE = "limitador_tpu/ops/kernel.py"

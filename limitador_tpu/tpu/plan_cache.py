@@ -41,9 +41,10 @@ families.
 
 from __future__ import annotations
 
+import base64
 import threading
 from collections import OrderedDict
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 __all__ = [
     "DecisionPlan",
@@ -54,6 +55,8 @@ __all__ = [
     "PLAN_OK",
     "PLAN_UNKNOWN",
     "PLAN_FOREIGN",
+    "plan_to_wire",
+    "plan_from_wire",
 ]
 
 #: Prometheus families owned by this subsystem (lint-enforced against
@@ -283,6 +286,172 @@ class DecisionPlanCache(_BaseCache):
     def _clear_locked(self) -> None:
         super()._clear_locked()
         self._by_slot.clear()
+
+    # -- plan seed (ISSUE 18: warm-standby fast join) -------------------------
+
+    def export_seed(self, counter_of_slot=None,
+                    max_entries: int = 4096) -> List[dict]:
+        """The portable seed of this cache: newest entries first (the
+        LRU tail is the live working set), bounded so one seed RPC
+        stays within the lane's receive cap. Kernel rows that cannot be
+        attributed to live counters are skipped (see plan_to_wire)."""
+        with self._lock:
+            items = list(self._entries.items())[-int(max_entries):]
+        out = []
+        for blob, plan in reversed(items):
+            wire = plan_to_wire(blob, plan, counter_of_slot)
+            if wire is not None:
+                out.append(wire)
+        return out
+
+    def import_seed(self, entries, slot_of_counter=None,
+                    epoch: Optional[int] = None) -> int:
+        """Replay a shipped seed through :meth:`put` under ``epoch``
+        (the limits epoch snapshotted when the ship started): a limits
+        reload racing the ship bumps the epoch and every row discards —
+        the existing stale-put contract, now covering whole seeds.
+        Returns the number of rows actually seeded."""
+        if epoch is None:
+            epoch = self.epoch
+        seeded = 0
+        for entry in entries:
+            try:
+                rebuilt = plan_from_wire(entry, slot_of_counter)
+            except (KeyError, ValueError, TypeError):
+                continue  # one malformed row must not fail the seed
+            if rebuilt is None:
+                continue
+            blob, plan = rebuilt
+            before = len(self._entries)
+            self.put(blob, plan, epoch)
+            if len(self._entries) > before:
+                seeded += 1
+        return seeded
+
+
+# ---------------------------------------------------------------------------
+# Plan-seed wire format (ISSUE 18: warm-standby fast join)
+# ---------------------------------------------------------------------------
+# A joining host starts with an EMPTY plan cache: every repeat
+# descriptor pays the full derivation (parse + CEL match + slot hash)
+# once more, right when the join wants the fastest possible
+# time-to-first-decision. The seed ships the donor's blob->plan entries
+# over the ``kind:"plan_seed"`` lane RPC (server/peering.py) in a
+# PORTABLE form: device slot indices are host-local (each host's table
+# allocates independently), so a kernel hit travels as the COUNTER
+# IDENTITY that resolved it plus the portable ints of its record — the
+# importer re-resolves slots against its own table and rebuilds a
+# record that is byte-identical except for the slot column, which by
+# construction points at the importer's cell for the same counter.
+# Import rides :meth:`DecisionPlanCache.put` unchanged, so a limits
+# reload racing the ship discards the whole seed through the existing
+# stale-epoch contract (the epoch the donor snapshotted no longer
+# matches).
+
+
+def _limit_identity_to_wire(limit) -> dict:
+    """JSON-safe identity of a Limit (same fields the migrate lane's
+    ``_counter_to_wire`` carries — ``policy`` is identity-bearing)."""
+    return {
+        "ns": str(limit.namespace),
+        "max": limit.max_value,
+        "seconds": limit.seconds,
+        "conditions": sorted(c.source for c in limit.conditions),
+        "variables": sorted(v.source for v in limit.variables),
+        "name": limit.name,
+        "id": limit.id,
+        "policy": limit.policy,
+    }
+
+
+def _counter_identity_from_wire(blob: dict):
+    from ..core.counter import Counter
+    from ..core.limit import Limit
+
+    limit = Limit(
+        blob["ns"], blob["max"], blob["seconds"],
+        blob.get("conditions", ()), blob.get("variables", ()),
+        name=blob.get("name"), id=blob.get("id"),
+        policy=blob.get("policy", "fixed_window"),
+    )
+    return Counter(limit, dict(blob.get("vars", ())))
+
+
+def plan_to_wire(blob: bytes, plan: DecisionPlan,
+                 counter_of_slot=None) -> Optional[dict]:
+    """One cache entry as a JSON-safe seed row, or None when it cannot
+    travel (a kernel hit's slot was recycled and can no longer be
+    attributed to a counter — the importer would rebuild a wrong
+    record). ``counter_of_slot(slot) -> Counter | None`` attributes
+    kernel hits; kernel plans are skipped entirely without it."""
+    out = {
+        "blob": base64.b64encode(blob).decode(),
+        "kind": int(plan.kind),
+        "ns": plan.namespace,
+        "delta": int(plan.delta),
+        "delta_capped": int(plan.delta_capped),
+        "owner": int(plan.owner),
+        "names": list(plan.limit_names),
+    }
+    if plan.kind != PLAN_KERNEL:
+        return out
+    if counter_of_slot is None:
+        return None
+    hits = []
+    record = plan.record
+    for i in range(plan.nhits):
+        slot = record[4 * i]
+        counter = counter_of_slot(slot)
+        if counter is None:
+            return None
+        wire = _limit_identity_to_wire(counter.limit)
+        wire["vars"] = sorted(counter.set_variables.items())
+        hits.append({
+            "c": wire,
+            # the portable record tail: (max, window_ms, bucket_flag)
+            # ships verbatim — only the slot column is host-local
+            "rec": [int(record[4 * i + 1]), int(record[4 * i + 2]),
+                    int(record[4 * i + 3])],
+        })
+    out["hits"] = hits
+    return out
+
+
+def plan_from_wire(entry: dict,
+                   slot_of_counter=None) -> Optional[Tuple[bytes, DecisionPlan]]:
+    """Rebuild (blob, plan) from one seed row under THIS host's table.
+    ``slot_of_counter(counter) -> slot | None`` allocates/resolves the
+    importer's device slot for each kernel hit; a row that cannot
+    resolve (table full) is skipped, never mis-seeded."""
+    blob = base64.b64decode(entry["blob"])
+    kind = int(entry["kind"])
+    if kind != PLAN_KERNEL:
+        return blob, DecisionPlan(
+            kind, namespace=entry.get("ns"), delta=int(entry["delta"]),
+            delta_capped=int(entry.get("delta_capped", 1)),
+            owner=int(entry.get("owner", -1)),
+        )
+    if slot_of_counter is None:
+        return None
+    record: List[int] = []
+    for hit in entry.get("hits", ()):
+        counter = _counter_identity_from_wire(hit["c"])
+        slot = slot_of_counter(counter)
+        if slot is None:
+            return None
+        rec = hit["rec"]
+        record.extend((int(slot), int(rec[0]), int(rec[1]), int(rec[2])))
+    record_t = tuple(record)
+    return blob, DecisionPlan(
+        PLAN_KERNEL,
+        namespace=entry.get("ns"),
+        delta=int(entry["delta"]),
+        delta_capped=int(entry.get("delta_capped", 1)),
+        record=record_t,
+        limit_names=tuple(entry.get("names", ())),
+        slots=record_t[0::4],
+        owner=int(entry.get("owner", -1)),
+    )
 
 
 class CounterPlanCache(_BaseCache):

@@ -975,6 +975,55 @@ int64_t hp_plan_count(void* c) {
   return (int64_t)((Ctx*)c)->mirror.live;
 }
 
+// ---- plan-seed export (ISSUE 18: warm-standby fast join) ------------------
+// Serialize every LIVE mirror entry so a joining host can be seeded
+// with the donor's blob->plan state. Two-call protocol: returns the
+// byte size the snapshot needs; the entries are written only when
+// ``cap`` covers it (callers probe with cap=0, then allocate). Layout:
+// i64 count, then per entry: i32 blob_len, blob bytes, i32 kind,
+// i32 ns_token, i32 delta, i32 delta_capped, i32 owner, i32 nhits,
+// nhits*REC_STRIDE i32 recs. Tokens (ns_token, the rec name column)
+// are THIS process's interner values — the Python exporter maps them
+// back to strings before anything crosses the wire, and the importer
+// replays through hp_plan_put with its own tokens; a raw byte-copy
+// between processes would alias unrelated strings.
+int64_t hp_plan_export(void* c, uint8_t* buf, int64_t cap) {
+  PlanMirror& m = ((Ctx*)c)->mirror;
+  int64_t need = (int64_t)sizeof(int64_t);
+  for (const PlanEntry& e : m.table) {
+    if (e.state != 1) continue;
+    need += (int64_t)(7 * sizeof(int32_t)) + (int64_t)e.blob_len +
+            (int64_t)e.nhits * REC_STRIDE * (int64_t)sizeof(int32_t);
+  }
+  if (buf == nullptr || cap < need) return need;
+  uint8_t* p = buf;
+  int64_t count = (int64_t)m.live;
+  memcpy(p, &count, sizeof(count));
+  p += sizeof(count);
+  auto put_i32 = [&p](int32_t v) {
+    memcpy(p, &v, sizeof(v));
+    p += sizeof(v);
+  };
+  for (const PlanEntry& e : m.table) {
+    if (e.state != 1) continue;
+    put_i32((int32_t)e.blob_len);
+    memcpy(p, m.blob_arena.data() + e.blob_off, e.blob_len);
+    p += e.blob_len;
+    put_i32(e.kind);
+    put_i32(e.ns_token);
+    put_i32(e.delta);
+    put_i32(e.delta_capped);
+    put_i32(e.owner);
+    put_i32(e.nhits);
+    if (e.nhits > 0) {
+      size_t n = (size_t)e.nhits * REC_STRIDE * sizeof(int32_t);
+      memcpy(p, m.recs.data() + e.rec_off, n);
+      p += n;
+    }
+  }
+  return need;
+}
+
 // out[9]: hits, misses, staged_hits, insertions, invalidations,
 // overflows, live plans, epoch, foreign rows
 void hp_lane_stats(void* c, int64_t* out) {

@@ -81,6 +81,11 @@ SERVER_ENV_VARS = frozenset({
     # tiered storage (ISSUE 17): ambient tiering would silently swap
     # the storage class (and migration timing) under any spawned server
     "TPU_TIER_MODE", "TPU_TIER_COLD", "TPU_TIER_MIGRATE_INTERVAL",
+    # warm standby & fast join (ISSUE 18): an ambient standby flag would
+    # boot a memberless coordinator instead of the configured pod; an
+    # ambient XLA cache dir would warm-start compiles a cold-boot test
+    # is timing
+    "TPU_POD_STANDBY", "TPU_XLA_CACHE_DIR",
 })
 
 

@@ -1,0 +1,95 @@
+"""One warm standby of the pod join drill (NOT a pytest module).
+
+Spawned by tests/test_pod_join_drill.py (and `make pod-join-drill`) as
+the promotion target: a MEMBERLESS host — formed lane, provisional
+single-host router, resize coordinator with the join callbacks armed,
+kernels warmed — that answers nothing until the drill's in-test
+initiator promotes it over ``join_host``. This is the ``--standby on``
+boot, subprocess-for-real so the promotion crosses process and wire
+boundaries exactly like production.
+
+    python tests/pod_join_worker.py --listen 127.0.0.1:PORT \
+        --ready READY --stop STOP --out OUT.json
+
+Protocol with the parent test: touch READY once warmed and joinable
+(NO limits loaded — the join ships them); on STOP dump identity,
+counters and the event timeline to OUT.json and exit 0.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tests.pod_resize_worker import counter_dump  # noqa: E402
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--listen", required=True)
+    parser.add_argument("--ready", required=True)
+    parser.add_argument("--stop", required=True)
+    parser.add_argument("--out", required=True)
+    args = parser.parse_args()
+
+    from limitador_tpu import RateLimiter
+    from limitador_tpu.routing import PodRouter, PodTopology
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.server.standby import WarmStandby
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    limiter = RateLimiter(InMemoryStorage(8192))
+    lane = PeerLane(0, args.listen, {}, None, resilience=cfg)
+    frontend = PodFrontend(
+        limiter,
+        PodRouter(PodTopology(hosts=1, host_id=0, shards_per_host=1)),
+        lane, resilience=cfg,
+    )
+    coordinator = PodResizeCoordinator(
+        frontend, peers={}, listen_address=args.listen,
+        transition_timeout_s=30.0,
+    )
+    frontend.attach_resize(coordinator)
+    standby = WarmStandby(frontend, coordinator, warm_buckets=(8,))
+    lane.start()
+    standby.warm()
+    with open(args.ready, "w") as f:
+        f.write(str(lane.port))
+    try:
+        while not os.path.exists(args.stop):
+            time.sleep(0.05)
+        with open(args.out, "w") as f:
+            json.dump({
+                "host_id": coordinator.host_id,
+                "topology": {
+                    "hosts": frontend.router.topology.hosts,
+                    "host_id": frontend.router.topology.host_id,
+                },
+                "standby": standby.status(),
+                "counters": counter_dump(frontend),
+                "limits_loaded": bool(frontend._last_limits),
+                "events": frontend.events_debug()["events"],
+                "stats": {
+                    k: v for k, v in frontend.library_stats().items()
+                    if k.startswith(("join_", "standby_", "pod_routed"))
+                },
+            }, f)
+    finally:
+        lane.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -319,3 +319,22 @@ def test_dashboard_metrics_all_exported():
                 continue
             missing.add(ident)
     assert not missing, f"dashboard references unexported metrics: {missing}"
+
+
+def test_dashboard_covers_join_families():
+    """ISSUE 18: the warm-standby/fast-join plane ships WITH its
+    Grafana row — a "Fast join" row exists and every standby_*/join_*
+    family (standby.METRIC_FAMILIES plus the join families the resize
+    coordinator owns) is referenced by at least one panel
+    expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("fast join" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.server.resize import METRIC_FAMILIES as RESIZE
+    from limitador_tpu.server.standby import METRIC_FAMILIES as STANDBY
+
+    for family in STANDBY + tuple(
+        f for f in RESIZE if f.startswith("join_")
+    ):
+        assert family in exprs, f"no panel queries {family}"

@@ -1,0 +1,304 @@
+"""Warm-standby promotion under fire (ISSUE 18).
+
+Fast tier: the join ships the LIVE limits generation — a standby that
+never loaded a limits file enforces them correctly the moment it is
+promoted (oracle-checked), and a replace-mode join while the dead
+member's journal is accruing hands the journaled deltas to the
+adoptee through the existing PR 11 reconcile path.
+
+Slow tier (`make pod-join-drill`): the promotion-under-fire drill — a
+live 2-host pod mid-soak has member 1 (a real subprocess) SIGKILLed,
+then the warm standby (tests/pod_join_worker.py, also a real
+subprocess) promoted as its replacement over ``join_host``. Every
+decision through the whole window keeps answering (zero failed
+answers; the PR 11 degraded stand-in covers the dead window), and the
+merged event timeline shows the causal
+``join_begin < epoch_bump < join_end`` chain.
+"""
+
+import asyncio
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from limitador_tpu.routing import PodRouter, PodTopology
+
+REPO_ROOT = Path(__file__).parent.parent
+MEMBER_WORKER = Path(__file__).parent / "pod_resize_worker.py"
+STANDBY_WORKER = Path(__file__).parent / "pod_join_worker.py"
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- fast tier: the shipped limits enforce on the adoptee ----------------------
+
+
+def test_join_ships_limits_that_enforce_on_the_adoptee():
+    """The standby never saw a limits file; after a grow-mode join its
+    decisions for its shard range are byte-equal to a single-process
+    oracle — including the limited=True verdicts past max_value."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, Limit, RateLimiter
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    from tests.test_standby import _check, _standby_pod, _stop
+
+    limits = [Limit("join", 3, 300, [], ["u"], name="per_u")]
+    lanes, fronts, _standby, addrs, limits = _standby_pod(
+        2, limits=limits, warm=True
+    )
+    try:
+        assert not fronts[-1]._last_limits  # truly cold config
+        out = fronts[0].resize.join_host(addrs[-1])
+        assert out["ok"], out
+        assert fronts[-1]._last_limits  # the ship configured it
+        oracle = RateLimiter(InMemoryStorage(4096))
+        oracle.configure_with(limits)
+        from tests.test_standby import _owned_users
+
+        user = _owned_users(fronts[0], 2, limits, n=1)[0]
+        for _ in range(6):  # past max_value: verdicts must flip
+            got = _check(fronts[0], user)
+            want = oracle.check_rate_limited_and_update(
+                "join", Context({"u": user}), 1, False
+            )
+            assert bool(got.limited) == bool(want.limited)
+    finally:
+        _stop(lanes)
+
+
+def test_replace_join_hands_journal_to_the_adoptee():
+    """Deltas journaled against the dead member while it was down
+    replay into the standby after the replace-mode join — the PR 11
+    reconcile path, re-pointed at the adoptee's address."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Limit
+
+    from tests.test_standby import _check, _owned_users, _standby_pod, _stop
+
+    limits = [Limit("join", 50, 300, [], ["u"], name="per_u")]
+    lanes, fronts, _standby, addrs, limits = _standby_pod(
+        2, limits=limits, warm=True
+    )
+    try:
+        users = _owned_users(fronts[0], 1, limits, n=4)
+        lanes[1].stop()  # the member dies
+        # degraded window: forwards to the dead owner journal locally
+        deadline = time.time() + 10
+        journaled = 0
+        while journaled == 0 and time.time() < deadline:
+            for u in users:
+                assert _check(fronts[0], u) is not None
+            journaled = fronts[0].library_stats()[
+                "pod_failover_journal_depth"
+            ]
+        assert journaled > 0, "journal never accrued"
+        out = fronts[0].resize.join_host(addrs[-1], replace=1)
+        assert out["ok"], out
+        # probes find the adoptee serving; the journal replays into it
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if (
+                fronts[0].library_stats()[
+                    "pod_failover_journal_depth"
+                ] == 0
+                and fronts[-1].get_counters("join")
+            ):
+                break
+            for u in users:
+                _check(fronts[0], u)
+            time.sleep(0.1)
+        assert fronts[0].library_stats()[
+            "pod_failover_journal_depth"
+        ] == 0, "journal never replayed into the adoptee"
+        assert fronts[-1].get_counters("join"), (
+            "the adoptee never received the journaled deltas"
+        )
+    finally:
+        _stop(lanes)
+
+
+# -- the promotion-under-fire drill (slow) -------------------------------------
+
+
+def _spawn(cmd_tail, tmp_path, tag):
+    ready = tmp_path / f"ready-{tag}"
+    stop = tmp_path / f"stop-{tag}"
+    out = tmp_path / f"out-{tag}.json"
+    env = {
+        k: v for k, v in os.environ.items()
+        if not k.startswith("TPU_POD_")
+    }
+    env["PYTHONPATH"] = str(REPO_ROOT)
+    cmd = [sys.executable] + cmd_tail + [
+        "--ready", str(ready), "--stop", str(stop), "--out", str(out),
+    ]
+    proc = subprocess.Popen(
+        cmd, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+    )
+    deadline = time.time() + 60
+    while not ready.exists():
+        if proc.poll() is not None:
+            _stdout, stderr = proc.communicate()
+            pytest.skip(
+                f"worker {tag} failed to start: {stderr.strip()[-400:]}"
+            )
+        if time.time() > deadline:
+            proc.kill()
+            pytest.skip(f"worker {tag} did not come up in time")
+        time.sleep(0.05)
+    return proc, stop, out
+
+
+@pytest.mark.slow
+def test_pod_join_drill_sigkill_then_promote_standby(tmp_path):
+    """ISSUE 18 acceptance: SIGKILL a member mid-soak, promote the warm
+    standby as its replacement, zero failed answers through the whole
+    window, and the causal ``join_begin < epoch_bump < join_end``
+    chain on the initiator's timeline."""
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    from tests.pod_resize_worker import RESIZE_NAMESPACE, resize_limits
+
+    port0, port1, port2 = _free_port(), _free_port(), _free_port()
+    addr0 = f"127.0.0.1:{port0}"
+    addr1 = f"127.0.0.1:{port1}"
+    addr2 = f"127.0.0.1:{port2}"
+
+    proc1, _stop1, _out1 = _spawn(
+        [str(MEMBER_WORKER), "--listen", addr1, "--host-id", "1",
+         "--hosts", "2", "--peer", f"0={addr0}"],
+        tmp_path, "member1",
+    )
+    proc2, stop2, out2 = _spawn(
+        [str(STANDBY_WORKER), "--listen", addr2],
+        tmp_path, "standby",
+    )
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    lane = PeerLane(0, addr0, {1: addr1}, None, resilience=cfg)
+    lane.start()
+    frontend = PodFrontend(
+        RateLimiter(InMemoryStorage(8192)),
+        PodRouter(PodTopology(hosts=2, host_id=0, shards_per_host=1)),
+        lane, resilience=cfg,
+    )
+    coordinator = PodResizeCoordinator(
+        frontend,
+        peers={0: addr0, 1: addr1},
+        listen_address=addr0,
+        transition_timeout_s=20.0,
+    )
+    frontend.attach_resize(coordinator)
+    asyncio.run(frontend.configure_with(resize_limits()))
+
+    failed = []
+
+    def soak(tag, rounds, users):
+        for r in range(rounds):
+            for u in users:
+                try:
+                    got = asyncio.run(
+                        frontend.check_rate_limited_and_update(
+                            RESIZE_NAMESPACE, Context({"u": u}), 1,
+                            False,
+                        )
+                    )
+                except Exception as exc:
+                    failed.append((tag, r, u, f"{exc}"))
+                    continue
+                if got is None:
+                    failed.append((tag, r, u, "no answer"))
+
+    users = [f"drill-{i}" for i in range(24)]
+    try:
+        # phase A: healthy 2-host soak
+        soak("healthy", 3, users)
+
+        # phase B: SIGKILL member 1 mid-soak; the degraded stand-in
+        # keeps every answer flowing
+        proc1.send_signal(signal.SIGKILL)
+        proc1.wait(timeout=10)
+        soak("dead", 3, users)
+
+        # phase C: promote the warm standby as member 1's replacement
+        t0 = time.perf_counter()
+        out = coordinator.join_host(addr2, replace=1)
+        promote_s = time.perf_counter() - t0
+        assert out["ok"], out
+        assert out["mode"] == "replace" and out["joiner"] == 1
+        # convergence: the PR 11 probes must find the adoptee serving
+        # and close the dead window's breaker before forwards flow —
+        # keep soaking (still zero failed answers) until they do
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            before = frontend.library_stats()
+            soak("converge", 1, users)
+            after = frontend.library_stats()
+            if (
+                after["pod_routed_forwarded"]
+                > before["pod_routed_forwarded"]
+                and after["pod_failover_degraded_decisions"]
+                == before["pod_failover_degraded_decisions"]
+            ):
+                break
+            time.sleep(0.2)
+        soak("promoted", 3, users)
+
+        # zero failed answers across the WHOLE window
+        assert not failed, failed[:5]
+
+        # the causal chain on the initiator's timeline
+        seq = {}
+        for event in frontend.events_debug()["events"]:
+            seq.setdefault(event["kind"], event["seq"])
+        assert (
+            seq["join_begin"] < seq["epoch_bump"] < seq["join_end"]
+        ), seq
+        stats = coordinator.stats()
+        assert stats["join_completed"] == 1
+        assert stats["join_aborted"] == 0
+
+        # the adoptee: correct identity, warmed, and actually serving
+        stop2.touch()
+        proc2.wait(timeout=15)
+        dump = json.loads(out2.read_text())
+        assert dump["host_id"] == 1
+        assert dump["topology"] == {"hosts": 2, "host_id": 1}
+        assert dump["limits_loaded"]  # the ship configured it
+        assert dump["standby"]["standby_ready"] == 1
+        kinds = [e["kind"] for e in dump["events"]]
+        assert "standby_ready" in kinds
+        assert "epoch_bump" in kinds
+        assert dump["counters"], "the adoptee never answered a key"
+        # the promotion itself is sub-second machinery (generous CI
+        # bound; the bench records the honest cold/warm ttfd numbers)
+        assert promote_s < 10.0, promote_s
+    finally:
+        for proc in (proc1, proc2):
+            if proc.poll() is None:
+                proc.kill()
+        lane.stop()
