@@ -11,7 +11,7 @@
 # `test` skips the @pytest.mark.slow chaos/soak/race-hunt scenarios for
 # a fast gate; `test-all` (and `check-all`) runs everything.
 
-.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill tier-soak pod-join-drill
+.PHONY: check check-all lint test test-all bench bench-trend race-hunt pod-smoke pod-chaos pod-resize-chaos flight-drill tier-soak pod-join-drill controller-drill
 
 check: lint test
 
@@ -64,6 +64,16 @@ pod-resize-chaos:
 # order on the merged pod event timeline.
 pod-join-drill:
 	python -m pytest tests/test_standby.py tests/test_pod_join_drill.py -q
+
+# Capacity-controller autoscale drill (ISSUE 20): the fast knob/
+# hysteresis/interlock tier plus the slow drill — under sustained
+# burn the controller grows a live 2-host pod to 3 by promoting the
+# warm standby over the PR 18 join path, shrinks back to 2 on
+# sustained idle, with zero failed answers, zero topology flaps
+# through the ramp noise, and the causal controller_actuation <
+# join_begin < epoch_bump < join_end chain on the pod timeline.
+controller-drill:
+	python -m pytest tests/test_controller.py tests/test_controller_drill.py -q
 
 # Flight-recorder drill (ISSUE 16): under live decision traffic, fire
 # the manual trigger through POST /debug/flight/trigger and validate

@@ -47,6 +47,17 @@ The other BASELINE configs run with --config:
     --config onbox      serving-stack closed-loop latency with the jax
                         backend pinned on-box (LIMITADOR_TPU_PLATFORM=cpu):
                         the p99<=2ms evidence with the WAN tunnel excluded
+    --config controller self-driving capacity A/B (ISSUE 20): one
+                        open-loop bursty multi-tenant drive (zipf-mixture
+                        tenants, calm -> 5x load step -> diurnal ramp ->
+                        night) through the REAL admission plane, static
+                        vs adaptive (live CapacityController): the
+                        adaptive row must hold SLO burn < 1 through the
+                        step that makes static shed blindly, calm no
+                        worse; plus the autoscale segment — the same
+                        drive with the membership axis armed (grow on
+                        sustained burn, drain on sustained idle, flap
+                        count through the ramp)
 """
 
 import argparse
@@ -282,6 +293,341 @@ def bench_flight():
             lower_is_better=True, sample_stride=stride,
             exemplars=rec.exemplars, tail_retained=rec.tail_retained,
         )
+
+
+def controller_drive(rng, tenants=48, base=60.0, step_factor=5.0,
+                     calm_ticks=150, step_ticks=150, ramp_ticks=300,
+                     night_ticks=100):
+    """The open-loop bursty multi-tenant drive (ISSUE 20): per-tick
+    Poisson arrival counts over a zipf-mixture tenant population,
+    through four segments — calm, a hard ``step_factor``x load step,
+    one full diurnal ramp cycle (base -> peak -> base), night idle.
+    Open loop on purpose: arrivals never slow down because the server
+    sheds, which is exactly the regime that separates a capacity
+    controller from reactive AIMD alone. Yields ``(tick, phase,
+    [(namespace, count), ...])``; reused by the A/B row and the
+    autoscale segment of ``--config controller``."""
+    import math
+
+    weights = 1.0 / np.arange(1, tenants + 1) ** 0.99
+    weights /= weights.sum()
+    names = [f"tenant-{i:02d}" for i in range(tenants)]
+    total = calm_ticks + step_ticks + ramp_ticks + night_ticks
+    for t in range(total):
+        if t < calm_ticks:
+            phase, rate = "calm", base
+        elif t < calm_ticks + step_ticks:
+            phase, rate = "step", base * step_factor
+        elif t < calm_ticks + step_ticks + ramp_ticks:
+            u = (t - calm_ticks - step_ticks) / ramp_ticks
+            phase = "ramp"
+            rate = base * (1.0 + (step_factor - 1.0) * 0.5
+                           * (1.0 - math.cos(2.0 * math.pi * u)))
+        else:
+            phase, rate = "night", base * 0.2
+        n = int(rng.poisson(rate))
+        if n:
+            counts = rng.multinomial(n, weights)
+            arrivals = [
+                (names[i], int(c)) for i, c in enumerate(counts) if c
+            ]
+        else:
+            arrivals = []
+        yield t, phase, arrivals
+
+
+def _controller_sim(mode, seed=7):
+    """One pass of ``controller_drive`` against the REAL admission
+    plane — AdaptiveLimiter AIMD, priority shares, shed floor — over a
+    simulated service stage: ``per_host_capacity`` decisions per 100ms
+    tick per host, FIFO queue, per-decision queue wait judged against
+    a 100ms budget (the sim plane's SLO). Modes:
+
+    * ``static``   — the pre-controller plane: AIMD alone.
+    * ``adaptive`` — a live CapacityController (admission knobs) holds
+      the ceiling at the model's sustainable point instead of letting
+      the AIMD envelope ride at the hard max until the step hits.
+    * ``autoscale``— membership axis only: the controller grows a
+      simulated 2-host pod on sustained burn (capacity scales with
+      hosts) and drains it back on sustained night idle.
+
+    All clocks (AIMD, admission, controller) run on simulated time, so
+    the pass is deterministic for a seed."""
+    from limitador_tpu.admission.controller import (
+        AdmissionController,
+        AdmissionShed,
+    )
+    from limitador_tpu.admission.overload import AdaptiveLimiter
+    from limitador_tpu.admission.priority import PriorityResolver
+    from limitador_tpu.control import (
+        CapacityController,
+        ModelPolicy,
+        ServerActuator,
+    )
+    from limitador_tpu.observability.signals import ControlSignals
+
+    tick_s = 0.1
+    budget_s = 0.1        # the sim plane's SLO budget (one tick)
+    slo_target = 0.01     # <= 1% of served decisions over budget
+    per_host = 40         # served decisions per tick per host
+    tenants = 48
+    rng = np.random.default_rng(seed)
+
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    resolver = PriorityResolver(namespace_map={
+        f"tenant-{i:02d}": i % 4 for i in range(tenants)
+    })
+    overload = AdaptiveLimiter(
+        max_inflight=4096, target_queue_wait=0.05, clock=clock,
+    )
+    admission = AdmissionController(
+        mode="enforce", overload=overload, priorities=resolver,
+        clock=clock,
+    )
+
+    coordinator = None
+    controller = None
+    actuator = None
+    offered_ewma = 0.0
+    wait_ms = 0.0
+
+    def capacity_tick():
+        hosts = (
+            coordinator.router.topology.hosts
+            if coordinator is not None else 2
+        )
+        return per_host * hosts
+
+    import types
+
+    if mode == "adaptive":
+        # the fitted serving model at this sim's operating point: the
+        # Little's-law ceiling (max rate x budget) is what lets the
+        # adaptive row hold the queue INSIDE the budget before the
+        # step lands, instead of reacting after it blows
+        estimator = types.SimpleNamespace(
+            budget_ms=budget_s * 1e3,
+            what_if=lambda: {
+                "max_decisions_per_sec": capacity_tick() / tick_s,
+                "predicted_decisions_per_sec": min(
+                    offered_ewma, capacity_tick()
+                ) / tick_s,
+                "predicted_latency_ms": wait_ms,
+            },
+        )
+        actuator = ServerActuator(overload=overload, admission=admission)
+        # default ceiling margin (1.5x the Little's-law point): queue
+        # depth caps at 120 < 2 service quanta, so every admitted
+        # decision still lands inside the one-tick budget, while calm
+        # traffic clears the priority-share caps untouched
+        controller = CapacityController(
+            actuator,
+            policy=ModelPolicy(budget_ms=budget_s * 1e3),
+            estimator=estimator, mode="on", interval_s=tick_s,
+            clock=clock,
+        )
+    elif mode == "autoscale":
+        coordinator = types.SimpleNamespace(
+            busy=False,
+            _peers={0: "sim-0", 1: "sim-1"},
+            router=types.SimpleNamespace(
+                topology=types.SimpleNamespace(hosts=2)
+            ),
+        )
+
+        def _join(address):
+            h = coordinator.router.topology.hosts
+            coordinator._peers[h] = address
+            coordinator.router.topology.hosts = h + 1
+            return {"ok": True, "mode": "grow", "joiner": h}
+
+        def _drain():
+            h = coordinator.router.topology.hosts
+            coordinator._peers.pop(h - 1, None)
+            coordinator.router.topology.hosts = h - 1
+            return {"ok": True}
+
+        coordinator.join_host = _join
+        coordinator.drain_host = _drain
+        actuator = ServerActuator(
+            coordinator=coordinator, standby_addresses=["sim-standby"],
+            min_hosts=2, max_hosts=3,
+        )
+        controller = CapacityController(
+            actuator, policy=ModelPolicy(budget_ms=budget_s * 1e3),
+            mode="on", interval_s=tick_s, sustain_s=1.0, dwell_s=5.0,
+            clock=clock,
+        )
+
+    from collections import deque as _deque
+
+    queue = []                       # (enqueue_tick, ticket) FIFO
+    window = _deque(maxlen=20)       # (served, over) per tick
+    burn = 0.0
+    prev_counts = {}
+    phases = {}
+    phase_order = []
+    for t, phase, arrivals in controller_drive(rng, tenants=tenants):
+        clock.t = t * tick_s
+        if phase not in phases:
+            phase_order.append(phase)
+        agg = phases.setdefault(phase, {
+            "ticks": 0, "offered": 0, "served": 0, "over": 0,
+            "max_burn": 0.0, "sheds": {},
+        })
+        agg["ticks"] += 1
+        offered = 0
+        for ns, count in arrivals:
+            offered += count
+            for _ in range(count):
+                try:
+                    ticket = admission.admit(ns)
+                except AdmissionShed:
+                    continue
+                queue.append((t, ticket))
+        agg["offered"] += offered
+        offered_ewma += 0.2 * (offered - offered_ewma)
+        # the service stage: capacity decisions leave the queue FIFO
+        cap = capacity_tick()
+        served = queue[:cap]
+        del queue[:cap]
+        over = 0
+        max_wait = 0.0
+        for enq, ticket in served:
+            wait = (t - enq) * tick_s
+            max_wait = max(max_wait, wait)
+            if wait > budget_s:
+                over += 1
+            ticket.release()
+        if served:
+            overload.observe(max_wait)
+        agg["served"] += len(served)
+        agg["over"] += over
+        window.append((len(served), over))
+        w_served = sum(s for s, _ in window)
+        w_over = sum(o for o, o2 in [(o, o) for _, o in window])
+        if w_served:
+            burn = (w_over / w_served) / slo_target
+        agg["max_burn"] = max(agg["max_burn"], round(burn, 2))
+        wait_ms = len(queue) / cap * tick_s * 1e3
+        # per-tick shed deltas: phase aggregates + the rate signal
+        counts = dict(admission._shed_counts)
+        rates = {}
+        for key, n in counts.items():
+            d = n - prev_counts.get(key, 0)
+            if d:
+                reason, pname = key
+                skey = f"{reason}:{pname}"
+                agg["sheds"][skey] = agg["sheds"].get(skey, 0) + d
+                rates[pname] = rates.get(pname, 0.0) + d / tick_s
+        prev_counts = counts
+        if controller is not None:
+            headroom = 0.0
+            if mode == "autoscale" and offered_ewma > 0:
+                headroom = cap / offered_ewma
+            controller.tick(ControlSignals(
+                ts=clock.t, queue_wait_ms=wait_ms,
+                slo_burn_5m=round(burn, 4),
+                slo_breached=int(burn >= 1.0),
+                shed_rate_by_priority=rates,
+                capacity_headroom_ratio=headroom,
+                model_r2=0.9 if mode == "adaptive" else 0.0,
+            ))
+        agg["hosts"] = (
+            coordinator.router.topology.hosts
+            if coordinator is not None else 2
+        )
+
+    out = {"mode": mode, "phases": {}}
+    for phase in phase_order:
+        agg = phases[phase]
+        served = agg["served"]
+        out["phases"][phase] = {
+            "offered_per_s": round(
+                agg["offered"] / (agg["ticks"] * tick_s), 1
+            ),
+            "served_per_s": round(served / (agg["ticks"] * tick_s), 1),
+            "over_budget_pct": (
+                round(100.0 * agg["over"] / served, 3) if served else 0.0
+            ),
+            "max_burn": agg["max_burn"],
+            "sheds": dict(sorted(agg["sheds"].items())),
+            "hosts": agg["hosts"],
+        }
+    if controller is not None:
+        stats = controller.stats()
+        out["knob_actuations"] = stats["ctl_knob_actuations"]
+        out["hosts_added"] = stats["ctl_hosts_added"]
+        out["hosts_drained"] = stats["ctl_hosts_drained"]
+        out["final_knobs"] = {
+            k: round(v, 2) for k, v in actuator.read().items()
+        }
+    return out
+
+
+def bench_controller():
+    """ISSUE 20: the self-driving-capacity A/B row plus the autoscale
+    segment, all three passes over the SAME open-loop drive (see
+    ``controller_drive``). The headline is the adaptive pass's worst
+    SLO burn through the load step (must stay < 1.0); the row carries
+    the static pass's counterpart, the calm-segment served rates (the
+    no-regression guard), the per-class shed split, and the autoscale
+    pass's membership actions (one grow + one drain, zero flaps)."""
+    static = _controller_sim("static")
+    adaptive = _controller_sim("adaptive")
+    autoscale = _controller_sim("autoscale")
+    for row in (static, adaptive, autoscale):
+        for phase, p in row["phases"].items():
+            print(
+                f"{row['mode']:>9} {phase:>5}: offered {p['offered_per_s']:7.1f}/s "
+                f"served {p['served_per_s']:7.1f}/s "
+                f"over-budget {p['over_budget_pct']:6.2f}% "
+                f"max-burn {p['max_burn']:8.2f} hosts {p['hosts']}",
+                file=sys.stderr,
+            )
+    sheds_of = lambda row, phase: row["phases"][phase]["sheds"]  # noqa: E731
+    print(
+        "step sheds static "
+        f"{sheds_of(static, 'step')} vs adaptive "
+        f"{sheds_of(adaptive, 'step')}",
+        file=sys.stderr,
+    )
+    print(
+        f"autoscale: +{autoscale['hosts_added']} host on the step, "
+        f"-{autoscale['hosts_drained']} at night, final "
+        f"{autoscale['phases']['night']['hosts']} hosts",
+        file=sys.stderr,
+    )
+    # floor at 0.01 so the improvement ratio stays finite: a clean run
+    # holds burn at literally zero through the step
+    emit(
+        "controller_step_slo_burn",
+        max(adaptive["phases"]["step"]["max_burn"], 0.01),
+        "burn", 1.0, ndigits=3, lower_is_better=True,
+        mode="adaptive",
+        static_step_burn=static["phases"]["step"]["max_burn"],
+        static_ramp_burn=static["phases"]["ramp"]["max_burn"],
+        adaptive_ramp_burn=adaptive["phases"]["ramp"]["max_burn"],
+        calm_served_static=static["phases"]["calm"]["served_per_s"],
+        calm_served_adaptive=adaptive["phases"]["calm"]["served_per_s"],
+        step_sheds_static=sheds_of(static, "step"),
+        step_sheds_adaptive=sheds_of(adaptive, "step"),
+        knob_actuations=adaptive["knob_actuations"],
+        final_knobs=adaptive["final_knobs"],
+        autoscale={
+            "hosts_added": autoscale["hosts_added"],
+            "hosts_drained": autoscale["hosts_drained"],
+            "step_hosts": autoscale["phases"]["step"]["hosts"],
+            "night_hosts": autoscale["phases"]["night"]["hosts"],
+            "step_burn": autoscale["phases"]["step"]["max_burn"],
+        },
+    )
 
 
 def bench_tiered(require_device: bool = False):
@@ -3180,7 +3526,7 @@ def main():
         default="device",
         choices=["device", "memory", "pipeline", "native", "lease",
                  "tenants", "sharded", "backends", "grpc", "fleet",
-                 "onbox", "pod", "flight", "tiered"],
+                 "onbox", "pod", "flight", "tiered", "controller"],
     )
     # internal: one process of the pod sweep (spawned by bench_pod)
     parser.add_argument("--pod-worker-id", type=int, default=None,
@@ -3237,6 +3583,8 @@ def main():
         return bench_flight()
     if args.config == "tiered":
         return bench_tiered(require_device=args.require_device)
+    if args.config == "controller":
+        return bench_controller()
 
     # End-to-end gRPC latency evidence rides along with the headline
     # (device) run only. It runs FIRST — before this process initializes

@@ -56,8 +56,10 @@ __all__ = [
 #: sheds enforced too.
 ADMISSION_MODES = ("off", "monitor", "enforce")
 
-#: Why a request was shed before batch admission.
-SHED_REASONS = ("deadline", "overload")
+#: Why a request was shed before batch admission. ``controller`` =
+#: the capacity controller's shed floor (ISSUE 20) put this request's
+#: priority class below the line.
+SHED_REASONS = ("deadline", "overload", "controller")
 
 #: Prometheus families this subsystem writes (observability/metrics.py
 #: declares them; ``tools/lint.py``'s registry lint cross-checks this

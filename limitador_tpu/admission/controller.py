@@ -107,6 +107,11 @@ class AdmissionController:
         self.failover = failover or FailoverStore()
         self.shed_overlimit = shed_response == SHED_OVERLIMIT
         self.deadline_margin = float(deadline_margin)
+        #: capacity-controller knob (ISSUE 20): priority classes
+        #: STRICTLY below this level shed before any other admission
+        #: check runs (reason ``controller``). 0 = shed nothing, the
+        #: default — byte-identical to the pre-controller path.
+        self.shed_floor = 0
         self.watchdog_tick = float(watchdog_tick)
         self._clock = clock
         self._shed_counts = {}  # (reason, priority name) -> int
@@ -180,7 +185,9 @@ class AdmissionController:
         the request is admitted anyway."""
         priority = self.priorities.resolve(namespace, values)
         reason = None
-        if deadline is not None:
+        if priority < self.shed_floor:
+            reason = "controller"
+        if reason is None and deadline is not None:
             estimate = self.overload.queue_wait_estimate()
             if deadline <= estimate + self.deadline_margin:
                 reason = "deadline"
@@ -192,10 +199,11 @@ class AdmissionController:
         if self.enforcing:
             raise AdmissionShed(reason, priority, self.shed_overlimit)
         # monitor mode: shed counted, request admitted anyway. Deadline
-        # sheds never tried for a slot — try now; either way the ticket
-        # records whether it actually holds one, so release() balances.
+        # and controller sheds never tried for a slot — try now; either
+        # way the ticket records whether it actually holds one, so
+        # release() balances.
         holds = (
-            reason == "deadline" and self.overload.try_acquire(priority)
+            reason != "overload" and self.overload.try_acquire(priority)
         )
         return _Ticket(self, holds_slot=holds)
 

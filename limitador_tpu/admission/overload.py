@@ -49,6 +49,9 @@ class AdaptiveLimiter:
         import time
 
         self.max_inflight = max(int(max_inflight), 1)
+        #: the configured hard cap; ``set_ceiling`` (the capacity
+        #: controller's knob) may only tighten below this, never raise
+        self.hard_max = self.max_inflight
         self.min_limit = max(min(int(min_limit), self.max_inflight), 1)
         self.target_queue_wait = float(target_queue_wait)
         self.ewma_alpha = float(ewma_alpha)
@@ -70,6 +73,20 @@ class AdaptiveLimiter:
     @property
     def inflight(self) -> int:
         return self._inflight
+
+    def set_ceiling(self, ceiling: int) -> int:
+        """Clamp the AIMD envelope's top to ``ceiling`` (the capacity
+        controller's admission knob). Bounded to
+        ``[min_limit, hard_max]`` — the controller can tighten below
+        the configured ``--max-inflight`` and relax back up to it, but
+        never above. The additive-increase ramp immediately honours
+        the new top; a limit already above it snaps down. Returns the
+        applied ceiling."""
+        with self._lock:
+            c = max(min(int(ceiling), self.hard_max), self.min_limit)
+            self.max_inflight = c
+            self._limit = min(self._limit, float(c))
+            return c
 
     def queue_wait_estimate(self) -> float:
         """Current queue-wait estimate in seconds (0.0 before the first

@@ -1,0 +1,59 @@
+"""Self-driving capacity: the model-based controller closing the
+admission AND membership loops (ISSUE 20, ROADMAP direction 2).
+
+Four hand-tuned controllers steer the same p99 budget blind to each
+other — the AIMD admission limit, deadline shedding, the ChunkPlanner
+EWMA and lease grant sizing — and pod membership is operator-triggered
+even though live ``add_host``/``drain_host`` (PR 15) and sub-second
+warm joins (PR 18) made topology a cheap actuator. This package is the
+single control loop over all of them:
+
+* :mod:`actuator` — :class:`KnobSpec` + the typed :class:`Actuator`
+  surface (read / apply / membership) every policy talks through, and
+  :class:`ServerActuator` binding the live subsystems. The surface is
+  deliberately policy-agnostic: the DRL adaptive-rate-limiting
+  controller (PAPERS.md) drops in behind the same four knobs + one
+  membership axis without touching any subsystem.
+* :mod:`policy` — :class:`ModelPolicy`, the first (model-based) policy:
+  maximize predicted throughput × p99-compliance × per-tenant fairness
+  against the PR 14 fitted coefficients, with rule-based fallbacks
+  while the model is in warmup.
+* :mod:`controller` — :class:`CapacityController`: the cadence thread
+  (inline-tickable for tests) that snapshots the PR 12 signal bus,
+  asks the policy, then actuates under per-knob slew limits, the CUSUM
+  drift gate, membership dwell + hysteresis, and the global "never
+  actuate while a resize/join transition is active" interlock.
+
+``--capacity-controller`` defaults to ``off`` (subsystem not
+constructed — byte-identical to PR 18); ``observe`` computes and logs
+every decision without actuating; ``on`` closes the loops.
+"""
+
+from .actuator import KNOBS, Actuator, KnobSpec, ServerActuator
+from .controller import CTL_MODES, CapacityController
+from .policy import ModelPolicy, Proposal
+
+__all__ = [
+    "CTL_MODES",
+    "KNOBS",
+    "METRIC_FAMILIES",
+    "Actuator",
+    "CapacityController",
+    "KnobSpec",
+    "ModelPolicy",
+    "Proposal",
+    "ServerActuator",
+]
+
+#: Prometheus families this subsystem writes (observability/metrics.py
+#: declares them; the analysis registry pass cross-checks this tuple
+#: against the declarations so the two can never drift).
+METRIC_FAMILIES = (
+    "ctl_mode",
+    "ctl_knob",
+    "ctl_actuations",
+    "ctl_membership_actions",
+    "ctl_interlock_holds",
+    "ctl_objective",
+    "ctl_pressure",
+)

@@ -98,6 +98,15 @@ EVENT_KINDS = (
     "join_end",
     "standby_ready",
     "plan_seeded",
+    # capacity controller (ISSUE 20): one autoscale/protection action —
+    # a membership actuation (detail: action=add_host/drain_host,
+    # hosts, reason, pressure; emitted BEFORE the resize drives, so
+    # the chain reads controller_actuation < join_begin/resize_begin <
+    # epoch_bump < join_end/resize_end) or a shed-floor jump (detail:
+    # action=shed_floor, from_floor, to_floor). Routine knob slews do
+    # NOT emit — they live in the controller's decision ring. The
+    # flight recorder triggers a bundle on this kind.
+    "controller_actuation",
 )
 
 

@@ -89,6 +89,10 @@ TRIGGER_REASONS = (
     "resize_abort",
     "drift",
     "device_probe",
+    # capacity controller (ISSUE 20): a membership actuation or
+    # shed-floor jump emitted a controller_actuation pod event —
+    # every autoscale decision leaves an autopsy bundle
+    "controller_actuation",
 )
 
 #: incident bundle schema version (bundles are self-contained JSON;
@@ -467,6 +471,7 @@ class TriggerEngine(threading.Thread):
     EVENT_TRIGGERS = {
         "breaker_open": "breaker_open",
         "resize_abort": "resize_abort",
+        "controller_actuation": "controller_actuation",
     }
 
     def __init__(

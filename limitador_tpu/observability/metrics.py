@@ -1227,6 +1227,65 @@ class PrometheusMetrics:
             self.tier_resident.labels(tier)
         for direction in ("promote", "demote"):
             self.tier_migrations.labels(direction)
+        # -- capacity controller (control/, ISSUE 20). Family names
+        # are registered in control.METRIC_FAMILIES (lint
+        # cross-checked); fed by the controller's render hook.
+        self.ctl_mode = Gauge(
+            "ctl_mode",
+            "Capacity controller mode (0=off, 1=observe, 2=on)",
+            registry=self.registry,
+        )
+        self.ctl_knob = Gauge(
+            "ctl_knob",
+            "Live value of each capacity-controller knob "
+            "(admission_ceiling, shed_floor, chunk_target_ms, "
+            "lease_scale)",
+            ["knob"],
+            registry=self.registry,
+        )
+        self.ctl_actuations = Counter(
+            "ctl_actuations",
+            "Slew-limited knob writes applied by the capacity "
+            "controller, by knob",
+            ["knob"],
+            registry=self.registry,
+        )
+        self.ctl_membership_actions = Counter(
+            "ctl_membership_actions",
+            "Pod membership actuations driven by the capacity "
+            "controller (add_host = warm-standby join, drain_host = "
+            "tail-host drain)",
+            ["action"],
+            registry=self.registry,
+        )
+        self.ctl_interlock_holds = Counter(
+            "ctl_interlock_holds",
+            "Controller ticks skipped whole because a resize/join "
+            "transition was active (the global actuation interlock)",
+            registry=self.registry,
+        )
+        self.ctl_objective = Gauge(
+            "ctl_objective",
+            "Last proposal's objective J = predicted throughput x "
+            "p99-compliance x fairness (0 while the model is in "
+            "warmup)",
+            registry=self.registry,
+        )
+        self.ctl_pressure = Gauge(
+            "ctl_pressure",
+            "Last proposal's scalar overload signal (max of SLO burn, "
+            "queue-wait/budget, inverse model headroom; 1.0 = at "
+            "capacity)",
+            registry=self.registry,
+        )
+        for knob in (
+            "admission_ceiling", "shed_floor", "chunk_target_ms",
+            "lease_scale",
+        ):
+            self.ctl_knob.labels(knob)
+            self.ctl_actuations.labels(knob)
+        for action in ("add_host", "drain_host"):
+            self.ctl_membership_actions.labels(action)
         # Pre-seed the bounded label sets so the families render (and
         # dashboards/benches see zeros) before the first flush.
         from ..admission import SHED_REASONS

@@ -132,6 +132,15 @@ class ControlSignals:
         "model_r2",
         "capacity_headroom_ratio",
         "model_drift",
+        # capacity-controller tail (ISSUE 20) — the active knob values
+        # + last actuation reason, appended at the END so the
+        # observation vector only ever grows; order re-pinned by
+        # tests/test_controller.py.
+        "ctl_admission_ceiling",
+        "ctl_shed_floor",
+        "ctl_chunk_target_ms",
+        "ctl_lease_scale",
+        "ctl_last_reason",
     )
 
     __slots__ = FIELDS
@@ -167,6 +176,11 @@ class ControlSignals:
             "capacity_headroom_ratio", 0.0
         )
         self.model_drift = kw.get("model_drift", 0)
+        self.ctl_admission_ceiling = kw.get("ctl_admission_ceiling", 0.0)
+        self.ctl_shed_floor = kw.get("ctl_shed_floor", 0.0)
+        self.ctl_chunk_target_ms = kw.get("ctl_chunk_target_ms", 0.0)
+        self.ctl_lease_scale = kw.get("ctl_lease_scale", 0.0)
+        self.ctl_last_reason = kw.get("ctl_last_reason", "")
 
     def to_dict(self) -> dict:
         return {f: getattr(self, f) for f in self.FIELDS}
@@ -207,6 +221,13 @@ class ControlSignals:
             float(self.model_r2),
             float(self.capacity_headroom_ratio),
             float(self.model_drift),
+            # capacity-controller tail (ISSUE 20): the active knob
+            # values; ctl_last_reason is a string and drops here like
+            # top_namespace does.
+            float(self.ctl_admission_ceiling),
+            float(self.ctl_shed_floor),
+            float(self.ctl_chunk_target_ms),
+            float(self.ctl_lease_scale),
         ])
         return out
 
@@ -248,6 +269,7 @@ class SignalBus:
         self._observatory = None
         self._pod = None
         self._model = None
+        self._controller = None
         # previous cumulative shed counts + timestamp, for the rates;
         # baselines only advance once per MIN_RATE_WINDOW_S so the four
         # independent snapshot triggers (drain tick, renders, the two
@@ -288,6 +310,14 @@ class SignalBus:
         and drift bit join every snapshot (ISSUE 14) — the tail
         direction 4's controller consumes without touching the fit."""
         self._model = model
+
+    def attach_controller(self, controller) -> None:
+        """Attach the capacity controller (or anything exposing
+        ``signal_fields() -> dict``): active knob values + last
+        actuation reason join every snapshot (ISSUE 20) — the
+        controller's ACTIONS become part of the observation a future
+        policy (or an operator) learns from."""
+        self._controller = controller
 
     def warm(self) -> None:
         """Pre-compute the box calibration score off-thread so the
@@ -370,6 +400,12 @@ class SignalBus:
         if model is not None:
             try:
                 kw.update(model.signal_fields())
+            except Exception:
+                pass
+        controller = self._controller
+        if controller is not None:
+            try:
+                kw.update(controller.signal_fields())
             except Exception:
                 pass
         if _BOX_CALIBRATION is not None:

@@ -416,6 +416,69 @@ def build_parser() -> argparse.ArgumentParser:
         "(each round drains the heat accumulators, prices candidates "
         "and runs the two-phase ledgered moves)",
     )
+    # capacity controller (docs/configuration.md "Self-driving
+    # capacity", ISSUE 20): one model-based loop over admission,
+    # shedding, chunking, lease sizing AND pod membership
+    p.add_argument(
+        "--capacity-controller", choices=["on", "off", "observe"],
+        default=_env("TPU_CTL_MODE", "off"),
+        help="self-driving capacity (ISSUE 20): one model-based "
+        "controller jointly actuates the admission AIMD ceiling, the "
+        "deadline-shed priority floor, the ChunkPlanner target, the "
+        "lease grant scale and pod membership (warm-standby join on "
+        "sustained burn, tail-host drain on sustained idle). observe "
+        "= compute and log every decision without actuating; off "
+        "(default) = controller not constructed, byte-identical "
+        "PR 18 behavior",
+    )
+    p.add_argument(
+        "--ctl-interval", type=float,
+        default=float(_env("TPU_CTL_INTERVAL_S", "1.0")),
+        help="controller: seconds between control ticks",
+    )
+    p.add_argument(
+        "--ctl-sustain", type=float,
+        default=float(_env("TPU_CTL_SUSTAIN_S", "5.0")),
+        help="controller: a membership proposal must hold its "
+        "hysteresis band this long before actuating (leaving the "
+        "band resets the clock)",
+    )
+    p.add_argument(
+        "--ctl-dwell", type=float,
+        default=float(_env("TPU_CTL_DWELL_S", "30.0")),
+        help="controller: minimum seconds between membership "
+        "actuations (with --ctl-sustain, what keeps diurnal ramps "
+        "from flapping topology)",
+    )
+    p.add_argument(
+        "--ctl-standby", default=_env("TPU_CTL_STANDBY", ""),
+        help="controller: comma-separated peer-lane addresses of warm "
+        "standbys (--standby on processes) the controller may promote "
+        "on sustained burn; empty = membership grows unavailable",
+    )
+    p.add_argument(
+        "--ctl-min-hosts", type=int,
+        default=int(_env("TPU_CTL_MIN_HOSTS", "1")),
+        help="controller: never drain the pod below this many hosts",
+    )
+    p.add_argument(
+        "--ctl-max-hosts", type=int,
+        default=int(_env("TPU_CTL_MAX_HOSTS", "8")),
+        help="controller: never grow the pod above this many hosts",
+    )
+    p.add_argument(
+        "--ctl-grow-headroom", type=float,
+        default=float(_env("TPU_CTL_GROW_HEADROOM", "1.2")),
+        help="controller: propose add_host while the model's capacity "
+        "headroom ratio stays below this band",
+    )
+    p.add_argument(
+        "--ctl-shrink-headroom", type=float,
+        default=float(_env("TPU_CTL_SHRINK_HEADROOM", "3.0")),
+        help="controller: propose drain_host while the headroom ratio "
+        "stays above this band (the dead band between the two absorbs "
+        "ramps)",
+    )
     # pod fast path (docs/configuration.md "Pod fast path", ISSUE 13):
     # shard-aware native hot lane + lockstep psum lane for global limits
     p.add_argument(
@@ -1901,6 +1964,77 @@ async def _amain(args) -> int:
                 )
                 + " (GET /debug/tiering)")
 
+    # Capacity controller (ISSUE 20): one model-based loop jointly
+    # actuating admission ceiling, shed floor, chunk target, lease
+    # scale and pod membership. Wired last so the actuator binds every
+    # live subsystem; off (the default) constructs nothing.
+    capacity_controller = None
+    if args.capacity_controller != "off":
+        from ..control import (
+            CapacityController,
+            ModelPolicy,
+            ServerActuator,
+        )
+
+        ctl_planners = []
+        if hasattr(counters_storage, "_batcher_pairs"):
+            for mb, _ub in counters_storage._batcher_pairs():
+                cp = getattr(mb, "chunk_planner", None)
+                if cp is not None:
+                    ctl_planners.append(cp)
+        cp = getattr(native_pipeline, "chunk_planner", None)
+        if cp is not None:
+            ctl_planners.append(cp)
+        ctl_coordinator = (
+            getattr(pod_frontend, "resize", None)
+            if pod_frontend is not None else None
+        )
+        ctl_actuator = ServerActuator(
+            overload=admission.overload if admission is not None else None,
+            admission=admission,
+            planners=ctl_planners,
+            broker=(
+                native_pipeline.lease_broker
+                if native_pipeline is not None else None
+            ),
+            coordinator=ctl_coordinator,
+            standby_addresses=[
+                a.strip() for a in args.ctl_standby.split(",")
+                if a.strip()
+            ],
+            min_hosts=args.ctl_min_hosts,
+            max_hosts=args.ctl_max_hosts,
+        )
+        capacity_controller = CapacityController(
+            ctl_actuator,
+            policy=ModelPolicy(
+                budget_ms=args.slo_budget_ms,
+                grow_headroom=args.ctl_grow_headroom,
+                shrink_headroom=args.ctl_shrink_headroom,
+            ),
+            signals=signal_bus,
+            estimator=model_estimator,
+            events=(
+                getattr(pod_frontend, "events", None)
+                if pod_frontend is not None else None
+            ),
+            mode=args.capacity_controller,
+            interval_s=args.ctl_interval,
+            sustain_s=args.ctl_sustain,
+            dwell_s=args.ctl_dwell,
+        )
+        if signal_bus is not None:
+            signal_bus.attach_controller(capacity_controller)
+        metrics.attach_render_hook(capacity_controller)
+        capacity_controller.start()
+        log.info(
+            "capacity controller "
+            f"{'ON' if args.capacity_controller == 'on' else 'observing'}: "
+            f"{len(ctl_actuator.specs())} knobs, membership "
+            f"{'armed' if ctl_coordinator is not None else 'unavailable'}, "
+            f"tick {args.ctl_interval:.1f}s, sustain "
+            f"{args.ctl_sustain:.0f}s, dwell {args.ctl_dwell:.0f}s")
+
     authority_server = None
     if args.authority_listen:
         from ..storage.authority import serve_authority
@@ -2021,6 +2155,8 @@ async def _amain(args) -> int:
         debug_sources.append(flight_engine)
     if tier_manager is not None:
         debug_sources.append(tier_manager)
+    if capacity_controller is not None:
+        debug_sources.append(capacity_controller)
     http_runner = await run_http_server(
         limiter, args.http_host, args.http_port, metrics, status,
         debug_sources=debug_sources,
@@ -2109,6 +2245,10 @@ async def _amain(args) -> int:
         )
     await rls_server.stop(grace=1.0)
     await http_runner.cleanup()
+    if capacity_controller is not None:
+        # First: nothing may actuate (or propose a resize) into
+        # subsystems that are shutting down behind it.
+        capacity_controller.close()
     if observatory is not None:
         observatory.close()
     if tier_manager is not None:

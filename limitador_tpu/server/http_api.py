@@ -126,6 +126,9 @@ DEBUG_SOURCE_SECTIONS = (
     # tiered storage (ISSUE 17): per-tier residency, migration rounds,
     # cold-decide latency and the model-priced row costs
     ("tiering", "tiering_debug"),
+    # capacity controller (ISSUE 20): mode, knob values/specs, the
+    # decision ring, membership clocks and interlock tallies
+    ("controller", "controller_debug"),
 )
 
 #: every /debug/stats section THIS module can add on top of
@@ -153,6 +156,7 @@ DEBUG_STATS_SECTIONS = (
     "standby",
     "flight",
     "tiering",
+    "controller",
 )
 
 

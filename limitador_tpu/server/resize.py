@@ -236,6 +236,18 @@ class PodResizeCoordinator:
             t = self._transition
             return t is not None and t.state in ("armed", "migrating")
 
+    @property
+    def busy(self) -> bool:
+        """True from a resize/join proposal's entry until its
+        transition commits or fails — the capacity controller's
+        global actuation interlock (ISSUE 20): nothing may actuate
+        while membership is in flight."""
+        with self._lock:
+            t = self._transition
+            return self._proposing or (
+                t is not None and t.state in ("armed", "migrating")
+            )
+
     def _storage(self):
         storage = self.frontend._limiter.storage
         return getattr(storage, "counters", storage)

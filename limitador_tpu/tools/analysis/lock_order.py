@@ -54,14 +54,18 @@ __all__ = [
 #: leaf-ish outermost hold — no sync waits and no storage-plane
 #: acquisitions under it.
 TRACKED_DOMAINS = (
-    "peering", "join", "tier", "broker", "native", "storage",
+    "control", "peering", "join", "tier", "broker", "native", "storage",
     "plan_cache", "observatory",
 )
 
 #: the documented canonical acquisition order (outermost first); the
-#: graph may use any PREFIX-compatible subset, never the reverse
+#: graph may use any PREFIX-compatible subset, never the reverse.
+#: ``control`` (ISSUE 20) is the capacity controller's ring/counter
+#: lock: outermost by construction AND leaf in practice — the tick
+#: calls every actuator (which take join/broker/storage locks)
+#: OUTSIDE it, so it may never be acquired under any other domain.
 CANONICAL_ORDER = (
-    "peering", "join", "tier", "broker", "native", "storage",
+    "control", "peering", "join", "tier", "broker", "native", "storage",
     "plan_cache",
 )
 
@@ -89,6 +93,10 @@ MODULE_SELF_DOMAINS = {
     # held for state flips only; the ship/migrate RPCs, the kernel
     # warm-up and every admin_call run OUTSIDE it.
     ("limitador_tpu/server/resize.py", "_lock"): "join",
+    # capacity controller (ISSUE 20): guards only the decision ring +
+    # counters; actuator calls happen outside it (see CANONICAL_ORDER)
+    ("limitador_tpu/control/controller.py", "_lock"): "control",
+    ("limitador_tpu/control/actuator.py", "_lock"): "control",
 }
 
 #: receiver NAME -> domain for cross-object acquisitions

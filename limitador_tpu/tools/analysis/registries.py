@@ -62,6 +62,9 @@ REGISTRY_OWNED_PREFIXES = {
     # plane owns standby_*
     "join_": "limitador_tpu/server/resize.py",
     "standby_": "limitador_tpu/server/standby.py",
+    # capacity controller (ISSUE 20): knob gauges, actuation tallies
+    # and the interlock/objective/pressure surfaces
+    "ctl_": "limitador_tpu/control/__init__.py",
 }
 
 #: the native telemetry plane's phase registry module

@@ -90,6 +90,13 @@ HOT_MODULES = (
     # warm-up and the state ship run at boot / on the join driver
     # thread and must never be named with a decision prefix.
     "limitador_tpu/server/standby.py",
+    # capacity controller (ISSUE 20): knob writes land on subsystem
+    # hot paths (the limiter cap, the planner target, the broker
+    # scale) and signal_fields() rides every bus snapshot — no sync,
+    # no launch, no implicit asarray may live here; the cadence tick
+    # itself runs on the controller's own thread.
+    "limitador_tpu/control/controller.py",
+    "limitador_tpu/control/actuator.py",
 )
 
 #: function-name prefixes that mark the decision path (begin/submit

@@ -120,6 +120,22 @@ class ChunkPlanner:
         self.target_s = float(target_s)
         self._per_hit_s = 0.0  # EWMA device_sync seconds per hit
 
+    #: retarget() bounds — the capacity controller may steer target_s
+    #: only inside this envelope (seconds)
+    MIN_TARGET_S = 0.0005
+    MAX_TARGET_S = 0.008
+
+    def retarget(self, target_s: float) -> float:
+        """Move the auto-mode device-time target (the capacity
+        controller's chunk knob, ISSUE 20). Clamped to
+        ``[MIN_TARGET_S, MAX_TARGET_S]``; a fixed ``dispatch_chunk``
+        still wins in :meth:`chunk_hits`. Returns the applied target
+        in seconds."""
+        self.target_s = min(
+            max(float(target_s), self.MIN_TARGET_S), self.MAX_TARGET_S
+        )
+        return self.target_s
+
     def observe(self, device_s: float, hits: int) -> None:
         """Feed one finished launch's device_sync time."""
         if hits <= 0 or device_s <= 0.0:

@@ -86,6 +86,13 @@ SERVER_ENV_VARS = frozenset({
     # ambient XLA cache dir would warm-start compiles a cold-boot test
     # is timing
     "TPU_POD_STANDBY", "TPU_XLA_CACHE_DIR",
+    # capacity controller (ISSUE 20): an ambient controller would
+    # actuate knobs (or membership!) under any spawned server a test
+    # is timing or byte-pinning
+    "TPU_CTL_MODE", "TPU_CTL_INTERVAL_S", "TPU_CTL_SUSTAIN_S",
+    "TPU_CTL_DWELL_S", "TPU_CTL_STANDBY", "TPU_CTL_MIN_HOSTS",
+    "TPU_CTL_MAX_HOSTS", "TPU_CTL_GROW_HEADROOM",
+    "TPU_CTL_SHRINK_HEADROOM",
 })
 
 

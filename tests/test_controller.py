@@ -1,0 +1,498 @@
+"""Capacity controller (ISSUE 20): the model-based loop that closes
+admission AND membership.
+
+Pins, in dependency order: the ControlSignals controller tail (the
+observation contract), KnobSpec slew envelopes, the off-by-default
+flag (byte-identical to PR 18), observe-mode parity (computes, never
+actuates), the resize interlock, the drift gate, and the membership
+sustain + dwell hysteresis — an up-down-up diurnal ramp must produce
+AT MOST ONE membership change. The slow end-to-end drill (a live pod
+grown and shrunk by the controller) lives in
+tests/test_controller_drill.py (``make controller-drill``).
+"""
+
+import pytest
+
+from limitador_tpu.control import (
+    CTL_MODES,
+    CapacityController,
+    KnobSpec,
+    ServerActuator,
+)
+from limitador_tpu.control.actuator import KNOBS, Actuator
+from limitador_tpu.observability.events import PodEventLog
+from limitador_tpu.observability.signals import ControlSignals, SignalBus
+
+
+class Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class FakeActuator(Actuator):
+    """Records every apply/membership call; optionally emits the
+    downstream join event so the causal-order test can compare
+    sequence numbers the way the real coordinator chain does."""
+
+    def __init__(self, knobs=KNOBS, hosts=2, events=None):
+        self._specs = tuple(knobs)
+        self.values = {s.name: s.neutral for s in self._specs}
+        self.applied = []
+        self.membership = []
+        self.n_hosts = hosts
+        self.grow_ok = True
+        self.shrink_ok = True
+        self.transition = False
+        self.events = events
+
+    def specs(self):
+        return self._specs
+
+    def read(self):
+        return dict(self.values)
+
+    def apply(self, name, value):
+        self.values[name] = value
+        self.applied.append((name, value))
+        return value
+
+    def hosts(self):
+        return self.n_hosts
+
+    def transition_active(self):
+        return self.transition
+
+    def can_grow(self):
+        return self.grow_ok
+
+    def can_shrink(self):
+        return self.shrink_ok
+
+    def add_host(self):
+        if self.events is not None:
+            self.events.emit("join_begin", host=self.n_hosts)
+        self.n_hosts += 1
+        self.membership.append("add_host")
+        return {"ok": True}
+
+    def drain_host(self):
+        self.n_hosts -= 1
+        self.membership.append("drain_host")
+        return {"ok": True}
+
+
+def _controller(act, clock, mode="on", **kw):
+    kw.setdefault("interval_s", 1.0)
+    kw.setdefault("sustain_s", 5.0)
+    kw.setdefault("dwell_s", 30.0)
+    return CapacityController(act, mode=mode, clock=clock, **kw)
+
+
+def _tick(ctl, clock, snap, n=1):
+    last = None
+    for _ in range(n):
+        clock.advance(1.0)
+        last = ctl.tick(snap)
+    return last
+
+
+# pressure fallback snapshots (model in warmup: headroom 0)
+BURN = dict(slo_burn_5m=2.0, queue_wait_ms=10.0)
+# queue 1.5ms / 2ms budget = 0.75: inside the dead band
+CALM = dict(queue_wait_ms=1.5)
+# headroom-band snapshots (model fitted)
+GROW = dict(capacity_headroom_ratio=1.0)
+HOLD = dict(capacity_headroom_ratio=2.0)
+IDLE = dict(capacity_headroom_ratio=4.0)
+
+
+# -- the observation contract -------------------------------------------------
+
+
+def test_controller_signal_tail_order_is_pinned():
+    """Satellite (ISSUE 20): the controller tail appends at the very
+    END of FIELDS — the observation vector only ever grows. This test
+    IS the re-pin (the full order lives in test_pod_plane)."""
+    assert ControlSignals.FIELDS[-5:] == (
+        "ctl_admission_ceiling",
+        "ctl_shed_floor",
+        "ctl_chunk_target_ms",
+        "ctl_lease_scale",
+        "ctl_last_reason",
+    )
+    s = ControlSignals(
+        ctl_admission_ceiling=512.0, ctl_shed_floor=2.0,
+        ctl_chunk_target_ms=1.5, ctl_lease_scale=0.5,
+        ctl_last_reason="headroom_burn",
+    )
+    # ctl_last_reason is a string: dropped from the vector like
+    # top_namespace, so the numeric tail is exactly the four knobs
+    assert s.vector()[-4:] == [512.0, 2.0, 1.5, 0.5]
+    assert ControlSignals().vector()[-4:] == [0.0, 0.0, 0.0, 0.0]
+    assert ControlSignals().ctl_last_reason == ""
+
+
+def test_signal_bus_joins_controller_fields():
+    act = FakeActuator()
+    act.values["admission_ceiling"] = 256.0
+    act.values["shed_floor"] = 1.0
+    clock = Clock()
+    ctl = _controller(act, clock, mode="observe")
+    bus = SignalBus()
+    bus.attach_controller(ctl)
+    snap = bus.snapshot()
+    assert snap.ctl_admission_ceiling == 256.0
+    assert snap.ctl_shed_floor == 1.0
+    assert snap.ctl_lease_scale == 1.0
+    # without a controller attached the tail stays neutral — the off
+    # path's snapshot schema is unchanged
+    bare = SignalBus().snapshot()
+    assert bare.ctl_admission_ceiling == 0.0
+    assert bare.ctl_last_reason == ""
+
+
+# -- the knob envelopes -------------------------------------------------------
+
+
+def test_knobspec_slew_envelope():
+    chunk = KnobSpec("chunk_target_ms", lo=0.5, hi=8.0, slew=0.25,
+                     neutral=2.0)
+    # multiplicative: at most 25% of current per tick, either way
+    assert chunk.slewed(2.0, 8.0) == 2.5
+    assert chunk.slewed(2.0, 0.5) == 1.5
+    # the drift gate's scale tightens the same envelope
+    assert chunk.slewed(2.0, 8.0, scale=0.25) == 2.125
+    # bounds always win over the target
+    assert chunk.slewed(0.6, 0.1) == 0.5
+    floor = KnobSpec("shed_floor", lo=0, hi=3, slew=1.0, neutral=0,
+                     integer=True, additive=True)
+    # additive integer knob: one class per tick, clamped to [0, 3]
+    assert floor.slewed(0, 3) == 1.0
+    assert floor.slewed(3, 0) == 2.0
+    assert floor.slewed(3, 9) == 3.0
+
+
+def test_server_actuator_binds_live_subsystems():
+    from types import SimpleNamespace
+
+    from limitador_tpu.admission.controller import AdmissionController
+    from limitador_tpu.admission.overload import AdaptiveLimiter
+    from limitador_tpu.tpu.batcher import ChunkPlanner
+
+    overload = AdaptiveLimiter(max_inflight=1024)
+    admission = AdmissionController(mode="monitor", overload=overload)
+    planners = [ChunkPlanner(), ChunkPlanner()]
+    broker = SimpleNamespace(grant_scale=1.0)
+    act = ServerActuator(
+        overload=overload, admission=admission, planners=planners,
+        broker=broker,
+    )
+    names = [s.name for s in act.specs()]
+    assert names == [
+        "admission_ceiling", "shed_floor", "chunk_target_ms",
+        "lease_scale",
+    ]
+    # the ceiling envelope tops out at the configured hard max
+    ceiling = act.specs()[0]
+    assert ceiling.hi == 1024.0 and ceiling.neutral == 1024.0
+    assert act.read() == {
+        "admission_ceiling": 1024.0, "shed_floor": 0.0,
+        "chunk_target_ms": 2.0, "lease_scale": 1.0,
+    }
+    # applies land on the subsystems (ALL planner lanes retarget)
+    assert act.apply("admission_ceiling", 256) == 256.0
+    assert overload.max_inflight == 256
+    assert act.apply("shed_floor", 2) == 2.0
+    assert admission.shed_floor == 2
+    assert act.apply("chunk_target_ms", 1.0) == 1.0
+    assert all(p.target_s == 0.001 for p in planners)
+    assert act.apply("lease_scale", 2.0) == 2.0
+    assert broker.grant_scale == 2.0
+    # no coordinator: no membership axis
+    assert act.hosts() == 0
+    assert not act.can_grow() and not act.can_shrink()
+
+
+def test_adaptive_limiter_ceiling_only_tightens():
+    from limitador_tpu.admission.overload import AdaptiveLimiter
+
+    overload = AdaptiveLimiter(max_inflight=1024)
+    assert overload.set_ceiling(100) == 100
+    assert overload.max_inflight == 100
+    assert overload.limit <= 100  # the live AIMD limit snaps down too
+    # the configured --max-inflight stays a hard cap
+    assert overload.set_ceiling(999_999) == 1024
+    assert overload.hard_max == 1024
+
+
+def test_chunk_planner_retarget_is_clamped():
+    from limitador_tpu.tpu.batcher import ChunkPlanner
+
+    planner = ChunkPlanner()
+    assert planner.retarget(0.004) == 0.004
+    assert planner.retarget(0.0) == ChunkPlanner.MIN_TARGET_S
+    assert planner.retarget(1.0) == ChunkPlanner.MAX_TARGET_S
+
+
+def test_admission_shed_floor_sheds_with_controller_reason():
+    from limitador_tpu.admission import SHED_REASONS
+    from limitador_tpu.admission.controller import (
+        AdmissionController,
+        AdmissionShed,
+    )
+    from limitador_tpu.admission.priority import PriorityResolver
+
+    assert "controller" in SHED_REASONS
+    adm = AdmissionController(
+        mode="enforce",
+        priorities=PriorityResolver(namespace_map={"bulk": 0}),
+    )
+    # floor 0 (the default): byte-identical to the pre-controller path
+    adm.admit("bulk").release()
+    adm.shed_floor = 1
+    with pytest.raises(AdmissionShed) as exc:
+        adm.admit("bulk")
+    assert exc.value.reason == "controller"
+    # classes at/above the floor still admit
+    adm.admit("api").release()
+    # monitor mode: counted, admitted anyway, slot accounting balanced
+    mon = AdmissionController(
+        mode="monitor",
+        priorities=PriorityResolver(namespace_map={"bulk": 0}),
+    )
+    mon.shed_floor = 1
+    ticket = mon.admit("bulk")
+    assert ticket.holds_slot
+    ticket.release()
+    assert mon.overload.inflight == 0
+    assert mon._shed_counts[("controller", "low")] == 1
+
+
+# -- modes --------------------------------------------------------------------
+
+
+def test_off_is_the_default_and_never_constructs(monkeypatch):
+    """The ``--capacity-controller off`` pin: the flag defaults to
+    off, and off is not a constructible controller mode — the server
+    wiring constructs nothing, byte-identical to PR 18."""
+    for var in ("TPU_CTL_MODE", "TPU_CTL_INTERVAL_S",
+                "TPU_CTL_SUSTAIN_S", "TPU_CTL_DWELL_S",
+                "TPU_CTL_STANDBY", "TPU_CTL_MIN_HOSTS",
+                "TPU_CTL_MAX_HOSTS", "TPU_CTL_GROW_HEADROOM",
+                "TPU_CTL_SHRINK_HEADROOM"):
+        monkeypatch.delenv(var, raising=False)
+    from limitador_tpu.server.__main__ import build_parser
+
+    args = build_parser().parse_args(["x.yaml", "tpu"])
+    assert args.capacity_controller == "off"
+    assert args.ctl_interval == 1.0
+    assert args.ctl_sustain == 5.0
+    assert args.ctl_dwell == 30.0
+    assert args.ctl_standby == ""
+    assert args.ctl_min_hosts == 1 and args.ctl_max_hosts == 8
+    assert CTL_MODES == ("off", "observe", "on")
+    with pytest.raises(ValueError):
+        CapacityController(FakeActuator(), mode="off")
+
+
+def test_observe_mode_computes_but_never_actuates():
+    """Observe parity: every decision is computed and recorded, no
+    knob moves, no membership call happens — ever."""
+    act = FakeActuator()
+    clock = Clock()
+    ctl = _controller(act, clock, mode="observe", sustain_s=2.0)
+    before = act.read()
+    last = _tick(ctl, clock, ControlSignals(**BURN), n=8)
+    assert act.applied == []
+    assert act.membership == []
+    assert act.read() == before
+    # ...but the would-have-done record is fully populated
+    assert last["would"]  # burn tightens ceiling/chunk, raises floor
+    assert last["membership"]["would"] == "add_host"
+    assert ctl.stats()["ctl_ticks"] == 8
+    assert ctl.stats()["ctl_knob_actuations"] == 0
+
+
+# -- the guard stack ----------------------------------------------------------
+
+
+def test_interlock_freezes_actuation_during_transition():
+    act = FakeActuator()
+    act.transition = True
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=0.0)
+    d = _tick(ctl, clock, ControlSignals(**BURN), n=3)
+    assert d["held"] == "interlock"
+    assert d["applied"] == {} and d["membership"] is None
+    assert act.applied == [] and act.membership == []
+    assert ctl.stats()["ctl_interlock_holds"] == 3
+    # the transition ending releases the hold on the next tick
+    act.transition = False
+    d = _tick(ctl, clock, ControlSignals(**BURN))
+    assert d["held"] != "interlock"
+    assert act.membership == ["add_host"]
+
+
+def test_drift_gate_damps_slews_and_freezes_membership():
+    act = FakeActuator()
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=0.0, drift_damp=0.25)
+    snap = ControlSignals(model_drift=1, **GROW)
+    d = _tick(ctl, clock, snap, n=10)
+    assert d["held"] == "drift_damped"
+    # headroom burn would grow — but a drifted model must not steer
+    # topology, no matter how long the burn sustains
+    assert act.membership == []
+    # the chunk knob still moves, inside a quarter-size envelope:
+    # full slew from 2.0 toward budget/2 = 1.0 would step to 1.5;
+    # damped it steps 0.125 to 1.875 on the first tick
+    first_chunk = next(
+        v for (name, v) in act.applied if name == "chunk_target_ms"
+    )
+    assert first_chunk == 1.875
+
+
+def test_membership_hysteresis_up_down_up_ramp_flaps_at_most_once():
+    """THE anti-flap pin: a diurnal up-down-up ramp — bursts shorter
+    than the sustain window, then one real sustained burn, then noise
+    again — produces at most ONE membership change."""
+    act = FakeActuator()
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=5.0, dwell_s=30.0)
+    grow, hold, idle = (
+        ControlSignals(**GROW), ControlSignals(**HOLD),
+        ControlSignals(**IDLE),
+    )
+    # up (4 ticks < sustain) -> down (dead band resets) -> up again
+    _tick(ctl, clock, grow, n=4)
+    _tick(ctl, clock, hold, n=2)
+    _tick(ctl, clock, grow, n=4)
+    _tick(ctl, clock, hold, n=2)
+    assert act.membership == []  # sub-sustain bursts never actuate
+    # one genuinely sustained burn crosses the sustain gate once
+    _tick(ctl, clock, grow, n=6)
+    assert act.membership == ["add_host"]
+    # immediately idle: the shrink desire sustains, but the dwell
+    # clock (30s since the grow) holds it — no flap
+    d = _tick(ctl, clock, idle, n=8)
+    assert act.membership == ["add_host"]
+    assert d["membership"]["held"] == "dwell"
+    # ...and once the pod has dwelt, the sustained idle drains
+    clock.advance(30.0)
+    _tick(ctl, clock, idle, n=7)
+    assert act.membership == ["add_host", "drain_host"]
+
+
+def test_membership_respects_feasibility():
+    act = FakeActuator()
+    act.grow_ok = False
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=1.0)
+    d = _tick(ctl, clock, ControlSignals(**GROW), n=4)
+    assert act.membership == []
+    assert d["membership"]["held"] == "infeasible"
+
+
+# -- events + metrics ---------------------------------------------------------
+
+
+def test_membership_event_precedes_the_join_chain():
+    """The causal chain: controller_actuation is emitted BEFORE the
+    resize path drives, so the timeline reads controller_actuation <
+    join_begin (< epoch_bump < join_end on a live pod — the drill
+    asserts the full chain)."""
+    events = PodEventLog(host_id=0)
+    act = FakeActuator(events=events)
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=0.0, events=events)
+    _tick(ctl, clock, ControlSignals(**GROW))
+    seq = {e["kind"]: e["seq"] for e in events.snapshot()}
+    assert seq["controller_actuation"] < seq["join_begin"]
+    actuation = events.snapshot(kind="controller_actuation")[0]
+    assert actuation["detail"]["action"] == "add_host"
+    assert actuation["detail"]["reason"] == "headroom_burn"
+
+
+def test_shed_floor_jump_emits_controller_actuation():
+    events = PodEventLog(host_id=0)
+    act = FakeActuator()
+    clock = Clock()
+    ctl = _controller(act, clock, events=events)
+    # headroom in the dead band: pure SLO burn, no membership desire
+    _tick(ctl, clock, ControlSignals(
+        slo_burn_5m=1.5, capacity_headroom_ratio=2.0,
+    ))
+    jumps = events.snapshot(kind="controller_actuation")
+    assert len(jumps) == 1
+    assert jumps[0]["detail"] == {
+        "action": "shed_floor", "from_floor": 0.0, "to_floor": 1.0,
+        "reason": "slo_burn",
+    }
+
+
+def test_trigger_engine_fires_on_controller_actuation():
+    """Satellite (ISSUE 20): the flight recorder's TriggerEngine
+    watches the controller_actuation pod-event kind — every autoscale
+    decision leaves an incident bundle."""
+    from limitador_tpu.observability.flight import (
+        TRIGGER_REASONS,
+        BundleSpool,
+        FlightRecorder,
+        TriggerEngine,
+    )
+
+    assert "controller_actuation" in TRIGGER_REASONS
+    assert (
+        TriggerEngine.EVENT_TRIGGERS["controller_actuation"]
+        == "controller_actuation"
+    )
+    import tempfile
+
+    events = PodEventLog(host_id=0)
+    with tempfile.TemporaryDirectory() as spool_dir:
+        rec = FlightRecorder(sample_stride=1)
+        eng = TriggerEngine(rec, BundleSpool(spool_dir), events=events)
+        eng.tick()  # first tick primes baselines
+        events.emit(
+            "controller_actuation", action="add_host", hosts=2,
+            reason="headroom_burn",
+        )
+        eng.tick()
+        assert eng.trigger_counts["controller_actuation"] == 1
+        assert eng.spool.list()
+
+
+def test_controller_metrics_and_debug_surfaces():
+    from limitador_tpu.observability import PrometheusMetrics
+
+    act = FakeActuator()
+    clock = Clock()
+    ctl = _controller(act, clock, sustain_s=0.0)
+    _tick(ctl, clock, ControlSignals(**BURN), n=2)
+    metrics = PrometheusMetrics()
+    metrics.attach_render_hook(ctl)
+    text = metrics.render().decode()
+    assert f"ctl_mode {float(CTL_MODES.index('on'))}" in text
+    # two burn ticks stepped the additive floor twice (slew 1/tick)
+    assert 'ctl_knob{knob="shed_floor"} 2.0' in text
+    assert 'ctl_actuations_total{knob="shed_floor"} 2.0' in text
+    assert 'ctl_membership_actions_total{action="add_host"} 1.0' in text
+    assert "ctl_pressure 5.0" in text  # queue 10ms / 2ms budget
+    # second render: the delta-sync counters must not double-count
+    text = metrics.render().decode()
+    assert 'ctl_actuations_total{knob="shed_floor"} 2.0' in text
+    # the /debug/stats section
+    dbg = ctl.controller_debug()
+    assert dbg["mode"] == "on"
+    assert dbg["membership_actions"]["add_host"] == 1
+    assert dbg["hosts"] == 3
+    assert dbg["decisions"] and dbg["last_proposal"]
+    assert [s["name"] for s in dbg["specs"]] == [
+        s.name for s in KNOBS
+    ]

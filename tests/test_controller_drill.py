@@ -1,0 +1,202 @@
+"""Self-driving autoscale drill (ISSUE 20 acceptance).
+
+``make controller-drill``: a live 2-host pod — in-process frontend
+host 0, member 1 and a warm standby as REAL subprocesses — soaked with
+decision traffic while the capacity controller runs in ``on`` mode.
+Sustained burn makes the controller grow the pod 2 -> 3 by promoting
+the warm standby over the PR 18 join path; ramp noise (bursts shorter
+than the sustain window) must not move topology; sustained idle
+shrinks it back to 2 once the dwell expires, returning the drained
+host's address to the standby pool. Zero failed answers through the
+whole window, exactly one grow + one shrink (zero flaps), and the
+causal ``controller_actuation < join_begin < epoch_bump < join_end``
+chain on the pod timeline.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from limitador_tpu.routing import PodRouter, PodTopology
+
+from tests.test_pod_join_drill import (
+    MEMBER_WORKER,
+    STANDBY_WORKER,
+    _free_port,
+    _spawn,
+)
+
+
+@pytest.mark.slow
+def test_controller_drill_grows_and_shrinks_a_live_pod(tmp_path):
+    pytest.importorskip("grpc")
+    from limitador_tpu import Context, RateLimiter
+    from limitador_tpu.control import CapacityController, ServerActuator
+    from limitador_tpu.observability.signals import ControlSignals
+    from limitador_tpu.server.peering import (
+        PeerLane,
+        PodFrontend,
+        PodResilience,
+    )
+    from limitador_tpu.server.resize import PodResizeCoordinator
+    from limitador_tpu.storage.in_memory import InMemoryStorage
+
+    from tests.pod_resize_worker import RESIZE_NAMESPACE, resize_limits
+
+    port0, port1, port2 = _free_port(), _free_port(), _free_port()
+    addr0 = f"127.0.0.1:{port0}"
+    addr1 = f"127.0.0.1:{port1}"
+    addr2 = f"127.0.0.1:{port2}"
+
+    proc1, _stop1, _out1 = _spawn(
+        [str(MEMBER_WORKER), "--listen", addr1, "--host-id", "1",
+         "--hosts", "2", "--peer", f"0={addr0}"],
+        tmp_path, "member1",
+    )
+    proc2, _stop2, _out2 = _spawn(
+        [str(STANDBY_WORKER), "--listen", addr2],
+        tmp_path, "standby",
+    )
+
+    cfg = PodResilience(
+        degraded=True, retry=True, breaker_failures=2,
+        breaker_reset_s=0.2, probe_interval_s=0.1, retry_backoff_ms=1.0,
+    )
+    lane = PeerLane(0, addr0, {1: addr1}, None, resilience=cfg)
+    lane.start()
+    frontend = PodFrontend(
+        RateLimiter(InMemoryStorage(8192)),
+        PodRouter(PodTopology(hosts=2, host_id=0, shards_per_host=1)),
+        lane, resilience=cfg,
+    )
+    coordinator = PodResizeCoordinator(
+        frontend,
+        peers={0: addr0, 1: addr1},
+        listen_address=addr0,
+        transition_timeout_s=20.0,
+    )
+    frontend.attach_resize(coordinator)
+    asyncio.run(frontend.configure_with(resize_limits()))
+
+    # the controller drives the SAME coordinator the server wires: the
+    # warm standby is its only grow headroom, min_hosts floors the drain
+    actuator = ServerActuator(
+        coordinator=coordinator, standby_addresses=[addr2],
+        min_hosts=2, max_hosts=3,
+    )
+    controller = CapacityController(
+        actuator, events=frontend.events, mode="on",
+        interval_s=0.1, sustain_s=0.4, dwell_s=2.0,
+    )
+
+    burn = ControlSignals(capacity_headroom_ratio=1.0)   # grow band
+    hold = ControlSignals(capacity_headroom_ratio=2.0)   # dead band
+    idle = ControlSignals(capacity_headroom_ratio=4.0)   # shrink band
+
+    failed = []
+    users = [f"ctl-{i}" for i in range(24)]
+
+    def soak(tag, rounds=1):
+        for r in range(rounds):
+            for u in users:
+                try:
+                    got = asyncio.run(
+                        frontend.check_rate_limited_and_update(
+                            RESIZE_NAMESPACE, Context({"u": u}), 1,
+                            False,
+                        )
+                    )
+                except Exception as exc:
+                    failed.append((tag, r, u, f"{exc}"))
+                    continue
+                if got is None:
+                    failed.append((tag, r, u, "no answer"))
+
+    def drive(snapshot, tag, until, timeout_s=20.0):
+        """Tick the controller against ``snapshot`` while soaking,
+        until the predicate holds (or the deadline trips)."""
+        deadline = time.time() + timeout_s
+        while not until():
+            assert time.time() < deadline, (
+                f"{tag}: never converged "
+                f"(debug={controller.controller_debug()})"
+            )
+            controller.tick(snapshot)
+            soak(tag)
+            time.sleep(0.05)
+
+    try:
+        # phase A: calm 2-host soak — the dead band never actuates
+        for _ in range(6):
+            controller.tick(hold)
+            soak("calm")
+            time.sleep(0.05)
+        assert actuator.hosts() == 2
+        assert controller.stats()["ctl_hosts_added"] == 0
+
+        # phase B: sustained burn under fire — the controller promotes
+        # the warm standby (2 -> 3) over the join path
+        drive(burn, "grow", lambda: actuator.hosts() == 3)
+        assert controller.stats()["ctl_hosts_added"] == 1
+        assert actuator.standby_pool() == []  # consumed by the join
+        assert coordinator.stats()["join_completed"] == 1
+        assert coordinator.stats()["join_aborted"] == 0
+
+        # phase C: ramp noise — up-down-up bursts shorter than the
+        # sustain window (and inside the dwell) must not flap topology
+        for _ in range(2):
+            for _ in range(2):
+                controller.tick(burn)
+                soak("ramp")
+                time.sleep(0.05)
+            for _ in range(2):
+                controller.tick(hold)
+                soak("ramp")
+                time.sleep(0.05)
+        assert actuator.hosts() == 3
+        assert controller.stats()["ctl_hosts_drained"] == 0
+
+        # phase D: sustained idle — once the dwell expires the
+        # controller drains the tail host back to the 2-host floor
+        drive(idle, "shrink", lambda: actuator.hosts() == 2)
+        assert controller.stats()["ctl_hosts_drained"] == 1
+        # the drained host's address came home: a later burn could
+        # re-promote it warm
+        assert actuator.standby_pool() == [addr2]
+
+        # keep serving on the shrunk topology
+        for _ in range(3):
+            controller.tick(idle)
+            soak("after")
+
+        # zero failed answers across the WHOLE window
+        assert not failed, failed[:5]
+
+        # exactly one grow + one shrink: zero flaps
+        stats = controller.stats()
+        assert stats["ctl_hosts_added"] == 1
+        assert stats["ctl_hosts_drained"] == 1
+        actuations = frontend.events.snapshot(kind="controller_actuation")
+        assert [e["detail"]["action"] for e in actuations] == [
+            "add_host", "drain_host",
+        ]
+        assert actuations[0]["detail"]["reason"] == "headroom_burn"
+        assert actuations[1]["detail"]["reason"] == "headroom_idle"
+
+        # the causal chain: the controller's decision precedes the
+        # join it drove, which precedes the epoch bump and the commit
+        seq = {}
+        for event in frontend.events_debug()["events"]:
+            seq.setdefault(event["kind"], event["seq"])
+        assert (
+            seq["controller_actuation"]
+            < seq["join_begin"]
+            < seq["epoch_bump"]
+            < seq["join_end"]
+        ), seq
+    finally:
+        for proc in (proc1, proc2):
+            if proc.poll() is None:
+                proc.kill()
+        lane.stop()

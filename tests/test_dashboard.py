@@ -291,6 +291,21 @@ def test_dashboard_slo_alert_panel_gated_on_device_backing():
             ), f"panel {p.get('title')!r} presents slo_breached ungated"
 
 
+def test_dashboard_covers_controller_families():
+    """ISSUE 20: the capacity controller ships WITH its Grafana row —
+    a "Capacity controller" row exists and every family the controller
+    owns (control.METRIC_FAMILIES) is referenced by at least one panel
+    expression."""
+    doc = json.loads(DASHBOARD.read_text())
+    rows = {p["title"] for p in doc["panels"] if p["type"] == "row"}
+    assert any("capacity controller" in r.lower() for r in rows)
+    exprs = "\n".join(dashboard_exprs())
+    from limitador_tpu.control import METRIC_FAMILIES
+
+    for family in METRIC_FAMILIES:
+        assert family in exprs, f"no panel queries {family}"
+
+
 def test_dashboard_metrics_all_exported():
     names = exported_names()
     missing = set()

@@ -79,10 +79,11 @@ def _drive(est, n, speed=1.0, slow=1.0, lease=0.0, noise=0.02,
 
 def test_control_signals_tail_order_is_pinned():
     """The observation vector is the adaptive controller's input
-    contract: the ISSUE 14 model fields append at the very END, after
-    the ISSUE 11/12 pod tail, and nothing ever reshuffles. This test
-    IS the pin (the full-order pin lives in test_pod_plane)."""
-    assert ControlSignals.FIELDS[-3:] == (
+    contract: the ISSUE 14 model fields append after the ISSUE 11/12
+    pod tail (the ISSUE 20 controller tail now sits after them) and
+    nothing ever reshuffles. This test IS the pin (the full-order pin
+    lives in test_pod_plane)."""
+    assert ControlSignals.FIELDS[-8:-5] == (
         "model_r2",
         "capacity_headroom_ratio",
         "model_drift",
@@ -90,9 +91,9 @@ def test_control_signals_tail_order_is_pinned():
     s = ControlSignals(
         model_r2=0.9, capacity_headroom_ratio=2.5, model_drift=1
     )
-    assert s.vector()[-3:] == [0.9, 2.5, 1.0]
+    assert s.vector()[-7:-4] == [0.9, 2.5, 1.0]
     # defaults: schema identical with no estimator attached
-    assert ControlSignals().vector()[-3:] == [0.0, 0.0, 0.0]
+    assert ControlSignals().vector()[-7:-4] == [0.0, 0.0, 0.0]
 
 
 def test_signal_bus_joins_model_fields():

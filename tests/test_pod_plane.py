@@ -346,11 +346,19 @@ def test_control_signals_field_order_is_pinned():
         "peers_suspect",
         "peers_down",
         "pod_degraded_share",
-        # serving-model observatory tail (ISSUE 14), appended LAST —
-        # also pinned (with the full order) by tests/test_model.py
+        # serving-model observatory tail (ISSUE 14) — also pinned
+        # (with the full order) by tests/test_model.py
         "model_r2",
         "capacity_headroom_ratio",
         "model_drift",
+        # capacity-controller tail (ISSUE 20), appended LAST — the
+        # active knob values plus the last actuation reason, so every
+        # decision exemplar records what the controller was holding
+        "ctl_admission_ceiling",
+        "ctl_shed_floor",
+        "ctl_chunk_target_ms",
+        "ctl_lease_scale",
+        "ctl_last_reason",
     )
 
 
@@ -370,6 +378,9 @@ def test_control_signals_vector_order_is_pinned():
         pod_routed_share=0.75, peers_up=2, peers_suspect=1,
         peers_down=1, pod_degraded_share=0.125,
         model_r2=0.93, capacity_headroom_ratio=1.4, model_drift=1,
+        ctl_admission_ceiling=512.0, ctl_shed_floor=1.0,
+        ctl_chunk_target_ms=2.0, ctl_lease_scale=1.5,
+        ctl_last_reason="slo_burn",
     )
     assert s.vector() == [
         1.0, 2.0, 0.5, 1.0,              # ts, queue, fill, breaker
@@ -378,7 +389,9 @@ def test_control_signals_vector_order_is_pinned():
         10.0, 11.0, 12.0, 13.0, 14.0,    # native p99s in _PHASES order
         0.1, 0.2, 1.0, 27.5, 1.0, 3.0,   # slo/box/device/near
         0.75, 2.0, 1.0, 1.0, 0.125,      # the pod tail
-        0.93, 1.4, 1.0,                  # the model tail, appended LAST
+        0.93, 1.4, 1.0,                  # the model tail
+        512.0, 1.0, 2.0, 1.5,            # the controller tail, LAST
+        # (ctl_last_reason is a string — excluded like top_namespace)
     ]
 
 
@@ -396,11 +409,12 @@ def test_signal_bus_joins_pod_fields():
     snap = bus.snapshot()
     assert snap.pod_routed_share == 0.9
     assert snap.peers_down == 1
-    # the pod slice sits just above the ISSUE 14 model tail (last 3)
-    assert snap.vector()[-8:-3] == [0.9, 3.0, 0.0, 1.0, 0.05]
+    # the pod slice sits above the ISSUE 14 model tail (3) and the
+    # ISSUE 20 controller tail (4 numeric fields)
+    assert snap.vector()[-12:-7] == [0.9, 3.0, 0.0, 1.0, 0.05]
     # without a pod the tail stays at neutral defaults (same schema)
     bare = SignalBus().snapshot()
-    assert bare.vector()[-8:-3] == [0.0, 0.0, 0.0, 0.0, 0.0]
+    assert bare.vector()[-12:-7] == [0.0, 0.0, 0.0, 0.0, 0.0]
 
 
 # -- metrics + HTTP surfaces ---------------------------------------------------
